@@ -1,0 +1,10 @@
+"""dynamo_tpu: a TPU-native distributed LLM inference serving framework.
+
+A ground-up rebuild of the capabilities of NVIDIA Dynamo (see SURVEY.md) with a
+JAX/XLA/Pallas engine at the core: OpenAI-compatible frontend, component-model
+distributed runtime with pluggable request/event planes, KV-cache-aware radix
+routing, disaggregated prefill/decode over separate XLA programs, multi-tier
+KV block management (HBM -> host DRAM -> disk), request migration, SLA planner.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,60 @@
+"""Distributed runtime: component model, request/event planes, discovery."""
+
+from .component import (
+    Client,
+    Component,
+    Endpoint,
+    Instance,
+    Namespace,
+    RouterMode,
+    ServedEndpoint,
+)
+from .config import RuntimeConfig
+from .discovery.store import (
+    EventType,
+    FileKVStore,
+    KVStore,
+    MemKVStore,
+    WatchEvent,
+    make_store,
+)
+from .distributed import DistributedRuntime, make_runtime
+from .engine import AsyncEngine, Context, FnEngine, Operator, collect
+from .event_plane.base import EventPlane, InProcEventPlane, Subscription
+from .logging import get_logger, init_logging
+from .metrics import MetricsScope
+from .request_plane.tcp import NoResponders, RequestPlaneError, TcpClient, TcpRequestServer
+
+__all__ = [
+    "AsyncEngine",
+    "Client",
+    "Component",
+    "Context",
+    "DistributedRuntime",
+    "Endpoint",
+    "EventPlane",
+    "EventType",
+    "FileKVStore",
+    "FnEngine",
+    "InProcEventPlane",
+    "Instance",
+    "KVStore",
+    "MemKVStore",
+    "MetricsScope",
+    "Namespace",
+    "NoResponders",
+    "Operator",
+    "RequestPlaneError",
+    "RouterMode",
+    "RuntimeConfig",
+    "ServedEndpoint",
+    "Subscription",
+    "TcpClient",
+    "TcpRequestServer",
+    "WatchEvent",
+    "collect",
+    "get_logger",
+    "init_logging",
+    "make_runtime",
+    "make_store",
+]

@@ -1,0 +1,123 @@
+"""Layered runtime configuration + centralized environment-variable catalog.
+
+Analog of the reference's figment-based RuntimeConfig (lib/runtime/src/config.rs)
+and its ``DYN_*`` env catalog (lib/runtime/src/config/environment_names.rs).
+We use a ``DTPU_*`` prefix. Precedence: explicit kwargs > env > defaults
+(code that passes a value means it; env configures what code left open).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+# ---------------------------------------------------------------------------
+# Environment variable catalog (single source of truth for names)
+# ---------------------------------------------------------------------------
+
+ENV_LOG = "DTPU_LOG"                                  # log level (debug/info/warn/error)
+ENV_LOG_JSONL = "DTPU_LOGGING_JSONL"                  # structured JSONL logging on/off
+ENV_REQUEST_PLANE = "DTPU_REQUEST_PLANE"              # tcp | http | inproc
+ENV_EVENT_PLANE = "DTPU_EVENT_PLANE"                  # zmq | inproc
+ENV_STORE = "DTPU_STORE"                              # mem | file | etcd
+ENV_STORE_PATH = "DTPU_STORE_PATH"                    # path for the file store
+ENV_SYSTEM_PORT = "DTPU_SYSTEM_PORT"                  # system status server port
+ENV_SYSTEM_HOST = "DTPU_SYSTEM_HOST"
+ENV_HOST_IP = "DTPU_HOST_IP"                          # advertised host for request plane
+ENV_LEASE_TTL_S = "DTPU_LEASE_TTL_S"                  # discovery lease ttl
+ENV_NAMESPACE = "DTPU_NAMESPACE"
+ENV_KV_BLOCK_SIZE = "DTPU_KV_BLOCK_SIZE"              # tokens per kv block
+ENV_ROUTER_REPLICA_SYNC = "DTPU_ROUTER_REPLICA_SYNC"
+ENV_MIGRATION_LIMIT = "DTPU_MIGRATION_LIMIT"
+ENV_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT = "DTPU_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT"
+ENV_CANARY_WAIT_TIME = "DTPU_CANARY_WAIT_TIME"
+ENV_KVBM_HOST_CACHE_GB = "DTPU_KVBM_HOST_CACHE_GB"    # G2 host DRAM pool size
+ENV_KVBM_DISK_CACHE_GB = "DTPU_KVBM_DISK_CACHE_GB"    # G3 local disk pool size
+ENV_KVBM_DISK_PATH = "DTPU_KVBM_DISK_PATH"
+ENV_HTTP_PORT = "DTPU_HTTP_PORT"
+ENV_BUSY_THRESHOLD = "DTPU_BUSY_THRESHOLD"
+ENV_AUDIT_SINKS = "DTPU_AUDIT_SINKS"
+
+_TRUTHY = {"1", "true", "yes", "on", "enabled"}
+_FALSEY = {"0", "false", "no", "off", "disabled", ""}
+
+
+def is_truthy(val: Optional[str]) -> bool:
+    """Permissive env-var boolean parsing (reference: lib/config/src/lib.rs:20)."""
+    if val is None:
+        return False
+    return val.strip().lower() in _TRUTHY
+
+
+def is_falsey(val: Optional[str]) -> bool:
+    if val is None:
+        return True
+    return val.strip().lower() in _FALSEY
+
+
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return is_truthy(raw)
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    """Top-level runtime knobs; every field has an env override."""
+
+    request_plane: str = "tcp"           # tcp | http | inproc
+    event_plane: str = "zmq"             # zmq | inproc
+    store: str = "mem"                   # mem | file | etcd
+    store_path: str = "/tmp/dtpu_store"
+    host_ip: str = "127.0.0.1"
+    system_port: int = 0                 # 0 = disabled
+    lease_ttl_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 30.0
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "RuntimeConfig":
+        cfg = cls(
+            request_plane=env_str(ENV_REQUEST_PLANE, cls.request_plane),
+            event_plane=env_str(ENV_EVENT_PLANE, cls.event_plane),
+            store=env_str(ENV_STORE, cls.store),
+            store_path=env_str(ENV_STORE_PATH, cls.store_path),
+            host_ip=env_str(ENV_HOST_IP, cls.host_ip),
+            system_port=env_int(ENV_SYSTEM_PORT, cls.system_port),
+            lease_ttl_s=env_float(ENV_LEASE_TTL_S, cls.lease_ttl_s),
+            graceful_shutdown_timeout_s=env_float(
+                ENV_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT, cls.graceful_shutdown_timeout_s
+            ),
+        )
+        for k, v in overrides.items():
+            if v is not None:
+                setattr(cfg, k, v)
+        return cfg
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

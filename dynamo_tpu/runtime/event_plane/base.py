@@ -1,0 +1,74 @@
+"""Event plane interface: fire-and-forget pub/sub for KV events + metrics.
+
+Analog of the reference's event plane abstraction with NATS/ZMQ transports
+(lib/runtime/src/transports/event_plane/). Topics are dot-separated strings;
+subscriptions match by prefix. Payloads are opaque bytes (callers msgpack).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional, Tuple
+
+
+class Subscription:
+    def __init__(self):
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+
+    def _emit(self, topic: str, payload: bytes) -> None:
+        if not self._closed:
+            self._queue.put_nowait((topic, payload))
+
+    def __aiter__(self) -> AsyncIterator[Tuple[str, bytes]]:
+        return self
+
+    async def __anext__(self) -> Tuple[str, bytes]:
+        item = await self._queue.get()
+        if item is None:
+            raise StopAsyncIteration
+        return item
+
+    async def next(self, timeout: Optional[float] = None) -> Optional[Tuple[str, bytes]]:
+        try:
+            item = await asyncio.wait_for(self._queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        return item
+
+    def cancel(self) -> None:
+        self._closed = True
+        self._queue.put_nowait(None)
+
+
+class EventPlane:
+    async def publish(self, topic: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    async def subscribe(self, topic_prefix: str) -> Subscription:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class InProcEventPlane(EventPlane):
+    """Same-process pub/sub: deterministic and instant, the test default."""
+
+    def __init__(self):
+        self._subs: list = []  # (prefix, Subscription)
+
+    async def publish(self, topic: str, payload: bytes) -> None:
+        for prefix, sub in list(self._subs):
+            if topic.startswith(prefix):
+                sub._emit(topic, payload)
+
+    async def subscribe(self, topic_prefix: str) -> Subscription:
+        sub = Subscription()
+        self._subs.append((topic_prefix, sub))
+        return sub
+
+    async def close(self) -> None:
+        for _, sub in self._subs:
+            sub.cancel()
+        self._subs.clear()

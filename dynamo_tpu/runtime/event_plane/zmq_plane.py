@@ -1,0 +1,155 @@
+"""ZMQ event plane: XPUB/XSUB broker + connecting pub/sub endpoints.
+
+Analog of the reference's ZMQ event transport
+(lib/runtime/src/transports/event_plane/zmq_transport.rs). Many publishers and
+many subscribers meet at a small forwarding broker whose address lives in the
+discovery store under ``v1/event_broker``; the first runtime to come up starts
+it (lease-attached, so a crashed broker host is detected and replaced).
+
+Wire format: multipart [topic: utf-8, payload: bytes].
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+import zmq
+import zmq.asyncio
+
+from ..discovery.store import KVStore
+from ..logging import get_logger
+from .base import EventPlane, Subscription
+
+log = get_logger("runtime.event_plane.zmq")
+
+BROKER_KEY = "v1/event_broker"
+
+
+class ZmqBroker:
+    """XSUB (publishers connect) <-> XPUB (subscribers connect) forwarder."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._host = host
+        self._ctx = zmq.asyncio.Context.instance()
+        self._xsub: Optional[zmq.asyncio.Socket] = None
+        self._xpub: Optional[zmq.asyncio.Socket] = None
+        self._task: Optional[asyncio.Task] = None
+        self.pub_addr = ""   # where publishers connect (broker's XSUB bind)
+        self.sub_addr = ""   # where subscribers connect (broker's XPUB bind)
+
+    async def start(self) -> None:
+        self._xsub = self._ctx.socket(zmq.XSUB)
+        xsub_port = self._xsub.bind_to_random_port(f"tcp://{self._host}")
+        self._xpub = self._ctx.socket(zmq.XPUB)
+        self._xpub.setsockopt(zmq.XPUB_VERBOSE, 1)
+        xpub_port = self._xpub.bind_to_random_port(f"tcp://{self._host}")
+        self.pub_addr = f"tcp://{self._host}:{xsub_port}"
+        self.sub_addr = f"tcp://{self._host}:{xpub_port}"
+        self._task = asyncio.create_task(self._forward())
+        log.debug("zmq broker up: pub=%s sub=%s", self.pub_addr, self.sub_addr)
+
+    async def _forward(self) -> None:
+        assert self._xsub is not None and self._xpub is not None
+        poller = zmq.asyncio.Poller()
+        poller.register(self._xsub, zmq.POLLIN)
+        poller.register(self._xpub, zmq.POLLIN)
+        try:
+            while True:
+                events = dict(await poller.poll())
+                if self._xsub in events:
+                    msg = await self._xsub.recv_multipart()
+                    await self._xpub.send_multipart(msg)
+                if self._xpub in events:
+                    msg = await self._xpub.recv_multipart()  # subscription frames
+                    await self._xsub.send_multipart(msg)
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        for s in (self._xsub, self._xpub):
+            if s is not None:
+                s.close(0)
+
+
+class ZmqEventPlane(EventPlane):
+    def __init__(self, pub_addr: str, sub_addr: str, broker: Optional[ZmqBroker] = None):
+        self._ctx = zmq.asyncio.Context.instance()
+        self._pub = self._ctx.socket(zmq.PUB)
+        self._pub.connect(pub_addr)
+        self._sub_addr = sub_addr
+        self._broker = broker  # set if this plane founded the broker
+        self._sub_tasks: List[asyncio.Task] = []
+        self._sub_sockets: List[zmq.asyncio.Socket] = []
+        self._warmed = False
+
+    async def publish(self, topic: str, payload: bytes) -> None:
+        if not self._warmed:
+            # PUB->broker connect is async; without a beat the first publishes
+            # are dropped on the floor (zmq slow-joiner).
+            await asyncio.sleep(0.15)
+            self._warmed = True
+        await self._pub.send_multipart([topic.encode(), payload])
+
+    async def subscribe(self, topic_prefix: str) -> Subscription:
+        sock = self._ctx.socket(zmq.SUB)
+        sock.connect(self._sub_addr)
+        sock.setsockopt(zmq.SUBSCRIBE, topic_prefix.encode())
+        self._sub_sockets.append(sock)
+        sub = Subscription()
+
+        async def recv_loop() -> None:
+            try:
+                while True:
+                    topic, payload = await sock.recv_multipart()
+                    sub._emit(topic.decode(), payload)
+            except asyncio.CancelledError:
+                pass
+            except zmq.ZMQError:
+                pass
+
+        task = asyncio.create_task(recv_loop())
+        self._sub_tasks.append(task)
+        orig_cancel = sub.cancel
+
+        def cancel() -> None:
+            task.cancel()
+            sock.close(0)
+            orig_cancel()
+
+        sub.cancel = cancel  # type: ignore[method-assign]
+        await asyncio.sleep(0.15)  # let the broker see the subscription
+        return sub
+
+    async def close(self) -> None:
+        for t in self._sub_tasks:
+            t.cancel()
+        for s in self._sub_sockets:
+            s.close(0)
+        self._pub.close(0)
+        if self._broker is not None:
+            await self._broker.stop()
+
+
+async def event_plane_from_store(store: KVStore, lease_id: Optional[str] = None) -> EventPlane:
+    """Join (or found) the process-shared ZMQ event plane via the store.
+
+    Founding is racy (no compare-and-swap in the store interface), so after
+    publishing our broker we re-read: if another founder's record won, we tear
+    our broker down and join theirs — everyone converges on one broker.
+    """
+    rec = await store.get_obj(BROKER_KEY)
+    if rec is not None:
+        return ZmqEventPlane(rec["pub"], rec["sub"])
+    broker = ZmqBroker()
+    await broker.start()
+    ours = {"pub": broker.pub_addr, "sub": broker.sub_addr}
+    await store.put_obj(BROKER_KEY, ours, lease_id)
+    await asyncio.sleep(0.05)  # let a concurrent founder's put land
+    rec = await store.get_obj(BROKER_KEY) or ours
+    if rec != ours:
+        await broker.stop()
+        return ZmqEventPlane(rec["pub"], rec["sub"])
+    return ZmqEventPlane(rec["pub"], rec["sub"], broker=broker)

@@ -1,0 +1,93 @@
+"""Structured logging: human-readable or JSONL, env-configured.
+
+Analog of the reference's logging layer (lib/runtime/src/logging.rs) minus the
+OTLP exporter (gated: zero-egress environments); trace/request ids propagate
+through a contextvar and are stamped on every record.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import sys
+import time
+from typing import Optional
+
+from .config import ENV_LOG, ENV_LOG_JSONL, is_truthy
+
+_request_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dtpu_request_id", default=None
+)
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def set_request_id(rid: Optional[str]) -> None:
+    _request_id.set(rid)
+
+
+def get_request_id() -> Optional[str]:
+    return _request_id.get()
+
+
+class _JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        rec = {
+            "ts": round(time.time(), 6),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        rid = _request_id.get()
+        if rid:
+            rec["request_id"] = rid
+        if record.exc_info and record.exc_info[0] is not None:
+            rec["exception"] = self.formatException(record.exc_info)
+        return json.dumps(rec, separators=(",", ":"))
+
+
+class _TextFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        rid = _request_id.get()
+        prefix = f"[{rid[:8]}] " if rid else ""
+        base = super().format(record)
+        return base.replace(record.getMessage(), prefix + record.getMessage(), 1)
+
+
+_initialized = False
+
+
+def init_logging(level: Optional[str] = None, jsonl: Optional[bool] = None) -> None:
+    """Idempotent root logger setup for the dynamo_tpu.* hierarchy."""
+    global _initialized
+    if _initialized:
+        return
+    _initialized = True
+    lvl = _LEVELS.get((level or os.environ.get(ENV_LOG, "info")).lower(), logging.INFO)
+    use_jsonl = jsonl if jsonl is not None else is_truthy(os.environ.get(ENV_LOG_JSONL))
+    handler = logging.StreamHandler(sys.stderr)
+    if use_jsonl:
+        handler.setFormatter(_JsonlFormatter())
+    else:
+        handler.setFormatter(
+            _TextFormatter("%(asctime)s %(levelname)-5s %(name)s: %(message)s", "%H:%M:%S")
+        )
+    root = logging.getLogger("dynamo_tpu")
+    root.setLevel(lvl)
+    root.addHandler(handler)
+    root.propagate = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    if not name.startswith("dynamo_tpu"):
+        name = f"dynamo_tpu.{name}"
+    return logging.getLogger(name)

@@ -1,0 +1,134 @@
+"""Component model e2e: serve endpoints, discover via store, route requests."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    MemKVStore,
+    NoResponders,
+    RouterMode,
+    RuntimeConfig,
+)
+
+
+def make_rt(store):
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=1.0)
+    return DistributedRuntime(cfg, store=store)
+
+
+async def test_serve_and_route_round_robin():
+    store = MemKVStore()
+    async with make_rt(store) as worker_rt, make_rt(store) as frontend_rt:
+        hits = {"a": 0, "b": 0}
+
+        def make_handler(name):
+            async def handler(request, context):
+                hits[name] += 1
+                yield {"from": name, "echo": request}
+
+            return handler
+
+        ep = worker_rt.namespace("ns").component("backend").endpoint("generate")
+        served_a = await ep.serve(make_handler("a"))
+        served_b = await ep.serve(make_handler("b"))
+
+        client = await frontend_rt.namespace("ns").component("backend").endpoint(
+            "generate"
+        ).client(RouterMode.ROUND_ROBIN)
+        await client.wait_for_instances(2)
+
+        for i in range(6):
+            stream = await client.generate({"i": i})
+            [_ async for _ in stream]
+        assert hits == {"a": 3, "b": 3}
+
+        await client.stop()
+        await served_a.stop()
+        await served_b.stop()
+
+
+async def test_direct_routing_by_instance_id():
+    store = MemKVStore()
+    async with make_rt(store) as rt:
+        async def handler(request, context):
+            yield {"pong": True}
+
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        served = await ep.serve(handler)
+        client = await ep.client(RouterMode.DIRECT)
+        await client.wait_for_instances(1)
+        stream = await client.generate({}, instance_id=served.instance_id)
+        items = [x async for x in stream]
+        assert items == [{"pong": True}]
+        with pytest.raises(NoResponders):
+            await client.generate({}, instance_id=12345)
+        await client.stop()
+        await served.stop()
+
+
+async def test_instance_removed_on_stop():
+    store = MemKVStore()
+    async with make_rt(store) as rt:
+        async def handler(request, context):
+            yield {}
+
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        served = await ep.serve(handler)
+        client = await ep.client()
+        await client.wait_for_instances(1)
+        await served.stop()
+        for _ in range(50):
+            if not client.instances:
+                break
+            await asyncio.sleep(0.05)
+        assert not client.instances
+        await client.stop()
+
+
+async def test_lease_death_removes_instance():
+    """Worker runtime dies (lease expires) -> frontend client drops the instance."""
+    store = MemKVStore()
+    worker_rt = await make_rt(store).start()
+
+    async def handler(request, context):
+        yield {}
+
+    ep = worker_rt.namespace("ns").component("c").endpoint("e")
+    await ep.serve(handler)
+
+    async with make_rt(store) as frontend_rt:
+        client = await frontend_rt.namespace("ns").component("c").endpoint("e").client()
+        await client.wait_for_instances(1)
+
+        # simulate crash: stop keepalive without cleanup
+        worker_rt._keepalive_task.cancel()
+        for _ in range(100):
+            if not client.instances:
+                break
+            await asyncio.sleep(0.1)
+        assert not client.instances
+        await client.stop()
+
+
+async def test_metadata_update():
+    store = MemKVStore()
+    async with make_rt(store) as rt:
+        async def handler(request, context):
+            yield {}
+
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        served = await ep.serve(handler, metadata={"model": "m0"})
+        client = await ep.client()
+        insts = await client.wait_for_instances(1)
+        assert insts[0].metadata == {"model": "m0"}
+        await served.update_metadata({"ready": True})
+        for _ in range(50):
+            inst = client.instances.get(served.instance_id)
+            if inst and inst.metadata.get("ready"):
+                break
+            await asyncio.sleep(0.05)
+        assert client.instances[served.instance_id].metadata["ready"] is True
+        await client.stop()
+        await served.stop()

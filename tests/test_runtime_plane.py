@@ -1,0 +1,132 @@
+"""Request plane (TCP streaming RPC) + event plane tests."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import Context, InProcEventPlane, NoResponders, TcpClient, TcpRequestServer
+from dynamo_tpu.runtime.event_plane.zmq_plane import ZmqBroker, ZmqEventPlane
+
+
+async def echo_handler(request, context):
+    for i in range(request["n"]):
+        yield {"i": i, "msg": request["msg"]}
+
+
+async def test_tcp_stream_roundtrip():
+    server = TcpRequestServer(echo_handler)
+    addr = await server.start()
+    client = TcpClient()
+    stream = await client.call(addr, {"n": 3, "msg": "hi"})
+    items = [item async for item in stream]
+    assert items == [{"i": 0, "msg": "hi"}, {"i": 1, "msg": "hi"}, {"i": 2, "msg": "hi"}]
+    await client.close()
+    await server.stop()
+
+
+async def test_tcp_concurrent_multiplexed():
+    server = TcpRequestServer(echo_handler)
+    addr = await server.start()
+    client = TcpClient()
+
+    async def one(n):
+        stream = await client.call(addr, {"n": n, "msg": str(n)})
+        return [item["i"] async for item in stream]
+
+    results = await asyncio.gather(*[one(n) for n in range(1, 6)])
+    assert results == [list(range(n)) for n in range(1, 6)]
+    await client.close()
+    await server.stop()
+
+
+async def test_tcp_handler_error_propagates():
+    async def bad_handler(request, context):
+        yield {"ok": True}
+        raise ValueError("boom")
+
+    server = TcpRequestServer(bad_handler)
+    addr = await server.start()
+    client = TcpClient()
+    stream = await client.call(addr, {})
+    items = []
+    with pytest.raises(Exception, match="boom"):
+        async for item in stream:
+            items.append(item)
+    assert items == [{"ok": True}]
+    await client.close()
+    await server.stop()
+
+
+async def test_tcp_connect_refused_is_no_responders():
+    client = TcpClient()
+    with pytest.raises(NoResponders):
+        await client.call("127.0.0.1:1", {"n": 1})
+    await client.close()
+
+
+async def test_tcp_cancel_stops_server_side():
+    started = asyncio.Event()
+    cancelled = asyncio.Event()
+
+    async def slow_handler(request, context):
+        started.set()
+        for i in range(1000):
+            if context.is_stopped():
+                cancelled.set()
+                return
+            yield {"i": i}
+            await asyncio.sleep(0.01)
+
+    server = TcpRequestServer(slow_handler)
+    addr = await server.start()
+    client = TcpClient()
+    ctx = Context()
+    stream = await client.call(addr, {}, ctx)
+    seen = 0
+    async for _ in stream:
+        seen += 1
+        if seen == 3:
+            ctx.stop_generating()
+            break
+    await asyncio.wait_for(cancelled.wait(), 5)
+    assert seen == 3
+    await client.close()
+    await server.stop()
+
+
+async def test_context_tree_propagation():
+    root = Context("r")
+    child = root.child()
+    grandchild = child.child()
+    assert not grandchild.is_stopped()
+    root.stop_generating()
+    assert child.is_stopped() and grandchild.is_stopped()
+    assert not grandchild.is_killed()
+    root.kill()
+    assert grandchild.is_killed()
+
+
+async def test_inproc_event_plane():
+    plane = InProcEventPlane()
+    sub = await plane.subscribe("kv.")
+    await plane.publish("kv.events.w1", b"a")
+    await plane.publish("other.topic", b"b")
+    topic, payload = await asyncio.wait_for(sub.__anext__(), 5)
+    assert (topic, payload) == ("kv.events.w1", b"a")
+    assert sub._queue.empty()
+    await plane.close()
+
+
+async def test_zmq_event_plane_broker():
+    broker = ZmqBroker()
+    await broker.start()
+    pub_plane = ZmqEventPlane(broker.pub_addr, broker.sub_addr)
+    sub_plane = ZmqEventPlane(broker.pub_addr, broker.sub_addr)
+    sub = await sub_plane.subscribe("kv.events.")
+    await pub_plane.publish("kv.events.w1", b"payload1")
+    topic, payload = await asyncio.wait_for(sub.__anext__(), 10)
+    assert (topic, payload) == ("kv.events.w1", b"payload1")
+    sub.cancel()
+    await pub_plane.close()
+    await sub_plane.close()
+    await broker.stop()

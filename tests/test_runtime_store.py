@@ -1,0 +1,112 @@
+"""Discovery store tests: put/get/watch/lease semantics for mem + file backends."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import EventType, FileKVStore, MemKVStore
+
+
+@pytest.fixture(params=["mem", "file"])
+def store_factory(request, tmp_store_path):
+    def make():
+        if request.param == "mem":
+            return MemKVStore()
+        return FileKVStore(tmp_store_path)
+
+    return make
+
+
+async def test_put_get_delete(store_factory):
+    store = store_factory()
+    await store.put("v1/a", b"1")
+    assert await store.get("v1/a") == b"1"
+    await store.put("v1/a", b"2")
+    assert await store.get("v1/a") == b"2"
+    await store.delete("v1/a")
+    assert await store.get("v1/a") is None
+    await store.close()
+
+
+async def test_list_prefix(store_factory):
+    store = store_factory()
+    await store.put("v1/mdc/m1", b"a")
+    await store.put("v1/mdc/m2", b"b")
+    await store.put("v1/other/x", b"c")
+    items = await store.list_prefix("v1/mdc/")
+    assert items == {"v1/mdc/m1": b"a", "v1/mdc/m2": b"b"}
+    await store.close()
+
+
+async def test_watch_snapshot_then_stream(store_factory):
+    store = store_factory()
+    await store.put("v1/i/one", b"1")
+    watcher = await store.watch("v1/i/")
+
+    ev = await asyncio.wait_for(watcher.__anext__(), 5)
+    assert (ev.type, ev.key, ev.value) == (EventType.PUT, "v1/i/one", b"1")
+
+    await store.put("v1/i/two", b"2")
+    ev = await asyncio.wait_for(watcher.__anext__(), 5)
+    assert (ev.type, ev.key) == (EventType.PUT, "v1/i/two")
+
+    await store.delete("v1/i/one")
+    ev = await asyncio.wait_for(watcher.__anext__(), 5)
+    assert (ev.type, ev.key) == (EventType.DELETE, "v1/i/one")
+
+    watcher.cancel()
+    await store.close()
+
+
+async def test_lease_revoke_deletes_keys(store_factory):
+    store = store_factory()
+    lease = await store.create_lease(ttl_s=5.0)
+    await store.put("v1/i/leased", b"x", lease.id)
+    await store.put("v1/i/unleased", b"y")
+    assert await store.get("v1/i/leased") == b"x"
+    await store.revoke_lease(lease.id)
+    assert await store.get("v1/i/leased") is None
+    assert await store.get("v1/i/unleased") == b"y"
+    await store.close()
+
+
+async def test_mem_lease_expiry():
+    store = MemKVStore()
+    lease = await store.create_lease(ttl_s=0.3)
+    await store.put("v1/i/x", b"x", lease.id)
+    await asyncio.sleep(0.8)  # no keepalive -> reaper revokes
+    assert await store.get("v1/i/x") is None
+    await store.close()
+
+
+async def test_file_lease_expiry_without_keepalive(tmp_store_path):
+    writer = FileKVStore(tmp_store_path)
+    reader = FileKVStore(tmp_store_path)
+    lease = await writer.create_lease(ttl_s=0.2)
+    await writer.put("v1/i/x", b"x", lease.id)
+    assert await reader.get("v1/i/x") == b"x"
+    await asyncio.sleep(0.2 + FileKVStore.GRACE_S + 0.3)
+    assert await reader.get("v1/i/x") is None  # stale heartbeat -> dead
+    await writer.close()
+    await reader.close()
+
+
+async def test_file_store_cross_instance_watch(tmp_store_path):
+    """Two FileKVStore handles on the same dir see each other (cross-process model)."""
+    a = FileKVStore(tmp_store_path)
+    b = FileKVStore(tmp_store_path)
+    watcher = await b.watch("v1/")
+    await a.put("v1/k", b"v")
+    ev = await asyncio.wait_for(watcher.__anext__(), 5)
+    assert (ev.type, ev.key, ev.value) == (EventType.PUT, "v1/k", b"v")
+    watcher.cancel()
+    await a.close()
+    await b.close()
+
+
+async def test_obj_roundtrip(store_factory):
+    store = store_factory()
+    obj = {"name": "m", "n": 3, "nested": {"a": [1, 2]}, "blob": b"\x00\x01"}
+    await store.put_obj("v1/obj", obj)
+    assert await store.get_obj("v1/obj") == obj
+    await store.close()
