@@ -1,0 +1,35 @@
+"""KV-cache-aware routing: prefix index + cost-based worker selection."""
+
+from .indexer import ApproxKvIndexer, KvIndexer
+from .protocols import (
+    KvCacheEvent,
+    KvEventKind,
+    OverlapScores,
+    RouterEvent,
+    WorkerMetrics,
+    WorkerWithDpRank,
+)
+from .publisher import KvEventPublisher, WorkerMetricsPublisher, events_topic, metrics_topic
+from .radix_tree import RadixTree
+from .router import KvRouter
+from .scheduler import KvRouterConfig, KvScheduler, SchedulingDecision
+
+__all__ = [
+    "ApproxKvIndexer",
+    "KvCacheEvent",
+    "KvEventKind",
+    "KvEventPublisher",
+    "KvIndexer",
+    "KvRouter",
+    "KvRouterConfig",
+    "KvScheduler",
+    "OverlapScores",
+    "RadixTree",
+    "RouterEvent",
+    "SchedulingDecision",
+    "WorkerMetrics",
+    "WorkerMetricsPublisher",
+    "WorkerWithDpRank",
+    "events_topic",
+    "metrics_topic",
+]

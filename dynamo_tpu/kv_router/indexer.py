@@ -1,0 +1,115 @@
+"""KV indexers: event-driven (exact) and approximate (TTL-simulated).
+
+Analogs of the reference's KvIndexer (lib/kv-router/src/indexer.rs:453) and
+ApproxKvIndexer with its TTL PruneManager (lib/kv-router/src/approx.rs).
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Dict, List, Optional
+
+from ..runtime.logging import get_logger
+from ..tokens import SequenceHash
+from .protocols import KvEventKind, OverlapScores, RouterEvent, WorkerWithDpRank
+from .radix_tree import RadixTree
+
+log = get_logger("kv_router.indexer")
+
+
+class KvIndexer:
+    """Exact prefix index built from worker KV-cache events."""
+
+    def __init__(self, block_size: int = 16):
+        self.block_size = block_size
+        self.tree = RadixTree()
+        self._last_event_id: Dict[WorkerWithDpRank, int] = {}
+        self.events_applied = 0
+        self.events_dropped = 0
+
+    def apply(self, ev: RouterEvent) -> None:
+        last = self._last_event_id.get(ev.worker)
+        if ev.event_id and last is not None and ev.event_id <= last:
+            self.events_dropped += 1  # replay/duplicate
+            return
+        if ev.event_id:
+            self._last_event_id[ev.worker] = ev.event_id
+        kind = ev.event.kind
+        if kind == KvEventKind.STORED:
+            if ev.event.block_size and ev.event.block_size != self.block_size:
+                log.warning(
+                    "worker %s block_size %d != router %d; ignoring event",
+                    ev.worker, ev.event.block_size, self.block_size,
+                )
+                self.events_dropped += 1
+                return
+            self.tree.store(ev.worker, ev.event.block_hashes, ev.event.parent_hash)
+        elif kind == KvEventKind.REMOVED:
+            self.tree.remove(ev.worker, ev.event.block_hashes)
+        elif kind == KvEventKind.CLEARED:
+            self.tree.clear_worker(ev.worker)
+        self.events_applied += 1
+
+    def find_matches(self, block_hashes: List[SequenceHash]) -> OverlapScores:
+        return self.tree.find_matches(block_hashes)
+
+    def remove_worker(self, worker: WorkerWithDpRank) -> None:
+        self.tree.remove_worker(worker)
+        self._last_event_id.pop(worker, None)
+
+    def remove_worker_id(self, worker_id: int) -> None:
+        for w in [w for w in self.tree.workers() if w.worker_id == worker_id]:
+            self.remove_worker(w)
+
+    def block_count(self) -> int:
+        return len(self.tree)
+
+
+class ApproxKvIndexer:
+    """Eventless fallback: the router *assumes* whatever it routed is cached.
+
+    On each routed request, insert its block hashes for the chosen worker with
+    a TTL; a lazy min-heap prune expires entries (reference PruneManager,
+    lib/kv-router/src/approx.rs). Accuracy degrades under eviction pressure,
+    but no worker cooperation is required.
+    """
+
+    def __init__(self, block_size: int = 16, ttl_s: float = 120.0):
+        self.block_size = block_size
+        self.ttl_s = ttl_s
+        self.tree = RadixTree()
+        # (expiry_time, worker, seq_hash)
+        self._expiry_heap: List = []
+        self._expiry: Dict = {}  # (worker, seq_hash) -> latest expiry
+
+    def process_routed_request(
+        self, block_hashes: List[SequenceHash], worker: WorkerWithDpRank,
+        now: Optional[float] = None,
+    ) -> None:
+        now = time.monotonic() if now is None else now
+        expiry = now + self.ttl_s
+        self.tree.store(worker, block_hashes, None)
+        for sh in block_hashes:
+            self._expiry[(worker, sh)] = expiry
+            heapq.heappush(self._expiry_heap, (expiry, worker, sh))
+        self._prune(now)
+
+    def find_matches(
+        self, block_hashes: List[SequenceHash], now: Optional[float] = None
+    ) -> OverlapScores:
+        self._prune(time.monotonic() if now is None else now)
+        return self.tree.find_matches(block_hashes)
+
+    def remove_worker(self, worker: WorkerWithDpRank) -> None:
+        self.tree.remove_worker(worker)
+        self._expiry = {k: v for k, v in self._expiry.items() if k[0] != worker}
+
+    def _prune(self, now: float) -> None:
+        while self._expiry_heap and self._expiry_heap[0][0] <= now:
+            expiry, worker, sh = heapq.heappop(self._expiry_heap)
+            current = self._expiry.get((worker, sh))
+            if current is None or current > expiry:
+                continue  # stale heap entry: re-inserted later with fresh TTL
+            del self._expiry[(worker, sh)]
+            self.tree.remove(worker, [sh])

@@ -1,0 +1,128 @@
+"""Worker-side publishers: KV cache events + load metrics onto the event plane.
+
+Analogs of the reference's KvEventPublisher (lib/llm/src/kv_router/publisher.rs:112)
+and WorkerMetricsPublisher (publisher.rs:957). Topic scheme::
+
+    kv.events.<namespace>.<component>     RouterEvent (msgpack)
+    kv.metrics.<namespace>.<component>    WorkerMetrics (msgpack)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Iterable, Optional
+
+import msgpack
+
+from ..runtime.event_plane.base import EventPlane
+from ..runtime.logging import get_logger
+from ..tokens import SequenceHash
+from .protocols import KvCacheEvent, KvEventKind, RouterEvent, WorkerMetrics, WorkerWithDpRank
+
+log = get_logger("kv_router.publisher")
+
+
+def events_topic(namespace: str, component: str) -> str:
+    return f"kv.events.{namespace}.{component}"
+
+
+def metrics_topic(namespace: str, component: str) -> str:
+    return f"kv.metrics.{namespace}.{component}"
+
+
+class KvEventPublisher:
+    def __init__(
+        self,
+        event_plane: EventPlane,
+        namespace: str,
+        component: str,
+        worker_id: int,
+        dp_rank: int = 0,
+        block_size: int = 16,
+    ):
+        self._plane = event_plane
+        self._topic = events_topic(namespace, component)
+        self.worker = WorkerWithDpRank(worker_id, dp_rank)
+        self.block_size = block_size
+        self._next_event_id = 1
+
+    async def _publish(self, event: KvCacheEvent) -> None:
+        ev = RouterEvent(worker=self.worker, event=event, event_id=self._next_event_id)
+        self._next_event_id += 1
+        await self._plane.publish(self._topic, msgpack.packb(ev.to_obj(), use_bin_type=True))
+
+    async def stored(
+        self, block_hashes: Iterable[SequenceHash], parent_hash: Optional[SequenceHash] = None
+    ) -> None:
+        await self._publish(
+            KvCacheEvent(
+                kind=KvEventKind.STORED,
+                block_hashes=list(block_hashes),
+                parent_hash=parent_hash,
+                block_size=self.block_size,
+            )
+        )
+
+    async def removed(self, block_hashes: Iterable[SequenceHash]) -> None:
+        await self._publish(
+            KvCacheEvent(kind=KvEventKind.REMOVED, block_hashes=list(block_hashes))
+        )
+
+    async def cleared(self) -> None:
+        await self._publish(KvCacheEvent(kind=KvEventKind.CLEARED))
+
+
+class WorkerMetricsPublisher:
+    """Periodic load snapshots; drive with publish() or run() background loop."""
+
+    def __init__(
+        self,
+        event_plane: EventPlane,
+        namespace: str,
+        component: str,
+        worker_id: int,
+        dp_rank: int = 0,
+    ):
+        self._plane = event_plane
+        self._topic = metrics_topic(namespace, component)
+        self.worker = WorkerWithDpRank(worker_id, dp_rank)
+        self._task: Optional[asyncio.Task] = None
+
+    async def publish(
+        self,
+        active_decode_blocks: int = 0,
+        active_prefill_tokens: int = 0,
+        num_requests_waiting: int = 0,
+        total_blocks: int = 0,
+    ) -> None:
+        m = WorkerMetrics(
+            worker=self.worker,
+            active_decode_blocks=active_decode_blocks,
+            active_prefill_tokens=active_prefill_tokens,
+            num_requests_waiting=num_requests_waiting,
+            total_blocks=total_blocks,
+            ts=time.time(),
+        )
+        await self._plane.publish(self._topic, msgpack.packb(m.to_obj(), use_bin_type=True))
+
+    def start(self, snapshot_fn, interval_s: float = 1.0) -> None:
+        """snapshot_fn() -> dict of publish() kwargs, polled every interval."""
+
+        async def loop() -> None:
+            try:
+                while True:
+                    try:
+                        await self.publish(**snapshot_fn())
+                    except Exception:
+                        log.exception("metrics publish failed")
+                    await asyncio.sleep(interval_s)
+            except asyncio.CancelledError:
+                pass
+
+        self._task = asyncio.create_task(loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

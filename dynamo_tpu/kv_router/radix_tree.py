@@ -1,0 +1,130 @@
+"""Prefix index over chained block hashes.
+
+Analog of the reference's RadixTree (lib/kv-router/src/radix_tree.rs:73,
+find_matches :154). Because block hashes are *chained* (a block's sequence
+hash encodes its whole prefix), the "radix tree" flattens to a map
+``sequence_hash -> set of workers holding that block`` plus parent links for
+eviction bookkeeping: matching a query prefix is a walk down its hash chain
+until no worker holds the next block. This is the same trick the reference's
+FlatHashMap alternative index exploits (lib/kv-router/src/flat_hashmap.rs:113).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..tokens import SequenceHash
+from .protocols import OverlapScores, WorkerWithDpRank
+
+
+@dataclasses.dataclass
+class _Node:
+    seq_hash: SequenceHash
+    parent: Optional[SequenceHash]
+    workers: Set[WorkerWithDpRank] = dataclasses.field(default_factory=set)
+    children: Set[SequenceHash] = dataclasses.field(default_factory=set)
+
+
+class RadixTree:
+    def __init__(self):
+        self._nodes: Dict[SequenceHash, _Node] = {}
+        self._worker_blocks: Dict[WorkerWithDpRank, Set[SequenceHash]] = {}
+
+    # -- mutation -----------------------------------------------------------
+    def store(
+        self,
+        worker: WorkerWithDpRank,
+        block_hashes: Iterable[SequenceHash],
+        parent_hash: Optional[SequenceHash] = None,
+    ) -> None:
+        parent = parent_hash
+        for sh in block_hashes:
+            node = self._nodes.get(sh)
+            if node is None:
+                node = _Node(sh, parent)
+                self._nodes[sh] = node
+                if parent is not None and parent in self._nodes:
+                    self._nodes[parent].children.add(sh)
+            node.workers.add(worker)
+            self._worker_blocks.setdefault(worker, set()).add(sh)
+            parent = sh
+
+    def remove(self, worker: WorkerWithDpRank, block_hashes: Iterable[SequenceHash]) -> None:
+        for sh in block_hashes:
+            node = self._nodes.get(sh)
+            if node is None:
+                continue
+            node.workers.discard(worker)
+            owned = self._worker_blocks.get(worker)
+            if owned is not None:
+                owned.discard(sh)
+            if not node.workers:
+                self._drop_node(sh)
+
+    def _drop_node(self, sh: SequenceHash) -> None:
+        node = self._nodes.pop(sh, None)
+        if node is None:
+            return
+        if node.parent is not None and node.parent in self._nodes:
+            self._nodes[node.parent].children.discard(sh)
+        # children become orphans; they stay indexed (their own hashes still
+        # fully identify their prefix) until their workers remove them
+
+    def remove_worker(self, worker: WorkerWithDpRank) -> None:
+        for sh in list(self._worker_blocks.get(worker, ())):
+            node = self._nodes.get(sh)
+            if node is None:
+                continue
+            node.workers.discard(worker)
+            if not node.workers:
+                self._drop_node(sh)
+        self._worker_blocks.pop(worker, None)
+
+    def clear_worker(self, worker: WorkerWithDpRank) -> None:
+        self.remove_worker(worker)
+
+    # -- query --------------------------------------------------------------
+    def find_matches(
+        self, block_hashes: List[SequenceHash], early_exit: bool = False
+    ) -> OverlapScores:
+        """Walk the query's hash chain; count per-worker contiguous matches.
+
+        A worker's score is the number of *leading* blocks of the query it
+        holds — only a contiguous prefix saves prefill work.
+        """
+        scores: Dict[WorkerWithDpRank, int] = {}
+        active: Optional[Set[WorkerWithDpRank]] = None
+        matched = 0
+        for sh in block_hashes:
+            node = self._nodes.get(sh)
+            if node is None or not node.workers:
+                break
+            holders = node.workers if active is None else (active & node.workers)
+            if not holders:
+                break
+            matched += 1
+            for w in holders:
+                scores[w] = matched
+            active = set(holders)
+            if early_exit and len(active) == 1:
+                # single candidate: extend its run without set machinery
+                (w,) = active
+                for sh2 in block_hashes[matched:]:
+                    node2 = self._nodes.get(sh2)
+                    if node2 is None or w not in node2.workers:
+                        break
+                    matched += 1
+                    scores[w] = matched
+                break
+        return OverlapScores(scores=scores, matched_blocks=matched)
+
+    # -- introspection ------------------------------------------------------
+    def worker_block_count(self, worker: WorkerWithDpRank) -> int:
+        return len(self._worker_blocks.get(worker, ()))
+
+    def workers(self) -> List[WorkerWithDpRank]:
+        return list(self._worker_blocks)
+
+    def __len__(self) -> int:
+        return len(self._nodes)

@@ -1,0 +1,119 @@
+"""KvRouter: ties indexer + scheduler + event-plane subscriber into one
+routing service the frontend pipeline (or a standalone router process) uses.
+
+Analog of the reference's KvRouter/KvScheduler service side
+(lib/llm/src/kv_router/{kv_router,scheduler,subscriber}.rs). ``schedule()``
+takes a tokenized request, hashes it into blocks, queries the prefix index,
+and returns a (worker_id, dp_rank, overlap) decision; active-request
+bookkeeping feeds the load term while worker metrics are in flight.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Sequence
+
+import msgpack
+
+from ..runtime.event_plane.base import EventPlane, Subscription
+from ..runtime.logging import get_logger
+from ..tokens import compute_sequence_hashes
+from .indexer import ApproxKvIndexer, KvIndexer
+from .protocols import RouterEvent, WorkerMetrics, WorkerWithDpRank
+from .publisher import events_topic, metrics_topic
+from .scheduler import KvRouterConfig, KvScheduler, SchedulingDecision
+
+log = get_logger("kv_router.router")
+
+
+class KvRouter:
+    def __init__(
+        self,
+        event_plane: EventPlane,
+        namespace: str,
+        component: str,
+        block_size: int = 16,
+        config: Optional[KvRouterConfig] = None,
+        seed: Optional[int] = None,
+    ):
+        self.config = config or KvRouterConfig()
+        self.block_size = block_size
+        self.namespace = namespace
+        self.component = component
+        self._plane = event_plane
+        self.scheduler = KvScheduler(self.config, seed=seed)
+        self.indexer: KvIndexer | ApproxKvIndexer
+        if self.config.use_kv_events:
+            self.indexer = KvIndexer(block_size)
+        else:
+            self.indexer = ApproxKvIndexer(block_size, ttl_s=self.config.approx_ttl_s)
+        self._subs: List[Subscription] = []
+        self._tasks: List[asyncio.Task] = []
+        # request_id -> (worker, blocks) for free() on completion
+        self._active: Dict[str, tuple] = {}
+
+    async def start(self) -> "KvRouter":
+        if self.config.use_kv_events:
+            ev_sub = await self._plane.subscribe(events_topic(self.namespace, self.component))
+            self._subs.append(ev_sub)
+            self._tasks.append(asyncio.create_task(self._event_loop(ev_sub)))
+        m_sub = await self._plane.subscribe(metrics_topic(self.namespace, self.component))
+        self._subs.append(m_sub)
+        self._tasks.append(asyncio.create_task(self._metrics_loop(m_sub)))
+        return self
+
+    async def _event_loop(self, sub: Subscription) -> None:
+        assert isinstance(self.indexer, KvIndexer)
+        async for _topic, payload in sub:
+            try:
+                ev = RouterEvent.from_obj(msgpack.unpackb(payload, raw=False))
+                self.indexer.apply(ev)
+            except Exception:
+                log.exception("bad router event")
+
+    async def _metrics_loop(self, sub: Subscription) -> None:
+        async for _topic, payload in sub:
+            try:
+                m = WorkerMetrics.from_obj(msgpack.unpackb(payload, raw=False))
+                self.scheduler.update_metrics(m)
+            except Exception:
+                log.exception("bad metrics event")
+
+    # -- the routing decision ------------------------------------------------
+    def schedule_tokens(
+        self,
+        token_ids: Sequence[int],
+        candidates: Sequence[WorkerWithDpRank],
+        request_id: Optional[str] = None,
+    ) -> SchedulingDecision:
+        hashes = compute_sequence_hashes(token_ids, self.block_size)
+        overlaps = self.indexer.find_matches(hashes)
+        tree_sizes = {c: self.indexer.tree.worker_block_count(c) for c in candidates}
+        decision = self.scheduler.select_worker(
+            candidates, overlaps, query_blocks=len(hashes), tree_sizes=tree_sizes
+        )
+        new_blocks = decision.query_blocks - decision.overlap_blocks
+        self.scheduler.add_local_load(decision.worker, new_blocks)
+        if request_id is not None:
+            self._active[request_id] = (decision.worker, new_blocks)
+        if isinstance(self.indexer, ApproxKvIndexer):
+            self.indexer.process_routed_request(hashes, decision.worker)
+        return decision
+
+    def complete(self, request_id: str) -> None:
+        """Request finished: release its optimistic load contribution."""
+        entry = self._active.pop(request_id, None)
+        if entry is not None:
+            worker, blocks = entry
+            self.scheduler.sub_local_load(worker, blocks)
+
+    def remove_worker_id(self, worker_id: int) -> None:
+        for w in [w for w in self.indexer.tree.workers() if w.worker_id == worker_id]:
+            self.indexer.remove_worker(w)
+            self.scheduler.remove_worker(w)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for s in self._subs:
+            s.cancel()

@@ -1,0 +1,138 @@
+"""KV-aware worker selection: softmax over a prefill+decode cost.
+
+Analog of the reference's KvScheduler / DefaultWorkerSelector
+(lib/llm/src/kv_router/scheduler.rs:93,511-601):
+
+    logit(w) = overlap_weight * potential_prefill_blocks(w) + decode_blocks(w)
+
+where ``potential_prefill_blocks = query_blocks - overlap_blocks(w)`` (work the
+worker would still have to do) and ``decode_blocks`` is its current load. The
+*lowest* logit is best; selection samples a softmax over ``-logit / T`` with
+temperature T (T=0 -> argmin), tie-breaking toward the worker with the
+smallest cached-block footprint to spread the tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..runtime.logging import get_logger
+from .protocols import OverlapScores, WorkerMetrics, WorkerWithDpRank
+
+log = get_logger("kv_router.scheduler")
+
+
+@dataclasses.dataclass
+class KvRouterConfig:
+    """Knobs mirroring the reference's KvRouterConfig
+    (lib/llm/src/kv_router/kv_router.rs:139-165)."""
+
+    overlap_score_weight: float = 1.0
+    router_temperature: float = 0.0
+    use_kv_events: bool = True            # False -> ApproxKvIndexer
+    replica_sync: bool = False            # sync routing decisions across routers
+    metrics_stale_after_s: float = 10.0
+    approx_ttl_s: float = 120.0
+
+
+@dataclasses.dataclass
+class SchedulingDecision:
+    worker: WorkerWithDpRank
+    overlap_blocks: int
+    query_blocks: int
+    logits: Dict[WorkerWithDpRank, float]
+
+    @property
+    def cached_tokens(self) -> int:
+        return self.overlap_blocks  # caller multiplies by block_size
+
+
+class KvScheduler:
+    def __init__(self, config: Optional[KvRouterConfig] = None, seed: Optional[int] = None):
+        self.config = config or KvRouterConfig()
+        self._rng = random.Random(seed)
+        # live load state, fed by WorkerMetrics events + local bookkeeping
+        self._metrics: Dict[WorkerWithDpRank, WorkerMetrics] = {}
+        # blocks this router routed but the worker hasn't reported yet
+        self._local_decode_blocks: Dict[WorkerWithDpRank, int] = {}
+
+    # -- state feeds ---------------------------------------------------------
+    def update_metrics(self, m: WorkerMetrics) -> None:
+        self._metrics[m.worker] = m
+        # worker's own report supersedes our optimistic local estimate
+        self._local_decode_blocks[m.worker] = 0
+
+    def add_local_load(self, worker: WorkerWithDpRank, blocks: int) -> None:
+        self._local_decode_blocks[worker] = self._local_decode_blocks.get(worker, 0) + blocks
+
+    def sub_local_load(self, worker: WorkerWithDpRank, blocks: int) -> None:
+        self._local_decode_blocks[worker] = max(
+            0, self._local_decode_blocks.get(worker, 0) - blocks
+        )
+
+    def remove_worker(self, worker: WorkerWithDpRank) -> None:
+        self._metrics.pop(worker, None)
+        self._local_decode_blocks.pop(worker, None)
+
+    def decode_blocks(self, worker: WorkerWithDpRank) -> int:
+        m = self._metrics.get(worker)
+        reported = 0
+        if m is not None and (
+            self.config.metrics_stale_after_s <= 0
+            or time.time() - m.ts < self.config.metrics_stale_after_s
+        ):
+            reported = m.active_decode_blocks
+        return reported + self._local_decode_blocks.get(worker, 0)
+
+    # -- selection -----------------------------------------------------------
+    def select_worker(
+        self,
+        candidates: Sequence[WorkerWithDpRank],
+        overlaps: OverlapScores,
+        query_blocks: int,
+        tree_sizes: Optional[Dict[WorkerWithDpRank, int]] = None,
+    ) -> SchedulingDecision:
+        if not candidates:
+            raise ValueError("no candidate workers")
+        w = self.config.overlap_score_weight
+        logits: Dict[WorkerWithDpRank, float] = {}
+        for cand in candidates:
+            overlap = overlaps.scores.get(cand, 0)
+            potential_prefill = max(0, query_blocks - overlap)
+            logits[cand] = w * potential_prefill + self.decode_blocks(cand)
+
+        chosen = self._sample(logits, tree_sizes or {})
+        return SchedulingDecision(
+            worker=chosen,
+            overlap_blocks=overlaps.scores.get(chosen, 0),
+            query_blocks=query_blocks,
+            logits=logits,
+        )
+
+    def _sample(
+        self, logits: Dict[WorkerWithDpRank, float], tree_sizes: Dict[WorkerWithDpRank, int]
+    ) -> WorkerWithDpRank:
+        temp = self.config.router_temperature
+        items = sorted(logits.items(), key=lambda kv: (kv[1], tree_sizes.get(kv[0], 0), kv[0]))
+        if temp <= 0.0:
+            best_logit = items[0][1]
+            best = [wk for wk, lg in items if lg == best_logit]
+            if len(best) == 1:
+                return best[0]
+            # tie-break: fewest cached blocks spreads load across the fleet
+            return min(best, key=lambda wk: (tree_sizes.get(wk, 0), wk))
+        # softmax over negative cost (lower cost -> higher probability)
+        mx = max(-lg / temp for _, lg in items)
+        weights = [math.exp(-lg / temp - mx) for _, lg in items]
+        total = sum(weights)
+        r = self._rng.random() * total
+        acc = 0.0
+        for (wk, _), wt in zip(items, weights):
+            acc += wt
+            if r <= acc:
+                return wk
+        return items[-1][0]

@@ -1,0 +1,199 @@
+"""RadixTree / KvIndexer / KvScheduler unit tests (mirrors the reference's
+scheduler + radix test coverage, lib/llm/src/kv_router/scheduler.rs tests)."""
+
+import asyncio
+
+from dynamo_tpu.kv_router import (
+    ApproxKvIndexer,
+    KvCacheEvent,
+    KvEventKind,
+    KvEventPublisher,
+    KvIndexer,
+    KvRouter,
+    KvRouterConfig,
+    KvScheduler,
+    RadixTree,
+    RouterEvent,
+    WorkerMetrics,
+    WorkerMetricsPublisher,
+    WorkerWithDpRank,
+)
+from dynamo_tpu.runtime import InProcEventPlane
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+W0 = WorkerWithDpRank(0)
+W1 = WorkerWithDpRank(1)
+W1R1 = WorkerWithDpRank(1, 1)
+
+
+def hashes(tokens, bs=4):
+    return compute_sequence_hashes(tokens, bs)
+
+
+class TestRadixTree:
+    def test_store_and_match(self):
+        tree = RadixTree()
+        h = hashes(list(range(16)))  # 4 blocks
+        tree.store(W0, h)
+        tree.store(W1, h[:2])
+        m = tree.find_matches(h)
+        assert m.scores[W0] == 4
+        assert m.scores[W1] == 2
+        assert m.matched_blocks == 4
+
+    def test_contiguity_required(self):
+        tree = RadixTree()
+        h = hashes(list(range(16)))
+        tree.store(W0, [h[0], h[2]])  # hole at block 1
+        m = tree.find_matches(h)
+        assert m.scores[W0] == 1
+
+    def test_divergent_suffix_no_match(self):
+        tree = RadixTree()
+        tree.store(W0, hashes(list(range(16))))
+        other = hashes(list(range(8)) + [99] * 8)
+        m = tree.find_matches(other)
+        assert m.scores[W0] == 2  # shared 2-block prefix only
+
+    def test_remove_and_worker_removal(self):
+        tree = RadixTree()
+        h = hashes(list(range(16)))
+        tree.store(W0, h)
+        tree.store(W1, h)
+        tree.remove(W0, h[2:])
+        assert tree.find_matches(h).scores[W0] == 2
+        assert tree.find_matches(h).scores[W1] == 4
+        tree.remove_worker(W1)
+        assert W1 not in tree.find_matches(h).scores
+        assert tree.worker_block_count(W1) == 0
+        assert len(tree) == 2  # only W0's remaining 2 blocks
+
+    def test_dp_ranks_are_distinct(self):
+        tree = RadixTree()
+        h = hashes(list(range(8)))
+        tree.store(W1, h)
+        tree.store(W1R1, h[:1])
+        m = tree.find_matches(h)
+        assert m.scores[W1] == 2
+        assert m.scores[W1R1] == 1
+
+
+class TestKvIndexer:
+    def test_event_application(self):
+        idx = KvIndexer(block_size=4)
+        h = hashes(list(range(16)))
+        idx.apply(RouterEvent(W0, KvCacheEvent(KvEventKind.STORED, h, None, 4), 1))
+        assert idx.find_matches(h).scores[W0] == 4
+        idx.apply(RouterEvent(W0, KvCacheEvent(KvEventKind.REMOVED, h[3:]), 2))
+        assert idx.find_matches(h).scores[W0] == 3
+        idx.apply(RouterEvent(W0, KvCacheEvent(KvEventKind.CLEARED), 3))
+        assert W0 not in idx.find_matches(h).scores
+
+    def test_duplicate_events_dropped(self):
+        idx = KvIndexer(block_size=4)
+        h = hashes(list(range(8)))
+        ev = RouterEvent(W0, KvCacheEvent(KvEventKind.STORED, h, None, 4), 5)
+        idx.apply(ev)
+        idx.apply(ev)  # replay
+        assert idx.events_applied == 1
+        assert idx.events_dropped == 1
+
+    def test_block_size_mismatch_ignored(self):
+        idx = KvIndexer(block_size=4)
+        h = hashes(list(range(8)), bs=8)
+        idx.apply(RouterEvent(W0, KvCacheEvent(KvEventKind.STORED, h, None, 8), 1))
+        assert idx.block_count() == 0
+
+
+class TestApproxIndexer:
+    def test_ttl_expiry(self):
+        idx = ApproxKvIndexer(block_size=4, ttl_s=10.0)
+        h = hashes(list(range(16)))
+        idx.process_routed_request(h, W0, now=0.0)
+        assert idx.find_matches(h, now=5.0).scores[W0] == 4
+        assert W0 not in idx.find_matches(h, now=11.0).scores
+
+    def test_reroute_refreshes_ttl(self):
+        idx = ApproxKvIndexer(block_size=4, ttl_s=10.0)
+        h = hashes(list(range(8)))
+        idx.process_routed_request(h, W0, now=0.0)
+        idx.process_routed_request(h, W0, now=8.0)  # refresh
+        assert idx.find_matches(h, now=15.0).scores[W0] == 2
+        assert W0 not in idx.find_matches(h, now=19.0).scores
+
+
+class TestScheduler:
+    def test_prefers_overlap(self):
+        sched = KvScheduler(KvRouterConfig(router_temperature=0.0))
+        tree = RadixTree()
+        h = hashes(list(range(40)))  # 10 blocks
+        tree.store(W0, h[:8])
+        d = sched.select_worker([W0, W1], tree.find_matches(h), query_blocks=10)
+        assert d.worker == W0
+        assert d.overlap_blocks == 8
+
+    def test_load_beats_small_overlap(self):
+        cfg = KvRouterConfig(router_temperature=0.0, metrics_stale_after_s=0.0)
+        sched = KvScheduler(cfg)
+        tree = RadixTree()
+        h = hashes(list(range(40)))
+        tree.store(W0, h[:1])  # tiny overlap...
+        import time
+        sched.update_metrics(WorkerMetrics(W0, active_decode_blocks=100, ts=time.time()))
+        cfg.metrics_stale_after_s = 1e9
+        d = sched.select_worker([W0, W1], tree.find_matches(h), query_blocks=10)
+        assert d.worker == W1  # W0: 9 prefill + 100 load vs W1: 10 prefill
+
+    def test_tie_break_smallest_tree(self):
+        sched = KvScheduler(KvRouterConfig(router_temperature=0.0))
+        from dynamo_tpu.kv_router import OverlapScores
+
+        d = sched.select_worker(
+            [W0, W1], OverlapScores(), query_blocks=4, tree_sizes={W0: 100, W1: 3}
+        )
+        assert d.worker == W1
+
+    def test_local_load_accounting(self):
+        sched = KvScheduler(KvRouterConfig(router_temperature=0.0))
+        sched.add_local_load(W0, 50)
+        from dynamo_tpu.kv_router import OverlapScores
+
+        d = sched.select_worker([W0, W1], OverlapScores(), query_blocks=4, tree_sizes={})
+        assert d.worker == W1
+        sched.sub_local_load(W0, 50)
+
+    def test_temperature_sampling_spreads(self):
+        sched = KvScheduler(KvRouterConfig(router_temperature=5.0), seed=42)
+        from dynamo_tpu.kv_router import OverlapScores
+
+        picks = {
+            sched.select_worker([W0, W1], OverlapScores(), 4, {}).worker for _ in range(50)
+        }
+        assert picks == {W0, W1}  # nonzero temperature explores both
+
+
+async def test_router_end_to_end_over_event_plane():
+    """Worker publishes KV events + metrics; router routes accordingly."""
+    plane = InProcEventPlane()
+    router = await KvRouter(plane, "ns", "backend", block_size=4).start()
+
+    pub0 = KvEventPublisher(plane, "ns", "backend", worker_id=0, block_size=4)
+    mpub1 = WorkerMetricsPublisher(plane, "ns", "backend", worker_id=1)
+
+    prompt = list(range(32))  # 8 blocks
+    await pub0.stored(compute_sequence_hashes(prompt, 4))
+    await mpub1.publish(active_decode_blocks=0)
+    await asyncio.sleep(0.05)  # let subscriber loops drain
+
+    d = router.schedule_tokens(prompt, [W0, W1], request_id="r1")
+    assert d.worker == W0
+    assert d.overlap_blocks == 8
+    router.complete("r1")
+
+    # worker 0 evicts everything -> new request prefers idle worker by tie-break
+    await pub0.cleared()
+    await asyncio.sleep(0.05)
+    d2 = router.schedule_tokens(list(range(100, 132)), [W0, W1], request_id="r2")
+    assert d2.overlap_blocks == 0
+    await router.stop()
+    await plane.close()

@@ -1,0 +1,64 @@
+"""Block hashing + token block sequence tests."""
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_sequence_hashes,
+)
+
+
+def test_block_hash_deterministic():
+    assert compute_block_hash([1, 2, 3]) == compute_block_hash([1, 2, 3])
+    assert compute_block_hash([1, 2, 3]) != compute_block_hash([1, 2, 4])
+    assert compute_block_hash([1, 2]) != compute_block_hash([2, 1])
+
+
+def test_extra_key_changes_hash():
+    assert compute_block_hash([1, 2], b"lora-A") != compute_block_hash([1, 2])
+    assert compute_block_hash([1, 2], b"lora-A") != compute_block_hash([1, 2], b"lora-B")
+
+
+def test_sequence_hash_chaining():
+    toks = list(range(64))
+    h4 = compute_sequence_hashes(toks, block_size=16)
+    assert len(h4) == 4
+    # shared prefix -> identical leading hashes
+    other = list(range(48)) + [999] * 16
+    h_other = compute_sequence_hashes(other, block_size=16)
+    assert h_other[:3] == h4[:3]
+    assert h_other[3] != h4[3]
+    # same block contents at a different position -> different sequence hash
+    swapped = toks[16:32] + toks[:16] + toks[32:]
+    h_swapped = compute_sequence_hashes(swapped, block_size=16)
+    assert h_swapped[0] != h4[0]
+
+
+def test_partial_blocks_excluded():
+    assert len(compute_sequence_hashes(list(range(17)), 16)) == 1
+    assert len(compute_sequence_hashes(list(range(15)), 16)) == 0
+
+
+def test_token_block_sequence_incremental_matches_batch():
+    toks = list(range(50))
+    seq = TokenBlockSequence(block_size=16)
+    sealed = []
+    for t in toks:
+        b = seq.append(t)
+        if b:
+            sealed.append(b)
+    assert len(sealed) == 3
+    assert seq.tail_tokens == toks[48:]
+    assert seq.sequence_hashes() == compute_sequence_hashes(toks, 16)
+    assert seq.tokens() == toks
+    assert len(seq) == 50
+
+    batch = TokenBlockSequence(toks, block_size=16)
+    assert batch.sequence_hashes() == seq.sequence_hashes()
+
+
+def test_block_parent_links():
+    seq = TokenBlockSequence(list(range(32)), block_size=16)
+    b0, b1 = seq.blocks
+    assert b0.parent_hash is None
+    assert b1.parent_hash == b0.sequence_hash
+    assert (b0.position, b1.position) == (0, 1)
