@@ -1,0 +1,68 @@
+"""python -m dynamo_tpu.frontend — OpenAI HTTP frontend + model watcher.
+
+Analog of the reference's `python -m dynamo.frontend`
+(components/src/dynamo/frontend/main.py): one process running the OpenAI
+HTTP server, the MDC watcher, the preprocessor and the (KV) router.
+"""
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.kv_router import KvRouterConfig
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig, init_logging
+
+
+def parse_args():
+    p = argparse.ArgumentParser("dynamo_tpu.frontend")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument(
+        "--router-mode", choices=["round-robin", "random", "kv"], default="round-robin"
+    )
+    p.add_argument("--store", default=None, help="mem|file (default from DTPU_STORE)")
+    p.add_argument("--store-path", default=None)
+    p.add_argument("--event-plane", default=None, help="zmq|inproc")
+    p.add_argument("--busy-threshold", type=int, default=None)
+    p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    p.add_argument("--router-temperature", type=float, default=0.0)
+    p.add_argument("--no-kv-events", action="store_true")
+    return p.parse_args()
+
+
+async def main() -> None:
+    args = parse_args()
+    init_logging()
+    cfg = RuntimeConfig.from_env(
+        store=args.store, store_path=args.store_path, event_plane=args.event_plane
+    )
+    runtime = await DistributedRuntime(cfg).start()
+    manager = ModelManager()
+    kv_cfg = KvRouterConfig(
+        overlap_score_weight=args.kv_overlap_score_weight,
+        router_temperature=args.router_temperature,
+        use_kv_events=not args.no_kv_events,
+    )
+    watcher = await ModelWatcher(
+        runtime, manager, RouterMode(args.router_mode), kv_cfg
+    ).start()
+    service = HttpService(
+        manager, runtime.metrics, busy_threshold=args.busy_threshold,
+        host=args.host, port=args.port,
+    )
+    await service.start()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await service.stop()
+    await watcher.stop()
+    await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

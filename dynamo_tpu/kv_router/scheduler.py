@@ -62,6 +62,10 @@ class KvScheduler:
 
     # -- state feeds ---------------------------------------------------------
     def update_metrics(self, m: WorkerMetrics) -> None:
+        # staleness is judged against *our* clock: stamp arrival time rather
+        # than trusting the producer's wall clock (cross-host skew would
+        # silently disable the load term)
+        m.ts = time.time()
         self._metrics[m.worker] = m
         # worker's own report supersedes our optimistic local estimate
         self._local_decode_blocks[m.worker] = 0

@@ -1,0 +1,37 @@
+"""LLM serving layer: protocols, preprocessing, discovery, HTTP frontend."""
+
+from .backend import Backend, StopStringJail
+from .discovery import ModelManager, ModelPipeline, ModelWatcher
+from .engines import EchoEngine
+from .migration import Migration
+from .model_card import (
+    MDC_PREFIX,
+    ModelDeploymentCard,
+    ModelRuntimeConfig,
+    mdc_key,
+    model_slug,
+)
+from .preprocessor import OpenAIPreprocessor
+from .serve import register_llm
+from .tokenizer import ByteTokenizer, DecodeStream, HFTokenizer, load_tokenizer
+
+__all__ = [
+    "Backend",
+    "ByteTokenizer",
+    "DecodeStream",
+    "EchoEngine",
+    "HFTokenizer",
+    "MDC_PREFIX",
+    "Migration",
+    "ModelDeploymentCard",
+    "ModelManager",
+    "ModelPipeline",
+    "ModelRuntimeConfig",
+    "ModelWatcher",
+    "OpenAIPreprocessor",
+    "StopStringJail",
+    "load_tokenizer",
+    "mdc_key",
+    "model_slug",
+    "register_llm",
+]

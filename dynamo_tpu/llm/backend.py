@@ -1,0 +1,131 @@
+"""Worker-side Backend operator: detokenization + stop-condition handling.
+
+Analog of the reference's Backend operator (lib/llm/src/backend.rs:1-16) plus
+the stop-string "jail" that holds back text which might still complete a stop
+sequence (reference: lib/llm/src/protocols/openai/chat_completions/jail.rs).
+
+Wraps a token engine: takes PreprocessedRequest objects off the request plane,
+streams BackendOutput objects back with incremental text attached and stop
+strings enforced exactly (the emitted text never contains the stop string).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, List, Optional, Tuple
+
+from ..runtime.engine import AsyncEngine, Context
+from ..runtime.logging import get_logger
+from .protocols.common import FINISH_STOP, BackendOutput, PreprocessedRequest
+from .tokenizer import DecodeStream, Tokenizer
+
+log = get_logger("llm.backend")
+
+
+class StopStringJail:
+    """Text-side stop handling with partial-match holdback."""
+
+    def __init__(self, stop_strings: List[str]):
+        self._stops = [s for s in stop_strings if s]
+        self._held = ""
+        self._max_len = max((len(s) for s in self._stops), default=0)
+
+    def push(self, delta: str) -> Tuple[str, bool]:
+        """Returns (text safe to emit, hit_stop)."""
+        if not self._stops:
+            return delta, False
+        buf = self._held + delta
+        # full match anywhere in the buffer -> emit up to match, stop
+        best: Optional[int] = None
+        for s in self._stops:
+            idx = buf.find(s)
+            if idx != -1 and (best is None or idx < best):
+                best = idx
+        if best is not None:
+            self._held = ""
+            return buf[:best], True
+        # hold back the longest suffix that is a proper prefix of any stop
+        hold = 0
+        max_check = min(len(buf), self._max_len - 1)
+        for k in range(max_check, 0, -1):
+            suffix = buf[len(buf) - k :]
+            if any(s.startswith(suffix) for s in self._stops):
+                hold = k
+                break
+        if hold:
+            self._held = buf[len(buf) - hold :]
+            return buf[: len(buf) - hold], False
+        self._held = ""
+        return buf, False
+
+    def flush(self) -> str:
+        out, self._held = self._held, ""
+        return out
+
+
+class Backend:
+    """Operator: engine's raw token stream -> detokenized, stop-enforced stream."""
+
+    def __init__(self, engine: AsyncEngine, tokenizer: Tokenizer):
+        self.engine = engine
+        self.tokenizer = tokenizer
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_obj(request)
+        decode = DecodeStream(self.tokenizer)
+        jail = StopStringJail(req.stop.stop_strings)
+        stop_token_ids = set(req.stop.stop_token_ids)
+        if not req.stop.ignore_eos and self.tokenizer.eos_token_id is not None:
+            stop_token_ids.add(self.tokenizer.eos_token_id)
+        max_tokens = req.stop.max_tokens
+        produced = 0
+        finished = False
+
+        async for step in self.engine.generate(req, context):
+            out = step if isinstance(step, BackendOutput) else BackendOutput.from_obj(step)
+            emit_ids: List[int] = []
+            finish: Optional[str] = out.finish_reason
+            for tid in out.token_ids:
+                if finished:
+                    break
+                produced += 1
+                if tid in stop_token_ids and produced > req.stop.min_tokens:
+                    finish = FINISH_STOP
+                    finished = True
+                    break  # eos/stop token excluded from output
+                emit_ids.append(tid)
+                if max_tokens is not None and produced >= max_tokens:
+                    finish = finish or "length"
+                    finished = True
+                    break
+            text_delta = decode.step(emit_ids) if emit_ids else ""
+            hit = False
+            if text_delta or finish:
+                text_delta, hit = jail.push(text_delta)
+                if hit:
+                    finish = FINISH_STOP
+                    finished = True
+                elif finish is not None:
+                    # generation over without completing a stop string: release
+                    # everything held back (jail prefixes + split UTF-8 tail)
+                    tail, hit = jail.push(decode.flush())
+                    if hit:
+                        text_delta += tail
+                    else:
+                        text_delta += tail + jail.flush()
+            yield BackendOutput(
+                token_ids=emit_ids,
+                text=text_delta,
+                finish_reason=finish,
+                cumulative_tokens=produced,
+                logprobs=out.logprobs,
+                top_logprobs=out.top_logprobs,
+                annotations=out.annotations,
+                kv_transfer=out.kv_transfer,
+            ).to_obj()
+            if finish is not None:
+                return
+            if context.is_stopped():
+                yield BackendOutput(
+                    finish_reason="cancelled", cumulative_tokens=produced
+                ).to_obj()
+                return

@@ -1,0 +1,249 @@
+"""Frontend model discovery: ModelManager + ModelWatcher + routed pipelines.
+
+Analog of the reference's ModelManager (lib/llm/src/discovery/model_manager.rs:64),
+ModelWatcher (discovery/watcher.rs:57,112) and the routed-pipeline builder
+(lib/llm/src/entrypoint/input/common.rs:173-260). Workers publish
+ModelDeploymentCards under ``v1/mdc/...`` tied to their lease; the frontend
+watches that prefix and (un)registers per-model pipelines:
+
+    OpenAIPreprocessor -> Migration -> [KvRouter] -> endpoint Client -> worker
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import msgpack
+
+from ..kv_router import KvRouter, KvRouterConfig, WorkerWithDpRank
+from ..runtime.component import Client, RouterMode
+from ..runtime.discovery.store import EventType
+from ..runtime.distributed import DistributedRuntime
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from ..runtime.request_plane.tcp import NoResponders
+from .migration import Migration
+from .model_card import MDC_PREFIX, ModelDeploymentCard
+from .preprocessor import (
+    ANNOTATION_CACHED_TOKENS,
+    ANNOTATION_WORKER_ID,
+    OpenAIPreprocessor,
+)
+from .protocols.common import BackendOutput, PreprocessedRequest
+
+log = get_logger("llm.discovery")
+
+
+class ModelPipeline:
+    """Everything needed to serve one model from the frontend."""
+
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        card: ModelDeploymentCard,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+        kv_router_config: Optional[KvRouterConfig] = None,
+    ):
+        self.runtime = runtime
+        self.card = card
+        self.router_mode = router_mode
+        self.kv_router_config = kv_router_config
+        self.preprocessor = OpenAIPreprocessor(card)
+        self.client: Optional[Client] = None
+        self.kv_router: Optional[KvRouter] = None
+        self.migration = Migration(self._send, card.migration_limit)
+        self.instance_count = 0
+        self._known_worker_ids: set = set()
+
+    async def start(self) -> "ModelPipeline":
+        endpoint = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint(self.card.endpoint)
+        )
+        self.client = await endpoint.client(
+            RouterMode.ROUND_ROBIN if self.router_mode == RouterMode.KV else self.router_mode
+        )
+        if self.router_mode == RouterMode.KV:
+            self.kv_router = await KvRouter(
+                self.runtime.event_plane,
+                self.card.namespace,
+                self.card.component,
+                block_size=self.card.kv_block_size,
+                config=self.kv_router_config,
+            ).start()
+        return self
+
+    async def stop(self) -> None:
+        if self.kv_router is not None:
+            await self.kv_router.stop()
+        if self.client is not None:
+            await self.client.stop()
+
+    # -- routing -------------------------------------------------------------
+    def _candidates(self, excluded: List[int]) -> List[WorkerWithDpRank]:
+        assert self.client is not None
+        cands: List[WorkerWithDpRank] = []
+        for iid, inst in self.client.instances.items():
+            if iid in excluded:
+                continue
+            dp = int(inst.metadata.get("data_parallel_size", 1) or 1)
+            for r in range(dp):
+                cands.append(WorkerWithDpRank(iid, r))
+        return cands
+
+    def _prune_dead_workers(self) -> None:
+        if self.kv_router is None or self.client is None:
+            return
+        live = set(self.client.instances)
+        gone = self._known_worker_ids - live
+        for iid in gone:
+            self.kv_router.remove_worker_id(iid)
+        self._known_worker_ids = set(live)
+
+    async def _send(
+        self, req: PreprocessedRequest, context: Context, excluded: List[int]
+    ) -> AsyncIterator[Any]:
+        assert self.client is not None
+        instance_id: Optional[int] = None
+        if self.kv_router is not None:
+            self._prune_dead_workers()
+            cands = self._candidates(excluded)
+            if not cands:
+                # every instance is excluded (dead mid-request): fail this
+                # attempt rather than round-robin back onto a dead worker
+                raise NoResponders(f"no non-excluded instances for {self.card.name}")
+            decision = self.kv_router.schedule_tokens(
+                req.token_ids, cands, request_id=req.request_id
+            )
+            instance_id = decision.worker.worker_id
+            req.annotations[ANNOTATION_CACHED_TOKENS] = (
+                decision.overlap_blocks * self.card.kv_block_size
+            )
+            req.annotations[ANNOTATION_WORKER_ID] = instance_id
+            req.annotations["dp_rank"] = decision.worker.dp_rank
+        elif excluded:
+            # non-KV mode: steer away from excluded (dead) instances
+            alive = [i for i in self.client.instance_ids() if i not in excluded]
+            if not alive:
+                raise NoResponders(f"no non-excluded instances for {self.card.name}")
+            instance_id = alive[0]
+        try:
+            return await self.client.generate(req.to_obj(), context, instance_id)
+        except NoResponders as e:
+            if instance_id is not None:
+                e.instance_id = instance_id  # type: ignore[attr-defined]
+            raise
+
+    async def generate_tokens(
+        self, req: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        """The full internal stream: migration-wrapped routed generation."""
+        first = True
+        try:
+            async for out in self.migration.generate(req, context):
+                if first:
+                    first = False
+                    # frontend-known metrics (input tokens, cache overlap,
+                    # chosen worker) ride the first chunk's annotations
+                    merged = dict(req.annotations)
+                    merged.update(out.annotations)
+                    out.annotations = merged
+                yield out
+        finally:
+            if self.kv_router is not None:
+                self.kv_router.complete(req.request_id)
+
+
+class ModelManager:
+    def __init__(self):
+        self._models: Dict[str, ModelPipeline] = {}
+
+    def get(self, model: str) -> Optional[ModelPipeline]:
+        return self._models.get(model)
+
+    def add(self, model: str, pipeline: ModelPipeline) -> None:
+        self._models[model] = pipeline
+
+    async def remove(self, model: str) -> None:
+        p = self._models.pop(model, None)
+        if p is not None:
+            await p.stop()
+
+    def list_models(self) -> List[str]:
+        return sorted(self._models)
+
+    def pipelines(self) -> List[ModelPipeline]:
+        return list(self._models.values())
+
+
+class ModelWatcher:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        manager: ModelManager,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+        kv_router_config: Optional[KvRouterConfig] = None,
+    ):
+        self.runtime = runtime
+        self.manager = manager
+        self.router_mode = router_mode
+        self.kv_router_config = kv_router_config
+        self._task: Optional[asyncio.Task] = None
+        self._watcher = None
+        # mdc store key -> model name (for DELETE handling)
+        self._key_model: Dict[str, str] = {}
+        self._model_keys: Dict[str, set] = {}
+
+    async def start(self) -> "ModelWatcher":
+        self._watcher = await self.runtime.store.watch(MDC_PREFIX + "/")
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def _loop(self) -> None:
+        assert self._watcher is not None
+        async for ev in self._watcher:
+            try:
+                if ev.type == EventType.PUT and ev.value is not None:
+                    await self._handle_put(ev.key, ev.value)
+                elif ev.type == EventType.DELETE:
+                    await self._handle_delete(ev.key)
+            except Exception:
+                log.exception("model watcher event failed (%s)", ev.key)
+
+    async def _handle_put(self, key: str, value: bytes) -> None:
+        card = ModelDeploymentCard.from_obj(msgpack.unpackb(value, raw=False))
+        self._key_model[key] = card.name
+        self._model_keys.setdefault(card.name, set()).add(key)
+        if self.manager.get(card.name) is None:
+            log.info("model %s appeared (card at %s)", card.name, key)
+            pipeline = await ModelPipeline(
+                self.runtime, card, self.router_mode, self.kv_router_config
+            ).start()
+            self.manager.add(card.name, pipeline)
+        pipe = self.manager.get(card.name)
+        if pipe is not None:
+            pipe.instance_count = len(self._model_keys[card.name])
+
+    async def _handle_delete(self, key: str) -> None:
+        model = self._key_model.pop(key, None)
+        if model is None:
+            return
+        keys = self._model_keys.get(model, set())
+        keys.discard(key)
+        pipe = self.manager.get(model)
+        if pipe is not None:
+            pipe.instance_count = len(keys)
+        if not keys:
+            log.info("last instance of model %s gone; deregistering", model)
+            self._model_keys.pop(model, None)
+            await self.manager.remove(model)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        if self._watcher is not None:
+            self._watcher.cancel()
+        for model in list(self.manager.list_models()):
+            await self.manager.remove(model)

@@ -1,0 +1,37 @@
+"""Built-in toy engines: echo (tokens in -> tokens out) for tests and wiring.
+
+Analog of the reference's EchoEngine (lib/llm/src/engines.rs:67)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator
+
+from ..runtime.engine import Context
+from .protocols.common import FINISH_LENGTH, FINISH_STOP, BackendOutput, PreprocessedRequest
+
+
+class EchoEngine:
+    """Streams the prompt's token ids back one at a time (bounded by
+    max_tokens), with a configurable per-token delay to exercise streaming."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_obj(request)
+        limit = req.stop.max_tokens or len(req.token_ids)
+        produced = 0
+        for tid in req.token_ids:
+            if context.is_stopped():
+                return
+            if produced >= limit:
+                yield BackendOutput(finish_reason=FINISH_LENGTH, cumulative_tokens=produced)
+                return
+            produced += 1
+            yield BackendOutput(token_ids=[tid], cumulative_tokens=produced)
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+        yield BackendOutput(finish_reason=FINISH_STOP, cumulative_tokens=produced)

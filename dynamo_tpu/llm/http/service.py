@@ -1,0 +1,298 @@
+"""OpenAI-compatible HTTP frontend (aiohttp) with SSE streaming.
+
+Analog of the reference's axum HTTP service (lib/llm/src/http/service/
+service_v2.rs + openai.rs handlers): /v1/chat/completions, /v1/completions,
+/v1/models plus /health, /live, /metrics. Includes the reference's operational
+behaviors: client-disconnect -> request cancellation (disconnect.rs), busy
+threshold -> 503 (busy_threshold.rs), per-model TTFT/ITL metrics
+(service/metrics.rs). Chat and text completions share one request path; the
+only per-endpoint differences are request parsing and delta generation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import AsyncIterator, Optional
+
+from aiohttp import web
+from aiohttp.client_exceptions import ClientConnectionResetError
+
+from ...runtime import metrics as M
+from ...runtime.engine import Context
+from ...runtime.logging import get_logger
+from ...runtime.request_plane.tcp import NoResponders
+from ..discovery import ModelManager, ModelPipeline
+from ..protocols.common import BackendOutput, PreprocessedRequest
+from ..protocols.delta import (
+    ChatDeltaGenerator,
+    CompletionDeltaGenerator,
+    aggregate_chat,
+    aggregate_completion,
+)
+from ..protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    ModelInfo,
+    ModelList,
+)
+
+log = get_logger("llm.http")
+
+SSE_HEADERS = {
+    "Content-Type": "text/event-stream",
+    "Cache-Control": "no-cache",
+    "Connection": "keep-alive",
+    "X-Accel-Buffering": "no",
+}
+
+_DISCONNECT = (ConnectionResetError, ClientConnectionResetError)
+
+
+def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": status}}, status=status
+    )
+
+
+def _sse_error_event(message: str, err_type: str) -> bytes:
+    payload = json.dumps({"error": {"message": message, "type": err_type}})
+    return f"data: {payload}\n\n".encode()
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager,
+        metrics_scope: Optional[M.MetricsScope] = None,
+        busy_threshold: Optional[int] = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+    ):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.busy_threshold = busy_threshold
+        self.inflight = 0
+        self.metrics = metrics_scope or M.MetricsScope()
+        self._requests = self.metrics.counter(
+            M.REQUESTS_TOTAL, "requests", extra_labels=(M.LABEL_MODEL, "status")
+        )
+        self._inflight_g = self.metrics.gauge(M.INFLIGHT_REQUESTS, "in-flight requests")
+        self._ttft = self.metrics.histogram(
+            M.TTFT_SECONDS, "time to first token", extra_labels=(M.LABEL_MODEL,),
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+        )
+        self._itl = self.metrics.histogram(
+            M.ITL_SECONDS, "inter-token latency", extra_labels=(M.LABEL_MODEL,),
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0),
+        )
+        self._input_tokens = self.metrics.counter(
+            M.INPUT_TOKENS, "input tokens", extra_labels=(M.LABEL_MODEL,)
+        )
+        self._output_tokens = self.metrics.counter(
+            M.OUTPUT_TOKENS, "output tokens", extra_labels=(M.LABEL_MODEL,)
+        )
+        self._runner: Optional[web.AppRunner] = None
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/live", self.live)
+        app.router.add_get("/metrics", self.metrics_handler)
+        return app
+
+    async def start(self) -> str:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        actual = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        self.port = actual
+        log.info("OpenAI HTTP frontend listening on %s:%d", self.host, actual)
+        return f"{self.host}:{actual}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- aux handlers --------------------------------------------------------
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "models": self.manager.list_models()})
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def metrics_handler(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.expose(), content_type="text/plain")
+
+    async def models(self, request: web.Request) -> web.Response:
+        data = ModelList(
+            data=[ModelInfo(id=m, created=int(time.time())) for m in self.manager.list_models()]
+        )
+        return web.json_response(data.model_dump())
+
+    # -- shared request path -------------------------------------------------
+    def _observed(
+        self, stream: AsyncIterator[BackendOutput], model: str, t_start: float
+    ) -> AsyncIterator[BackendOutput]:
+        """Wrap the token stream with TTFT/ITL observation."""
+
+        async def gen():
+            first_at = None
+            last_at = None
+            async for out in stream:
+                now = time.monotonic()
+                if out.token_ids:
+                    if first_at is None:
+                        first_at = now
+                        self._ttft.observe(now - t_start, model=model)
+                    elif last_at is not None:
+                        self._itl.observe(now - last_at, model=model)
+                    last_at = now
+                yield out
+
+        return gen()
+
+    async def _run(
+        self,
+        request: web.Request,
+        preq: PreprocessedRequest,
+        pipeline: ModelPipeline,
+        model: str,
+        stream_mode: bool,
+        delta_gen,
+        aggregator,
+    ) -> web.StreamResponse:
+        """Execute one generation request: routing, streaming, metrics, errors."""
+        ctx = Context(preq.request_id)
+        self.inflight += 1
+        self._inflight_g.set(self.inflight)
+        status = "200"
+        resp: Optional[web.StreamResponse] = None
+        prompt_tokens = completion_tokens = 0
+        try:
+            stream = self._observed(
+                pipeline.generate_tokens(preq, ctx), model, time.monotonic()
+            )
+            if stream_mode:
+                resp = web.StreamResponse(headers=SSE_HEADERS)
+                await resp.prepare(request)
+                try:
+                    async for out in stream:
+                        for chunk in delta_gen.on_output(out):
+                            await resp.write(
+                                f"data: {chunk.model_dump_json(exclude_none=True)}\n\n".encode()
+                            )
+                    await resp.write(b"data: [DONE]\n\n")
+                    await resp.write_eof()
+                except _DISCONNECT:
+                    status = "499"
+                    ctx.kill()
+                finally:
+                    prompt_tokens = delta_gen.prompt_tokens
+                    completion_tokens = delta_gen.completion_tokens
+                return resp
+            result = await aggregator(stream)
+            usage = result.usage
+            if usage is not None:
+                prompt_tokens, completion_tokens = usage.prompt_tokens, usage.completion_tokens
+            return web.json_response(result.model_dump(exclude_none=True))
+        except NoResponders:
+            status = "503"
+            return await self._fail(resp, 503, "no workers available", "service_unavailable")
+        except asyncio.CancelledError:
+            status = "499"
+            ctx.kill()
+            raise
+        except Exception as e:
+            log.exception("request %s failed", preq.request_id[:16])
+            status = "500"
+            return await self._fail(resp, 500, str(e), "internal_error")
+        finally:
+            self.inflight -= 1
+            self._inflight_g.set(self.inflight)
+            self._requests.inc(model=model, status=status)
+            self._input_tokens.inc(prompt_tokens, model=model)
+            self._output_tokens.inc(completion_tokens, model=model)
+            ctx.stop_generating()
+
+    async def _fail(
+        self, resp: Optional[web.StreamResponse], status: int, msg: str, err_type: str
+    ) -> web.StreamResponse:
+        """Error path that respects an already-started SSE stream: once
+        headers went out we can only append an error event, never start a
+        second response on the same connection."""
+        if resp is None:
+            return _error(status, msg, err_type)
+        try:
+            await resp.write(_sse_error_event(msg, err_type))
+            await resp.write_eof()
+        except _DISCONNECT:
+            pass
+        return resp
+
+    def _check_capacity(self) -> Optional[web.Response]:
+        if self.busy_threshold is not None and self.inflight >= self.busy_threshold:
+            return _error(503, "service busy", "service_unavailable")
+        return None
+
+    # -- endpoints -----------------------------------------------------------
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        busy = self._check_capacity()
+        if busy is not None:
+            return busy
+        try:
+            body = await request.json()
+            req = ChatCompletionRequest.model_validate(body)
+        except (json.JSONDecodeError, ValueError) as e:
+            return _error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return _error(404, f"model '{req.model}' not found", "model_not_found")
+        try:
+            preq = pipeline.preprocessor.preprocess_chat(req)
+        except ValueError as e:
+            return _error(400, str(e), "context_length_exceeded")
+
+        include_usage = bool(req.stream_options and req.stream_options.include_usage)
+        gen = ChatDeltaGenerator(preq.request_id, req.model, include_usage)
+        return await self._run(
+            request, preq, pipeline, req.model, req.stream, gen,
+            lambda s: aggregate_chat(preq.request_id, req.model, s),
+        )
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        busy = self._check_capacity()
+        if busy is not None:
+            return busy
+        try:
+            body = await request.json()
+            req = CompletionRequest.model_validate(body)
+        except (json.JSONDecodeError, ValueError) as e:
+            return _error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return _error(404, f"model '{req.model}' not found", "model_not_found")
+        prompt = req.prompt
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], (list, str)):
+            if len(prompt) > 1 or isinstance(prompt[0], list):
+                return _error(400, "batched prompts not supported; send one request per prompt")
+            prompt = prompt[0]
+        try:
+            preq = pipeline.preprocessor.preprocess_completion(req, prompt)
+        except ValueError as e:
+            return _error(400, str(e), "context_length_exceeded")
+
+        include_usage = bool(req.stream_options and req.stream_options.include_usage)
+        gen = CompletionDeltaGenerator(preq.request_id, req.model, include_usage)
+        echo_text = prompt if (req.echo and isinstance(prompt, str)) else ""
+        return await self._run(
+            request, preq, pipeline, req.model, req.stream, gen,
+            lambda s: aggregate_completion(preq.request_id, req.model, s, echo_text),
+        )

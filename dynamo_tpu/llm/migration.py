@@ -1,0 +1,72 @@
+"""Request migration: replay in-flight requests to another worker on failure.
+
+Analog of the reference's Migration operator (lib/llm/src/migration.rs:24-43):
+if the worker dies before or during generation (NoResponders / dropped
+stream), re-send the request to a different worker carrying the tokens already
+generated (``prior_token_ids``) so decode resumes where it stopped, bounded by
+``migration_limit`` attempts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Awaitable, Callable, List, Optional
+
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from ..runtime.request_plane.tcp import NoResponders
+from .protocols.common import BackendOutput, PreprocessedRequest
+
+log = get_logger("llm.migration")
+
+# send(request, context, exclude_instance_ids) -> response stream
+SendFn = Callable[[PreprocessedRequest, Context, List[int]], Awaitable[AsyncIterator[Any]]]
+
+
+class Migration:
+    def __init__(self, send: SendFn, migration_limit: int = 0):
+        self.send = send
+        self.migration_limit = migration_limit
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        attempts_left = self.migration_limit
+        accumulated: List[int] = list(request.prior_token_ids)
+        excluded: List[int] = []
+
+        while True:
+            req = request
+            if accumulated != list(request.prior_token_ids):
+                # re-issue with progress so the new worker resumes decode
+                req = PreprocessedRequest.from_obj(request.to_obj())
+                req.prior_token_ids = list(accumulated)
+                if req.stop.max_tokens is not None:
+                    req.stop.max_tokens = max(
+                        1, req.stop.max_tokens - (len(accumulated) - len(request.prior_token_ids))
+                    )
+            try:
+                stream = await self.send(req, context, excluded)
+                async for item in stream:
+                    out = item if isinstance(item, BackendOutput) else BackendOutput.from_obj(item)
+                    accumulated.extend(out.token_ids)
+                    yield out
+                    if out.finish_reason is not None:
+                        return
+                # stream ended without finish_reason: worker died mid-request
+                raise NoResponders("stream ended without finish")
+            except (NoResponders, ConnectionError) as e:
+                if context.is_stopped() or attempts_left <= 0:
+                    if attempts_left <= 0 and not context.is_stopped():
+                        log.warning("migration limit exhausted: %s", e)
+                        raise
+                    return
+                attempts_left -= 1
+                worker_id: Optional[int] = None
+                if isinstance(e, NoResponders):
+                    worker_id = getattr(e, "instance_id", None)
+                if worker_id is not None:
+                    excluded.append(worker_id)
+                log.info(
+                    "migrating request %s (%d tokens so far, %d attempts left): %s",
+                    req.request_id, len(accumulated), attempts_left, e,
+                )

@@ -1,0 +1,83 @@
+"""ModelDeploymentCard: the unit of model discovery.
+
+Analog of the reference's MDC (lib/llm/src/model_card.rs, stored under
+``v1/mdc``): everything a frontend needs to serve a model — name, tokenizer
+source, context limits, KV block size, model type, migration limit, runtime
+config — written to the discovery store by workers under their lease, watched
+by frontends.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+MDC_PREFIX = "v1/mdc"
+
+MODEL_TYPE_CHAT = "chat"
+MODEL_TYPE_COMPLETIONS = "completions"
+MODEL_TYPE_EMBEDDING = "embedding"
+MODEL_TYPE_PREFILL = "prefill"  # prefill-only pool member (disaggregation)
+
+MODEL_INPUT_TEXT = "text"      # worker wants raw text (does its own tokenize)
+MODEL_INPUT_TOKENS = "tokens"  # worker wants token ids (frontend preprocesses)
+
+
+def mdc_key(namespace: str, model_slug: str, instance_id: int) -> str:
+    return f"{MDC_PREFIX}/{namespace}/{model_slug}/{instance_id:016x}"
+
+
+def model_slug(name: str) -> str:
+    return name.replace("/", "--").lower()
+
+
+@dataclasses.dataclass
+class ModelRuntimeConfig:
+    """Worker capability advertisement (reference: runtime_config.rs)."""
+
+    total_kv_blocks: int = 0
+    kv_block_size: int = 16
+    max_batch_size: int = 0
+    data_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    max_context_len: int = 0
+
+    def to_obj(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_obj(cls, obj: Dict[str, Any]) -> "ModelRuntimeConfig":
+        return cls(**{k: v for k, v in obj.items() if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+@dataclasses.dataclass
+class ModelDeploymentCard:
+    name: str                                  # served model name ("meta-llama/Llama-3-8B")
+    namespace: str = "dynamo"
+    component: str = "backend"
+    endpoint: str = "generate"
+    model_type: List[str] = dataclasses.field(default_factory=lambda: [MODEL_TYPE_CHAT, MODEL_TYPE_COMPLETIONS])
+    model_input: str = MODEL_INPUT_TOKENS
+    # tokenizer/template source: HF repo id or local path; None -> no preprocessor
+    tokenizer: Optional[str] = None
+    context_length: int = 8192
+    kv_block_size: int = 16
+    migration_limit: int = 0
+    runtime_config: ModelRuntimeConfig = dataclasses.field(default_factory=ModelRuntimeConfig)
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def slug(self) -> str:
+        return model_slug(self.name)
+
+    def to_obj(self) -> Dict[str, Any]:
+        obj = dataclasses.asdict(self)
+        obj["runtime_config"] = self.runtime_config.to_obj()
+        return obj
+
+    @classmethod
+    def from_obj(cls, obj: Dict[str, Any]) -> "ModelDeploymentCard":
+        rc = ModelRuntimeConfig.from_obj(obj.get("runtime_config") or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in obj.items() if k in known and k != "runtime_config"}
+        return cls(runtime_config=rc, **kwargs)

@@ -1,0 +1,100 @@
+"""Frontend preprocessor: OpenAI request -> PreprocessedRequest (tokens).
+
+Analog of the reference's OpenAIPreprocessor (lib/llm/src/preprocessor.rs):
+applies the chat template, tokenizes, folds sampling + stop options into the
+internal request, and stamps metric annotations (input token count, cached
+tokens once routing decides).
+"""
+
+from __future__ import annotations
+
+from typing import List, Union
+
+from ..runtime.logging import get_logger
+from .model_card import ModelDeploymentCard
+from .protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+from .protocols.openai import ChatCompletionRequest, CompletionRequest, new_request_id
+from .tokenizer import Tokenizer, load_tokenizer
+
+log = get_logger("llm.preprocessor")
+
+ANNOTATION_INPUT_TOKENS = "input_tokens"
+ANNOTATION_CACHED_TOKENS = "cached_tokens"
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+ANNOTATION_WORKER_ID = "worker_id"
+ANNOTATION_PREFILL_WORKER_ID = "prefill_worker_id"
+
+
+class OpenAIPreprocessor:
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Tokenizer | None = None):
+        self.card = card
+        self.tokenizer = tokenizer or load_tokenizer(card.tokenizer)
+
+    # -- tokenization --------------------------------------------------------
+    def tokenize_chat(self, request: ChatCompletionRequest) -> List[int]:
+        messages = [m.model_dump(exclude_none=True) for m in request.messages]
+        encode_chat = getattr(self.tokenizer, "encode_chat", None)
+        if encode_chat is not None:
+            return encode_chat(messages)
+        prompt = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
+        return self.tokenizer.encode(prompt)
+
+    def tokenize_prompt(self, prompt: Union[str, List[int]]) -> List[int]:
+        if isinstance(prompt, str):
+            return self.tokenizer.encode(prompt)
+        return list(prompt)
+
+    # -- request conversion --------------------------------------------------
+    def _common(
+        self,
+        request: Union[ChatCompletionRequest, CompletionRequest],
+        token_ids: List[int],
+        request_id: str,
+    ) -> PreprocessedRequest:
+        if len(token_ids) >= self.card.context_length:
+            raise ValueError(
+                f"prompt length {len(token_ids)} exceeds model context "
+                f"{self.card.context_length}"
+            )
+        sampling = SamplingOptions(
+            temperature=request.temperature if request.temperature is not None else 1.0,
+            top_p=request.top_p if request.top_p is not None else 1.0,
+            top_k=request.top_k if request.top_k is not None else -1,
+            min_p=request.min_p or 0.0,
+            seed=request.seed,
+            frequency_penalty=request.frequency_penalty or 0.0,
+            presence_penalty=request.presence_penalty or 0.0,
+            repetition_penalty=request.repetition_penalty or 1.0,
+            # chat style: logprobs=true + top_logprobs=N; completions style:
+            # logprobs=N directly
+            logprobs=(
+                int(request.logprobs)
+                if isinstance(request.logprobs, int) and not isinstance(request.logprobs, bool)
+                else int(request.top_logprobs or 1) if request.logprobs else 0
+            ),
+        )
+        max_new = request.effective_max_tokens()
+        budget = self.card.context_length - len(token_ids)
+        stop = StopConditions(
+            max_tokens=min(max_new, budget) if max_new else budget,
+            stop_strings=request.stop_list(),
+            ignore_eos=bool(request.ignore_eos),
+        )
+        return PreprocessedRequest(
+            request_id=request_id,
+            model=request.model,
+            token_ids=token_ids,
+            stop=stop,
+            sampling=sampling,
+            annotations={ANNOTATION_INPUT_TOKENS: len(token_ids)},
+        )
+
+    def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
+        rid = new_request_id("chatcmpl")
+        return self._common(request, self.tokenize_chat(request), rid)
+
+    def preprocess_completion(
+        self, request: CompletionRequest, prompt: Union[str, List[int]]
+    ) -> PreprocessedRequest:
+        rid = new_request_id("cmpl")
+        return self._common(request, self.tokenize_prompt(prompt), rid)

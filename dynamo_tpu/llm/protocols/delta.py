@@ -1,0 +1,166 @@
+"""Delta generators: BackendOutput stream -> OpenAI SSE response objects.
+
+Analog of the reference's streaming delta generator + aggregators
+(lib/llm/src/protocols/openai/chat_completions/delta.rs, aggregator.rs).
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Optional
+
+from .common import BackendOutput
+from .openai import (
+    ChatChoice,
+    ChatChunkChoice,
+    ChatCompletionChunk,
+    ChatCompletionResponse,
+    ChatDelta,
+    ChatResponseMessage,
+    CompletionChoice,
+    CompletionResponse,
+    Usage,
+    now_ts,
+)
+
+
+class ChatDeltaGenerator:
+    def __init__(self, request_id: str, model: str, include_usage: bool = False):
+        self.id = request_id
+        self.model = model
+        self.created = now_ts()
+        self.include_usage = include_usage
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+        self.cached_tokens: Optional[int] = None
+        self._first = True
+
+    def _chunk(self, delta: ChatDelta, finish: Optional[str] = None) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[ChatChunkChoice(index=0, delta=delta, finish_reason=finish)],
+        )
+
+    def on_output(self, out: BackendOutput):
+        """Yields zero or more chunks for one backend step."""
+        if out.annotations:
+            self.prompt_tokens = out.annotations.get("input_tokens", self.prompt_tokens)
+            if "cached_tokens" in out.annotations:
+                self.cached_tokens = out.annotations["cached_tokens"]
+        self.completion_tokens = max(self.completion_tokens, out.cumulative_tokens)
+        chunks = []
+        if self._first:
+            self._first = False
+            chunks.append(self._chunk(ChatDelta(role="assistant", content="")))
+        if out.text:
+            chunks.append(self._chunk(ChatDelta(content=out.text)))
+        if out.finish_reason is not None:
+            chunks.append(self._chunk(ChatDelta(), finish=out.finish_reason))
+            if self.include_usage:
+                usage_chunk = ChatCompletionChunk(
+                    id=self.id, created=self.created, model=self.model, choices=[],
+                    usage=self.usage(),
+                )
+                chunks.append(usage_chunk)
+        return chunks
+
+    def usage(self) -> Usage:
+        return Usage(
+            prompt_tokens=self.prompt_tokens,
+            completion_tokens=self.completion_tokens,
+            total_tokens=self.prompt_tokens + self.completion_tokens,
+            cached_tokens=self.cached_tokens,
+        )
+
+
+async def aggregate_chat(
+    request_id: str, model: str, stream: AsyncIterator[BackendOutput]
+) -> ChatCompletionResponse:
+    """Non-streaming mode: fold the whole stream into one response."""
+    gen = ChatDeltaGenerator(request_id, model)
+    text_parts = []
+    finish = None
+    async for out in stream:
+        gen.on_output(out)
+        if out.text:
+            text_parts.append(out.text)
+        if out.finish_reason is not None:
+            finish = out.finish_reason
+    return ChatCompletionResponse(
+        id=request_id,
+        created=gen.created,
+        model=model,
+        choices=[
+            ChatChoice(
+                index=0,
+                message=ChatResponseMessage(content="".join(text_parts)),
+                finish_reason=finish or "stop",
+            )
+        ],
+        usage=gen.usage(),
+    )
+
+
+class CompletionDeltaGenerator:
+    """Streaming text-completions: each step is a partial CompletionResponse."""
+
+    def __init__(self, request_id: str, model: str, include_usage: bool = False):
+        self.id = request_id
+        self.model = model
+        self.created = now_ts()
+        self.include_usage = include_usage
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+        self.cached_tokens: Optional[int] = None
+
+    def on_output(self, out: BackendOutput):
+        if out.annotations:
+            self.prompt_tokens = out.annotations.get("input_tokens", self.prompt_tokens)
+            if "cached_tokens" in out.annotations:
+                self.cached_tokens = out.annotations["cached_tokens"]
+        self.completion_tokens = max(self.completion_tokens, out.cumulative_tokens)
+        chunks = []
+        if out.text or out.finish_reason is not None:
+            resp = CompletionResponse(
+                id=self.id, created=self.created, model=self.model,
+                choices=[CompletionChoice(index=0, text=out.text or "", finish_reason=out.finish_reason)],
+            )
+            chunks.append(resp)
+        if out.finish_reason is not None and self.include_usage:
+            chunks.append(
+                CompletionResponse(
+                    id=self.id, created=self.created, model=self.model, choices=[],
+                    usage=self.usage(),
+                )
+            )
+        return chunks
+
+    def usage(self) -> Usage:
+        return Usage(
+            prompt_tokens=self.prompt_tokens,
+            completion_tokens=self.completion_tokens,
+            total_tokens=self.prompt_tokens + self.completion_tokens,
+            cached_tokens=self.cached_tokens,
+        )
+
+
+async def aggregate_completion(
+    request_id: str, model: str, stream: AsyncIterator[BackendOutput], echo_text: str = ""
+) -> CompletionResponse:
+    gen = CompletionDeltaGenerator(request_id, model)
+    parts = [echo_text] if echo_text else []
+    finish = None
+    async for out in stream:
+        gen.on_output(out)
+        if out.text:
+            parts.append(out.text)
+        if out.finish_reason is not None:
+            finish = out.finish_reason
+    return CompletionResponse(
+        id=request_id,
+        created=gen.created,
+        model=model,
+        choices=[CompletionChoice(index=0, text="".join(parts), finish_reason=finish or "stop")],
+        usage=gen.usage(),
+    )

@@ -1,0 +1,49 @@
+"""Worker-side registration: serve an engine + publish its model card.
+
+Analog of the reference's ``register_llm`` binding
+(lib/bindings/python/rust/lib.rs:230-248): wraps the engine in the Backend
+operator (detokenize + stop handling), serves the endpoint on the request
+plane, and writes the ModelDeploymentCard into the store under the worker's
+lease so frontends discover it (reference: lib/llm/src/model_card.rs:32).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..runtime.component import ServedEndpoint
+from ..runtime.distributed import DistributedRuntime
+from ..runtime.engine import AsyncEngine
+from .backend import Backend
+from .model_card import ModelDeploymentCard, mdc_key
+from .tokenizer import Tokenizer, load_tokenizer
+
+
+async def register_llm(
+    runtime: DistributedRuntime,
+    engine: AsyncEngine,
+    card: ModelDeploymentCard,
+    tokenizer: Optional[Tokenizer] = None,
+    raw_token_stream: bool = False,
+    metadata: Optional[Dict[str, Any]] = None,
+) -> ServedEndpoint:
+    """Serve ``engine`` for ``card`` and announce it.
+
+    raw_token_stream=True skips the Backend wrapper (engine already emits
+    finished BackendOutput objs with text + stop handling)."""
+    tok = tokenizer or load_tokenizer(card.tokenizer)
+    handler = engine.generate if raw_token_stream else Backend(engine, tok).generate
+    endpoint = (
+        runtime.namespace(card.namespace).component(card.component).endpoint(card.endpoint)
+    )
+    md = {
+        "model": card.name,
+        "data_parallel_size": card.runtime_config.data_parallel_size,
+        "total_kv_blocks": card.runtime_config.total_kv_blocks,
+    }
+    if metadata:
+        md.update(metadata)
+    served = await endpoint.serve(handler, metadata=md)
+    key = mdc_key(card.namespace, card.slug, served.instance_id)
+    await served.publish_extra(key, card.to_obj())
+    return served

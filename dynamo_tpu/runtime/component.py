@@ -152,6 +152,9 @@ class ServedEndpoint:
         self.instance = instance
         self.server = server
         self._key = key
+        # additional lease-attached keys (e.g. model cards) that live and die
+        # with this endpoint: key -> msgpack-able object
+        self.extra_objs: Dict[str, Any] = {}
 
     @property
     def instance_id(self) -> int:
@@ -167,10 +170,17 @@ class ServedEndpoint:
             self._key, self.instance.to_obj(), self.endpoint.runtime.lease_id
         )
 
+    async def publish_extra(self, key: str, obj: Any) -> None:
+        rt = self.endpoint.runtime
+        self.extra_objs[key] = obj
+        await rt.store.put_obj(key, obj, rt.lease_id)
+
     async def stop(self, graceful_timeout_s: float = 5.0) -> None:
         rt = self.endpoint.runtime
         if self in getattr(rt, "served", []):
             rt.served.remove(self)
+        for key in self.extra_objs:
+            await rt.store.delete(key)
         await rt.store.delete(self._key)
         await self.server.stop(graceful_timeout_s)
 

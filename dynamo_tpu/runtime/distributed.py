@@ -84,6 +84,8 @@ class DistributedRuntime(DistributedRuntimeBase):
                                 await self.store.put_obj(
                                     served._key, served.instance.to_obj(), self.lease_id
                                 )
+                                for k, obj in served.extra_objs.items():
+                                    await self.store.put_obj(k, obj, self.lease_id)
                             except Exception:
                                 log.exception("re-register %s failed", served._key)
         except asyncio.CancelledError:

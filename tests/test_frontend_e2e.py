@@ -1,0 +1,229 @@
+"""Frontend e2e: echo worker registers -> watcher discovers -> HTTP serves.
+
+Mirrors the reference's frontend-vs-mocker e2e
+(tests/frontend/test_completion_mocker_engine.py) at a smaller scale: real
+HTTP server, real discovery, real request plane — echo engine instead of GPU.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+
+from dynamo_tpu.llm import (
+    EchoEngine,
+    ModelDeploymentCard,
+    ModelManager,
+    ModelWatcher,
+    register_llm,
+)
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    InProcEventPlane,
+    MemKVStore,
+    RouterMode,
+    RuntimeConfig,
+)
+
+
+def make_rt(store):
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    return DistributedRuntime(cfg, store=store, event_plane=InProcEventPlane())
+
+
+async def start_stack(store, router_mode=RouterMode.ROUND_ROBIN):
+    worker_rt = await make_rt(store).start()
+    frontend_rt = await make_rt(store).start()
+    card = ModelDeploymentCard(name="echo-model", tokenizer="byte", context_length=4096)
+    served = await register_llm(worker_rt, EchoEngine(), card)
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager, router_mode).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    addr = await service.start()
+    # wait for discovery
+    for _ in range(100):
+        if manager.get("echo-model") and manager.get("echo-model").client.instances:
+            break
+        await asyncio.sleep(0.05)
+    return worker_rt, frontend_rt, served, watcher, service, f"http://127.0.0.1:{service.port}"
+
+
+async def stop_stack(worker_rt, frontend_rt, served, watcher, service):
+    await service.stop()
+    await watcher.stop()
+    await served.stop()
+    await worker_rt.shutdown()
+    await frontend_rt.shutdown()
+
+
+async def test_chat_completion_aggregated():
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, base = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "echo-model",
+                    "messages": [{"role": "user", "content": "hello!"}],
+                },
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["object"] == "chat.completion"
+            # echo engine streams the templated prompt back
+            assert "hello!" in body["choices"][0]["message"]["content"]
+            assert body["usage"]["prompt_tokens"] > 0
+            assert body["usage"]["completion_tokens"] > 0
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_chat_completion_streaming_sse():
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, base = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "echo-model",
+                    "messages": [{"role": "user", "content": "abc"}],
+                    "stream": True,
+                    "stream_options": {"include_usage": True},
+                },
+            )
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            chunks = []
+            done = False
+            async for line in r.content:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    done = True
+                    break
+                chunks.append(json.loads(payload))
+            assert done
+            text = "".join(
+                c["choices"][0]["delta"].get("content") or ""
+                for c in chunks if c["choices"]
+            )
+            assert "abc" in text
+            finish = [c["choices"][0].get("finish_reason") for c in chunks if c["choices"]]
+            assert "stop" in finish
+            usages = [c for c in chunks if c.get("usage")]
+            assert usages and usages[-1]["usage"]["completion_tokens"] > 0
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_completions_endpoint():
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, base = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/completions",
+                json={"model": "echo-model", "prompt": "xyz", "max_tokens": 3},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["object"] == "text_completion"
+            assert body["choices"][0]["text"] == "xyz"
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_model_listing_and_404():
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, base = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(f"{base}/v1/models")
+            models = [m["id"] for m in (await r.json())["data"]]
+            assert models == ["echo-model"]
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+            )
+            assert r.status == 404
+            r = await s.post(f"{base}/v1/chat/completions", json={"model": "echo-model"})
+            assert r.status == 400
+            r = await s.get(f"{base}/metrics")
+            assert "dtpu_requests_total" in await r.text()
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_model_removed_when_worker_leaves():
+    store = MemKVStore()
+    stack = await start_stack(store)
+    worker_rt, frontend_rt, served, watcher, service, base = stack
+    try:
+        await served.stop()
+        for _ in range(100):
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(f"{base}/v1/models")
+                models = [m["id"] for m in (await r.json())["data"]]
+            if not models:
+                break
+            await asyncio.sleep(0.05)
+        assert models == []
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()
+
+
+async def test_kv_routing_mode_e2e():
+    """KV router mode with echo workers: requests flow, repeat prompts stick."""
+    store = MemKVStore()
+    # shared event plane so router sees worker events (none from echo, but
+    # the ApproxKvIndexer path works without events)
+    plane = InProcEventPlane()
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    worker_rt = await DistributedRuntime(cfg, store=store, event_plane=plane).start()
+    frontend_rt = await DistributedRuntime(cfg, store=store, event_plane=plane).start()
+    card = ModelDeploymentCard(name="echo-model", tokenizer="byte", context_length=4096)
+    s1 = await register_llm(worker_rt, EchoEngine(), card)
+    s2 = await register_llm(worker_rt, EchoEngine(), card)
+    manager = ModelManager()
+    from dynamo_tpu.kv_router import KvRouterConfig
+
+    watcher = await ModelWatcher(
+        frontend_rt, manager, RouterMode.KV, KvRouterConfig(use_kv_events=False)
+    ).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        for _ in range(100):
+            p = manager.get("echo-model")
+            if p and len(p.client.instances) == 2:
+                break
+            await asyncio.sleep(0.05)
+        prompt = {"model": "echo-model", "messages": [{"role": "user", "content": "route me " * 20}]}
+        async with aiohttp.ClientSession() as s:
+            for _ in range(3):
+                r = await s.post(f"{base}/v1/chat/completions", json=prompt)
+                assert r.status == 200
+        # approx indexer should have recorded blocks for the routed worker
+        router = manager.get("echo-model").kv_router
+        assert router is not None
+        assert len(router.indexer.tree) > 0
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await s1.stop()
+        await s2.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()

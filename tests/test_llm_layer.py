@@ -1,0 +1,178 @@
+"""LLM layer units: tokenizer, jail, backend, preprocessor, deltas."""
+
+import pytest
+
+from dynamo_tpu.llm import ByteTokenizer, DecodeStream, StopStringJail
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.engines import EchoEngine
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest, ChatMessage
+from dynamo_tpu.runtime import Context
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer()
+        for text in ["hello world", "héllo ünïcode 漢字", ""]:
+            assert tok.decode(tok.encode(text)) == text
+
+    def test_chat_encoding_has_specials(self):
+        tok = ByteTokenizer()
+        ids = tok.encode_chat([{"role": "user", "content": "hi"}])
+        assert tok.BOS in ids and tok.IM_START in ids and tok.IM_END in ids
+
+    def test_decode_stream_multibyte(self):
+        tok = ByteTokenizer()
+        text = "héllo漢"
+        ids = tok.encode(text)
+        ds = DecodeStream(tok)
+        out = ""
+        for i in ids:
+            out += ds.step([i])
+        out += ds.flush()
+        assert out == text
+
+
+class TestStopStringJail:
+    def test_exact_stop(self):
+        jail = StopStringJail(["STOP"])
+        text, hit = jail.push("hello STOP world")
+        assert (text, hit) == ("hello ", True)
+
+    def test_partial_holdback_then_release(self):
+        jail = StopStringJail(["STOP"])
+        text, hit = jail.push("abc ST")
+        assert (text, hit) == ("abc ", False)
+        text, hit = jail.push("ILL going")  # "STILL" != STOP -> release held
+        assert (text, hit) == ("STILL going", False)
+
+    def test_partial_holdback_completes(self):
+        jail = StopStringJail(["STOP"])
+        t1, h1 = jail.push("abc ST")
+        t2, h2 = jail.push("OP def")
+        assert (t1 + t2, h2) == ("abc ", True)
+
+    def test_split_across_many_chunks(self):
+        jail = StopStringJail(["<|end|>"])
+        emitted = ""
+        hit = False
+        for ch in "result<|end|>junk":
+            t, h = jail.push(ch)
+            emitted += t
+            if h:
+                hit = True
+                break
+        assert emitted == "result"
+        assert hit
+
+
+async def run_backend(prompt_ids, stop=None, max_tokens=None, delay=0.0):
+    tok = ByteTokenizer()
+    backend = Backend(EchoEngine(delay_s=delay), tok)
+    req = PreprocessedRequest(
+        request_id="r", model="m", token_ids=prompt_ids,
+        stop=StopConditions(max_tokens=max_tokens, stop_strings=stop or []),
+    )
+    outs = []
+    async for obj in backend.generate(req, Context()):
+        outs.append(BackendOutput.from_obj(obj))
+    return outs
+
+
+async def test_backend_echo_detokenizes():
+    tok = ByteTokenizer()
+    ids = tok.encode("hello")
+    outs = await run_backend(ids)
+    text = "".join(o.text or "" for o in outs)
+    assert text == "hello"
+    assert outs[-1].finish_reason == "stop"
+
+
+async def test_backend_max_tokens():
+    tok = ByteTokenizer()
+    ids = tok.encode("hello world")
+    outs = await run_backend(ids, max_tokens=5)
+    text = "".join(o.text or "" for o in outs)
+    assert text == "hello"
+    assert outs[-1].finish_reason in ("length", "stop")
+
+
+async def test_backend_stop_string():
+    tok = ByteTokenizer()
+    ids = tok.encode("foo END bar")
+    outs = await run_backend(ids, stop=["END"])
+    text = "".join(o.text or "" for o in outs)
+    assert text == "foo "
+    assert outs[-1].finish_reason == "stop"
+
+
+async def test_backend_eos_token():
+    tok = ByteTokenizer()
+    ids = tok.encode("ab") + [tok.EOS] + tok.encode("cd")
+    outs = await run_backend(ids)
+    text = "".join(o.text or "" for o in outs)
+    assert text == "ab"
+    assert outs[-1].finish_reason == "stop"
+
+
+class TestPreprocessor:
+    def make(self, ctx_len=1000):
+        card = ModelDeploymentCard(name="m", context_length=ctx_len, tokenizer="byte")
+        return OpenAIPreprocessor(card)
+
+    def test_chat_preprocess(self):
+        pre = self.make()
+        req = ChatCompletionRequest(
+            model="m",
+            messages=[ChatMessage(role="user", content="hi")],
+            max_tokens=32,
+            temperature=0.5,
+            stop=["\n\n"],
+        )
+        p = pre.preprocess_chat(req)
+        assert p.stop.max_tokens == 32
+        assert p.sampling.temperature == 0.5
+        assert p.stop.stop_strings == ["\n\n"]
+        assert p.annotations["input_tokens"] == len(p.token_ids)
+        assert len(p.token_ids) > 0
+
+    def test_context_overflow_rejected(self):
+        pre = self.make(ctx_len=4)
+        req = ChatCompletionRequest(
+            model="m", messages=[ChatMessage(role="user", content="much too long prompt")]
+        )
+        with pytest.raises(ValueError, match="context"):
+            pre.preprocess_chat(req)
+
+    def test_max_tokens_clamped_to_budget(self):
+        pre = self.make(ctx_len=50)
+        req = ChatCompletionRequest(
+            model="m", messages=[ChatMessage(role="user", content="hi")], max_tokens=10_000
+        )
+        p = pre.preprocess_chat(req)
+        assert p.stop.max_tokens == 50 - len(p.token_ids)
+
+    def test_validation_rejects_bad_fields(self):
+        with pytest.raises(ValueError):
+            ChatCompletionRequest.model_validate(
+                {"model": "m", "messages": [], "temperature": 0.1}
+            )
+        with pytest.raises(ValueError):
+            ChatCompletionRequest.model_validate(
+                {"model": "m", "messages": [{"role": "user", "content": "x"}], "temperature": 99}
+            )
+
+
+async def test_backend_flushes_held_stop_prefix_on_finish():
+    """Output ending in a proper prefix of a stop string must not be dropped."""
+    tok = ByteTokenizer()
+    ids = tok.encode("foo#")  # '#' is a prefix of stop '##'
+    outs = await run_backend(ids, stop=["##"])
+    text = "".join(o.text or "" for o in outs)
+    assert text == "foo#"
