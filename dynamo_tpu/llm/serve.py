@@ -26,6 +26,7 @@ async def register_llm(
     tokenizer: Optional[Tokenizer] = None,
     raw_token_stream: bool = False,
     metadata: Optional[Dict[str, Any]] = None,
+    instance_id: Optional[int] = None,
 ) -> ServedEndpoint:
     """Serve ``engine`` for ``card`` and announce it.
 
@@ -43,7 +44,7 @@ async def register_llm(
     }
     if metadata:
         md.update(metadata)
-    served = await endpoint.serve(handler, metadata=md)
+    served = await endpoint.serve(handler, instance_id=instance_id, metadata=md)
     key = mdc_key(card.namespace, card.slug, served.instance_id)
     await served.publish_extra(key, card.to_obj())
     return served

@@ -1,0 +1,95 @@
+"""python -m dynamo_tpu.mocker — accelerator-free worker for fleet testing.
+
+Analog of the reference's `python -m dynamo.mocker`
+(components/src/dynamo/mocker): registers a MockerEngine as a real worker —
+request plane endpoint, model card, KV events, load metrics — so routers,
+planners and frontends can be exercised at scale on one box.
+"""
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.kv_router import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm import ModelDeploymentCard, ModelRuntimeConfig, register_llm
+from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+from dynamo_tpu.runtime.component import new_instance_id
+
+
+def parse_args():
+    p = argparse.ArgumentParser("dynamo_tpu.mocker")
+    p.add_argument("--model", default="mock-model", help="served model name")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--store", default=None)
+    p.add_argument("--store-path", default=None)
+    p.add_argument("--event-plane", default=None)
+    p.add_argument("--num-blocks", type=int, default=4096)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-num-seqs", type=int, default=256)
+    p.add_argument("--speedup-ratio", type=float, default=1.0)
+    p.add_argument("--startup-time", type=float, default=0.0)
+    p.add_argument("--migration-limit", type=int, default=0)
+    p.add_argument("--model-type", default="chat,completions")
+    p.add_argument("--num-workers", type=int, default=1, help="instances in this process")
+    return p.parse_args()
+
+
+async def main() -> None:
+    args = parse_args()
+    init_logging()
+    cfg = RuntimeConfig.from_env(
+        store=args.store, store_path=args.store_path, event_plane=args.event_plane
+    )
+    runtime = await DistributedRuntime(cfg).start()
+
+    served = []
+    for _ in range(args.num_workers):
+        instance_id = new_instance_id()
+        engine_args = MockEngineArgs(
+            num_blocks=args.num_blocks,
+            block_size=args.block_size,
+            max_num_seqs=args.max_num_seqs,
+            speedup_ratio=args.speedup_ratio,
+            startup_time_s=args.startup_time,
+        )
+        kv_pub = KvEventPublisher(
+            runtime.event_plane, args.namespace, args.component,
+            worker_id=instance_id, block_size=args.block_size,
+        )
+        m_pub = WorkerMetricsPublisher(
+            runtime.event_plane, args.namespace, args.component, worker_id=instance_id
+        )
+        engine = MockerEngine(engine_args, kv_pub, m_pub)
+        card = ModelDeploymentCard(
+            name=args.model,
+            namespace=args.namespace,
+            component=args.component,
+            endpoint=args.endpoint,
+            model_type=args.model_type.split(","),
+            tokenizer="byte",
+            kv_block_size=args.block_size,
+            migration_limit=args.migration_limit,
+            runtime_config=ModelRuntimeConfig(
+                total_kv_blocks=args.num_blocks, kv_block_size=args.block_size,
+                max_batch_size=args.max_num_seqs,
+            ),
+        )
+        s = await register_llm(runtime, engine, card, instance_id=instance_id)
+        served.append(s)
+    print(f"MOCKER_READY {len(served)} workers", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    for s in served:
+        await s.stop()
+    await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,380 @@
+"""Mocker: an accelerator-free engine simulating paged-KV continuous batching.
+
+Analog of the reference's mocker (lib/mocker/src/{scheduler,kv_manager,
+evictor}.rs, MockEngineArgs at protocols.rs:89-129, behavior documented in
+docs/mocker/mocker.md:7-24): simulates block allocation, prefix-cache reuse,
+LRU eviction, chunked prefill, watermark admission and step timing — so the
+entire control plane (router, planner, frontends, fault tolerance) can be
+exercised at fleet scale with zero TPUs.
+
+Deterministic token generation: output token ids derive from a hash of
+(request_id, position), so tests can assert exact streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import time
+from collections import OrderedDict
+from typing import Any, AsyncIterator, Dict, List, Optional, Set
+
+from ..kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from ..tokens import SequenceHash, TokenBlockSequence
+from ..llm.protocols.common import (
+    FINISH_LENGTH,
+    FINISH_STOP,
+    BackendOutput,
+    PreprocessedRequest,
+)
+
+log = get_logger("mocker")
+
+
+@dataclasses.dataclass
+class MockEngineArgs:
+    """Mirrors the reference's MockEngineArgs (lib/mocker/src/protocols.rs:89-129)."""
+
+    num_blocks: int = 4096
+    block_size: int = 16
+    watermark: float = 0.01            # fraction of blocks kept free
+    max_num_seqs: int = 256
+    max_num_batched_tokens: int = 8192
+    enable_prefix_caching: bool = True
+    enable_chunked_prefill: bool = True
+    speedup_ratio: float = 1.0         # >1 -> faster simulated clock
+    dp_size: int = 1
+    startup_time_s: float = 0.0
+    # timing model: per-iteration costs (seconds)
+    prefill_base_s: float = 0.02
+    prefill_per_token_s: float = 0.0001
+    decode_base_s: float = 0.005
+    decode_per_kv_block_s: float = 0.000002
+
+
+def _mock_token(request_id: str, position: int, vocab: int = 250) -> int:
+    h = hashlib.blake2b(f"{request_id}:{position}".encode(), digest_size=4).digest()
+    return 32 + int.from_bytes(h, "little") % vocab  # printable-byte range
+
+
+class KvBlockState:
+    """Paged-KV bookkeeping: active (pinned) + cached (evictable LRU) blocks."""
+
+    def __init__(self, args: MockEngineArgs):
+        self.args = args
+        self.capacity = args.num_blocks
+        # seq_hash -> refcount (active use by running requests)
+        self.active: Dict[SequenceHash, int] = {}
+        # LRU of inactive cached blocks (prefix cache), most-recent last
+        self.cached: OrderedDict[SequenceHash, None] = OrderedDict()
+        self.events_stored: List[List[SequenceHash]] = []
+        self.events_removed: List[List[SequenceHash]] = []
+
+    # -- accounting ----------------------------------------------------------
+    @property
+    def used_blocks(self) -> int:
+        return len(self.active) + len(self.cached)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.capacity - len(self.active) - len(self.cached)
+
+    def evictable_blocks(self) -> int:
+        return len(self.cached)
+
+    def can_allocate(self, n_new: int) -> bool:
+        headroom = self.capacity * (1.0 - self.args.watermark)
+        return len(self.active) + n_new <= headroom + 1e-9
+
+    # -- operations ----------------------------------------------------------
+    def cached_prefix_len(self, hashes: List[SequenceHash]) -> int:
+        """Contiguous leading blocks already present (active or cached)."""
+        n = 0
+        for h in hashes:
+            if h in self.active or h in self.cached:
+                n += 1
+            else:
+                break
+        return n
+
+    def acquire(self, hashes: List[SequenceHash]) -> Optional[List[SequenceHash]]:
+        """Pin blocks for a running request, reusing cache, evicting LRU as
+        needed. Returns newly-stored hashes, or None if out of memory."""
+        new: List[SequenceHash] = []
+        needed = 0
+        for h in hashes:
+            if h not in self.active and h not in self.cached:
+                needed += 1
+        # evict from LRU until there is room (only blocks not being acquired)
+        acquiring: Set[SequenceHash] = set(hashes)
+        evicted: List[SequenceHash] = []
+        while self.free_blocks < needed:
+            victim = None
+            for h in self.cached:
+                if h not in acquiring:
+                    victim = h
+                    break
+            if victim is None:
+                return None
+            self.cached.pop(victim)
+            evicted.append(victim)
+        if evicted:
+            self.events_removed.append(evicted)
+        if not self.can_allocate(sum(1 for h in hashes if h not in self.active)):
+            # re-insert nothing; admission simply fails this cycle
+            return None
+        for h in hashes:
+            if h in self.active:
+                self.active[h] += 1
+            elif h in self.cached:
+                self.cached.pop(h)
+                self.active[h] = 1
+            else:
+                self.active[h] = 1
+                new.append(h)
+        if new:
+            self.events_stored.append(new)
+        return new
+
+    def release(self, hashes: List[SequenceHash]) -> None:
+        """Unpin: blocks move to the prefix cache (LRU) when refcount hits 0."""
+        for h in hashes:
+            rc = self.active.get(h)
+            if rc is None:
+                continue
+            if rc <= 1:
+                del self.active[h]
+                if self.args.enable_prefix_caching:
+                    self.cached[h] = None
+                    self.cached.move_to_end(h)
+                else:
+                    self.events_removed.append([h])
+            else:
+                self.active[h] = rc - 1
+
+    def drain_events(self):
+        stored, self.events_stored = self.events_stored, []
+        removed, self.events_removed = self.events_removed, []
+        return stored, removed
+
+
+@dataclasses.dataclass
+class _Running:
+    req: PreprocessedRequest
+    context: Context
+    seq: TokenBlockSequence              # prompt + generated tokens
+    out_queue: asyncio.Queue
+    prefill_remaining: int               # tokens of prompt not yet prefilled
+    cached_tokens: int = 0
+    produced: int = 0
+    acquired: List[SequenceHash] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+
+class MockerEngine:
+    """AsyncEngine with a continuous-batching simulation loop."""
+
+    def __init__(
+        self,
+        args: Optional[MockEngineArgs] = None,
+        kv_publisher: Optional[KvEventPublisher] = None,
+        metrics_publisher: Optional[WorkerMetricsPublisher] = None,
+    ):
+        self.args = args or MockEngineArgs()
+        self.kv = KvBlockState(self.args)
+        self.kv_publisher = kv_publisher
+        self.metrics_publisher = metrics_publisher
+        self._waiting: List[_Running] = []
+        self._running: List[_Running] = []
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._started_at = time.monotonic()
+
+    # -- engine interface ----------------------------------------------------
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_obj(request)
+        self._ensure_loop()
+        startup_left = self.args.startup_time_s - (time.monotonic() - self._started_at)
+        if startup_left > 0:
+            await asyncio.sleep(startup_left / self.args.speedup_ratio)
+        seq = TokenBlockSequence(req.token_ids, self.args.block_size)
+        state = _Running(
+            req=req,
+            context=context,
+            seq=seq,
+            out_queue=asyncio.Queue(),
+            prefill_remaining=len(req.token_ids),
+        )
+        self._waiting.append(state)
+        self._wake.set()
+        while True:
+            item = await state.out_queue.get()
+            if item is None:
+                return
+            yield item
+            if item.finish_reason is not None:
+                return
+
+    # -- simulation loop -----------------------------------------------------
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                if not self._waiting and not self._running:
+                    self._wake.clear()
+                    await self._wake.wait()
+                self._admit()
+                step_time = await self._step()
+                await self._publish_events()
+                await asyncio.sleep(step_time / self.args.speedup_ratio)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            log.exception("mocker loop crashed")
+
+    def _admit(self) -> None:
+        still_waiting: List[_Running] = []
+        for st in self._waiting:
+            if st.context.is_stopped():
+                st.out_queue.put_nowait(
+                    BackendOutput(finish_reason="cancelled", cumulative_tokens=0)
+                )
+                continue
+            if len(self._running) >= self.args.max_num_seqs:
+                still_waiting.append(st)
+                continue
+            hashes = st.seq.sequence_hashes()
+            cached = (
+                self.kv.cached_prefix_len(hashes) if self.args.enable_prefix_caching else 0
+            )
+            needed_new = sum(
+                1 for h in hashes if h not in self.kv.active and h not in self.kv.cached
+            )
+            if not self.kv.can_allocate(needed_new) and self.kv.evictable_blocks() < needed_new:
+                still_waiting.append(st)  # not enough memory yet
+                continue
+            if self.kv.acquire(hashes) is None:
+                still_waiting.append(st)
+                continue
+            st.acquired = list(hashes)
+            st.cached_tokens = cached * self.args.block_size
+            st.prefill_remaining = max(0, len(st.req.token_ids) - st.cached_tokens)
+            self._running.append(st)
+        self._waiting = still_waiting
+
+    async def _step(self) -> float:
+        """One engine iteration; returns simulated duration (seconds)."""
+        if not self._running:
+            return 0.001
+        duration = 0.0
+        prefill_budget = self.args.max_num_batched_tokens
+        decode_kv_blocks = 0
+        finished: List[_Running] = []
+
+        for st in self._running:
+            if st.context.is_stopped():
+                st.out_queue.put_nowait(
+                    BackendOutput(finish_reason="cancelled", cumulative_tokens=st.produced)
+                )
+                finished.append(st)
+                continue
+            if st.prefill_remaining > 0:
+                chunk = (
+                    min(st.prefill_remaining, prefill_budget)
+                    if self.args.enable_chunked_prefill
+                    else st.prefill_remaining
+                )
+                if chunk <= 0:
+                    continue
+                st.prefill_remaining -= chunk
+                prefill_budget -= chunk
+                duration += self.args.prefill_base_s + self.args.prefill_per_token_s * chunk
+                if st.prefill_remaining == 0:
+                    # first token arrives with prefill completion
+                    self._emit_token(st)
+                    if st.done:
+                        finished.append(st)
+                continue
+            # decode: one token per iteration
+            decode_kv_blocks += st.seq.num_blocks()
+            self._emit_token(st)
+            if st.done:
+                finished.append(st)
+
+        duration += self.args.decode_base_s + self.args.decode_per_kv_block_s * decode_kv_blocks
+
+        for st in finished:
+            self._running.remove(st)
+            self.kv.release(st.acquired)
+        return max(duration, 0.0005)
+
+    def _emit_token(self, st: _Running) -> None:
+        first = st.produced == 0  # covers full-cache-hit requests that skip prefill
+        tid = _mock_token(st.req.request_id, st.produced)
+        st.produced += 1
+        sealed = st.seq.append(tid)
+        if sealed is not None:
+            got = self.kv.acquire([sealed.sequence_hash])
+            if got is not None:
+                st.acquired.append(sealed.sequence_hash)
+        finish: Optional[str] = None
+        limit = st.req.stop.max_tokens
+        if limit is not None and st.produced >= limit:
+            finish = FINISH_LENGTH
+        # deterministic "natural" stop: ~1/128 chance per token via hash
+        elif _mock_token(st.req.request_id, st.produced - 1, 1 << 16) % 128 == 0 and (
+            st.produced > st.req.stop.min_tokens
+        ):
+            finish = FINISH_STOP
+        ann = {}
+        if first:
+            ann = {
+                "cached_tokens": st.cached_tokens,
+                "input_tokens": len(st.req.token_ids),
+            }
+        st.out_queue.put_nowait(
+            BackendOutput(
+                token_ids=[tid],
+                finish_reason=finish,
+                cumulative_tokens=st.produced,
+                annotations=ann,
+            )
+        )
+        if finish is not None:
+            st.done = True
+
+    async def _publish_events(self) -> None:
+        stored, removed = self.kv.drain_events()
+        if self.kv_publisher is not None:
+            for batch in stored:
+                await self.kv_publisher.stored(batch)
+            for batch in removed:
+                await self.kv_publisher.removed(batch)
+        if self.metrics_publisher is not None:
+            await self.metrics_publisher.publish(
+                active_decode_blocks=len(self.kv.active),
+                num_requests_waiting=len(self._waiting),
+                total_blocks=self.args.num_blocks,
+            )
+
+    # -- introspection (for planner/tests) ------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "running": len(self._running),
+            "waiting": len(self._waiting),
+            "active_blocks": len(self.kv.active),
+            "cached_blocks": len(self.kv.cached),
+            "free_blocks": self.kv.free_blocks,
+        }

@@ -1,0 +1,148 @@
+"""Mocker engine tests: continuous batching, prefix cache, eviction, events."""
+
+import asyncio
+
+from dynamo_tpu.kv_router import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.mocker.engine import KvBlockState, MockEngineArgs, MockerEngine
+from dynamo_tpu.runtime import Context, InProcEventPlane
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+
+def fast_args(**kw):
+    defaults = dict(
+        num_blocks=128,
+        block_size=4,
+        speedup_ratio=1000.0,
+        prefill_base_s=0.001,
+        decode_base_s=0.001,
+    )
+    defaults.update(kw)
+    return MockEngineArgs(**defaults)
+
+
+def req(rid, tokens, max_tokens=8):
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=tokens,
+        stop=StopConditions(max_tokens=max_tokens),
+    )
+
+
+async def collect(engine, r, ctx=None):
+    outs = []
+    async for o in engine.generate(r, ctx or Context()):
+        outs.append(o)
+    return outs
+
+
+async def test_generates_deterministic_tokens():
+    engine = MockerEngine(fast_args())
+    outs1 = await collect(engine, req("r1", list(range(20)), max_tokens=6))
+    outs2 = await collect(engine, req("r1", list(range(20)), max_tokens=6))
+    ids1 = [t for o in outs1 for t in o.token_ids]
+    ids2 = [t for o in outs2 for t in o.token_ids]
+    assert ids1 == ids2
+    assert len(ids1) == 6
+    assert outs1[-1].finish_reason in ("length", "stop")
+    engine.stop()
+
+
+async def test_first_output_has_cache_annotations():
+    engine = MockerEngine(fast_args())
+    outs = await collect(engine, req("a", list(range(32)), max_tokens=2))
+    assert outs[0].annotations["input_tokens"] == 32
+    assert outs[0].annotations["cached_tokens"] == 0
+    # same prompt again: prefix cache hit
+    outs2 = await collect(engine, req("b", list(range(32)), max_tokens=2))
+    assert outs2[0].annotations["cached_tokens"] == 32
+    engine.stop()
+
+
+async def test_concurrent_requests_batch():
+    engine = MockerEngine(fast_args(max_num_seqs=8))
+    results = await asyncio.gather(
+        *[collect(engine, req(f"r{i}", [i] * 16, max_tokens=5)) for i in range(8)]
+    )
+    for outs in results:
+        assert sum(len(o.token_ids) for o in outs) == 5
+    engine.stop()
+
+
+async def test_cancellation():
+    engine = MockerEngine(fast_args(speedup_ratio=1.0, decode_base_s=0.05))
+    ctx = Context()
+    outs = []
+
+    async def run():
+        async for o in engine.generate(req("c", list(range(8)), max_tokens=1000), ctx):
+            outs.append(o)
+
+    task = asyncio.create_task(run())
+    await asyncio.sleep(0.3)
+    ctx.stop_generating()
+    await asyncio.wait_for(task, 5)
+    assert outs[-1].finish_reason == "cancelled"
+    engine.stop()
+
+
+async def test_memory_pressure_queues_requests():
+    # 8 blocks of 4 tokens = 32-token capacity; two 16-token prompts + decode
+    engine = MockerEngine(fast_args(num_blocks=8, watermark=0.0, max_num_seqs=8))
+    results = await asyncio.gather(
+        *[collect(engine, req(f"m{i}", [100 + i] * 12, max_tokens=4)) for i in range(4)]
+    )
+    for outs in results:
+        assert outs[-1].finish_reason is not None  # all eventually complete
+    engine.stop()
+
+
+async def test_kv_events_published():
+    plane = InProcEventPlane()
+    sub = await plane.subscribe("kv.")
+    kv_pub = KvEventPublisher(plane, "ns", "c", worker_id=7, block_size=4)
+    m_pub = WorkerMetricsPublisher(plane, "ns", "c", worker_id=7)
+    engine = MockerEngine(fast_args(), kv_pub, m_pub)
+    await collect(engine, req("e", list(range(16)), max_tokens=2))
+    topics = set()
+    for _ in range(50):
+        item = await sub.next(timeout=0.2)
+        if item is None:
+            break
+        topics.add(item[0])
+    assert "kv.events.ns.c" in topics
+    assert "kv.metrics.ns.c" in topics
+    engine.stop()
+    await plane.close()
+
+
+class TestKvBlockState:
+    def test_prefix_reuse_and_lru_eviction(self):
+        args = fast_args(num_blocks=4, watermark=0.0)
+        kv = KvBlockState(args)
+        h1 = compute_sequence_hashes(list(range(8)), 4)     # 2 blocks
+        h2 = compute_sequence_hashes(list(range(100, 108)), 4)
+        assert kv.acquire(h1) == h1
+        kv.release(h1)  # -> cached
+        assert kv.cached_prefix_len(h1) == 2
+        assert kv.acquire(h2) == h2                          # fits alongside
+        h3 = compute_sequence_hashes(list(range(200, 208)), 4)
+        assert kv.acquire(h3) == h3                          # evicts h1 LRU
+        assert kv.cached_prefix_len(h1) == 0
+        stored, removed = kv.drain_events()
+        assert any(h1[0] in batch for batch in removed)
+
+    def test_refcounting(self):
+        kv = KvBlockState(fast_args(num_blocks=8, watermark=0.0))
+        h = compute_sequence_hashes(list(range(8)), 4)
+        kv.acquire(h)
+        kv.acquire(h)
+        kv.release(h)
+        assert all(x in kv.active for x in h)  # still pinned by second req
+        kv.release(h)
+        assert all(x in kv.cached for x in h)
+
+    def test_watermark_blocks_admission(self):
+        kv = KvBlockState(fast_args(num_blocks=10, watermark=0.5))
+        h = compute_sequence_hashes(list(range(24)), 4)  # 6 blocks > 5 allowed
+        assert not kv.can_allocate(6)
+        assert kv.can_allocate(5)
