@@ -1,0 +1,133 @@
+"""Benchmark: steady-state decode throughput of the TPU engine on real hardware.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Measures aggregated serving throughput (tokens/sec/chip) of a Qwen3-0.6B-scale
+model (random weights — throughput is weight-agnostic) with a batch of
+concurrent streams through the full engine path: continuous batching, paged KV
+attention, fused on-device sampling.
+
+vs_baseline: fraction of the single-chip HBM roofline for batched decode
+(bytes moved per step ≈ model bytes + KV gather traffic at ~816 GB/s on
+v5e), since the reference publishes no absolute tok/s for this class
+(BASELINE.md — relative plots only). >1.0 would beat the roofline estimate.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dtpu_jax_cache")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig  # noqa: E402
+from dynamo_tpu.llm.protocols.common import (  # noqa: E402
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig  # noqa: E402
+from dynamo_tpu.runtime.engine import Context  # noqa: E402
+
+BATCH = int(os.environ.get("BENCH_BATCH", "8"))
+PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "256"))
+DECODE_TOKENS = int(os.environ.get("BENCH_DECODE", "128"))
+WARMUP_TOKENS = 16
+
+
+def model_config() -> LlamaConfig:
+    return LlamaConfig.qwen3_0_6b(vocab_size=151936)
+
+
+def roofline_tokens_per_s(cfg: LlamaConfig, batch: int, ctx: int) -> float:
+    """Bandwidth-bound decode estimate for one v5e chip (~816 GB/s HBM)."""
+    bw = 816e9
+    param_bytes = 2 * (
+        cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_embeddings else 2)
+        + cfg.num_layers
+        * (
+            cfg.hidden_size * (cfg.q_size + 2 * cfg.kv_size)
+            + cfg.q_size * cfg.hidden_size
+            + 3 * cfg.hidden_size * cfg.intermediate_size
+        )
+    )
+    kv_bytes_per_seq = 2 * 2 * cfg.num_layers * ctx * cfg.num_kv_heads * cfg.head_dim
+    step_bytes = param_bytes + batch * kv_bytes_per_seq
+    steps_per_s = bw / step_bytes
+    return steps_per_s * batch
+
+
+async def run_bench() -> dict:
+    mcfg = model_config()
+    ctx = ((PROMPT_LEN + DECODE_TOKENS + 32 + 127) // 128) * 128
+    cfg = TpuEngineConfig(
+        model=mcfg,
+        num_blocks=max(1024, (ctx // 16) * (BATCH + 2)),
+        block_size=16,
+        max_batch_size=BATCH,
+        max_context=ctx,
+        prefill_buckets=tuple(
+            b for b in (256, 512, 1024, 2048, 4096, 8192) if b < ctx
+        ) + (ctx,),
+    )
+    engine = TpuEngine(cfg)
+
+    async def one(i: int, n_tokens: int, t_first: list):
+        req = PreprocessedRequest(
+            request_id=f"bench-{i}-{n_tokens}",
+            model="bench",
+            token_ids=[(i * 131 + j * 7) % 500 for j in range(PROMPT_LEN)],
+            stop=StopConditions(max_tokens=n_tokens, ignore_eos=True),
+            sampling=SamplingOptions(temperature=0.0),
+        )
+        count = 0
+        async for out in engine.generate(req, Context()):
+            if count == 0 and out.token_ids:
+                t_first.append(time.monotonic())
+            count += len(out.token_ids)
+        return count
+
+    try:
+        # warmup: compile prefill + decode
+        await asyncio.gather(*[one(i, WARMUP_TOKENS, []) for i in range(BATCH)])
+        # timed run
+        t_firsts: list = []
+        t0 = time.monotonic()
+        counts = await asyncio.gather(
+            *[one(100 + i, DECODE_TOKENS, t_firsts) for i in range(BATCH)]
+        )
+        t1 = time.monotonic()
+    finally:
+        engine.stop()
+
+    total_tokens = sum(counts)
+    elapsed = t1 - t0
+    ttft = (min(t_firsts) - t0) if t_firsts else 0.0
+    tok_s = total_tokens / elapsed
+    roof = roofline_tokens_per_s(mcfg, BATCH, PROMPT_LEN + DECODE_TOKENS)
+    return {
+        "metric": "decode_throughput_qwen3_0.6b_bs%d" % BATCH,
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tok_s / roof, 4),
+        "detail": {
+            "total_tokens": total_tokens,
+            "elapsed_s": round(elapsed, 2),
+            "first_ttft_s": round(ttft, 3),
+            "roofline_tok_s": round(roof, 1),
+            "device": str(jax.devices()[0]),
+            "batch": BATCH,
+            "prompt_len": PROMPT_LEN,
+        },
+    }
+
+
+if __name__ == "__main__":
+    result = asyncio.run(run_bench())
+    print(json.dumps(result))

@@ -1,0 +1,134 @@
+"""python -m dynamo_tpu.engine — a real TPU/JAX engine worker.
+
+The TPU-native analog of `python -m dynamo.vllm` (components/src/dynamo/vllm/
+main.py): brings up a TpuEngine (paged KV, continuous batching, TP-sharded
+forward), registers the model card + endpoint, publishes KV events and load
+metrics for the router.
+
+Model selection:
+  --model-path /path/to/hf_checkpoint   local HF llama/qwen checkpoint
+  --preset tiny|qwen3-0.6b|llama3-8b|llama3-70b  random-init architecture
+"""
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.engine.weights import config_from_hf, load_params
+from dynamo_tpu.kv_router import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm import ModelDeploymentCard, ModelRuntimeConfig, register_llm
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+from dynamo_tpu.runtime.component import new_instance_id
+
+PRESETS = {
+    "tiny": lambda: LlamaConfig(),
+    "qwen3-0.6b": LlamaConfig.qwen3_0_6b,
+    "llama3-8b": LlamaConfig.llama3_8b,
+    "llama3-70b": LlamaConfig.llama3_70b,
+}
+
+
+def parse_args():
+    p = argparse.ArgumentParser("dynamo_tpu.engine")
+    p.add_argument("--model", default="tpu-model", help="served model name")
+    p.add_argument("--model-path", default=None, help="local HF checkpoint dir")
+    p.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
+    p.add_argument("--tokenizer", default=None, help="tokenizer path (default: model-path or byte)")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--store", default=None)
+    p.add_argument("--store-path", default=None)
+    p.add_argument("--event-plane", default=None)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--num-blocks", type=int, default=2048)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--max-context", type=int, default=2048)
+    p.add_argument("--migration-limit", type=int, default=0)
+    return p.parse_args()
+
+
+async def main() -> None:
+    args = parse_args()
+    init_logging()
+    cfg = RuntimeConfig.from_env(
+        store=args.store, store_path=args.store_path, event_plane=args.event_plane
+    )
+    runtime = await DistributedRuntime(cfg).start()
+
+    params = None
+    if args.model_path:
+        mcfg = config_from_hf(args.model_path)
+        params = load_params(args.model_path, mcfg)
+        tokenizer_ref = args.tokenizer or args.model_path
+    else:
+        mcfg = PRESETS[args.preset]()
+        tokenizer_ref = args.tokenizer or "byte"
+
+    instance_id = new_instance_id()
+    kv_pub = KvEventPublisher(
+        runtime.event_plane, args.namespace, args.component,
+        worker_id=instance_id, block_size=args.block_size,
+    )
+    m_pub = WorkerMetricsPublisher(
+        runtime.event_plane, args.namespace, args.component, worker_id=instance_id
+    )
+    bs = args.block_size
+
+    def rnd(n):  # round up to a block multiple
+        return ((n + bs - 1) // bs) * bs
+
+    ctx = rnd(args.max_context)
+    buckets = tuple(
+        rnd(b) for b in (64, 128, 256, 512, 1024, 2048, 4096, 8192) if rnd(b) < ctx
+    ) + (ctx,)
+    args.max_context = ctx
+    engine = TpuEngine(
+        TpuEngineConfig(
+            model=mcfg,
+            num_blocks=args.num_blocks,
+            block_size=args.block_size,
+            max_batch_size=args.max_batch_size,
+            max_context=args.max_context,
+            tp=args.tp,
+            prefill_buckets=buckets,
+        ),
+        params=params,
+        kv_publisher=kv_pub,
+        metrics_publisher=m_pub,
+    )
+    card = ModelDeploymentCard(
+        name=args.model,
+        namespace=args.namespace,
+        component=args.component,
+        endpoint=args.endpoint,
+        tokenizer=tokenizer_ref,
+        context_length=args.max_context,
+        kv_block_size=args.block_size,
+        migration_limit=args.migration_limit,
+        runtime_config=ModelRuntimeConfig(
+            total_kv_blocks=args.num_blocks,
+            kv_block_size=args.block_size,
+            max_batch_size=args.max_batch_size,
+            tensor_parallel_size=args.tp,
+            max_context_len=args.max_context,
+        ),
+    )
+    served = await register_llm(runtime, engine, card, instance_id=instance_id)
+    print(f"TPU_ENGINE_READY {args.model} tp={args.tp}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    engine.stop()
+    await served.stop()
+    await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

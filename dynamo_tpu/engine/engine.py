@@ -1,0 +1,568 @@
+"""TpuEngine: continuous-batching paged-KV serving engine on JAX/XLA.
+
+The part the reference delegates to vLLM/SGLang/TRT-LLM — here it is
+framework-native and TPU-first:
+
+- prefill and decode are two separately-compiled XLA programs with static
+  shapes (prompt lengths bucketed, decode batch fixed-width with idle slots),
+  so the steady state never recompiles;
+- the paged KV cache lives in HBM as [num_blocks, block_size, kv_heads,
+  head_dim] per layer, sharded over the TP mesh axis on kv_heads;
+- sampling is fused into both programs (only token ids [B] return to host);
+- device-side prefix-cache reuse: the host BlockAllocator content-addresses
+  sealed blocks by chained sequence hash, prefill feeds only the un-cached
+  suffix and attends over cached pages via the block table;
+- device calls run in an executor thread so the asyncio control plane (request
+  plane heartbeats, event publishing) never stalls behind the TPU.
+
+Model-parallel execution: params carry NamedShardings from
+parallel/mesh.py; XLA GSPMD inserts the ICI collectives (psum after
+row-parallel matmuls). One engine process per TP slice, like one reference
+worker per NCCL TP group.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from concurrent.futures import ThreadPoolExecutor
+from functools import partial
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from ..llm.protocols.common import (
+    FINISH_LENGTH,
+    FINISH_STOP,
+    BackendOutput,
+    PreprocessedRequest,
+)
+from ..models import llama
+from ..ops import attention as att
+from ..parallel import mesh as meshlib
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from ..tokens import TokenBlockSequence
+from .allocator import BlockAllocator, OutOfBlocks
+from .sampling import logprobs_of, sample_tokens
+
+log = get_logger("engine")
+
+
+@dataclasses.dataclass
+class TpuEngineConfig:
+    model: llama.LlamaConfig
+    num_blocks: int = 512
+    block_size: int = 16
+    max_batch_size: int = 8
+    max_context: int = 2048
+    tp: int = 1
+    prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
+    seed: int = 0
+    # use the Pallas decode kernel when running on real TPU (ops/pallas)
+    use_pallas: bool = False
+
+    def __post_init__(self):
+        bad = [b for b in self.prefill_buckets if b % self.block_size]
+        if bad:
+            raise ValueError(
+                f"prefill_buckets {bad} not multiples of block_size {self.block_size}"
+            )
+        if self.prefill_buckets[-1] < self.max_context:
+            raise ValueError(
+                f"largest prefill bucket {self.prefill_buckets[-1]} < max_context "
+                f"{self.max_context}: long prompts would have no bucket"
+            )
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return (self.max_context + self.block_size - 1) // self.block_size
+
+
+@dataclasses.dataclass
+class _Seq:
+    req: PreprocessedRequest
+    context: Context
+    out_queue: asyncio.Queue
+    seq: TokenBlockSequence               # prompt + generated
+    slot: int = -1
+    block_ids: List[int] = dataclasses.field(default_factory=list)
+    produced: int = 0
+    last_token: int = 0
+    cached_tokens: int = 0
+    sealed_upto: int = 0                  # how many blocks committed to cache
+    done: bool = False
+
+
+class TpuEngine:
+    """AsyncEngine serving PreprocessedRequests with a real JAX model."""
+
+    def __init__(
+        self,
+        config: TpuEngineConfig,
+        params: Optional[llama.Params] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        kv_publisher: Optional[KvEventPublisher] = None,
+        metrics_publisher: Optional[WorkerMetricsPublisher] = None,
+    ):
+        self.cfg = config
+        self.mcfg = config.model
+        self.mesh = mesh if mesh is not None else meshlib.make_mesh(tp=config.tp)
+        self.kv_publisher = kv_publisher
+        self.metrics_publisher = metrics_publisher
+        self.allocator = BlockAllocator(config.num_blocks, config.block_size)
+        self._host_rng = np.random.default_rng(config.seed)
+
+        # --- place params + caches on the mesh ---
+        with self.mesh:
+            if params is None:
+                params = llama.init_params(jax.random.PRNGKey(config.seed), self.mcfg)
+            self.params = self._shard_params(params)
+            self.k_caches, self.v_caches = self._init_caches()
+
+        # --- slot state (decode batch is fixed-width) ---
+        B = config.max_batch_size
+        self._slots: List[Optional[_Seq]] = [None] * B
+        self._tokens = np.zeros(B, np.int32)
+        self._seq_lens = np.zeros(B, np.int32)
+        self._block_tables = np.zeros((B, config.max_blocks_per_seq), np.int32)
+        self._temps = np.zeros(B, np.float32)
+        self._top_ks = np.zeros(B, np.int32)
+        self._top_ps = np.ones(B, np.float32)
+        self._seeds = np.zeros(B, np.uint32)
+
+        self._waiting: List[_Seq] = []
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-step")
+        self._build_programs()
+
+    # ------------------------------------------------------------------ setup
+    def _shard_params(self, params: llama.Params) -> llama.Params:
+        specs = meshlib.param_specs_llama()
+
+        def put(x, spec):
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+        out: llama.Params = {
+            "embed": put(params["embed"], specs["embed"]),
+            "final_norm": put(params["final_norm"], specs["norm"]),
+            "layers": [],
+        }
+        if "lm_head" in params:
+            out["lm_head"] = put(params["lm_head"], specs["lm_head"])
+        for lp in params["layers"]:
+            slp = {}
+            for name, w in lp.items():
+                if name in ("wq", "wk", "wv"):
+                    slp[name] = put(w, specs["wq"])
+                elif name == "wo":
+                    slp[name] = put(w, specs["wo"])
+                elif name in ("w_gate", "w_up"):
+                    slp[name] = put(w, specs["w_gate"])
+                elif name == "w_down":
+                    slp[name] = put(w, specs["w_down"])
+                elif name in ("bq", "bk", "bv"):
+                    slp[name] = put(w, P(meshlib.AXIS_TP))
+                else:  # norms
+                    slp[name] = put(w, specs["norm"])
+            out["layers"].append(slp)
+        return out
+
+    def _init_caches(self) -> Tuple[List[jax.Array], List[jax.Array]]:
+        shape = (
+            self.cfg.num_blocks,
+            self.cfg.block_size,
+            self.mcfg.num_kv_heads,
+            self.mcfg.head_dim,
+        )
+        sharding = NamedSharding(self.mesh, meshlib.kv_cache_spec())
+        zeros = partial(jnp.zeros, shape, self.mcfg.dtype)
+        k = [jax.device_put(zeros(), sharding) for _ in range(self.mcfg.num_layers)]
+        v = [jax.device_put(zeros(), sharding) for _ in range(self.mcfg.num_layers)]
+        return k, v
+
+    def _build_programs(self) -> None:
+        cfg, mcfg = self.cfg, self.mcfg
+
+        def prefill(params, k_caches, v_caches, tokens, positions, block_table,
+                    new_block_ids, total_len, seeds, steps, temp, top_k, top_p):
+            # tokens/positions: [S_pad]; block_table: [max_blocks_per_seq]
+            def attend(q, k_new, v_new, layer_idx):
+                kc, vc = att.write_prefill_kv(
+                    k_caches[layer_idx], v_caches[layer_idx], k_new, v_new, new_block_ids
+                )
+                k_caches[layer_idx], v_caches[layer_idx] = kc, vc
+                k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
+                return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
+
+            hidden = llama.forward(params, mcfg, tokens, positions, attend)
+            # logits at the last real token (positions are absolute; the last
+            # real new token sits where position == total_len - 1)
+            last_idx = jnp.argmax(positions == total_len - 1)
+            logits = llama.lm_logits(params, mcfg, hidden[last_idx][None])  # [1, V]
+            tok = sample_tokens(logits, seeds, steps, temp, top_k, top_p)
+            lp = logprobs_of(logits, tok)
+            return k_caches, v_caches, tok[0], lp[0]
+
+        def decode(params, k_caches, v_caches, tokens, positions, block_tables,
+                   seq_lens, write_blocks, write_offsets, seeds, steps, temps,
+                   top_ks, top_ps):
+            # tokens: [B]; block_tables: [B, max_blocks_per_seq]
+            def attend(q, k_new, v_new, layer_idx):
+                kc, vc = att.write_decode_kv(
+                    k_caches[layer_idx], v_caches[layer_idx],
+                    k_new[:, 0], v_new[:, 0], write_blocks, write_offsets,
+                )
+                k_caches[layer_idx], v_caches[layer_idx] = kc, vc
+                out = att.paged_decode_attention(
+                    q[:, 0], kc, vc, block_tables, seq_lens
+                )
+                return out[:, None]
+
+            hidden = llama.forward(
+                params, mcfg, tokens[:, None], positions[:, None], attend
+            )  # [B, 1, H]
+            logits = llama.lm_logits(params, mcfg, hidden[:, 0])  # [B, V]
+            toks = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
+            lps = logprobs_of(logits, toks)
+            return k_caches, v_caches, toks, lps
+
+        self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2))
+        self._decode_fn = jax.jit(decode, donate_argnums=(1, 2))
+
+    # ---------------------------------------------------------------- serving
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[BackendOutput]:
+        req = request if isinstance(request, PreprocessedRequest) else (
+            PreprocessedRequest.from_obj(request)
+        )
+        if len(req.token_ids) + len(req.prior_token_ids) >= self.cfg.max_context:
+            raise ValueError(
+                f"prompt {len(req.token_ids)} tokens exceeds engine max_context "
+                f"{self.cfg.max_context}"
+            )
+        self._ensure_loop()
+        all_tokens = list(req.token_ids) + list(req.prior_token_ids)
+        st = _Seq(
+            req=req,
+            context=context,
+            out_queue=asyncio.Queue(),
+            seq=TokenBlockSequence(all_tokens, self.cfg.block_size),
+            last_token=all_tokens[-1] if all_tokens else 0,
+        )
+        self._waiting.append(st)
+        self._wake.set()
+        while True:
+            item = await st.out_queue.get()
+            if item is None:
+                return
+            yield item
+            if item.finish_reason is not None:
+                return
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+        self._executor.shutdown(wait=False)
+
+    # ------------------------------------------------------------- step loop
+    async def _loop(self) -> None:
+        loop = asyncio.get_event_loop()
+        try:
+            while True:
+                if not self._waiting and all(s is None for s in self._slots):
+                    self._wake.clear()
+                    await self._wake.wait()
+                self._admit_cancelled()
+                admitted = self._try_admit()
+                for st in admitted:
+                    results = await loop.run_in_executor(
+                        self._executor, self._run_prefill, st
+                    )
+                    for rst, tok, lp in results:
+                        self._accept_token(rst, tok, lp)
+                if any(s is not None and not s.done for s in self._slots):
+                    results = await loop.run_in_executor(self._executor, self._run_decode)
+                    for rst, tok, lp in results:
+                        self._accept_token(rst, tok, lp)
+                self._reap_finished()
+                await self._publish_events()
+                await asyncio.sleep(0)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            log.exception("engine loop crashed")
+            for st in list(self._waiting) + [s for s in self._slots if s]:
+                st.done = True
+                st.out_queue.put_nowait(
+                    BackendOutput(finish_reason="error", cumulative_tokens=st.produced)
+                )
+                if st.block_ids:
+                    self.allocator.release(st.block_ids)
+            self._waiting = []
+            self._slots = [None] * self.cfg.max_batch_size
+            self._seq_lens[:] = 0
+
+    def _admit_cancelled(self) -> None:
+        keep = []
+        for st in self._waiting:
+            if st.context.is_stopped():
+                st.out_queue.put_nowait(
+                    BackendOutput(finish_reason="cancelled", cumulative_tokens=0)
+                )
+            else:
+                keep.append(st)
+        self._waiting = keep
+
+    def _free_slot(self) -> int:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return -1
+
+    def _try_admit(self) -> List[_Seq]:
+        admitted: List[_Seq] = []
+        still: List[_Seq] = []
+        for st in self._waiting:
+            slot = self._free_slot()
+            if slot < 0:
+                still.append(st)
+                continue
+            prompt_len = len(st.seq)
+            hashes = st.seq.sequence_hashes()
+            # reuse at most the blocks strictly before the last prompt token so
+            # prefill always has >=1 token to produce logits from
+            reusable = min(len(hashes), (prompt_len - 1) // self.cfg.block_size)
+            prefix_ids = self.allocator.acquire_prefix(hashes[:reusable])
+            prefix_blocks = len(prefix_ids)
+            blocks_needed = (
+                (prompt_len + self.cfg.block_size - 1) // self.cfg.block_size
+                - prefix_blocks
+            )
+            if not self.allocator.can_allocate(blocks_needed):
+                self.allocator.release(prefix_ids)
+                still.append(st)
+                continue
+            try:
+                new_ids = self.allocator.allocate(blocks_needed)
+            except OutOfBlocks:
+                self.allocator.release(prefix_ids)
+                still.append(st)
+                continue
+            st.block_ids = prefix_ids + new_ids
+            st.cached_tokens = prefix_blocks * self.cfg.block_size
+            # complete prompt blocks become content-addressed now (prefill
+            # writes them this step); future requests can reuse them
+            for i in range(prefix_blocks, len(hashes)):
+                self.allocator.commit(st.block_ids[i], hashes[i])
+            st.sealed_upto = len(hashes)
+            st.slot = slot
+            self._slots[slot] = st
+            self._block_tables[slot].fill(0)
+            self._block_tables[slot, : len(st.block_ids)] = st.block_ids
+            self._seq_lens[slot] = prompt_len
+            self._temps[slot] = st.req.sampling.temperature
+            self._top_ks[slot] = st.req.sampling.top_k
+            self._top_ps[slot] = st.req.sampling.top_p
+            seed = st.req.sampling.seed
+            self._seeds[slot] = np.uint32(
+                seed if seed is not None else self._host_rng.integers(1 << 32)
+            )
+            admitted.append(st)
+            log.debug(
+                "admit %s: %d tokens (%d cached), slot %d",
+                st.req.request_id[:8], prompt_len, st.cached_tokens, slot,
+            )
+        self._waiting = still
+        return admitted
+
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prefill of {n} tokens exceeds largest bucket "
+            f"{self.cfg.prefill_buckets[-1]}"
+        )
+
+    # -- device calls (run in executor thread) -------------------------------
+    def _run_prefill(self, st: _Seq) -> List[Tuple[_Seq, int, float]]:
+        bs = self.cfg.block_size
+        prompt = st.seq.tokens()
+        prefix = st.cached_tokens
+        suffix = prompt[prefix:]
+        S = len(suffix)
+        S_pad = self._bucket(S)
+        n_new_blocks = S_pad // bs
+
+        tokens = np.zeros(S_pad, np.int32)
+        tokens[:S] = suffix
+        positions = np.full(S_pad, self.cfg.max_context - 1, np.int32)
+        positions[:S] = np.arange(prefix, prefix + S)
+        # destinations: real blocks for the suffix span, scratch elsewhere
+        new_block_ids = np.zeros(n_new_blocks, np.int32)
+        real_new = st.block_ids[prefix // bs :]
+        new_block_ids[: len(real_new)] = real_new
+
+        temp = np.array([st.req.sampling.temperature], np.float32)
+        top_k = np.array([st.req.sampling.top_k], np.int32)
+        top_p = np.array([st.req.sampling.top_p], np.float32)
+        seeds = np.array([self._seeds[st.slot]], np.uint32)
+        steps = np.array([0], np.int32)
+
+        self.k_caches, self.v_caches, tok, lp = self._prefill_fn(
+            self.params, self.k_caches, self.v_caches,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self._block_tables[st.slot]),
+            jnp.asarray(new_block_ids), jnp.int32(len(prompt)),
+            jnp.asarray(seeds), jnp.asarray(steps),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+        )
+        return [(st, int(tok), float(lp))]
+
+    def _run_decode(self) -> List[Tuple[_Seq, int, float]]:
+        bs = self.cfg.block_size
+        B = self.cfg.max_batch_size
+        write_blocks = np.zeros(B, np.int32)
+        write_offsets = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        seq_lens = np.zeros(B, np.int32)
+        for i, st in enumerate(self._slots):
+            if st is None or st.done:
+                continue
+            L = len(st.seq)                    # includes the token being fed
+            positions[i] = L - 1
+            seq_lens[i] = L
+            self._tokens[i] = st.last_token
+            blk = (L - 1) // bs
+            write_blocks[i] = st.block_ids[blk]
+            write_offsets[i] = (L - 1) % bs
+
+        steps = np.zeros(B, np.int32)
+        for i, st in enumerate(self._slots):
+            if st is not None and not st.done:
+                steps[i] = st.produced
+
+        self.k_caches, self.v_caches, toks, lps = self._decode_fn(
+            self.params, self.k_caches, self.v_caches,
+            jnp.asarray(self._tokens), jnp.asarray(positions),
+            jnp.asarray(self._block_tables), jnp.asarray(seq_lens),
+            jnp.asarray(write_blocks), jnp.asarray(write_offsets),
+            jnp.asarray(self._seeds), jnp.asarray(steps),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+        )
+        toks_np = np.asarray(toks)
+        lps_np = np.asarray(lps)
+        results = []
+        for i, st in enumerate(self._slots):
+            if st is None or st.done:
+                continue
+            results.append((st, int(toks_np[i]), float(lps_np[i])))
+        return results
+
+    # -- host-side token bookkeeping -----------------------------------------
+    def _accept_token(self, st: _Seq, tok: int, logprob: float) -> None:
+        """Runs in the executor thread: pure host state mutation."""
+        st.produced += 1
+        finish: Optional[str] = None
+        # engine-level stop ids only; the worker Backend layer enforces the
+        # tokenizer-specific EOS (llm/backend.py)
+        stop_ids = set(st.req.stop.stop_token_ids)
+        if tok in stop_ids and st.produced > st.req.stop.min_tokens:
+            finish = FINISH_STOP
+        limit = st.req.stop.max_tokens
+        if finish is None and limit is not None and st.produced >= limit:
+            finish = FINISH_LENGTH
+        if finish is None and st.context.is_stopped():
+            finish = "cancelled"
+
+        emit_ids = [] if finish == FINISH_STOP and tok in stop_ids else [tok]
+        ann: Dict[str, Any] = {}
+        if st.produced == 1:
+            ann = {
+                "cached_tokens": st.cached_tokens,
+                "input_tokens": len(st.req.token_ids),
+            }
+
+        if finish is None:
+            L_before = len(st.seq)
+            if L_before + 1 >= self.cfg.max_context:
+                finish = FINISH_LENGTH
+            else:
+                sealed = st.seq.append(tok)
+                st.last_token = tok
+                if sealed is not None:
+                    self.allocator.commit(
+                        st.block_ids[sealed.position], sealed.sequence_hash
+                    )
+                    st.sealed_upto = sealed.position + 1
+                # ensure a block exists for the *next* token's write position
+                L_after = L_before + 1
+                needed_blocks = L_after // self.cfg.block_size + 1
+                if needed_blocks > len(st.block_ids):
+                    try:
+                        (new_id,) = self.allocator.allocate(1)
+                        st.block_ids.append(new_id)
+                        self._block_tables[st.slot, len(st.block_ids) - 1] = new_id
+                    except OutOfBlocks:
+                        finish = FINISH_LENGTH  # out of memory: end gracefully
+
+        out = BackendOutput(
+            token_ids=emit_ids,
+            finish_reason=finish,
+            cumulative_tokens=st.produced,
+            logprobs=[logprob] if emit_ids else None,
+            annotations=ann,
+        )
+        st.out_queue.put_nowait(out)
+        if finish is not None:
+            st.done = True
+
+    def _reap_finished(self) -> None:
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            if st.done or st.context.is_killed():
+                self.allocator.release(st.block_ids)
+                self._slots[i] = None
+                self._seq_lens[i] = 0
+                if not st.done:
+                    st.out_queue.put_nowait(
+                        BackendOutput(finish_reason="cancelled", cumulative_tokens=st.produced)
+                    )
+
+    async def _publish_events(self) -> None:
+        stored, removed = self.allocator.drain_events()
+        if self.kv_publisher is not None:
+            for batch in stored:
+                await self.kv_publisher.stored(batch)
+            for batch in removed:
+                await self.kv_publisher.removed(batch)
+        if self.metrics_publisher is not None and (stored or removed):
+            await self.metrics_publisher.publish(
+                active_decode_blocks=self.allocator.active_blocks,
+                num_requests_waiting=len(self._waiting),
+                total_blocks=self.cfg.num_blocks,
+            )
+
+    # -- introspection -------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "running": sum(1 for s in self._slots if s is not None),
+            "waiting": len(self._waiting),
+            "active_blocks": self.allocator.active_blocks,
+            "cached_blocks": self.allocator.cached_blocks,
+            "free_blocks": self.allocator.free_blocks,
+        }

@@ -1,0 +1,69 @@
+"""On-device batched sampling: greedy / temperature / top-k / top-p.
+
+Logits never leave the device (vocab-sized transfers per step would saturate
+PCIe/host); only the sampled token ids [B] come back. All branches are
+tensor-masked (no data-dependent control flow) so one compiled program serves
+every per-request sampling configuration.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jax.Array,        # [B, V] float32
+    seeds: jax.Array,         # [B] uint32 per-request seed
+    steps: jax.Array,         # [B] int32 decode position (key = fold_in(seed, step))
+    temperature: jax.Array,   # [B] 0 => greedy
+    top_k: jax.Array,         # [B] int32, <=0 => disabled
+    top_p: jax.Array,         # [B] float32, >=1 => disabled
+) -> jax.Array:
+    """Returns sampled token ids [B] int32.
+
+    Keys are derived statelessly from (seed, step): a seeded request
+    reproduces its exact sample stream regardless of what else is in the
+    batch or how long the engine has been running."""
+    B, V = logits.shape
+
+    # top-k mask: keep the k highest logits per row
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]          # [B, V]
+    k_idx = jnp.clip(jnp.where(top_k <= 0, V, top_k) - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
+    masked = jnp.where(logits >= kth, logits, NEG_INF)
+
+    # top-p (nucleus) mask over the surviving set
+    temp_safe = jnp.maximum(temperature, 1e-6)[:, None]
+    probs_sorted = jax.nn.softmax(
+        jnp.sort(masked / temp_safe, axis=-1)[:, ::-1], axis=-1
+    )
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    # number of tokens needed to reach top_p (at least 1)
+    p = jnp.where(top_p >= 1.0, 1.0, top_p)[:, None]
+    include = cumprobs - probs_sorted < p                      # [B, V] sorted order
+    count = jnp.maximum(include.sum(axis=-1), 1)               # [B]
+    sorted_masked = jnp.sort(masked, axis=-1)[:, ::-1]
+    cutoff = jnp.take_along_axis(sorted_masked, (count - 1)[:, None], axis=-1)
+    masked = jnp.where(masked >= cutoff, masked, NEG_INF)
+
+    # gumbel-max sample at temperature; greedy where temperature == 0
+    def row_gumbel(seed, step):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return jax.random.gumbel(key, (V,), dtype=jnp.float32)
+
+    gumbel = jax.vmap(row_gumbel)(seeds, steps)
+    sampled = jnp.argmax(masked / temp_safe + gumbel, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def logprobs_of(
+    logits: jax.Array,        # [B, V] float32
+    token_ids: jax.Array,     # [B] the chosen tokens
+) -> jax.Array:
+    """Log-probability of each chosen token [B]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, token_ids[:, None].astype(jnp.int32), axis=-1)[:, 0]

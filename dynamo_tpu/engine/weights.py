@@ -1,0 +1,109 @@
+"""HF checkpoint loading: safetensors -> llama param pytree.
+
+Loads local HuggingFace-format checkpoints (config.json + *.safetensors) into
+the functional param layout of models/llama.py. Works fully offline; when no
+checkpoint is given the engine random-initializes (benchmark throughput does
+not depend on trained weights).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig
+from ..runtime.logging import get_logger
+
+log = get_logger("engine.weights")
+
+
+def config_from_hf(path: str) -> LlamaConfig:
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        intermediate_size=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        max_position=hf.get("max_position_embeddings", 8192),
+        qkv_bias=hf.get("attention_bias", False)
+        or hf.get("model_type", "") == "qwen2",
+        qk_norm=hf.get("model_type", "") == "qwen3",
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+
+def _open_safetensors(path: str):
+    """Yields (name, np.ndarray) from all safetensors shards in ``path``."""
+    from safetensors import safe_open  # available via transformers dep
+
+    files = sorted(
+        f for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def load_params(path: str, cfg: Optional[LlamaConfig] = None) -> Dict[str, Any]:
+    """Map HF llama/qwen tensor names onto our pytree."""
+    cfg = cfg or config_from_hf(path)
+    layers: list = [dict() for _ in range(cfg.num_layers)]
+    params: Dict[str, Any] = {"layers": layers}
+    dt = cfg.dtype
+
+    def put(arr: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(arr, dt)
+
+    for name, w in _open_safetensors(path):
+        if name == "model.embed_tokens.weight":
+            params["embed"] = put(w)
+        elif name == "model.norm.weight":
+            params["final_norm"] = put(w)
+        elif name == "lm_head.weight":
+            params["lm_head"] = put(w.T)
+        elif name.startswith("model.layers."):
+            parts = name.split(".")
+            li = int(parts[2])
+            rest = ".".join(parts[3:])
+            lp = layers[li]
+            # HF stores Linear as [out, in]; we use [in, out] -> transpose
+            mapping = {
+                "input_layernorm.weight": ("attn_norm", False),
+                "post_attention_layernorm.weight": ("mlp_norm", False),
+                "self_attn.q_proj.weight": ("wq", True),
+                "self_attn.k_proj.weight": ("wk", True),
+                "self_attn.v_proj.weight": ("wv", True),
+                "self_attn.o_proj.weight": ("wo", True),
+                "self_attn.q_proj.bias": ("bq", False),
+                "self_attn.k_proj.bias": ("bk", False),
+                "self_attn.v_proj.bias": ("bv", False),
+                "self_attn.q_norm.weight": ("q_norm", False),
+                "self_attn.k_norm.weight": ("k_norm", False),
+                "mlp.gate_proj.weight": ("w_gate", True),
+                "mlp.up_proj.weight": ("w_up", True),
+                "mlp.down_proj.weight": ("w_down", True),
+            }
+            if rest in mapping:
+                ours, transpose = mapping[rest]
+                lp[ours] = put(w.T if transpose else w)
+            else:
+                log.debug("ignoring unmapped tensor %s", name)
+    if cfg.tie_embeddings and "lm_head" not in params:
+        pass  # lm_logits uses embed.T
+    missing = [i for i, lp in enumerate(layers) if "wq" not in lp]
+    if missing:
+        raise ValueError(f"checkpoint at {path} missing layers {missing[:4]}...")
+    log.info("loaded %d layers from %s", cfg.num_layers, path)
+    return params

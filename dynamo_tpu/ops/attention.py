@@ -1,0 +1,161 @@
+"""Attention ops for paged-KV serving: prefill, prefix-extend, paged decode.
+
+Pure-JAX reference implementations (XLA fuses these well on TPU already);
+the Pallas ragged-paged-attention kernel in ops/pallas_attention.py is a
+drop-in replacement on the same interfaces for the decode hot path.
+
+Replaces what the reference delegates to engine-internal kernels (vLLM
+paged attention / FlashInfer); the CUDA block-copy kernel analog lives in
+ops/block_copy.py.
+
+Layout: paged KV cache per layer is ``[num_blocks, block_size, kv_heads,
+head_dim]`` — block-major so a block is contiguous in HBM (transfer-friendly,
+like the reference KVBM's fully-contiguous layout, lib/llm/src/block_manager/
+layout.rs) with heads minor to keep per-head slices dense for TP sharding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _gqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
+    """q [S,h,d] x k [T,kvh,d] -> scores [S,h,T] with GQA head grouping."""
+    S, h, d = q.shape
+    T, kvh, _ = k.shape
+    g = h // kvh
+    qg = q.reshape(S, kvh, g, d)
+    scores = jnp.einsum("skgd,tkd->skgt", qg.astype(jnp.float32), k.astype(jnp.float32))
+    return scores.reshape(S, h, T)
+
+
+def _gqa_values(weights: jax.Array, v: jax.Array) -> jax.Array:
+    """weights [S,h,T] x v [T,kvh,d] -> out [S,h,d]."""
+    S, h, T = weights.shape
+    _, kvh, d = v.shape
+    g = h // kvh
+    wg = weights.reshape(S, kvh, g, T)
+    out = jnp.einsum("skgt,tkd->skgd", wg, v.astype(jnp.float32))
+    return out.reshape(S, h, d)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Plain causal self-attention for a single contiguous sequence.
+
+    q,k,v: [S, heads/kv_heads, head_dim] -> [S, heads, head_dim]."""
+    S = q.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    scores = _gqa_scores(q, k) * scale
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(causal[:, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return _gqa_values(weights, v).astype(q.dtype)
+
+
+def extend_attention(
+    q: jax.Array,            # [S_new, h, d] queries for the new suffix
+    k_ctx: jax.Array,        # [T_max, kvh, d] gathered context incl. new keys
+    v_ctx: jax.Array,        # [T_max, kvh, d]
+    q_positions: jax.Array,  # [S_new] absolute positions of the queries
+    total_len: jax.Array,    # scalar: valid length of the context
+) -> jax.Array:
+    """Prefix-extend attention: new tokens attend causally over (cached prefix
+    + themselves). Used for prefill with device-side prefix-cache reuse and
+    for chunked prefill continuation. Context is padded to T_max; invalid
+    positions masked."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    T = k_ctx.shape[0]
+    scores = _gqa_scores(q, k_ctx) * scale  # [S,h,T]
+    key_pos = jnp.arange(T)
+    valid = key_pos[None, :] < jnp.minimum(q_positions[:, None] + 1, total_len)
+    scores = jnp.where(valid[:, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return _gqa_values(weights, v_ctx).astype(q.dtype)
+
+
+def gather_kv(
+    k_cache: jax.Array,      # [num_blocks, block_size, kvh, d]
+    v_cache: jax.Array,
+    block_table: jax.Array,  # [max_blocks] int32 (padded with 0)
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather one sequence's KV pages into contiguous [max_blocks*bs, kvh, d]."""
+    bs = k_cache.shape[1]
+    k = k_cache[block_table]  # [max_blocks, bs, kvh, d]
+    v = v_cache[block_table]
+    mb = block_table.shape[0]
+    return (
+        k.reshape(mb * bs, *k.shape[2:]),
+        v.reshape(mb * bs, *v.shape[2:]),
+    )
+
+
+def paged_decode_attention(
+    q: jax.Array,             # [B, h, d] one query token per sequence
+    k_cache: jax.Array,       # [num_blocks, bs, kvh, d]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    seq_lens: jax.Array,      # [B] int32 context length incl. current token
+) -> jax.Array:
+    """Paged decode attention, batched: each query attends over its own pages.
+
+    Pure-JAX formulation: per-sequence page gather via vmap; masked softmax.
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+
+    def one(qb, table, length):
+        k, v = gather_kv(k_cache, v_cache, table)      # [T, kvh, d]
+        h, d = qb.shape
+        kvh = k.shape[1]
+        g = h // kvh
+        qg = qb.reshape(kvh, g, d)
+        scores = jnp.einsum(
+            "kgd,tkd->kgt", qg.astype(jnp.float32), k.astype(jnp.float32)
+        ) * scale                                       # [kvh, g, T]
+        T = k.shape[0]
+        valid = jnp.arange(T) < length
+        scores = jnp.where(valid[None, None, :], scores, NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("kgt,tkd->kgd", weights, v.astype(jnp.float32))
+        return out.reshape(h, d)
+
+    return jax.vmap(one)(q, block_tables, seq_lens).astype(q.dtype)
+
+
+def write_prefill_kv(
+    k_cache: jax.Array,       # [num_blocks, bs, kvh, d]
+    v_cache: jax.Array,
+    k_new: jax.Array,         # [S_pad, kvh, d] (S_pad multiple of bs)
+    v_new: jax.Array,
+    block_ids: jax.Array,     # [S_pad // bs] destination blocks for the span
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter a contiguous span of new KV into its pages (prefill path).
+
+    The caller pads S to a block multiple and supplies one destination block
+    per chunk; padding rows land in a scratch block (block 0 by convention is
+    reserved as scratch so garbage writes are harmless)."""
+    bs = k_cache.shape[1]
+    S = k_new.shape[0]
+    k_blocks = k_new.reshape(S // bs, bs, *k_new.shape[1:])
+    v_blocks = v_new.reshape(S // bs, bs, *v_new.shape[1:])
+    return k_cache.at[block_ids].set(k_blocks), v_cache.at[block_ids].set(v_blocks)
+
+
+def write_decode_kv(
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    k_new: jax.Array,         # [B, kvh, d]
+    v_new: jax.Array,
+    block_ids: jax.Array,     # [B] destination block of each seq's current pos
+    offsets: jax.Array,       # [B] offset within the block
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter one token per sequence into its page slot (decode path)."""
+    return (
+        k_cache.at[block_ids, offsets].set(k_new),
+        v_cache.at[block_ids, offsets].set(v_new),
+    )

@@ -7,10 +7,19 @@ GPU-free test strategy, reference tests/README.md). Set env BEFORE jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # env exports axon (real TPU); tests force CPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# persistent compile cache: engine tests compile several XLA programs
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dtpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+import jax  # noqa: E402
+
+# the axon TPU plugin pins itself regardless of the env var; the config update
+# is what actually forces the CPU backend with the 8 virtual devices
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
