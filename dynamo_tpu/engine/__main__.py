@@ -48,6 +48,13 @@ def parse_args():
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-context", type=int, default=2048)
     p.add_argument("--migration-limit", type=int, default=0)
+    p.add_argument(
+        "--disagg",
+        choices=["none", "prefill", "decode"],
+        default="none",
+        help="prefill: join the prefill pool + serve kv_fetch; decode: serve "
+        "decode with remote-KV import (also serves kv_fetch for peers)",
+    )
     return p.parse_args()
 
 
@@ -68,13 +75,21 @@ async def main() -> None:
         mcfg = PRESETS[args.preset]()
         tokenizer_ref = args.tokenizer or "byte"
 
+    component = args.component
+    model_type = ["chat", "completions"]
+    if args.disagg == "prefill":
+        component = (
+            args.component + "_prefill" if args.component == "backend" else args.component
+        )
+        model_type = ["prefill"]
+
     instance_id = new_instance_id()
     kv_pub = KvEventPublisher(
-        runtime.event_plane, args.namespace, args.component,
+        runtime.event_plane, args.namespace, component,
         worker_id=instance_id, block_size=args.block_size,
     )
     m_pub = WorkerMetricsPublisher(
-        runtime.event_plane, args.namespace, args.component, worker_id=instance_id
+        runtime.event_plane, args.namespace, component, worker_id=instance_id
     )
     bs = args.block_size
 
@@ -100,11 +115,16 @@ async def main() -> None:
         kv_publisher=kv_pub,
         metrics_publisher=m_pub,
     )
+    if args.disagg in ("prefill", "decode"):
+        addr = await engine.serve_transfer(host=cfg.host_ip)
+        print(f"KV_TRANSFER at {addr}", flush=True)
+
     card = ModelDeploymentCard(
         name=args.model,
         namespace=args.namespace,
-        component=args.component,
+        component=component,
         endpoint=args.endpoint,
+        model_type=model_type,
         tokenizer=tokenizer_ref,
         context_length=args.max_context,
         kv_block_size=args.block_size,

@@ -140,7 +140,29 @@ class TpuEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-step")
+        # disaggregation: KV transfer in/out (engine/transfer.py)
+        self.transfer_address: Optional[str] = None
+        self._transfer_server = None
+        self._transfer_client = None
         self._build_programs()
+
+    # ------------------------------------------------------ kv transfer wiring
+    async def serve_transfer(self, host: str = "127.0.0.1") -> str:
+        """Start the kv_fetch endpoint (prefill side of disaggregation)."""
+        from ..runtime.request_plane.tcp import TcpRequestServer
+        from .transfer import KvTransferServer
+
+        srv = KvTransferServer(self)
+        self._transfer_server = TcpRequestServer(srv.handle, host=host)
+        self.transfer_address = await self._transfer_server.start()
+        return self.transfer_address
+
+    def _get_transfer_client(self):
+        if self._transfer_client is None:
+            from .transfer import KvTransferClient
+
+            self._transfer_client = KvTransferClient(self)
+        return self._transfer_client
 
     # ------------------------------------------------------------------ setup
     def _shard_params(self, params: llama.Params) -> llama.Params:
@@ -257,12 +279,36 @@ class TpuEngine:
             seq=TokenBlockSequence(all_tokens, self.cfg.block_size),
             last_token=all_tokens[-1] if all_tokens else 0,
         )
+        # disaggregated decode: pull the prefill worker's KV pages first so
+        # admission sees them as a cached prefix (no recompute)
+        if req.kv_transfer and req.kv_transfer.get("address"):
+            try:
+                got = await self._get_transfer_client().fetch_and_import(
+                    req.kv_transfer["address"],
+                    [int(h) for h in req.kv_transfer.get("hashes", [])],
+                )
+                log.debug("imported %d transferred kv tokens for %s", got, req.request_id[:8])
+            except Exception:
+                log.exception("kv transfer failed; recomputing prefill locally")
+        # disaggregated prefill: announce our pages on the way out
+        is_prefill_side = req.annotations.get("disagg") == "prefill"
         self._waiting.append(st)
         self._wake.set()
         while True:
             item = await st.out_queue.get()
             if item is None:
                 return
+            if (
+                is_prefill_side
+                and item.finish_reason is not None
+                and self.transfer_address is not None
+            ):
+                prompt_blocks = len(req.token_ids) // self.cfg.block_size
+                item.kv_transfer = {
+                    "address": self.transfer_address,
+                    "hashes": [int(h) for h in st.seq.sequence_hashes()[:prompt_blocks]],
+                    "num_tokens": prompt_blocks * self.cfg.block_size,
+                }
             yield item
             if item.finish_reason is not None:
                 return
@@ -274,6 +320,8 @@ class TpuEngine:
     def stop(self) -> None:
         if self._loop_task is not None:
             self._loop_task.cancel()
+        if self._transfer_server is not None:
+            asyncio.ensure_future(self._transfer_server.stop(0.5))
         self._executor.shutdown(wait=False)
 
     # ------------------------------------------------------------- step loop

@@ -1,0 +1,142 @@
+"""KV block transfer between engines: the TPU-native NIXL analog.
+
+The reference moves KV between prefill and decode GPUs over NIXL RDMA
+(lib/memory/src/nixl.rs, dynamo.nixl_connect, docs/design_docs/
+disagg_serving.md:20,54). On TPU the equivalent paths are:
+
+1. **DCN / host-staging (implemented here, works everywhere):** prefill
+   engine gathers the request's KV pages device->host, ships them over the
+   request plane (msgpack bytes on TCP), decode engine scatters host->device
+   into its own pages. Content addressing makes the protocol idempotent and
+   failure-tolerant: blocks are requested *by sequence hash*; whatever the
+   prefill side still holds is returned, and the decode side recomputes any
+   missing suffix — no pinning handshake required.
+2. **ICI collective-permute (same-pod slices):** planned fast path —
+   jitted shard_map ppermute moving pages directly HBM->HBM across a shared
+   mesh; requires a multi-slice deployment (interface reserved via
+   TransferBackend).
+
+Wire protocol (served as a normal endpoint, "kv_fetch"):
+    request : {"hashes": [u64...], "layers": L, "dtype": str}
+    response: one item {"matched": n, "shape": [...], "data": bytes}
+              (data = np array [L, 2, n, bs, kvh, d] tobytes, C-order)
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from ..runtime.request_plane.tcp import TcpClient
+from ..tokens import SequenceHash
+
+log = get_logger("engine.transfer")
+
+
+class KvTransferServer:
+    """Serves this engine's KV pages by sequence hash."""
+
+    def __init__(self, engine):
+        self.engine = engine  # TpuEngine (duck-typed: allocator, k/v_caches)
+
+    async def handle(self, request: Any, context: Context) -> AsyncIterator[Dict]:
+        hashes: List[SequenceHash] = list(request.get("hashes", []))
+        alloc = self.engine.allocator
+        # pin the matched prefix so eviction can't race the device gather
+        block_ids = alloc.acquire_prefix(hashes)
+        try:
+            n = len(block_ids)
+            if n == 0:
+                yield {"matched": 0, "data": b"", "shape": []}
+                return
+            data, shape = await self._gather(block_ids)
+            yield {"matched": n, "data": data, "shape": shape}
+        finally:
+            alloc.release(block_ids)
+
+    async def _gather(self, block_ids: List[int]) -> Tuple[bytes, List[int]]:
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+
+        def gather():
+            ids = jnp.asarray(np.asarray(block_ids, np.int32))
+            layers = []
+            for kc, vc in zip(self.engine.k_caches, self.engine.v_caches):
+                k = np.asarray(kc[ids])   # [n, bs, kvh, d]
+                v = np.asarray(vc[ids])
+                layers.append(np.stack([k, v]))  # [2, n, bs, kvh, d]
+            arr = np.stack(layers)               # [L, 2, n, bs, kvh, d]
+            return arr.astype(np.float32).tobytes(), list(arr.shape)
+
+        return await loop.run_in_executor(self.engine._executor, gather)
+
+
+class KvTransferClient:
+    """Fetches remote pages and imports them into a local engine's cache."""
+
+    def __init__(self, engine, tcp_client: Optional[TcpClient] = None):
+        self.engine = engine
+        self._tcp = tcp_client or TcpClient()
+
+    async def fetch_and_import(
+        self, address: str, hashes: List[SequenceHash]
+    ) -> int:
+        """Pull blocks for ``hashes`` from ``address``; returns tokens imported.
+
+        Already-cached local blocks are skipped (only the missing suffix is
+        fetched). Imported blocks are committed content-addressed, so the
+        engine's normal admission path picks them up as a cached prefix."""
+        alloc = self.engine.allocator
+        have = len(alloc.match_prefix(hashes))
+        want = hashes[have:]
+        if not want:
+            return have * alloc.block_size
+        stream = await self._tcp.call(address, {"hashes": [int(h) for h in want]})
+        matched = 0
+        data = b""
+        shape: List[int] = []
+        async for item in stream:
+            matched = item.get("matched", 0)
+            data = item.get("data", b"")
+            shape = item.get("shape", [])
+        if matched == 0:
+            return have * alloc.block_size
+        arr = np.frombuffer(data, np.float32).reshape(shape)
+        imported = await self._import(arr, want[:matched])
+        return (have + imported) * alloc.block_size
+
+    async def _import(self, arr: np.ndarray, hashes: List[SequenceHash]) -> int:
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        alloc = self.engine.allocator
+        n = arr.shape[2]
+        try:
+            local_ids = alloc.allocate(n)
+        except Exception:
+            log.warning("no room to import %d transferred blocks; skipping", n)
+            return 0
+
+        def scatter():
+            ids = jnp.asarray(np.asarray(local_ids, np.int32))
+            dtype = self.engine.mcfg.dtype
+            for li in range(arr.shape[0]):
+                k = jnp.asarray(arr[li, 0], dtype)
+                v = jnp.asarray(arr[li, 1], dtype)
+                self.engine.k_caches[li] = self.engine.k_caches[li].at[ids].set(k)
+                self.engine.v_caches[li] = self.engine.v_caches[li].at[ids].set(v)
+
+        await loop.run_in_executor(self.engine._executor, scatter)
+        for bid, h in zip(local_ids, hashes):
+            alloc.commit(bid, h)
+        alloc.release(local_ids)  # unpinned -> reusable cached prefix
+        return n
+
+    async def close(self) -> None:
+        await self._tcp.close()

@@ -55,6 +55,8 @@ class ModelPipeline:
         self.migration = Migration(self._send, card.migration_limit)
         self.instance_count = 0
         self._known_worker_ids: set = set()
+        # disaggregation: set when a prefill pool is registered for this model
+        self.prefill_router = None
 
     async def start(self) -> "ModelPipeline":
         endpoint = (
@@ -76,6 +78,8 @@ class ModelPipeline:
         return self
 
     async def stop(self) -> None:
+        if self.prefill_router is not None:
+            await self.prefill_router.stop()
         if self.kv_router is not None:
             await self.kv_router.stop()
         if self.client is not None:
@@ -139,17 +143,43 @@ class ModelPipeline:
     async def generate_tokens(
         self, req: PreprocessedRequest, context: Context
     ) -> AsyncIterator[BackendOutput]:
-        """The full internal stream: migration-wrapped routed generation."""
-        first = True
+        """The full internal stream: [prefill hop ->] migration-wrapped routed
+        generation. Disaggregation is elastic: with no prefill pool (or on
+        prefill failure) the aggregated path serves the request unchanged."""
+        offset = 0
+        if self.prefill_router is not None and self.prefill_router.has_workers:
+            pre_out = await self.prefill_router.run_prefill(req, context)
+            if pre_out is not None and pre_out.token_ids:
+                merged = dict(req.annotations)
+                merged.update(pre_out.annotations)
+                if req.stop.max_tokens == 1:
+                    pre_out.annotations = merged
+                    yield pre_out
+                    return
+                # first token streams now; decode continues from it
+                first_tok = pre_out.token_ids[-1]
+                yield BackendOutput(
+                    token_ids=list(pre_out.token_ids),
+                    cumulative_tokens=1,
+                    logprobs=pre_out.logprobs,
+                    annotations=merged,
+                )
+                offset = 1
+                req = PreprocessedRequest.from_obj(req.to_obj())
+                req.prior_token_ids = [first_tok]
+                req.kv_transfer = pre_out.kv_transfer
+                if req.stop.max_tokens is not None:
+                    req.stop.max_tokens -= 1
+        first = offset == 0
         try:
             async for out in self.migration.generate(req, context):
                 if first:
                     first = False
-                    # frontend-known metrics (input tokens, cache overlap,
-                    # chosen worker) ride the first chunk's annotations
                     merged = dict(req.annotations)
                     merged.update(out.annotations)
                     out.annotations = merged
+                if offset:
+                    out.cumulative_tokens += offset
                 yield out
         finally:
             if self.kv_router is not None:
@@ -195,6 +225,9 @@ class ModelWatcher:
         # mdc store key -> model name (for DELETE handling)
         self._key_model: Dict[str, str] = {}
         self._model_keys: Dict[str, set] = {}
+        # disaggregation: prefill pool cards by model name
+        self._prefill_cards: Dict[str, ModelDeploymentCard] = {}
+        self._prefill_keys: Dict[str, set] = {}
 
     async def start(self) -> "ModelWatcher":
         self._watcher = await self.runtime.store.watch(MDC_PREFIX + "/")
@@ -214,6 +247,16 @@ class ModelWatcher:
 
     async def _handle_put(self, key: str, value: bytes) -> None:
         card = ModelDeploymentCard.from_obj(msgpack.unpackb(value, raw=False))
+        from .model_card import MODEL_TYPE_PREFILL
+
+        if MODEL_TYPE_PREFILL in card.model_type:
+            self._key_model[key] = card.name
+            self._prefill_keys.setdefault(card.name, set()).add(key)
+            if card.name not in self._prefill_cards:
+                self._prefill_cards[card.name] = card
+                log.info("prefill pool for %s appeared", card.name)
+            await self._sync_prefill(card.name)
+            return
         self._key_model[key] = card.name
         self._model_keys.setdefault(card.name, set()).add(key)
         if self.manager.get(card.name) is None:
@@ -225,10 +268,40 @@ class ModelWatcher:
         pipe = self.manager.get(card.name)
         if pipe is not None:
             pipe.instance_count = len(self._model_keys[card.name])
+        await self._sync_prefill(card.name)
+
+    async def _sync_prefill(self, model: str) -> None:
+        """Attach/detach the PrefillRouter as prefill pools come and go."""
+        pipe = self.manager.get(model)
+        if pipe is None:
+            return
+        has_pool = bool(self._prefill_keys.get(model))
+        if has_pool and pipe.prefill_router is None:
+            from .prefill_router import PrefillRouter
+
+            pipe.prefill_router = await PrefillRouter(
+                self.runtime,
+                self._prefill_cards[model],
+                self.kv_router_config if self.router_mode == RouterMode.KV else None,
+            ).start()
+            log.info("disaggregation enabled for %s", model)
+        elif not has_pool and pipe.prefill_router is not None:
+            router = pipe.prefill_router
+            pipe.prefill_router = None
+            await router.stop()
+            log.info("disaggregation disabled for %s (prefill pool empty)", model)
 
     async def _handle_delete(self, key: str) -> None:
         model = self._key_model.pop(key, None)
         if model is None:
+            return
+        pkeys = self._prefill_keys.get(model)
+        if pkeys is not None and key in pkeys:
+            pkeys.discard(key)
+            if not pkeys:
+                self._prefill_cards.pop(model, None)
+                self._prefill_keys.pop(model, None)
+            await self._sync_prefill(model)
             return
         keys = self._model_keys.get(model, set())
         keys.discard(key)

@@ -1,0 +1,106 @@
+"""PrefillRouter: disaggregated prefill/decode orchestration on the frontend.
+
+Analog of the reference's PrefillRouter (lib/llm/src/kv_router/
+prefill_router.rs:102,505 + docs/design_docs/disagg_serving.md): when a
+prefill pool is registered for a model, each request is first sent to a
+prefill worker as a clone with ``max_tokens=1``; the first token streams to
+the client immediately, and the decode request carries the prefill worker's
+KV-transfer metadata (address + block hashes) plus the first token as prior
+context. If no prefill pool exists (elastic xPyD: pools scale to zero) the
+request falls through to the aggregated path — runtime-reconfigurable
+disaggregation, like the reference (disagg_serving.md:67-69).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from ..kv_router import KvRouter, KvRouterConfig, WorkerWithDpRank
+from ..runtime.component import Client, RouterMode
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from ..runtime.request_plane.tcp import NoResponders
+from .model_card import ModelDeploymentCard
+from .preprocessor import ANNOTATION_PREFILL_WORKER_ID
+from .protocols.common import BackendOutput, PreprocessedRequest
+
+log = get_logger("llm.prefill_router")
+
+
+class PrefillRouter:
+    def __init__(
+        self,
+        runtime,
+        card: ModelDeploymentCard,
+        kv_router_config: Optional[KvRouterConfig] = None,
+    ):
+        self.runtime = runtime
+        self.card = card  # the *prefill* pool's card
+        self.client: Optional[Client] = None
+        self.kv_router: Optional[KvRouter] = None
+        self.kv_router_config = kv_router_config
+
+    async def start(self) -> "PrefillRouter":
+        endpoint = (
+            self.runtime.namespace(self.card.namespace)
+            .component(self.card.component)
+            .endpoint(self.card.endpoint)
+        )
+        self.client = await endpoint.client(RouterMode.ROUND_ROBIN)
+        if self.kv_router_config is not None:
+            self.kv_router = await KvRouter(
+                self.runtime.event_plane,
+                self.card.namespace,
+                self.card.component,
+                block_size=self.card.kv_block_size,
+                config=self.kv_router_config,
+            ).start()
+        return self
+
+    @property
+    def has_workers(self) -> bool:
+        return self.client is not None and bool(self.client.instances)
+
+    async def run_prefill(
+        self, req: PreprocessedRequest, context: Context
+    ) -> Optional[BackendOutput]:
+        """Send the max_tokens=1 clone to a prefill worker.
+
+        Returns the prefill output (first token + kv_transfer metadata), or
+        None if prefill failed/unavailable (caller falls back to aggregated).
+        """
+        assert self.client is not None
+        preq = PreprocessedRequest.from_obj(req.to_obj())
+        preq.stop.max_tokens = 1
+        preq.stop.min_tokens = 0
+        preq.stop.stop_strings = []
+        preq.annotations["disagg"] = "prefill"
+
+        instance_id: Optional[int] = None
+        if self.kv_router is not None and self.client.instances:
+            cands = [WorkerWithDpRank(i) for i in self.client.instance_ids()]
+            decision = self.kv_router.schedule_tokens(preq.token_ids, cands)
+            instance_id = decision.worker.worker_id
+        try:
+            stream = await self.client.generate(preq.to_obj(), context.child(), instance_id)
+            last: Optional[BackendOutput] = None
+            async for item in stream:
+                out = item if isinstance(item, BackendOutput) else BackendOutput.from_obj(item)
+                last = out
+                if out.finish_reason is not None:
+                    break
+            if last is not None and instance_id is not None:
+                last.annotations[ANNOTATION_PREFILL_WORKER_ID] = instance_id
+            return last
+        except NoResponders:
+            log.info("prefill pool unavailable; falling back to aggregated")
+            return None
+        except Exception:
+            log.exception("prefill failed; falling back to aggregated")
+            return None
+
+    async def stop(self) -> None:
+        if self.kv_router is not None:
+            await self.kv_router.stop()
+        if self.client is not None:
+            await self.client.stop()

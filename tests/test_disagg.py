@@ -1,0 +1,167 @@
+"""Disaggregated prefill/decode e2e on the virtual CPU mesh.
+
+Golden correctness: the disaggregated path (prefill worker -> KV transfer ->
+decode worker) must produce token-identical greedy output to the aggregated
+path, with the decode worker importing (not recomputing) the prefill KV.
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.kv_router import KvRouterConfig
+from dynamo_tpu.llm import ModelDeploymentCard, ModelManager, ModelWatcher, register_llm
+from dynamo_tpu.llm.model_card import MODEL_TYPE_PREFILL
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    InProcEventPlane,
+    MemKVStore,
+    RouterMode,
+    RuntimeConfig,
+)
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+
+def tiny_cfg(**kw):
+    mcfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+    defaults = dict(
+        num_blocks=64, block_size=4, max_batch_size=4, max_context=128,
+        prefill_buckets=(16, 32, 64, 128),
+    )
+    defaults.update(kw)
+    return TpuEngineConfig(model=mcfg, **defaults)
+
+
+def make_rt(store, plane):
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    return DistributedRuntime(cfg, store=store, event_plane=plane)
+
+
+def preq(rid, tokens, max_tokens=8):
+    return PreprocessedRequest(
+        request_id=rid, model="disagg-model", token_ids=tokens,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+async def test_disagg_matches_aggregated():
+    prompt = list(range(100, 130))  # 30 tokens
+
+    # ---- golden: aggregated single engine ----
+    agg = TpuEngine(tiny_cfg())
+    golden = []
+    try:
+        async for out in agg.generate(preq("golden", prompt), Context()):
+            golden.extend(out.token_ids)
+    finally:
+        agg.stop()
+    assert len(golden) == 8
+
+    # ---- disaggregated stack ----
+    store = MemKVStore()
+    plane = InProcEventPlane()
+    prefill_rt = await make_rt(store, plane).start()
+    decode_rt = await make_rt(store, plane).start()
+    frontend_rt = await make_rt(store, plane).start()
+
+    prefill_engine = TpuEngine(tiny_cfg())
+    await prefill_engine.serve_transfer()
+    decode_engine = TpuEngine(tiny_cfg())
+
+    prefill_card = ModelDeploymentCard(
+        name="disagg-model", component="backend_prefill",
+        model_type=[MODEL_TYPE_PREFILL], tokenizer="byte",
+        kv_block_size=4, context_length=128,
+    )
+    decode_card = ModelDeploymentCard(
+        name="disagg-model", component="backend", tokenizer="byte",
+        kv_block_size=4, context_length=128,
+    )
+    s_prefill = await register_llm(prefill_rt, prefill_engine, prefill_card)
+    s_decode = await register_llm(decode_rt, decode_engine, decode_card)
+
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager, RouterMode.ROUND_ROBIN).start()
+    try:
+        for _ in range(100):
+            pipe = manager.get("disagg-model")
+            if (
+                pipe is not None
+                and pipe.client.instances
+                and pipe.prefill_router is not None
+                and pipe.prefill_router.has_workers
+            ):
+                break
+            await asyncio.sleep(0.05)
+        pipe = manager.get("disagg-model")
+        assert pipe is not None and pipe.prefill_router is not None
+
+        got = []
+        cum = []
+        async for out in pipe.generate_tokens(preq("disagg", prompt), Context()):
+            got.extend(out.token_ids)
+            cum.append(out.cumulative_tokens)
+        assert got == golden, f"disagg {got} != aggregated {golden}"
+        assert cum[-1] == len(golden)
+
+        # the decode engine must have IMPORTED the prefill pages: its
+        # allocator should know the prompt's complete-block hashes
+        hashes = compute_sequence_hashes(prompt, 4)
+        reusable = (len(prompt) - 1) // 4
+        matched = decode_engine.allocator.match_prefix(hashes[:reusable])
+        assert len(matched) > 0, "no transferred blocks in decode allocator"
+    finally:
+        await watcher.stop()
+        await s_prefill.stop()
+        await s_decode.stop()
+        prefill_engine.stop()
+        decode_engine.stop()
+        await prefill_rt.shutdown()
+        await decode_rt.shutdown()
+        await frontend_rt.shutdown()
+
+
+async def test_disagg_falls_back_without_prefill_pool():
+    """Elastic xPyD: no prefill workers -> aggregated path serves unchanged."""
+    store = MemKVStore()
+    plane = InProcEventPlane()
+    decode_rt = await make_rt(store, plane).start()
+    frontend_rt = await make_rt(store, plane).start()
+    engine = TpuEngine(tiny_cfg())
+    card = ModelDeploymentCard(
+        name="disagg-model", component="backend", tokenizer="byte",
+        kv_block_size=4, context_length=128,
+    )
+    served = await register_llm(decode_rt, engine, card)
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager, RouterMode.ROUND_ROBIN).start()
+    try:
+        for _ in range(100):
+            pipe = manager.get("disagg-model")
+            if pipe is not None and pipe.client.instances:
+                break
+            await asyncio.sleep(0.05)
+        pipe = manager.get("disagg-model")
+        assert pipe.prefill_router is None
+        got = []
+        async for out in pipe.generate_tokens(preq("agg", list(range(20))), Context()):
+            got.extend(out.token_ids)
+        assert len(got) == 8
+    finally:
+        await watcher.stop()
+        await served.stop()
+        engine.stop()
+        await decode_rt.shutdown()
+        await frontend_rt.shutdown()
