@@ -48,6 +48,11 @@ def parse_args():
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-context", type=int, default=2048)
     p.add_argument("--migration-limit", type=int, default=0)
+    p.add_argument("--kvbm-host-gb", type=float, default=0.0,
+                   help="host DRAM KV tier size (G2); 0 disables kvbm")
+    p.add_argument("--kvbm-disk-gb", type=float, default=0.0,
+                   help="disk KV tier size (G3)")
+    p.add_argument("--kvbm-disk-path", default="/tmp/dtpu_kvbm")
     p.add_argument(
         "--disagg",
         choices=["none", "prefill", "decode"],
@@ -101,6 +106,19 @@ async def main() -> None:
         rnd(b) for b in (64, 128, 256, 512, 1024, 2048, 4096, 8192) if rnd(b) < ctx
     ) + (ctx,)
     args.max_context = ctx
+    kvbm = None
+    if args.kvbm_host_gb > 0 or args.kvbm_disk_gb > 0:
+        from dynamo_tpu.kvbm.pool import KvbmTiers
+
+        block_nbytes = (
+            4 * mcfg.num_layers * 2 * args.block_size * mcfg.num_kv_heads * mcfg.head_dim
+        )
+        kvbm = KvbmTiers(
+            block_nbytes,
+            host_capacity_bytes=int(args.kvbm_host_gb * (1 << 30)),
+            disk_capacity_bytes=int(args.kvbm_disk_gb * (1 << 30)),
+            disk_path=args.kvbm_disk_path,
+        )
     engine = TpuEngine(
         TpuEngineConfig(
             model=mcfg,
@@ -114,6 +132,7 @@ async def main() -> None:
         params=params,
         kv_publisher=kv_pub,
         metrics_publisher=m_pub,
+        kvbm=kvbm,
     )
     if args.disagg in ("prefill", "decode"):
         addr = await engine.serve_transfer(host=cfg.host_ip)

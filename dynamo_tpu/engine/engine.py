@@ -109,6 +109,7 @@ class TpuEngine:
         mesh: Optional[jax.sharding.Mesh] = None,
         kv_publisher: Optional[KvEventPublisher] = None,
         metrics_publisher: Optional[WorkerMetricsPublisher] = None,
+        kvbm=None,
     ):
         self.cfg = config
         self.mcfg = config.model
@@ -117,6 +118,10 @@ class TpuEngine:
         self.metrics_publisher = metrics_publisher
         self.allocator = BlockAllocator(config.num_blocks, config.block_size)
         self._host_rng = np.random.default_rng(config.seed)
+        # multi-tier KV (kvbm/pool.py): sealed blocks write through to host
+        # DRAM (G2) / disk (G3); admission onboards matched prefixes back
+        self.kvbm = kvbm
+        self._offload_pending: List[Tuple[int, int]] = []  # (block_id, seq_hash)
 
         # --- place params + caches on the mesh ---
         with self.mesh:
@@ -290,6 +295,11 @@ class TpuEngine:
                 log.debug("imported %d transferred kv tokens for %s", got, req.request_id[:8])
             except Exception:
                 log.exception("kv transfer failed; recomputing prefill locally")
+        if self.kvbm is not None:
+            try:
+                await self._onboard_from_kvbm(st)
+            except Exception:
+                log.exception("kvbm onboard failed; prefilling from scratch")
         # disaggregated prefill: announce our pages on the way out
         is_prefill_side = req.annotations.get("disagg") == "prefill"
         self._waiting.append(st)
@@ -324,6 +334,76 @@ class TpuEngine:
             asyncio.ensure_future(self._transfer_server.stop(0.5))
         self._executor.shutdown(wait=False)
 
+    # ------------------------------------------------------- kvbm offload/onboard
+    def _offload_blocks(self, pending: List[Tuple[int, int]]) -> None:
+        """Executor thread: copy sealed device pages to the host tier.
+        Best-effort cache write-through: failures are logged, never fatal."""
+        if self.kvbm is None or not pending:
+            return
+        try:
+            ids = jnp.asarray(np.asarray([bid for bid, _ in pending], np.int32))
+            layers = []
+            for kc, vc in zip(self.k_caches, self.v_caches):
+                k = np.asarray(kc[ids], np.float32)  # [n, bs, kvh, d]
+                v = np.asarray(vc[ids], np.float32)
+                layers.append(np.stack([k, v], axis=1))  # [n, 2, bs, kvh, d]
+            arr = np.stack(layers, axis=1)               # [n, L, 2, bs, kvh, d]
+            for i, (_, h) in enumerate(pending):
+                self.kvbm.store(h, arr[i])
+        except Exception:
+            log.exception("kv offload failed (continuing without write-through)")
+
+    def _scatter_blocks(self, local_ids: List[int], arr: np.ndarray) -> None:
+        """Executor thread: device scatter only — no allocator access here
+        (the allocator is single-threaded on the event loop)."""
+        ids = jnp.asarray(np.asarray(local_ids, np.int32))
+        dtype = self.mcfg.dtype
+        for li in range(arr.shape[1]):
+            k = jnp.asarray(arr[:, li, 0], dtype)
+            v = jnp.asarray(arr[:, li, 1], dtype)
+            self.k_caches[li] = self.k_caches[li].at[ids].set(k)
+            self.v_caches[li] = self.v_caches[li].at[ids].set(v)
+
+    async def import_blocks(self, hashes: List[int], arr: np.ndarray) -> int:
+        """Import [n, L, 2, bs, kvh, d] as content-addressed cached pages.
+        Shared by the kv transfer plane and kvbm onboarding. Allocator
+        mutations stay on the event-loop thread; only the scatter runs in
+        the executor."""
+        n = arr.shape[0]
+        try:
+            local_ids = self.allocator.allocate(n)
+        except OutOfBlocks:
+            log.warning("no room to import %d blocks; skipping", n)
+            return 0
+        loop = asyncio.get_event_loop()
+        try:
+            await loop.run_in_executor(self._executor, self._scatter_blocks, local_ids, arr)
+        except Exception:
+            self.allocator.release(local_ids)
+            raise
+        for bid, h in zip(local_ids, hashes):
+            self.allocator.commit(bid, h)
+        self.allocator.release(local_ids)
+        return n
+
+    async def _onboard_from_kvbm(self, st: "_Seq") -> None:
+        """Pull a host/disk-cached prefix into device pages before admission."""
+        if self.kvbm is None:
+            return
+        bs = self.cfg.block_size
+        hashes = st.seq.sequence_hashes()[: (len(st.seq) - 1) // bs]
+        have = len(self.allocator.match_prefix(hashes))
+        n = self.kvbm.match_prefix(hashes[have:])
+        if n == 0:
+            return
+        loop = asyncio.get_event_loop()
+        arr = await loop.run_in_executor(None, self.kvbm.load_prefix, hashes[have : have + n])
+        if arr is None:
+            return
+        got = await self.import_blocks(list(hashes[have : have + n]), arr)
+        if got:
+            log.debug("onboarded %d blocks from kvbm for %s", got, st.req.request_id[:8])
+
     # ------------------------------------------------------------- step loop
     async def _loop(self) -> None:
         loop = asyncio.get_event_loop()
@@ -345,6 +425,12 @@ class TpuEngine:
                     for rst, tok, lp in results:
                         self._accept_token(rst, tok, lp)
                 self._reap_finished()
+                if self._offload_pending:
+                    pending, self._offload_pending = self._offload_pending, []
+                    # fire-and-forget: the single-thread executor orders this
+                    # gather before any later step that could rewrite the
+                    # pages, and decode never waits on the host copy
+                    self._executor.submit(self._offload_blocks, pending)
                 await self._publish_events()
                 await asyncio.sleep(0)
         except asyncio.CancelledError:
@@ -414,6 +500,8 @@ class TpuEngine:
             # writes them this step); future requests can reuse them
             for i in range(prefix_blocks, len(hashes)):
                 self.allocator.commit(st.block_ids[i], hashes[i])
+                if self.kvbm is not None:
+                    self._offload_pending.append((st.block_ids[i], hashes[i]))
             st.sealed_upto = len(hashes)
             st.slot = slot
             self._slots[slot] = st
@@ -556,6 +644,10 @@ class TpuEngine:
                         st.block_ids[sealed.position], sealed.sequence_hash
                     )
                     st.sealed_upto = sealed.position + 1
+                    if self.kvbm is not None:
+                        self._offload_pending.append(
+                            (st.block_ids[sealed.position], sealed.sequence_hash)
+                        )
                 # ensure a block exists for the *next* token's write position
                 L_after = L_before + 1
                 needed_blocks = L_after // self.cfg.block_size + 1
@@ -593,6 +685,23 @@ class TpuEngine:
 
     async def _publish_events(self) -> None:
         stored, removed = self.allocator.drain_events()
+        if self.kvbm is not None:
+            # tier evictions: blocks gone from G2+G3 AND not resident in G1
+            # are no longer servable anywhere -> tell the router
+            gone = [
+                h for h in self.kvbm.drain_evicted()
+                if self.allocator._by_hash.get(h) is None
+            ]
+            if gone:
+                removed = removed + [gone]
+            # a device-evicted block still in G2/G3 is still servable (we
+            # onboard on demand): don't tell the router it's gone — the
+            # consolidated view, like the reference's kv_consolidator
+            # (lib/llm/src/block_manager/kv_consolidator)
+            removed = [
+                [h for h in batch if h not in self.kvbm] for batch in removed
+            ]
+            removed = [b for b in removed if b]
         if self.kv_publisher is not None:
             for batch in stored:
                 await self.kv_publisher.stored(batch)

@@ -112,31 +112,9 @@ class KvTransferClient:
         return (have + imported) * alloc.block_size
 
     async def _import(self, arr: np.ndarray, hashes: List[SequenceHash]) -> int:
-        import asyncio
-
-        loop = asyncio.get_event_loop()
-        alloc = self.engine.allocator
-        n = arr.shape[2]
-        try:
-            local_ids = alloc.allocate(n)
-        except Exception:
-            log.warning("no room to import %d transferred blocks; skipping", n)
-            return 0
-
-        def scatter():
-            ids = jnp.asarray(np.asarray(local_ids, np.int32))
-            dtype = self.engine.mcfg.dtype
-            for li in range(arr.shape[0]):
-                k = jnp.asarray(arr[li, 0], dtype)
-                v = jnp.asarray(arr[li, 1], dtype)
-                self.engine.k_caches[li] = self.engine.k_caches[li].at[ids].set(k)
-                self.engine.v_caches[li] = self.engine.v_caches[li].at[ids].set(v)
-
-        await loop.run_in_executor(self.engine._executor, scatter)
-        for bid, h in zip(local_ids, hashes):
-            alloc.commit(bid, h)
-        alloc.release(local_ids)  # unpinned -> reusable cached prefix
-        return n
+        # wire layout [L, 2, n, bs, kvh, d] -> block-major [n, L, 2, ...]
+        block_major = np.ascontiguousarray(np.moveaxis(arr, 2, 0))
+        return await self.engine.import_blocks(list(hashes), block_major)
 
     async def close(self) -> None:
         await self._tcp.close()

@@ -1,0 +1,223 @@
+"""Multi-tier KV block pools: G2 host DRAM and G3 local disk.
+
+Analog of the reference's KVBM block manager (lib/llm/src/block_manager:
+G1 device / G2 host / G3 disk / G4 remote, block_manager.rs:63-77) built for
+the TPU engine: sealed device blocks are written through to a host pool
+asynchronously; host overflow spills to disk; a prefix lookup that misses HBM
+onboards from host/disk back into device pages before prefill.
+
+Storage layout per block: float32 array [L, 2, bs, kvh, d] (same shape the
+transfer plane uses) — one contiguous buffer per block keeps the host copy
+a single memcpy and the disk tier a single file write.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+from ..tokens import SequenceHash
+
+log = get_logger("kvbm")
+
+
+class HostBlockPool:
+    """G2: content-addressed host DRAM pool with LRU eviction."""
+
+    def __init__(self, capacity_bytes: int, block_nbytes: int):
+        self.capacity_blocks = max(0, capacity_bytes // max(block_nbytes, 1))
+        self.block_nbytes = block_nbytes
+        self._data: OrderedDict[SequenceHash, np.ndarray] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __contains__(self, h: SequenceHash) -> bool:
+        with self._lock:
+            return h in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def store(self, h: SequenceHash, block: np.ndarray) -> Optional[Tuple[SequenceHash, np.ndarray]]:
+        """Insert; returns an evicted (hash, block) for spillover, if any."""
+        if self.capacity_blocks == 0:
+            return (h, block)
+        evicted = None
+        with self._lock:
+            if h in self._data:
+                self._data.move_to_end(h)
+                return None
+            if len(self._data) >= self.capacity_blocks:
+                evicted = self._data.popitem(last=False)
+            self._data[h] = block
+        return evicted
+
+    def get(self, h: SequenceHash) -> Optional[np.ndarray]:
+        with self._lock:
+            block = self._data.get(h)
+            if block is not None:
+                self._data.move_to_end(h)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return block
+
+    def drop(self, h: SequenceHash) -> None:
+        with self._lock:
+            self._data.pop(h, None)
+
+
+class DiskBlockPool:
+    """G3: one file per block under a spill directory, LRU by access order."""
+
+    def __init__(self, path: str, capacity_bytes: int, block_nbytes: int):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.capacity_blocks = max(0, capacity_bytes // max(block_nbytes, 1))
+        self._lru: OrderedDict[SequenceHash, None] = OrderedDict()
+        self._lock = threading.Lock()
+        # recover existing blocks (warm restart: the disk tier survives)
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".kv"):
+                try:
+                    self._lru[int(name[:-3], 16)] = None
+                except ValueError:
+                    pass
+
+    def _file(self, h: SequenceHash) -> str:
+        return os.path.join(self.path, f"{h:016x}.kv")
+
+    def __contains__(self, h: SequenceHash) -> bool:
+        with self._lock:
+            return h in self._lru
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def store(self, h: SequenceHash, block: np.ndarray) -> List[SequenceHash]:
+        """Insert; returns hashes evicted from disk (gone for good)."""
+        if self.capacity_blocks == 0:
+            return [h]
+        gone: List[SequenceHash] = []
+        with self._lock:
+            if h in self._lru:
+                self._lru.move_to_end(h)
+                return gone
+            while len(self._lru) >= self.capacity_blocks:
+                victim, _ = self._lru.popitem(last=False)
+                gone.append(victim)
+                try:
+                    os.unlink(self._file(victim))
+                except FileNotFoundError:
+                    pass
+        tmp = self._file(h) + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.save(f, block, allow_pickle=False)
+        os.replace(tmp, self._file(h))
+        with self._lock:
+            self._lru[h] = None
+        return gone
+
+    def get(self, h: SequenceHash) -> Optional[np.ndarray]:
+        with self._lock:
+            if h not in self._lru:
+                return None
+            self._lru.move_to_end(h)
+        try:
+            with open(self._file(h), "rb") as f:
+                return np.load(f, allow_pickle=False)
+        except (FileNotFoundError, ValueError):
+            with self._lock:
+                self._lru.pop(h, None)
+            return None
+
+
+class KvbmTiers:
+    """G2+G3 stack with write-through offload and prefix onboarding."""
+
+    def __init__(
+        self,
+        block_nbytes: int,
+        host_capacity_bytes: int = 1 << 30,
+        disk_capacity_bytes: int = 0,
+        disk_path: str = "/tmp/dtpu_kvbm",
+    ):
+        self.host = HostBlockPool(host_capacity_bytes, block_nbytes)
+        self.disk = (
+            DiskBlockPool(disk_path, disk_capacity_bytes, block_nbytes)
+            if disk_capacity_bytes > 0
+            else None
+        )
+        self.offloaded = 0
+        self.onboarded = 0
+        # hashes evicted from every tier since the last drain (the engine
+        # turns these into router 'removed' events so the index stays honest)
+        self._evicted: List[SequenceHash] = []
+        self._evicted_lock = threading.Lock()
+
+    def __contains__(self, h: SequenceHash) -> bool:
+        return h in self.host or (self.disk is not None and h in self.disk)
+
+    def _insert_host(self, h: SequenceHash, block: np.ndarray) -> None:
+        """Host insert with spill-to-disk; tracks blocks gone from all tiers."""
+        evicted = self.host.store(h, block)
+        if evicted is None:
+            return
+        if self.disk is not None:
+            gone = self.disk.store(*evicted)
+        else:
+            gone = [evicted[0]]
+        if gone:
+            with self._evicted_lock:
+                self._evicted.extend(gone)
+
+    def store(self, h: SequenceHash, block: np.ndarray) -> None:
+        self._insert_host(h, block)
+        self.offloaded += 1
+
+    def drain_evicted(self) -> List[SequenceHash]:
+        with self._evicted_lock:
+            out, self._evicted = self._evicted, []
+        return out
+
+    def match_prefix(self, hashes: List[SequenceHash]) -> int:
+        n = 0
+        for h in hashes:
+            if h in self:
+                n += 1
+            else:
+                break
+        return n
+
+    def load_prefix(self, hashes: List[SequenceHash]) -> Optional[np.ndarray]:
+        """Contiguous blocks [n, L, 2, bs, kvh, d] for a matched prefix."""
+        blocks = []
+        for h in hashes:
+            b = self.host.get(h)
+            if b is None and self.disk is not None:
+                b = self.disk.get(h)
+                if b is not None:
+                    self._insert_host(h, b)  # promote G3 -> G2 (with spill)
+            if b is None:
+                break
+            blocks.append(b)
+        if not blocks:
+            return None
+        self.onboarded += len(blocks)
+        return np.stack(blocks)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "host_blocks": len(self.host),
+            "disk_blocks": len(self.disk) if self.disk is not None else 0,
+            "host_hits": self.host.hits,
+            "host_misses": self.host.misses,
+            "offloaded": self.offloaded,
+            "onboarded": self.onboarded,
+        }

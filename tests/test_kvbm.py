@@ -1,0 +1,152 @@
+"""KVBM multi-tier tests: host/disk pools + engine offload/onboard e2e."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.kvbm.pool import DiskBlockPool, HostBlockPool, KvbmTiers
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime import Context
+
+
+def blk(v, shape=(2, 2, 4, 2, 8)):
+    return np.full(shape, v, np.float32)
+
+
+class TestHostPool:
+    def test_store_get_lru(self):
+        pool = HostBlockPool(capacity_bytes=3 * blk(0).nbytes, block_nbytes=blk(0).nbytes)
+        for i in range(3):
+            assert pool.store(i, blk(i)) is None
+        pool.get(0)  # refresh 0
+        evicted = pool.store(99, blk(99))  # evicts LRU = 1
+        assert evicted[0] == 1
+        assert pool.get(0) is not None
+        assert pool.get(1) is None
+
+    def test_zero_capacity_passthrough(self):
+        pool = HostBlockPool(0, blk(0).nbytes)
+        evicted = pool.store(1, blk(1))
+        assert evicted is not None and evicted[0] == 1  # immediately spills
+        assert pool.get(1) is None
+
+
+class TestDiskPool:
+    def test_store_get_survives_reopen(self, tmp_path):
+        p = DiskBlockPool(str(tmp_path), 10 * blk(0).nbytes, blk(0).nbytes)
+        p.store(0xAB, blk(7))
+        got = p.get(0xAB)
+        np.testing.assert_array_equal(got, blk(7))
+        # warm restart: a new pool instance sees the block on disk
+        p2 = DiskBlockPool(str(tmp_path), 10 * blk(0).nbytes, blk(0).nbytes)
+        assert 0xAB in p2
+        np.testing.assert_array_equal(p2.get(0xAB), blk(7))
+
+    def test_capacity_eviction(self, tmp_path):
+        p = DiskBlockPool(str(tmp_path), 2 * blk(0).nbytes, blk(0).nbytes)
+        for i in range(4):
+            p.store(i, blk(i))
+        assert len(p) == 2
+        assert p.get(3) is not None
+
+
+class TestTiers:
+    def test_spillover_and_promotion(self, tmp_path):
+        nbytes = blk(0).nbytes
+        tiers = KvbmTiers(
+            nbytes, host_capacity_bytes=2 * nbytes,
+            disk_capacity_bytes=10 * nbytes, disk_path=str(tmp_path),
+        )
+        for i in range(4):
+            tiers.store(i, blk(i))
+        # 0,1 spilled to disk; 2,3 in host
+        assert len(tiers.host) == 2
+        assert len(tiers.disk) == 2
+        assert tiers.match_prefix([0, 1, 2, 3]) == 4
+        arr = tiers.load_prefix([0, 1])
+        np.testing.assert_array_equal(arr[0], blk(0))
+        assert 0 in tiers.host  # promoted G3 -> G2
+
+
+# ------------------------------------------------------------------- engine
+def tiny_engine_with_kvbm(num_blocks=16, host_blocks=64):
+    mcfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+    bs = 4
+    block_nbytes = 4 * mcfg.num_layers * 2 * bs * mcfg.num_kv_heads * mcfg.head_dim
+    kvbm = KvbmTiers(block_nbytes, host_capacity_bytes=host_blocks * block_nbytes)
+    cfg = TpuEngineConfig(
+        model=mcfg, num_blocks=num_blocks, block_size=bs, max_batch_size=2,
+        max_context=64, prefill_buckets=(16, 32, 64),
+    )
+    return TpuEngine(cfg, kvbm=kvbm), kvbm
+
+
+def preq(rid, tokens, max_tokens=6):
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=tokens,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+async def run(engine, r):
+    toks, cached = [], None
+    async for out in engine.generate(r, Context()):
+        toks.extend(out.token_ids)
+        if out.annotations:
+            cached = out.annotations.get("cached_tokens")
+    return toks, cached
+
+
+async def test_offload_then_onboard_after_device_eviction():
+    """Fill the tiny device cache until the first prompt's blocks are evicted
+    from HBM, then re-send it: the engine must onboard from the host tier and
+    produce identical output with cached_tokens > 0."""
+    engine, kvbm = tiny_engine_with_kvbm(num_blocks=14)
+    try:
+        prompt_a = list(range(100, 124))  # 24 tokens = 6 blocks
+        t1, cached1 = await run(engine, preq("a", prompt_a))
+        assert cached1 == 0
+        await asyncio.sleep(0.05)
+        assert kvbm.stats()["offloaded"] >= 6  # write-through happened
+
+        # churn the device cache with different prompts (13 usable blocks)
+        for i in range(4):
+            await run(engine, preq(f"churn{i}", list(range(200 + 30 * i, 224 + 30 * i))))
+
+        # prompt_a's device blocks are gone (evicted), but G2 still has them
+        t2, cached2 = await run(engine, preq("a2", prompt_a))
+        assert t2 == t1
+        assert cached2 and cached2 > 0, "onboard from host tier did not happen"
+        assert kvbm.stats()["onboarded"] > 0
+    finally:
+        engine.stop()
+
+
+async def test_kvbm_write_through_is_async():
+    """Offload must not change outputs (write-through correctness)."""
+    engine, kvbm = tiny_engine_with_kvbm()
+    engine_plain = TpuEngine(
+        TpuEngineConfig(
+            model=engine.mcfg, num_blocks=16, block_size=4, max_batch_size=2,
+            max_context=64, prefill_buckets=(16, 32, 64),
+        )
+    )
+    try:
+        prompt = list(range(50, 70))
+        t_kvbm, _ = await run(engine, preq("x", prompt))
+        t_plain, _ = await run(engine_plain, preq("x", prompt))
+        assert t_kvbm == t_plain
+    finally:
+        engine.stop()
+        engine_plain.stop()
