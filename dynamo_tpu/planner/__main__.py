@@ -1,0 +1,98 @@
+"""python -m dynamo_tpu.planner — SLA autoscaler service.
+
+Analog of `python -m dynamo.planner.planner_sla` (components/src/dynamo/
+planner/planner_sla.py:36-55): observes worker metrics over the event plane
+and writes target replica counts through the virtual connector (an external
+launcher or operator converges on them), or spawns local workers directly
+with --connector subprocess (fleet-in-a-box).
+"""
+
+import argparse
+import asyncio
+import signal
+import sys
+
+from dynamo_tpu.planner.connectors import SubprocessConnector, VirtualConnector
+from dynamo_tpu.planner.core import DisaggPlanner, PerfInterpolator, PlannerConfig, SlaTargets
+from dynamo_tpu.planner.metrics_source import EventPlaneMetricsSource
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+
+
+def parse_args():
+    p = argparse.ArgumentParser("dynamo_tpu.planner")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--store", default=None)
+    p.add_argument("--store-path", default=None)
+    p.add_argument("--event-plane", default=None)
+    p.add_argument("--prefill-component", default="backend_prefill")
+    p.add_argument("--decode-component", default="backend")
+    p.add_argument("--adjustment-interval", type=float, default=10.0)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--total-budget", type=int, default=0, help="chip budget across pools")
+    p.add_argument("--ttft-sla", type=float, default=0.5)
+    p.add_argument("--itl-sla", type=float, default=0.05)
+    p.add_argument("--predictor", default="holt",
+                   choices=["constant", "moving-average", "holt", "arima"])
+    p.add_argument("--connector", default="virtual", choices=["virtual", "subprocess"])
+    p.add_argument("--worker-cmd", default=None,
+                   help="subprocess connector: shell command template with "
+                        "{component} placeholder")
+    return p.parse_args()
+
+
+async def main() -> None:
+    args = parse_args()
+    init_logging()
+    cfg = RuntimeConfig.from_env(
+        store=args.store, store_path=args.store_path, event_plane=args.event_plane
+    )
+    runtime = await DistributedRuntime(cfg).start()
+
+    if args.connector == "subprocess":
+        if not args.worker_cmd:
+            print("--worker-cmd required with --connector subprocess", file=sys.stderr)
+            sys.exit(2)
+
+        def make_cmd(component, index):
+            return args.worker_cmd.format(component=component).split()
+
+        connector = SubprocessConnector(make_cmd)
+    else:
+        connector = VirtualConnector(runtime.store, args.namespace)
+
+    planner = DisaggPlanner(
+        connector,
+        PlannerConfig(
+            adjustment_interval_s=args.adjustment_interval,
+            predictor=args.predictor,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            total_budget=args.total_budget,
+            sla=SlaTargets(ttft_s=args.ttft_sla, itl_s=args.itl_sla),
+        ),
+        PerfInterpolator(),
+        prefill_component=args.prefill_component,
+        decode_component=args.decode_component,
+    )
+    source = await EventPlaneMetricsSource(
+        runtime.event_plane, args.namespace,
+        [args.prefill_component, args.decode_component],
+    ).start()
+    planner.start(source.snapshot)
+    print("PLANNER_READY", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    planner.stop()
+    source.stop()
+    if isinstance(connector, SubprocessConnector):
+        connector.shutdown()
+    await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,132 @@
+"""Planner connectors: how scaling decisions become running workers.
+
+Analogs of the reference's connectors (components/src/dynamo/planner/
+kubernetes_connector.py:48,333 and virtual_connector.py:28):
+
+- VirtualConnector: writes target replica counts into the discovery store
+  under ``v1/planner/...``; an external launcher (or the subprocess connector
+  below) watches and converges. Non-k8s coordination, like the reference's.
+- SubprocessConnector: actually spawns/stops local worker processes (mocker
+  or TPU engine) to match the target — the fleet-in-a-box used by scaling
+  e2e tests (reference tests/planner/test_scaling_e2e.py runs on mockers).
+- KubernetesConnector: patches deployment replicas via the k8s API (gated:
+  no cluster in this environment; import kubernetes lazily).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional, Protocol
+
+from ..runtime.discovery.store import KVStore
+from ..runtime.logging import get_logger
+
+log = get_logger("planner.connectors")
+
+PLANNER_PREFIX = "v1/planner"
+
+
+def target_key(namespace: str, component: str) -> str:
+    return f"{PLANNER_PREFIX}/{namespace}/{component}/target_replicas"
+
+
+class Connector(Protocol):
+    async def get_replicas(self, component: str) -> int: ...
+
+    async def set_replicas(self, component: str, n: int) -> None: ...
+
+
+class VirtualConnector:
+    """Store-backed coordination (reference virtual_connector.py:28)."""
+
+    def __init__(self, store: KVStore, namespace: str = "dynamo"):
+        self.store = store
+        self.namespace = namespace
+
+    async def get_replicas(self, component: str) -> int:
+        obj = await self.store.get_obj(target_key(self.namespace, component))
+        return int(obj["target"]) if obj else 0
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        await self.store.put_obj(
+            target_key(self.namespace, component), {"target": int(n)}
+        )
+
+
+class SubprocessConnector:
+    """Spawns real local workers to match the target (fleet-in-a-box)."""
+
+    def __init__(self, make_cmd, poll_ready_s: float = 0.0):
+        """make_cmd(component, index) -> argv list for one worker process."""
+        self.make_cmd = make_cmd
+        self.poll_ready_s = poll_ready_s
+        self._procs: Dict[str, List[subprocess.Popen]] = {}
+
+    async def get_replicas(self, component: str) -> int:
+        procs = self._procs.get(component, [])
+        procs = [p for p in procs if p.poll() is None]
+        self._procs[component] = procs
+        return len(procs)
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        procs = self._procs.setdefault(component, [])
+        procs[:] = [p for p in procs if p.poll() is None]
+        while len(procs) < n:
+            cmd = self.make_cmd(component, len(procs))
+            log.info("spawning %s worker: %s", component, " ".join(cmd))
+            procs.append(
+                subprocess.Popen(
+                    cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    env=os.environ.copy(),
+                )
+            )
+            if self.poll_ready_s:
+                await asyncio.sleep(self.poll_ready_s)
+        while len(procs) > n:
+            p = procs.pop()
+            log.info("stopping %s worker pid %d", component, p.pid)
+            p.send_signal(signal.SIGTERM)
+
+    def shutdown(self) -> None:
+        for procs in self._procs.values():
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+
+class KubernetesConnector:
+    """Patch deployment/scale subresource (reference kubernetes_connector.py).
+
+    Gated: requires the `kubernetes` package + in-cluster/SA config, neither
+    of which exists in this image; construction raises a clear error so the
+    planner falls back to the virtual connector."""
+
+    def __init__(self, namespace: str = "default", deployment_prefix: str = "dynamo-"):
+        try:
+            import kubernetes  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "kubernetes client not available; use VirtualConnector and an "
+                "external operator instead"
+            ) from e
+        from kubernetes import client, config
+
+        config.load_incluster_config()
+        self._apps = client.AppsV1Api()
+        self.namespace = namespace
+        self.prefix = deployment_prefix
+
+    async def get_replicas(self, component: str) -> int:
+        dep = self._apps.read_namespaced_deployment_scale(
+            f"{self.prefix}{component}", self.namespace
+        )
+        return dep.status.replicas or 0
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        self._apps.patch_namespaced_deployment_scale(
+            f"{self.prefix}{component}", self.namespace, {"spec": {"replicas": n}}
+        )

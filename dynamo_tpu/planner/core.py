@@ -1,0 +1,215 @@
+"""SLA-driven autoscaling planner for prefill/decode pools.
+
+Analog of the reference's planner core (components/src/dynamo/planner/
+planner_core.py: BasePlanner :258, observe_metrics :511, plan_adjustment :631,
+_apply_scaling :691; PrefillPlanner :764, DecodePlanner :801, DisaggPlanner
+:859): observe load, predict one interval ahead, convert predicted load into
+required replicas through a per-worker capacity model (the profiler
+interpolation analog), clamp to budgets, and apply through a connector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import math
+import time
+from typing import Dict, List, Optional
+
+from ..runtime.logging import get_logger
+from .connectors import Connector
+from .predictors import make_predictor
+
+log = get_logger("planner")
+
+
+@dataclasses.dataclass
+class PerfInterpolator:
+    """Per-worker capacity model from profiled sweeps.
+
+    Analog of the reference's perf_interpolation.py over profiler NPZ sweeps:
+    given (isl, osl) predicts a single worker's sustainable rates. Defaults
+    are linear models; calibrate with measured points via fit_*()."""
+
+    # prefill: tokens/sec one worker sustains at a given ISL
+    prefill_tokens_per_s: float = 20000.0
+    # decode: tokens/sec/worker at the target ITL
+    decode_tokens_per_s: float = 2000.0
+    # profiled (isl, tokens_per_s) points for interpolation
+    prefill_points: List = dataclasses.field(default_factory=list)
+    decode_points: List = dataclasses.field(default_factory=list)
+
+    def prefill_capacity(self, isl: float) -> float:
+        return self._interp(self.prefill_points, isl, self.prefill_tokens_per_s)
+
+    def decode_capacity(self, active_seqs: float) -> float:
+        return self._interp(self.decode_points, active_seqs, self.decode_tokens_per_s)
+
+    @staticmethod
+    def _interp(points: List, x: float, default: float) -> float:
+        if not points:
+            return default
+        pts = sorted(points)
+        if x <= pts[0][0]:
+            return pts[0][1]
+        if x >= pts[-1][0]:
+            return pts[-1][1]
+        for (x0, y0), (x1, y1) in zip(pts, pts[1:]):
+            if x0 <= x <= x1:
+                t = (x - x0) / (x1 - x0) if x1 > x0 else 0.0
+                return y0 + t * (y1 - y0)
+        return default
+
+
+@dataclasses.dataclass
+class SlaTargets:
+    ttft_s: float = 0.5
+    itl_s: float = 0.05
+
+
+@dataclasses.dataclass
+class PlannerConfig:
+    adjustment_interval_s: float = 10.0
+    predictor: str = "holt"
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # total accelerator budget across pools (reference GPU budgets,
+    # planner_core.py:132-256); 0 = unbounded
+    total_budget: int = 0
+    scale_down_headroom: float = 0.8   # only shrink when utilization < this
+    sla: SlaTargets = dataclasses.field(default_factory=SlaTargets)
+
+
+@dataclasses.dataclass
+class LoadSnapshot:
+    """One observation window of demand."""
+
+    request_rate: float = 0.0          # requests/s
+    prefill_tokens_rate: float = 0.0   # prompt tokens/s arriving
+    decode_tokens_rate: float = 0.0    # output tokens/s being generated
+    avg_isl: float = 0.0
+    num_waiting: int = 0
+    active_seqs: int = 0
+    ts: float = dataclasses.field(default_factory=time.time)
+
+
+class PoolPlanner:
+    """Scales one worker pool (prefill or decode) against its capacity model."""
+
+    def __init__(
+        self,
+        name: str,
+        component: str,
+        connector: Connector,
+        config: PlannerConfig,
+        capacity_fn,
+    ):
+        self.name = name
+        self.component = component
+        self.connector = connector
+        self.config = config
+        self.capacity_fn = capacity_fn  # (snapshot) -> tokens/s one worker sustains
+        self.load_predictor = make_predictor(config.predictor)
+        self.last_decision: Optional[int] = None
+
+    def observe(self, rate: float) -> None:
+        self.load_predictor.observe(rate)
+
+    def desired_replicas(self, snapshot: LoadSnapshot) -> int:
+        predicted = self.load_predictor.predict(1)
+        capacity = max(self.capacity_fn(snapshot), 1e-9)
+        needed = math.ceil(predicted / capacity)
+        # queue pressure bumps the floor: waiting work means we're behind
+        if snapshot.num_waiting > 0:
+            needed = max(needed, math.ceil(snapshot.num_waiting / 4) + 1)
+        return max(self.config.min_replicas, min(self.config.max_replicas, max(needed, 1)))
+
+    async def plan_and_apply(self, snapshot: LoadSnapshot) -> int:
+        desired = self.desired_replicas(snapshot)
+        current = await self.connector.get_replicas(self.component)
+        if desired < current:
+            # hysteresis: only scale down with real headroom
+            predicted = self.load_predictor.predict(1)
+            capacity = max(self.capacity_fn(snapshot), 1e-9)
+            if predicted > capacity * desired * self.config.scale_down_headroom:
+                desired = current
+        if desired != current:
+            log.info(
+                "%s pool: scaling %s %d -> %d (predicted load %.1f)",
+                self.name, self.component, current, desired, self.load_predictor.predict(1),
+            )
+            await self.connector.set_replicas(self.component, desired)
+        self.last_decision = desired
+        return desired
+
+
+class DisaggPlanner:
+    """Coordinates prefill + decode pools under one budget (DisaggPlanner :859)."""
+
+    def __init__(
+        self,
+        connector: Connector,
+        config: Optional[PlannerConfig] = None,
+        interpolator: Optional[PerfInterpolator] = None,
+        prefill_component: str = "backend_prefill",
+        decode_component: str = "backend",
+    ):
+        self.config = config or PlannerConfig()
+        self.interp = interpolator or PerfInterpolator()
+        self.connector = connector
+        self.prefill = PoolPlanner(
+            "prefill", prefill_component, connector, self.config,
+            lambda s: self.interp.prefill_capacity(s.avg_isl),
+        )
+        self.decode = PoolPlanner(
+            "decode", decode_component, connector, self.config,
+            lambda s: self.interp.decode_capacity(s.active_seqs),
+        )
+        self._task: Optional[asyncio.Task] = None
+
+    def observe(self, snapshot: LoadSnapshot) -> None:
+        self.prefill.observe(snapshot.prefill_tokens_rate)
+        self.decode.observe(snapshot.decode_tokens_rate)
+        self._last_snapshot = snapshot
+
+    async def plan(self) -> Dict[str, int]:
+        snap = getattr(self, "_last_snapshot", LoadSnapshot())
+        p = self.prefill.desired_replicas(snap)
+        d = self.decode.desired_replicas(snap)
+        budget = self.config.total_budget
+        if budget and p + d > budget:
+            # proportional squeeze under budget (reference GPU budgets)
+            scale = budget / (p + d)
+            p = max(self.config.min_replicas, int(p * scale))
+            d = max(self.config.min_replicas, budget - p)
+        await self._apply(self.prefill, p)
+        await self._apply(self.decode, d)
+        return {"prefill": p, "decode": d}
+
+    async def _apply(self, pool: PoolPlanner, desired: int) -> None:
+        current = await self.connector.get_replicas(pool.component)
+        if desired != current:
+            log.info("scaling %s %d -> %d", pool.component, current, desired)
+            await self.connector.set_replicas(pool.component, desired)
+        pool.last_decision = desired
+
+    def start(self, metrics_fn) -> None:
+        """metrics_fn() -> LoadSnapshot, polled every adjustment interval."""
+
+        async def loop() -> None:
+            try:
+                while True:
+                    await asyncio.sleep(self.config.adjustment_interval_s)
+                    try:
+                        self.observe(metrics_fn())
+                        await self.plan()
+                    except Exception:
+                        log.exception("planning cycle failed")
+            except asyncio.CancelledError:
+                pass
+
+        self._task = asyncio.create_task(loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()

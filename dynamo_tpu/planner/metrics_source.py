@@ -1,0 +1,81 @@
+"""Demand observation for the planner.
+
+The reference scrapes Prometheus (components/src/dynamo/planner/utils/
+prometheus.py); here the primary source is the event plane the workers
+already publish to (WorkerMetrics: waiting queue, active blocks), plus an
+optional Prometheus scrape of the frontend for request/token rates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional
+
+import msgpack
+
+from ..kv_router.protocols import WorkerMetrics, WorkerWithDpRank
+from ..kv_router.publisher import metrics_topic
+from ..runtime.event_plane.base import EventPlane
+from ..runtime.logging import get_logger
+from .core import LoadSnapshot
+
+log = get_logger("planner.metrics")
+
+
+class EventPlaneMetricsSource:
+    """Aggregates worker metrics into LoadSnapshots."""
+
+    def __init__(self, plane: EventPlane, namespace: str, components: list):
+        self.plane = plane
+        self.namespace = namespace
+        self.components = components
+        self._latest: Dict[WorkerWithDpRank, WorkerMetrics] = {}
+        self._tasks = []
+        self._subs = []
+        # cumulative token counters for rate estimation
+        self._last_rate_calc = time.time()
+        self._decode_tokens_window = 0
+        self._prefill_tokens_window = 0
+
+    async def start(self) -> "EventPlaneMetricsSource":
+        for comp in self.components:
+            sub = await self.plane.subscribe(metrics_topic(self.namespace, comp))
+            self._subs.append(sub)
+            self._tasks.append(asyncio.create_task(self._consume(sub)))
+        return self
+
+    async def _consume(self, sub) -> None:
+        async for _topic, payload in sub:
+            try:
+                m = WorkerMetrics.from_obj(msgpack.unpackb(payload, raw=False))
+                self._latest[m.worker] = m
+            except Exception:
+                log.exception("bad worker metrics")
+
+    def record_request(self, prefill_tokens: int) -> None:
+        self._prefill_tokens_window += prefill_tokens
+
+    def record_decode_tokens(self, n: int) -> None:
+        self._decode_tokens_window += n
+
+    def snapshot(self) -> LoadSnapshot:
+        now = time.time()
+        dt = max(now - self._last_rate_calc, 1e-6)
+        fresh = [m for m in self._latest.values() if now - m.ts < 30.0]
+        snap = LoadSnapshot(
+            prefill_tokens_rate=self._prefill_tokens_window / dt,
+            decode_tokens_rate=self._decode_tokens_window / dt,
+            num_waiting=sum(m.num_requests_waiting for m in fresh),
+            active_seqs=sum(m.active_decode_blocks for m in fresh),
+        )
+        self._last_rate_calc = now
+        self._prefill_tokens_window = 0
+        self._decode_tokens_window = 0
+        return snap
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for s in self._subs:
+            s.cancel()

@@ -1,0 +1,78 @@
+"""Load predictors for the SLA planner.
+
+Analog of the reference's predictor zoo (components/src/dynamo/planner/utils/
+load_predictor.py:28,97,110 — constant / ARIMA / Prophet). statsmodels is not
+in this image, so the trend-aware predictor is Holt's double exponential
+smoothing implemented directly — same role as the ARIMA default: smooth the
+recent window, extrapolate one planning interval ahead.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+
+class ConstantPredictor:
+    """Predict the last observation (reference: load_predictor.py:97)."""
+
+    def __init__(self, window: int = 1):
+        self._last: float = 0.0
+
+    def observe(self, value: float) -> None:
+        self._last = value
+
+    def predict(self, steps_ahead: int = 1) -> float:
+        return self._last
+
+
+class MovingAveragePredictor:
+    def __init__(self, window: int = 6):
+        self._buf: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._buf.append(value)
+
+    def predict(self, steps_ahead: int = 1) -> float:
+        if not self._buf:
+            return 0.0
+        return sum(self._buf) / len(self._buf)
+
+
+class HoltPredictor:
+    """Double exponential smoothing: level + trend, extrapolated ahead."""
+
+    def __init__(self, alpha: float = 0.5, beta: float = 0.3):
+        self.alpha = alpha
+        self.beta = beta
+        self._level: Optional[float] = None
+        self._trend: float = 0.0
+
+    def observe(self, value: float) -> None:
+        if self._level is None:
+            self._level = value
+            self._trend = 0.0
+            return
+        prev_level = self._level
+        self._level = self.alpha * value + (1 - self.alpha) * (self._level + self._trend)
+        self._trend = self.beta * (self._level - prev_level) + (1 - self.beta) * self._trend
+
+    def predict(self, steps_ahead: int = 1) -> float:
+        if self._level is None:
+            return 0.0
+        return max(0.0, self._level + steps_ahead * self._trend)
+
+
+PREDICTORS = {
+    "constant": ConstantPredictor,
+    "moving-average": MovingAveragePredictor,
+    "holt": HoltPredictor,
+    "arima": HoltPredictor,  # alias: the trend-aware default
+}
+
+
+def make_predictor(kind: str):
+    try:
+        return PREDICTORS[kind]()
+    except KeyError:
+        raise ValueError(f"unknown predictor {kind!r}; options: {sorted(PREDICTORS)}")
