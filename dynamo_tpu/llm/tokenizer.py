@@ -64,12 +64,22 @@ class ByteTokenizer:
         for i in ids:
             if i < 256:
                 out.append(i)
-            else:
+            elif i in self._SPECIAL:
                 if not skip_special_tokens:
                     if out:
                         parts.append(out.decode("utf-8", errors="replace"))
                         out = bytearray()
-                    parts.append(self._SPECIAL.get(i, f"<unk:{i}>"))
+                    parts.append(self._SPECIAL[i])
+            else:
+                # out-of-vocab id (e.g. random-init model with a larger lm
+                # head than the byte vocab): emit a visible placeholder
+                # instead of silently dropping — smoke tests stream
+                # *something*. Must not be U+FFFD: DecodeStream holds back
+                # trailing U+FFFD as a split-multibyte sentinel.
+                if out:
+                    parts.append(out.decode("utf-8", errors="replace"))
+                    out = bytearray()
+                parts.append(f"<unk:{i}>")
         if out:
             parts.append(out.decode("utf-8", errors="replace"))
         return "".join(parts)

@@ -236,3 +236,20 @@ async def test_sampling_with_temperature_varies():
         assert t1 != t2  # different seeds explore differently
     finally:
         engine.stop()
+
+
+async def test_pallas_decode_path_equivalence():
+    """Engine with the Pallas decode kernel (interpreted on CPU) produces the
+    same greedy tokens as the pure-JAX attention path."""
+    prompt = list(range(40, 60))
+    e1 = tiny_engine(use_pallas=False)
+    try:
+        ref, _ = await run_req(e1, greedy_req("a", prompt))
+    finally:
+        e1.stop()
+    e2 = tiny_engine(use_pallas=True)
+    try:
+        got, _ = await run_req(e2, greedy_req("b", prompt))
+    finally:
+        e2.stop()
+    assert got == ref
