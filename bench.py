@@ -38,6 +38,7 @@ from dynamo_tpu.runtime.engine import Context  # noqa: E402
 BATCH = int(os.environ.get("BENCH_BATCH", "8"))
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "256"))
 DECODE_TOKENS = int(os.environ.get("BENCH_DECODE", "128"))
+DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
 WARMUP_TOKENS = 16
 
 
@@ -75,6 +76,7 @@ async def run_bench() -> dict:
         prefill_buckets=tuple(
             b for b in (256, 512, 1024, 2048, 4096, 8192) if b < ctx
         ) + (ctx,),
+        decode_steps=DECODE_STEPS,
     )
     engine = TpuEngine(cfg)
 

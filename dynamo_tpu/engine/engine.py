@@ -68,6 +68,11 @@ class TpuEngineConfig:
     # on the TPU backend (28x over the pure-JAX gather path on v5e), force
     # with True/False (tests run it via the interpreter on CPU)
     use_pallas: Optional[bool] = None
+    # decode horizon: run this many decode iterations inside one XLA program
+    # (lax.scan, sampled tokens fed back device-side) so per-dispatch launch
+    # latency amortizes over N tokens. Stop conditions are applied host-side
+    # post-hoc (at most N-1 speculatively-decoded tokens are discarded).
+    decode_steps: int = 8
 
     def __post_init__(self):
         bad = [b for b in self.prefill_buckets if b % self.block_size]
@@ -99,6 +104,20 @@ class _Seq:
     cached_tokens: int = 0
     sealed_upto: int = 0                  # how many blocks committed to cache
     done: bool = False
+
+
+@dataclasses.dataclass
+class _Chain:
+    """An in-flight multi-step decode dispatch: packed [2, N, B] results not
+    yet fetched, the device-side carry for dispatching the next horizon
+    without a host round-trip, and the per-slot sequence snapshot taken at
+    dispatch time (results must never be applied to a sequence admitted into
+    a recycled slot afterwards)."""
+    packed: jax.Array
+    tokens: jax.Array
+    seq_lens: jax.Array
+    steps: jax.Array
+    seqs: List[Optional["_Seq"]] = dataclasses.field(default_factory=list)
 
 
 class TpuEngine:
@@ -144,9 +163,18 @@ class TpuEngine:
         self._seeds = np.zeros(B, np.uint32)
 
         self._waiting: List[_Seq] = []
+        # chained decode: in-flight horizon (packed results + device carry)
+        self._chain: Optional[_Chain] = None
+        # device-resident copies of slot arrays, re-uploaded only when the
+        # host copy changes (host<->device RPCs are the bottleneck on
+        # tunneled TPUs: ~100ms per transfer vs ~0.03ms per dispatch)
+        self._dev_cache: Dict[str, jax.Array] = {}
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-step")
+        self._offload_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-offload"
+        )
         # disaggregation: KV transfer in/out (engine/transfer.py)
         self.transfer_address: Optional[str] = None
         self._transfer_server = None
@@ -283,8 +311,65 @@ class TpuEngine:
             lps = logprobs_of(logits, toks)
             return k_caches, v_caches, toks, lps
 
+        def decode_multi(params, k_caches, v_caches, tokens, seq_lens,
+                         block_tables, active, seeds, steps0, temps, top_ks,
+                         top_ps):
+            """cfg.decode_steps decode iterations in one program: each step
+            writes the fed token's KV, attends, samples, and feeds the sample
+            back — tokens only reach the host once per horizon. seq_lens==0
+            slots (inactive) write to scratch block 0 and are discarded.
+
+            Returns the sampled (token, logprob) pairs packed into ONE f32
+            array [2, N, B] (token ids are exact in f32 below 2^24) so the
+            host pays a single device->host fetch per horizon, plus the
+            device-resident carry (tokens/seq_lens/steps) that lets the loop
+            dispatch the next horizon without any host round-trip."""
+            bs = cfg.block_size
+
+            def one_step(carry, s):
+                k_caches, v_caches, tokens, seq_lens = carry
+                positions = jnp.maximum(seq_lens - 1, 0)
+                write_blocks = jnp.where(
+                    active,
+                    jnp.take_along_axis(
+                        block_tables, (positions // bs)[:, None], axis=1
+                    )[:, 0],
+                    0,
+                )
+                write_offsets = jnp.where(active, positions % bs, 0)
+
+                def attend(q, k_new, v_new, layer_idx):
+                    kc, vc = att.write_decode_kv(
+                        k_caches[layer_idx], v_caches[layer_idx],
+                        k_new[:, 0], v_new[:, 0], write_blocks, write_offsets,
+                    )
+                    k_caches[layer_idx], v_caches[layer_idx] = kc, vc
+                    out = paged_attention(q[:, 0], kc, vc, block_tables, seq_lens)
+                    return out[:, None]
+
+                hidden = llama.forward(
+                    params, mcfg, tokens[:, None], positions[:, None], attend
+                )
+                logits = llama.lm_logits(params, mcfg, hidden[:, 0])
+                toks = sample_tokens(logits, seeds, steps0 + s, temps, top_ks, top_ps)
+                lps = logprobs_of(logits, toks)
+                seq_lens = seq_lens + active.astype(jnp.int32)
+                return (k_caches, v_caches, toks, seq_lens), (toks, lps)
+
+            (k_caches, v_caches, tokens, seq_lens), (toks_seq, lps_seq) = (
+                jax.lax.scan(
+                    one_step,
+                    (k_caches, v_caches, tokens, seq_lens),
+                    jnp.arange(cfg.decode_steps),
+                )
+            )
+            packed = jnp.stack([toks_seq.astype(jnp.float32), lps_seq])
+            next_steps = steps0 + jnp.where(active, cfg.decode_steps, 0)
+            return k_caches, v_caches, packed, tokens, seq_lens, next_steps
+
         self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2))
         self._decode_fn = jax.jit(decode, donate_argnums=(1, 2))
+        self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2))
 
     # ---------------------------------------------------------------- serving
     async def generate(
@@ -358,17 +443,27 @@ class TpuEngine:
         self._executor.shutdown(wait=False)
 
     # ------------------------------------------------------- kvbm offload/onboard
-    def _offload_blocks(self, pending: List[Tuple[int, int]]) -> None:
-        """Executor thread: copy sealed device pages to the host tier.
-        Best-effort cache write-through: failures are logged, never fatal."""
-        if self.kvbm is None or not pending:
-            return
+    def _enqueue_offload_gather(self, pending: List[Tuple[int, int]]):
+        """Event-loop thread: ENQUEUE the device-side page gathers for sealed
+        blocks immediately (cheap async dispatch). Enqueue order is what
+        guarantees the gather reads the pages before any later-dispatched
+        decode/prefill can rewrite them after LRU eviction — the host fetch
+        itself can then run lazily on the offload thread."""
+        ids = jnp.asarray(np.asarray([bid for bid, _ in pending], np.int32))
+        gathered = []
+        for kc, vc in zip(self.k_caches, self.v_caches):
+            gathered.append((kc[ids], vc[ids]))  # [n, bs, kvh, d] each
+        return gathered
+
+    def _offload_fetch(self, pending: List[Tuple[int, int]], gathered) -> None:
+        """Offload thread: fetch the already-gathered pages and store to the
+        host tier. Best-effort cache write-through: failures are logged,
+        never fatal."""
         try:
-            ids = jnp.asarray(np.asarray([bid for bid, _ in pending], np.int32))
             layers = []
-            for kc, vc in zip(self.k_caches, self.v_caches):
-                k = np.asarray(kc[ids], np.float32)  # [n, bs, kvh, d]
-                v = np.asarray(vc[ids], np.float32)
+            for k_dev, v_dev in gathered:
+                k = np.asarray(k_dev, np.float32)
+                v = np.asarray(v_dev, np.float32)
                 layers.append(np.stack([k, v], axis=1))  # [n, 2, bs, kvh, d]
             arr = np.stack(layers, axis=1)               # [n, L, 2, bs, kvh, d]
             for i, (_, h) in enumerate(pending):
@@ -433,6 +528,7 @@ class TpuEngine:
         try:
             while True:
                 if not self._waiting and all(s is None for s in self._slots):
+                    self._chain = None  # all snapshot seqs are done by now
                     self._wake.clear()
                     await self._wake.wait()
                 self._admit_cancelled()
@@ -443,17 +539,54 @@ class TpuEngine:
                     )
                     for rst, tok, lp in results:
                         self._accept_token(rst, tok, lp)
-                if any(s is not None and not s.done for s in self._slots):
-                    results = await loop.run_in_executor(self._executor, self._run_decode)
-                    for rst, tok, lp in results:
-                        self._accept_token(rst, tok, lp)
+                has_active = any(
+                    s is not None and not s.done for s in self._slots
+                )
+                if self._chain is not None:
+                    # speculatively enqueue the next horizon BEFORE fetching
+                    # this one's results: the ~100ms readback RPC overlaps
+                    # the next horizon's device compute. Dispatch runs on the
+                    # executor: the first call jit-compiles (30-90s cold) and
+                    # must not stall the event loop's lease heartbeats.
+                    chain = self._chain
+                    next_chain = None
+                    if (
+                        has_active
+                        and not self._waiting
+                        and self._can_chain(chain)
+                        and self._prepare_horizon(depth=2)
+                    ):
+                        next_chain = await loop.run_in_executor(
+                            self._executor, self._dispatch_horizon, chain
+                        )
+                    self._chain = next_chain
+                    packed = await loop.run_in_executor(
+                        self._executor, np.asarray, chain.packed
+                    )
+                    self._apply_packed(chain, packed)
+                elif has_active:
+                    if self._prepare_horizon(depth=1):
+                        self._chain = await loop.run_in_executor(
+                            self._executor, self._dispatch_horizon, None
+                        )
+                    else:
+                        results = await loop.run_in_executor(
+                            self._executor, self._run_decode
+                        )
+                        for rst, tok, lp in results:
+                            self._accept_token(rst, tok, lp)
                 self._reap_finished()
-                if self._offload_pending:
+                if self._offload_pending and self.kvbm is not None:
                     pending, self._offload_pending = self._offload_pending, []
-                    # fire-and-forget: the single-thread executor orders this
-                    # gather before any later step that could rewrite the
-                    # pages, and decode never waits on the host copy
-                    self._executor.submit(self._offload_blocks, pending)
+                    # gather ENQUEUE happens here on the loop thread, in
+                    # program order before any later horizon dispatch that
+                    # could evict+rewrite the pages; only the host fetch is
+                    # fire-and-forget (on its own thread so it never delays
+                    # the decode executor)
+                    gathered = self._enqueue_offload_gather(pending)
+                    self._offload_executor.submit(
+                        self._offload_fetch, pending, gathered
+                    )
                 await self._publish_events()
                 await asyncio.sleep(0)
         except asyncio.CancelledError:
@@ -470,6 +603,7 @@ class TpuEngine:
             self._waiting = []
             self._slots = [None] * self.cfg.max_batch_size
             self._seq_lens[:] = 0
+            self._chain = None
 
     def _admit_cancelled(self) -> None:
         keep = []
@@ -589,6 +723,126 @@ class TpuEngine:
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
         )
         return [(st, int(tok), float(lp))]
+
+    def _prepare_horizon(self, depth: int = 1) -> bool:
+        """Pre-allocate pages so every active sequence can absorb ``depth``
+        more decode horizons (depth=2 when dispatching on top of an in-flight
+        chain). False => fall back to the single-step program (block pressure
+        or a sequence within a horizon of max_context)."""
+        n = self.cfg.decode_steps
+        if n <= 1:
+            return False
+        bs = self.cfg.block_size
+        granted: List[Tuple[_Seq, int]] = []  # rollback on partial failure
+        ok = True
+        for st in self._slots:
+            if st is None or st.done:
+                continue
+            L = len(st.seq)
+            if L + depth * n >= self.cfg.max_context:
+                ok = False
+                break
+            needed = (L + depth * n) // bs + 1
+            extra = needed - len(st.block_ids)
+            if extra > 0:
+                if not self.allocator.can_allocate(extra):
+                    ok = False
+                    break
+                try:
+                    new_ids = self.allocator.allocate(extra)
+                except OutOfBlocks:
+                    ok = False
+                    break
+                for bid in new_ids:
+                    st.block_ids.append(bid)
+                    self._block_tables[st.slot, len(st.block_ids) - 1] = bid
+                granted.append((st, len(new_ids)))
+        if not ok:
+            # under pressure: give back what this call took, or the fallback
+            # path itself starves (the blocks would sit idle until finish)
+            for st, count in granted:
+                taken = st.block_ids[-count:]
+                del st.block_ids[-count:]
+                self.allocator.release(taken)
+            return False
+        return True
+
+    def _dev(self, name: str, host_arr: np.ndarray) -> jax.Array:
+        """Device-resident copy of a slot array, re-uploaded only on change
+        (host<->device transfers are ~100ms RPCs on tunneled TPUs)."""
+        cached = self._dev_cache.get(name)
+        if cached is None or not np.array_equal(
+            self._dev_cache.get(name + "/host"), host_arr
+        ):
+            self._dev_cache[name] = jnp.asarray(host_arr)
+            self._dev_cache[name + "/host"] = host_arr.copy()
+        return self._dev_cache[name]
+
+    def _dispatch_horizon(self, chain: Optional[_Chain]) -> _Chain:
+        """Enqueue one multi-step decode. With ``chain`` given, the carry
+        (tokens/seq_lens/steps) comes straight from the in-flight dispatch —
+        no host round-trip; otherwise it is synced up from host state."""
+        B = self.cfg.max_batch_size
+        active = np.zeros(B, bool)
+        for i, st in enumerate(self._slots):
+            if st is not None and not st.done:
+                active[i] = True
+        if chain is not None:
+            tokens, seq_lens, steps = chain.tokens, chain.seq_lens, chain.steps
+        else:
+            seq_lens_np = np.zeros(B, np.int32)
+            steps_np = np.zeros(B, np.int32)
+            for i, st in enumerate(self._slots):
+                if st is None or st.done:
+                    continue
+                seq_lens_np[i] = len(st.seq)
+                steps_np[i] = st.produced
+                self._tokens[i] = st.last_token
+            tokens = jnp.asarray(self._tokens)
+            seq_lens = jnp.asarray(seq_lens_np)
+            steps = jnp.asarray(steps_np)
+
+        (self.k_caches, self.v_caches, packed, tokens, seq_lens, steps) = (
+            self._decode_multi_fn(
+                self.params, self.k_caches, self.v_caches,
+                tokens, seq_lens,
+                self._dev("tables", self._block_tables),
+                self._dev("active", active),
+                self._dev("seeds", self._seeds),
+                steps,
+                self._dev("temps", self._temps),
+                self._dev("top_ks", self._top_ks),
+                self._dev("top_ps", self._top_ps),
+            )
+        )
+        seqs = [
+            st if (st is not None and not st.done) else None
+            for st in self._slots
+        ]
+        return _Chain(packed, tokens, seq_lens, steps, seqs)
+
+    def _can_chain(self, chain: _Chain) -> bool:
+        """A new horizon may ride on ``chain``'s device carry only if every
+        currently-active slot holds the same sequence it held at dispatch —
+        an admission into a recycled slot would decode from a stale carry."""
+        for i, st in enumerate(self._slots):
+            if st is not None and not st.done and chain.seqs[i] is not st:
+                return False
+        return True
+
+    def _apply_packed(self, chain: _Chain, packed_np: np.ndarray) -> None:
+        """Apply one consumed horizon [2, N, B]: feed each snapshot slot's
+        tokens through stop handling in order; the speculated tail past a
+        finish is discarded."""
+        toks = packed_np[0].astype(np.int32)
+        lps = packed_np[1]
+        for i, st in enumerate(chain.seqs):
+            if st is None or st.done:
+                continue
+            for s in range(toks.shape[0]):
+                if st.done:
+                    break
+                self._accept_token(st, int(toks[s, i]), float(lps[s, i]))
 
     def _run_decode(self) -> List[Tuple[_Seq, int, float]]:
         bs = self.cfg.block_size

@@ -253,3 +253,37 @@ async def test_pallas_decode_path_equivalence():
     finally:
         e2.stop()
     assert got == ref
+
+
+async def test_multi_step_decode_equivalence():
+    """decode_steps>1 (horizon scan) must produce exactly the single-step
+    token stream: same stateless (seed, step) sampling, same stop handling."""
+    prompt = list(range(10, 30))
+    e1 = tiny_engine(decode_steps=1)
+    try:
+        ref, _ = await run_req(e1, greedy_req("a", prompt, max_tokens=13))
+    finally:
+        e1.stop()
+    e2 = tiny_engine(decode_steps=4)  # 13 tokens: not a horizon multiple
+    try:
+        got, _ = await run_req(e2, greedy_req("b", prompt, max_tokens=13))
+    finally:
+        e2.stop()
+    assert len(ref) == 13
+    assert got == ref
+
+
+async def test_multi_step_stop_token_mid_horizon():
+    """A stop token sampled mid-horizon trims the speculated tail."""
+    engine = tiny_engine(decode_steps=8)
+    try:
+        prompt = list(range(30, 50))
+        # run once to learn the greedy stream, then stop on its 3rd token
+        probe, _ = await run_req(engine, greedy_req("p", prompt, max_tokens=8))
+        stop_tok = probe[2]
+        req = greedy_req("s", prompt, max_tokens=8)
+        req.stop.stop_token_ids = [stop_tok]
+        toks, _ = await run_req(engine, req)
+        assert toks == probe[:2]  # stop token itself is not emitted
+    finally:
+        engine.stop()
