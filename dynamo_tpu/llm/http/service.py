@@ -23,6 +23,7 @@ from ...runtime import metrics as M
 from ...runtime.engine import Context
 from ...runtime.logging import get_logger
 from ...runtime.request_plane.tcp import NoResponders
+from ...parsers import get_reasoning_parser, get_tool_parser
 from ..discovery import ModelManager, ModelPipeline
 from ..protocols.common import BackendOutput, PreprocessedRequest
 from ..protocols.delta import (
@@ -48,6 +49,16 @@ SSE_HEADERS = {
 }
 
 _DISCONNECT = (ConnectionResetError, ClientConnectionResetError)
+
+
+def _safe_parser(factory, name):
+    """A bad parser name on a model card must degrade to pass-through, not
+    turn every chat request into a 500."""
+    try:
+        return factory(name)
+    except ValueError:
+        log.warning("unknown parser %r on model card; passing text through", name)
+        return None
 
 
 def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
@@ -261,10 +272,21 @@ class HttpService:
             return _error(400, str(e), "context_length_exceeded")
 
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
-        gen = ChatDeltaGenerator(preq.request_id, req.model, include_usage)
+        card = pipeline.card
+        gen = ChatDeltaGenerator(
+            preq.request_id, req.model, include_usage,
+            reasoning_parser=_safe_parser(get_reasoning_parser, card.reasoning_parser),
+            tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
+        )
         return await self._run(
             request, preq, pipeline, req.model, req.stream, gen,
-            lambda s: aggregate_chat(preq.request_id, req.model, s),
+            lambda s: aggregate_chat(
+                preq.request_id, req.model, s,
+                reasoning_parser=_safe_parser(
+                    get_reasoning_parser, card.reasoning_parser
+                ),
+                tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
+            ),
         )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:

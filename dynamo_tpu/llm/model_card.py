@@ -63,6 +63,10 @@ class ModelDeploymentCard:
     context_length: int = 8192
     kv_block_size: int = 16
     migration_limit: int = 0
+    # streaming output parsers (dynamo_tpu/parsers registry names); None
+    # passes raw text through (reference: parser selection in lib/parsers)
+    reasoning_parser: Optional[str] = None
+    tool_parser: Optional[str] = None
     runtime_config: ModelRuntimeConfig = dataclasses.field(default_factory=ModelRuntimeConfig)
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 

@@ -24,7 +24,14 @@ from .openai import (
 
 
 class ChatDeltaGenerator:
-    def __init__(self, request_id: str, model: str, include_usage: bool = False):
+    def __init__(
+        self,
+        request_id: str,
+        model: str,
+        include_usage: bool = False,
+        reasoning_parser=None,
+        tool_parser=None,
+    ):
         self.id = request_id
         self.model = model
         self.created = now_ts()
@@ -33,6 +40,9 @@ class ChatDeltaGenerator:
         self.completion_tokens = 0
         self.cached_tokens: Optional[int] = None
         self._first = True
+        self.reasoning_parser = reasoning_parser
+        self.tool_parser = tool_parser
+        self._tool_call_count = 0
 
     def _chunk(self, delta: ChatDelta, finish: Optional[str] = None) -> ChatCompletionChunk:
         return ChatCompletionChunk(
@@ -41,6 +51,31 @@ class ChatDeltaGenerator:
             model=self.model,
             choices=[ChatChunkChoice(index=0, delta=delta, finish_reason=finish)],
         )
+
+    def _parse(self, text: str, flush: bool = False):
+        """Pipe raw text through the reasoning then tool parsers; returns
+        (content, reasoning, tool_calls). Tool markers never appear inside
+        reasoning spans, so reasoning splits first."""
+        reasoning = ""
+        if self.reasoning_parser is not None:
+            ev = self.reasoning_parser.feed(text)
+            if flush:
+                fin = self.reasoning_parser.flush()
+                ev.content += fin.content
+                ev.reasoning += fin.reasoning
+            text, reasoning = ev.content, ev.reasoning
+        tool_calls = []
+        if self.tool_parser is not None:
+            tev = self.tool_parser.feed(text)
+            if flush:
+                fin = self.tool_parser.flush()
+                tev.content += fin.content
+                tev.tool_calls.extend(fin.tool_calls)
+            text, tool_calls = tev.content, tev.tool_calls
+        for tc in tool_calls:
+            tc["index"] = self._tool_call_count
+            self._tool_call_count += 1
+        return text, reasoning, tool_calls
 
     def on_output(self, out: BackendOutput):
         """Yields zero or more chunks for one backend step."""
@@ -53,10 +88,19 @@ class ChatDeltaGenerator:
         if self._first:
             self._first = False
             chunks.append(self._chunk(ChatDelta(role="assistant", content="")))
-        if out.text:
-            chunks.append(self._chunk(ChatDelta(content=out.text)))
-        if out.finish_reason is not None:
-            chunks.append(self._chunk(ChatDelta(), finish=out.finish_reason))
+        finished = out.finish_reason is not None
+        content, reasoning, tool_calls = self._parse(out.text or "", flush=finished)
+        if reasoning:
+            chunks.append(self._chunk(ChatDelta(reasoning_content=reasoning)))
+        if content:
+            chunks.append(self._chunk(ChatDelta(content=content)))
+        if tool_calls:
+            chunks.append(self._chunk(ChatDelta(tool_calls=tool_calls)))
+        if finished:
+            finish = out.finish_reason
+            if self._tool_call_count and finish == "stop":
+                finish = "tool_calls"
+            chunks.append(self._chunk(ChatDelta(), finish=finish))
             if self.include_usage:
                 usage_chunk = ChatCompletionChunk(
                     id=self.id, created=self.created, model=self.model, choices=[],
@@ -75,18 +119,32 @@ class ChatDeltaGenerator:
 
 
 async def aggregate_chat(
-    request_id: str, model: str, stream: AsyncIterator[BackendOutput]
+    request_id: str,
+    model: str,
+    stream: AsyncIterator[BackendOutput],
+    reasoning_parser=None,
+    tool_parser=None,
 ) -> ChatCompletionResponse:
     """Non-streaming mode: fold the whole stream into one response."""
-    gen = ChatDeltaGenerator(request_id, model)
+    gen = ChatDeltaGenerator(
+        request_id, model,
+        reasoning_parser=reasoning_parser, tool_parser=tool_parser,
+    )
     text_parts = []
+    reasoning_parts = []
+    tool_calls = []
     finish = None
     async for out in stream:
-        gen.on_output(out)
-        if out.text:
-            text_parts.append(out.text)
-        if out.finish_reason is not None:
-            finish = out.finish_reason
+        for chunk in gen.on_output(out):
+            for choice in chunk.choices:
+                if choice.delta.content:
+                    text_parts.append(choice.delta.content)
+                if choice.delta.reasoning_content:
+                    reasoning_parts.append(choice.delta.reasoning_content)
+                if choice.delta.tool_calls:
+                    tool_calls.extend(choice.delta.tool_calls)
+                if choice.finish_reason is not None:
+                    finish = choice.finish_reason
     return ChatCompletionResponse(
         id=request_id,
         created=gen.created,
@@ -94,7 +152,14 @@ async def aggregate_chat(
         choices=[
             ChatChoice(
                 index=0,
-                message=ChatResponseMessage(content="".join(text_parts)),
+                message=ChatResponseMessage(
+                    content="".join(text_parts),
+                    reasoning_content="".join(reasoning_parts) or None,
+                    tool_calls=[
+                        {k: v for k, v in tc.items() if k != "index"}
+                        for tc in tool_calls
+                    ] or None,
+                ),
                 finish_reason=finish or "stop",
             )
         ],

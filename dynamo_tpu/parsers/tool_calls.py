@@ -1,0 +1,219 @@
+"""Streaming tool-call parsers.
+
+Analog of the reference's tool_calling parsers (lib/parsers/src/tool_calling/:
+json, pythonic, xml/dsml, harmony). Each parser consumes text deltas, passes
+non-tool content through (with minimal hold-back while a marker prefix is
+possible), and emits complete OpenAI-shape tool calls:
+
+    {"id": "call_<n>", "type": "function",
+     "function": {"name": str, "arguments": json-string}}
+
+Completed calls are emitted as soon as their closing marker parses (streamed
+per-call, like the reference jail releasing a held tool call).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .jail import split_safe
+
+
+@dataclasses.dataclass
+class ToolEvent:
+    content: str = ""
+    tool_calls: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+
+def _mk_call(name: str, arguments: Any) -> Dict[str, Any]:
+    if not isinstance(arguments, str):
+        arguments = json.dumps(arguments)
+    return {
+        "id": f"call_{uuid.uuid4().hex[:24]}",
+        "type": "function",
+        "function": {"name": name, "arguments": arguments},
+    }
+
+
+class _TagToolParser:
+    """Shared machinery for parsers whose tool calls sit between an open and
+    a close tag; subclasses parse the captured body."""
+
+    open_tag = ""
+    close_tag = ""
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._in_call = False
+
+    def _parse_body(self, body: str) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def feed(self, text: str) -> ToolEvent:
+        self._buf += text
+        ev = ToolEvent()
+        while True:
+            if not self._in_call:
+                idx = self._buf.find(self.open_tag)
+                if idx >= 0:
+                    ev.content += self._buf[:idx]
+                    self._buf = self._buf[idx + len(self.open_tag):]
+                    self._in_call = True
+                    continue
+                safe, held = split_safe(self._buf, [self.open_tag])
+                ev.content += safe
+                self._buf = held
+                return ev
+            idx = self._buf.find(self.close_tag)
+            if idx < 0:
+                return ev  # wait for the close tag
+            body = self._buf[:idx]
+            self._buf = self._buf[idx + len(self.close_tag):]
+            self._in_call = False
+            try:
+                ev.tool_calls.extend(self._parse_body(body))
+            except Exception:
+                # malformed call: surface the raw text instead of dropping it
+                ev.content += self.open_tag + body + self.close_tag
+            # swallow a single newline separating consecutive tool calls
+            if self._buf.startswith("\n"):
+                self._buf = self._buf[1:]
+
+    def flush(self) -> ToolEvent:
+        held, self._buf = self._buf, ""
+        if self._in_call:
+            self._in_call = False
+            return ToolEvent(content=self.open_tag + held)
+        return ToolEvent(content=held)
+
+
+class JsonToolParser(_TagToolParser):
+    """Hermes/Qwen style: <tool_call>{"name": ..., "arguments": {...}}</tool_call>."""
+
+    open_tag = "<tool_call>"
+    close_tag = "</tool_call>"
+
+    def _parse_body(self, body: str) -> List[Dict[str, Any]]:
+        obj = json.loads(body)
+        calls = obj if isinstance(obj, list) else [obj]
+        out = []
+        for c in calls:
+            out.append(
+                _mk_call(c["name"], c.get("arguments", c.get("parameters", {})))
+            )
+        return out
+
+
+class XmlToolParser(_TagToolParser):
+    """<function=name><parameter=key>value</parameter>...</function> style
+    (reference: tool_calling/dsml + xml parsers)."""
+
+    open_tag = "<function="
+    close_tag = "</function>"
+    _param_re = re.compile(
+        r"<parameter=([^>]+)>(.*?)</parameter>", re.DOTALL
+    )
+
+    def _parse_body(self, body: str) -> List[Dict[str, Any]]:
+        name, sep, rest = body.partition(">")
+        if not sep:
+            raise ValueError("unterminated function tag")
+        args = {}
+        for key, value in self._param_re.findall(rest):
+            value = value.strip()
+            try:
+                args[key] = json.loads(value)
+            except Exception:
+                args[key] = value
+        return [_mk_call(name.strip(), args)]
+
+
+class PythonicToolParser:
+    """Llama-3.x pythonic style: the whole message is a list of calls, e.g.
+    ``[get_weather(city="SF"), search(q="tpu", k=3)]``. Nothing can stream
+    until the closing bracket; a message that does not look like a call list
+    streams through untouched."""
+
+    _head_re = re.compile(r"^\s*\[\s*[A-Za-z_][\w.]*\s*\(")
+
+    def __init__(self) -> None:
+        self._buf = ""
+        self._decided: Optional[bool] = None  # None = still sniffing
+
+    def feed(self, text: str) -> ToolEvent:
+        self._buf += text
+        if self._decided is None:
+            if self._head_re.match(self._buf):
+                self._decided = True
+            elif len(self._buf) > 64 or (
+                self._buf.strip() and not "[".startswith(self._buf.strip()[:1])
+            ):
+                self._decided = False
+        if self._decided is False:
+            out, self._buf = self._buf, ""
+            return ToolEvent(content=out)
+        if self._decided is True:
+            calls = self._try_parse(self._buf)
+            if calls is not None:
+                self._buf = ""
+                self._decided = None
+                return ToolEvent(tool_calls=calls)
+        return ToolEvent()
+
+    def _try_parse(self, text: str) -> Optional[List[Dict[str, Any]]]:
+        try:
+            tree = ast.parse(text.strip(), mode="eval")
+        except SyntaxError:
+            return None
+        if not isinstance(tree.body, ast.List):
+            return None
+        calls = []
+        for node in tree.body.elts:
+            if not isinstance(node, ast.Call):
+                return None
+            if node.args:
+                # positional args can't be mapped to names without the tool
+                # schema — fall back to raw text rather than dropping them
+                return None
+            name = ast.unparse(node.func)
+            args: Dict[str, Any] = {}
+            for kw in node.keywords:
+                if kw.arg is None:
+                    return None
+                try:
+                    args[kw.arg] = ast.literal_eval(kw.value)
+                except Exception:
+                    args[kw.arg] = ast.unparse(kw.value)
+            calls.append(_mk_call(name, args))
+        return calls
+
+    def flush(self) -> ToolEvent:
+        held, self._buf = self._buf, ""
+        self._decided = None
+        return ToolEvent(content=held)
+
+
+_REGISTRY = {
+    "json": JsonToolParser,
+    "hermes": JsonToolParser,
+    "qwen": JsonToolParser,
+    "pythonic": PythonicToolParser,
+    "xml": XmlToolParser,
+    "dsml": XmlToolParser,
+}
+
+
+def get_tool_parser(name: Optional[str]):
+    if not name or name == "none":
+        return None
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown tool parser {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None

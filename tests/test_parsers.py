@@ -1,0 +1,245 @@
+"""Streaming parser tests: reasoning split, tool calls, jail hold-back.
+
+Every parser is exercised with adversarial chunking (1-char deltas) to prove
+incremental correctness — the reference tests its parsers the same way
+(lib/parsers tests + lib/llm/tests/test_jail.rs)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.parsers import (
+    HoldBack,
+    JsonToolParser,
+    PythonicToolParser,
+    ReasoningParser,
+    XmlToolParser,
+    get_reasoning_parser,
+    get_tool_parser,
+    split_safe,
+)
+
+
+def chunked(text, n):
+    return [text[i:i + n] for i in range(0, len(text), n)]
+
+
+def run_reasoning(parser, chunks):
+    content, reasoning = "", ""
+    for c in chunks:
+        ev = parser.feed(c)
+        content += ev.content
+        reasoning += ev.reasoning
+    fin = parser.flush()
+    return content + fin.content, reasoning + fin.reasoning
+
+
+def run_tools(parser, chunks):
+    content, calls = "", []
+    for c in chunks:
+        ev = parser.feed(c)
+        content += ev.content
+        calls.extend(ev.tool_calls)
+    fin = parser.flush()
+    return content + fin.content, calls + fin.tool_calls
+
+
+# ---------------------------------------------------------------- jail
+class TestHoldBack:
+    def test_split_safe(self):
+        assert split_safe("hello <th", ["<think>"]) == ("hello ", "<th")
+        assert split_safe("hello", ["<think>"]) == ("hello", "")
+        assert split_safe("<", ["<think>"]) == ("", "<")
+
+    def test_feed_flush(self):
+        hb = HoldBack(["STOP"])
+        assert hb.feed("abc ST") == "abc "
+        assert hb.feed("x") == "STx"  # "ST" turned out not to be STOP
+        assert hb.feed(" STO") == " "
+        assert hb.flush() == "STO"
+
+    def test_marker_never_leaks_early(self):
+        hb = HoldBack(["<|eot|>"])
+        out = ""
+        for c in "hi <|eo and more <|eot".split():
+            out += hb.feed(c)
+        assert "<|eot" not in out
+
+
+# ---------------------------------------------------------------- reasoning
+class TestReasoning:
+    @pytest.mark.parametrize("n", [1, 3, 1000])
+    def test_think_tags(self, n):
+        text = "<think>step by step</think>The answer is 4."
+        c, r = run_reasoning(ReasoningParser(), chunked(text, n))
+        assert r == "step by step"
+        assert c == "The answer is 4."
+
+    @pytest.mark.parametrize("n", [1, 5])
+    def test_forced_reasoning_no_open_tag(self, n):
+        text = "thinking hard</think>done"
+        p = ReasoningParser(force_reasoning=True)
+        c, r = run_reasoning(p, chunked(text, n))
+        assert r == "thinking hard"
+        assert c == "done"
+
+    def test_unclosed_reasoning_flushes_as_reasoning(self):
+        p = ReasoningParser(force_reasoning=True)
+        c, r = run_reasoning(p, ["still thinking when stream ends"])
+        assert r == "still thinking when stream ends"
+        assert c == ""
+
+    def test_no_tags_passthrough(self):
+        c, r = run_reasoning(ReasoningParser(), ["plain response"])
+        assert c == "plain response"
+        assert r == ""
+
+    def test_registry(self):
+        assert get_reasoning_parser(None) is None
+        assert get_reasoning_parser("deepseek_r1")._state == "reasoning"
+        with pytest.raises(ValueError):
+            get_reasoning_parser("nope")
+
+
+# ---------------------------------------------------------------- tool calls
+class TestJsonTools:
+    @pytest.mark.parametrize("n", [1, 7, 1000])
+    def test_single_call(self, n):
+        text = 'Sure. <tool_call>{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call>'
+        c, calls = run_tools(JsonToolParser(), chunked(text, n))
+        assert c == "Sure. "
+        assert len(calls) == 1
+        assert calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "SF"}
+        assert calls[0]["id"].startswith("call_")
+
+    def test_multiple_calls(self):
+        text = (
+            '<tool_call>{"name": "a", "arguments": {}}</tool_call>\n'
+            '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>'
+        )
+        c, calls = run_tools(JsonToolParser(), chunked(text, 9))
+        assert [x["function"]["name"] for x in calls] == ["a", "b"]
+        assert c == ""
+
+    def test_malformed_json_surfaces_raw(self):
+        text = "<tool_call>{broken</tool_call>after"
+        c, calls = run_tools(JsonToolParser(), [text])
+        assert calls == []
+        assert "{broken" in c and "after" in c
+
+    def test_unclosed_call_flushes_raw(self):
+        c, calls = run_tools(JsonToolParser(), ['<tool_call>{"name": "a"'])
+        assert calls == []
+        assert c.startswith("<tool_call>")
+
+
+class TestPythonicTools:
+    @pytest.mark.parametrize("n", [1, 6, 1000])
+    def test_call_list(self, n):
+        text = '[get_weather(city="SF"), search(q="tpu", k=3)]'
+        c, calls = run_tools(PythonicToolParser(), chunked(text, n))
+        assert c == ""
+        assert [x["function"]["name"] for x in calls] == ["get_weather", "search"]
+        assert json.loads(calls[1]["function"]["arguments"]) == {"q": "tpu", "k": 3}
+
+    def test_plain_text_streams_through(self):
+        text = "The weather in SF is sunny today, around 18C."
+        c, calls = run_tools(PythonicToolParser(), chunked(text, 5))
+        assert calls == []
+        assert c == text
+
+    def test_bracket_but_not_calls(self):
+        text = "[1, 2, 3] is a list"
+        c, calls = run_tools(PythonicToolParser(), [text])
+        assert calls == []
+        assert c == text
+
+
+class TestXmlTools:
+    @pytest.mark.parametrize("n", [1, 8, 1000])
+    def test_function_params(self, n):
+        text = (
+            "<function=lookup><parameter=key>alpha</parameter>"
+            "<parameter=n>5</parameter></function>"
+        )
+        c, calls = run_tools(XmlToolParser(), chunked(text, n))
+        assert c == ""
+        assert calls[0]["function"]["name"] == "lookup"
+        assert json.loads(calls[0]["function"]["arguments"]) == {
+            "key": "alpha", "n": 5,
+        }
+
+    def test_registry(self):
+        assert type(get_tool_parser("hermes")) is JsonToolParser
+        assert type(get_tool_parser("pythonic")) is PythonicToolParser
+        assert type(get_tool_parser("dsml")) is XmlToolParser
+        assert get_tool_parser(None) is None
+        with pytest.raises(ValueError):
+            get_tool_parser("nope")
+
+
+# ------------------------------------------------- delta generator wiring
+class TestDeltaIntegration:
+    def test_chat_delta_reasoning_and_tools(self):
+        from dynamo_tpu.llm.protocols.common import BackendOutput
+        from dynamo_tpu.llm.protocols.delta import ChatDeltaGenerator
+
+        gen = ChatDeltaGenerator(
+            "r1", "m",
+            reasoning_parser=ReasoningParser(),
+            tool_parser=JsonToolParser(),
+        )
+        stream = (
+            "<think>plan</think>ok "
+            '<tool_call>{"name": "f", "arguments": {}}</tool_call>'
+        )
+        chunks = []
+        for piece in chunked(stream, 11):
+            chunks.extend(gen.on_output(BackendOutput(text=piece, cumulative_tokens=1)))
+        chunks.extend(
+            gen.on_output(BackendOutput(finish_reason="stop", cumulative_tokens=2))
+        )
+        reasoning = "".join(
+            c.choices[0].delta.reasoning_content or "" for c in chunks if c.choices
+        )
+        content = "".join(
+            c.choices[0].delta.content or "" for c in chunks if c.choices
+        )
+        calls = [
+            tc for c in chunks if c.choices
+            for tc in (c.choices[0].delta.tool_calls or [])
+        ]
+        finish = [
+            c.choices[0].finish_reason for c in chunks
+            if c.choices and c.choices[0].finish_reason
+        ]
+        assert reasoning == "plan"
+        assert content == "ok "
+        assert len(calls) == 1 and calls[0]["index"] == 0
+        assert finish == ["tool_calls"]
+
+
+class TestReviewFixes:
+    def test_pythonic_positional_args_fall_back_to_raw(self):
+        text = '[get_weather("SF")]'
+        c, calls = run_tools(PythonicToolParser(), chunked(text, 4))
+        assert calls == []
+        assert c == text  # surfaced raw, not silently dropped
+
+    @pytest.mark.parametrize("n", [1, 9, 1000])
+    def test_gpt_oss_final_channel_markers_stripped(self, n):
+        p = get_reasoning_parser("gpt_oss")
+        text = (
+            "<|channel|>analysis<|message|>plan here<|end|>"
+            "<|start|>assistant<|channel|>final<|message|>Hello!<|return|>"
+        )
+        c, r = run_reasoning(p, chunked(text, n))
+        assert r == "plan here"
+        assert c == "Hello!"
+
+    def test_bad_parser_name_degrades_to_passthrough(self):
+        from dynamo_tpu.llm.http.service import _safe_parser
+        from dynamo_tpu.parsers import get_reasoning_parser as grp
+        assert _safe_parser(grp, "definitely-not-a-parser") is None
+        assert _safe_parser(grp, None) is None
