@@ -187,7 +187,8 @@ class TpuEngine:
         from ..runtime.request_plane.tcp import TcpRequestServer
         from .transfer import KvTransferServer
 
-        srv = KvTransferServer(self)
+        srv = KvTransferServer(self, host=host)
+        self._kv_transfer_srv = srv
         self._transfer_server = TcpRequestServer(srv.handle, host=host)
         self.transfer_address = await self._transfer_server.start()
         return self.transfer_address
@@ -440,6 +441,8 @@ class TpuEngine:
             self._loop_task.cancel()
         if self._transfer_server is not None:
             asyncio.ensure_future(self._transfer_server.stop(0.5))
+        if getattr(self, "_kv_transfer_srv", None) is not None:
+            self._kv_transfer_srv.close()
         self._executor.shutdown(wait=False)
 
     # ------------------------------------------------------- kvbm offload/onboard

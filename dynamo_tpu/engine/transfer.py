@@ -17,13 +17,23 @@ disagg_serving.md:20,54). On TPU the equivalent paths are:
    TransferBackend).
 
 Wire protocol (served as a normal endpoint, "kv_fetch"):
-    request : {"hashes": [u64...], "layers": L, "dtype": str}
-    response: one item {"matched": n, "shape": [...], "data": bytes}
-              (data = np array [L, 2, n, bs, kvh, d] tobytes, C-order)
+    request : {"hashes": [u64...], "native_ok": bool}
+    response: one item, either
+      inline:  {"matched": n, "shape": [...], "data": bytes}
+               (data = np array [L, 2, n, bs, kvh, d] tobytes, C-order)
+      native:  {"matched": n, "block_shape": [L, 2, bs, kvh, d],
+                "native": {"host", "port", "region", "slots": [...]}}
+               — bulk bytes then move over the C++ agent
+               (native/transfer/agent.cpp) with raw scatter/gather TCP,
+               bypassing the Python request plane; the control message only
+               carries slot indices. Slots are leased from a staging arena
+               and freed by a follow-up {"free_slots": [...]} call (or by
+               lease expiry, so a crashed client can't pin the arena).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import jax
@@ -37,15 +47,94 @@ from ..tokens import SequenceHash
 
 log = get_logger("engine.transfer")
 
+NATIVE_REGION = 1
+SLOT_LEASE_S = 30.0
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """np.dtype('bfloat16') is only resolvable through ml_dtypes."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
 
 class KvTransferServer:
     """Serves this engine's KV pages by sequence hash."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, host: str = "127.0.0.1", arena_slots: int = 256):
         self.engine = engine  # TpuEngine (duck-typed: allocator, k/v_caches)
+        self.host = host
+        self._agent = None
+        self._arena: Optional[np.ndarray] = None
+        # slot -> (expiry, token): the token is a per-lease generation id so
+        # a late/duplicate free_slots after expiry+re-lease cannot release
+        # another client's fresh lease
+        self._slot_lease: Dict[int, Tuple[float, int]] = {}
+        self._lease_counter = 0
+        self._arena_slots = arena_slots
+        m = self.engine.mcfg
+        bs = self.engine.cfg.block_size
+        self._block_shape = [m.num_layers, 2, bs, m.num_kv_heads, m.head_dim]
+        self._arena_dtype = np.dtype(m.dtype)  # cache dtype (bf16 halves bytes)
+
+    def _ensure_native(self) -> bool:
+        """Lazy: the arena (GiB-scale for big models) and agent come up on
+        the first native-capable fetch, not at serve_transfer time."""
+        if self._agent is not None:
+            return True
+        try:
+            from ..transfer import NativeAgent, native_available
+
+            if not native_available():
+                return False
+            block_elems = int(np.prod(self._block_shape))
+            self._arena = np.zeros(
+                (self._arena_slots, block_elems), self._arena_dtype
+            )
+            self._agent = NativeAgent(host=self.host)
+            self._agent.register(
+                NATIVE_REGION, self._arena,
+                self._arena_dtype.itemsize * block_elems,
+            )
+            log.info(
+                "native transfer agent serving on %s:%d (%.0f MiB arena)",
+                self.host, self._agent.port, self._arena.nbytes / 2**20,
+            )
+            return True
+        except Exception:
+            log.exception("native transfer agent unavailable; inline payloads only")
+            self._agent = None
+            return False
+
+    def _lease_slots(self, n: int) -> Optional[Tuple[List[int], int]]:
+        now = time.monotonic()
+        free = [
+            s for s in range(self._arena_slots)
+            if self._slot_lease.get(s, (0.0, 0))[0] < now
+        ]
+        if len(free) < n:
+            return None
+        self._lease_counter += 1
+        token = self._lease_counter
+        slots = free[:n]
+        for s in slots:
+            self._slot_lease[s] = (now + SLOT_LEASE_S, token)
+        return slots, token
 
     async def handle(self, request: Any, context: Context) -> AsyncIterator[Dict]:
+        if "free_slots" in request:
+            token = request.get("token")
+            for s in request["free_slots"]:
+                lease = self._slot_lease.get(int(s))
+                if lease is not None and lease[1] == token:
+                    self._slot_lease.pop(int(s), None)
+            yield {"ok": True}
+            return
         hashes: List[SequenceHash] = list(request.get("hashes", []))
+        native_ok = bool(request.get("native_ok")) and self._ensure_native()
         alloc = self.engine.allocator
         # pin the matched prefix so eviction can't race the device gather
         block_ids = alloc.acquire_prefix(hashes)
@@ -54,10 +143,39 @@ class KvTransferServer:
             if n == 0:
                 yield {"matched": 0, "data": b"", "shape": []}
                 return
-            data, shape = await self._gather(block_ids)
-            yield {"matched": n, "data": data, "shape": shape}
+            leased = self._lease_slots(n) if native_ok else None
+            if leased is not None:
+                slots, token = leased
+                await self._gather_into_arena(block_ids, slots)
+                yield {
+                    "matched": n,
+                    "block_shape": self._block_shape,
+                    "dtype": self._arena_dtype.name,
+                    "native": {
+                        "host": self.host,
+                        "port": self._agent.port,
+                        "region": NATIVE_REGION,
+                        "slots": slots,
+                        "token": token,
+                    },
+                }
+            else:
+                data, shape = await self._gather(block_ids)
+                yield {"matched": n, "data": data, "shape": shape}
         finally:
             alloc.release(block_ids)
+
+    def _gather_np(self, block_ids: List[int], dtype=np.float32) -> np.ndarray:
+        """Executor thread: device gather -> [L, 2, n, bs, kvh, d]; dtype=None
+        keeps the cache dtype (native path; bf16 halves the wire bytes)."""
+        ids = jnp.asarray(np.asarray(block_ids, np.int32))
+        layers = []
+        for kc, vc in zip(self.engine.k_caches, self.engine.v_caches):
+            k = np.asarray(kc[ids])   # [n, bs, kvh, d]
+            v = np.asarray(vc[ids])
+            layers.append(np.stack([k, v]))  # [2, n, bs, kvh, d]
+        arr = np.stack(layers)               # [L, 2, n, bs, kvh, d]
+        return arr if dtype is None else arr.astype(dtype)
 
     async def _gather(self, block_ids: List[int]) -> Tuple[bytes, List[int]]:
         import asyncio
@@ -65,16 +183,30 @@ class KvTransferServer:
         loop = asyncio.get_event_loop()
 
         def gather():
-            ids = jnp.asarray(np.asarray(block_ids, np.int32))
-            layers = []
-            for kc, vc in zip(self.engine.k_caches, self.engine.v_caches):
-                k = np.asarray(kc[ids])   # [n, bs, kvh, d]
-                v = np.asarray(vc[ids])
-                layers.append(np.stack([k, v]))  # [2, n, bs, kvh, d]
-            arr = np.stack(layers)               # [L, 2, n, bs, kvh, d]
-            return arr.astype(np.float32).tobytes(), list(arr.shape)
+            arr = self._gather_np(block_ids)
+            return arr.tobytes(), list(arr.shape)
 
         return await loop.run_in_executor(self.engine._executor, gather)
+
+    async def _gather_into_arena(self, block_ids: List[int], slots: List[int]) -> None:
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+
+        def gather():
+            arr = self._gather_np(block_ids, dtype=None)  # [L, 2, n, ...]
+            block_major = np.moveaxis(arr, 2, 0)          # [n, L, 2, ...]
+            n = len(block_ids)
+            flat = block_major.reshape(n, -1)
+            for i, s in enumerate(slots):
+                self._arena[s] = flat[i]
+
+        await loop.run_in_executor(self.engine._executor, gather)
+
+    def close(self) -> None:
+        if self._agent is not None:
+            self._agent.close()
+            self._agent = None
 
 
 class KvTransferClient:
@@ -97,24 +229,65 @@ class KvTransferClient:
         want = hashes[have:]
         if not want:
             return have * alloc.block_size
-        stream = await self._tcp.call(address, {"hashes": [int(h) for h in want]})
-        matched = 0
-        data = b""
-        shape: List[int] = []
-        async for item in stream:
-            matched = item.get("matched", 0)
-            data = item.get("data", b"")
-            shape = item.get("shape", [])
+        from ..transfer import native_available
+
+        stream = await self._tcp.call(
+            address,
+            {"hashes": [int(h) for h in want], "native_ok": native_available()},
+        )
+        item: Dict[str, Any] = {}
+        async for it in stream:
+            item = it
+        matched = item.get("matched", 0)
         if matched == 0:
             return have * alloc.block_size
-        arr = np.frombuffer(data, np.float32).reshape(shape)
-        imported = await self._import(arr, want[:matched])
+        if "native" in item:
+            block_major = await self._native_fetch(address, item, matched)
+            if block_major is None:
+                return have * alloc.block_size
+        else:
+            arr = np.frombuffer(item.get("data", b""), np.float32).reshape(
+                item.get("shape", [])
+            )
+            block_major = np.ascontiguousarray(np.moveaxis(arr, 2, 0))
+        imported = await self.engine.import_blocks(
+            list(want[:matched]), block_major
+        )
         return (have + imported) * alloc.block_size
 
-    async def _import(self, arr: np.ndarray, hashes: List[SequenceHash]) -> int:
-        # wire layout [L, 2, n, bs, kvh, d] -> block-major [n, L, 2, ...]
-        block_major = np.ascontiguousarray(np.moveaxis(arr, 2, 0))
-        return await self.engine.import_blocks(list(hashes), block_major)
+    async def _native_fetch(
+        self, address: str, item: Dict[str, Any], matched: int
+    ) -> Optional[np.ndarray]:
+        """Bulk-fetch leased slots over the C++ agent; returns block-major
+        [n, L, 2, bs, kvh, d] float32 or None on failure (caller recomputes)."""
+        import asyncio
+
+        from ..transfer import native_fetch
+
+        nat = item["native"]
+        block_shape = item["block_shape"]
+        dtype = _dtype_from_name(item.get("dtype", "float32"))
+        block_bytes = int(np.prod(block_shape)) * dtype.itemsize
+        loop = asyncio.get_event_loop()
+        try:
+            raw = await loop.run_in_executor(
+                None, native_fetch,
+                nat["host"], nat["port"], nat["region"], nat["slots"], block_bytes,
+            )
+        except Exception:
+            log.exception("native kv fetch failed; recomputing prefill locally")
+            return None
+        finally:
+            try:
+                stream = await self._tcp.call(
+                    address,
+                    {"free_slots": nat["slots"], "token": nat.get("token")},
+                )
+                async for _ in stream:
+                    pass
+            except Exception:
+                pass  # lease expiry reclaims the slots
+        return raw.view(dtype).reshape([matched] + list(block_shape))
 
     async def close(self) -> None:
         await self._tcp.close()

@@ -1,0 +1,9 @@
+"""Native transfer plane (NIXL analog): host-staging KV block movement.
+
+C++ agent in native/transfer/agent.cpp, loaded via ctypes (the image has no
+pybind11). See ``native.py`` for the Python surface.
+"""
+
+from .native import NativeAgent, ensure_native, native_available, native_fetch
+
+__all__ = ["NativeAgent", "ensure_native", "native_available", "native_fetch"]

@@ -30,8 +30,8 @@ from dynamo_tpu.runtime import (
 from dynamo_tpu.tokens import compute_sequence_hashes
 
 
-def tiny_cfg(**kw):
-    mcfg = LlamaConfig(
+def tiny_cfg(model=None, **kw):
+    mcfg = model or LlamaConfig(
         vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
         num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
     )
@@ -165,3 +165,63 @@ async def test_disagg_falls_back_without_prefill_pool():
         engine.stop()
         await decode_rt.shutdown()
         await frontend_rt.shutdown()
+
+
+async def test_disagg_uses_native_transfer(monkeypatch):
+    """When the C++ agent is available, the KV bytes move over it (the
+    request plane only carries slot metadata), and the decode side still
+    imports rather than recomputes."""
+    import dynamo_tpu.transfer as nt
+
+    if not nt.native_available():
+        import pytest
+        pytest.skip("native toolchain unavailable")
+
+    calls = []
+    real_fetch = nt.native_fetch
+
+    def counting_fetch(*a, **kw):
+        calls.append(a)
+        return real_fetch(*a, **kw)
+
+    monkeypatch.setattr(nt, "native_fetch", counting_fetch)
+
+    # bf16 caches: the arena + wire dtype follow the cache dtype (the
+    # realistic config; exercises the ml_dtypes name round-trip)
+    bf16_model = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128,
+        dtype=jnp.bfloat16,
+    )
+    prefill = TpuEngine(tiny_cfg(model=bf16_model))
+    decode = TpuEngine(tiny_cfg(model=bf16_model))
+    try:
+        addr = await prefill.serve_transfer()
+        prompt = list(range(200, 240))  # 40 tokens = 10 blocks
+        # aggregated reference on a third engine
+        ref_engine = TpuEngine(tiny_cfg(model=bf16_model))
+        try:
+            ref = []
+            async for out in ref_engine.generate(preq("ref", prompt), Context()):
+                ref.extend(out.token_ids)
+        finally:
+            ref_engine.stop()
+
+        # prefill side: run max_tokens=1 to populate its cache
+        async for _ in prefill.generate(preq("p", prompt, max_tokens=1), Context()):
+            pass
+        hashes = [int(h) for h in compute_sequence_hashes(prompt, 4)]
+        req = preq("d", prompt)
+        req.kv_transfer = {"address": addr, "hashes": hashes}
+        toks = []
+        cached = None
+        async for out in decode.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.annotations and "cached_tokens" in out.annotations:
+                cached = out.annotations["cached_tokens"]
+        assert calls, "native transfer path was not used"
+        assert cached and cached > 0  # imported, not recomputed
+        assert toks == ref
+    finally:
+        prefill.stop()
+        decode.stop()
