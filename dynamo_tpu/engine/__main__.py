@@ -19,6 +19,7 @@ from dynamo_tpu.engine.weights import config_from_hf, load_params
 from dynamo_tpu.kv_router import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm import ModelDeploymentCard, ModelRuntimeConfig, register_llm
 from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.models.moe import MoeConfig
 from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
 from dynamo_tpu.runtime.component import new_instance_id
 
@@ -27,6 +28,8 @@ PRESETS = {
     "qwen3-0.6b": LlamaConfig.qwen3_0_6b,
     "llama3-8b": LlamaConfig.llama3_8b,
     "llama3-70b": LlamaConfig.llama3_70b,
+    "tiny-moe": MoeConfig.tiny_moe,
+    "qwen3-30b-a3b": MoeConfig.qwen3_30b_a3b,
 }
 
 

@@ -42,7 +42,7 @@ from ..llm.protocols.common import (
     BackendOutput,
     PreprocessedRequest,
 )
-from ..models import llama
+from ..models import llama, registry
 from ..ops import attention as att
 from ..parallel import mesh as meshlib
 from ..runtime.engine import Context
@@ -145,9 +145,13 @@ class TpuEngine:
         self._offload_pending: List[Tuple[int, int]] = []  # (block_id, seq_hash)
 
         # --- place params + caches on the mesh ---
+        self._forward = registry.forward_fn(self.mcfg)
+        self._lm_logits = registry.lm_logits_fn(self.mcfg)
         with self.mesh:
             if params is None:
-                params = llama.init_params(jax.random.PRNGKey(config.seed), self.mcfg)
+                params = registry.init_params(
+                    jax.random.PRNGKey(config.seed), self.mcfg
+                )
             self.params = self._shard_params(params)
             self.k_caches, self.v_caches = self._init_caches()
 
@@ -202,33 +206,21 @@ class TpuEngine:
 
     # ------------------------------------------------------------------ setup
     def _shard_params(self, params: llama.Params) -> llama.Params:
-        specs = meshlib.param_specs_llama()
+        specs = registry.param_specs(self.mcfg)
 
         def put(x, spec):
             return jax.device_put(x, NamedSharding(self.mesh, spec))
 
-        out: llama.Params = {
-            "embed": put(params["embed"], specs["embed"]),
-            "final_norm": put(params["final_norm"], specs["norm"]),
-            "layers": [],
-        }
-        if "lm_head" in params:
-            out["lm_head"] = put(params["lm_head"], specs["lm_head"])
+        out: llama.Params = {"layers": []}
+        for name, w in params.items():
+            if name == "layers":
+                continue
+            out[name] = put(w, specs["top"].get(name, specs["default"]))
         for lp in params["layers"]:
-            slp = {}
-            for name, w in lp.items():
-                if name in ("wq", "wk", "wv"):
-                    slp[name] = put(w, specs["wq"])
-                elif name == "wo":
-                    slp[name] = put(w, specs["wo"])
-                elif name in ("w_gate", "w_up"):
-                    slp[name] = put(w, specs["w_gate"])
-                elif name == "w_down":
-                    slp[name] = put(w, specs["w_down"])
-                elif name in ("bq", "bk", "bv"):
-                    slp[name] = put(w, P(meshlib.AXIS_TP))
-                else:  # norms
-                    slp[name] = put(w, specs["norm"])
+            slp = {
+                name: put(w, specs["layer"].get(name, specs["default"]))
+                for name, w in lp.items()
+            }
             out["layers"].append(slp)
         return out
 
@@ -247,6 +239,7 @@ class TpuEngine:
 
     def _build_programs(self) -> None:
         cfg, mcfg = self.cfg, self.mcfg
+        fwd, logits_fn = self._forward, self._lm_logits
 
         use_pallas = cfg.use_pallas
         if use_pallas is None:
@@ -282,11 +275,11 @@ class TpuEngine:
                 k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
                 return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
 
-            hidden = llama.forward(params, mcfg, tokens, positions, attend)
+            hidden = fwd(params, mcfg, tokens, positions, attend)
             # logits at the last real token (positions are absolute; the last
             # real new token sits where position == total_len - 1)
             last_idx = jnp.argmax(positions == total_len - 1)
-            logits = llama.lm_logits(params, mcfg, hidden[last_idx][None])  # [1, V]
+            logits = logits_fn(params, mcfg, hidden[last_idx][None])  # [1, V]
             tok = sample_tokens(logits, seeds, steps, temp, top_k, top_p)
             lp = logprobs_of(logits, tok)
             return k_caches, v_caches, tok[0], lp[0]
@@ -304,10 +297,10 @@ class TpuEngine:
                 out = paged_attention(q[:, 0], kc, vc, block_tables, seq_lens)
                 return out[:, None]
 
-            hidden = llama.forward(
+            hidden = fwd(
                 params, mcfg, tokens[:, None], positions[:, None], attend
             )  # [B, 1, H]
-            logits = llama.lm_logits(params, mcfg, hidden[:, 0])  # [B, V]
+            logits = logits_fn(params, mcfg, hidden[:, 0])  # [B, V]
             toks = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
             lps = logprobs_of(logits, toks)
             return k_caches, v_caches, toks, lps
@@ -348,10 +341,10 @@ class TpuEngine:
                     out = paged_attention(q[:, 0], kc, vc, block_tables, seq_lens)
                     return out[:, None]
 
-                hidden = llama.forward(
+                hidden = fwd(
                     params, mcfg, tokens[:, None], positions[:, None], attend
                 )
-                logits = llama.lm_logits(params, mcfg, hidden[:, 0])
+                logits = logits_fn(params, mcfg, hidden[:, 0])
                 toks = sample_tokens(logits, seeds, steps0 + s, temps, top_ks, top_ps)
                 lps = logprobs_of(logits, toks)
                 seq_lens = seq_lens + active.astype(jnp.int32)

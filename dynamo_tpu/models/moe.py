@@ -1,0 +1,311 @@
+"""Sparse MoE model family (Qwen3-MoE / Mixtral style) with TPU-native
+expert parallelism.
+
+The reference only passes EP knobs through to engine-internal all-to-all
+(moe_expert_parallel_size etc., components/src/dynamo/trtllm/engine.py:
+120-122; SGLang EPLB docs) — this framework owns the model, so EP is
+implemented directly over the mesh:
+
+* ``moe_ffn``            — dense reference (single device / replicated).
+* ``moe_ffn_ep_psum``    — experts sharded over an axis, tokens REPLICATED
+  on it (the engine's decode layout: EP rides the tp axis); each shard
+  computes its local experts' contribution, one psum combines. Same
+  collective cost as a TP row-parallel matmul.
+* ``moe_ffn_ep_a2a``     — tokens SHARDED over the ep axis (GShard/Switch
+  style): capacity-bounded dispatch, all-to-all to the expert owners over
+  ICI, expert compute, all-to-all back, weighted combine. This is the
+  scale path for large-batch prefill.
+
+Routing is softmax-then-top-k with optional top-k renormalization
+(Qwen3-MoE convention). Expert-load counts are returned for an
+EPLB-style rebalancing feed (reference: docs/backends/sglang/
+expert-distribution-eplb.md — pattern only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import llama
+from .llama import (
+    AttendFn,
+    Params,
+    apply_rope,
+    rms_norm,
+    rope_cos_sin,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig(llama.LlamaConfig):
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 128
+    norm_topk_prob: bool = True
+    # a2a dispatch capacity per (source shard, expert) = ceil(T*K/E * factor)
+    capacity_factor: float = 2.0
+
+    @classmethod
+    def tiny_moe(cls, **kw) -> "MoeConfig":
+        defaults = dict(
+            vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=128,
+            num_experts=4, num_experts_per_tok=2, moe_intermediate_size=64,
+            dtype=jnp.float32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def qwen3_30b_a3b(cls, vocab_size: int = 151936) -> "MoeConfig":
+        return cls(
+            vocab_size=vocab_size, hidden_size=2048, num_layers=48,
+            num_heads=32, num_kv_heads=4, head_dim=128,
+            intermediate_size=6144,  # unused (all layers sparse)
+            num_experts=128, num_experts_per_tok=8,
+            moe_intermediate_size=768, rope_theta=1000000.0, qk_norm=True,
+            tie_embeddings=False,
+        )
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_layer_params(rng: jax.Array, cfg: MoeConfig) -> Params:
+    k = jax.random.split(rng, 9)
+    h, qd, kvd = cfg.hidden_size, cfg.q_size, cfg.kv_size
+    E, inter = cfg.num_experts, cfg.moe_intermediate_size
+    scale = 1.0 / math.sqrt(h)
+    iscale = 1.0 / math.sqrt(inter)
+    p: Params = {
+        "attn_norm": jnp.ones((h,), cfg.dtype),
+        "mlp_norm": jnp.ones((h,), cfg.dtype),
+        "wq": (jax.random.normal(k[0], (h, qd)) * scale).astype(cfg.dtype),
+        "wk": (jax.random.normal(k[1], (h, kvd)) * scale).astype(cfg.dtype),
+        "wv": (jax.random.normal(k[2], (h, kvd)) * scale).astype(cfg.dtype),
+        "wo": (jax.random.normal(k[3], (qd, h)) * scale).astype(cfg.dtype),
+        "w_router": (jax.random.normal(k[4], (h, E)) * scale).astype(cfg.dtype),
+        # expert-stacked FFN weights: [E, ...] so the expert dim shards
+        "w_gate": (jax.random.normal(k[5], (E, h, inter)) * scale).astype(cfg.dtype),
+        "w_up": (jax.random.normal(k[6], (E, h, inter)) * scale).astype(cfg.dtype),
+        "w_down": (jax.random.normal(k[7], (E, inter, h)) * iscale).astype(cfg.dtype),
+    }
+    if cfg.qk_norm:
+        p["q_norm"] = jnp.ones((cfg.head_dim,), cfg.dtype)
+        p["k_norm"] = jnp.ones((cfg.head_dim,), cfg.dtype)
+    return p
+
+
+def init_params(rng: jax.Array, cfg: MoeConfig) -> Params:
+    keys = jax.random.split(rng, cfg.num_layers + 2)
+    params: Params = {
+        "embed": (
+            jax.random.normal(keys[0], (cfg.vocab_size, cfg.hidden_size)) * 0.02
+        ).astype(cfg.dtype),
+        "final_norm": jnp.ones((cfg.hidden_size,), cfg.dtype),
+        "layers": [init_layer_params(keys[i + 2], cfg) for i in range(cfg.num_layers)],
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(keys[1], (cfg.hidden_size, cfg.vocab_size)) * 0.02
+        ).astype(cfg.dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+def route(
+    p: Params, cfg: MoeConfig, x: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """softmax-then-top-k router. x [T, H] -> (weights [T, K] f32, idx [T, K])."""
+    logits = (x @ p["w_router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topw, topi = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+    if cfg.norm_topk_prob:
+        topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+    return topw, topi
+
+
+def expert_load(cfg: MoeConfig, topi: jax.Array) -> jax.Array:
+    """Tokens-per-expert counts [E] — the EPLB rebalancing feed."""
+    oh = jax.nn.one_hot(topi.reshape(-1), cfg.num_experts, dtype=jnp.int32)
+    return oh.sum(0)
+
+
+def _expert_mlp(w_gate, w_up, w_down, x, out_dtype):
+    """x [E, B, H] through per-expert SwiGLU -> [E, B, H]."""
+    gate = jnp.einsum("ebh,ehi->ebi", x, w_gate)
+    up = jnp.einsum("ebh,ehi->ebi", x, w_up)
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(out_dtype) * up
+    return jnp.einsum("ebi,eih->ebh", act, w_down)
+
+
+# ---------------------------------------------------------------------------
+# dense reference
+# ---------------------------------------------------------------------------
+
+
+def moe_ffn(p: Params, cfg: MoeConfig, x: jax.Array) -> jax.Array:
+    """Dense reference: every expert computed for every token, masked
+    combine. Exact (no capacity drops); O(T*E) compute — fine for tests and
+    single-chip small-E serving."""
+    T, H = x.shape
+    topw, topi = route(p, cfg, x)                        # [T, K]
+    out_all = _expert_mlp(
+        p["w_gate"], p["w_up"], p["w_down"],
+        jnp.broadcast_to(x, (cfg.num_experts, T, H)), x.dtype,
+    )                                                    # [E, T, H]
+    oh = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [T, K, E]
+    weights = (topw[..., None] * oh).sum(1)              # [T, E]
+    return jnp.einsum("te,eth->th", weights.astype(x.dtype), out_all)
+
+
+# ---------------------------------------------------------------------------
+# EP strategies
+# ---------------------------------------------------------------------------
+
+
+def moe_ffn_ep_psum(
+    p: Params, cfg: MoeConfig, x: jax.Array, axis_name: str
+) -> jax.Array:
+    """Inside shard_map: tokens replicated on ``axis_name``, expert-stacked
+    weights sharded on their leading dim. Each shard computes its local
+    experts' weighted contribution; psum combines."""
+    T, H = x.shape
+    E_loc = p["w_gate"].shape[0]
+    me = jax.lax.axis_index(axis_name)
+    topw, topi = route(p, cfg, x)                        # router is replicated
+    out_all = _expert_mlp(
+        p["w_gate"], p["w_up"], p["w_down"],
+        jnp.broadcast_to(x, (E_loc, T, H)), x.dtype,
+    )                                                    # [E_loc, T, H]
+    oh = jax.nn.one_hot(
+        topi - me * E_loc, E_loc, dtype=jnp.float32
+    )                                                    # [T, K, E_loc] (oob -> 0)
+    weights = (topw[..., None] * oh).sum(1)              # [T, E_loc]
+    local = jnp.einsum("te,eth->th", weights.astype(x.dtype), out_all)
+    return jax.lax.psum(local, axis_name)
+
+
+def moe_ffn_ep_a2a(
+    p: Params, cfg: MoeConfig, x: jax.Array, axis_name: str
+) -> jax.Array:
+    """Inside shard_map: tokens SHARDED on ``axis_name`` [T_loc, H], experts
+    sharded [E_loc, ...]. GShard-style capacity dispatch with two
+    all-to-alls over ICI."""
+    T, H = x.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    ep = jax.lax.psum(1, axis_name)
+    E_loc = E // ep
+    C = max(1, int(math.ceil(T * K / E * cfg.capacity_factor)))
+
+    topw, topi = route(p, cfg, x)                        # [T, K]
+    flat_i = topi.reshape(T * K)                         # expert per slot
+    flat_w = topw.reshape(T * K)
+    oh = jax.nn.one_hot(flat_i, E, dtype=jnp.float32)    # [T*K, E]
+    pos = jnp.cumsum(oh, axis=0) - oh                    # queue position
+    pos_sel = (pos * oh).sum(-1).astype(jnp.int32)       # [T*K]
+    keep = pos_sel < C
+    disp = oh * keep[:, None]                            # drop overflow
+    slot_oh = jax.nn.one_hot(pos_sel, C, dtype=jnp.float32)
+    # combine[t*k, e, c]: 1 where slot lands at (e, c)
+    combine = disp[:, :, None] * slot_oh[:, None, :]     # [T*K, E, C]
+
+    x_rep = jnp.repeat(x, K, axis=0)                     # [T*K, H] (slot-major)
+    x_disp = jnp.einsum(
+        "sec,sh->ech", combine.astype(x.dtype), x_rep
+    )                                                    # [E, C, H]
+
+    # ship each expert's buffer to its owner: tiled a2a keeps [E, C, H],
+    # rows regrouped as (src_shard, local_expert)
+    x_recv = jax.lax.all_to_all(
+        x_disp, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+    x_exp = (
+        x_recv.reshape(ep, E_loc, C, H)
+        .transpose(1, 0, 2, 3)
+        .reshape(E_loc, ep * C, H)
+    )
+    y_exp = _expert_mlp(p["w_gate"], p["w_up"], p["w_down"], x_exp, x.dtype)
+    y_send = (
+        y_exp.reshape(E_loc, ep, C, H)
+        .transpose(1, 0, 2, 3)
+        .reshape(E, C, H)
+    )
+    y_recv = jax.lax.all_to_all(
+        y_send, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+    weighted = combine * flat_w[:, None, None]           # [T*K, E, C]
+    y = jnp.einsum("sec,ech->sh", weighted.astype(x.dtype), y_recv)
+    return y.reshape(T, K, H).sum(1)
+
+
+# ---------------------------------------------------------------------------
+# full model
+# ---------------------------------------------------------------------------
+
+
+def layer_forward(
+    p: Params,
+    cfg: MoeConfig,
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    attend: AttendFn,
+    layer_idx: int,
+    ffn_fn=None,
+) -> jax.Array:
+    """Same attention block as llama.layer_forward (cited there); the MLP is
+    the sparse MoE. ``ffn_fn(p, cfg, x2d)`` overrides the FFN strategy."""
+    h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = h @ p["wq"]
+    k = h @ p["wk"]
+    v = h @ p["wv"]
+    new_shape = h.shape[:-1]
+    q = q.reshape(*new_shape, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(*new_shape, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(*new_shape, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, p["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, p["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn_out = attend(q, k, v, layer_idx)
+    attn_out = attn_out.reshape(*new_shape, cfg.q_size)
+    x = x + attn_out @ p["wo"]
+
+    h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+    lead = h.shape[:-1]
+    h2d = h.reshape(-1, cfg.hidden_size)
+    fn = ffn_fn if ffn_fn is not None else moe_ffn
+    y = fn(p, cfg, h2d).reshape(*lead, cfg.hidden_size)
+    return x + y
+
+
+def forward(
+    params: Params,
+    cfg: MoeConfig,
+    token_ids: jax.Array,
+    positions: jax.Array,
+    attend: AttendFn,
+    ffn_fn=None,
+) -> jax.Array:
+    x = params["embed"][token_ids]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = cos[..., None, :], sin[..., None, :]
+    for i, layer in enumerate(params["layers"]):
+        x = layer_forward(layer, cfg, x, cos, sin, attend, i, ffn_fn=ffn_fn)
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+lm_logits = llama.lm_logits

@@ -1,0 +1,66 @@
+"""Model-family registry: one place that maps a model config to its
+(init, forward, lm_logits, partition-spec) functions so the engine stays
+family-agnostic (reference analog: engine selection by ModelDeploymentCard
+rather than hard-coded architectures).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import AXIS_TP
+from . import llama, moe
+
+
+def is_moe(cfg) -> bool:
+    return isinstance(cfg, moe.MoeConfig)
+
+
+def init_params(rng, cfg):
+    return (moe if is_moe(cfg) else llama).init_params(rng, cfg)
+
+
+def forward_fn(cfg):
+    return (moe if is_moe(cfg) else llama).forward
+
+
+def lm_logits_fn(cfg):
+    return (moe if is_moe(cfg) else llama).lm_logits
+
+
+def param_specs(cfg) -> dict:
+    """name -> PartitionSpec for top-level and per-layer params.
+
+    Dense family: megatron TP (parallel/mesh.param_specs_llama). MoE: the
+    expert-stacked FFN weights shard on the EXPERT dim over the tp axis
+    (EP rides the same devices as attention TP); GSPMD inserts the psum at
+    the expert-contraction einsum. The router is tiny and replicated.
+    """
+    top = {
+        "embed": P(None, AXIS_TP),
+        "final_norm": P(None),
+        "lm_head": P(None, AXIS_TP),
+    }
+    layer = {
+        "wq": P(None, AXIS_TP),
+        "wk": P(None, AXIS_TP),
+        "wv": P(None, AXIS_TP),
+        "wo": P(AXIS_TP, None),
+        "bq": P(AXIS_TP),
+        "bk": P(AXIS_TP),
+        "bv": P(AXIS_TP),
+    }
+    if is_moe(cfg):
+        layer.update({
+            "w_router": P(None, None),
+            "w_gate": P(AXIS_TP, None, None),
+            "w_up": P(AXIS_TP, None, None),
+            "w_down": P(AXIS_TP, None, None),
+        })
+    else:
+        layer.update({
+            "w_gate": P(None, AXIS_TP),
+            "w_up": P(None, AXIS_TP),
+            "w_down": P(AXIS_TP, None),
+        })
+    return {"top": top, "layer": layer, "default": P()}

@@ -1,0 +1,113 @@
+"""Ring attention: blockwise context-parallel prefill over the ICI mesh.
+
+The reference has no in-engine attention — long context is handled by
+chunked prefill + P/D disaggregation + TRT-LLM context_parallel_size
+passthrough (SURVEY.md §2.5 SP row; components/src/dynamo/trtllm/
+engine.py:119). This framework owns its engine, so context parallelism is
+implemented directly: the sequence is sharded over the ``sp`` mesh axis,
+each device keeps its Q shard resident, and KV shards rotate around the
+ring via ``ppermute`` while flash-style online-softmax accumulation folds
+in one block per step. Peak memory per device is O(S/sp) and the KV
+rotation rides nearest-neighbor ICI links concurrently with compute.
+
+Causality across shards falls out of global position indices: the rotation
+schedule pairs every Q shard with every KV shard exactly once, and blocks
+strictly above the diagonal contribute nothing (fully masked).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import AXIS_SP
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, m_prev, l_prev, acc_prev):
+    """One online-softmax accumulation step.
+
+    q [S,h,d] f32, k/v [T,kvh,d] f32, q_pos [S], k_pos [T].
+    Carries: m,l [S,h,1], acc [S,h,d]."""
+    S, h, d = q.shape
+    T, kvh, _ = k.shape
+    g = h // kvh
+    scale = 1.0 / (d ** 0.5)
+    qg = (q * scale).reshape(S, kvh, g, d)
+    s = jnp.einsum("skgd,tkd->skgt", qg, k).reshape(S, h, T)
+    mask = q_pos[:, None] >= k_pos[None, :]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    # fully-masked rows (block above the diagonal): keep carries unchanged
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    pg = p.reshape(S, kvh, g, T)
+    pv = jnp.einsum("skgt,tkd->skgd", pg, v).reshape(S, h, d)
+    acc_new = alpha * acc_prev + pv
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_shard(q, k, v, axis_name: str):
+    """Per-shard body (inside shard_map): q,k,v are this device's sequence
+    chunk [S_loc, heads, d] / [S_loc, kv_heads, d]."""
+    sp = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    S_loc = q.shape[0]
+    h = q.shape[1]
+    d = q.shape[2]
+
+    qf = q.astype(jnp.float32)
+    q_pos = me * S_loc + jnp.arange(S_loc)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(t, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # after t rotations we hold the KV chunk originally on shard me - t
+        src = jax.lax.rem(me - t + sp, sp)
+        k_pos = src * S_loc + jnp.arange(S_loc)
+        m, l, acc = _block_attend(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            q_pos, k_pos, m, l, acc,
+        )
+        # rotate for the next step (skipped on the final iteration by loop
+        # bound; a wasted last permute would add one ICI hop of latency)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m, l, acc
+
+    m0 = jnp.full((S_loc, h, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((S_loc, h, 1), jnp.float32)
+    a0 = jnp.zeros((S_loc, h, d), jnp.float32)
+    _, _, m, l, acc = jax.lax.fori_loop(0, sp, step, (k, v, m0, l0, a0))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_prefill_attention(
+    mesh: Mesh,
+    q: jax.Array,   # [S, h, d] global sequence (sharded or shardable on S)
+    k: jax.Array,   # [S, kvh, d]
+    v: jax.Array,
+    sp_axis: str = AXIS_SP,
+) -> jax.Array:
+    """Causal self-attention over a long sequence, context-parallel over the
+    ``sp`` mesh axis. S must divide evenly by the axis size (pad upstream).
+    Degenerates to plain causal attention when the axis size is 1."""
+    sp = mesh.shape[sp_axis]
+    if q.shape[0] % sp:
+        raise ValueError(f"sequence {q.shape[0]} not divisible by sp={sp}")
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_shard, axis_name=sp_axis),
+        mesh=mesh,
+        in_specs=(P(sp_axis, None, None),) * 3,
+        out_specs=P(sp_axis, None, None),
+        check_vma=False,
+    )
+    return fn(q, k, v)
