@@ -38,7 +38,8 @@ from dynamo_tpu.runtime.engine import Context  # noqa: E402
 BATCH = int(os.environ.get("BENCH_BATCH", "8"))
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "256"))
 DECODE_TOKENS = int(os.environ.get("BENCH_DECODE", "128"))
-DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
+DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "32"))
+PIPELINE = int(os.environ.get("BENCH_PIPELINE", "3"))
 WARMUP_TOKENS = 16
 
 
@@ -64,19 +65,25 @@ def roofline_tokens_per_s(cfg: LlamaConfig, batch: int, ctx: int) -> float:
     return steps_per_s * batch
 
 
-async def run_bench() -> dict:
+async def run_bench(batch: int = BATCH) -> dict:
     mcfg = model_config()
-    ctx = ((PROMPT_LEN + DECODE_TOKENS + 32 + 127) // 128) * 128
+    # headroom so deep horizon pipelines never fall back to single-step near
+    # the end of generation (prepare_horizon needs L + depth*steps < ctx)
+    ctx = (
+        (PROMPT_LEN + DECODE_TOKENS + PIPELINE * DECODE_STEPS + 32 + 127)
+        // 128
+    ) * 128
     cfg = TpuEngineConfig(
         model=mcfg,
-        num_blocks=max(1024, (ctx // 16) * (BATCH + 2)),
+        num_blocks=max(1024, (ctx // 16) * (batch + 2)),
         block_size=16,
-        max_batch_size=BATCH,
+        max_batch_size=batch,
         max_context=ctx,
         prefill_buckets=tuple(
             b for b in (256, 512, 1024, 2048, 4096, 8192) if b < ctx
         ) + (ctx,),
         decode_steps=DECODE_STEPS,
+        decode_pipeline=PIPELINE,
     )
     engine = TpuEngine(cfg)
 
@@ -97,12 +104,12 @@ async def run_bench() -> dict:
 
     try:
         # warmup: compile prefill + decode
-        await asyncio.gather(*[one(i, WARMUP_TOKENS, []) for i in range(BATCH)])
+        await asyncio.gather(*[one(i, WARMUP_TOKENS, []) for i in range(batch)])
         # timed run
         t_firsts: list = []
         t0 = time.monotonic()
         counts = await asyncio.gather(
-            *[one(100 + i, DECODE_TOKENS, t_firsts) for i in range(BATCH)]
+            *[one(100 + i, DECODE_TOKENS, t_firsts) for i in range(batch)]
         )
         t1 = time.monotonic()
     finally:
@@ -112,9 +119,9 @@ async def run_bench() -> dict:
     elapsed = t1 - t0
     ttft = (min(t_firsts) - t0) if t_firsts else 0.0
     tok_s = total_tokens / elapsed
-    roof = roofline_tokens_per_s(mcfg, BATCH, PROMPT_LEN + DECODE_TOKENS)
+    roof = roofline_tokens_per_s(mcfg, batch, PROMPT_LEN + DECODE_TOKENS)
     return {
-        "metric": "decode_throughput_qwen3_0.6b_bs%d" % BATCH,
+        "metric": "decode_throughput_qwen3_0.6b_bs%d" % batch,
         "value": round(tok_s, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tok_s / roof, 4),
@@ -124,12 +131,35 @@ async def run_bench() -> dict:
             "first_ttft_s": round(ttft, 3),
             "roofline_tok_s": round(roof, 1),
             "device": str(jax.devices()[0]),
-            "batch": BATCH,
+            "batch": batch,
             "prompt_len": PROMPT_LEN,
+            "decode_steps": DECODE_STEPS,
+            "pipeline": PIPELINE,
         },
     }
 
 
+def main() -> None:
+    sweep_env = os.environ.get("BENCH_SWEEP", "")
+    if sweep_env:
+        batches = [int(b) for b in sweep_env.split(",")]
+        results = [asyncio.run(run_bench(b)) for b in batches]
+        best = max(results, key=lambda r: r["vs_baseline"])
+        best = dict(best)
+        best["detail"] = dict(best["detail"])
+        best["detail"]["batch_sweep"] = [
+            {
+                "batch": r["detail"]["batch"],
+                "tok_s": r["value"],
+                "vs_roofline": r["vs_baseline"],
+                "ttft_s": r["detail"]["first_ttft_s"],
+            }
+            for r in results
+        ]
+        print(json.dumps(best))
+    else:
+        print(json.dumps(asyncio.run(run_bench())))
+
+
 if __name__ == "__main__":
-    result = asyncio.run(run_bench())
-    print(json.dumps(result))
+    main()

@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
@@ -49,7 +50,14 @@ from ..runtime.engine import Context
 from ..runtime.logging import get_logger
 from ..tokens import TokenBlockSequence
 from .allocator import BlockAllocator, OutOfBlocks
-from .sampling import logprobs_of, sample_tokens
+from .sampling import (
+    TOP_LOGPROBS_K,
+    apply_penalties,
+    logprobs_of,
+    sample_tokens,
+    top_logprobs,
+    update_counts,
+)
 
 log = get_logger("engine")
 
@@ -72,7 +80,13 @@ class TpuEngineConfig:
     # (lax.scan, sampled tokens fed back device-side) so per-dispatch launch
     # latency amortizes over N tokens. Stop conditions are applied host-side
     # post-hoc (at most N-1 speculatively-decoded tokens are discarded).
-    decode_steps: int = 8
+    decode_steps: int = 16
+    # in-flight decode horizons: results of horizon N are fetched only after
+    # horizon N+depth-1 is dispatched, so the device->host readback RTT
+    # (hundreds of ms on tunneled TPUs) hides behind `depth-1` horizons of
+    # device compute. Each extra slot adds decode_steps tokens of emission
+    # latency and speculation waste at stop.
+    decode_pipeline: int = 2
 
     def __post_init__(self):
         bad = [b for b in self.prefill_buckets if b % self.block_size]
@@ -164,11 +178,24 @@ class TpuEngine:
         self._temps = np.zeros(B, np.float32)
         self._top_ks = np.zeros(B, np.int32)
         self._top_ps = np.ones(B, np.float32)
+        self._min_ps = np.zeros(B, np.float32)
+        self._pres = np.zeros(B, np.float32)
+        self._freqs = np.zeros(B, np.float32)
+        self._reps = np.ones(B, np.float32)
+        self._lp_ns = np.zeros(B, np.int32)    # requested top-logprobs per slot
         self._seeds = np.zeros(B, np.uint32)
+        # penalty state tables (device-resident; see engine/sampling.py)
+        V = self.mcfg.vocab_size
+        with self.mesh:
+            self.output_counts = jnp.zeros((B, V), jnp.int32)
+            self.prompt_masks = jnp.zeros((B, V), jnp.int8)
+        self._slot_dirty = np.zeros(B, bool)   # slot's penalty tables need reset
 
         self._waiting: List[_Seq] = []
-        # chained decode: in-flight horizon (packed results + device carry)
-        self._chain: Optional[_Chain] = None
+        # chained decode: FIFO of in-flight horizons (packed results + device
+        # carry); results are fetched decode_pipeline-1 horizons behind the
+        # dispatch front so readback RTT hides behind device compute
+        self._chains: "deque[_Chain]" = deque()
         # device-resident copies of slot arrays, re-uploaded only when the
         # host copy changes (host<->device RPCs are the bottleneck on
         # tunneled TPUs: ~100ms per transfer vs ~0.03ms per dispatch)
@@ -264,8 +291,27 @@ class TpuEngine:
         else:
             paged_attention = att.paged_decode_attention
 
-        def prefill(params, k_caches, v_caches, tokens, positions, block_table,
-                    new_block_ids, total_len, seeds, steps, temp, top_k, top_p):
+        def pen_need(pres, freqs, reps):
+            return jnp.any((pres != 0.0) | (freqs != 0.0) | (reps != 1.0))
+
+        def pack_step(toks, lps, tlp_vals, tlp_ids):
+            """[B] toks/lps + [B,K] top-logprob rows -> one [B, 2+2K] f32 row
+            (token ids are exact in f32 below 2^24) so the host pays a single
+            device->host fetch per horizon."""
+            return jnp.concatenate(
+                [
+                    toks.astype(jnp.float32)[:, None],
+                    lps[:, None],
+                    tlp_ids.astype(jnp.float32),
+                    tlp_vals,
+                ],
+                axis=-1,
+            )
+
+        def prefill(params, k_caches, v_caches, counts, tokens, positions,
+                    block_table, new_block_ids, total_len, seeds, steps, temp,
+                    top_k, top_p, min_p, pres, freq, rep, prompt_masks, slot,
+                    lp_need):
             # tokens/positions: [S_pad]; block_table: [max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx):
                 kc, vc = att.write_prefill_kv(
@@ -280,13 +326,27 @@ class TpuEngine:
             # real new token sits where position == total_len - 1)
             last_idx = jnp.argmax(positions == total_len - 1)
             logits = logits_fn(params, mcfg, hidden[last_idx][None])  # [1, V]
-            tok = sample_tokens(logits, seeds, steps, temp, top_k, top_p)
+            pen = apply_penalties(
+                logits, jnp.zeros_like(logits, jnp.int32),
+                prompt_masks[slot][None], pres, freq, rep,
+            )
+            tok = sample_tokens(pen, seeds, steps, temp, top_k, top_p, min_p)
+            # the first generated token must enter the output counts, or the
+            # first decode step's penalties miss it
+            counts = jax.lax.cond(
+                pen_need(pres, freq, rep),
+                lambda c: c.at[slot, tok[0]].add(1),
+                lambda c: c,
+                counts,
+            )
             lp = logprobs_of(logits, tok)
-            return k_caches, v_caches, tok[0], lp[0]
+            tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
+            return k_caches, v_caches, counts, tok[0], lp[0], tlp_vals[0], tlp_ids[0]
 
-        def decode(params, k_caches, v_caches, tokens, positions, block_tables,
-                   seq_lens, write_blocks, write_offsets, seeds, steps, temps,
-                   top_ks, top_ps):
+        def decode(params, k_caches, v_caches, counts, tokens, positions,
+                   block_tables, seq_lens, write_blocks, write_offsets, seeds,
+                   steps, temps, top_ks, top_ps, min_ps, pres, freqs, reps,
+                   prompt_masks, lp_need):
             # tokens: [B]; block_tables: [B, max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx):
                 kc, vc = att.write_decode_kv(
@@ -301,27 +361,33 @@ class TpuEngine:
                 params, mcfg, tokens[:, None], positions[:, None], attend
             )  # [B, 1, H]
             logits = logits_fn(params, mcfg, hidden[:, 0])  # [B, V]
-            toks = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
+            pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
+            toks = sample_tokens(pen, seeds, steps, temps, top_ks, top_ps, min_ps)
+            counts = update_counts(
+                counts, toks, seq_lens > 0, pen_need(pres, freqs, reps)
+            )
             lps = logprobs_of(logits, toks)
-            return k_caches, v_caches, toks, lps
+            tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
+            return k_caches, v_caches, counts, toks, lps, tlp_vals, tlp_ids
 
-        def decode_multi(params, k_caches, v_caches, tokens, seq_lens,
+        def decode_multi(params, k_caches, v_caches, counts, tokens, seq_lens,
                          block_tables, active, seeds, steps0, temps, top_ks,
-                         top_ps):
+                         top_ps, min_ps, pres, freqs, reps, prompt_masks,
+                         lp_need):
             """cfg.decode_steps decode iterations in one program: each step
             writes the fed token's KV, attends, samples, and feeds the sample
             back — tokens only reach the host once per horizon. seq_lens==0
             slots (inactive) write to scratch block 0 and are discarded.
 
-            Returns the sampled (token, logprob) pairs packed into ONE f32
-            array [2, N, B] (token ids are exact in f32 below 2^24) so the
-            host pays a single device->host fetch per horizon, plus the
-            device-resident carry (tokens/seq_lens/steps) that lets the loop
-            dispatch the next horizon without any host round-trip."""
+            Returns the per-step results packed into ONE f32 array
+            [N, B, 2+2K] (sampled token, its logprob, top-K logprob rows),
+            plus the device-resident carry (tokens/seq_lens/steps) that lets
+            the loop dispatch the next horizon without any host round-trip."""
             bs = cfg.block_size
+            need_pen = pen_need(pres, freqs, reps)
 
             def one_step(carry, s):
-                k_caches, v_caches, tokens, seq_lens = carry
+                k_caches, v_caches, counts, tokens, seq_lens = carry
                 positions = jnp.maximum(seq_lens - 1, 0)
                 write_blocks = jnp.where(
                     active,
@@ -345,25 +411,36 @@ class TpuEngine:
                     params, mcfg, tokens[:, None], positions[:, None], attend
                 )
                 logits = logits_fn(params, mcfg, hidden[:, 0])
-                toks = sample_tokens(logits, seeds, steps0 + s, temps, top_ks, top_ps)
+                pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
+                toks = sample_tokens(
+                    pen, seeds, steps0 + s, temps, top_ks, top_ps, min_ps
+                )
+                counts = update_counts(counts, toks, active, need_pen)
                 lps = logprobs_of(logits, toks)
+                tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
                 seq_lens = seq_lens + active.astype(jnp.int32)
-                return (k_caches, v_caches, toks, seq_lens), (toks, lps)
+                return (
+                    (k_caches, v_caches, counts, toks, seq_lens),
+                    pack_step(toks, lps, tlp_vals, tlp_ids),
+                )
 
-            (k_caches, v_caches, tokens, seq_lens), (toks_seq, lps_seq) = (
+            (k_caches, v_caches, counts, tokens, seq_lens), packed = (
                 jax.lax.scan(
                     one_step,
-                    (k_caches, v_caches, tokens, seq_lens),
+                    (k_caches, v_caches, counts, tokens, seq_lens),
                     jnp.arange(cfg.decode_steps),
                 )
             )
-            packed = jnp.stack([toks_seq.astype(jnp.float32), lps_seq])
             next_steps = steps0 + jnp.where(active, cfg.decode_steps, 0)
-            return k_caches, v_caches, packed, tokens, seq_lens, next_steps
+            return k_caches, v_caches, counts, packed, tokens, seq_lens, next_steps
 
-        self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2))
-        self._decode_fn = jax.jit(decode, donate_argnums=(1, 2))
-        self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2))
+        def reset_slot(prompt_masks, counts, slot, row):
+            return prompt_masks.at[slot].set(row), counts.at[slot].set(0)
+
+        self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2, 3))
+        self._decode_fn = jax.jit(decode, donate_argnums=(1, 2, 3))
+        self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2, 3))
+        self._reset_slot_fn = jax.jit(reset_slot, donate_argnums=(0, 1))
 
     # ---------------------------------------------------------------- serving
     async def generate(
@@ -524,7 +601,7 @@ class TpuEngine:
         try:
             while True:
                 if not self._waiting and all(s is None for s in self._slots):
-                    self._chain = None  # all snapshot seqs are done by now
+                    self._chains.clear()  # all snapshot seqs are done by now
                     self._wake.clear()
                     await self._wake.wait()
                 self._admit_cancelled()
@@ -533,44 +610,41 @@ class TpuEngine:
                     results = await loop.run_in_executor(
                         self._executor, self._run_prefill, st
                     )
-                    for rst, tok, lp in results:
-                        self._accept_token(rst, tok, lp)
+                    for rst, tok, lp, tids, tvals in results:
+                        self._accept_token(rst, tok, lp, tids, tvals)
                 has_active = any(
                     s is not None and not s.done for s in self._slots
                 )
-                if self._chain is not None:
-                    # speculatively enqueue the next horizon BEFORE fetching
-                    # this one's results: the ~100ms readback RPC overlaps
-                    # the next horizon's device compute. Dispatch runs on the
-                    # executor: the first call jit-compiles (30-90s cold) and
-                    # must not stall the event loop's lease heartbeats.
-                    chain = self._chain
-                    next_chain = None
-                    if (
-                        has_active
-                        and not self._waiting
-                        and self._can_chain(chain)
-                        and self._prepare_horizon(depth=2)
-                    ):
-                        next_chain = await loop.run_in_executor(
-                            self._executor, self._dispatch_horizon, chain
+                # top up the horizon pipeline BEFORE fetching the oldest
+                # results: readback RTT (hundreds of ms tunneled) overlaps
+                # the in-flight horizons' device compute. Dispatch runs on
+                # the executor: the first call jit-compiles (30-90s cold)
+                # and must not stall the event loop's lease heartbeats.
+                while (
+                    has_active
+                    and not self._waiting
+                    and len(self._chains) < self.cfg.decode_pipeline
+                    and (not self._chains or self._can_chain(self._chains[-1]))
+                    and self._prepare_horizon(depth=len(self._chains) + 1)
+                ):
+                    prev = self._chains[-1] if self._chains else None
+                    self._chains.append(
+                        await loop.run_in_executor(
+                            self._executor, self._dispatch_horizon, prev
                         )
-                    self._chain = next_chain
+                    )
+                if self._chains:
+                    chain = self._chains.popleft()
                     packed = await loop.run_in_executor(
                         self._executor, np.asarray, chain.packed
                     )
                     self._apply_packed(chain, packed)
                 elif has_active:
-                    if self._prepare_horizon(depth=1):
-                        self._chain = await loop.run_in_executor(
-                            self._executor, self._dispatch_horizon, None
-                        )
-                    else:
-                        results = await loop.run_in_executor(
-                            self._executor, self._run_decode
-                        )
-                        for rst, tok, lp in results:
-                            self._accept_token(rst, tok, lp)
+                    results = await loop.run_in_executor(
+                        self._executor, self._run_decode
+                    )
+                    for rst, tok, lp, tids, tvals in results:
+                        self._accept_token(rst, tok, lp, tids, tvals)
                 self._reap_finished()
                 if self._offload_pending and self.kvbm is not None:
                     pending, self._offload_pending = self._offload_pending, []
@@ -599,7 +673,7 @@ class TpuEngine:
             self._waiting = []
             self._slots = [None] * self.cfg.max_batch_size
             self._seq_lens[:] = 0
-            self._chain = None
+            self._chains.clear()
 
     def _admit_cancelled(self) -> None:
         keep = []
@@ -661,13 +735,37 @@ class TpuEngine:
             self._block_tables[slot].fill(0)
             self._block_tables[slot, : len(st.block_ids)] = st.block_ids
             self._seq_lens[slot] = prompt_len
-            self._temps[slot] = st.req.sampling.temperature
-            self._top_ks[slot] = st.req.sampling.top_k
-            self._top_ps[slot] = st.req.sampling.top_p
-            seed = st.req.sampling.seed
+            s = st.req.sampling
+            self._temps[slot] = s.temperature
+            self._top_ks[slot] = s.top_k
+            self._top_ps[slot] = s.top_p
+            self._min_ps[slot] = s.min_p
+            self._pres[slot] = s.presence_penalty
+            self._freqs[slot] = s.frequency_penalty
+            self._reps[slot] = s.repetition_penalty
+            self._lp_ns[slot] = min(max(s.logprobs, 0), TOP_LOGPROBS_K)
+            seed = s.seed
             self._seeds[slot] = np.uint32(
                 seed if seed is not None else self._host_rng.integers(1 << 32)
             )
+            # penalty tables: reset the slot's rows when this request uses
+            # penalties (needs a fresh prompt mask) or a prior occupant left
+            # them dirty. One tiny async dispatch; skipped entirely on the
+            # common penalties-off path.
+            has_pen = (
+                s.presence_penalty != 0.0
+                or s.frequency_penalty != 0.0
+                or s.repetition_penalty != 1.0
+            )
+            if has_pen or self._slot_dirty[slot]:
+                row = np.zeros(self.mcfg.vocab_size, np.int8)
+                if has_pen:
+                    row[np.asarray(st.seq.tokens(), np.int64)] = 1
+                self.prompt_masks, self.output_counts = self._reset_slot_fn(
+                    self.prompt_masks, self.output_counts,
+                    jnp.int32(slot), jnp.asarray(row),
+                )
+            self._slot_dirty[slot] = has_pen
             admitted.append(st)
             log.debug(
                 "admit %s: %d tokens (%d cached), slot %d",
@@ -704,21 +802,33 @@ class TpuEngine:
         real_new = st.block_ids[prefix // bs :]
         new_block_ids[: len(real_new)] = real_new
 
-        temp = np.array([st.req.sampling.temperature], np.float32)
-        top_k = np.array([st.req.sampling.top_k], np.int32)
-        top_p = np.array([st.req.sampling.top_p], np.float32)
+        s = st.req.sampling
+        temp = np.array([s.temperature], np.float32)
+        top_k = np.array([s.top_k], np.int32)
+        top_p = np.array([s.top_p], np.float32)
+        min_p = np.array([s.min_p], np.float32)
+        pres = np.array([s.presence_penalty], np.float32)
+        freq = np.array([s.frequency_penalty], np.float32)
+        rep = np.array([s.repetition_penalty], np.float32)
         seeds = np.array([self._seeds[st.slot]], np.uint32)
         steps = np.array([0], np.int32)
 
-        self.k_caches, self.v_caches, tok, lp = self._prefill_fn(
-            self.params, self.k_caches, self.v_caches,
+        (self.k_caches, self.v_caches, self.output_counts, tok, lp, tlp_vals,
+         tlp_ids) = self._prefill_fn(
+            self.params, self.k_caches, self.v_caches, self.output_counts,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self._block_tables[st.slot]),
             jnp.asarray(new_block_ids), jnp.int32(len(prompt)),
             jnp.asarray(seeds), jnp.asarray(steps),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(min_p), jnp.asarray(pres), jnp.asarray(freq),
+            jnp.asarray(rep), self.prompt_masks, jnp.int32(st.slot),
+            jnp.bool_(self._lp_ns[st.slot] > 0),
         )
-        return [(st, int(tok), float(lp))]
+        if self._lp_ns[st.slot] > 0:
+            return [(st, int(tok), float(lp),
+                     np.asarray(tlp_ids), np.asarray(tlp_vals))]
+        return [(st, int(tok), float(lp), None, None)]
 
     def _prepare_horizon(self, depth: int = 1) -> bool:
         """Pre-allocate pages so every active sequence can absorb ``depth``
@@ -798,9 +908,10 @@ class TpuEngine:
             seq_lens = jnp.asarray(seq_lens_np)
             steps = jnp.asarray(steps_np)
 
-        (self.k_caches, self.v_caches, packed, tokens, seq_lens, steps) = (
+        (self.k_caches, self.v_caches, self.output_counts, packed, tokens,
+         seq_lens, steps) = (
             self._decode_multi_fn(
-                self.params, self.k_caches, self.v_caches,
+                self.params, self.k_caches, self.v_caches, self.output_counts,
                 tokens, seq_lens,
                 self._dev("tables", self._block_tables),
                 self._dev("active", active),
@@ -809,8 +920,18 @@ class TpuEngine:
                 self._dev("temps", self._temps),
                 self._dev("top_ks", self._top_ks),
                 self._dev("top_ps", self._top_ps),
+                self._dev("min_ps", self._min_ps),
+                self._dev("pres", self._pres),
+                self._dev("freqs", self._freqs),
+                self._dev("reps", self._reps),
+                self.prompt_masks,
+                jnp.bool_(bool(np.any(self._lp_ns[active] > 0))),
             )
         )
+        # start the D2H readback immediately: by the time this horizon's turn
+        # to be applied comes (decode_pipeline-1 horizons later) the bytes
+        # are already on host and np.asarray is a no-wait copy
+        packed.copy_to_host_async()
         seqs = [
             st if (st is not None and not st.done) else None
             for st in self._slots
@@ -827,18 +948,26 @@ class TpuEngine:
         return True
 
     def _apply_packed(self, chain: _Chain, packed_np: np.ndarray) -> None:
-        """Apply one consumed horizon [2, N, B]: feed each snapshot slot's
+        """Apply one consumed horizon [N, B, 2+2K]: feed each snapshot slot's
         tokens through stop handling in order; the speculated tail past a
         finish is discarded."""
-        toks = packed_np[0].astype(np.int32)
-        lps = packed_np[1]
+        K = TOP_LOGPROBS_K
+        toks = packed_np[:, :, 0].astype(np.int32)
+        lps = packed_np[:, :, 1]
+        tlp_ids = packed_np[:, :, 2 : 2 + K].astype(np.int32)
+        tlp_vals = packed_np[:, :, 2 + K :]
         for i, st in enumerate(chain.seqs):
             if st is None or st.done:
                 continue
+            want_tlp = st.req.sampling.logprobs > 0
             for s in range(toks.shape[0]):
                 if st.done:
                     break
-                self._accept_token(st, int(toks[s, i]), float(lps[s, i]))
+                self._accept_token(
+                    st, int(toks[s, i]), float(lps[s, i]),
+                    tlp_ids[s, i] if want_tlp else None,
+                    tlp_vals[s, i] if want_tlp else None,
+                )
 
     def _run_decode(self) -> List[Tuple[_Seq, int, float]]:
         bs = self.cfg.block_size
@@ -863,26 +992,44 @@ class TpuEngine:
             if st is not None and not st.done:
                 steps[i] = st.produced
 
-        self.k_caches, self.v_caches, toks, lps = self._decode_fn(
-            self.params, self.k_caches, self.v_caches,
+        lp_need = bool(np.any((self._lp_ns > 0) & (seq_lens > 0)))
+        (self.k_caches, self.v_caches, self.output_counts, toks, lps,
+         tlp_vals, tlp_ids) = self._decode_fn(
+            self.params, self.k_caches, self.v_caches, self.output_counts,
             jnp.asarray(self._tokens), jnp.asarray(positions),
             jnp.asarray(self._block_tables), jnp.asarray(seq_lens),
             jnp.asarray(write_blocks), jnp.asarray(write_offsets),
             jnp.asarray(self._seeds), jnp.asarray(steps),
             jnp.asarray(self._temps),
             jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+            jnp.asarray(self._min_ps), jnp.asarray(self._pres),
+            jnp.asarray(self._freqs), jnp.asarray(self._reps),
+            self.prompt_masks, jnp.bool_(lp_need),
         )
         toks_np = np.asarray(toks)
         lps_np = np.asarray(lps)
+        tlp_ids_np = np.asarray(tlp_ids) if lp_need else None
+        tlp_vals_np = np.asarray(tlp_vals) if lp_need else None
         results = []
         for i, st in enumerate(self._slots):
             if st is None or st.done:
                 continue
-            results.append((st, int(toks_np[i]), float(lps_np[i])))
+            if self._lp_ns[i] > 0 and tlp_ids_np is not None:
+                results.append((st, int(toks_np[i]), float(lps_np[i]),
+                                tlp_ids_np[i], tlp_vals_np[i]))
+            else:
+                results.append((st, int(toks_np[i]), float(lps_np[i]), None, None))
         return results
 
     # -- host-side token bookkeeping -----------------------------------------
-    def _accept_token(self, st: _Seq, tok: int, logprob: float) -> None:
+    def _accept_token(
+        self,
+        st: _Seq,
+        tok: int,
+        logprob: float,
+        tlp_ids: Optional[np.ndarray] = None,
+        tlp_vals: Optional[np.ndarray] = None,
+    ) -> None:
         """Runs in the executor thread: pure host state mutation."""
         st.produced += 1
         finish: Optional[str] = None
@@ -932,11 +1079,18 @@ class TpuEngine:
                     except OutOfBlocks:
                         finish = FINISH_LENGTH  # out of memory: end gracefully
 
+        tlp: Optional[List[Dict[int, float]]] = None
+        n_tlp = min(st.req.sampling.logprobs, TOP_LOGPROBS_K)
+        if emit_ids and n_tlp > 0 and tlp_ids is not None:
+            tlp = [
+                {int(i): float(v) for i, v in zip(tlp_ids[:n_tlp], tlp_vals[:n_tlp])}
+            ]
         out = BackendOutput(
             token_ids=emit_ids,
             finish_reason=finish,
             cumulative_tokens=st.produced,
             logprobs=[logprob] if emit_ids else None,
+            top_logprobs=tlp,
             annotations=ann,
         )
         st.out_queue.put_nowait(out)

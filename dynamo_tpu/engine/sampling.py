@@ -1,17 +1,91 @@
-"""On-device batched sampling: greedy / temperature / top-k / top-p.
+"""On-device batched sampling: greedy / temperature / top-k / top-p / min-p,
+frequency / presence / repetition penalties, and top-N logprobs.
 
 Logits never leave the device (vocab-sized transfers per step would saturate
-PCIe/host); only the sampled token ids [B] come back. All branches are
-tensor-masked (no data-dependent control flow) so one compiled program serves
-every per-request sampling configuration.
+the host link); only sampled token ids (+ small top-k logprob rows) come back.
+All branches are tensor-masked — no data-dependent *shapes* — but the
+expensive paths (full-vocab sort for top-k/top-p, [B,V] gumbel draw, [B,V]
+penalty tables) are gated behind ``lax.cond`` on whether any request in the
+batch actually enables them, so a greedy batch pays only an argmax. This
+mirrors how the reference folds per-request sampling options in its
+preprocessor (lib/llm/src/preprocessor.rs) and leaves the hot loop branchless.
+
+Penalty semantics match vLLM/OpenAI:
+- repetition_penalty: tokens seen in prompt OR output; logit>0 ? l/r : l*r
+- frequency_penalty:  logits -= fp * count(token in output)
+- presence_penalty:   logits -= pp * (token in output)
 """
 
 from __future__ import annotations
+
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+# top-N logprobs rows returned by the decode program when any request asks
+# for them (OpenAI allows up to 20; vLLM caps similarly)
+TOP_LOGPROBS_K = 8
+
+
+def apply_penalties(
+    logits: jax.Array,             # [B, V] float32
+    output_counts: jax.Array,      # [B, V] int32 generated-token counts
+    prompt_mask: jax.Array,        # [B, V] int8/bool tokens present in prompt
+    presence: jax.Array,           # [B]
+    frequency: jax.Array,          # [B]
+    repetition: jax.Array,         # [B]
+) -> jax.Array:
+    """Returns penalized logits. Free (one cond + passthrough) when the whole
+    batch has penalties disabled."""
+
+    def with_pen(l):
+        counts_f = output_counts.astype(jnp.float32)
+        out_seen = output_counts > 0
+        seen = out_seen | (prompt_mask != 0)
+        rep = jnp.where(l > 0, l / repetition[:, None], l * repetition[:, None])
+        l = jnp.where(seen, rep, l)
+        l = l - frequency[:, None] * counts_f
+        l = l - presence[:, None] * out_seen.astype(jnp.float32)
+        return l
+
+    need = jnp.any(
+        (presence != 0.0) | (frequency != 0.0) | (repetition != 1.0)
+    )
+    return jax.lax.cond(need, with_pen, lambda l: l, logits)
+
+
+def _mask_topk_topp(
+    logits: jax.Array,       # [B, V] (already penalized)
+    temp_safe: jax.Array,    # [B, 1] clamped temperature
+    top_k: jax.Array,        # [B] <=0 => disabled
+    top_p: jax.Array,        # [B] >=1 => disabled
+) -> jax.Array:
+    """One descending sort serves both filters: the top-k cutoff is the kth
+    sorted value; top-p is computed over the top-k-surviving prefix of the
+    same sorted array (softmax in sorted order, cumulative mass)."""
+    B, V = logits.shape
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]              # [B, V]
+
+    k_eff = jnp.where(top_k <= 0, V, jnp.minimum(top_k, V))       # [B]
+    k_idx = jnp.clip(k_eff - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
+
+    # top-p over the top-k set: positions >= k are excluded from the mass
+    rank = jnp.arange(V)[None, :]
+    in_topk = rank < k_eff[:, None]
+    sorted_scaled = jnp.where(in_topk, sorted_desc / temp_safe, NEG_INF)
+    probs_sorted = jax.nn.softmax(sorted_scaled, axis=-1)
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    p = jnp.where(top_p >= 1.0, 1.0, top_p)[:, None]
+    include = (cumprobs - probs_sorted < p) & in_topk
+    count = jnp.maximum(include.sum(axis=-1), 1)                  # [B]
+    cutoff_p = jnp.take_along_axis(sorted_desc, (count - 1)[:, None], axis=-1)
+
+    cutoff = jnp.maximum(kth, cutoff_p)
+    return jnp.where(logits >= cutoff, logits, NEG_INF)
 
 
 def sample_tokens(
@@ -21,6 +95,7 @@ def sample_tokens(
     temperature: jax.Array,   # [B] 0 => greedy
     top_k: jax.Array,         # [B] int32, <=0 => disabled
     top_p: jax.Array,         # [B] float32, >=1 => disabled
+    min_p: Optional[jax.Array] = None,  # [B] float32, <=0 => disabled
 ) -> jax.Array:
     """Returns sampled token ids [B] int32.
 
@@ -28,42 +103,83 @@ def sample_tokens(
     reproduces its exact sample stream regardless of what else is in the
     batch or how long the engine has been running."""
     B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # top-k mask: keep the k highest logits per row
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]          # [B, V]
-    k_idx = jnp.clip(jnp.where(top_k <= 0, V, top_k) - 1, 0, V - 1)
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
-    masked = jnp.where(logits >= kth, logits, NEG_INF)
+    def sampled_branch(l):
+        temp_safe = jnp.maximum(temperature, 1e-6)[:, None]
 
-    # top-p (nucleus) mask over the surviving set
-    temp_safe = jnp.maximum(temperature, 1e-6)[:, None]
-    probs_sorted = jax.nn.softmax(
-        jnp.sort(masked / temp_safe, axis=-1)[:, ::-1], axis=-1
+        need_sort = jnp.any((top_k > 0) | (top_p < 1.0))
+        l = jax.lax.cond(
+            need_sort,
+            lambda x: _mask_topk_topp(x, temp_safe, top_k, top_p),
+            lambda x: x,
+            l,
+        )
+        if min_p is not None:
+            # p_i/p_max >= min_p  <=>  l_i >= l_max + temp*ln(min_p)
+            max_l = jnp.max(l, axis=-1, keepdims=True)
+            mp = jnp.clip(min_p, 1e-10, 1.0)[:, None]
+            thresh = max_l + temp_safe * jnp.log(mp)
+            l = jnp.where(
+                (min_p > 0.0)[:, None] & (l < thresh), NEG_INF, l
+            )
+
+        def row_gumbel(seed, step):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+            return jax.random.gumbel(key, (V,), dtype=jnp.float32)
+
+        gumbel = jax.vmap(row_gumbel)(seeds, steps)
+        return jnp.argmax(l / temp_safe + gumbel, axis=-1).astype(jnp.int32)
+
+    any_sampled = jnp.any(temperature > 0.0)
+    sampled = jax.lax.cond(
+        any_sampled, sampled_branch, lambda l: greedy, logits
     )
-    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
-    # number of tokens needed to reach top_p (at least 1)
-    p = jnp.where(top_p >= 1.0, 1.0, top_p)[:, None]
-    include = cumprobs - probs_sorted < p                      # [B, V] sorted order
-    count = jnp.maximum(include.sum(axis=-1), 1)               # [B]
-    sorted_masked = jnp.sort(masked, axis=-1)[:, ::-1]
-    cutoff = jnp.take_along_axis(sorted_masked, (count - 1)[:, None], axis=-1)
-    masked = jnp.where(masked >= cutoff, masked, NEG_INF)
-
-    # gumbel-max sample at temperature; greedy where temperature == 0
-    def row_gumbel(seed, step):
-        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
-        return jax.random.gumbel(key, (V,), dtype=jnp.float32)
-
-    gumbel = jax.vmap(row_gumbel)(seeds, steps)
-    sampled = jnp.argmax(masked / temp_safe + gumbel, axis=-1)
-    greedy = jnp.argmax(logits, axis=-1)
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
 def logprobs_of(
-    logits: jax.Array,        # [B, V] float32
+    logits: jax.Array,        # [B, V] float32 (pre-penalty logits)
     token_ids: jax.Array,     # [B] the chosen tokens
 ) -> jax.Array:
     """Log-probability of each chosen token [B]."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     return jnp.take_along_axis(logp, token_ids[:, None].astype(jnp.int32), axis=-1)[:, 0]
+
+
+def top_logprobs(
+    logits: jax.Array,        # [B, V] float32
+    need: jax.Array,          # scalar bool: any request wants top logprobs
+    k: int = TOP_LOGPROBS_K,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k (ids, logprobs) rows, or zeros when nobody asked ([B,k] each).
+
+    The cond keeps the top_k scan off the hot path for batches that don't
+    request logprobs."""
+    B, V = logits.shape
+
+    def compute(l):
+        vals, ids = jax.lax.top_k(l, k)
+        lse = jax.nn.logsumexp(l, axis=-1, keepdims=True)
+        return (vals - lse), ids.astype(jnp.int32)
+
+    def zeros(l):
+        return jnp.zeros((B, k), jnp.float32), jnp.zeros((B, k), jnp.int32)
+
+    return jax.lax.cond(need, compute, zeros, logits)
+
+
+def update_counts(
+    output_counts: jax.Array,  # [B, V] int32
+    tokens: jax.Array,         # [B] sampled this step
+    active: jax.Array,         # [B] bool
+    need: jax.Array,           # scalar bool: any penalties enabled
+) -> jax.Array:
+    """Scatter-add the sampled tokens into the per-slot output counts (only
+    maintained while some request has penalties on)."""
+
+    def upd(c):
+        rows = jnp.arange(c.shape[0])
+        return c.at[rows, tokens].add(active.astype(jnp.int32))
+
+    return jax.lax.cond(need, upd, lambda c: c, output_counts)

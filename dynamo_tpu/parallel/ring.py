@@ -77,10 +77,20 @@ def _ring_attention_shard(q, k, v, axis_name: str):
             qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
             q_pos, k_pos, m, l, acc,
         )
-        # rotate for the next step (skipped on the final iteration by loop
-        # bound; a wasted last permute would add one ICI hop of latency)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+
+        # rotate for the next step; guarded so the final iteration skips the
+        # permute (its result would never be read — one wasted ICI hop per
+        # layer otherwise)
+        def rotate(kv):
+            k_cur, v_cur = kv
+            return (
+                jax.lax.ppermute(k_cur, axis_name, perm),
+                jax.lax.ppermute(v_cur, axis_name, perm),
+            )
+
+        k_nxt, v_nxt = jax.lax.cond(
+            t + 1 < sp, rotate, lambda kv: kv, (k_cur, v_cur)
+        )
         return k_nxt, v_nxt, m, l, acc
 
     m0 = jnp.full((S_loc, h, 1), NEG_INF, jnp.float32)
@@ -111,3 +121,103 @@ def ring_prefill_attention(
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+def _ring_extend_shard(
+    q, k, v, q_pos, k_ctx, v_ctx, ctx_len, chunk_start, axis_name: str
+):
+    """Per-shard body for prefix-extend ring attention (inside shard_map).
+
+    q/k/v: this device's chunk shard [S_loc, heads/kv, d]; q_pos [S_loc]
+    absolute positions. k_ctx/v_ctx: the cached-prefix pages (replicated,
+    [T_ctx, kvh, d]) of which the first ``ctx_len`` rows are valid.
+    chunk_start: absolute position of the chunk's first token."""
+    sp = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    S_loc, h, d = q.shape
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((S_loc, h, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((S_loc, h, 1), jnp.float32)
+    acc = jnp.zeros((S_loc, h, d), jnp.float32)
+
+    # 1) attend the cached prefix locally (pages are replicated across sp;
+    #    gather rows past ctx_len are garbage — push their k_pos beyond any
+    #    query so the causal mask kills them)
+    T_ctx = k_ctx.shape[0]
+    if T_ctx > 0:
+        ctx_pos = jnp.arange(T_ctx)
+        ctx_pos = jnp.where(ctx_pos < ctx_len, ctx_pos, jnp.int32(2**30))
+        m, l, acc = _block_attend(
+            qf, k_ctx.astype(jnp.float32), v_ctx.astype(jnp.float32),
+            q_pos, ctx_pos, m, l, acc,
+        )
+
+    # 2) ring over the chunk's own KV shards
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(t, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src = jax.lax.rem(me - t + sp, sp)
+        k_pos = chunk_start + src * S_loc + jnp.arange(S_loc)
+        m, l, acc = _block_attend(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            q_pos, k_pos, m, l, acc,
+        )
+
+        def rotate(kv):
+            k_cur, v_cur = kv
+            return (
+                jax.lax.ppermute(k_cur, axis_name, perm),
+                jax.lax.ppermute(v_cur, axis_name, perm),
+            )
+
+        k_nxt, v_nxt = jax.lax.cond(
+            t + 1 < sp, rotate, lambda kv: kv, (k_cur, v_cur)
+        )
+        return k_nxt, v_nxt, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, sp, step, (k, v, m, l, acc))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_extend_attention(
+    mesh: Mesh,
+    q: jax.Array,        # [S, h, d] chunk queries (shardable on S)
+    k_new: jax.Array,    # [S, kvh, d] chunk keys
+    v_new: jax.Array,
+    k_ctx: jax.Array,    # [T_ctx, kvh, d] gathered prefix pages (replicated)
+    v_ctx: jax.Array,
+    q_positions: jax.Array,  # [S] absolute positions
+    ctx_len: jax.Array,      # scalar: valid prefix length (== chunk start)
+    chunk_start: jax.Array,  # scalar: absolute position of chunk token 0
+    sp_axis: str = AXIS_SP,
+) -> jax.Array:
+    """Prefix-extend attention for chunked prefill, context-parallel over the
+    ``sp`` axis: the engine's long-context prefill path (VERDICT r1 item 2).
+    Each device holds S/sp of the chunk's queries+KV; chunk KV rotates around
+    the ring while the cached-prefix pages are attended locally. The merge is
+    a single online-softmax accumulation chain, so the result is exactly
+    ``extend_attention`` over (prefix ++ chunk)."""
+    sp = mesh.shape[sp_axis]
+    if q.shape[0] % sp:
+        raise ValueError(f"chunk {q.shape[0]} not divisible by sp={sp}")
+    fn = jax.shard_map(
+        functools.partial(_ring_extend_shard, sp_axis and sp_axis, axis_name=sp_axis)
+        if False
+        else functools.partial(_ring_extend_shard, axis_name=sp_axis),
+        mesh=mesh,
+        in_specs=(
+            P(sp_axis, None, None),   # q
+            P(sp_axis, None, None),   # k_new
+            P(sp_axis, None, None),   # v_new
+            P(sp_axis),               # q_pos
+            P(None, None, None),      # k_ctx
+            P(None, None, None),      # v_ctx
+            P(),                      # ctx_len
+            P(),                      # chunk_start
+        ),
+        out_specs=P(sp_axis, None, None),
+        check_vma=False,
+    )
+    return fn(q, k_new, v_new, q_positions, k_ctx, v_ctx, ctx_len, chunk_start)
