@@ -45,6 +45,12 @@ def parse_args():
     p.add_argument("--store", default=None)
     p.add_argument("--store-path", default=None)
     p.add_argument("--event-plane", default=None)
+    p.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu", "axon"],
+        help="force the JAX backend (the axon TPU plugin pins itself even "
+        "when JAX_PLATFORMS=cpu; this applies jax.config.update early so "
+        "CPU smoke runs work on TPU hosts)",
+    )
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--num-blocks", type=int, default=2048)
     p.add_argument("--block-size", type=int, default=16)
@@ -68,6 +74,10 @@ def parse_args():
 
 async def main() -> None:
     args = parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     init_logging()
     cfg = RuntimeConfig.from_env(
         store=args.store, store_path=args.store_path, event_plane=args.event_plane

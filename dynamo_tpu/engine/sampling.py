@@ -26,8 +26,10 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 # top-N logprobs rows returned by the decode program when any request asks
-# for them (OpenAI allows up to 20; vLLM caps similarly)
-TOP_LOGPROBS_K = 8
+# for them. OpenAI's schema allows top_logprobs up to 20, so the on-device
+# top_k matches — requests are never silently clamped below what the API
+# validated (lib/llm/src/protocols/openai/chat_completions/delta.rs analog).
+TOP_LOGPROBS_K = 20
 
 
 def apply_penalties(

@@ -69,6 +69,45 @@ class Backend:
         self.engine = engine
         self.tokenizer = tokenizer
 
+    def _token_entry(self, tid: int, lp: float) -> dict:
+        s = self.tokenizer.decode([tid], skip_special_tokens=False)
+        return {"token": s, "logprob": lp, "bytes": list(s.encode("utf-8"))}
+
+    def _logprob_entries(
+        self,
+        emit_ids: List[int],
+        logprobs: Optional[List[float]],
+        top_logprobs: Optional[List[dict]],
+        n_top: int,
+    ) -> Optional[List[dict]]:
+        """OpenAI-shaped logprob entries, one per emitted token: the chosen
+        token's own (token, logprob, bytes) plus the top-N alternatives,
+        sorted descending. The chosen token is guaranteed present: when it
+        falls outside the engine's top-N it is appended as an N+1th entry
+        (vLLM semantics), so under greedy sampling it always leads the list."""
+        if logprobs is None:
+            return None
+        entries: List[dict] = []
+        for i, tid in enumerate(emit_ids):
+            lp = float(logprobs[i]) if i < len(logprobs) else 0.0
+            entry = self._token_entry(tid, lp)
+            if n_top > 0 and top_logprobs is not None and i < len(top_logprobs):
+                alts = {int(t): float(v) for t, v in top_logprobs[i].items()}
+                chosen_lp = alts.pop(tid, lp)
+                # top-n of the *other* candidates + the chosen token: when the
+                # chosen was in the engine's top-n this yields exactly n rows,
+                # otherwise n+1 rows with the chosen ranked last
+                merged = sorted(alts.items(), key=lambda kv: -kv[1])[:n_top]
+                merged.append((tid, chosen_lp))
+                merged.sort(key=lambda kv: -kv[1])
+                entry["top_logprobs"] = [
+                    self._token_entry(int(t), float(v)) for t, v in merged
+                ]
+            else:
+                entry["top_logprobs"] = []
+            entries.append(entry)
+        return entries
+
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
         req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_obj(request)
         decode = DecodeStream(self.tokenizer)
@@ -112,6 +151,11 @@ class Backend:
                         text_delta += tail
                     else:
                         text_delta += tail + jail.flush()
+            entries = None
+            if (req.sampling.want_logprobs or req.sampling.logprobs > 0) and emit_ids:
+                entries = self._logprob_entries(
+                    emit_ids, out.logprobs, out.top_logprobs, req.sampling.logprobs
+                )
             yield BackendOutput(
                 token_ids=emit_ids,
                 text=text_delta,
@@ -119,6 +163,7 @@ class Backend:
                 cumulative_tokens=produced,
                 logprobs=out.logprobs,
                 top_logprobs=out.top_logprobs,
+                logprob_entries=entries,
                 annotations=out.annotations,
                 kv_transfer=out.kv_transfer,
             ).to_obj()

@@ -31,7 +31,18 @@ class EchoEngine:
                 yield BackendOutput(finish_reason=FINISH_LENGTH, cumulative_tokens=produced)
                 return
             produced += 1
-            yield BackendOutput(token_ids=[tid], cumulative_tokens=produced)
+            # deterministic synthetic logprobs (chosen token is always the
+            # argmax) so API-surface tests can exercise the full
+            # engine->Backend->delta logprob path without a real model
+            lps = None
+            tlps = None
+            if req.sampling.want_logprobs or req.sampling.logprobs > 0:
+                lps = [-0.25]
+                tlps = [{tid: -0.25, (tid + 1) % 512: -1.25, (tid + 2) % 512: -2.25}]
+            yield BackendOutput(
+                token_ids=[tid], cumulative_tokens=produced,
+                logprobs=lps, top_logprobs=tlps,
+            )
             if self.delay_s:
                 await asyncio.sleep(self.delay_s)
         yield BackendOutput(finish_reason=FINISH_STOP, cumulative_tokens=produced)

@@ -65,13 +65,15 @@ class OpenAIPreprocessor:
             frequency_penalty=request.frequency_penalty or 0.0,
             presence_penalty=request.presence_penalty or 0.0,
             repetition_penalty=request.repetition_penalty or 1.0,
-            # chat style: logprobs=true + top_logprobs=N; completions style:
-            # logprobs=N directly
+            # chat style: logprobs=true (+ top_logprobs=N alternatives);
+            # completions style: logprobs=N directly (N=0 still returns the
+            # chosen token's logprob with no alternatives)
             logprobs=(
                 int(request.logprobs)
                 if isinstance(request.logprobs, int) and not isinstance(request.logprobs, bool)
-                else int(request.top_logprobs or 1) if request.logprobs else 0
+                else int(request.top_logprobs or 0)
             ),
+            want_logprobs=request.logprobs is not None and request.logprobs is not False,
         )
         max_new = request.effective_max_tokens()
         budget = self.card.context_length - len(token_ids)

@@ -42,7 +42,11 @@ class SamplingOptions:
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
     repetition_penalty: float = 1.0
-    logprobs: int = 0  # number of top logprobs to return (0 = off)
+    logprobs: int = 0       # number of top-logprob alternatives to return
+    # logprobs can be "on" with zero alternatives (chat logprobs:true without
+    # top_logprobs; completions logprobs:0) — the chosen token's logprob is
+    # still returned, so a separate enable flag is needed
+    want_logprobs: bool = False
 
     def to_obj(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -106,6 +110,10 @@ class BackendOutput:
     # logprob of each token in token_ids (parallel list), optional
     logprobs: Optional[List[float]] = None
     top_logprobs: Optional[List[Dict[int, float]]] = None
+    # detokenized OpenAI-shaped logprob entries, parallel to token_ids; built
+    # by the worker-side Backend (it owns the tokenizer):
+    # {token, logprob, bytes, top_logprobs: [{token, logprob, bytes}, ...]}
+    logprob_entries: Optional[List[Dict[str, Any]]] = None
     # metrics annotations (first chunk): cached_tokens, input_tokens, worker_id
     annotations: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # disaggregation: prefill worker returns kv transfer params here
@@ -123,6 +131,8 @@ class BackendOutput:
             out["top_logprobs"] = [
                 {str(k): v for k, v in d.items()} for d in self.top_logprobs
             ]
+        if self.logprob_entries is not None:
+            out["logprob_entries"] = self.logprob_entries
         if self.annotations:
             out["ann"] = self.annotations
         if self.kv_transfer is not None:
@@ -142,6 +152,7 @@ class BackendOutput:
             ]
             if obj.get("top_logprobs")
             else None,
+            logprob_entries=obj.get("logprob_entries"),
             annotations=obj.get("ann") or {},
             kv_transfer=obj.get("kv_transfer"),
         )

@@ -43,13 +43,25 @@ class ChatDeltaGenerator:
         self.reasoning_parser = reasoning_parser
         self.tool_parser = tool_parser
         self._tool_call_count = 0
+        # logprob entries not yet attached to an emitted content chunk (jail
+        # holdback / parser diversion can delay the text they belong to)
+        self._pending_logprobs: list = []
 
-    def _chunk(self, delta: ChatDelta, finish: Optional[str] = None) -> ChatCompletionChunk:
+    def _chunk(
+        self,
+        delta: ChatDelta,
+        finish: Optional[str] = None,
+        logprobs: Optional[dict] = None,
+    ) -> ChatCompletionChunk:
         return ChatCompletionChunk(
             id=self.id,
             created=self.created,
             model=self.model,
-            choices=[ChatChunkChoice(index=0, delta=delta, finish_reason=finish)],
+            choices=[
+                ChatChunkChoice(
+                    index=0, delta=delta, finish_reason=finish, logprobs=logprobs
+                )
+            ],
         )
 
     def _parse(self, text: str, flush: bool = False):
@@ -89,18 +101,36 @@ class ChatDeltaGenerator:
             self._first = False
             chunks.append(self._chunk(ChatDelta(role="assistant", content="")))
         finished = out.finish_reason is not None
+        step_entries = list(out.logprob_entries or [])
         content, reasoning, tool_calls = self._parse(out.text or "", flush=finished)
         if reasoning:
             chunks.append(self._chunk(ChatDelta(reasoning_content=reasoning)))
         if content:
-            chunks.append(self._chunk(ChatDelta(content=content)))
+            # entries held back earlier (jail/UTF-8 holdback) belong to text
+            # that is only now being released as content
+            lp = None
+            entries = self._pending_logprobs + step_entries
+            self._pending_logprobs = []
+            if entries:
+                lp = {"content": entries}
+            chunks.append(self._chunk(ChatDelta(content=content), logprobs=lp))
+        elif not (reasoning or tool_calls):
+            self._pending_logprobs.extend(step_entries)
+        # else: this step's tokens were diverted into reasoning/tool-call
+        # fields; OpenAI logprobs.content must only cover content tokens, so
+        # their entries are dropped (the engine emits one token per step, so
+        # step granularity == token granularity)
         if tool_calls:
             chunks.append(self._chunk(ChatDelta(tool_calls=tool_calls)))
         if finished:
             finish = out.finish_reason
             if self._tool_call_count and finish == "stop":
                 finish = "tool_calls"
-            chunks.append(self._chunk(ChatDelta(), finish=finish))
+            lp = None
+            if self._pending_logprobs:
+                lp = {"content": self._pending_logprobs}
+                self._pending_logprobs = []
+            chunks.append(self._chunk(ChatDelta(), finish=finish, logprobs=lp))
             if self.include_usage:
                 usage_chunk = ChatCompletionChunk(
                     id=self.id, created=self.created, model=self.model, choices=[],
@@ -133,6 +163,7 @@ async def aggregate_chat(
     text_parts = []
     reasoning_parts = []
     tool_calls = []
+    logprob_entries = []
     finish = None
     async for out in stream:
         for chunk in gen.on_output(out):
@@ -143,6 +174,8 @@ async def aggregate_chat(
                     reasoning_parts.append(choice.delta.reasoning_content)
                 if choice.delta.tool_calls:
                     tool_calls.extend(choice.delta.tool_calls)
+                if choice.logprobs and choice.logprobs.get("content"):
+                    logprob_entries.extend(choice.logprobs["content"])
                 if choice.finish_reason is not None:
                     finish = choice.finish_reason
     return ChatCompletionResponse(
@@ -161,6 +194,7 @@ async def aggregate_chat(
                     ] or None,
                 ),
                 finish_reason=finish or "stop",
+                logprobs={"content": logprob_entries} if logprob_entries else None,
             )
         ],
         usage=gen.usage(),
@@ -170,7 +204,13 @@ async def aggregate_chat(
 class CompletionDeltaGenerator:
     """Streaming text-completions: each step is a partial CompletionResponse."""
 
-    def __init__(self, request_id: str, model: str, include_usage: bool = False):
+    def __init__(
+        self,
+        request_id: str,
+        model: str,
+        include_usage: bool = False,
+        text_offset: int = 0,
+    ):
         self.id = request_id
         self.model = model
         self.created = now_ts()
@@ -178,6 +218,30 @@ class CompletionDeltaGenerator:
         self.prompt_tokens = 0
         self.completion_tokens = 0
         self.cached_tokens: Optional[int] = None
+        self._text_offset = text_offset  # chars of response text emitted so far
+        # entries from steps whose text was held back (stop-string jail /
+        # split UTF-8); they ride on the next emitted chunk
+        self._pending_entries: list = []
+
+    def _completion_logprobs(self, entries: list, chunk_text: str) -> Optional[dict]:
+        """Legacy completions logprobs block: parallel arrays keyed by token
+        string. Offsets are anchored to the *actual* emitted text (cumulative
+        token-string lengths, clamped to the chunk) so jail-trimmed or
+        re-detokenized text never pushes offsets past the response."""
+        if not entries:
+            return None
+        lp = {"tokens": [], "token_logprobs": [], "top_logprobs": [], "text_offset": []}
+        base = self._text_offset
+        cum = 0
+        for e in entries:
+            lp["tokens"].append(e["token"])
+            lp["token_logprobs"].append(e["logprob"])
+            lp["top_logprobs"].append(
+                {alt["token"]: alt["logprob"] for alt in e.get("top_logprobs", [])}
+            )
+            lp["text_offset"].append(base + min(cum, len(chunk_text)))
+            cum += len(e["token"])
+        return lp
 
     def on_output(self, out: BackendOutput):
         if out.annotations:
@@ -186,11 +250,19 @@ class CompletionDeltaGenerator:
                 self.cached_tokens = out.annotations["cached_tokens"]
         self.completion_tokens = max(self.completion_tokens, out.cumulative_tokens)
         chunks = []
+        if out.logprob_entries:
+            self._pending_entries.extend(out.logprob_entries)
         if out.text or out.finish_reason is not None:
+            text = out.text or ""
+            entries, self._pending_entries = self._pending_entries, []
             resp = CompletionResponse(
                 id=self.id, created=self.created, model=self.model,
-                choices=[CompletionChoice(index=0, text=out.text or "", finish_reason=out.finish_reason)],
+                choices=[CompletionChoice(
+                    index=0, text=text, finish_reason=out.finish_reason,
+                    logprobs=self._completion_logprobs(entries, text),
+                )],
             )
+            self._text_offset += len(text)
             chunks.append(resp)
         if out.finish_reason is not None and self.include_usage:
             chunks.append(
@@ -213,11 +285,18 @@ class CompletionDeltaGenerator:
 async def aggregate_completion(
     request_id: str, model: str, stream: AsyncIterator[BackendOutput], echo_text: str = ""
 ) -> CompletionResponse:
-    gen = CompletionDeltaGenerator(request_id, model)
+    gen = CompletionDeltaGenerator(request_id, model, text_offset=len(echo_text))
     parts = [echo_text] if echo_text else []
     finish = None
+    logprobs: Optional[dict] = None
     async for out in stream:
-        gen.on_output(out)
+        for chunk in gen.on_output(out):
+            for choice in chunk.choices:
+                if choice.logprobs:
+                    if logprobs is None:
+                        logprobs = {k: [] for k in choice.logprobs}
+                    for k, v in choice.logprobs.items():
+                        logprobs[k].extend(v)
         if out.text:
             parts.append(out.text)
         if out.finish_reason is not None:
@@ -226,6 +305,9 @@ async def aggregate_completion(
         id=request_id,
         created=gen.created,
         model=model,
-        choices=[CompletionChoice(index=0, text="".join(parts), finish_reason=finish or "stop")],
+        choices=[CompletionChoice(
+            index=0, text="".join(parts), finish_reason=finish or "stop",
+            logprobs=logprobs,
+        )],
         usage=gen.usage(),
     )

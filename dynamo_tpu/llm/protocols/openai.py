@@ -64,6 +64,18 @@ class SamplingFields(_Lenient):
     top_logprobs: Optional[int] = Field(default=None, ge=0, le=20)
     ignore_eos: Optional[bool] = None  # extension, matches reference nvext
 
+    @model_validator(mode="after")
+    def _logprob_bounds(self) -> "SamplingFields":
+        # completions-style integer logprobs: same 0..20 window the chat
+        # top_logprobs field gets from its own Field constraint — reject
+        # instead of silently clamping (engine returns up to 20 rows)
+        if isinstance(self.logprobs, int) and not isinstance(self.logprobs, bool):
+            if not 0 <= self.logprobs <= 20:
+                raise ValueError("logprobs must be between 0 and 20")
+        if self.top_logprobs is not None and not self.logprobs:
+            raise ValueError("top_logprobs requires logprobs to be set")
+        return self
+
     def stop_list(self) -> List[str]:
         if self.stop is None:
             return []

@@ -141,6 +141,125 @@ async def test_completions_endpoint():
         await stop_stack(*handles)
 
 
+async def test_chat_logprobs_e2e():
+    """logprobs flow engine -> Backend (detokenized entries) -> delta
+    generator -> HTTP response, aggregated and streaming; the chosen token
+    leads the top_logprobs list (ref: chat_completions/delta.rs,
+    aggregator.rs)."""
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, base = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            # aggregated
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "echo-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "logprobs": True,
+                    "top_logprobs": 3,
+                    "max_tokens": 4,
+                },
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            lp = body["choices"][0]["logprobs"]
+            assert lp and lp["content"], body
+            for entry in lp["content"]:
+                assert isinstance(entry["token"], str)
+                assert entry["logprob"] <= 0.0
+                assert isinstance(entry["bytes"], list)
+                tops = entry["top_logprobs"]
+                assert 1 <= len(tops) <= 3
+                # chosen token leads the (descending) top list
+                assert tops[0]["token"] == entry["token"]
+                assert tops[0]["logprob"] == entry["logprob"]
+                assert all(
+                    tops[i]["logprob"] >= tops[i + 1]["logprob"]
+                    for i in range(len(tops) - 1)
+                )
+            # streaming
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "echo-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "logprobs": True,
+                    "top_logprobs": 2,
+                    "max_tokens": 4,
+                    "stream": True,
+                },
+            )
+            assert r.status == 200
+            stream_entries = []
+            async for line in r.content:
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                chunk = json.loads(line[len("data: "):])
+                for c in chunk.get("choices", []):
+                    if c.get("logprobs"):
+                        stream_entries.extend(c["logprobs"]["content"])
+            assert stream_entries
+            assert all(e["top_logprobs"][0]["token"] == e["token"] for e in stream_entries)
+            # validation: top_logprobs without logprobs -> 400
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "echo-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "top_logprobs": 3,
+                },
+            )
+            assert r.status == 400
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_completions_logprobs_e2e():
+    """Legacy completions logprobs block: parallel token/logprob/offset
+    arrays (ref: http/service/openai.rs:289 completions handler)."""
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, base = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/completions",
+                json={
+                    "model": "echo-model",
+                    "prompt": "abcd",
+                    "max_tokens": 4,
+                    "logprobs": 2,
+                },
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            lp = body["choices"][0]["logprobs"]
+            assert lp is not None
+            n = len(lp["tokens"])
+            assert n == len(lp["token_logprobs"]) == len(lp["top_logprobs"]) == len(lp["text_offset"])
+            assert n > 0
+            # offsets are monotonically non-decreasing and start at 0 (no echo)
+            assert lp["text_offset"][0] == 0
+            assert all(
+                lp["text_offset"][i] <= lp["text_offset"][i + 1] for i in range(n - 1)
+            )
+            # each top dict contains the chosen token with its own logprob
+            for tok, tlp, tops in zip(lp["tokens"], lp["token_logprobs"], lp["top_logprobs"]):
+                assert tok in tops
+                assert tops[tok] == tlp
+            # out-of-range logprobs rejected
+            r = await s.post(
+                f"{base}/v1/completions",
+                json={"model": "echo-model", "prompt": "x", "logprobs": 21},
+            )
+            assert r.status == 400
+    finally:
+        await stop_stack(*handles)
+
+
 async def test_model_listing_and_404():
     store = MemKVStore()
     stack = await start_stack(store)

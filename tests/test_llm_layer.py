@@ -121,6 +121,81 @@ async def test_backend_eos_token():
     assert outs[-1].finish_reason == "stop"
 
 
+def test_logprob_entries_chosen_outside_top_n():
+    """When the sampled token is not among the engine's top-N the chosen
+    entry is appended as an N+1th row (vLLM semantics), never sliced out."""
+    tok = ByteTokenizer()
+    backend = Backend(EchoEngine(), tok)
+    entries = backend._logprob_entries(
+        emit_ids=[65],
+        logprobs=[-5.0],
+        top_logprobs=[{70: -0.5, 71: -1.0}],  # chosen (65) absent
+        n_top=2,
+    )
+    tops = entries[0]["top_logprobs"]
+    assert len(tops) == 3
+    assert tops[-1]["token"] == "A" and tops[-1]["logprob"] == -5.0
+    assert tops[0]["logprob"] >= tops[1]["logprob"] >= tops[2]["logprob"]
+    # chosen inside top-N: exactly N rows, chosen ranked by value
+    entries = backend._logprob_entries(
+        emit_ids=[65], logprobs=[-0.1], top_logprobs=[{65: -0.1, 70: -0.5}], n_top=2
+    )
+    tops = entries[0]["top_logprobs"]
+    assert len(tops) == 2 and tops[0]["token"] == "A"
+
+
+async def test_backend_logprobs_on_with_zero_alternatives():
+    """chat logprobs:true without top_logprobs / completions logprobs:0 ->
+    entries with the chosen token's logprob and an empty top list."""
+    tok = ByteTokenizer()
+    backend = Backend(EchoEngine(), tok)
+    req = PreprocessedRequest(
+        request_id="r", model="m", token_ids=tok.encode("ab"),
+        stop=StopConditions(max_tokens=2),
+    )
+    req.sampling.want_logprobs = True
+    req.sampling.logprobs = 0
+    outs = []
+    async for obj in backend.generate(req, Context()):
+        outs.append(BackendOutput.from_obj(obj))
+    entries = [e for o in outs for e in (o.logprob_entries or [])]
+    assert entries
+    for e in entries:
+        assert e["top_logprobs"] == []
+        assert e["logprob"] <= 0.0
+
+
+async def test_backend_logprobs_survive_stop_jail_holdback():
+    """Entries from steps whose text is held back by the stop-string jail
+    still reach the stream (pending-buffer path in the delta generators)."""
+    from dynamo_tpu.llm.protocols.delta import CompletionDeltaGenerator
+
+    tok = ByteTokenizer()
+    backend = Backend(EchoEngine(), tok)
+    req = PreprocessedRequest(
+        request_id="r", model="m", token_ids=tok.encode("abEN x"),
+        stop=StopConditions(stop_strings=["END"]),
+    )
+    req.sampling.want_logprobs = True
+    req.sampling.logprobs = 1
+    gen = CompletionDeltaGenerator("r", "m")
+    toks, offsets, text_parts = [], [], []
+    async for obj in backend.generate(req, Context()):
+        out = BackendOutput.from_obj(obj)
+        for chunk in gen.on_output(out):
+            for ch in chunk.choices:
+                text_parts.append(ch.text)
+                if ch.logprobs:
+                    toks.extend(ch.logprobs["tokens"])
+                    offsets.extend(ch.logprobs["text_offset"])
+    text = "".join(text_parts)
+    assert text == "abEN x"  # EN is held back then released (END never completes)
+    # every emitted token has an entry, offsets stay within the text
+    assert len(toks) == len(text)
+    assert all(0 <= o <= len(text) for o in offsets)
+    assert offsets == sorted(offsets)
+
+
 class TestPreprocessor:
     def make(self, ctx_len=1000):
         card = ModelDeploymentCard(name="m", context_length=ctx_len, tokenizer="byte")
