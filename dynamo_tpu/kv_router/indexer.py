@@ -65,6 +65,29 @@ class KvIndexer:
     def block_count(self) -> int:
         return len(self.tree)
 
+    def snapshot(self) -> dict:
+        return {
+            "tree": self.tree.snapshot(),
+            "last_event_id": [
+                [w.to_obj(), eid] for w, eid in self._last_event_id.items()
+            ],
+        }
+
+    def load_snapshot(self, obj: dict) -> None:
+        """MERGE a peer's snapshot into local state (new-replica catch-up).
+
+        Merging — not replacing — means KV events applied live while the
+        snapshot was in flight are never wiped (events and sync ride separate
+        topics with no cross-topic ordering). The cost is soft: a block the
+        worker REMOVED between snapshot-build and arrival is resurrected
+        until the worker's next removal/clear — a stale routing hint, not a
+        correctness loss. Event-id high-water marks take the max per worker
+        so the replay guard stays tight."""
+        self.tree.merge_snapshot(obj.get("tree", {}))
+        for w_obj, eid in obj.get("last_event_id", []):
+            w = WorkerWithDpRank.from_obj(w_obj)
+            self._last_event_id[w] = max(self._last_event_id.get(w, 0), int(eid))
+
 
 class ApproxKvIndexer:
     """Eventless fallback: the router *assumes* whatever it routed is cached.
@@ -104,6 +127,29 @@ class ApproxKvIndexer:
     def remove_worker(self, worker: WorkerWithDpRank) -> None:
         self.tree.remove_worker(worker)
         self._expiry = {k: v for k, v in self._expiry.items() if k[0] != worker}
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        return {
+            "ttl": [
+                [w.to_obj(), sh, max(0.0, exp - now)]
+                for (w, sh), exp in self._expiry.items()
+            ]
+        }
+
+    def load_snapshot(self, obj: dict) -> None:
+        now = time.monotonic()
+        for w_obj, sh, remaining in obj.get("ttl", []):
+            w = WorkerWithDpRank.from_obj(w_obj)
+            expiry = now + float(remaining)
+            # never shorten a fresher TTL learned from live route sync while
+            # the snapshot was in flight (stale heap entries are skipped by
+            # _prune's current-expiry check)
+            if expiry <= self._expiry.get((w, sh), 0.0):
+                continue
+            self.tree.store(w, [sh], None)
+            self._expiry[(w, sh)] = expiry
+            heapq.heappush(self._expiry_heap, (expiry, w, sh))
 
     def _prune(self, now: float) -> None:
         while self._expiry_heap and self._expiry_heap[0][0] <= now:

@@ -119,6 +119,35 @@ class RadixTree:
                 break
         return OverlapScores(scores=scores, matched_blocks=matched)
 
+    # -- snapshot -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Serializable full-tree state (reference: the router state snapshot
+        gated by KvRouterConfig's snapshot threshold, kv_router.rs:163-165)."""
+        return {
+            "nodes": [
+                [n.seq_hash, n.parent, [w.to_obj() for w in sorted(n.workers)]]
+                for n in self._nodes.values()
+            ]
+        }
+
+    def merge_snapshot(self, obj: dict) -> None:
+        """Add every (node, worker) pair from a snapshot to this tree;
+        existing state is kept (see KvIndexer.load_snapshot for why merge)."""
+        for seq_hash, parent, workers in obj.get("nodes", []):
+            for w in workers:
+                self.store(WorkerWithDpRank.from_obj(w), [seq_hash], parent)
+        # nodes arrive in arbitrary order; store() can only link a child to a
+        # parent that already exists, so re-link in a second pass
+        for node in self._nodes.values():
+            if node.parent is not None and node.parent in self._nodes:
+                self._nodes[node.parent].children.add(node.seq_hash)
+
+    @classmethod
+    def from_snapshot(cls, obj: dict) -> "RadixTree":
+        tree = cls()
+        tree.merge_snapshot(obj)
+        return tree
+
     # -- introspection ------------------------------------------------------
     def worker_block_count(self, worker: WorkerWithDpRank) -> int:
         return len(self._worker_blocks.get(worker, ()))

@@ -6,11 +6,19 @@ Analog of the reference's KvRouter/KvScheduler service side
 takes a tokenized request, hashes it into blocks, queries the prefix index,
 and returns a (worker_id, dp_rank, overlap) decision; active-request
 bookkeeping feeds the load term while worker metrics are in flight.
+
+Replica sync (config.replica_sync, reference subscriber.rs): every routing
+decision/completion is published on ``kv.sync.<ns>.<component>``; peer
+routers ingest them so their load (and, in approx mode, prefix) views agree.
+A router that starts late sends a snapshot request on the same topic and the
+first peer to answer ships its full indexer state + in-flight load table.
 """
 
 from __future__ import annotations
 
 import asyncio
+import random
+import uuid
 from typing import Dict, List, Optional, Sequence
 
 import msgpack
@@ -24,6 +32,10 @@ from .publisher import events_topic, metrics_topic
 from .scheduler import KvRouterConfig, KvScheduler, SchedulingDecision
 
 log = get_logger("kv_router.router")
+
+
+def sync_topic(namespace: str, component: str) -> str:
+    return f"kv.sync.{namespace}.{component}"
 
 
 class KvRouter:
@@ -51,6 +63,17 @@ class KvRouter:
         self._tasks: List[asyncio.Task] = []
         # request_id -> (worker, blocks) for free() on completion
         self._active: Dict[str, tuple] = {}
+        # replica sync state
+        self.router_id = uuid.uuid4().hex
+        self._remote_active: Dict[tuple, tuple] = {}  # (router, req) -> (worker, blocks)
+        self.synced_from_peer = False
+        # frees with no matching active entry during the startup window are
+        # remembered as tombstones, so a snapshot listing the same request
+        # (built before the free) doesn't add phantom in-flight load
+        self._free_tombstones: set = set()
+        self._tombstone_deadline = 0.0
+        # requesters whose snapshot someone already answered (reply dedup)
+        self._snapshots_seen: set = set()
 
     async def start(self) -> "KvRouter":
         if self.config.use_kv_events:
@@ -60,6 +83,12 @@ class KvRouter:
         m_sub = await self._plane.subscribe(metrics_topic(self.namespace, self.component))
         self._subs.append(m_sub)
         self._tasks.append(asyncio.create_task(self._metrics_loop(m_sub)))
+        if self.config.replica_sync:
+            s_sub = await self._plane.subscribe(sync_topic(self.namespace, self.component))
+            self._subs.append(s_sub)
+            self._tasks.append(asyncio.create_task(self._sync_loop(s_sub)))
+            self._tombstone_deadline = asyncio.get_running_loop().time() + 5.0
+            await self._publish_sync({"kind": "snapshot_request"})
         return self
 
     async def _event_loop(self, sub: Subscription) -> None:
@@ -78,6 +107,113 @@ class KvRouter:
                 self.scheduler.update_metrics(m)
             except Exception:
                 log.exception("bad metrics event")
+
+    # -- replica sync --------------------------------------------------------
+    async def _publish_sync(self, obj: dict) -> None:
+        obj["router"] = self.router_id
+        await self._plane.publish(
+            sync_topic(self.namespace, self.component),
+            msgpack.packb(obj, use_bin_type=True),
+        )
+
+    def _publish_sync_soon(self, obj: dict) -> None:
+        """Fire-and-forget from sync code paths (schedule_tokens/complete)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (unit tests driving the router synchronously)
+        t = loop.create_task(self._publish_sync(obj))
+        self._tasks.append(t)
+        t.add_done_callback(lambda t: self._tasks.remove(t) if t in self._tasks else None)
+
+    async def _sync_loop(self, sub: Subscription) -> None:
+        async for _topic, payload in sub:
+            try:
+                obj = msgpack.unpackb(payload, raw=False)
+                if obj.get("router") == self.router_id:
+                    continue
+                self._apply_sync(obj)
+            except Exception:
+                log.exception("bad sync event")
+
+    def _apply_sync(self, obj: dict) -> None:
+        kind = obj.get("kind")
+        if kind == "route":
+            worker = WorkerWithDpRank.from_obj(obj["worker"])
+            blocks = int(obj["blocks"])
+            key = (obj["router"], obj["request_id"])
+            self._remote_active[key] = (worker, blocks)
+            self.scheduler.add_local_load(worker, blocks)
+            if isinstance(self.indexer, ApproxKvIndexer) and obj.get("hashes"):
+                self.indexer.process_routed_request(list(obj["hashes"]), worker)
+        elif kind == "free":
+            entry = self._remote_active.pop((obj["router"], obj["request_id"]), None)
+            if entry is not None:
+                self.scheduler.sub_local_load(*entry)
+            elif (
+                not self.synced_from_peer
+                and asyncio.get_running_loop().time() < self._tombstone_deadline
+            ):
+                # a free racing ahead of the snapshot that lists its request:
+                # remember it so the snapshot entry is skipped, not leaked
+                self._free_tombstones.add((obj["router"], obj["request_id"]))
+        elif kind == "snapshot_request":
+            self._answer_snapshot_soon(obj["router"])
+        elif kind == "snapshot":
+            target = obj.get("for")
+            self._snapshots_seen.add(target)
+            if target != self.router_id or self.synced_from_peer:
+                return
+            self.synced_from_peer = True
+            self.indexer.load_snapshot(obj.get("indexer", {}))
+            for rid, req_id, w_obj, blocks in obj.get("active", []):
+                worker = WorkerWithDpRank.from_obj(w_obj)
+                key = (rid, req_id)
+                if key in self._free_tombstones or key in self._remote_active:
+                    continue
+                self._remote_active[key] = (worker, int(blocks))
+                self.scheduler.add_local_load(worker, int(blocks))
+            self._free_tombstones.clear()
+            log.info(
+                "router %s synced from peer: %d blocks, %d in-flight",
+                self.router_id[:8], len(self.indexer.tree), len(self._remote_active),
+            )
+
+    def _answer_snapshot_soon(self, requester: str) -> None:
+        """Reply to a snapshot request after a small jittered delay, skipping
+        if another peer's answer for the same requester was seen meanwhile —
+        without this, every peer ships its full tree for every joiner."""
+        if not (len(self.indexer.tree) > 0 or self._active or self._remote_active):
+            return
+        self._snapshots_seen.discard(requester)
+
+        async def answer() -> None:
+            await asyncio.sleep(0.05 + 0.2 * random.random())
+            if requester in self._snapshots_seen:
+                return
+            await self._publish_sync(
+                {
+                    "kind": "snapshot",
+                    "for": requester,
+                    "indexer": self.indexer.snapshot(),
+                    "active": [
+                        [rid, req_id, w.to_obj(), blocks]
+                        for (rid, req_id), (w, blocks) in self._remote_active.items()
+                    ]
+                    + [
+                        [self.router_id, req_id, w.to_obj(), blocks]
+                        for req_id, (w, blocks) in self._active.items()
+                    ],
+                }
+            )
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        t = loop.create_task(answer())
+        self._tasks.append(t)
+        t.add_done_callback(lambda t: self._tasks.remove(t) if t in self._tasks else None)
 
     # -- the routing decision ------------------------------------------------
     def schedule_tokens(
@@ -98,6 +234,16 @@ class KvRouter:
             self._active[request_id] = (decision.worker, new_blocks)
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routed_request(hashes, decision.worker)
+        if self.config.replica_sync and request_id is not None:
+            msg = {
+                "kind": "route",
+                "request_id": request_id,
+                "worker": decision.worker.to_obj(),
+                "blocks": new_blocks,
+            }
+            if isinstance(self.indexer, ApproxKvIndexer):
+                msg["hashes"] = list(hashes)
+            self._publish_sync_soon(msg)
         return decision
 
     def complete(self, request_id: str) -> None:
@@ -106,11 +252,25 @@ class KvRouter:
         if entry is not None:
             worker, blocks = entry
             self.scheduler.sub_local_load(worker, blocks)
+            if self.config.replica_sync:
+                self._publish_sync_soon({"kind": "free", "request_id": request_id})
 
     def remove_worker_id(self, worker_id: int) -> None:
-        for w in [w for w in self.indexer.tree.workers() if w.worker_id == worker_id]:
+        # a dead worker may hold scheduler load without any tree blocks (it
+        # was routed to but never published an event), so clear scheduler
+        # state for every rank seen in the in-flight tables too
+        gone = {w for w in self.indexer.tree.workers() if w.worker_id == worker_id}
+        for table in (self._active, self._remote_active):
+            gone.update(w for w, _ in table.values() if w.worker_id == worker_id)
+        for w in gone:
             self.indexer.remove_worker(w)
             self.scheduler.remove_worker(w)
+        self._active = {
+            k: v for k, v in self._active.items() if v[0].worker_id != worker_id
+        }
+        self._remote_active = {
+            k: v for k, v in self._remote_active.items() if v[0].worker_id != worker_id
+        }
 
     async def stop(self) -> None:
         for t in self._tasks:

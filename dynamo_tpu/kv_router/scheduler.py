@@ -34,7 +34,11 @@ class KvRouterConfig:
     overlap_score_weight: float = 1.0
     router_temperature: float = 0.0
     use_kv_events: bool = True            # False -> ApproxKvIndexer
-    replica_sync: bool = False            # sync routing decisions across routers
+    # publish routing decisions / completions on the event plane and ingest
+    # peers', so replicated routers share one load + (approx) prefix view;
+    # new replicas catch up via a snapshot handshake (reference:
+    # lib/llm/src/kv_router/subscriber.rs, kv_router.rs:163-165)
+    replica_sync: bool = False
     metrics_stale_after_s: float = 10.0
     approx_ttl_s: float = 120.0
 

@@ -1,0 +1,7 @@
+"""Standalone KV-router service (analog of the reference's
+components/src/dynamo/router: a routing endpoint any client can call for a
+worker set it does not own — used for prefill pools and shared frontends)."""
+
+from .service import RouterService
+
+__all__ = ["RouterService"]

@@ -1,0 +1,69 @@
+"""python -m dynamo_tpu.router — standalone KV-router service.
+
+Analog of the reference's `python -m dynamo.router`
+(components/src/dynamo/router/__main__.py): exposes KV-aware worker selection
+for a component's worker set as its own endpoint, so prefill orchestrators
+and multiple frontends can share one routing brain. Run several with
+--replica-sync and their load/prefix views stay consistent.
+"""
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.kv_router import KvRouterConfig
+from dynamo_tpu.router.service import RouterService
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+
+
+def parse_args():
+    p = argparse.ArgumentParser("dynamo_tpu.router")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--store", default=None)
+    p.add_argument("--store-path", default=None)
+    p.add_argument("--event-plane", default=None)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--overlap-score-weight", type=float, default=1.0)
+    p.add_argument("--router-temperature", type=float, default=0.0)
+    p.add_argument("--no-kv-events", action="store_true",
+                   help="use the ApproxKvIndexer instead of worker KV events")
+    p.add_argument("--replica-sync", action="store_true",
+                   help="sync decisions + state with other router instances")
+    return p.parse_args()
+
+
+async def main() -> None:
+    args = parse_args()
+    init_logging()
+    cfg = RuntimeConfig.from_env(
+        store=args.store, store_path=args.store_path, event_plane=args.event_plane
+    )
+    runtime = await DistributedRuntime(cfg).start()
+    service = await RouterService(
+        runtime,
+        namespace=args.namespace,
+        component=args.component,
+        endpoint=args.endpoint,
+        block_size=args.block_size,
+        config=KvRouterConfig(
+            overlap_score_weight=args.overlap_score_weight,
+            router_temperature=args.router_temperature,
+            use_kv_events=not args.no_kv_events,
+            replica_sync=args.replica_sync,
+        ),
+    ).start()
+    print(f"ROUTER_READY {service.router.router_id}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await service.stop()
+    await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,243 @@
+"""Replica-synced routers + standalone router service.
+
+Mirrors the reference's two-router replica-sync e2e
+(tests/router/test_router_e2e_with_mockers.py; lib/llm/src/kv_router/
+subscriber.rs): N routers over one event plane agree on load and (approx)
+prefix views, late joiners catch up via snapshot, and the standalone
+`dynamo_tpu.router` service routes for a mocker fleet over the request plane.
+"""
+
+import asyncio
+
+from dynamo_tpu.kv_router import (
+    KvEventPublisher,
+    KvRouter,
+    KvRouterConfig,
+    WorkerWithDpRank,
+)
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    InProcEventPlane,
+    MemKVStore,
+    RuntimeConfig,
+)
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+W0 = WorkerWithDpRank(0)
+W1 = WorkerWithDpRank(1)
+BS = 4
+
+
+async def drain():
+    for _ in range(5):
+        await asyncio.sleep(0.01)
+
+
+async def poll(cond, timeout=3.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            return False
+        await asyncio.sleep(0.02)
+    return True
+
+
+async def test_replica_sync_shares_load_view():
+    """Router B sees the load router A routed (reference subscriber.rs)."""
+    plane = InProcEventPlane()
+    cfg = KvRouterConfig(replica_sync=True)
+    a = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    b = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    try:
+        prompt = list(range(32))  # 8 blocks
+        d = a.schedule_tokens(prompt, [W0, W1], request_id="r1")
+        await drain()
+        # B accounts A's in-flight blocks on the same worker
+        assert b.scheduler.decode_blocks(d.worker) == 8
+        assert a.scheduler.decode_blocks(d.worker) == 8
+        # B's next decision avoids the loaded worker
+        d2 = b.schedule_tokens(list(range(100, 132)), [W0, W1], request_id="r2")
+        assert d2.worker != d.worker
+        # completion on A propagates
+        a.complete("r1")
+        await drain()
+        assert b.scheduler.decode_blocks(d.worker) == 0
+    finally:
+        await a.stop()
+        await b.stop()
+        await plane.close()
+
+
+async def test_replica_sync_approx_prefix_stickiness():
+    """Approx mode: peers mirror routed prefixes, so the same prompt routed
+    through *either* router lands on the same worker."""
+    plane = InProcEventPlane()
+    cfg = KvRouterConfig(replica_sync=True, use_kv_events=False)
+    a = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    b = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    try:
+        prompt = list(range(64))
+        d = a.schedule_tokens(prompt, [W0, W1], request_id="r1")
+        a.complete("r1")
+        await drain()
+        d2 = b.schedule_tokens(prompt, [W0, W1], request_id="r2")
+        assert d2.worker == d.worker
+        assert d2.overlap_blocks > 0
+    finally:
+        await a.stop()
+        await b.stop()
+        await plane.close()
+
+
+async def test_late_joiner_snapshot_catchup():
+    """A router that starts after the fleet has state receives a peer
+    snapshot: full prefix tree + in-flight load (kv_router.rs:163-165)."""
+    plane = InProcEventPlane()
+    cfg = KvRouterConfig(replica_sync=True)
+    a = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    try:
+        pub = KvEventPublisher(plane, "ns", "be", worker_id=0, block_size=BS)
+        prompt = list(range(32))
+        await pub.stored(compute_sequence_hashes(prompt, BS))
+        await drain()
+        assert len(a.indexer.tree) == 8
+        a.schedule_tokens(prompt, [W0, W1], request_id="inflight")
+
+        b = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+        assert await poll(lambda: b.synced_from_peer)  # jittered snapshot reply
+        assert len(b.indexer.tree) == 8
+        # in-flight load came across too: W0 holds the full prefix, so the
+        # only load is optimistic prefill bookkeeping (0 new blocks) — check
+        # the tables agree instead of a specific number
+        assert b.scheduler.decode_blocks(W0) == a.scheduler.decode_blocks(W0)
+        # and B routes the same prompt to the same worker A would
+        assert (
+            b.schedule_tokens(prompt, [W0, W1]).worker
+            == a.schedule_tokens(prompt, [W0, W1]).worker
+        )
+        await b.stop()
+    finally:
+        await a.stop()
+        await plane.close()
+
+
+async def test_live_events_survive_snapshot_merge():
+    """KV events applied while a snapshot is in flight are merged, not wiped:
+    the joiner ends with snapshot blocks AND the live event's blocks."""
+    plane = InProcEventPlane()
+    cfg = KvRouterConfig(replica_sync=True)
+    a = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    try:
+        pub = KvEventPublisher(plane, "ns", "be", worker_id=0, block_size=BS)
+        await pub.stored(compute_sequence_hashes(list(range(16)), BS))  # 4 blocks
+        await drain()
+        b = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+        # before the (jittered) snapshot reply lands, a fresh event arrives
+        # and B applies it live
+        await pub.stored(compute_sequence_hashes(list(range(100, 116)), BS))
+        assert await poll(lambda: b.synced_from_peer)
+        assert await poll(lambda: len(b.indexer.tree) == 8), len(b.indexer.tree)
+        await b.stop()
+    finally:
+        await a.stop()
+        await plane.close()
+
+
+async def _start_mocker(runtime, name, instance_id, plane):
+    from dynamo_tpu.kv_router import WorkerMetricsPublisher
+    from dynamo_tpu.llm import ModelDeploymentCard, register_llm
+    from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+
+    kv_pub = KvEventPublisher(plane, "dynamo", "backend", worker_id=instance_id, block_size=BS)
+    m_pub = WorkerMetricsPublisher(plane, "dynamo", "backend", worker_id=instance_id)
+    engine = MockerEngine(MockEngineArgs(block_size=BS, num_blocks=512), kv_pub, m_pub)
+    card = ModelDeploymentCard(
+        name=name, tokenizer="byte", kv_block_size=BS, context_length=4096
+    )
+    return await register_llm(runtime, engine, card, instance_id=instance_id)
+
+
+async def test_standalone_router_service_over_mockers():
+    """Two replica-synced RouterServices route a mocker fleet consistently:
+    the same prompt asked of either service lands on the same worker, with
+    overlap visible on the repeat (reference components/src/dynamo/router)."""
+    from dynamo_tpu.router import RouterService
+
+    store = MemKVStore()
+    plane = InProcEventPlane()
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+
+    def rt():
+        return DistributedRuntime(cfg, store=store, event_plane=plane)
+
+    worker_rt = await rt().start()
+    r1_rt = await rt().start()
+    r2_rt = await rt().start()
+    caller_rt = await rt().start()
+    s1 = await _start_mocker(worker_rt, "mock", 11, plane)
+    s2 = await _start_mocker(worker_rt, "mock", 22, plane)
+    svc_cfg = KvRouterConfig(replica_sync=True)
+    svc1 = await RouterService(r1_rt, block_size=BS, config=svc_cfg).start()
+    svc2 = await RouterService(r2_rt, block_size=BS, config=svc_cfg).start()
+    try:
+        await svc1.client.wait_for_instances(2, timeout=10)
+        await svc2.client.wait_for_instances(2, timeout=10)
+        client = await (
+            caller_rt.namespace("dynamo").component("backend-router").endpoint("route")
+        ).client()
+        await client.wait_for_instances(2, timeout=10)
+
+        async def route(instance_id, token_ids, rid):
+            stream = await client.generate(
+                {"op": "route", "token_ids": token_ids, "request_id": rid},
+                instance_id=instance_id,
+            )
+            async for item in stream:
+                return item
+
+        # address each service explicitly (instance ids are random, so sorted
+        # order says nothing about which service is which)
+        iids = [svc1.served.instance_id, svc2.served.instance_id]
+        prompt = list(range(40))
+        first = await route(iids[0], prompt, "q1")
+        assert "worker_id" in first, first
+        # run the generation on the routed mocker: its KV events flow to both
+        # routers, so the repeat prompt asked of the *other* service sticks
+        from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+
+        gen_client = await (
+            caller_rt.namespace("dynamo").component("backend").endpoint("generate")
+        ).client()
+        await gen_client.wait_for_instances(2, timeout=10)
+        req = PreprocessedRequest(
+            request_id="q1", model="mock", token_ids=prompt,
+            stop=StopConditions(max_tokens=2, ignore_eos=True),
+        )
+        stream = await gen_client.generate(
+            req.to_obj(), instance_id=first["worker_id"]
+        )
+        async for _ in stream:
+            pass
+        await drain()
+        second = await route(iids[1], prompt, "q2")
+        assert second["worker_id"] == first["worker_id"]
+        assert second["overlap_blocks"] > 0
+        await gen_client.stop()
+        # free on the service that routed it
+        stream = await client.generate(
+            {"op": "free", "request_id": "q1"}, instance_id=iids[0]
+        )
+        async for item in stream:
+            assert item == {"ok": True}
+        # state introspection reports both routers synced on one view
+        stream = await client.generate({"op": "state"}, instance_id=iids[1])
+        async for st in stream:
+            assert st["router_id"] == svc2.router.router_id
+    finally:
+        await svc1.stop()
+        await svc2.stop()
+        for s in (s1, s2):
+            await s.stop()
+        for r in (worker_rt, r1_rt, r2_rt, caller_rt):
+            await r.shutdown()
+        await plane.close()
