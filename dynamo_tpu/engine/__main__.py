@@ -45,6 +45,11 @@ def parse_args():
     p.add_argument("--store", default=None)
     p.add_argument("--store-path", default=None)
     p.add_argument("--event-plane", default=None)
+    p.add_argument("--status-port", type=int, default=-1,
+                   help="system status server port (/health /live /metrics "
+                   "/metadata); 0 = ephemeral, -1 = disabled")
+    p.add_argument("--graceful-timeout", type=float, default=10.0,
+                   help="seconds to wait for in-flight requests on shutdown")
     p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu", "axon"],
         help="force the JAX backend (the axon TPU plugin pins itself even "
@@ -170,15 +175,65 @@ async def main() -> None:
         ),
     )
     served = await register_llm(runtime, engine, card, instance_id=instance_id)
-    print(f"TPU_ENGINE_READY {args.model} tp={args.tp}", flush=True)
+
+    # health: engine watchdog + endpoint canary + status side-port
+    # (reference: engine_monitor.py, health_check.rs, system_status_server.rs)
+    from dynamo_tpu.engine.monitor import EngineWatchdog
+    from dynamo_tpu.runtime.health import EndpointCanary, HealthState, StatusServer
 
     stop = asyncio.Event()
-    loop = asyncio.get_event_loop()
+    health = HealthState()
+
+    async def on_down() -> None:
+        stop.set()  # watchdog already deregistered; exit so a supervisor restarts
+
+    watchdog = EngineWatchdog(engine, [served], state=health, on_down=on_down).start()
+    canary = EndpointCanary(
+        {f"{card.component}/{card.endpoint}": served.address}, state=health
+    ).start()
+    status_server = None
+    if args.status_port >= 0:
+        g_running = runtime.metrics.gauge("dtpu_engine_running_seqs", "active sequences")
+        g_waiting = runtime.metrics.gauge("dtpu_engine_waiting_seqs", "queued sequences")
+        g_free = runtime.metrics.gauge("dtpu_engine_free_blocks", "free KV blocks")
+        g_cached = runtime.metrics.gauge("dtpu_engine_cached_blocks", "prefix-cached KV blocks")
+
+        def refresh_gauges() -> None:
+            snap = engine.snapshot()
+            g_running.set(snap["running"])
+            g_waiting.set(snap["waiting"])
+            g_free.set(snap["free_blocks"])
+            g_cached.set(snap["cached_blocks"])
+
+        status_server = StatusServer(
+            health,
+            metrics_scope=runtime.metrics,
+            pre_expose=refresh_gauges,
+            metadata_fn=lambda: {
+                "model": args.model,
+                "instance_id": f"{instance_id:016x}",
+                "tp": args.tp,
+                "engine": engine.snapshot(),
+                "canary_rtt_s": canary.last_rtt,
+            },
+            port=args.status_port,
+        )
+        await status_server.start()
+    print(f"TPU_ENGINE_READY {args.model} tp={args.tp}", flush=True)
+
+    loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    # graceful drain: deregister first (discovery stops routing here), then
+    # the request server waits out in-flight streams before closing
+    await watchdog.stop()
+    await canary.stop()
+    if status_server is not None:
+        await status_server.stop()
+    if not watchdog.fired:
+        await served.stop(graceful_timeout_s=args.graceful_timeout)
     engine.stop()
-    await served.stop()
     await runtime.shutdown()
 
 

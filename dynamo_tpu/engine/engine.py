@@ -202,6 +202,10 @@ class TpuEngine:
         self._dev_cache: Dict[str, jax.Array] = {}
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
+        # engine health: False after a step-loop crash (watchdog deregisters
+        # the worker; reference components/src/dynamo/vllm/engine_monitor.py)
+        self.healthy = True
+        self.on_crash: Optional[Any] = None  # callback(exc) scheduled on loop crash
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-step")
         self._offload_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-offload"
@@ -661,8 +665,11 @@ class TpuEngine:
                 await asyncio.sleep(0)
         except asyncio.CancelledError:
             pass
-        except Exception:
+        except Exception as crash:
             log.exception("engine loop crashed")
+            self.healthy = False
+            if self.on_crash is not None:
+                asyncio.ensure_future(self.on_crash(crash))
             for st in list(self._waiting) + [s for s in self._slots if s]:
                 st.done = True
                 st.out_queue.put_nowait(

@@ -169,6 +169,11 @@ class KvRouter:
             for rid, req_id, w_obj, blocks in obj.get("active", []):
                 worker = WorkerWithDpRank.from_obj(w_obj)
                 key = (rid, req_id)
+                if rid == self.router_id:
+                    # our own route reflected back by a peer's snapshot: the
+                    # load already sits in _active, and our future 'free' is
+                    # ignored by our own sync loop — adding here would leak
+                    continue
                 if key in self._free_tombstones or key in self._remote_active:
                     continue
                 self._remote_active[key] = (worker, int(blocks))

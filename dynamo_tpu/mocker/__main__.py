@@ -34,6 +34,8 @@ def parse_args():
     p.add_argument("--migration-limit", type=int, default=0)
     p.add_argument("--model-type", default="chat,completions")
     p.add_argument("--num-workers", type=int, default=1, help="instances in this process")
+    p.add_argument("--status-port", type=int, default=-1,
+                   help="system status server port (0 = ephemeral, -1 = off)")
     return p.parse_args()
 
 
@@ -79,13 +81,32 @@ async def main() -> None:
         )
         s = await register_llm(runtime, engine, card, instance_id=instance_id)
         served.append(s)
+    canary = status_server = None
+    if args.status_port >= 0:
+        from dynamo_tpu.runtime.health import EndpointCanary, HealthState, StatusServer
+
+        health = HealthState()
+        canary = EndpointCanary(
+            {f"worker/{s.instance_id:016x}": s.address for s in served}, state=health
+        ).start()
+        status_server = StatusServer(
+            health,
+            metrics_scope=runtime.metrics,
+            metadata_fn=lambda: {"model": args.model, "workers": len(served)},
+            port=args.status_port,
+        )
+        await status_server.start()
     print(f"MOCKER_READY {len(served)} workers", flush=True)
 
     stop = asyncio.Event()
-    loop = asyncio.get_event_loop()
+    loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if canary is not None:
+        await canary.stop()
+    if status_server is not None:
+        await status_server.stop()
     for s in served:
         await s.stop()
     await runtime.shutdown()

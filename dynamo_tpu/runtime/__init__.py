@@ -21,6 +21,7 @@ from .discovery.store import (
 from .distributed import DistributedRuntime, make_runtime
 from .engine import AsyncEngine, Context, FnEngine, Operator, collect
 from .event_plane.base import EventPlane, InProcEventPlane, Subscription
+from .health import EndpointCanary, HealthState, StatusServer
 from .logging import get_logger, init_logging
 from .metrics import MetricsScope
 from .request_plane.tcp import NoResponders, RequestPlaneError, TcpClient, TcpRequestServer
@@ -32,8 +33,11 @@ __all__ = [
     "Context",
     "DistributedRuntime",
     "Endpoint",
+    "EndpointCanary",
     "EventPlane",
     "EventType",
+    "HealthState",
+    "StatusServer",
     "FileKVStore",
     "FnEngine",
     "InProcEventPlane",

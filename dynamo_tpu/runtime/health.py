@@ -1,0 +1,189 @@
+"""Health subsystem: canary checks + per-component system status server.
+
+Analogs of the reference's canary health checks (lib/runtime/src/
+health_check.rs — synthetic probes through the real serving path, not just
+process liveness) and the system status server
+(lib/runtime/src/system_status_server.rs:159-215 — /health /live /metrics
+/metadata on a side port for every component, not only the HTTP frontend).
+
+The canary pings a worker's own served endpoints over the actual TCP request
+plane (connect + codec + server loop), so a wedged event loop or dead socket
+fails the probe even while the process is alive. Consecutive failures flip
+the subsystem unhealthy and fire a callback (deregister, shed, restart —
+caller's choice).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Awaitable, Callable, Dict, List, Optional
+
+from aiohttp import web
+
+from . import metrics as M
+from .logging import get_logger
+from .request_plane.tcp import TcpClient
+
+log = get_logger("runtime.health")
+
+
+class HealthState:
+    """Aggregated health of named subsystems (endpoints, engine, planes)."""
+
+    def __init__(self):
+        self._subsystems: Dict[str, bool] = {}
+        self._detail: Dict[str, str] = {}
+
+    def set(self, name: str, healthy: bool, detail: str = "") -> None:
+        self._subsystems[name] = healthy
+        self._detail[name] = detail
+
+    def remove(self, name: str) -> None:
+        self._subsystems.pop(name, None)
+        self._detail.pop(name, None)
+
+    @property
+    def healthy(self) -> bool:
+        return all(self._subsystems.values()) if self._subsystems else True
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "status": "healthy" if self.healthy else "unhealthy",
+            "subsystems": {
+                name: {"healthy": ok, "detail": self._detail.get(name, "")}
+                for name, ok in self._subsystems.items()
+            },
+        }
+
+
+class EndpointCanary:
+    """Periodic request-plane pings of served endpoints.
+
+    targets: name -> address. After ``fail_threshold`` consecutive failures a
+    target is marked unhealthy in ``state`` and ``on_unhealthy(name)`` fires
+    once per downtime episode; a later success marks it healthy again."""
+
+    def __init__(
+        self,
+        targets: Dict[str, str],
+        state: Optional[HealthState] = None,
+        interval_s: float = 1.0,
+        timeout_s: float = 2.0,
+        fail_threshold: int = 3,
+        on_unhealthy: Optional[Callable[[str], Awaitable[None]]] = None,
+    ):
+        self.targets = dict(targets)
+        self.state = state or HealthState()
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.fail_threshold = fail_threshold
+        self.on_unhealthy = on_unhealthy
+        self.last_rtt: Dict[str, float] = {}
+        self._fails: Dict[str, int] = {}
+        self._down: set = set()
+        self._client = TcpClient()
+        self._task: Optional[asyncio.Task] = None
+        for name in self.targets:
+            self.state.set(name, True, "not probed yet")
+
+    async def probe_once(self) -> None:
+        for name, address in list(self.targets.items()):
+            try:
+                rtt = await self._client.ping(address, timeout=self.timeout_s)
+                self.last_rtt[name] = rtt
+                self._fails[name] = 0
+                self._down.discard(name)
+                self.state.set(name, True, f"rtt={rtt*1000:.1f}ms")
+            except Exception as e:
+                n = self._fails.get(name, 0) + 1
+                self._fails[name] = n
+                if n >= self.fail_threshold:
+                    self.state.set(name, False, f"{n} consecutive failures: {e}")
+                    if name not in self._down:
+                        self._down.add(name)
+                        log.warning("canary: endpoint %s unhealthy (%s)", name, e)
+                        if self.on_unhealthy is not None:
+                            await self.on_unhealthy(name)
+
+    def start(self) -> "EndpointCanary":
+        async def loop() -> None:
+            try:
+                while True:
+                    await self.probe_once()
+                    await asyncio.sleep(self.interval_s)
+            except asyncio.CancelledError:
+                pass
+
+        self._task = asyncio.create_task(loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        await self._client.close()
+
+
+class StatusServer:
+    """Side-port HTTP server exposing component health and metrics.
+
+    Routes (reference system_status_server.rs:159-215):
+      /health    aggregated HealthState (+ canary RTTs), 503 when unhealthy
+      /live      process liveness (always 200 while serving)
+      /metrics   Prometheus exposition from the runtime registry
+      /metadata  caller-provided component metadata (model, config, snapshot)
+    """
+
+    def __init__(
+        self,
+        state: HealthState,
+        metrics_scope: Optional[M.MetricsScope] = None,
+        metadata_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        pre_expose: Optional[Callable[[], None]] = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self.state = state
+        self.metrics = metrics_scope
+        self.metadata_fn = metadata_fn
+        self.pre_expose = pre_expose  # refresh gauges right before scraping
+        self.host = host
+        self.port = port
+        self.started_at = time.time()
+        self._runner: Optional[web.AppRunner] = None
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/metadata", self._metadata)
+        self.app = app
+
+    async def _health(self, request: web.Request) -> web.Response:
+        snap = self.state.snapshot()
+        return web.json_response(snap, status=200 if self.state.healthy else 503)
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live", "uptime_s": time.time() - self.started_at})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        if self.pre_expose is not None:
+            self.pre_expose()
+        body = self.metrics.expose() if self.metrics is not None else b""
+        return web.Response(body=body, content_type="text/plain")
+
+    async def _metadata(self, request: web.Request) -> web.Response:
+        meta = self.metadata_fn() if self.metadata_fn is not None else {}
+        return web.json_response(meta)
+
+    async def start(self) -> str:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.info("status server on %s:%d", self.host, self.port)
+        return f"{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()

@@ -193,6 +193,7 @@ class _Conn:
         self.writer = writer
         self.write_lock = asyncio.Lock()
         self.streams: Dict[str, asyncio.Queue] = {}
+        self.pong_waiters: list = []  # Futures resolved FIFO by pong frames
         self.reader_task: Optional[asyncio.Task] = None
         self.closed = False
 
@@ -207,6 +208,13 @@ class _Conn:
                 msg = await _read_frame(self.reader)
                 if msg is None:
                     break
+                if msg.get("t") == "pong":
+                    while self.pong_waiters:
+                        fut = self.pong_waiters.pop(0)
+                        if not fut.done():
+                            fut.set_result(True)
+                            break
+                    continue
                 rid = msg.get("id")
                 q = self.streams.get(rid)
                 if q is not None:
@@ -217,6 +225,10 @@ class _Conn:
             self.closed = True
             for q in self.streams.values():
                 q.put_nowait({"t": "err", "error": "connection lost", "code": "no_responders"})
+            for fut in self.pong_waiters:
+                if not fut.done():
+                    fut.set_result(False)
+            self.pong_waiters.clear()
             self.writer.close()
 
 
@@ -295,6 +307,26 @@ class TcpClient:
                 conn.streams.pop(rid, None)
 
         return stream()
+
+    async def ping(self, address: str, timeout: float = 2.0) -> float:
+        """Round-trip a ping through the full request-plane path (connect,
+        frame codec, server read loop). Returns RTT seconds; raises
+        NoResponders on connect failure or pong timeout. This is the canary
+        probe primitive (reference: lib/runtime/src/health_check.rs)."""
+        t0 = asyncio.get_running_loop().time()
+        conn = await self._get_conn(address)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        conn.pong_waiters.append(fut)
+        try:
+            await conn.send({"t": "ping"})
+            ok = await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, ConnectionResetError, BrokenPipeError) as e:
+            if fut in conn.pong_waiters:
+                conn.pong_waiters.remove(fut)
+            raise NoResponders(f"ping {address}: {e!r}") from e
+        if not ok:
+            raise NoResponders(f"ping {address}: connection lost")
+        return asyncio.get_running_loop().time() - t0
 
     async def close(self) -> None:
         for conn in self._conns.values():

@@ -1,0 +1,224 @@
+"""Health subsystem: canary probes, status server, engine watchdog, drain.
+
+Mirrors the reference's canary health checks (lib/runtime/src/health_check.rs),
+system status server (system_status_server.rs:159-215), vLLM engine monitor
+(components/src/dynamo/vllm/engine_monitor.py) and graceful-shutdown drain
+(DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT).
+"""
+
+import asyncio
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.engine.monitor import EngineWatchdog
+from dynamo_tpu.llm import (
+    EchoEngine,
+    ModelDeploymentCard,
+    ModelManager,
+    ModelWatcher,
+    register_llm,
+)
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    EndpointCanary,
+    HealthState,
+    InProcEventPlane,
+    MemKVStore,
+    RuntimeConfig,
+    StatusServer,
+)
+
+
+def make_rt(store):
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    return DistributedRuntime(cfg, store=store, event_plane=InProcEventPlane())
+
+
+async def poll(cond, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            return False
+        await asyncio.sleep(0.02)
+    return True
+
+
+async def test_canary_detects_dead_endpoint_and_status_server_reports():
+    store = MemKVStore()
+    rt = await make_rt(store).start()
+    served = await (
+        rt.namespace("ns").component("c").endpoint("gen").serve(EchoEngine().generate)
+    )
+    state = HealthState()
+    down_names = []
+
+    async def on_unhealthy(name):
+        down_names.append(name)
+
+    canary = EndpointCanary(
+        {"c/gen": served.address}, state=state,
+        interval_s=0.05, timeout_s=0.5, fail_threshold=2,
+        on_unhealthy=on_unhealthy,
+    )
+    status = StatusServer(state, metadata_fn=lambda: {"model": "m"}, host="127.0.0.1")
+    await status.start()
+    try:
+        await canary.probe_once()
+        assert state.healthy
+        assert canary.last_rtt["c/gen"] > 0
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(f"http://127.0.0.1:{status.port}/health")
+            assert r.status == 200
+            assert (await r.json())["subsystems"]["c/gen"]["healthy"]
+            r = await s.get(f"http://127.0.0.1:{status.port}/metadata")
+            assert (await r.json())["model"] == "m"
+            r = await s.get(f"http://127.0.0.1:{status.port}/live")
+            assert r.status == 200
+
+        # kill the endpoint's server: probes must flip it unhealthy
+        await served.server.stop(0.1)
+        await canary.probe_once()
+        await canary.probe_once()
+        assert not state.healthy
+        assert down_names == ["c/gen"]
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(f"http://127.0.0.1:{status.port}/health")
+            assert r.status == 503
+    finally:
+        await canary.stop()
+        await status.stop()
+        await served.stop()
+        await rt.shutdown()
+
+
+def tiny_engine():
+    mcfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+    cfg = TpuEngineConfig(
+        model=mcfg, num_blocks=64, block_size=4, max_batch_size=4,
+        max_context=256, prefill_buckets=(16, 32, 64, 128, 256),
+    )
+    return TpuEngine(cfg, mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+
+
+async def test_engine_crash_deregisters_model_before_requests_fail():
+    """The done-bar from the reference's engine monitor: when the engine loop
+    dies, the watchdog pulls the model out of discovery — new requests get a
+    clean 404 (model gone) instead of being routed into a dead worker."""
+    store = MemKVStore()
+    worker_rt = await make_rt(store).start()
+    frontend_rt = await make_rt(store).start()
+    engine = tiny_engine()
+    card = ModelDeploymentCard(
+        name="crashy", tokenizer="byte", context_length=256, kv_block_size=4
+    )
+    served = await register_llm(worker_rt, engine, card)
+    watchdog = EngineWatchdog(engine, [served], poll_s=0.02).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        assert await poll(
+            lambda: manager.get("crashy") is not None
+            and manager.get("crashy").client.instances
+        )
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "crashy", "messages": [{"role": "user", "content": "ok"}],
+                      "max_tokens": 2, "ignore_eos": True},
+            )
+            assert r.status == 200
+
+        # poison the device programs: the next request crashes the step loop
+        def boom(*a, **k):
+            raise RuntimeError("injected device failure")
+
+        engine._prefill_fn = boom
+        engine._decode_fn = boom
+        engine._decode_multi_fn = boom
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "crashy", "messages": [{"role": "user", "content": "x"}],
+                      "max_tokens": 2, "ignore_eos": True},
+            )
+            # in-flight request fails (single worker, nothing to migrate
+            # to): either an HTTP error or a terminal "error" finish from
+            # the crash handler's drain of live sequences
+            if r.status == 200:
+                body = await r.json()
+                assert body["choices"][0]["finish_reason"] == "error", body
+
+        assert await poll(lambda: not engine.healthy)
+        assert await poll(lambda: watchdog.fired)
+        # the model leaves discovery...
+        assert await poll(lambda: manager.get("crashy") is None)
+        # ...so new requests fail clean: 404 model-not-found, not a timeout
+        # into a dead worker
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "crashy", "messages": [{"role": "user", "content": "y"}]},
+            )
+            assert r.status == 404
+    finally:
+        await watchdog.stop()
+        await service.stop()
+        await watcher.stop()
+        engine.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()
+
+
+async def test_graceful_stop_drains_inflight_stream():
+    """ServedEndpoint.stop() deregisters immediately but lets in-flight
+    streams finish (graceful drain, reference GracefulShutdownTracker)."""
+    store = MemKVStore()
+    rt = await make_rt(store).start()
+    echo = EchoEngine(delay_s=0.02)
+
+    async def handler(req, ctx):
+        async for out in echo.generate(req, ctx):
+            yield out.to_obj()
+
+    served = await rt.namespace("ns").component("c").endpoint("gen").serve(handler)
+    client_rt = await make_rt(store).start()
+    client = await (
+        client_rt.namespace("ns").component("c").endpoint("gen").client()
+    )
+    try:
+        await client.wait_for_instances(1, timeout=5)
+        req = PreprocessedRequest(
+            request_id="r", model="m", token_ids=list(range(20)),
+            stop=StopConditions(max_tokens=20, ignore_eos=True),
+        )
+        stream = await client.generate(req.to_obj(), Context())
+        got = []
+
+        async def consume():
+            async for item in stream:
+                got.append(item)
+
+        consumer = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)  # a few tokens in flight
+        await served.stop(graceful_timeout_s=5.0)  # must NOT cut the stream
+        await asyncio.wait_for(consumer, timeout=5)
+        token_count = sum(len(o.get("token_ids", [])) for o in got)
+        assert token_count == 20, f"stream was cut at {token_count}/20 tokens"
+    finally:
+        await client.stop()
+        await client_rt.shutdown()
+        await rt.shutdown()
