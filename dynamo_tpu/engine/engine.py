@@ -159,7 +159,7 @@ class TpuEngine:
         self._offload_pending: List[Tuple[int, int]] = []  # (block_id, seq_hash)
 
         # --- place params + caches on the mesh ---
-        self._forward = registry.forward_fn(self.mcfg)
+        self._forward = registry.forward_fn(self.mcfg, self.mesh)
         self._lm_logits = registry.lm_logits_fn(self.mcfg)
         with self.mesh:
             if params is None:

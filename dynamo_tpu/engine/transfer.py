@@ -34,6 +34,7 @@ Wire protocol (served as a normal endpoint, "kv_fetch"):
 from __future__ import annotations
 
 import time
+import zlib
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import jax
@@ -146,7 +147,7 @@ class KvTransferServer:
             leased = self._lease_slots(n) if native_ok else None
             if leased is not None:
                 slots, token = leased
-                await self._gather_into_arena(block_ids, slots)
+                checksums = await self._gather_into_arena(block_ids, slots)
                 yield {
                     "matched": n,
                     "block_shape": self._block_shape,
@@ -157,6 +158,13 @@ class KvTransferServer:
                         "region": NATIVE_REGION,
                         "slots": slots,
                         "token": token,
+                        # end-to-end integrity: the client re-checksums what
+                        # it fetched. If this lease expired mid-read and the
+                        # slots were re-gathered for another request, the
+                        # torn bytes fail the check and the client recomputes
+                        # instead of importing poison into its
+                        # content-addressed prefix cache
+                        "crc32": checksums,
                     },
                 }
             else:
@@ -188,20 +196,26 @@ class KvTransferServer:
 
         return await loop.run_in_executor(self.engine._executor, gather)
 
-    async def _gather_into_arena(self, block_ids: List[int], slots: List[int]) -> None:
+    async def _gather_into_arena(
+        self, block_ids: List[int], slots: List[int]
+    ) -> List[int]:
+        """Returns the per-slot crc32 of the bytes placed in the arena."""
         import asyncio
 
         loop = asyncio.get_event_loop()
 
-        def gather():
+        def gather() -> List[int]:
             arr = self._gather_np(block_ids, dtype=None)  # [L, 2, n, ...]
             block_major = np.moveaxis(arr, 2, 0)          # [n, L, 2, ...]
             n = len(block_ids)
             flat = block_major.reshape(n, -1)
+            sums = []
             for i, s in enumerate(slots):
                 self._arena[s] = flat[i]
+                sums.append(zlib.crc32(self._arena[s].view(np.uint8)))
+            return sums
 
-        await loop.run_in_executor(self.engine._executor, gather)
+        return await loop.run_in_executor(self.engine._executor, gather)
 
     def close(self) -> None:
         if self._agent is not None:
@@ -287,6 +301,20 @@ class KvTransferClient:
                     pass
             except Exception:
                 pass  # lease expiry reclaims the slots
+        # integrity check: if our lease expired mid-read and a re-lease
+        # overwrote the slots, the bytes are torn — importing them would
+        # poison the content-addressed prefix cache with wrong KV under a
+        # valid hash. Verify against the server's gather-time checksums.
+        expected = nat.get("crc32")
+        if expected is not None:
+            for i in range(matched):
+                if zlib.crc32(raw[i]) != expected[i]:
+                    log.warning(
+                        "kv transfer checksum mismatch on slot %s (stale "
+                        "lease overwrite?); recomputing prefill locally",
+                        nat["slots"][i],
+                    )
+                    return None
         return raw.view(dtype).reshape([matched] + list(block_shape))
 
     async def close(self) -> None:

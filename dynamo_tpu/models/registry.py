@@ -6,6 +6,9 @@ rather than hard-coded architectures).
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import AXIS_TP
@@ -20,8 +23,48 @@ def init_params(rng, cfg):
     return (moe if is_moe(cfg) else llama).init_params(rng, cfg)
 
 
-def forward_fn(cfg):
-    return (moe if is_moe(cfg) else llama).forward
+def forward_fn(cfg, mesh=None):
+    """Forward pass for the family. For MoE the FFN strategy is picked here
+    so serving never pays dense all-expert FLOPs (ADVICE r2):
+
+    - experts replicated (no mesh / tp==1): exact per-token gather
+      (moe_ffn_gather, T*K expert applications instead of T*E)
+    - experts sharded over tp (EP rides the TP axis): shard_map'd
+      moe_ffn_ep_psum — each shard computes only its local experts, one
+      psum combines (same collective as a TP row matmul)
+    """
+    if not is_moe(cfg):
+        return llama.forward
+    # the gather path materializes [T, H, I] per-token weight copies: a win
+    # at decode widths, an OOM at prefill widths — pick per program off the
+    # static token count (each prefill bucket compiles its own program)
+    GATHER_MAX_TOKENS = 32
+    if mesh is None or mesh.shape.get(AXIS_TP, 1) == 1:
+        def ffn_local(p, _cfg, x):
+            if x.shape[0] <= GATHER_MAX_TOKENS:
+                return moe.moe_ffn_gather(p, _cfg, x)
+            return moe.moe_ffn(p, _cfg, x)
+
+        return partial(moe.forward, ffn_fn=ffn_local)
+
+    # one source of truth for the expert layout: the same specs the engine
+    # places the params with (below)
+    layer_specs = param_specs(cfg)["layer"]
+    ep_keys = ("w_router", "w_gate", "w_up", "w_down")
+    ep_specs = ({k: layer_specs[k] for k in ep_keys}, P())
+
+    def ffn(p, _cfg, x):
+        sub = {k: p[k] for k in ep_keys}
+        fn = jax.shard_map(
+            lambda sp, sx: moe.moe_ffn_ep_psum(sp, _cfg, sx, AXIS_TP),
+            mesh=mesh,
+            in_specs=ep_specs,
+            out_specs=P(),
+            check_vma=False,
+        )
+        return fn(sub, x)
+
+    return partial(moe.forward, ffn_fn=ffn)
 
 
 def lm_logits_fn(cfg):

@@ -194,6 +194,10 @@ class _Conn:
         self.write_lock = asyncio.Lock()
         self.streams: Dict[str, asyncio.Queue] = {}
         self.pong_waiters: list = []  # Futures resolved FIFO by pong frames
+        # pongs owed to pings that already timed out: discarded instead of
+        # resolving the NEXT ping's future (a wedged-but-alive server would
+        # otherwise look healthy forever via off-by-one pong credit)
+        self.stale_pongs = 0
         self.reader_task: Optional[asyncio.Task] = None
         self.closed = False
 
@@ -209,6 +213,9 @@ class _Conn:
                 if msg is None:
                     break
                 if msg.get("t") == "pong":
+                    if self.stale_pongs > 0:
+                        self.stale_pongs -= 1
+                        continue
                     while self.pong_waiters:
                         fut = self.pong_waiters.pop(0)
                         if not fut.done():
@@ -317,12 +324,18 @@ class TcpClient:
         conn = await self._get_conn(address)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         conn.pong_waiters.append(fut)
+        sent = False
         try:
             await conn.send({"t": "ping"})
+            sent = True
             ok = await asyncio.wait_for(fut, timeout)
         except (asyncio.TimeoutError, ConnectionResetError, BrokenPipeError) as e:
             if fut in conn.pong_waiters:
                 conn.pong_waiters.remove(fut)
+                if sent and isinstance(e, asyncio.TimeoutError):
+                    # our pong may still arrive late; it must be discarded,
+                    # not credited to the next ping
+                    conn.stale_pongs += 1
             raise NoResponders(f"ping {address}: {e!r}") from e
         if not ok:
             raise NoResponders(f"ping {address}: connection lost")

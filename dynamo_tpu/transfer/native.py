@@ -32,6 +32,9 @@ _lib = None
 _lib_lock = threading.Lock()
 _build_failed = False
 _build_thread: Optional[threading.Thread] = None
+# arenas whose agent teardown leaked its threads: kept alive forever so the
+# leaked writev path can never read freed memory
+_LEAKED_ARENAS: list = []
 
 
 def _build() -> bool:
@@ -75,7 +78,7 @@ def _load(build: bool = True) -> Optional[ctypes.CDLL]:
         ]
         lib.dtpu_agent_unregister.restype = ctypes.c_int
         lib.dtpu_agent_unregister.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.dtpu_agent_free.restype = None
+        lib.dtpu_agent_free.restype = ctypes.c_int  # 0 freed, 1 leaked
         lib.dtpu_agent_free.argtypes = [ctypes.c_void_p]
         lib.dtpu_fetch.restype = ctypes.c_longlong
         lib.dtpu_fetch.argtypes = [
@@ -148,8 +151,17 @@ class NativeAgent:
 
     def close(self) -> None:
         if self._handle:
-            self._lib.dtpu_agent_free(self._handle)
+            rc = self._lib.dtpu_agent_free(self._handle)
             self._handle = None
+            if rc == 1:
+                # teardown leaked the agent: its connection threads may still
+                # writev from our arenas, so the buffers must outlive us —
+                # park them for the process lifetime instead of freeing
+                log.warning(
+                    "native agent leaked on close; pinning %d arena(s) for "
+                    "process lifetime", len(self._regions),
+                )
+                _LEAKED_ARENAS.append(dict(self._regions))
             self._regions.clear()
 
     def __del__(self):

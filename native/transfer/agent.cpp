@@ -175,7 +175,9 @@ void serve_conn(Agent* a, int fd) {
     }
     if (!writev_all(fd, iov)) break;
   }
-  ::close(fd);
+  // deregister BEFORE close: the kernel reuses fd numbers immediately, so
+  // closing first could make this erase remove a newly-accepted connection's
+  // entry and leave it invisible to dtpu_agent_free's shutdown sweep
   {
     std::lock_guard<std::mutex> lk(a->mu);
     for (auto it = a->conn_fds.begin(); it != a->conn_fds.end(); ++it) {
@@ -185,6 +187,7 @@ void serve_conn(Agent* a, int fd) {
       }
     }
   }
+  ::close(fd);
   a->active_conns.fetch_sub(1);
 }
 
@@ -284,8 +287,14 @@ int dtpu_agent_unregister(void* agent, uint64_t region_id) {
   return a->regions.erase(region_id) ? 0 : -1;
 }
 
-void dtpu_agent_free(void* agent) {
-  if (!agent) return;
+// Returns 0 when the agent was fully torn down, 1 when connection threads
+// failed to drain and the Agent was intentionally leaked. A leaked agent's
+// threads may still read registered regions: the CALLER MUST keep every
+// registered buffer alive for the process lifetime on rc=1 (the Python
+// wrapper parks them in a graveyard) — freeing them would be a use-after-free
+// in the leaked writev path.
+int dtpu_agent_free(void* agent) {
+  if (!agent) return 0;
   Agent* a = static_cast<Agent*>(agent);
   a->stopping.store(true);
   ::shutdown(a->listen_fd, SHUT_RDWR);
@@ -300,8 +309,9 @@ void dtpu_agent_free(void* agent) {
   for (int spins = 0; a->active_conns.load() > 0 && spins < 5000; ++spins) {
     ::usleep(1000);
   }
-  if (a->active_conns.load() > 0) return;  // leak rather than free under a race
+  if (a->active_conns.load() > 0) return 1;  // leak rather than free under a race
   delete a;
+  return 0;
 }
 
 // Blocking gather of n blocks from a remote agent into dst (must hold

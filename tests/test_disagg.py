@@ -225,3 +225,61 @@ async def test_disagg_uses_native_transfer(monkeypatch):
     finally:
         prefill.stop()
         decode.stop()
+
+
+async def test_stale_lease_overwrite_never_imports_torn_bytes(monkeypatch):
+    """The slot-lease race (ADVICE r2): a fetch stalled past lease expiry
+    whose slots were re-gathered for another request must NOT import the
+    overwritten bytes — the gather-time checksums catch the tear and the
+    decode side recomputes, keeping greedy output identical."""
+    import numpy as np
+    import pytest
+
+    import dynamo_tpu.transfer as nt
+
+    if not nt.native_available():
+        pytest.skip("native toolchain unavailable")
+
+    prefill = TpuEngine(tiny_cfg())
+    decode = TpuEngine(tiny_cfg())
+    overwrote = []
+    real_fetch = nt.native_fetch
+
+    def stalled_fetch(host, port, region, slots, block_bytes):
+        # simulate: while this client is stalled, the lease expires and the
+        # server re-gathers ANOTHER request into the same slots
+        srv = prefill._kv_transfer_srv
+        srv._arena.view(np.uint8)[np.asarray(slots)] ^= 0xFF  # torn bytes
+        overwrote.append(list(slots))
+        return real_fetch(host, port, region, slots, block_bytes)
+
+    monkeypatch.setattr(nt, "native_fetch", stalled_fetch)
+    try:
+        addr = await prefill.serve_transfer()
+        prompt = list(range(100, 140))  # 10 blocks
+        ref_engine = TpuEngine(tiny_cfg())
+        try:
+            ref = []
+            async for out in ref_engine.generate(preq("ref", prompt), Context()):
+                ref.extend(out.token_ids)
+        finally:
+            ref_engine.stop()
+        async for _ in prefill.generate(preq("p", prompt, max_tokens=1), Context()):
+            pass
+        hashes = [int(h) for h in compute_sequence_hashes(prompt, 4)]
+        req = preq("d", prompt)
+        req.kv_transfer = {"address": addr, "hashes": hashes}
+        toks = []
+        cached = None
+        async for out in decode.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.annotations and "cached_tokens" in out.annotations:
+                cached = out.annotations["cached_tokens"]
+        assert overwrote, "native path not exercised"
+        # torn bytes rejected: nothing imported, prefill recomputed locally
+        assert not cached
+        # and the output is still correct (no poisoned prefix cache)
+        assert toks == ref
+    finally:
+        prefill.stop()
+        decode.stop()

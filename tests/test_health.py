@@ -99,6 +99,36 @@ async def test_canary_detects_dead_endpoint_and_status_server_reports():
         await rt.shutdown()
 
 
+async def test_stale_pong_not_credited_to_next_ping():
+    """A pong owed to a timed-out ping is discarded, not credited to the
+    next ping — otherwise a consistently-slow endpoint pings 'healthy'
+    forever off by one."""
+    from dynamo_tpu.runtime import NoResponders, TcpClient
+
+    store = MemKVStore()
+    rt = await make_rt(store).start()
+    served = await (
+        rt.namespace("ns").component("c").endpoint("gen").serve(EchoEngine().generate)
+    )
+    client = TcpClient()
+    try:
+        import pytest
+
+        with pytest.raises(NoResponders):
+            await client.ping(served.address, timeout=0.000001)  # forced timeout
+        conn = client._conns[served.address]
+        assert conn.stale_pongs == 1
+        await asyncio.sleep(0.1)  # the owed pong arrives and is discarded
+        rtt = await client.ping(served.address, timeout=2.0)
+        assert rtt < 1.0
+        assert conn.stale_pongs == 0
+        assert not conn.pong_waiters
+    finally:
+        await client.close()
+        await served.stop()
+        await rt.shutdown()
+
+
 def tiny_engine():
     mcfg = LlamaConfig(
         vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,

@@ -48,6 +48,14 @@ class TestEpEquivalence:
         self.x = jnp.asarray(rng.standard_normal((16, self.cfg.hidden_size)), jnp.float32)
         self.ref = moe.moe_ffn(self.p, self.cfg, self.x)
 
+    def test_gather_matches_dense(self):
+        """The sparse serving path (per-token expert gathers, T*K FLOPs)
+        is exact: identical to the dense all-expert reference."""
+        got = moe.moe_ffn_gather(self.p, self.cfg, self.x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(self.ref), rtol=2e-5, atol=2e-5
+        )
+
     @pytest.mark.parametrize("ep", [2, 4])
     def test_psum_matches_dense(self, ep):
         mesh = meshlib.make_mesh(tp=ep, devices=jax.devices()[:ep])
