@@ -57,6 +57,10 @@ def parse_args():
         "CPU smoke runs work on TPU hosts)",
     )
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel ranks served by this worker: each rank "
+                   "gets its own engine + KV pool on its own tp-sized device "
+                   "group; the KV router targets (worker, dp_rank)")
     p.add_argument("--num-blocks", type=int, default=2048)
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--max-batch-size", type=int, default=8)
@@ -107,13 +111,6 @@ async def main() -> None:
         model_type = ["prefill"]
 
     instance_id = new_instance_id()
-    kv_pub = KvEventPublisher(
-        runtime.event_plane, args.namespace, component,
-        worker_id=instance_id, block_size=args.block_size,
-    )
-    m_pub = WorkerMetricsPublisher(
-        runtime.event_plane, args.namespace, component, worker_id=instance_id
-    )
     bs = args.block_size
 
     def rnd(n):  # round up to a block multiple
@@ -137,23 +134,68 @@ async def main() -> None:
             disk_capacity_bytes=int(args.kvbm_disk_gb * (1 << 30)),
             disk_path=args.kvbm_disk_path,
         )
-    engine = TpuEngine(
-        TpuEngineConfig(
-            model=mcfg,
-            num_blocks=args.num_blocks,
-            block_size=args.block_size,
-            max_batch_size=args.max_batch_size,
-            max_context=args.max_context,
-            tp=args.tp,
-            prefill_buckets=buckets,
-        ),
-        params=params,
-        kv_publisher=kv_pub,
-        metrics_publisher=m_pub,
-        kvbm=kvbm,
+    engine_cfg = TpuEngineConfig(
+        model=mcfg,
+        num_blocks=args.num_blocks,
+        block_size=args.block_size,
+        max_batch_size=args.max_batch_size,
+        max_context=args.max_context,
+        tp=args.tp,
+        prefill_buckets=buckets,
     )
+
+    import jax as _jax
+
+    from dynamo_tpu.parallel.mesh import make_mesh
+
+    def rank_mesh(rank: int):
+        """Each dp_rank serves from its own tp-sized device group when the
+        host has enough chips; otherwise ranks share (CPU smoke / 1 chip)."""
+        devs = _jax.devices()
+        lo = rank * args.tp
+        if len(devs) >= args.dp * args.tp:
+            return make_mesh(tp=args.tp, devices=devs[lo : lo + args.tp])
+        if rank == 0 and args.dp > 1 and _jax.default_backend() != "cpu":
+            # sharing chips means every rank allocates a FULL KV cache +
+            # param copy on the same HBM — fine for smoke runs, an OOM
+            # hazard on real hardware
+            print(
+                f"WARNING: {len(devs)} device(s) < dp*tp={args.dp * args.tp}; "
+                f"all {args.dp} ranks share the same chips (HBM use scales "
+                f"with dp). Provision dp*tp chips for real dp serving.",
+                flush=True,
+            )
+        return make_mesh(tp=args.tp, devices=devs[: args.tp])
+
+    engines = []
+    for r in range(args.dp):
+        kv_pub = KvEventPublisher(
+            runtime.event_plane, args.namespace, component,
+            worker_id=instance_id, dp_rank=r, block_size=args.block_size,
+        )
+        m_pub = WorkerMetricsPublisher(
+            runtime.event_plane, args.namespace, component,
+            worker_id=instance_id, dp_rank=r,
+        )
+        engines.append(
+            TpuEngine(
+                engine_cfg,
+                params=params,
+                mesh=rank_mesh(r),
+                kv_publisher=kv_pub,
+                metrics_publisher=m_pub,
+                kvbm=kvbm if r == 0 else None,  # host tiers are rank-0 only
+            )
+        )
+    if args.dp > 1:
+        from dynamo_tpu.engine.dp import DpEngineGroup
+
+        engine = DpEngineGroup(engines)
+    else:
+        engine = engines[0]
     if args.disagg in ("prefill", "decode"):
-        addr = await engine.serve_transfer(host=cfg.host_ip)
+        transfer_engine = engines[0]
+        addr = await transfer_engine.serve_transfer(host=cfg.host_ip)
         print(f"KV_TRANSFER at {addr}", flush=True)
 
     card = ModelDeploymentCard(
@@ -168,6 +210,7 @@ async def main() -> None:
         migration_limit=args.migration_limit,
         runtime_config=ModelRuntimeConfig(
             total_kv_blocks=args.num_blocks,
+            data_parallel_size=args.dp,
             kv_block_size=args.block_size,
             max_batch_size=args.max_batch_size,
             tensor_parallel_size=args.tp,
@@ -200,10 +243,11 @@ async def main() -> None:
 
         def refresh_gauges() -> None:
             snap = engine.snapshot()
-            g_running.set(snap["running"])
-            g_waiting.set(snap["waiting"])
-            g_free.set(snap["free_blocks"])
-            g_cached.set(snap["cached_blocks"])
+            ranks = snap["ranks"] if "ranks" in snap else [snap]
+            g_running.set(sum(r["running"] for r in ranks))
+            g_waiting.set(sum(r["waiting"] for r in ranks))
+            g_free.set(sum(r["free_blocks"] for r in ranks))
+            g_cached.set(sum(r["cached_blocks"] for r in ranks))
 
         status_server = StatusServer(
             health,

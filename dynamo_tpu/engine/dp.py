@@ -1,0 +1,78 @@
+"""DpEngineGroup: one worker process serving N data-parallel ranks.
+
+Analog of the reference's dp_rank-aware workers: each dp_rank owns an
+independent KV pool and decode batch, the router targets a specific
+(worker_id, dp_rank), and non-selected ranks simply don't see the request
+(reference: lib/llm/src/kv_router/scheduler.rs:543-560 iterating every
+dp_rank per worker; components/src/dynamo/vllm/main.py:67 non-leader rank
+processes idling behind one endpoint).
+
+TPU-native shape: rank r runs its own TpuEngine over its own device slice
+(``meshes[r]``) — on a multi-chip host the ranks are disjoint chip groups
+doing replicated serving; in CI they share the virtual CPU mesh. Each rank
+publishes KV events and load metrics stamped with its dp_rank, so the
+router's radix tree and cost model see N independent pools behind one
+instance id.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, List, Optional
+
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from .engine import TpuEngine
+
+log = get_logger("engine.dp")
+
+
+class DpEngineGroup:
+    """Dispatches requests to the dp_rank the router selected."""
+
+    def __init__(self, engines: List[TpuEngine]):
+        if not engines:
+            raise ValueError("DpEngineGroup needs at least one engine")
+        self.engines = engines
+
+    @property
+    def dp_size(self) -> int:
+        return len(self.engines)
+
+    @property
+    def healthy(self) -> bool:
+        return all(e.healthy for e in self.engines)
+
+    @property
+    def on_crash(self):
+        return self.engines[0].on_crash
+
+    @on_crash.setter
+    def on_crash(self, cb) -> None:
+        # the watchdog's push hook fans out: any rank's crash trips it
+        for e in self.engines:
+            e.on_crash = cb
+
+    def rank_of(self, request: Any) -> int:
+        ann = request.get("annotations") if isinstance(request, dict) else (
+            getattr(request, "annotations", None)
+        )
+        rank = int((ann or {}).get("dp_rank", 0))
+        if not 0 <= rank < self.dp_size:
+            log.warning("dp_rank %d out of range (dp=%d); using 0", rank, self.dp_size)
+            rank = 0
+        return rank
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        rank = self.rank_of(request)
+        async for out in self.engines[rank].generate(request, context):
+            yield out
+
+    def snapshot(self) -> dict:
+        return {
+            "dp_size": self.dp_size,
+            "ranks": [e.snapshot() for e in self.engines],
+        }
+
+    def stop(self) -> None:
+        for e in self.engines:
+            e.stop()

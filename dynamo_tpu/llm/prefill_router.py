@@ -78,9 +78,17 @@ class PrefillRouter:
 
         instance_id: Optional[int] = None
         if self.kv_router is not None and self.client.instances:
-            cands = [WorkerWithDpRank(i) for i in self.client.instance_ids()]
+            # dp-aware like the decode path (scheduler.rs:543-560): every
+            # (instance, dp_rank) is a candidate, and the chosen rank rides
+            # the annotation so the worker's DpEngineGroup dispatches to it
+            cands = []
+            for iid, inst in self.client.instances.items():
+                dp = int(inst.metadata.get("data_parallel_size", 1) or 1)
+                for r in range(dp):
+                    cands.append(WorkerWithDpRank(iid, r))
             decision = self.kv_router.schedule_tokens(preq.token_ids, cands)
             instance_id = decision.worker.worker_id
+            preq.annotations["dp_rank"] = decision.worker.dp_rank
         try:
             stream = await self.client.generate(preq.to_obj(), context.child(), instance_id)
             last: Optional[BackendOutput] = None

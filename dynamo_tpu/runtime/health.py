@@ -167,7 +167,11 @@ class StatusServer:
 
     async def _metrics(self, request: web.Request) -> web.Response:
         if self.pre_expose is not None:
-            self.pre_expose()
+            try:
+                self.pre_expose()
+            except Exception:
+                # stale gauges beat a failed scrape
+                log.exception("metrics pre_expose hook failed")
         body = self.metrics.expose() if self.metrics is not None else b""
         return web.Response(body=body, content_type="text/plain")
 
