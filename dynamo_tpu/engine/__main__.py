@@ -103,7 +103,7 @@ async def main() -> None:
         tokenizer_ref = args.tokenizer or "byte"
 
     component = args.component
-    model_type = ["chat", "completions"]
+    model_type = ["chat", "completions", "embedding"]
     if args.disagg == "prefill":
         component = (
             args.component + "_prefill" if args.component == "backend" else args.component

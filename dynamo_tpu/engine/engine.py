@@ -441,10 +441,25 @@ class TpuEngine:
         def reset_slot(prompt_masks, counts, slot, row):
             return prompt_masks.at[slot].set(row), counts.at[slot].set(0)
 
+        def embed(params, tokens, positions, last_idx):
+            """Pooled forward for /v1/embeddings (reference: the Embedding
+            model type served by http/service/openai.rs:641): dense causal
+            attention (no KV pages touched — embeddings never pollute the
+            generation cache), last-token hidden state, L2-normalized.
+            Padded tail positions can't affect earlier queries (causal)."""
+
+            def attend(q, k_new, v_new, layer_idx):
+                return att.causal_attention(q, k_new, v_new)
+
+            hidden = fwd(params, mcfg, tokens, positions, attend)  # [S, H]
+            h = hidden[last_idx].astype(jnp.float32)
+            return h / jnp.maximum(jnp.linalg.norm(h), 1e-9)
+
         self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2, 3))
         self._decode_fn = jax.jit(decode, donate_argnums=(1, 2, 3))
         self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2, 3))
         self._reset_slot_fn = jax.jit(reset_slot, donate_argnums=(0, 1))
+        self._embed_fn = jax.jit(embed)
 
     # ---------------------------------------------------------------- serving
     async def generate(
@@ -458,6 +473,19 @@ class TpuEngine:
                 f"prompt {len(req.token_ids)} tokens exceeds engine max_context "
                 f"{self.cfg.max_context}"
             )
+        if req.annotations.get("op") == "embed":
+            loop = asyncio.get_event_loop()
+            vec = await loop.run_in_executor(
+                self._executor, self._run_embed, list(req.token_ids)
+            )
+            yield BackendOutput(
+                finish_reason=FINISH_STOP,
+                annotations={
+                    "embedding": [float(v) for v in vec],
+                    "input_tokens": len(req.token_ids),
+                },
+            )
+            return
         self._ensure_loop()
         all_tokens = list(req.token_ids) + list(req.prior_token_ids)
         st = _Seq(
@@ -791,6 +819,18 @@ class TpuEngine:
         )
 
     # -- device calls (run in executor thread) -------------------------------
+    def _run_embed(self, token_ids: List[int]) -> np.ndarray:
+        S = len(token_ids)
+        S_pad = self._bucket(S)
+        tokens = np.zeros(S_pad, np.int32)
+        tokens[:S] = token_ids
+        positions = np.arange(S_pad, dtype=np.int32)
+        vec = self._embed_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.int32(S - 1),
+        )
+        return np.asarray(vec)
+
     def _run_prefill(self, st: _Seq) -> List[Tuple[_Seq, int, float]]:
         bs = self.cfg.block_size
         prompt = st.seq.tokens()

@@ -26,6 +26,8 @@ def parse_args():
     p.add_argument("--store-path", default=None)
     p.add_argument("--event-plane", default=None, help="zmq|inproc")
     p.add_argument("--busy-threshold", type=int, default=None)
+    p.add_argument("--grpc-port", type=int, default=-1,
+                   help="KServe v2 gRPC frontend port (0 = ephemeral, -1 = off)")
     p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     p.add_argument("--router-temperature", type=float, default=0.0)
     p.add_argument("--no-kv-events", action="store_true")
@@ -53,12 +55,21 @@ async def main() -> None:
         host=args.host, port=args.port,
     )
     await service.start()
+    grpc_service = None
+    if args.grpc_port >= 0:
+        from dynamo_tpu.llm.grpc import KserveGrpcService
+
+        grpc_service = KserveGrpcService(manager, host=args.host, port=args.grpc_port)
+        await grpc_service.start()
+        print(f"KSERVE_GRPC_READY {grpc_service.port}", flush=True)
 
     stop = asyncio.Event()
-    loop = asyncio.get_event_loop()
+    loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if grpc_service is not None:
+        await grpc_service.stop()
     await service.stop()
     await watcher.stop()
     await runtime.shutdown()

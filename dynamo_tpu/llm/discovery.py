@@ -111,7 +111,11 @@ class ModelPipeline:
     ) -> AsyncIterator[Any]:
         assert self.client is not None
         instance_id: Optional[int] = None
-        if self.kv_router is not None:
+        # pooled forwards don't touch KV pages: routing them through the KV
+        # scheduler would charge phantom blocks to a worker (and pollute the
+        # approx prefix view) that complete() on the embed path never frees
+        use_kv = self.kv_router is not None and req.annotations.get("op") != "embed"
+        if use_kv:
             self._prune_dead_workers()
             cands = self._candidates(excluded)
             if not cands:
@@ -147,6 +151,11 @@ class ModelPipeline:
         generation. Disaggregation is elastic: with no prefill pool (or on
         prefill failure) the aggregated path serves the request unchanged."""
         offset = 0
+        if req.annotations.get("op") == "embed":
+            # pooled forwards never split across prefill/decode pools
+            async for out in self.migration.generate(req, context):
+                yield out
+            return
         if self.prefill_router is not None and self.prefill_router.has_workers:
             pre_out = await self.prefill_router.run_prefill(req, context)
             if pre_out is not None and pre_out.token_ids:

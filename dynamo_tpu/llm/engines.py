@@ -22,6 +22,14 @@ class EchoEngine:
         self, request: Any, context: Context
     ) -> AsyncIterator[BackendOutput]:
         req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_obj(request)
+        if req.annotations.get("op") == "embed":
+            # deterministic toy embedding so the API surface is testable
+            vec = [float(len(req.token_ids))] + [float(t) for t in req.token_ids[:3]]
+            yield BackendOutput(
+                finish_reason=FINISH_STOP,
+                annotations={"embedding": vec, "input_tokens": len(req.token_ids)},
+            )
+            return
         limit = req.stop.max_tokens or len(req.token_ids)
         produced = 0
         for tid in req.token_ids:

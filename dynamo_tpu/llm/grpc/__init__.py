@@ -1,0 +1,5 @@
+"""KServe v2 gRPC frontend (reference: lib/llm/src/grpc/service/kserve.rs)."""
+
+from .service import KserveGrpcService
+
+__all__ = ["KserveGrpcService"]

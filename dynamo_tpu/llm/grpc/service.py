@@ -1,0 +1,205 @@
+"""KServe v2 gRPC inference frontend.
+
+Analog of the reference's KServe service (lib/llm/src/grpc/service/kserve.rs):
+the same discovered model pipelines the OpenAI HTTP frontend serves, exposed
+over the standard v2 inference protocol — text in ("text_input" BYTES tensor),
+text out ("text_output"), with generation knobs as request parameters and
+token streaming via ModelStreamInfer.
+
+grpc_tools isn't in the image, so the message classes come from `protoc
+--python_out` (protos/kserve.proto -> kserve_pb2.py) and the service is
+registered with hand-rolled method handlers — ~30 lines that replace the
+generated *_pb2_grpc stubs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+import grpc
+
+from ...runtime.engine import Context
+from ...runtime.logging import get_logger
+from ..discovery import ModelManager
+from ..protocols.openai import CompletionRequest
+from . import kserve_pb2 as pb
+
+log = get_logger("llm.grpc")
+
+SERVICE_NAME = "inference.GRPCInferenceService"
+
+
+def _param(params, name: str, default=None):
+    p = params.get(name)
+    if p is None:
+        return default
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else default
+
+
+class KserveGrpcService:
+    def __init__(self, manager: ModelManager, host: str = "0.0.0.0", port: int = 0):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self._server: Optional[grpc.aio.Server] = None
+
+    # -- rpc handlers --------------------------------------------------------
+    async def ServerLive(self, request, context) -> pb.ServerLiveResponse:
+        return pb.ServerLiveResponse(live=True)
+
+    async def ServerReady(self, request, context) -> pb.ServerReadyResponse:
+        return pb.ServerReadyResponse(ready=bool(self.manager.list_models()))
+
+    async def ModelReady(self, request, context) -> pb.ModelReadyResponse:
+        pipe = self.manager.get(request.name)
+        ready = pipe is not None and bool(pipe.client and pipe.client.instances)
+        return pb.ModelReadyResponse(ready=ready)
+
+    async def ModelMetadata(self, request, context) -> pb.ModelMetadataResponse:
+        if self.manager.get(request.name) is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model '{request.name}' not found"
+            )
+        resp = pb.ModelMetadataResponse(
+            name=request.name, versions=["1"], platform="dynamo_tpu"
+        )
+        inp = resp.inputs.add()
+        inp.name, inp.datatype = "text_input", "BYTES"
+        inp.shape.append(1)
+        out = resp.outputs.add()
+        out.name, out.datatype = "text_output", "BYTES"
+        out.shape.append(1)
+        return resp
+
+    def _to_preq(self, request: pb.ModelInferRequest):
+        pipe = self.manager.get(request.model_name)
+        if pipe is None:
+            return None, None
+        text = ""
+        max_tokens = _param(request.parameters, "max_tokens")
+        temperature = _param(request.parameters, "temperature")
+        ignore_eos = _param(request.parameters, "ignore_eos")
+        for t in request.inputs:
+            if t.name == "text_input" and t.contents.bytes_contents:
+                text = t.contents.bytes_contents[0].decode("utf-8", "replace")
+            elif t.name == "max_tokens" and t.contents.int_contents:
+                max_tokens = int(t.contents.int_contents[0])
+            elif t.name == "temperature" and t.contents.fp32_contents:
+                temperature = float(t.contents.fp32_contents[0])
+        oai = CompletionRequest(
+            model=request.model_name,
+            prompt=text,
+            max_tokens=int(max_tokens) if max_tokens else None,
+            temperature=float(temperature) if temperature is not None else None,
+            ignore_eos=bool(ignore_eos) if ignore_eos is not None else None,
+        )
+        preq = pipe.preprocessor.preprocess_completion(oai, text)
+        if request.id:
+            preq.request_id = request.id
+        return pipe, preq
+
+    @staticmethod
+    def _text_response(request, text: str, finish: Optional[str]) -> pb.ModelInferResponse:
+        resp = pb.ModelInferResponse(
+            model_name=request.model_name, model_version="1", id=request.id
+        )
+        out = resp.outputs.add()
+        out.name, out.datatype = "text_output", "BYTES"
+        out.shape.append(1)
+        out.contents.bytes_contents.append(text.encode())
+        if finish:
+            resp.parameters["finish_reason"].string_param = finish
+        return resp
+
+    async def ModelInfer(self, request, context) -> pb.ModelInferResponse:
+        pipe, preq = self._to_preq(request)
+        if pipe is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model '{request.model_name}' not found"
+            )
+        ctx = Context(preq.request_id)
+        parts = []
+        finish = None
+        try:
+            async for out in pipe.generate_tokens(preq, ctx):
+                if out.text:
+                    parts.append(out.text)
+                if out.finish_reason is not None:
+                    finish = out.finish_reason
+        finally:
+            ctx.stop_generating()
+        return self._text_response(request, "".join(parts), finish)
+
+    async def ModelStreamInfer(
+        self, request, context
+    ) -> AsyncIterator[pb.ModelStreamInferResponse]:
+        pipe, preq = self._to_preq(request)
+        if pipe is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model '{request.model_name}' not found"
+            )
+        ctx = Context(preq.request_id)
+        try:
+            async for out in pipe.generate_tokens(preq, ctx):
+                if out.text or out.finish_reason is not None:
+                    yield pb.ModelStreamInferResponse(
+                        infer_response=self._text_response(
+                            request, out.text or "", out.finish_reason
+                        )
+                    )
+        except Exception as e:  # stream errors ride the error_message field
+            log.exception("stream infer failed")
+            yield pb.ModelStreamInferResponse(error_message=str(e))
+        finally:
+            ctx.stop_generating()
+
+    # -- server lifecycle ----------------------------------------------------
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        unary = grpc.unary_unary_rpc_method_handler
+        stream = grpc.unary_stream_rpc_method_handler
+        table = {
+            "ServerLive": unary(
+                self.ServerLive,
+                request_deserializer=pb.ServerLiveRequest.FromString,
+                response_serializer=pb.ServerLiveResponse.SerializeToString,
+            ),
+            "ServerReady": unary(
+                self.ServerReady,
+                request_deserializer=pb.ServerReadyRequest.FromString,
+                response_serializer=pb.ServerReadyResponse.SerializeToString,
+            ),
+            "ModelReady": unary(
+                self.ModelReady,
+                request_deserializer=pb.ModelReadyRequest.FromString,
+                response_serializer=pb.ModelReadyResponse.SerializeToString,
+            ),
+            "ModelMetadata": unary(
+                self.ModelMetadata,
+                request_deserializer=pb.ModelMetadataRequest.FromString,
+                response_serializer=pb.ModelMetadataResponse.SerializeToString,
+            ),
+            "ModelInfer": unary(
+                self.ModelInfer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelInferResponse.SerializeToString,
+            ),
+            "ModelStreamInfer": stream(
+                self.ModelStreamInfer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelStreamInferResponse.SerializeToString,
+            ),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE_NAME, table)
+
+    async def start(self) -> str:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        log.info("KServe gRPC frontend on %s:%d", self.host, self.port)
+        return f"{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)

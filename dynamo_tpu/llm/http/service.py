@@ -35,8 +35,13 @@ from ..protocols.delta import (
 from ..protocols.openai import (
     ChatCompletionRequest,
     CompletionRequest,
+    EmbeddingData,
+    EmbeddingRequest,
+    EmbeddingResponse,
     ModelInfo,
     ModelList,
+    Usage,
+    new_request_id,
 )
 
 log = get_logger("llm.http")
@@ -112,6 +117,7 @@ class HttpService:
         app = web.Application(client_max_size=64 * 1024 * 1024)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/live", self.live)
@@ -288,6 +294,103 @@ class HttpService:
                 tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
             ),
         )
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """/v1/embeddings off a pooled forward (reference:
+        http/service/openai.rs:641 embeddings handler + ModelType::Embedding).
+        Accepts a string, list of strings, or pre-tokenized int lists."""
+        busy = self._check_capacity()
+        if busy is not None:
+            return busy
+        try:
+            body = await request.json()
+            req = EmbeddingRequest.model_validate(body)
+        except (json.JSONDecodeError, ValueError) as e:
+            return _error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            return _error(404, f"model '{req.model}' not found", "model_not_found")
+        inputs = req.input
+        if isinstance(inputs, str) or (inputs and isinstance(inputs[0], int)):
+            inputs = [inputs]
+        if not inputs or any(
+            (isinstance(item, (str, list)) and len(item) == 0) for item in inputs
+        ):
+            return _error(400, "input must not be empty")
+        model = req.model
+        # preprocess up front so client mistakes are 400s, not worker errors
+        preqs = []
+        try:
+            for item in inputs:
+                preq = pipeline.preprocessor.preprocess_completion(
+                    CompletionRequest(model=model, prompt=item, max_tokens=1), item
+                )
+                preq.request_id = new_request_id("embd")
+                preq.annotations["op"] = "embed"
+                preqs.append(preq)
+        except ValueError as e:
+            return _error(400, str(e), "context_length_exceeded")
+        self.inflight += 1
+        self._inflight_g.set(self.inflight)
+        status = "200"
+        prompt_tokens = 0
+
+        async def one(preq) -> tuple:
+            ctx = Context(preq.request_id)
+            try:
+                async for out in pipeline.generate_tokens(preq, ctx):
+                    if out.annotations and "embedding" in out.annotations:
+                        return (
+                            out.annotations["embedding"],
+                            out.annotations.get("input_tokens", len(preq.token_ids)),
+                        )
+            finally:
+                ctx.stop_generating()
+            return None, 0
+
+        try:
+            # independent pooled forwards: fan out, assemble by index
+            results = await asyncio.gather(*[one(p) for p in preqs])
+            data = []
+            for i, (emb, n_toks) in enumerate(results):
+                if emb is None:
+                    status = "500"
+                    return _error(
+                        500, "worker returned no embedding (model may not "
+                        "support embeddings)", "internal_error",
+                    )
+                prompt_tokens += n_toks
+                if req.dimensions:
+                    # renormalize after Matryoshka-style truncation so
+                    # consumers still get unit vectors (OpenAI semantics)
+                    emb = emb[: req.dimensions]
+                    norm = sum(v * v for v in emb) ** 0.5
+                    if norm > 0:
+                        emb = [v / norm for v in emb]
+                if req.encoding_format == "base64":
+                    import base64
+                    import struct
+
+                    packed = struct.pack(f"<{len(emb)}f", *emb)
+                    emb = base64.b64encode(packed).decode()
+                data.append(EmbeddingData(index=i, embedding=emb))
+            resp = EmbeddingResponse(
+                data=data, model=model,
+                usage=Usage(prompt_tokens=prompt_tokens, total_tokens=prompt_tokens),
+            )
+            return web.json_response(resp.model_dump(exclude_none=True))
+        except NoResponders:
+            status = "503"
+            return _error(503, "no workers available", "service_unavailable")
+        except Exception as e:
+            log.exception("embeddings request failed")
+            status = "500"
+            return _error(500, str(e), "internal_error")
+        finally:
+            self.inflight -= 1
+            self._inflight_g.set(self.inflight)
+            self._requests.inc(model=model, status=status)
+            self._input_tokens.inc(prompt_tokens, model=model)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         busy = self._check_capacity()

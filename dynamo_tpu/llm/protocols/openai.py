@@ -199,7 +199,9 @@ class CompletionResponse(BaseModel):
 class EmbeddingData(BaseModel):
     object: Literal["embedding"] = "embedding"
     index: int
-    embedding: List[float]
+    # list of floats, or base64 of little-endian float32 when the request
+    # asked for encoding_format="base64"
+    embedding: Union[List[float], str]
 
 
 class EmbeddingResponse(BaseModel):

@@ -1,0 +1,238 @@
+"""KServe gRPC frontend + /v1/embeddings (reference:
+lib/llm/src/grpc/service/kserve.rs; http/service/openai.rs:641)."""
+
+import asyncio
+import base64
+import struct
+
+import aiohttp
+import grpc
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.llm import (
+    EchoEngine,
+    ModelDeploymentCard,
+    ModelManager,
+    ModelWatcher,
+    register_llm,
+)
+from dynamo_tpu.llm.grpc import KserveGrpcService
+from dynamo_tpu.llm.grpc import kserve_pb2 as pb
+from dynamo_tpu.llm.grpc.service import SERVICE_NAME
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    InProcEventPlane,
+    MemKVStore,
+    RuntimeConfig,
+)
+
+
+def make_rt(store):
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    return DistributedRuntime(cfg, store=store, event_plane=InProcEventPlane())
+
+
+async def start_stack(store):
+    worker_rt = await make_rt(store).start()
+    frontend_rt = await make_rt(store).start()
+    card = ModelDeploymentCard(
+        name="echo-model", tokenizer="byte", context_length=4096,
+        model_type=["chat", "completions", "embedding"],
+    )
+    served = await register_llm(worker_rt, EchoEngine(), card)
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager).start()
+    for _ in range(100):
+        if manager.get("echo-model") and manager.get("echo-model").client.instances:
+            break
+        await asyncio.sleep(0.05)
+    return worker_rt, frontend_rt, served, watcher, manager
+
+
+async def stop_stack(worker_rt, frontend_rt, served, watcher):
+    await watcher.stop()
+    await served.stop()
+    await worker_rt.shutdown()
+    await frontend_rt.shutdown()
+
+
+def _stub(channel):
+    def unary(method, req_cls, resp_cls):
+        return channel.unary_unary(
+            f"/{SERVICE_NAME}/{method}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+
+    class Stub:
+        ServerLive = unary("ServerLive", pb.ServerLiveRequest, pb.ServerLiveResponse)
+        ServerReady = unary("ServerReady", pb.ServerReadyRequest, pb.ServerReadyResponse)
+        ModelReady = unary("ModelReady", pb.ModelReadyRequest, pb.ModelReadyResponse)
+        ModelMetadata = unary(
+            "ModelMetadata", pb.ModelMetadataRequest, pb.ModelMetadataResponse
+        )
+        ModelInfer = unary("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse)
+        ModelStreamInfer = channel.unary_stream(
+            f"/{SERVICE_NAME}/ModelStreamInfer",
+            request_serializer=pb.ModelInferRequest.SerializeToString,
+            response_deserializer=pb.ModelStreamInferResponse.FromString,
+        )
+
+    return Stub
+
+
+def _infer_request(text: str, max_tokens: int = 8) -> pb.ModelInferRequest:
+    req = pb.ModelInferRequest(model_name="echo-model", id="req-1")
+    t = req.inputs.add()
+    t.name, t.datatype = "text_input", "BYTES"
+    t.shape.append(1)
+    t.contents.bytes_contents.append(text.encode())
+    req.parameters["max_tokens"].int64_param = max_tokens
+    req.parameters["ignore_eos"].bool_param = True
+    return req
+
+
+async def test_kserve_grpc_round_trip():
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, manager = stack
+    service = KserveGrpcService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{service.port}") as ch:
+            stub = _stub(ch)
+            assert (await stub.ServerLive(pb.ServerLiveRequest())).live
+            assert (await stub.ServerReady(pb.ServerReadyRequest())).ready
+            assert (await stub.ModelReady(pb.ModelReadyRequest(name="echo-model"))).ready
+            assert not (await stub.ModelReady(pb.ModelReadyRequest(name="nope"))).ready
+            meta = await stub.ModelMetadata(pb.ModelMetadataRequest(name="echo-model"))
+            assert meta.inputs[0].name == "text_input"
+            assert meta.outputs[0].datatype == "BYTES"
+
+            # unary inference round-trip: echo engine returns the prompt text
+            resp = await stub.ModelInfer(_infer_request("kserve!", max_tokens=7))
+            assert resp.id == "req-1"
+            out = resp.outputs[0]
+            assert out.name == "text_output" and out.datatype == "BYTES"
+            assert out.contents.bytes_contents[0].decode() == "kserve!"
+            assert resp.parameters["finish_reason"].string_param in ("stop", "length")
+
+            # streaming: chunks concatenate to the same text
+            chunks = []
+            async for item in stub.ModelStreamInfer(_infer_request("stream me", 9)):
+                assert not item.error_message
+                for o in item.infer_response.outputs:
+                    chunks.append(o.contents.bytes_contents[0].decode())
+            assert "".join(chunks) == "stream me"
+
+            # unknown model -> NOT_FOUND
+            try:
+                await stub.ModelInfer(_infer_request("x").__class__(model_name="nope"))
+                raised = False
+            except grpc.aio.AioRpcError as e:
+                raised = e.code() == grpc.StatusCode.NOT_FOUND
+            assert raised
+    finally:
+        await service.stop()
+        await stop_stack(*handles)
+
+
+async def test_embeddings_endpoint_http():
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, manager = stack
+    http = HttpService(manager, host="127.0.0.1", port=0)
+    await http.start()
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/embeddings",
+                json={"model": "echo-model", "input": ["abc", "defg"]},
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["object"] == "list" and len(body["data"]) == 2
+            assert body["data"][0]["index"] == 0
+            # echo's toy embedding leads with the token count
+            assert body["data"][0]["embedding"][0] == 3.0
+            assert body["data"][1]["embedding"][0] == 4.0
+            assert body["usage"]["prompt_tokens"] == 7
+            # base64 encoding round-trips to the same floats
+            r = await s.post(
+                f"{base}/v1/embeddings",
+                json={"model": "echo-model", "input": "abc",
+                      "encoding_format": "base64"},
+            )
+            body64 = await r.json()
+            raw = base64.b64decode(body64["data"][0]["embedding"])
+            vals = struct.unpack(f"<{len(raw)//4}f", raw)
+            assert vals[0] == 3.0
+            # unknown model 404
+            r = await s.post(f"{base}/v1/embeddings", json={"model": "x", "input": "a"})
+            assert r.status == 404
+            # empty input 400 (not a garbage embedding)
+            r = await s.post(
+                f"{base}/v1/embeddings", json={"model": "echo-model", "input": ""}
+            )
+            assert r.status == 400
+            # over-long input is the client's fault: 400, not 500
+            r = await s.post(
+                f"{base}/v1/embeddings",
+                json={"model": "echo-model", "input": "x" * 5000},
+            )
+            assert r.status == 400
+            # dimensions truncation renormalizes
+            r = await s.post(
+                f"{base}/v1/embeddings",
+                json={"model": "echo-model", "input": "abc", "dimensions": 2},
+            )
+            emb = (await r.json())["data"][0]["embedding"]
+            assert len(emb) == 2
+            assert abs(sum(v * v for v in emb) ** 0.5 - 1.0) < 1e-6
+    finally:
+        await http.stop()
+        await stop_stack(*handles)
+
+
+async def test_engine_pooled_embedding():
+    """The real engine's pooled forward: deterministic, L2-normalized,
+    text-sensitive, and it never touches the generation KV pages."""
+    mcfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+    cfg = TpuEngineConfig(
+        model=mcfg, num_blocks=64, block_size=4, max_batch_size=2,
+        max_context=128, prefill_buckets=(16, 32, 64, 128),
+    )
+    engine = TpuEngine(cfg, mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+
+    async def embed(tokens):
+        req = PreprocessedRequest(
+            request_id="e", model="m", token_ids=tokens,
+            stop=StopConditions(max_tokens=1),
+        )
+        req.annotations["op"] = "embed"
+        async for out in engine.generate(req, Context()):
+            return np.asarray(out.annotations["embedding"])
+
+    try:
+        v1 = await embed(list(range(10, 20)))
+        v2 = await embed(list(range(10, 20)))
+        v3 = await embed(list(range(30, 45)))
+        assert v1.shape == (64,)
+        np.testing.assert_allclose(np.linalg.norm(v1), 1.0, rtol=1e-5)
+        np.testing.assert_array_equal(v1, v2)
+        assert not np.allclose(v1, v3)
+        assert engine.allocator.active_blocks == 0  # no KV pages consumed
+    finally:
+        engine.stop()
