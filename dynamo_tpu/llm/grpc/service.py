@@ -113,7 +113,10 @@ class KserveGrpcService:
         return resp
 
     async def ModelInfer(self, request, context) -> pb.ModelInferResponse:
-        pipe, preq = self._to_preq(request)
+        try:
+            pipe, preq = self._to_preq(request)
+        except ValueError as e:  # over-long prompt / bad params -> clean status
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         if pipe is None:
             await context.abort(
                 grpc.StatusCode.NOT_FOUND, f"model '{request.model_name}' not found"
@@ -134,7 +137,10 @@ class KserveGrpcService:
     async def ModelStreamInfer(
         self, request, context
     ) -> AsyncIterator[pb.ModelStreamInferResponse]:
-        pipe, preq = self._to_preq(request)
+        try:
+            pipe, preq = self._to_preq(request)
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         if pipe is None:
             await context.abort(
                 grpc.StatusCode.NOT_FOUND, f"model '{request.model_name}' not found"

@@ -349,8 +349,15 @@ class HttpService:
             return None, 0
 
         try:
-            # independent pooled forwards: fan out, assemble by index
-            results = await asyncio.gather(*[one(p) for p in preqs])
+            # independent pooled forwards: fan out, assemble by index.
+            # return_exceptions so one failure doesn't leave siblings
+            # running unsupervised after the error response goes out
+            results = await asyncio.gather(
+                *[one(p) for p in preqs], return_exceptions=True
+            )
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
             data = []
             for i, (emb, n_toks) in enumerate(results):
                 if emb is None:

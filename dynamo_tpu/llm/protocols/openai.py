@@ -118,7 +118,7 @@ class EmbeddingRequest(_Lenient):
     model: str
     input: Union[str, List[str], List[int], List[List[int]]]
     encoding_format: Literal["float", "base64"] = "float"
-    dimensions: Optional[int] = None
+    dimensions: Optional[int] = Field(default=None, ge=1)
 
 
 # ---------------------------------------------------------------------------

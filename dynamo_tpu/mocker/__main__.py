@@ -36,6 +36,10 @@ def parse_args():
     p.add_argument("--num-workers", type=int, default=1, help="instances in this process")
     p.add_argument("--status-port", type=int, default=-1,
                    help="system status server port (0 = ephemeral, -1 = off)")
+    p.add_argument("--profile", default=None,
+                   help="profile JSON (python -m dynamo_tpu.profiler): "
+                   "calibrates the simulated timing to the measured engine "
+                   "(perf_model.rs analog)")
     return p.parse_args()
 
 
@@ -47,16 +51,29 @@ async def main() -> None:
     )
     runtime = await DistributedRuntime(cfg).start()
 
+    base_args = MockEngineArgs(
+        num_blocks=args.num_blocks,
+        block_size=args.block_size,
+        max_num_seqs=args.max_num_seqs,
+        speedup_ratio=args.speedup_ratio,
+        startup_time_s=args.startup_time,
+    )
+    if args.profile:
+        from dynamo_tpu.profiler import ProfileResult, calibrate_mocker_args
+
+        base_args = calibrate_mocker_args(ProfileResult.load(args.profile), base_args)
+        print(
+            f"MOCKER_CALIBRATED prefill={base_args.prefill_base_s:.4f}"
+            f"+{base_args.prefill_per_token_s * 1e6:.1f}us/tok "
+            f"decode={base_args.decode_base_s * 1e3:.2f}ms"
+            f"+{base_args.decode_per_kv_block_s * 1e6:.3f}us/blk",
+            flush=True,
+        )
+
     served = []
     for _ in range(args.num_workers):
         instance_id = new_instance_id()
-        engine_args = MockEngineArgs(
-            num_blocks=args.num_blocks,
-            block_size=args.block_size,
-            max_num_seqs=args.max_num_seqs,
-            speedup_ratio=args.speedup_ratio,
-            startup_time_s=args.startup_time,
-        )
+        engine_args = base_args
         kv_pub = KvEventPublisher(
             runtime.event_plane, args.namespace, args.component,
             worker_id=instance_id, block_size=args.block_size,

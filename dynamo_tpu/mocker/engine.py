@@ -189,6 +189,7 @@ class MockerEngine:
         self.metrics_publisher = metrics_publisher
         self._waiting: List[_Running] = []
         self._running: List[_Running] = []
+        self._outbox: List = []  # (queue, BackendOutput) deferred past the step sleep
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._started_at = time.monotonic()
@@ -237,12 +238,25 @@ class MockerEngine:
                     await self._wake.wait()
                 self._admit()
                 step_time = await self._step()
-                await self._publish_events()
+                # timing fidelity: a step's tokens become visible only after
+                # the simulated step duration has elapsed — a real engine's
+                # first token arrives AFTER prefill compute, so TTFT
+                # measurements (profiler, benchmarks) see the model's cost
                 await asyncio.sleep(step_time / self.args.speedup_ratio)
+                outbox, self._outbox = self._outbox, []
+                for q, item in outbox:
+                    q.put_nowait(item)
+                await self._publish_events()
         except asyncio.CancelledError:
             pass
         except Exception:
             log.exception("mocker loop crashed")
+        finally:
+            # never strand a consumer on a queue whose output was computed
+            # but not yet delivered when the loop died
+            for q, item in self._outbox:
+                q.put_nowait(item)
+            self._outbox = []
 
     def _admit(self) -> None:
         still_waiting: List[_Running] = []
@@ -285,9 +299,10 @@ class MockerEngine:
 
         for st in self._running:
             if st.context.is_stopped():
-                st.out_queue.put_nowait(
-                    BackendOutput(finish_reason="cancelled", cumulative_tokens=st.produced)
-                )
+                self._outbox.append((
+                    st.out_queue,
+                    BackendOutput(finish_reason="cancelled", cumulative_tokens=st.produced),
+                ))
                 finished.append(st)
                 continue
             if st.prefill_remaining > 0:
@@ -344,14 +359,15 @@ class MockerEngine:
                 "cached_tokens": st.cached_tokens,
                 "input_tokens": len(st.req.token_ids),
             }
-        st.out_queue.put_nowait(
+        self._outbox.append((
+            st.out_queue,
             BackendOutput(
                 token_ids=[tid],
                 finish_reason=finish,
                 cumulative_tokens=st.produced,
                 annotations=ann,
-            )
-        )
+            ),
+        ))
         if finish is not None:
             st.done = True
 

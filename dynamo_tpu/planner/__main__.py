@@ -34,6 +34,9 @@ def parse_args():
     p.add_argument("--itl-sla", type=float, default=0.05)
     p.add_argument("--predictor", default="holt",
                    choices=["constant", "moving-average", "holt", "arima"])
+    p.add_argument("--profile", default=None,
+                   help="profile JSON from python -m dynamo_tpu.profiler; "
+                   "scales on MEASURED capacities instead of defaults")
     p.add_argument("--connector", default="virtual", choices=["virtual", "subprocess"])
     p.add_argument("--worker-cmd", default=None,
                    help="subprocess connector: shell command template with "
@@ -71,7 +74,9 @@ async def main() -> None:
             total_budget=args.total_budget,
             sla=SlaTargets(ttft_s=args.ttft_sla, itl_s=args.itl_sla),
         ),
-        PerfInterpolator(),
+        PerfInterpolator.from_profile(args.profile)
+        if args.profile
+        else PerfInterpolator(),
         prefill_component=args.prefill_component,
         decode_component=args.decode_component,
     )

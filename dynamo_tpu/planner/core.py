@@ -45,6 +45,36 @@ class PerfInterpolator:
     def decode_capacity(self, active_seqs: float) -> float:
         return self._interp(self.decode_points, active_seqs, self.decode_tokens_per_s)
 
+    # -- calibration from measured sweeps (profiler/sweep.py) ----------------
+    def fit_prefill(self, points) -> "PerfInterpolator":
+        self.prefill_points = [tuple(p) for p in points]
+        if self.prefill_points:
+            self.prefill_tokens_per_s = self.prefill_points[-1][1]
+        return self
+
+    def fit_decode(self, points) -> "PerfInterpolator":
+        self.decode_points = [tuple(p) for p in points]
+        if self.decode_points:
+            # the planner divides aggregate load by one worker's sustainable
+            # rate: use the highest measured concurrency's throughput
+            self.decode_tokens_per_s = max(r for _, r in self.decode_points)
+        return self
+
+    @classmethod
+    def from_profile(cls, profile) -> "PerfInterpolator":
+        """profile: profiler.ProfileResult, its dict form, or a JSON path."""
+        if isinstance(profile, str):
+            import json
+
+            with open(profile) as f:
+                profile = json.load(f)
+        if not isinstance(profile, dict):
+            profile = profile.to_obj()
+        interp = cls()
+        interp.fit_prefill(profile.get("prefill_points", []))
+        interp.fit_decode(profile.get("decode_points", []))
+        return interp
+
     @staticmethod
     def _interp(points: List, x: float, default: float) -> float:
         if not points:

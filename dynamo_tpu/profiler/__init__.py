@@ -1,0 +1,7 @@
+"""SLA profiler: measured (isl, batch) sweeps feeding the planner's capacity
+model and the mocker's timing calibration (reference:
+benchmarks/profiler/profile_sla.py:138; lib/mocker/src/perf_model.rs)."""
+
+from .sweep import ProfileResult, calibrate_mocker_args, profile_engine
+
+__all__ = ["ProfileResult", "calibrate_mocker_args", "profile_engine"]

@@ -1,0 +1,100 @@
+"""python -m dynamo_tpu.profiler — measure a worker's capacity envelope.
+
+Analog of the reference's `profile_sla.py` entrypoint: sweeps (isl, batch)
+on a real engine (or the mocker), writes a profile JSON the planner loads
+via `--profile` / PerfInterpolator.from_profile and the mocker loads for
+timing calibration.
+"""
+
+import argparse
+import asyncio
+import json
+
+from dynamo_tpu.profiler.sweep import calibrate_mocker_args, profile_engine
+
+
+def parse_args():
+    p = argparse.ArgumentParser("dynamo_tpu.profiler")
+    p.add_argument("--engine", default="tpu", choices=["tpu", "mocker"])
+    p.add_argument("--preset", default="tiny")
+    p.add_argument("--model-path", default=None)
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"])
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--isl", default="128,512,1024")
+    p.add_argument("--osl", type=int, default=64)
+    p.add_argument("--batch", default="1,2,4,8")
+    p.add_argument("--reps", type=int, default=2)
+    p.add_argument("--num-blocks", type=int, default=4096)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-context", type=int, default=2048)
+    p.add_argument("--out", default="profile.json")
+    p.add_argument("--print-mocker-args", action="store_true",
+                   help="also print calibrated mocker timing constants")
+    return p.parse_args()
+
+
+async def main() -> None:
+    args = parse_args()
+    isl_list = [int(x) for x in args.isl.split(",")]
+    batch_list = [int(x) for x in args.batch.split(",")]
+
+    if args.engine == "mocker":
+        from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+
+        engine = MockerEngine(
+            MockEngineArgs(num_blocks=args.num_blocks, block_size=args.block_size)
+        )
+        stopper = getattr(engine, "stop", lambda: None)
+    else:
+        if args.platform:
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
+        from dynamo_tpu.engine.__main__ import PRESETS
+        from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+        from dynamo_tpu.engine.weights import config_from_hf, load_params
+
+        params = None
+        if args.model_path:
+            mcfg = config_from_hf(args.model_path)
+            params = load_params(args.model_path, mcfg)
+        else:
+            mcfg = PRESETS[args.preset]()
+        bs = args.block_size
+        ctx = ((args.max_context + bs - 1) // bs) * bs
+        buckets = tuple(
+            b for b in (64, 128, 256, 512, 1024, 2048, 4096, 8192) if b < ctx
+        ) + (ctx,)
+        engine = TpuEngine(
+            TpuEngineConfig(
+                model=mcfg, num_blocks=args.num_blocks, block_size=bs,
+                max_batch_size=max(batch_list), max_context=ctx,
+                prefill_buckets=buckets, tp=args.tp,
+            ),
+            params=params,
+        )
+        stopper = engine.stop
+
+    try:
+        result = await profile_engine(
+            engine, isl_list=isl_list, osl=args.osl,
+            batch_list=batch_list, reps=args.reps,
+        )
+    finally:
+        stopper()
+    result.meta["engine"] = args.engine
+    result.meta["preset"] = args.preset
+    result.save(args.out)
+    print(json.dumps(result.to_obj()))
+    if args.print_mocker_args:
+        cal = calibrate_mocker_args(result)
+        print(
+            f"mocker timing: prefill {cal.prefill_base_s:.4f}s + "
+            f"{cal.prefill_per_token_s * 1e6:.2f}us/tok; decode "
+            f"{cal.decode_base_s * 1e3:.2f}ms + "
+            f"{cal.decode_per_kv_block_s * 1e6:.3f}us/kv-block",
+        )
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

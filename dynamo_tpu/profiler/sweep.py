@@ -1,0 +1,187 @@
+"""Profiling sweeps over a live engine.
+
+Analog of the reference's SLA profiler (benchmarks/profiler/profile_sla.py:
+138 — sweep deployments across parallelism/batch configs, persist the
+measured rates, interpolate in the planner) collapsed to the single-worker
+measurements the planner's PerfInterpolator consumes:
+
+- prefill: tokens/sec one worker sustains at each input length (measured
+  from time-to-first-token of cold prompts);
+- decode: aggregate tokens/sec at each concurrent-sequence count (measured
+  from steady-state token production after the first token).
+
+Works against any AsyncEngine — the real TpuEngine on hardware, or the
+MockerEngine for control-plane tests — and doubles as the calibration
+source for the mocker's linear timing model (perf_model.rs analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+
+log = get_logger("profiler")
+
+
+@dataclasses.dataclass
+class ProfileResult:
+    """Measured single-worker capacities (the planner's interpolation feed)."""
+
+    prefill_points: List[Tuple[float, float]] = dataclasses.field(default_factory=list)
+    decode_points: List[Tuple[float, float]] = dataclasses.field(default_factory=list)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_obj(self) -> Dict[str, Any]:
+        return {
+            "prefill_points": [list(p) for p in self.prefill_points],
+            "decode_points": [list(p) for p in self.decode_points],
+            "meta": self.meta,
+        }
+
+    @classmethod
+    def from_obj(cls, obj: Dict[str, Any]) -> "ProfileResult":
+        return cls(
+            prefill_points=[tuple(p) for p in obj.get("prefill_points", [])],
+            decode_points=[tuple(p) for p in obj.get("decode_points", [])],
+            meta=obj.get("meta", {}),
+        )
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_obj(), f, indent=2)
+
+    @classmethod
+    def load(cls, path: str) -> "ProfileResult":
+        with open(path) as f:
+            return cls.from_obj(json.load(f))
+
+
+def _preq(rid: str, tokens: Sequence[int], max_tokens: int) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        request_id=rid, model="profile", token_ids=list(tokens),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+async def profile_engine(
+    engine,
+    isl_list: Sequence[int] = (128, 512, 1024),
+    osl: int = 64,
+    batch_list: Sequence[int] = (1, 2, 4, 8),
+    reps: int = 2,
+    seed: int = 0,
+    vocab: int = 250,
+) -> ProfileResult:
+    """Sweep one engine. Prompts are derived from (seed, sweep point, rep) so
+    every measurement is a cold prefix — prefix-cache hits would inflate the
+    numbers."""
+    import asyncio
+
+    result = ProfileResult(meta={"osl": osl, "reps": reps, "ts": time.time()})
+    uniq = [seed * 7919]
+
+    def prompt(n: int) -> List[int]:
+        uniq[0] += 1
+        base = uniq[0]
+        return [(base * 131 + j * 7) % vocab for j in range(n)]
+
+    # --- warmup: hit every prefill bucket + the decode program once, so
+    # XLA compile time (30-90s cold on TPU) never lands in a measurement ---
+    for isl in sorted(set(isl_list)):
+        req = _preq(f"warm-{isl}", prompt(isl), max_tokens=4)
+        async for _ in engine.generate(req, Context()):
+            pass
+
+    # --- prefill: TTFT of a single cold request per ISL ---
+    for isl in isl_list:
+        ttfts = []
+        for r in range(reps):
+            req = _preq(f"pf-{isl}-{r}", prompt(isl), max_tokens=1)
+            t0 = time.monotonic()
+            async for out in engine.generate(req, Context()):
+                if out.token_ids:
+                    ttfts.append(time.monotonic() - t0)
+                    break
+        best = min(ttfts)
+        result.prefill_points.append((float(isl), isl / best))
+        log.info("prefill isl=%d: ttft=%.4fs -> %.0f tok/s", isl, best, isl / best)
+
+    # --- decode: steady tokens/s at each concurrency ---
+    isl0 = min(isl_list)
+    result.meta["decode_isl"] = isl0
+    for batch in batch_list:
+        async def one(i: int, t_first: list, t_last: list, counts: list):
+            req = _preq(f"dc-{batch}-{i}", prompt(isl0), max_tokens=osl)
+            n = 0
+            async for out in engine.generate(req, Context()):
+                now = time.monotonic()
+                if n == 0 and out.token_ids:
+                    t_first.append(now)
+                n += len(out.token_ids)
+                t_last.append(now)
+            counts.append(n)
+
+        t_first: list = []
+        t_last: list = []
+        counts: list = []
+        await asyncio.gather(*[one(i, t_first, t_last, counts) for i in range(batch)])
+        total = sum(counts) - len(counts)  # exclude each stream's first token
+        window = max(t_last) - min(t_first)
+        rate = total / window if window > 0 else 0.0
+        result.decode_points.append((float(batch), rate))
+        log.info("decode batch=%d: %.0f tok/s", batch, rate)
+    return result
+
+
+def calibrate_mocker_args(profile: ProfileResult, args=None):
+    """Fit the mocker's linear timing model to a measured profile
+    (perf_model.rs analog: the simulator reproduces real timing).
+
+    prefill: time(isl) = base + per_token * isl, least-squares over the
+    measured (isl, rate) points. decode: step time at concurrency b is
+    base_total(b) = b / rate(b) ~= decode_base + slope * b (the per-sequence
+    attention cost folds into the slope)."""
+    import numpy as np
+
+    from ..mocker.engine import MockEngineArgs
+
+    args = args or MockEngineArgs()
+    if profile.prefill_points:
+        isl = np.array([p[0] for p in profile.prefill_points])
+        t = isl / np.array([max(p[1], 1e-9) for p in profile.prefill_points])
+        A = np.stack([np.ones_like(isl), isl], axis=1)
+        coef, *_ = np.linalg.lstsq(A, t, rcond=None)
+        base, per_token = float(max(coef[0], 0.0)), float(max(coef[1], 0.0))
+        args = dataclasses.replace(
+            args, prefill_base_s=base, prefill_per_token_s=per_token
+        )
+    if profile.decode_points:
+        b = np.array([p[0] for p in profile.decode_points])
+        step = b / np.array([max(p[1], 1e-9) for p in profile.decode_points])
+        A = np.stack([np.ones_like(b), b], axis=1)
+        coef, *_ = np.linalg.lstsq(A, step, rcond=None)
+        base = float(max(coef[0], 1e-6))
+        # the per-batch slope approximates KV traffic per active sequence
+        per_seq = float(max(coef[1], 0.0))
+        blocks_per_seq = max(
+            1.0,
+            (profile.meta.get("decode_isl", 0) + profile.meta.get("osl", 64) / 2)
+            / args.block_size,
+        )
+        args = dataclasses.replace(
+            args,
+            decode_base_s=base,
+            decode_per_kv_block_s=per_seq / blocks_per_seq,
+        )
+    return args

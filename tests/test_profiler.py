@@ -1,0 +1,121 @@
+"""Profiler sweeps -> planner calibration -> mocker timing calibration.
+
+Mirrors the reference's profiler-to-planner feed (benchmarks/profiler/
+profile_sla.py -> utils/perf_interpolation.py) and the mocker perf model
+(lib/mocker/src/perf_model.rs).
+"""
+
+import math
+
+import pytest
+
+from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+from dynamo_tpu.planner.connectors import Connector
+from dynamo_tpu.planner.core import (
+    DisaggPlanner,
+    LoadSnapshot,
+    PerfInterpolator,
+    PlannerConfig,
+)
+from dynamo_tpu.profiler import ProfileResult, calibrate_mocker_args, profile_engine
+
+# step durations well above asyncio timer jitter (~1-2ms), so single-rep
+# measurements are stable in CI
+TIMING = dict(
+    prefill_base_s=0.02, prefill_per_token_s=1e-4,
+    decode_base_s=0.01, decode_per_kv_block_s=5e-6,
+)
+
+
+async def _profile_mocker(**kw):
+    engine = MockerEngine(MockEngineArgs(block_size=4, num_blocks=2048, **TIMING))
+    return await profile_engine(
+        engine, isl_list=kw.get("isl", (32, 128)), osl=kw.get("osl", 16),
+        batch_list=kw.get("batch", (1, 4)), reps=1,
+    )
+
+
+async def test_profile_measures_sane_capacities():
+    prof = await _profile_mocker()
+    assert len(prof.prefill_points) == 2 and len(prof.decode_points) == 2
+    # prefill rate grows with ISL (base cost amortizes)
+    (i0, r0), (i1, r1) = prof.prefill_points
+    assert i0 < i1 and r1 > r0 > 0
+    # decode aggregate rate grows with concurrency
+    (b0, d0), (b1, d1) = prof.decode_points
+    assert d1 > d0 > 0
+    # measured prefill rate within 2x of the timing model's truth
+    truth = i1 / (TIMING["prefill_base_s"] + TIMING["prefill_per_token_s"] * i1)
+    assert truth / 2 < r1 < truth * 2
+
+
+async def test_interpolator_fits_measured_points():
+    prof = await _profile_mocker()
+    interp = PerfInterpolator.from_profile(prof.to_obj())
+    (i0, r0), (i1, r1) = prof.prefill_points
+    assert interp.prefill_capacity(i0) == pytest.approx(r0)
+    assert interp.prefill_capacity(i1) == pytest.approx(r1)
+    mid = interp.prefill_capacity((i0 + i1) / 2)
+    assert min(r0, r1) <= mid <= max(r0, r1)
+    # defaults are replaced by measured numbers
+    assert interp.decode_tokens_per_s == max(r for _, r in prof.decode_points)
+
+
+async def test_mocker_calibration_roundtrip():
+    """Calibrated constants reproduce the measured rates (perf_model.rs
+    analog): re-profiling a mocker built from the fitted args lands within
+    35% of the original measurements."""
+    prof = await _profile_mocker(isl=(32, 64, 128), batch=(1, 2, 4))
+    fitted = calibrate_mocker_args(prof, MockEngineArgs(block_size=4, num_blocks=2048))
+    engine = MockerEngine(fitted)
+    prof2 = await profile_engine(
+        engine, isl_list=(32, 64, 128), osl=16, batch_list=(1, 2, 4), reps=1
+    )
+    for (x1, r1), (x2, r2) in zip(prof.prefill_points, prof2.prefill_points):
+        assert x1 == x2
+        assert abs(r2 - r1) / r1 < 0.35, (x1, r1, r2)
+    for (b1, r1), (b2, r2) in zip(prof.decode_points, prof2.decode_points):
+        assert b1 == b2
+        assert abs(r2 - r1) / r1 < 0.35, (b1, r1, r2)
+
+
+class RecordingConnector(Connector):
+    def __init__(self):
+        self.replicas = {"backend_prefill": 1, "backend": 1}
+        self.calls = []
+
+    async def get_replicas(self, component):
+        return self.replicas[component]
+
+    async def set_replicas(self, component, n):
+        self.replicas[component] = n
+        self.calls.append((component, n))
+
+
+async def test_planner_scales_on_measured_capacity():
+    """Done-bar: the planner's replica math runs on MEASURED capacities, not
+    the hardcoded defaults."""
+    prof = await _profile_mocker()
+    interp = PerfInterpolator.from_profile(prof.to_obj())
+    decode_cap = interp.decode_capacity(4)
+    assert decode_cap != PerfInterpolator().decode_tokens_per_s
+
+    conn = RecordingConnector()
+    planner = DisaggPlanner(
+        conn,
+        PlannerConfig(min_replicas=1, max_replicas=16, predictor="constant"),
+        interp,
+    )
+    # steady decode load worth ~3.4 measured workers
+    load = 3.4 * decode_cap
+    isl = prof.prefill_points[0][0]
+    for _ in range(4):
+        planner.observe(LoadSnapshot(
+            decode_tokens_rate=load,
+            prefill_tokens_rate=interp.prefill_capacity(isl) * 1.5,
+            avg_isl=isl, active_seqs=4,
+        ))
+    sizes = await planner.plan()
+    assert sizes["decode"] == math.ceil(3.4)  # 4 workers of MEASURED capacity
+    assert sizes["prefill"] == 2
+    assert conn.replicas["backend"] == 4
