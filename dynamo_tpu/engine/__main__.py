@@ -64,7 +64,14 @@ def parse_args():
     p.add_argument("--num-blocks", type=int, default=2048)
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--max-batch-size", type=int, default=8)
-    p.add_argument("--max-context", type=int, default=2048)
+    p.add_argument("--max-context", type=int, default=2048,
+                   help="may exceed the largest prefill bucket: long prompts "
+                   "prefill in bounded chunks")
+    p.add_argument("--prefill-chunk", type=int, default=2048,
+                   help="largest single prefill dispatch (= largest bucket)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="context-parallel ring attention width for chunk "
+                   "prefill (sequence sharded over the sp mesh axis)")
     p.add_argument("--migration-limit", type=int, default=0)
     p.add_argument("--kvbm-host-gb", type=float, default=0.0,
                    help="host DRAM KV tier size (G2); 0 disables kvbm")
@@ -117,9 +124,14 @@ async def main() -> None:
         return ((n + bs - 1) // bs) * bs
 
     ctx = rnd(args.max_context)
+    # buckets bound the CHUNK size, not the context: long prompts prefill in
+    # chunks of the largest bucket, so a 16k+ context never compiles a 16k-
+    # wide prefill program
+    chunk_cap = min(ctx, rnd(args.prefill_chunk))
     buckets = tuple(
-        rnd(b) for b in (64, 128, 256, 512, 1024, 2048, 4096, 8192) if rnd(b) < ctx
-    ) + (ctx,)
+        rnd(b) for b in (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+        if rnd(b) < chunk_cap
+    ) + (chunk_cap,)
     args.max_context = ctx
     kvbm = None
     if args.kvbm_host_gb > 0 or args.kvbm_disk_gb > 0:
@@ -141,6 +153,7 @@ async def main() -> None:
         max_batch_size=args.max_batch_size,
         max_context=args.max_context,
         tp=args.tp,
+        sp=args.sp,
         prefill_buckets=buckets,
     )
 
@@ -149,12 +162,13 @@ async def main() -> None:
     from dynamo_tpu.parallel.mesh import make_mesh
 
     def rank_mesh(rank: int):
-        """Each dp_rank serves from its own tp-sized device group when the
-        host has enough chips; otherwise ranks share (CPU smoke / 1 chip)."""
+        """Each dp_rank serves from its own (tp*sp)-sized device group when
+        the host has enough chips; otherwise ranks share (CPU smoke / 1 chip)."""
         devs = _jax.devices()
-        lo = rank * args.tp
-        if len(devs) >= args.dp * args.tp:
-            return make_mesh(tp=args.tp, devices=devs[lo : lo + args.tp])
+        group = args.tp * args.sp
+        lo = rank * group
+        if len(devs) >= args.dp * group:
+            return make_mesh(tp=args.tp, sp=args.sp, devices=devs[lo : lo + group])
         if rank == 0 and args.dp > 1 and _jax.default_backend() != "cpu":
             # sharing chips means every rank allocates a FULL KV cache +
             # param copy on the same HBM — fine for smoke runs, an OOM
@@ -165,7 +179,9 @@ async def main() -> None:
                 f"with dp). Provision dp*tp chips for real dp serving.",
                 flush=True,
             )
-        return make_mesh(tp=args.tp, devices=devs[: args.tp])
+        n = min(len(devs), args.tp * args.sp)
+        sp = args.sp if n >= args.tp * args.sp else 1
+        return make_mesh(tp=args.tp, sp=sp, devices=devs[: args.tp * sp])
 
     engines = []
     for r in range(args.dp):

@@ -68,8 +68,16 @@ class TpuEngineConfig:
     num_blocks: int = 512
     block_size: int = 16
     max_batch_size: int = 8
+    # max_context may exceed the largest prefill bucket: prompts prefill in
+    # bounded chunks (one chunk per engine-loop tick, so running decodes
+    # never starve behind a long prefill — the reference treats chunked
+    # prefill as table stakes, lib/mocker/src/protocols.rs:112,
+    # components/src/dynamo/trtllm/engine.py:119)
     max_context: int = 2048
     tp: int = 1
+    # context parallelism: chunk prefill attention rides ring_extend_attention
+    # over the sp mesh axis (parallel/ring.py) — the long-context scale path
+    sp: int = 1
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
     seed: int = 0
     # Pallas ragged decode kernel (ops/pallas_attention): None = auto-enable
@@ -94,11 +102,11 @@ class TpuEngineConfig:
             raise ValueError(
                 f"prefill_buckets {bad} not multiples of block_size {self.block_size}"
             )
-        if self.prefill_buckets[-1] < self.max_context:
-            raise ValueError(
-                f"largest prefill bucket {self.prefill_buckets[-1]} < max_context "
-                f"{self.max_context}: long prompts would have no bucket"
-            )
+
+    @property
+    def prefill_chunk(self) -> int:
+        """Largest single prefill dispatch; longer prompts chunk at this."""
+        return self.prefill_buckets[-1]
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -117,6 +125,9 @@ class _Seq:
     last_token: int = 0
     cached_tokens: int = 0
     sealed_upto: int = 0                  # how many blocks committed to cache
+    prefill_pos: int = 0                  # prompt tokens whose KV is written
+    commit_upto: int = 0                  # prompt blocks content-addressed so far
+    prefilled: bool = False               # prefill complete -> decode eligible
     done: bool = False
 
 
@@ -192,6 +203,7 @@ class TpuEngine:
         self._slot_dirty = np.zeros(B, bool)   # slot's penalty tables need reset
 
         self._waiting: List[_Seq] = []
+        self._prefill_rr = 0  # round-robin cursor over prefilling sequences
         # chained decode: FIFO of in-flight horizons (packed results + device
         # carry); results are fetched decode_pipeline-1 horizons behind the
         # dispatch front so readback RTT hides behind device compute
@@ -312,40 +324,68 @@ class TpuEngine:
                 axis=-1,
             )
 
+        if cfg.sp > 1:
+            from ..parallel import ring as ringlib
+
         def prefill(params, k_caches, v_caches, counts, tokens, positions,
-                    block_table, new_block_ids, total_len, seeds, steps, temp,
-                    top_k, top_p, min_p, pres, freq, rep, prompt_masks, slot,
-                    lp_need):
-            # tokens/positions: [S_pad]; block_table: [max_blocks_per_seq]
+                    block_table, new_block_ids, total_len, chunk_start, seeds,
+                    steps, temp, top_k, top_p, min_p, pres, freq, rep,
+                    prompt_masks, slot, lp_need, is_final):
+            # tokens/positions: [S_pad] — ONE chunk of the prompt (the whole
+            # prompt when it fits a bucket); block_table: [max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx):
                 kc, vc = att.write_prefill_kv(
                     k_caches[layer_idx], v_caches[layer_idx], k_new, v_new, new_block_ids
                 )
                 k_caches[layer_idx], v_caches[layer_idx] = kc, vc
                 k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
+                if cfg.sp > 1:
+                    # context-parallel chunk attention: queries + chunk KV
+                    # shard over the sp axis and rotate around the ring; the
+                    # cached prefix is attended locally (parallel/ring.py)
+                    return ringlib.ring_extend_attention(
+                        self.mesh, q, k_new, v_new, k_ctx, v_ctx,
+                        positions, chunk_start, chunk_start,
+                    )
                 return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
 
             hidden = fwd(params, mcfg, tokens, positions, attend)
-            # logits at the last real token (positions are absolute; the last
-            # real new token sits where position == total_len - 1)
-            last_idx = jnp.argmax(positions == total_len - 1)
-            logits = logits_fn(params, mcfg, hidden[last_idx][None])  # [1, V]
-            pen = apply_penalties(
-                logits, jnp.zeros_like(logits, jnp.int32),
-                prompt_masks[slot][None], pres, freq, rep,
+
+            def sample_branch(counts):
+                # logits at the last real token (positions are absolute; the
+                # last real new token sits where position == total_len - 1)
+                last_idx = jnp.argmax(positions == total_len - 1)
+                logits = logits_fn(params, mcfg, hidden[last_idx][None])  # [1, V]
+                pen = apply_penalties(
+                    logits, jnp.zeros_like(logits, jnp.int32),
+                    prompt_masks[slot][None], pres, freq, rep,
+                )
+                tok = sample_tokens(pen, seeds, steps, temp, top_k, top_p, min_p)
+                # the first generated token must enter the output counts, or
+                # the first decode step's penalties miss it
+                counts = jax.lax.cond(
+                    pen_need(pres, freq, rep),
+                    lambda c: c.at[slot, tok[0]].add(1),
+                    lambda c: c,
+                    counts,
+                )
+                lp = logprobs_of(logits, tok)
+                tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
+                return counts, tok[0], lp[0], tlp_vals[0], tlp_ids[0]
+
+            def no_sample(counts):
+                # intermediate chunk: KV written, no token sampled — skips
+                # the full-vocab lm_head matmul entirely
+                K = TOP_LOGPROBS_K
+                return (
+                    counts, jnp.int32(0), jnp.float32(0.0),
+                    jnp.zeros((K,), jnp.float32), jnp.zeros((K,), jnp.int32),
+                )
+
+            counts, tok, lp, tlp_vals, tlp_ids = jax.lax.cond(
+                is_final, sample_branch, no_sample, counts
             )
-            tok = sample_tokens(pen, seeds, steps, temp, top_k, top_p, min_p)
-            # the first generated token must enter the output counts, or the
-            # first decode step's penalties miss it
-            counts = jax.lax.cond(
-                pen_need(pres, freq, rep),
-                lambda c: c.at[slot, tok[0]].add(1),
-                lambda c: c,
-                counts,
-            )
-            lp = logprobs_of(logits, tok)
-            tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
-            return k_caches, v_caches, counts, tok[0], lp[0], tlp_vals[0], tlp_ids[0]
+            return k_caches, v_caches, counts, tok, lp, tlp_vals, tlp_ids
 
         def decode(params, k_caches, v_caches, counts, tokens, positions,
                    block_tables, seq_lens, write_blocks, write_offsets, seeds,
@@ -468,10 +508,28 @@ class TpuEngine:
         req = request if isinstance(request, PreprocessedRequest) else (
             PreprocessedRequest.from_obj(request)
         )
-        if len(req.token_ids) + len(req.prior_token_ids) >= self.cfg.max_context:
+        n_prompt = len(req.token_ids) + len(req.prior_token_ids)
+        if n_prompt >= self.cfg.max_context:
             raise ValueError(
-                f"prompt {len(req.token_ids)} tokens exceeds engine max_context "
+                f"prompt {n_prompt} tokens exceeds engine max_context "
                 f"{self.cfg.max_context}"
+            )
+        if (
+            req.annotations.get("op") == "embed"
+            and len(req.token_ids) > self.cfg.prefill_chunk
+        ):
+            # the pooled forward is a single dense-attention dispatch; it is
+            # bounded by the largest bucket, unlike chunked generation prefill
+            raise ValueError(
+                f"embedding input {len(req.token_ids)} tokens exceeds the "
+                f"largest prefill bucket {self.cfg.prefill_chunk}"
+            )
+        if n_prompt // self.cfg.block_size + 2 > self.cfg.num_blocks:
+            # would wait forever in admission — no amount of eviction frees
+            # enough pages for this prompt
+            raise ValueError(
+                f"prompt {n_prompt} tokens cannot fit the KV pool "
+                f"({self.cfg.num_blocks} blocks x {self.cfg.block_size})"
             )
         if req.annotations.get("op") == "embed":
             loop = asyncio.get_event_loop()
@@ -637,15 +695,36 @@ class TpuEngine:
                     self._wake.clear()
                     await self._wake.wait()
                 self._admit_cancelled()
-                admitted = self._try_admit()
-                for st in admitted:
-                    results = await loop.run_in_executor(
-                        self._executor, self._run_prefill, st
-                    )
-                    for rst, tok, lp, tids, tvals in results:
-                        self._accept_token(rst, tok, lp, tids, tvals)
+                self._try_admit()
+                # chunked prefill: ONE bounded chunk per tick, so running
+                # decodes keep making progress under a long prefill; round-
+                # robin across prefilling sequences so a short prompt is not
+                # starved behind a long one
+                prefilling = [
+                    s for s in self._slots
+                    if s is not None and not s.done and not s.prefilled
+                ]
+                if prefilling:
+                    pick = prefilling[self._prefill_rr % len(prefilling)]
+                    self._prefill_rr += 1
+                    if pick.context.is_stopped():
+                        # client gone mid-prefill: stop burning chunks, free
+                        # the slot at the next reap
+                        pick.done = True
+                        pick.out_queue.put_nowait(BackendOutput(
+                            finish_reason="cancelled",
+                            cumulative_tokens=pick.produced,
+                        ))
+                    else:
+                        res = await loop.run_in_executor(
+                            self._executor, self._run_prefill_chunk, pick
+                        )
+                        self._commit_prefilled_blocks(pick)
+                        if res is not None:
+                            self._accept_token(*res)
                 has_active = any(
-                    s is not None and not s.done for s in self._slots
+                    s is not None and not s.done and s.prefilled
+                    for s in self._slots
                 )
                 # top up the horizon pipeline BEFORE fetching the oldest
                 # results: readback RTT (hundreds of ms tunneled) overlaps
@@ -758,13 +837,14 @@ class TpuEngine:
                 continue
             st.block_ids = prefix_ids + new_ids
             st.cached_tokens = prefix_blocks * self.cfg.block_size
-            # complete prompt blocks become content-addressed now (prefill
-            # writes them this step); future requests can reuse them
-            for i in range(prefix_blocks, len(hashes)):
-                self.allocator.commit(st.block_ids[i], hashes[i])
-                if self.kvbm is not None:
-                    self._offload_pending.append((st.block_ids[i], hashes[i]))
+            # prompt blocks become content-addressed ONLY as their chunks'
+            # KV is actually written (_commit_prefilled_blocks after each
+            # chunk) — committing at admission would let a concurrent
+            # request match pages that hold garbage, and a mid-prefill kill
+            # would leak unwritten blocks into the reusable LRU
+            st.commit_upto = prefix_blocks
             st.sealed_upto = len(hashes)
+            st.prefill_pos = st.cached_tokens
             st.slot = slot
             self._slots[slot] = st
             self._block_tables[slot].fill(0)
@@ -818,7 +898,73 @@ class TpuEngine:
             f"{self.cfg.prefill_buckets[-1]}"
         )
 
+    def _commit_prefilled_blocks(self, st: _Seq) -> None:
+        """Event-loop thread, after a chunk lands: content-address the prompt
+        blocks whose KV the chunk just wrote (and queue their host-tier
+        offload). Only written blocks ever become matchable."""
+        hashes = st.seq.sequence_hashes()
+        upto = min(st.prefill_pos // self.cfg.block_size, len(hashes))
+        for i in range(st.commit_upto, upto):
+            self.allocator.commit(st.block_ids[i], hashes[i])
+            if self.kvbm is not None:
+                self._offload_pending.append((st.block_ids[i], hashes[i]))
+        st.commit_upto = max(st.commit_upto, upto)
+
     # -- device calls (run in executor thread) -------------------------------
+    def _run_prefill_chunk(self, st: _Seq):
+        """Prefill ONE bounded chunk of st's prompt (reference chunked
+        prefill, protocols.rs:112): writes the chunk's KV pages; the final
+        chunk also samples the first token. Returns None for intermediate
+        chunks, else the (st, tok, lp, tlp...) acceptance tuple."""
+        bs = self.cfg.block_size
+        prompt = st.seq.tokens()
+        start = st.prefill_pos
+        remaining = len(prompt) - start
+        cap = self.cfg.prefill_chunk
+        is_final = remaining <= cap
+        chunk_len = remaining if is_final else cap
+        suffix = prompt[start : start + chunk_len]
+        S_pad = self._bucket(chunk_len)
+        n_new_blocks = S_pad // bs
+
+        tokens = np.zeros(S_pad, np.int32)
+        tokens[:chunk_len] = suffix
+        positions = np.full(S_pad, self.cfg.max_context - 1, np.int32)
+        positions[:chunk_len] = np.arange(start, start + chunk_len)
+        # destinations: real blocks for this chunk's span, scratch elsewhere
+        new_block_ids = np.zeros(n_new_blocks, np.int32)
+        real_new = st.block_ids[start // bs :][: n_new_blocks]
+        new_block_ids[: len(real_new)] = real_new
+
+        s = st.req.sampling
+        total_len = start + chunk_len
+        (self.k_caches, self.v_caches, self.output_counts, tok, lp, tlp_vals,
+         tlp_ids) = self._prefill_fn(
+            self.params, self.k_caches, self.v_caches, self.output_counts,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self._block_tables[st.slot]),
+            jnp.asarray(new_block_ids), jnp.int32(total_len), jnp.int32(start),
+            jnp.asarray(np.array([self._seeds[st.slot]], np.uint32)),
+            jnp.asarray(np.array([0], np.int32)),
+            jnp.asarray(np.array([s.temperature], np.float32)),
+            jnp.asarray(np.array([s.top_k], np.int32)),
+            jnp.asarray(np.array([s.top_p], np.float32)),
+            jnp.asarray(np.array([s.min_p], np.float32)),
+            jnp.asarray(np.array([s.presence_penalty], np.float32)),
+            jnp.asarray(np.array([s.frequency_penalty], np.float32)),
+            jnp.asarray(np.array([s.repetition_penalty], np.float32)),
+            self.prompt_masks, jnp.int32(st.slot),
+            jnp.bool_(self._lp_ns[st.slot] > 0),
+            jnp.bool_(is_final),
+        )
+        st.prefill_pos = total_len
+        if not is_final:
+            return None
+        st.prefilled = True
+        if self._lp_ns[st.slot] > 0:
+            return (st, int(tok), float(lp), np.asarray(tlp_ids), np.asarray(tlp_vals))
+        return (st, int(tok), float(lp), None, None)
+
     def _run_embed(self, token_ids: List[int]) -> np.ndarray:
         S = len(token_ids)
         S_pad = self._bucket(S)
@@ -830,52 +976,6 @@ class TpuEngine:
             jnp.int32(S - 1),
         )
         return np.asarray(vec)
-
-    def _run_prefill(self, st: _Seq) -> List[Tuple[_Seq, int, float]]:
-        bs = self.cfg.block_size
-        prompt = st.seq.tokens()
-        prefix = st.cached_tokens
-        suffix = prompt[prefix:]
-        S = len(suffix)
-        S_pad = self._bucket(S)
-        n_new_blocks = S_pad // bs
-
-        tokens = np.zeros(S_pad, np.int32)
-        tokens[:S] = suffix
-        positions = np.full(S_pad, self.cfg.max_context - 1, np.int32)
-        positions[:S] = np.arange(prefix, prefix + S)
-        # destinations: real blocks for the suffix span, scratch elsewhere
-        new_block_ids = np.zeros(n_new_blocks, np.int32)
-        real_new = st.block_ids[prefix // bs :]
-        new_block_ids[: len(real_new)] = real_new
-
-        s = st.req.sampling
-        temp = np.array([s.temperature], np.float32)
-        top_k = np.array([s.top_k], np.int32)
-        top_p = np.array([s.top_p], np.float32)
-        min_p = np.array([s.min_p], np.float32)
-        pres = np.array([s.presence_penalty], np.float32)
-        freq = np.array([s.frequency_penalty], np.float32)
-        rep = np.array([s.repetition_penalty], np.float32)
-        seeds = np.array([self._seeds[st.slot]], np.uint32)
-        steps = np.array([0], np.int32)
-
-        (self.k_caches, self.v_caches, self.output_counts, tok, lp, tlp_vals,
-         tlp_ids) = self._prefill_fn(
-            self.params, self.k_caches, self.v_caches, self.output_counts,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(self._block_tables[st.slot]),
-            jnp.asarray(new_block_ids), jnp.int32(len(prompt)),
-            jnp.asarray(seeds), jnp.asarray(steps),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(min_p), jnp.asarray(pres), jnp.asarray(freq),
-            jnp.asarray(rep), self.prompt_masks, jnp.int32(st.slot),
-            jnp.bool_(self._lp_ns[st.slot] > 0),
-        )
-        if self._lp_ns[st.slot] > 0:
-            return [(st, int(tok), float(lp),
-                     np.asarray(tlp_ids), np.asarray(tlp_vals))]
-        return [(st, int(tok), float(lp), None, None)]
 
     def _prepare_horizon(self, depth: int = 1) -> bool:
         """Pre-allocate pages so every active sequence can absorb ``depth``
@@ -889,7 +989,7 @@ class TpuEngine:
         granted: List[Tuple[_Seq, int]] = []  # rollback on partial failure
         ok = True
         for st in self._slots:
-            if st is None or st.done:
+            if st is None or st.done or not st.prefilled:
                 continue
             L = len(st.seq)
             if L + depth * n >= self.cfg.max_context:
@@ -938,7 +1038,7 @@ class TpuEngine:
         B = self.cfg.max_batch_size
         active = np.zeros(B, bool)
         for i, st in enumerate(self._slots):
-            if st is not None and not st.done:
+            if st is not None and not st.done and st.prefilled:
                 active[i] = True
         if chain is not None:
             tokens, seq_lens, steps = chain.tokens, chain.seq_lens, chain.steps
@@ -946,7 +1046,7 @@ class TpuEngine:
             seq_lens_np = np.zeros(B, np.int32)
             steps_np = np.zeros(B, np.int32)
             for i, st in enumerate(self._slots):
-                if st is None or st.done:
+                if st is None or st.done or not st.prefilled:
                     continue
                 seq_lens_np[i] = len(st.seq)
                 steps_np[i] = st.produced
@@ -980,7 +1080,7 @@ class TpuEngine:
         # are already on host and np.asarray is a no-wait copy
         packed.copy_to_host_async()
         seqs = [
-            st if (st is not None and not st.done) else None
+            st if (st is not None and not st.done and st.prefilled) else None
             for st in self._slots
         ]
         return _Chain(packed, tokens, seq_lens, steps, seqs)
@@ -990,7 +1090,10 @@ class TpuEngine:
         currently-active slot holds the same sequence it held at dispatch —
         an admission into a recycled slot would decode from a stale carry."""
         for i, st in enumerate(self._slots):
-            if st is not None and not st.done and chain.seqs[i] is not st:
+            if (
+                st is not None and not st.done and st.prefilled
+                and chain.seqs[i] is not st
+            ):
                 return False
         return True
 
@@ -1024,7 +1127,7 @@ class TpuEngine:
         positions = np.zeros(B, np.int32)
         seq_lens = np.zeros(B, np.int32)
         for i, st in enumerate(self._slots):
-            if st is None or st.done:
+            if st is None or st.done or not st.prefilled:
                 continue
             L = len(st.seq)                    # includes the token being fed
             positions[i] = L - 1
@@ -1036,7 +1139,7 @@ class TpuEngine:
 
         steps = np.zeros(B, np.int32)
         for i, st in enumerate(self._slots):
-            if st is not None and not st.done:
+            if st is not None and not st.done and st.prefilled:
                 steps[i] = st.produced
 
         lp_need = bool(np.any((self._lp_ns > 0) & (seq_lens > 0)))
@@ -1059,7 +1162,7 @@ class TpuEngine:
         tlp_vals_np = np.asarray(tlp_vals) if lp_need else None
         results = []
         for i, st in enumerate(self._slots):
-            if st is None or st.done:
+            if st is None or st.done or not st.prefilled:
                 continue
             if self._lp_ns[i] > 0 and tlp_ids_np is not None:
                 results.append((st, int(toks_np[i]), float(lps_np[i]),

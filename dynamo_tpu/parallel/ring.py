@@ -203,9 +203,7 @@ def ring_extend_attention(
     if q.shape[0] % sp:
         raise ValueError(f"chunk {q.shape[0]} not divisible by sp={sp}")
     fn = jax.shard_map(
-        functools.partial(_ring_extend_shard, sp_axis and sp_axis, axis_name=sp_axis)
-        if False
-        else functools.partial(_ring_extend_shard, axis_name=sp_axis),
+        functools.partial(_ring_extend_shard, axis_name=sp_axis),
         mesh=mesh,
         in_specs=(
             P(sp_axis, None, None),   # q

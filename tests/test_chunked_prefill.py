@@ -1,0 +1,222 @@
+"""Chunked prefill + long context in the real engine (VERDICT r2 item 2).
+
+The reference treats chunked prefill as table stakes (lib/mocker/src/
+protocols.rs:112, components/src/dynamo/trtllm/engine.py:119); here the
+engine owns it: prompts longer than the largest prefill bucket run in
+bounded chunks against cached prefix pages, one chunk per engine-loop tick,
+with ring_extend_attention as the context-parallel chunk path (sp > 1).
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.runtime import Context
+
+MODEL = LlamaConfig(
+    vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+    num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+)
+
+
+def engine(buckets, max_context=512, sp=1, tp=1, **kw):
+    defaults = dict(
+        num_blocks=256, block_size=4, max_batch_size=4,
+        max_context=max_context, prefill_buckets=buckets, sp=sp, tp=tp,
+    )
+    defaults.update(kw)
+    cfg = TpuEngineConfig(model=MODEL, **defaults)
+    n = sp * tp
+    return TpuEngine(cfg, mesh=make_mesh(tp=tp, sp=sp, devices=jax.devices()[:n]))
+
+
+def preq(rid, tokens, n=8):
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=tokens,
+        stop=StopConditions(max_tokens=n, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+async def run(eng, rid, tokens, n=8):
+    toks, cached = [], None
+    async for out in eng.generate(preq(rid, tokens, n), Context()):
+        toks.extend(out.token_ids)
+        if out.annotations and "cached_tokens" in out.annotations:
+            cached = out.annotations["cached_tokens"]
+    return toks, cached
+
+
+PROMPT = [(i * 37 + 11) % 500 for i in range(200)]
+
+
+async def test_chunked_equals_single_shot():
+    """A prompt longer than every bucket (forcing 7 chunks of <=32) produces
+    token-identical greedy output to a single-shot prefill."""
+    e_big = engine(buckets=(256,))
+    try:
+        ref, _ = await run(e_big, "ref", PROMPT)
+    finally:
+        e_big.stop()
+    e_chunked = engine(buckets=(16, 32))  # chunk cap 32 << 200-token prompt
+    try:
+        got, cached = await run(e_chunked, "chk", PROMPT)
+        assert got == ref
+        # prefix cache still content-addresses the chunked pages: a repeat
+        # reuses all complete prompt blocks
+        got2, cached2 = await run(e_chunked, "chk2", PROMPT)
+        assert got2 == ref
+        assert cached2 >= (len(PROMPT) - 1) // 4 * 4 - 4
+    finally:
+        e_chunked.stop()
+
+
+async def test_long_context_beyond_largest_bucket():
+    """max_context 2048 with a 128-token chunk cap: a 1500-token prompt
+    (12 chunks) serves end-to-end."""
+    e = engine(buckets=(64, 128), max_context=2048, num_blocks=1024)
+    prompt = [(i * 13 + 5) % 500 for i in range(1500)]
+    try:
+        toks, _ = await run(e, "long", prompt, n=4)
+        assert len(toks) == 4
+        # deterministic across runs
+        toks2, cached = await run(e, "long2", prompt, n=4)
+        assert toks2 == toks
+        assert cached and cached > 1400
+    finally:
+        e.stop()
+
+
+async def test_short_request_not_starved_by_long_prefill():
+    """Chunk-per-tick + round-robin: a short prompt submitted during a long
+    prefill gets its first token before the long prefill finishes."""
+    e = engine(buckets=(16, 32), max_context=1024)
+    long_prompt = [(i * 7 + 3) % 500 for i in range(800)]  # 25 chunks
+    order = []
+
+    async def drive(rid, tokens, n):
+        async for out in e.generate(preq(rid, tokens, n), Context()):
+            if out.token_ids:
+                order.append(rid)
+                return
+
+    try:
+        t_long = asyncio.create_task(drive("long", long_prompt, 1))
+        await asyncio.sleep(0.05)  # long prefill underway
+        t_short = asyncio.create_task(drive("short", list(range(20)), 1))
+        await asyncio.gather(t_long, t_short)
+        assert order[0] == "short", order
+    finally:
+        e.stop()
+
+
+async def test_sp_ring_prefill_matches_sp1():
+    """Engine-integrated CP: chunk prefill through ring_extend_attention on
+    an sp=2 mesh produces the same greedy output as sp=1."""
+    e1 = engine(buckets=(16, 32))
+    try:
+        ref, _ = await run(e1, "a", PROMPT)
+    finally:
+        e1.stop()
+    e2 = engine(buckets=(16, 32), sp=2)
+    try:
+        got, _ = await run(e2, "b", PROMPT)
+        assert got == ref
+    finally:
+        e2.stop()
+
+
+async def test_sp_with_tp_combined():
+    """sp=2 x tp=2 mesh: ring chunk attention + TP-sharded projections."""
+    e1 = engine(buckets=(16, 32))
+    try:
+        ref, _ = await run(e1, "a", PROMPT, n=4)
+    finally:
+        e1.stop()
+    e = engine(buckets=(16, 32), sp=2, tp=2)
+    try:
+        got, _ = await run(e, "c", PROMPT, n=4)
+        assert got == ref
+    finally:
+        e.stop()
+
+
+async def test_concurrent_identical_prompt_never_matches_unwritten_pages():
+    """Regression (code-review r3): block hashes are committed only after
+    their chunk's KV lands. A same-prompt request racing a chunked prefill
+    must produce correct output — never sample from garbage pages."""
+    e_ref = engine(buckets=(256,))
+    prompt = [(i * 37 + 11) % 500 for i in range(200)]
+    try:
+        async def collect(eng, rid):
+            toks = []
+            async for out in eng.generate(preq(rid, prompt, 6), Context()):
+                toks.extend(out.token_ids)
+            return toks
+
+        ref = await collect(e_ref, "ref")
+    finally:
+        e_ref.stop()
+
+    e = engine(buckets=(16, 32))  # 7 chunks
+    try:
+        async def collect2(rid, delay):
+            await asyncio.sleep(delay)
+            toks = []
+            async for out in e.generate(preq(rid, prompt, 6), Context()):
+                toks.extend(out.token_ids)
+            return toks
+
+        a, b = await asyncio.gather(collect2("a", 0), collect2("b", 0.02))
+        assert a == ref
+        assert b == ref  # not poisoned by matching unwritten pages
+    finally:
+        e.stop()
+
+
+async def test_cancel_mid_prefill_frees_slot_and_poisons_nothing():
+    """Killing a request mid-chunked-prefill stops chunk dispatch, frees the
+    slot, and leaves no unwritten block matchable."""
+    e = engine(buckets=(16, 32), max_context=1024, num_blocks=512)
+    long_prompt = [(i * 7 + 3) % 500 for i in range(800)]
+    ctx = Context("victim")
+
+    async def drive():
+        async for out in e.generate(preq("victim", long_prompt, 4), ctx):
+            pass
+
+    t = asyncio.create_task(drive())
+    await asyncio.sleep(0.1)  # a few chunks in
+    ctx.stop_generating()
+    await asyncio.wait_for(t, timeout=10)
+    # slot freed
+    for _ in range(100):
+        if all(s is None for s in e._slots):
+            break
+        await asyncio.sleep(0.02)
+    assert all(s is None for s in e._slots)
+    try:
+        # a later identical request must produce the same output as a fresh
+        # engine (whatever prefix it reuses was genuinely written)
+        toks = []
+        async for out in e.generate(preq("later", long_prompt, 4), Context()):
+            toks.extend(out.token_ids)
+        e2 = engine(buckets=(16, 32), max_context=1024, num_blocks=512)
+        try:
+            ref = []
+            async for out in e2.generate(preq("r", long_prompt, 4), Context()):
+                ref.extend(out.token_ids)
+        finally:
+            e2.stop()
+        assert toks == ref
+    finally:
+        e.stop()

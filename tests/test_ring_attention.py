@@ -72,3 +72,52 @@ def test_ring_under_jit_with_tp():
 
     ref = att.causal_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_extend_matches_dense_extend(sp):
+    """ring_extend_attention (chunk queries + cached prefix) == dense
+    extend_attention over (prefix ++ chunk) — the engine's chunked-prefill
+    CP path (VERDICT r2 item 2)."""
+    from dynamo_tpu.parallel.ring import ring_extend_attention
+
+    rng = np.random.default_rng(2)
+    h, kvh, d = 4, 2, 16
+    prefix, S = 24, 32  # chunk of 32 after a 24-token cached prefix
+    T_pad = 64          # padded prefix pages (rows past prefix are garbage)
+
+    k_full = jnp.asarray(rng.standard_normal((prefix + S, kvh, d)), jnp.float32)
+    v_full = jnp.asarray(rng.standard_normal((prefix + S, kvh, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((S, h, d)), jnp.float32)
+
+    # dense reference: chunk queries attend prefix + chunk
+    positions = jnp.arange(prefix, prefix + S)
+    ref = att.extend_attention(q, k_full, v_full, positions, jnp.int32(prefix + S))
+
+    # ring: prefix pages padded with garbage past prefix_len
+    k_ctx = jnp.asarray(rng.standard_normal((T_pad, kvh, d)), jnp.float32)
+    v_ctx = jnp.asarray(rng.standard_normal((T_pad, kvh, d)), jnp.float32)
+    k_ctx = k_ctx.at[:prefix].set(k_full[:prefix])
+    v_ctx = v_ctx.at[:prefix].set(v_full[:prefix])
+    mesh = meshlib.make_mesh(sp=sp, devices=jax.devices()[:sp])
+    got = ring_extend_attention(
+        mesh, q, k_full[prefix:], v_full[prefix:], k_ctx, v_ctx,
+        positions, jnp.int32(prefix), jnp.int32(prefix),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_extend_no_prefix():
+    """chunk_start=0 (first chunk): pure causal over the chunk."""
+    from dynamo_tpu.parallel.ring import ring_extend_attention
+
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, 32, 4, 2, 16)
+    mesh = meshlib.make_mesh(sp=4, devices=jax.devices()[:4])
+    ref = att.causal_attention(q, k, v)
+    k_ctx = jnp.zeros((16, 2, 16), jnp.float32)
+    v_ctx = jnp.zeros((16, 2, 16), jnp.float32)
+    got = ring_extend_attention(
+        mesh, q, k, v, k_ctx, v_ctx, jnp.arange(32), jnp.int32(0), jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5)
