@@ -124,7 +124,6 @@ class _Seq:
     produced: int = 0
     last_token: int = 0
     cached_tokens: int = 0
-    sealed_upto: int = 0                  # how many blocks committed to cache
     prefill_pos: int = 0                  # prompt tokens whose KV is written
     commit_upto: int = 0                  # prompt blocks content-addressed so far
     prefilled: bool = False               # prefill complete -> decode eligible
@@ -843,7 +842,6 @@ class TpuEngine:
             # request match pages that hold garbage, and a mid-prefill kill
             # would leak unwritten blocks into the reusable LRU
             st.commit_upto = prefix_blocks
-            st.sealed_upto = len(hashes)
             st.prefill_pos = st.cached_tokens
             st.slot = slot
             self._slots[slot] = st
@@ -1213,7 +1211,6 @@ class TpuEngine:
                     self.allocator.commit(
                         st.block_ids[sealed.position], sealed.sequence_hash
                     )
-                    st.sealed_upto = sealed.position + 1
                     if self.kvbm is not None:
                         self._offload_pending.append(
                             (st.block_ids[sealed.position], sealed.sequence_hash)

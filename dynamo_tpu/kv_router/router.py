@@ -47,8 +47,13 @@ class KvRouter:
         block_size: int = 16,
         config: Optional[KvRouterConfig] = None,
         seed: Optional[int] = None,
+        recorder=None,
     ):
         self.config = config or KvRouterConfig()
+        # optional runtime.recorder.Recorder: captures the ingested KV-event
+        # stream as JSONL for offline replay (reference lib/llm/src/recorder.rs
+        # feeding benchmarks/router playback)
+        self.recorder = recorder
         self.block_size = block_size
         self.namespace = namespace
         self.component = component
@@ -95,8 +100,11 @@ class KvRouter:
         assert isinstance(self.indexer, KvIndexer)
         async for _topic, payload in sub:
             try:
-                ev = RouterEvent.from_obj(msgpack.unpackb(payload, raw=False))
+                obj = msgpack.unpackb(payload, raw=False)
+                ev = RouterEvent.from_obj(obj)
                 self.indexer.apply(ev)
+                if self.recorder is not None:
+                    self.recorder.record({"kind": "kv_event", "event": obj})
             except Exception:
                 log.exception("bad router event")
 

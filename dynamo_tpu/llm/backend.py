@@ -110,6 +110,26 @@ class Backend:
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
         req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_obj(request)
+        # distributed tracing: continue the frontend's trace across the
+        # request-plane hop (runtime/tracing.py; reference logging.rs:206-270)
+        from ..runtime.tracing import get_tracer
+
+        tracer = get_tracer()
+        if not tracer.enabled:
+            async for item in self._generate_inner(req, context):
+                yield item
+            return
+        with tracer.span(
+            "worker.generate",
+            traceparent=req.annotations.get("traceparent"),
+            request_id=req.request_id,
+        ):
+            async for item in self._generate_inner(req, context):
+                yield item
+
+    async def _generate_inner(
+        self, req: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[Any]:
         decode = DecodeStream(self.tokenizer)
         jail = StopStringJail(req.stop.stop_strings)
         stop_token_ids = set(req.stop.stop_token_ids)

@@ -23,6 +23,8 @@ from ...runtime import metrics as M
 from ...runtime.engine import Context
 from ...runtime.logging import get_logger
 from ...runtime.request_plane.tcp import NoResponders
+from ...runtime.tracing import Tracer, get_tracer
+from ..audit import AuditBus
 from ...parsers import get_reasoning_parser, get_tool_parser
 from ..discovery import ModelManager, ModelPipeline
 from ..protocols.common import BackendOutput, PreprocessedRequest
@@ -85,11 +87,17 @@ class HttpService:
         busy_threshold: Optional[int] = None,
         host: str = "0.0.0.0",
         port: int = 8000,
+        tracer: Optional[Tracer] = None,
+        audit_bus: Optional[AuditBus] = None,
     ):
         self.manager = manager
         self.host = host
         self.port = port
         self.busy_threshold = busy_threshold
+        # observability: W3C traceparent in -> spans out (runtime/tracing.py,
+        # reference logging.rs:206-270); audit records per policy (llm/audit.py)
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.audit = audit_bus if audit_bus is not None else AuditBus()
         self.inflight = 0
         self.metrics = metrics_scope or M.MetricsScope()
         self._requests = self.metrics.counter(
@@ -137,6 +145,8 @@ class HttpService:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+        # short-lived processes would otherwise drop a partial span batch
+        self.tracer.flush()
 
     # -- aux handlers --------------------------------------------------------
     async def health(self, request: web.Request) -> web.Response:
@@ -185,6 +195,7 @@ class HttpService:
         stream_mode: bool,
         delta_gen,
         aggregator,
+        audit_handle=None,
     ) -> web.StreamResponse:
         """Execute one generation request: routing, streaming, metrics, errors."""
         ctx = Context(preq.request_id)
@@ -193,6 +204,15 @@ class HttpService:
         status = "200"
         resp: Optional[web.StreamResponse] = None
         prompt_tokens = completion_tokens = 0
+        # span parents on the client's traceparent header when present;
+        # downstream hops (request plane -> worker) get it via annotations
+        span = self.tracer.span(
+            "http.generate",
+            traceparent=request.headers.get("traceparent"),
+            request_id=preq.request_id, model=model, streaming=stream_mode,
+        )
+        preq.annotations["traceparent"] = span.traceparent()
+        span.__enter__()
         try:
             stream = self._observed(
                 pipeline.generate_tokens(preq, ctx), model, time.monotonic()
@@ -214,11 +234,19 @@ class HttpService:
                 finally:
                     prompt_tokens = delta_gen.prompt_tokens
                     completion_tokens = delta_gen.completion_tokens
+                    if audit_handle is not None:
+                        audit_handle.set_response({
+                            "streamed": True,
+                            "completion_tokens": completion_tokens,
+                            "prompt_tokens": prompt_tokens,
+                        })
                 return resp
             result = await aggregator(stream)
             usage = result.usage
             if usage is not None:
                 prompt_tokens, completion_tokens = usage.prompt_tokens, usage.completion_tokens
+            if audit_handle is not None:
+                audit_handle.set_response(result.model_dump(exclude_none=True))
             return web.json_response(result.model_dump(exclude_none=True))
         except NoResponders:
             status = "503"
@@ -238,6 +266,15 @@ class HttpService:
             self._input_tokens.inc(prompt_tokens, model=model)
             self._output_tokens.inc(completion_tokens, model=model)
             ctx.stop_generating()
+            span.set(status=status, completion_tokens=completion_tokens)
+            if status not in ("200", "499"):
+                # the handler converts errors to responses before the span
+                # closes, so mark failure explicitly or OTLP status reads OK
+                span.status = "ERROR"
+            span.__exit__(None, None, None)
+            if audit_handle is not None:
+                audit_handle.emit()
+                await self.audit.drain_async_sinks()
 
     async def _fail(
         self, resp: Optional[web.StreamResponse], status: int, msg: str, err_type: str
@@ -284,6 +321,9 @@ class HttpService:
             reasoning_parser=_safe_parser(get_reasoning_parser, card.reasoning_parser),
             tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
         )
+        audit_handle = self.audit.create_handle(
+            body, preq.request_id, req.model, req.stream
+        )
         return await self._run(
             request, preq, pipeline, req.model, req.stream, gen,
             lambda s: aggregate_chat(
@@ -293,6 +333,7 @@ class HttpService:
                 ),
                 tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
             ),
+            audit_handle=audit_handle,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -427,4 +468,7 @@ class HttpService:
         return await self._run(
             request, preq, pipeline, req.model, req.stream, gen,
             lambda s: aggregate_completion(preq.request_id, req.model, s, echo_text),
+            audit_handle=self.audit.create_handle(
+                body, preq.request_id, req.model, req.stream
+            ),
         )

@@ -46,6 +46,10 @@ class MockEngineArgs:
     enable_prefix_caching: bool = True
     enable_chunked_prefill: bool = True
     speedup_ratio: float = 1.0         # >1 -> faster simulated clock
+    # stamp every emitted token with the engine's simulated clock
+    # (annotations["sim_ts"]) so benchmarks measure TTFT/ITL in simulated
+    # time, immune to host asyncio jitter amplified by speedup_ratio
+    emit_sim_ts: bool = False
     dp_size: int = 1
     startup_time_s: float = 0.0
     # timing model: per-iteration costs (seconds)
@@ -190,6 +194,7 @@ class MockerEngine:
         self._waiting: List[_Running] = []
         self._running: List[_Running] = []
         self._outbox: List = []  # (queue, BackendOutput) deferred past the step sleep
+        self.sim_time = 0.0      # simulated seconds of engine compute elapsed
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._started_at = time.monotonic()
@@ -243,6 +248,10 @@ class MockerEngine:
                 # first token arrives AFTER prefill compute, so TTFT
                 # measurements (profiler, benchmarks) see the model's cost
                 await asyncio.sleep(step_time / self.args.speedup_ratio)
+                self.sim_time += step_time
+                if self.args.emit_sim_ts:
+                    for _, item in self._outbox:
+                        item.annotations["sim_ts"] = self.sim_time
                 outbox, self._outbox = self._outbox, []
                 for q, item in outbox:
                     q.put_nowait(item)

@@ -1,0 +1,304 @@
+"""Fleet-level benchmarks over the mocker: routing and disaggregation wins.
+
+Analog of the reference's router benchmark harness
+(benchmarks/router/prefix_ratio_benchmark.py — synthetic workloads with a
+controlled shared-prefix ratio, KV-aware routing vs round-robin) and its
+disagg-vs-agg comparisons (docs/design_docs/architecture.md:87-91): both run
+on the accelerator-free mocker so the *control plane* cost model (prefix
+reuse, prefill/decode interference) is what is measured.
+
+All latencies are measured on the mocker's **simulated clock**
+(MockEngineArgs.emit_sim_ts): wall-clock asyncio jitter is amplified by
+speedup_ratio and would otherwise drown the signal; simulated TTFT/ITL are
+deterministic engine-model quantities.
+
+Used by bench.py to report fleet metrics alongside the single-chip number.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..kv_router import (
+    KvEventPublisher,
+    KvRouter,
+    KvRouterConfig,
+    WorkerWithDpRank,
+)
+from ..llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from ..mocker.engine import MockEngineArgs, MockerEngine
+from ..runtime.engine import Context
+from ..runtime.event_plane.base import InProcEventPlane
+
+
+def _prompt(group: int, i: int, prompt_len: int, shared_len: int) -> List[int]:
+    """Group members share the first ``shared_len`` tokens exactly."""
+    shared = [(group * 37 + j * 3) % 512 for j in range(shared_len)]
+    unique = [(group * 37 + i * 101 + j * 7 + 1) % 512 for j in range(prompt_len - shared_len)]
+    return shared + unique
+
+
+def _req(rid: str, tokens: List[int], max_tokens: int) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        request_id=rid, model="bench", token_ids=tokens,
+        stop=StopConditions(max_tokens=max_tokens, min_tokens=max_tokens, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+def _pct(xs: List[float], p: float) -> float:
+    return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0
+
+
+def _stats(ttfts: List[float], itls: List[float], cached: int, inputs: int) -> Dict[str, float]:
+    ttfts, itls = sorted(ttfts), sorted(itls)
+    return {
+        "ttft_mean_ms": round(sum(ttfts) / max(len(ttfts), 1) * 1e3, 2),
+        "ttft_p95_ms": round(_pct(ttfts, 0.95) * 1e3, 2),
+        "itl_mean_ms": round(sum(itls) / max(len(itls), 1) * 1e3, 2),
+        "itl_p95_ms": round(_pct(itls, 0.95) * 1e3, 2),
+        "cache_hit_ratio": round(cached / max(inputs, 1), 4),
+    }
+
+
+async def _drive(
+    engines: List[MockerEngine],
+    workload: List[Tuple[str, List[int]]],
+    route_fn,
+    osl: int,
+    concurrency: int = 16,
+    done_fn=None,
+) -> Dict[str, float]:
+    """Run requests, picking the worker with ``route_fn(rid, tokens)`` at
+    dispatch time (so KV-aware routing sees earlier requests' cache events).
+    TTFT/ITL come from the engines' simulated clocks."""
+    sem = asyncio.Semaphore(concurrency)
+    ttfts: List[float] = []
+    itls: List[float] = []
+    cached = [0]
+    inputs = [0]
+
+    async def one(rid: str, tokens: List[int]):
+        async with sem:
+            widx = route_fn(rid, tokens)
+            eng = engines[widx]
+            req = _req(rid, tokens, osl)
+            t0 = eng.sim_time
+            t_prev: Optional[float] = None
+            async for out in eng.generate(req, Context()):
+                if not out.token_ids:
+                    continue
+                ts = out.annotations.get("sim_ts", eng.sim_time)
+                if t_prev is None:
+                    ttfts.append(ts - t0)
+                    cached[0] += out.annotations.get("cached_tokens", 0)
+                    inputs[0] += out.annotations.get("input_tokens", 0)
+                else:
+                    itls.append(ts - t_prev)
+                t_prev = ts
+            if done_fn is not None:
+                done_fn(rid)
+
+    await asyncio.gather(*[one(rid, toks) for rid, toks in workload])
+    stats = _stats(ttfts, itls, cached[0], inputs[0])
+    stats["engine_busy_s"] = round(sum(e.sim_time for e in engines), 3)
+    return stats
+
+
+async def router_prefix_bench(
+    num_workers: int = 8,
+    num_groups: int = 8,
+    requests_per_group: int = 8,
+    prompt_len: int = 2048,
+    prefix_ratio: float = 0.75,
+    osl: int = 8,
+    block_size: int = 16,
+    speedup: float = 100.0,
+) -> Dict[str, object]:
+    """KV-aware routing vs round-robin on a shared-prefix workload.
+
+    Groups of requests share ``prefix_ratio`` of their prompt; KV routing
+    lands same-group requests on the worker already holding the prefix
+    (prefill cost ~ uncached tokens in the mocker's timing model), while
+    round-robin scatters them and recomputes."""
+    import random as _random
+
+    shared_len = (int(prompt_len * prefix_ratio) // block_size) * block_size
+    # deterministic shuffle: arrival order is uncorrelated with group, so
+    # neither policy gets accidental group affinity from submit order
+    workload = [
+        (f"g{g}-r{i}", _prompt(g, i, prompt_len, shared_len))
+        for i in range(requests_per_group)
+        for g in range(num_groups)
+    ]
+    _random.Random(42).shuffle(workload)
+
+    async def run_mode(kv_aware: bool) -> Dict[str, float]:
+        plane = InProcEventPlane()
+        args = MockEngineArgs(
+            block_size=block_size, num_blocks=16384, speedup_ratio=speedup,
+            emit_sim_ts=True,
+        )
+        engines = []
+        for w in range(num_workers):
+            pub = KvEventPublisher(
+                plane, "bench", "backend", worker_id=w + 1, block_size=block_size
+            )
+            engines.append(MockerEngine(args, kv_publisher=pub))
+        router = await KvRouter(
+            plane, "bench", "backend", block_size=block_size,
+            config=KvRouterConfig(),
+        ).start()
+        cands = [WorkerWithDpRank(w + 1, 0) for w in range(num_workers)]
+        rr_cursor = [0]
+
+        def route(rid: str, tokens: List[int]) -> int:
+            if kv_aware:
+                d = router.schedule_tokens(tokens, cands, request_id=rid)
+                return d.worker.worker_id - 1
+            rr_cursor[0] += 1
+            return (rr_cursor[0] - 1) % num_workers
+
+        def done(rid: str) -> None:
+            if kv_aware:
+                router.complete(rid)
+
+        try:
+            stats = await _drive(
+                engines, workload, route, osl, concurrency=8, done_fn=done
+            )
+        finally:
+            for e in engines:
+                e.stop()
+            await router.stop()
+            await plane.close()
+        return stats
+
+    kv = await run_mode(True)
+    rr = await run_mode(False)
+    return {
+        "workload": {
+            "workers": num_workers,
+            "requests": len(workload),
+            "prompt_len": prompt_len,
+            "prefix_ratio": prefix_ratio,
+            "osl": osl,
+        },
+        "kv_routing": kv,
+        "round_robin": rr,
+        "ttft_speedup": round(
+            rr["ttft_mean_ms"] / max(kv["ttft_mean_ms"], 1e-9), 3
+        ),
+        "cache_hit_gain": round(
+            kv["cache_hit_ratio"] - rr["cache_hit_ratio"], 4
+        ),
+    }
+
+
+async def disagg_vs_agg_bench(
+    num_decodes: int = 8,
+    num_prefills: int = 24,
+    prompt_len: int = 4096,
+    osl: int = 256,
+    block_size: int = 16,
+    speedup: float = 100.0,
+) -> Dict[str, object]:
+    """Decode ITL under a prefill-heavy load: aggregated vs disaggregated.
+
+    The scenario the reference's disagg design targets
+    (docs/design_docs/disagg_serving.md): long decodes are in flight while a
+    stream of long prompts arrives. Aggregated, every arriving prefill chunk
+    inflates the shared engine step, spiking the decoders' ITL; with a
+    dedicated prefill worker (decode side sees the KV as transferred —
+    the mocker analog of the NIXL pull), decode steps stay pure."""
+    from ..tokens import TokenBlockSequence
+
+    args = MockEngineArgs(
+        block_size=block_size, num_blocks=32768, speedup_ratio=speedup,
+        emit_sim_ts=True,
+    )
+    decode_reqs = [
+        (f"dec{i}", _prompt(1000 + i, i, 256, 0)) for i in range(num_decodes)
+    ]
+    prefill_reqs = [
+        (f"pre{i}", _prompt(2000 + i, i, prompt_len, 0)) for i in range(num_prefills)
+    ]
+
+    async def run(disagg: bool) -> Dict[str, float]:
+        decode_eng = MockerEngine(args)
+        prefill_eng = MockerEngine(args) if disagg else decode_eng
+        itls: List[float] = []
+        pre_ttfts: List[float] = []
+
+        async def one_decode(rid: str, tokens: List[int]):
+            t_prev: Optional[float] = None
+            async for out in decode_eng.generate(_req(rid, tokens, osl), Context()):
+                if not out.token_ids:
+                    continue
+                ts = out.annotations.get("sim_ts", 0.0)
+                if t_prev is not None:
+                    itls.append(ts - t_prev)
+                t_prev = ts
+
+        async def one_prefill(rid: str, tokens: List[int]):
+            t0 = prefill_eng.sim_time
+            # prefill request: one token (reference disagg max_tokens=1)
+            async for out in prefill_eng.generate(_req(rid, tokens, 1), Context()):
+                if out.token_ids:
+                    pre_ttfts.append(out.annotations.get("sim_ts", 0.0) - t0)
+            if disagg:
+                # simulated KV transfer: decode side now holds the prefix
+                hashes = TokenBlockSequence(tokens, block_size).sequence_hashes()
+                decode_eng.kv.acquire(hashes)
+                decode_eng.kv.release(hashes)
+
+        async def prefill_stream():
+            # paced arrivals so prefills overlap the whole decode phase;
+            # gather (not poll) so a failed/token-less task can never hang
+            # the bench
+            tasks = []
+            for rid, toks in prefill_reqs:
+                await asyncio.sleep(0.002)
+                tasks.append(asyncio.ensure_future(one_prefill(rid, toks)))
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        try:
+            await asyncio.gather(
+                *[one_decode(rid, toks) for rid, toks in decode_reqs],
+                prefill_stream(),
+            )
+        finally:
+            decode_eng.stop()
+            prefill_eng.stop()
+        itls.sort()
+        pre_ttfts.sort()
+        return {
+            "decode_itl_mean_ms": round(sum(itls) / max(len(itls), 1) * 1e3, 3),
+            "decode_itl_p95_ms": round(_pct(itls, 0.95) * 1e3, 3),
+            "decode_itl_max_ms": round((itls[-1] if itls else 0.0) * 1e3, 3),
+            "prefill_ttft_mean_ms": round(
+                sum(pre_ttfts) / max(len(pre_ttfts), 1) * 1e3, 2
+            ),
+        }
+
+    agg = await run(False)
+    dis = await run(True)
+    return {
+        "workload": {
+            "decodes": num_decodes,
+            "prefills": num_prefills,
+            "prompt_len": prompt_len,
+            "osl": osl,
+        },
+        "aggregated": agg,
+        "disaggregated": dis,
+        "itl_p95_improvement": round(
+            agg["decode_itl_p95_ms"] / max(dis["decode_itl_p95_ms"], 1e-9), 3
+        ),
+    }

@@ -31,6 +31,9 @@ def parse_args():
                    help="use the ApproxKvIndexer instead of worker KV events")
     p.add_argument("--replica-sync", action="store_true",
                    help="sync decisions + state with other router instances")
+    p.add_argument("--record-events", default=None, metavar="PATH",
+                   help="record the ingested KV-event stream to a JSONL file "
+                        "(runtime/recorder.py; replayable with Recorder.replay)")
     return p.parse_args()
 
 
@@ -41,6 +44,11 @@ async def main() -> None:
         store=args.store, store_path=args.store_path, event_plane=args.event_plane
     )
     runtime = await DistributedRuntime(cfg).start()
+    recorder = None
+    if args.record_events:
+        from dynamo_tpu.runtime.recorder import Recorder
+
+        recorder = await Recorder(args.record_events).start()
     service = await RouterService(
         runtime,
         namespace=args.namespace,
@@ -53,6 +61,7 @@ async def main() -> None:
             use_kv_events=not args.no_kv_events,
             replica_sync=args.replica_sync,
         ),
+        recorder=recorder,
     ).start()
     print(f"ROUTER_READY {service.router.router_id}", flush=True)
 
@@ -62,6 +71,8 @@ async def main() -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     await service.stop()
+    if recorder is not None:
+        await recorder.stop()
     await runtime.shutdown()
 
 

@@ -35,8 +35,10 @@ class RouterService:
         endpoint: str = "generate",
         block_size: int = 16,
         config: Optional[KvRouterConfig] = None,
+        recorder=None,
     ):
         self.runtime = runtime
+        self.recorder = recorder
         self.namespace = namespace
         self.component = component
         self.endpoint = endpoint
@@ -60,6 +62,7 @@ class RouterService:
             self.component,
             block_size=self.block_size,
             config=self.config,
+            recorder=self.recorder,
         ).start()
         ep = (
             self.runtime.namespace(self.namespace)

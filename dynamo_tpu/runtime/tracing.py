@@ -1,0 +1,308 @@
+"""Distributed tracing: W3C traceparent propagation + OTLP-shaped export.
+
+Analog of the reference's OTLP tracing stack (lib/runtime/src/logging.rs:
+72-97 — tracing-subscriber + opentelemetry-otlp with traceparent
+extraction/injection, logging.rs:206-270). TPU-first design notes: spans are
+plain host-side bookkeeping (never traced under jit); propagation rides the
+same channels the reference uses — HTTP headers in the frontend, request
+annotations on the request plane.
+
+Exporters:
+- ``JsonlExporter``   — OTLP-shaped span dicts to a JSONL file (the air-gapped
+                        default; collectors can tail it).
+- ``OtlpHttpExporter``— OTLP/HTTP JSON to a configured collector endpoint
+                        (``DYN_OTLP_ENDPOINT``; the reference defaults to
+                        localhost:4317 gRPC — we speak OTLP/HTTP instead,
+                        one POST per batch, best-effort).
+- ``InMemoryExporter``— tests.
+
+Span context propagates across ``asyncio`` tasks via ``contextvars``, so an
+engine's nested spans parent correctly without explicit plumbing.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import dataclasses
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .logging import get_logger
+
+log = get_logger("tracing")
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "dtpu_current_span", default=None
+)
+
+TRACEPARENT_VERSION = "00"
+SAMPLED_FLAG = "01"
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+def parse_traceparent(header: str) -> Tuple[Optional[str], Optional[str]]:
+    """``00-<trace_id>-<parent_span_id>-<flags>`` -> (trace_id, parent_id).
+
+    Malformed headers yield (None, None) — a bad client header must never
+    fail a request (reference logging.rs:213-230 same tolerance)."""
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None, None
+    _, trace_id, span_id, _ = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None, None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None, None
+    return trace_id.lower(), span_id.lower()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"{TRACEPARENT_VERSION}-{trace_id}-{span_id}-{SAMPLED_FLAG}"
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    status: str = "OK"
+    _tracer: Optional["Tracer"] = dataclasses.field(default=None, repr=False)
+    _token: Any = dataclasses.field(default=None, repr=False)
+
+    def set(self, **attrs: Any) -> "Span":
+        self.attributes.update(attrs)
+        return self
+
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id)
+
+    # -- context manager ------------------------------------------------------
+    def __enter__(self) -> "Span":
+        self.start_ns = time.time_ns()
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end_ns = time.time_ns()
+        if exc_type is not None:
+            self.status = "ERROR"
+            self.attributes.setdefault("exception", repr(exc))
+        if self._token is not None:
+            try:
+                _current_span.reset(self._token)
+            except ValueError:
+                # async generators may exit in a different Context than the
+                # one that entered the span; the var is task-local anyway
+                pass
+        if self._tracer is not None:
+            self._tracer._finish(self)
+
+    def to_otlp(self) -> Dict[str, Any]:
+        """One span in OTLP/JSON shape (the unit inside scopeSpans.spans)."""
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            **({"parentSpanId": self.parent_id} if self.parent_id else {}),
+            "name": self.name,
+            "kind": 1,
+            "startTimeUnixNano": str(self.start_ns),
+            "endTimeUnixNano": str(self.end_ns),
+            "attributes": [
+                {"key": k, "value": _otlp_value(v)}
+                for k, v in self.attributes.items()
+            ],
+            "status": {"code": 2 if self.status == "ERROR" else 1},
+        }
+
+
+def _otlp_value(v: Any) -> Dict[str, Any]:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def current_traceparent() -> Optional[str]:
+    sp = _current_span.get()
+    return sp.traceparent() if sp is not None else None
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+class InMemoryExporter:
+    def __init__(self):
+        self.spans: List[Span] = []
+
+    def export(self, spans: List[Span]) -> None:
+        self.spans.extend(spans)
+
+
+class JsonlExporter:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def export(self, spans: List[Span]) -> None:
+        with self._lock, open(self.path, "a") as f:
+            for sp in spans:
+                f.write(json.dumps(sp.to_otlp()) + "\n")
+
+
+class OtlpHttpExporter:
+    """OTLP/HTTP JSON POST to ``<endpoint>/v1/traces``; best-effort, never
+    raises into the request path."""
+
+    def __init__(self, endpoint: str, service_name: str = "dynamo_tpu",
+                 timeout_s: float = 2.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.timeout_s = timeout_s
+
+    def export(self, spans: List[Span]) -> None:
+        body = json.dumps({
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": self.service_name},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "dynamo_tpu.tracing"},
+                    "spans": [sp.to_otlp() for sp in spans],
+                }],
+            }]
+        }).encode()
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                self.endpoint + "/v1/traces", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=self.timeout_s).read()
+        except Exception as e:
+            log.debug("otlp export failed (dropping %d spans): %r", len(spans), e)
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+class Tracer:
+    """Creates spans, batches finished ones, hands them to the exporter.
+
+    Flushing is size/time-triggered on the caller's thread (no background
+    task to leak); ``flush()`` forces the rest out — call it on shutdown."""
+
+    def __init__(self, exporter=None, service_name: str = "dynamo_tpu",
+                 batch_size: int = 64, flush_interval_s: float = 5.0):
+        self.exporter = exporter
+        self.service_name = service_name
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self._buf: List[Span] = []
+        self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
+
+    @classmethod
+    def from_env(cls, service_name: str = "dynamo_tpu") -> "Tracer":
+        """DYN_OTLP_ENDPOINT -> OTLP/HTTP; DYN_TRACE_JSONL -> file; else
+        tracing is a no-op (spans still propagate context)."""
+        endpoint = os.environ.get("DYN_OTLP_ENDPOINT", "")
+        jsonl = os.environ.get("DYN_TRACE_JSONL", "")
+        if endpoint:
+            return cls(OtlpHttpExporter(endpoint, service_name), service_name)
+        if jsonl:
+            return cls(JsonlExporter(jsonl), service_name)
+        return cls(None, service_name)
+
+    @property
+    def enabled(self) -> bool:
+        return self.exporter is not None
+
+    def span(
+        self,
+        name: str,
+        traceparent: Optional[str] = None,
+        **attrs: Any,
+    ) -> Span:
+        """New span. Parenting precedence: explicit ``traceparent`` header >
+        ambient contextvar > fresh trace root."""
+        trace_id = parent_id = None
+        if traceparent:
+            trace_id, parent_id = parse_traceparent(traceparent)
+        if trace_id is None:
+            amb = _current_span.get()
+            if amb is not None:
+                trace_id, parent_id = amb.trace_id, amb.span_id
+        return Span(
+            name=name,
+            trace_id=trace_id or new_trace_id(),
+            span_id=new_span_id(),
+            parent_id=parent_id,
+            attributes=dict(attrs),
+            _tracer=self,
+        )
+
+    def _finish(self, span: Span) -> None:
+        if self.exporter is None:
+            return
+        flush_now = False
+        with self._lock:
+            self._buf.append(span)
+            if (
+                len(self._buf) >= self.batch_size
+                or time.monotonic() - self._last_flush > self.flush_interval_s
+            ):
+                flush_now = True
+        if flush_now:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+            self._last_flush = time.monotonic()
+        if batch and self.exporter is not None:
+            self.exporter.export(batch)
+
+
+_global_tracer: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    global _global_tracer
+    if _global_tracer is None:
+        _global_tracer = Tracer.from_env()
+    return _global_tracer
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    global _global_tracer
+    _global_tracer = tracer

@@ -1,0 +1,206 @@
+"""Tracing (runtime/tracing.py), audit (llm/audit.py), recorder
+(runtime/recorder.py).
+
+Reference analogs: lib/runtime/src/logging.rs:72-97,206-270 (OTLP tracing +
+traceparent), lib/llm/src/audit/ (policy/handle/bus/sinks),
+lib/llm/src/recorder.rs (JSONL event recorder).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.audit import AuditBus, AuditPolicy
+from dynamo_tpu.runtime.recorder import Recorder
+from dynamo_tpu.runtime.tracing import (
+    InMemoryExporter,
+    Tracer,
+    current_traceparent,
+    format_traceparent,
+    parse_traceparent,
+)
+
+
+# ---------------------------------------------------------------- tracing
+def test_traceparent_roundtrip_and_tolerance():
+    tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+    hdr = format_traceparent(tid, sid)
+    assert parse_traceparent(hdr) == (tid, sid)
+    # malformed headers degrade to no-parent, never raise
+    assert parse_traceparent("garbage") == (None, None)
+    assert parse_traceparent("00-short-b7ad6b7169203331-01") == (None, None)
+    assert parse_traceparent("00-" + "z" * 32 + "-" + "1" * 16 + "-01") == (None, None)
+
+
+def test_spans_nest_and_export():
+    exp = InMemoryExporter()
+    tracer = Tracer(exp, batch_size=1)
+    with tracer.span("outer", request_id="r1") as outer:
+        assert current_traceparent() == outer.traceparent()
+        with tracer.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    names = [s.name for s in exp.spans]
+    assert names == ["inner", "outer"]  # inner finishes first
+    otlp = exp.spans[1].to_otlp()
+    assert otlp["traceId"] == outer.trace_id
+    assert otlp["status"]["code"] == 1
+    assert any(a["key"] == "request_id" for a in otlp["attributes"])
+
+
+def test_span_continues_remote_parent_and_records_errors():
+    exp = InMemoryExporter()
+    tracer = Tracer(exp, batch_size=1)
+    hdr = format_traceparent("a" * 32, "b" * 16)
+    with pytest.raises(RuntimeError):
+        with tracer.span("worker.generate", traceparent=hdr):
+            raise RuntimeError("boom")
+    (sp,) = exp.spans
+    assert sp.trace_id == "a" * 32
+    assert sp.parent_id == "b" * 16
+    assert sp.status == "ERROR"
+    assert sp.to_otlp()["status"]["code"] == 2
+
+
+def test_jsonl_exporter(tmp_path):
+    from dynamo_tpu.runtime.tracing import JsonlExporter
+
+    path = str(tmp_path / "spans.jsonl")
+    tracer = Tracer(JsonlExporter(path), batch_size=1)
+    with tracer.span("a"):
+        pass
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["name"] == "a"
+    assert int(lines[0]["endTimeUnixNano"]) >= int(lines[0]["startTimeUnixNano"])
+
+
+# ---------------------------------------------------------------- audit
+def _bus(tmp_path, force=True):
+    path = str(tmp_path / "audit.jsonl")
+    policy = AuditPolicy(enabled=True, force_logging=force, sinks=[f"jsonl:{path}"])
+    return AuditBus(policy), path
+
+
+def test_audit_handle_emits_once_with_request_and_response(tmp_path):
+    bus, path = _bus(tmp_path)
+    h = bus.create_handle({"model": "m", "messages": []}, "req-1", "m", streaming=False)
+    assert h is not None
+    h.set_response({"id": "req-1", "choices": []})
+    h.emit()
+    h.emit()  # exactly-once
+    recs = [json.loads(l) for l in open(path)]
+    assert len(recs) == 1
+    assert recs[0]["request_id"] == "req-1"
+    assert recs[0]["schema_version"] == 1
+    assert recs[0]["request"]["model"] == "m"
+    assert recs[0]["response"]["id"] == "req-1"
+
+
+def test_audit_policy_gates_on_store_flag(tmp_path):
+    bus, _ = _bus(tmp_path, force=False)
+    assert bus.create_handle({"model": "m"}, "r", "m", False) is None
+    assert bus.create_handle({"model": "m", "store": True}, "r", "m", False) is not None
+    off = AuditBus(AuditPolicy(enabled=False))
+    assert off.create_handle({"store": True}, "r", "m", False) is None
+
+
+def test_audit_event_plane_sink(tmp_path):
+    from dynamo_tpu.runtime.event_plane.base import InProcEventPlane
+
+    async def run():
+        plane = InProcEventPlane()
+        got = []
+        sub = await plane.subscribe("dynamo.audit.v1")
+
+        import msgpack
+
+        async def consume():
+            async for _, payload in sub:
+                got.append(msgpack.unpackb(payload, raw=False))
+                break
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.01)
+        policy = AuditPolicy(enabled=True, force_logging=True, sinks=["event"])
+        bus = AuditBus(policy, event_plane=plane)
+        h = bus.create_handle({"model": "m"}, "r9", "m", True)
+        h.emit()
+        await bus.drain_async_sinks()
+        await asyncio.wait_for(task, timeout=2.0)
+        await plane.close()
+        return got
+
+    got = asyncio.run(run())
+    assert got and got[0]["request_id"] == "r9"
+
+
+# ---------------------------------------------------------------- recorder
+def test_recorder_writes_rotates_and_replays(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+
+    async def run():
+        rec = await Recorder(path, max_lines_per_file=3).start()
+        for i in range(7):
+            assert rec.record({"i": i})
+        await rec.stop()
+        return rec.event_count
+
+    count = asyncio.run(run())
+    assert count == 7
+    # rotation: 3 + 3 + 1 across three files
+    import os
+
+    files = sorted(f for f in os.listdir(tmp_path) if f.startswith("events"))
+    assert len(files) == 3
+    loaded = Recorder.load(path)
+    assert [e["i"] for _, e in loaded] == [0, 1, 2]
+
+    async def replay():
+        return [e async for e in Recorder.replay(path, speedup=1e9)]
+
+    assert [e["i"] for e in asyncio.run(replay())] == [0, 1, 2]
+
+
+def test_router_records_kv_event_stream(tmp_path):
+    """KvRouter(recorder=...) captures ingested KV events as JSONL (the
+    --record-events path of python -m dynamo_tpu.router)."""
+    from dynamo_tpu.kv_router import KvEventPublisher, KvRouter
+    from dynamo_tpu.runtime.event_plane.base import InProcEventPlane
+
+    path = str(tmp_path / "kv_events.jsonl")
+
+    async def run():
+        plane = InProcEventPlane()
+        rec = await Recorder(path).start()
+        router = await KvRouter(plane, "ns", "be", block_size=16, recorder=rec).start()
+        pub = KvEventPublisher(plane, "ns", "be", worker_id=7, block_size=16)
+        await pub.stored([111, 222])
+        for _ in range(100):
+            if rec.event_count:
+                break
+            await asyncio.sleep(0.01)
+        await router.stop()
+        await rec.stop()
+        await plane.close()
+
+    asyncio.run(run())
+    events = [e for _, e in Recorder.load(path)]
+    assert events and events[0]["kind"] == "kv_event"
+
+
+def test_recorder_max_count_stops(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+
+    async def run():
+        rec = await Recorder(path, max_count=2).start()
+        for i in range(5):
+            rec.record({"i": i})
+        for _ in range(100):
+            if rec._stopped.is_set():
+                break
+            await asyncio.sleep(0.01)
+        await rec.stop()
+        return rec.event_count
+
+    assert asyncio.run(run()) == 2

@@ -1,0 +1,103 @@
+"""Pipeline parallelism (parallel/pipeline.py): GPipe schedule over the pp
+mesh axis, composed with tp and dp, on the 8-device virtual CPU mesh.
+
+Reference analog: pipeline_parallel_size forwarded to engine NCCL groups
+(components/src/dynamo/trtllm/engine.py:100-127); here PP is a first-class
+JAX transform, so correctness is provable against the dense forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.parallel.pipeline import (
+    make_pp_mesh,
+    make_train_step,
+    pipeline_loss_fn,
+    place_stacked,
+    stack_params,
+    unstack_params,
+)
+from dynamo_tpu.ops import attention as att
+
+
+def _cfg(layers=4):
+    return llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, num_layers=layers, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=96, dtype=jnp.float32,
+    )
+
+
+def _dense_loss(params, cfg, tokens):
+    """Reference loss: plain single-device forward, same math."""
+
+    def one_seq(toks):
+        def attend(q, k_new, v_new, layer_idx):
+            return att.causal_attention(q, k_new, v_new)
+
+        S = toks.shape[0]
+        hidden = llama.forward(params, cfg, toks, jnp.arange(S), attend)
+        logits = llama.lm_logits(params, cfg, hidden)
+        logp = jax.nn.log_softmax(logits[:-1], axis=-1)
+        return -jnp.take_along_axis(logp, toks[1:, None], axis=-1)[:, 0]
+
+    return jnp.mean(jax.vmap(one_seq)(tokens))
+
+
+def _tokens(b=4, s=16, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, vocab, (b, s)), jnp.int32)
+
+
+def test_stack_unstack_roundtrip():
+    cfg = _cfg()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    back = unstack_params(stack_params(params))
+    for i, lp in enumerate(params["layers"]):
+        for name, w in lp.items():
+            np.testing.assert_array_equal(np.asarray(w), np.asarray(back["layers"][i][name]))
+
+
+@pytest.mark.parametrize("pp,tp,dp,M", [(2, 1, 1, 2), (4, 2, 1, 4), (2, 2, 2, 2)])
+def test_pipeline_loss_matches_dense(pp, tp, dp, M):
+    """The pipelined loss must equal the dense single-device loss: same
+    params, same tokens, microbatching/ppermute/TP-psum are pure schedule."""
+    cfg = _cfg(layers=4)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    tokens = _tokens(b=4 * dp, s=12)
+
+    expected = float(_dense_loss(params, cfg, tokens))
+
+    mesh = make_pp_mesh(pp=pp, tp=tp, dp=dp)
+    stacked = place_stacked(mesh, stack_params(params))
+    loss_fn = pipeline_loss_fn(mesh, cfg, num_microbatches=M)
+    got = float(jax.jit(loss_fn)(stacked, tokens))
+    assert got == pytest.approx(expected, rel=2e-4), (got, expected)
+
+
+def test_pipeline_train_step_learns():
+    """Gradients flow through ppermute/scan: a few steps on a fixed batch
+    must reduce the loss."""
+    cfg = _cfg(layers=2)
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    tokens = _tokens(b=4, s=12, seed=3)
+
+    mesh = make_pp_mesh(pp=2, tp=2, dp=2)
+    stacked = place_stacked(mesh, stack_params(params))
+    step, init_opt = make_train_step(mesh, cfg, num_microbatches=2, learning_rate=0.1)
+    opt = init_opt(stacked)
+    losses = []
+    for _ in range(5):
+        stacked, opt, loss = step(stacked, opt, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_pipeline_rejects_bad_shapes():
+    cfg = _cfg(layers=3)
+    mesh = make_pp_mesh(pp=2)
+    with pytest.raises(ValueError):
+        pipeline_loss_fn(mesh, cfg, 2)
