@@ -1,0 +1,6 @@
+"""Deploy layer: DynamoGraphDeployment-style specs rendered to TPU-ready
+Kubernetes manifests (reference deploy/operator/)."""
+
+from .render import GraphSpec, ServiceSpec, render, render_service, render_yaml
+
+__all__ = ["GraphSpec", "ServiceSpec", "render", "render_service", "render_yaml"]

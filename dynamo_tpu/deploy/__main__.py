@@ -1,0 +1,38 @@
+"""python -m dynamo_tpu.deploy — render a graph spec to k8s manifests.
+
+    python -m dynamo_tpu.deploy render deploy/examples/agg-serving.yaml
+    python -m dynamo_tpu.deploy render spec.yaml -o manifests/
+"""
+
+import argparse
+import os
+import sys
+
+import yaml
+
+from dynamo_tpu.deploy.render import GraphSpec, render, render_yaml
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("dynamo_tpu.deploy")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("render", help="graph spec -> k8s YAML")
+    r.add_argument("spec")
+    r.add_argument("-o", "--out-dir", default=None,
+                   help="write one file per object (default: stdout stream)")
+    args = p.parse_args()
+
+    graph = GraphSpec.load(args.spec)
+    if args.out_dir is None:
+        sys.stdout.write(render_yaml(graph))
+        return
+    os.makedirs(args.out_dir, exist_ok=True)
+    for obj in render(graph):
+        name = f"{obj['kind'].lower()}-{obj['metadata']['name']}.yaml"
+        with open(os.path.join(args.out_dir, name), "w") as f:
+            yaml.safe_dump(obj, f, sort_keys=False)
+        print(os.path.join(args.out_dir, name))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,255 @@
+"""DynamoGraphDeployment -> Kubernetes manifests, TPU-first.
+
+Analog of the reference's operator CRD + controllers (deploy/operator/api/
+v1alpha1/dynamographdeployment_types.go: a graph spec whose ``services`` map
+declares frontends/routers/workers) collapsed to an offline renderer: one
+graph YAML in, ready-to-apply Kubernetes YAML out. Where the reference
+reconciles CRs in-cluster, this emits the same objects for `kubectl apply` /
+GitOps — no controller process to operate, and the output is inspectable.
+
+TPU-first specifics baked into worker rendering (GKE TPU scheduling):
+- ``google.com/tpu`` resource requests sized tp*sp per worker;
+- nodeSelector ``cloud.google.com/gke-tpu-accelerator`` +
+  ``gke-tpu-topology`` derived from the requested chip count/generation;
+- workers are a StatefulSet (stable identity for the discovery lease),
+  frontends/routers are Deployments behind Services;
+- every pod shares one netstore (discovery) Service and, optionally, a G4
+  block-store Service.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+# chips -> (accelerator, topology) for single-host v5e slices
+_V5E_TOPO = {1: "1x1", 4: "2x2", 8: "2x4"}
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    """One entry of spec.services (DynamoComponentDeploymentSharedSpec analog)."""
+
+    name: str
+    kind: str                       # frontend | router | worker | netstore | kvbm
+    replicas: int = 1
+    image: str = "dynamo-tpu:latest"
+    args: List[str] = dataclasses.field(default_factory=list)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    port: Optional[int] = None
+    # worker-only
+    tp: int = 1
+    sp: int = 1
+    dp: int = 1
+    tpu_generation: str = "v5e"
+    model: Optional[str] = None
+    preset: Optional[str] = None
+    disagg: Optional[str] = None    # prefill | decode
+
+
+@dataclasses.dataclass
+class GraphSpec:
+    name: str
+    namespace: str = "default"
+    services: List[ServiceSpec] = dataclasses.field(default_factory=list)
+    envs: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_obj(cls, obj: Dict[str, Any]) -> "GraphSpec":
+        services = []
+        for name, svc in (obj.get("services") or {}).items():
+            known = {f.name for f in dataclasses.fields(ServiceSpec)}
+            svc = dict(svc)
+            kind = svc.pop("kind", "worker")
+            services.append(ServiceSpec(
+                name=name, kind=kind,
+                **{k: v for k, v in svc.items() if k in known},
+            ))
+        return cls(
+            name=obj["name"],
+            namespace=obj.get("namespace", "default"),
+            services=services,
+            envs={k: str(v) for k, v in (obj.get("envs") or {}).items()},
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "GraphSpec":
+        with open(path) as f:
+            return cls.from_obj(yaml.safe_load(f))
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _labels(graph: GraphSpec, svc: ServiceSpec) -> Dict[str, str]:
+    return {
+        "app.kubernetes.io/part-of": graph.name,
+        "app.kubernetes.io/component": svc.kind,
+        "app.kubernetes.io/name": f"{graph.name}-{svc.name}",
+    }
+
+
+def _env_list(graph: GraphSpec, svc: ServiceSpec, extra: Dict[str, str]) -> List[Dict[str, str]]:
+    merged = {**graph.envs, **extra, **svc.env}
+    return [{"name": k, "value": str(v)} for k, v in sorted(merged.items())]
+
+
+def _store_address(graph: GraphSpec) -> str:
+    return f"{graph.name}-netstore.{graph.namespace}.svc:7460"
+
+
+def _container(graph: GraphSpec, svc: ServiceSpec, command: List[str],
+               extra_env: Dict[str, str], resources: Optional[Dict] = None,
+               ports: Optional[List[int]] = None) -> Dict[str, Any]:
+    c: Dict[str, Any] = {
+        "name": svc.name,
+        "image": svc.image,
+        "command": command + svc.args,
+        "env": _env_list(graph, svc, extra_env),
+    }
+    if resources:
+        c["resources"] = resources
+    if ports:
+        c["ports"] = [{"containerPort": p} for p in ports]
+    return c
+
+
+def _deployment(graph: GraphSpec, svc: ServiceSpec, container: Dict[str, Any],
+                node_selector: Optional[Dict[str, str]] = None,
+                kind: str = "Deployment") -> Dict[str, Any]:
+    labels = _labels(graph, svc)
+    pod_spec: Dict[str, Any] = {"containers": [container]}
+    if node_selector:
+        pod_spec["nodeSelector"] = node_selector
+    obj: Dict[str, Any] = {
+        "apiVersion": "apps/v1",
+        "kind": kind,
+        "metadata": {
+            "name": f"{graph.name}-{svc.name}",
+            "namespace": graph.namespace,
+            "labels": labels,
+        },
+        "spec": {
+            "replicas": svc.replicas,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": pod_spec,
+            },
+        },
+    }
+    if kind == "StatefulSet":
+        obj["spec"]["serviceName"] = f"{graph.name}-{svc.name}"
+        obj["spec"]["podManagementPolicy"] = "Parallel"
+    return obj
+
+
+def _service(graph: GraphSpec, svc: ServiceSpec, port: int,
+             headless: bool = False) -> Dict[str, Any]:
+    labels = _labels(graph, svc)
+    spec: Dict[str, Any] = {
+        "selector": labels,
+        "ports": [{"port": port, "targetPort": port}],
+    }
+    if headless:
+        spec["clusterIP"] = "None"
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{graph.name}-{svc.name}",
+            "namespace": graph.namespace,
+            "labels": labels,
+        },
+        "spec": spec,
+    }
+
+
+def render_service(graph: GraphSpec, svc: ServiceSpec) -> List[Dict[str, Any]]:
+    store = {"DTPU_STORE": "tcp", "DTPU_STORE_PATH": _store_address(graph)}
+    if svc.kind == "netstore":
+        c = _container(
+            graph, svc,
+            ["python", "-m", "dynamo_tpu.runtime.discovery.netstore",
+             "--port", "7460"],
+            {}, ports=[7460],
+        )
+        return [_deployment(graph, svc, c), _service(graph, svc, 7460)]
+
+    if svc.kind == "kvbm":
+        c = _container(
+            graph, svc,
+            ["python", "-m", "dynamo_tpu.kvbm", "--port", "7440"],
+            {}, ports=[7440],
+        )
+        return [_deployment(graph, svc, c), _service(graph, svc, 7440)]
+
+    if svc.kind == "frontend":
+        port = svc.port or 8000
+        c = _container(
+            graph, svc,
+            ["python", "-m", "dynamo_tpu.frontend", "--port", str(port)],
+            store, ports=[port],
+        )
+        return [_deployment(graph, svc, c), _service(graph, svc, port)]
+
+    if svc.kind == "router":
+        c = _container(
+            graph, svc,
+            ["python", "-m", "dynamo_tpu.router", "--replica-sync"],
+            store,
+        )
+        return [_deployment(graph, svc, c)]
+
+    if svc.kind == "worker":
+        chips = svc.tp * svc.sp
+        topo = _V5E_TOPO.get(chips)
+        if svc.tpu_generation == "v5e" and topo is None:
+            raise ValueError(
+                f"{svc.name}: tp*sp={chips} has no single-host v5e topology "
+                f"(choose from {sorted(_V5E_TOPO)})"
+            )
+        cmd = ["python", "-m", "dynamo_tpu.engine", "--tp", str(svc.tp),
+               "--sp", str(svc.sp), "--dp", str(svc.dp)]
+        if svc.model:
+            cmd += ["--model", svc.model]
+        if svc.preset:
+            cmd += ["--preset", svc.preset]
+        if svc.disagg:
+            cmd += ["--disagg", svc.disagg]
+        node_selector = {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": topo or "1x1",
+        }
+        c = _container(
+            graph, svc, cmd, store,
+            resources={
+                "requests": {"google.com/tpu": chips},
+                "limits": {"google.com/tpu": chips},
+            },
+        )
+        return [
+            _deployment(graph, svc, c, node_selector, kind="StatefulSet"),
+            _service(graph, svc, 0, headless=True),
+        ]
+
+    raise ValueError(f"unknown service kind {svc.kind!r} for {svc.name!r}")
+
+
+def render(graph: GraphSpec) -> List[Dict[str, Any]]:
+    objs: List[Dict[str, Any]] = []
+    kinds = [s.kind for s in graph.services]
+    if "netstore" not in kinds:
+        # every graph needs discovery; inject the shared store service
+        objs += render_service(graph, ServiceSpec(name="netstore", kind="netstore"))
+    for svc in graph.services:
+        objs += render_service(graph, svc)
+    return objs
+
+
+def render_yaml(graph: GraphSpec) -> str:
+    return yaml.safe_dump_all(render(graph), sort_keys=False)

@@ -78,6 +78,14 @@ def parse_args():
     p.add_argument("--kvbm-disk-gb", type=float, default=0.0,
                    help="disk KV tier size (G3)")
     p.add_argument("--kvbm-disk-path", default="/tmp/dtpu_kvbm")
+    p.add_argument("--kvbm-remote", default=None, metavar="HOST:PORT",
+                   help="G4 fleet-shared block store "
+                        "(python -m dynamo_tpu.kvbm)")
+    p.add_argument("--lora-max-adapters", type=int, default=0,
+                   help="static multi-LoRA slots; enables the load_lora/"
+                        "unload_lora/list_loras endpoints (reference "
+                        "components/src/dynamo/vllm/main.py:712)")
+    p.add_argument("--lora-rank", type=int, default=16)
     p.add_argument(
         "--disagg",
         choices=["none", "prefill", "decode"],
@@ -134,17 +142,23 @@ async def main() -> None:
     ) + (chunk_cap,)
     args.max_context = ctx
     kvbm = None
-    if args.kvbm_host_gb > 0 or args.kvbm_disk_gb > 0:
+    if args.kvbm_host_gb > 0 or args.kvbm_disk_gb > 0 or args.kvbm_remote:
         from dynamo_tpu.kvbm.pool import KvbmTiers
 
         block_nbytes = (
             4 * mcfg.num_layers * 2 * args.block_size * mcfg.num_kv_heads * mcfg.head_dim
         )
+        remote = None
+        if args.kvbm_remote:
+            from dynamo_tpu.kvbm.remote import RemoteBlockPool
+
+            remote = RemoteBlockPool(args.kvbm_remote)
         kvbm = KvbmTiers(
             block_nbytes,
             host_capacity_bytes=int(args.kvbm_host_gb * (1 << 30)),
             disk_capacity_bytes=int(args.kvbm_disk_gb * (1 << 30)),
             disk_path=args.kvbm_disk_path,
+            remote=remote,
         )
     engine_cfg = TpuEngineConfig(
         model=mcfg,
@@ -155,6 +169,8 @@ async def main() -> None:
         tp=args.tp,
         sp=args.sp,
         prefill_buckets=buckets,
+        lora_max_adapters=args.lora_max_adapters,
+        lora_rank=args.lora_rank,
     )
 
     import jax as _jax
@@ -234,6 +250,47 @@ async def main() -> None:
         ),
     )
     served = await register_llm(runtime, engine, card, instance_id=instance_id)
+
+    # LoRA management endpoints (load/unload/list), served beside generate
+    lora_served = []
+    if args.lora_max_adapters > 0:
+        from dynamo_tpu.lora import LoRACache, LocalLoRASource, load_adapter
+
+        lora_cache = LoRACache()
+        lora_source = LocalLoRASource()
+        # every dp rank owns its own engine (and mesh), so each gets its own
+        # adapter table: load/unload fan out to all of them
+        lora_engines = [e for e in engines if e.lora is not None]
+
+        async def handle_load(request, context):
+            name, uri = request["name"], request["uri"]
+            loop_ = asyncio.get_event_loop()
+
+            def work():
+                path = lora_source.fetch(uri, lora_cache)
+                weights, alpha = load_adapter(path)
+                return [e.lora.load(name, weights, alpha) for e in lora_engines]
+
+            try:
+                slots = await loop_.run_in_executor(None, work)
+                yield {"ok": True, "name": name, "slot": slots[0]}
+            except Exception as e:
+                yield {"ok": False, "error": str(e)}
+
+        async def handle_unload(request, context):
+            oks = [e.lora.unload(request["name"]) for e in lora_engines]
+            yield {"ok": all(oks)}
+
+        async def handle_list(request, context):
+            yield {"adapters": lora_engines[0].lora.list_adapters()}
+
+        comp = runtime.namespace(args.namespace).component(component)
+        for ep_name, handler in (
+            ("load_lora", handle_load),
+            ("unload_lora", handle_unload),
+            ("list_loras", handle_list),
+        ):
+            lora_served.append(await comp.endpoint(ep_name).serve(handler))
 
     # health: engine watchdog + endpoint canary + status side-port
     # (reference: engine_monitor.py, health_check.rs, system_status_server.rs)

@@ -95,6 +95,12 @@ class TpuEngineConfig:
     # device compute. Each extra slot adds decode_steps tokens of emission
     # latency and speculation waste at stop.
     decode_pipeline: int = 2
+    # multi-LoRA serving (lora/adapters.py): N static adapter slots baked
+    # into the programs at build; hot-load/unload are in-place table updates
+    # with zero recompiles. 0 disables (no lora ops in the hot path).
+    lora_max_adapters: int = 0
+    lora_rank: int = 16
+    lora_targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
 
     def __post_init__(self):
         bad = [b for b in self.prefill_buckets if b % self.block_size]
@@ -166,7 +172,11 @@ class TpuEngine:
         # multi-tier KV (kvbm/pool.py): sealed blocks write through to host
         # DRAM (G2) / disk (G3); admission onboards matched prefixes back
         self.kvbm = kvbm
-        self._offload_pending: List[Tuple[int, int]] = []  # (block_id, seq_hash)
+        # (block_id, seq_hash, priority): 0 = prompt-prefix blocks (highest
+        # reuse odds -> offload first), 1 = decode-sealed blocks; the kvbm
+        # priority queue transfers in that order (kvbm/pool.py OffloadQueue,
+        # reference offload.rs:10-16)
+        self._offload_pending: List[Tuple[int, int, int]] = []
 
         # --- place params + caches on the mesh ---
         self._forward = registry.forward_fn(self.mcfg, self.mesh)
@@ -193,6 +203,7 @@ class TpuEngine:
         self._freqs = np.zeros(B, np.float32)
         self._reps = np.ones(B, np.float32)
         self._lp_ns = np.zeros(B, np.int32)    # requested top-logprobs per slot
+        self._lora_slots = np.zeros(B, np.int32)  # adapter slot per batch slot
         self._seeds = np.zeros(B, np.uint32)
         # penalty state tables (device-resident; see engine/sampling.py)
         V = self.mcfg.vocab_size
@@ -221,6 +232,18 @@ class TpuEngine:
         self._offload_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-offload"
         )
+        # multi-LoRA adapter tables (static shapes; see lora/adapters.py)
+        self.lora = None
+        if config.lora_max_adapters > 0:
+            if registry.is_moe(self.mcfg):
+                raise ValueError("LoRA serving covers the dense family only")
+            from ..lora import LoraAdapterTable
+
+            with self.mesh:
+                self.lora = LoraAdapterTable(
+                    self.mcfg, config.lora_max_adapters, config.lora_rank,
+                    config.lora_targets, dtype=self.mcfg.dtype,
+                )
         # disaggregation: KV transfer in/out (engine/transfer.py)
         self.transfer_address: Optional[str] = None
         self._transfer_server = None
@@ -282,6 +305,17 @@ class TpuEngine:
     def _build_programs(self) -> None:
         cfg, mcfg = self.cfg, self.mcfg
         fwd, logits_fn = self._forward, self._lm_logits
+        lora_enabled = self.lora is not None
+
+        def call_fwd(params, tokens, positions, attend, lora_tables, lora_ids):
+            if not lora_enabled:
+                return fwd(params, mcfg, tokens, positions, attend)
+            from ..lora import make_lora_fn
+
+            return fwd(
+                params, mcfg, tokens, positions, attend,
+                lora=make_lora_fn(lora_tables, lora_ids),
+            )
 
         use_pallas = cfg.use_pallas
         if use_pallas is None:
@@ -329,7 +363,8 @@ class TpuEngine:
         def prefill(params, k_caches, v_caches, counts, tokens, positions,
                     block_table, new_block_ids, total_len, chunk_start, seeds,
                     steps, temp, top_k, top_p, min_p, pres, freq, rep,
-                    prompt_masks, slot, lp_need, is_final):
+                    prompt_masks, slot, lp_need, is_final, lora_tables,
+                    lora_id):
             # tokens/positions: [S_pad] — ONE chunk of the prompt (the whole
             # prompt when it fits a bucket); block_table: [max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx):
@@ -348,7 +383,7 @@ class TpuEngine:
                     )
                 return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
 
-            hidden = fwd(params, mcfg, tokens, positions, attend)
+            hidden = call_fwd(params, tokens, positions, attend, lora_tables, lora_id)
 
             def sample_branch(counts):
                 # logits at the last real token (positions are absolute; the
@@ -389,7 +424,7 @@ class TpuEngine:
         def decode(params, k_caches, v_caches, counts, tokens, positions,
                    block_tables, seq_lens, write_blocks, write_offsets, seeds,
                    steps, temps, top_ks, top_ps, min_ps, pres, freqs, reps,
-                   prompt_masks, lp_need):
+                   prompt_masks, lp_need, lora_tables, lora_ids):
             # tokens: [B]; block_tables: [B, max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx):
                 kc, vc = att.write_decode_kv(
@@ -400,8 +435,9 @@ class TpuEngine:
                 out = paged_attention(q[:, 0], kc, vc, block_tables, seq_lens)
                 return out[:, None]
 
-            hidden = fwd(
-                params, mcfg, tokens[:, None], positions[:, None], attend
+            hidden = call_fwd(
+                params, tokens[:, None], positions[:, None], attend,
+                lora_tables, lora_ids,
             )  # [B, 1, H]
             logits = logits_fn(params, mcfg, hidden[:, 0])  # [B, V]
             pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
@@ -416,7 +452,7 @@ class TpuEngine:
         def decode_multi(params, k_caches, v_caches, counts, tokens, seq_lens,
                          block_tables, active, seeds, steps0, temps, top_ks,
                          top_ps, min_ps, pres, freqs, reps, prompt_masks,
-                         lp_need):
+                         lp_need, lora_tables, lora_ids):
             """cfg.decode_steps decode iterations in one program: each step
             writes the fed token's KV, attends, samples, and feeds the sample
             back — tokens only reach the host once per horizon. seq_lens==0
@@ -450,8 +486,9 @@ class TpuEngine:
                     out = paged_attention(q[:, 0], kc, vc, block_tables, seq_lens)
                     return out[:, None]
 
-                hidden = fwd(
-                    params, mcfg, tokens[:, None], positions[:, None], attend
+                hidden = call_fwd(
+                    params, tokens[:, None], positions[:, None], attend,
+                    lora_tables, lora_ids,
                 )
                 logits = logits_fn(params, mcfg, hidden[:, 0])
                 pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
@@ -530,6 +567,12 @@ class TpuEngine:
                 f"prompt {n_prompt} tokens cannot fit the KV pool "
                 f"({self.cfg.num_blocks} blocks x {self.cfg.block_size})"
             )
+        lora_name = req.annotations.get("lora")
+        if lora_name:
+            if self.lora is None:
+                raise ValueError("engine built without LoRA support")
+            if self.lora.slot_of(lora_name) == 0:
+                raise ValueError(f"unknown LoRA adapter {lora_name!r}")
         if req.annotations.get("op") == "embed":
             loop = asyncio.get_event_loop()
             vec = await loop.run_in_executor(
@@ -611,15 +654,16 @@ class TpuEngine:
         guarantees the gather reads the pages before any later-dispatched
         decode/prefill can rewrite them after LRU eviction — the host fetch
         itself can then run lazily on the offload thread."""
-        ids = jnp.asarray(np.asarray([bid for bid, _ in pending], np.int32))
+        ids = jnp.asarray(np.asarray([bid for bid, _, _ in pending], np.int32))
         gathered = []
         for kc, vc in zip(self.k_caches, self.v_caches):
             gathered.append((kc[ids], vc[ids]))  # [n, bs, kvh, d] each
         return gathered
 
-    def _offload_fetch(self, pending: List[Tuple[int, int]], gathered) -> None:
-        """Offload thread: fetch the already-gathered pages and store to the
-        host tier. Best-effort cache write-through: failures are logged,
+    def _offload_fetch(self, pending: List[Tuple[int, int, int]], gathered) -> None:
+        """Offload thread: fetch the already-gathered pages and hand them to
+        the kvbm priority queue (prefix blocks outrank decode blocks; the
+        kvbm worker does the tier writes). Best-effort: failures are logged,
         never fatal."""
         try:
             layers = []
@@ -628,8 +672,10 @@ class TpuEngine:
                 v = np.asarray(v_dev, np.float32)
                 layers.append(np.stack([k, v], axis=1))  # [n, 2, bs, kvh, d]
             arr = np.stack(layers, axis=1)               # [n, L, 2, bs, kvh, d]
-            for i, (_, h) in enumerate(pending):
-                self.kvbm.store(h, arr[i])
+            for i, (_, h, prio) in enumerate(pending):
+                # copy: a view of arr would pin the whole n-block gather
+                # buffer in the host tier for as long as one block lives
+                self.kvbm.offload(h, arr[i].copy(), priority=prio)
         except Exception:
             log.exception("kv offload failed (continuing without write-through)")
 
@@ -673,10 +719,14 @@ class TpuEngine:
         bs = self.cfg.block_size
         hashes = st.seq.sequence_hashes()[: (len(st.seq) - 1) // bs]
         have = len(self.allocator.match_prefix(hashes))
-        n = self.kvbm.match_prefix(hashes[have:])
+        loop = asyncio.get_event_loop()
+        # match_prefix can hit the G4 remote store (blocking socket): keep it
+        # off the event loop, same as the load below
+        n = await loop.run_in_executor(
+            None, self.kvbm.match_prefix, hashes[have:]
+        )
         if n == 0:
             return
-        loop = asyncio.get_event_loop()
         arr = await loop.run_in_executor(None, self.kvbm.load_prefix, hashes[have : have + n])
         if arr is None:
             return
@@ -861,6 +911,10 @@ class TpuEngine:
             self._seeds[slot] = np.uint32(
                 seed if seed is not None else self._host_rng.integers(1 << 32)
             )
+            self._lora_slots[slot] = (
+                self.lora.slot_of(st.req.annotations.get("lora"))
+                if self.lora is not None else 0
+            )
             # penalty tables: reset the slot's rows when this request uses
             # penalties (needs a fresh prompt mask) or a prior occupant left
             # them dirty. One tiny async dispatch; skipped entirely on the
@@ -905,7 +959,7 @@ class TpuEngine:
         for i in range(st.commit_upto, upto):
             self.allocator.commit(st.block_ids[i], hashes[i])
             if self.kvbm is not None:
-                self._offload_pending.append((st.block_ids[i], hashes[i]))
+                self._offload_pending.append((st.block_ids[i], hashes[i], 0))
         st.commit_upto = max(st.commit_upto, upto)
 
     # -- device calls (run in executor thread) -------------------------------
@@ -954,6 +1008,7 @@ class TpuEngine:
             self.prompt_masks, jnp.int32(st.slot),
             jnp.bool_(self._lp_ns[st.slot] > 0),
             jnp.bool_(is_final),
+            self._lora_tables(), jnp.int32(self._lora_slots[st.slot]),
         )
         st.prefill_pos = total_len
         if not is_final:
@@ -1018,6 +1073,9 @@ class TpuEngine:
             return False
         return True
 
+    def _lora_tables(self):
+        return self.lora.tables() if self.lora is not None else {}
+
     def _dev(self, name: str, host_arr: np.ndarray) -> jax.Array:
         """Device-resident copy of a slot array, re-uploaded only on change
         (host<->device transfers are ~100ms RPCs on tunneled TPUs)."""
@@ -1071,6 +1129,8 @@ class TpuEngine:
                 self._dev("reps", self._reps),
                 self.prompt_masks,
                 jnp.bool_(bool(np.any(self._lp_ns[active] > 0))),
+                self._lora_tables(),
+                self._dev("lora_slots", self._lora_slots),
             )
         )
         # start the D2H readback immediately: by the time this horizon's turn
@@ -1153,6 +1213,7 @@ class TpuEngine:
             jnp.asarray(self._min_ps), jnp.asarray(self._pres),
             jnp.asarray(self._freqs), jnp.asarray(self._reps),
             self.prompt_masks, jnp.bool_(lp_need),
+            self._lora_tables(), jnp.asarray(self._lora_slots),
         )
         toks_np = np.asarray(toks)
         lps_np = np.asarray(lps)
@@ -1213,7 +1274,7 @@ class TpuEngine:
                     )
                     if self.kvbm is not None:
                         self._offload_pending.append(
-                            (st.block_ids[sealed.position], sealed.sequence_hash)
+                            (st.block_ids[sealed.position], sealed.sequence_hash, 1)
                         )
                 # ensure a block exists for the *next* token's write position
                 L_after = L_before + 1
@@ -1268,12 +1329,15 @@ class TpuEngine:
             ]
             if gone:
                 removed = removed + [gone]
-            # a device-evicted block still in G2/G3 is still servable (we
+            # a device-evicted block still in G2/G3/G4 is still servable (we
             # onboard on demand): don't tell the router it's gone — the
             # consolidated view, like the reference's kv_consolidator
-            # (lib/llm/src/block_manager/kv_consolidator)
+            # (lib/llm/src/block_manager/kv_consolidator). Remote membership
+            # is answered in one batched RPC per event batch.
             removed = [
-                [h for h in batch if h not in self.kvbm] for batch in removed
+                [h for h in batch if h not in servable]
+                for batch in removed
+                for servable in (set(self.kvbm.filter_servable(batch)),)
             ]
             removed = [b for b in removed if b]
         if self.kv_publisher is not None:

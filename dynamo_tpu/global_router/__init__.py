@@ -1,0 +1,18 @@
+"""Hierarchical 2-level routing: SLA-grid pool selection on top of per-pool
+local KV routers (reference components/src/dynamo/global_router)."""
+
+from .handler import GlobalRouterHandler
+from .pool_selection import (
+    DecodePoolSelectionStrategy,
+    GlobalRouterConfig,
+    PoolSpec,
+    PrefillPoolSelectionStrategy,
+)
+
+__all__ = [
+    "GlobalRouterHandler",
+    "GlobalRouterConfig",
+    "PoolSpec",
+    "PrefillPoolSelectionStrategy",
+    "DecodePoolSelectionStrategy",
+]

@@ -1,0 +1,54 @@
+"""python -m dynamo_tpu.global_router — hierarchical routing service.
+
+Registers as a worker for --model in --namespace (the frontend can't tell),
+and forwards each request to a pool namespace chosen by the SLA grid in
+--config (reference components/src/dynamo/global_router/__main__.py).
+"""
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.global_router import GlobalRouterConfig, GlobalRouterHandler
+from dynamo_tpu.llm import ModelDeploymentCard, register_llm
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
+
+
+def parse_args():
+    p = argparse.ArgumentParser("dynamo_tpu.global_router")
+    p.add_argument("--config", required=True, help="pool + grid JSON")
+    p.add_argument("--model", required=True)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="global_router")
+    p.add_argument("--store", default=None)
+    p.add_argument("--store-path", default=None)
+    p.add_argument("--event-plane", default=None)
+    p.add_argument("--block-size", type=int, default=16)
+    return p.parse_args()
+
+
+async def main() -> None:
+    args = parse_args()
+    init_logging()
+    cfg = RuntimeConfig.from_env(
+        store=args.store, store_path=args.store_path, event_plane=args.event_plane
+    )
+    runtime = await DistributedRuntime(cfg).start()
+    handler = GlobalRouterHandler(runtime, GlobalRouterConfig.load(args.config))
+    card = ModelDeploymentCard(
+        name=args.model, namespace=args.namespace, component=args.component,
+        tokenizer="byte", kv_block_size=args.block_size,
+    )
+    await register_llm(runtime, handler, card)
+    print("GLOBAL_ROUTER_READY", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await handler.stop()
+    await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

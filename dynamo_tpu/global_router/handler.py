@@ -1,0 +1,88 @@
+"""GlobalRouterHandler: an engine-shaped forwarder over pools of workers.
+
+Analog of the reference's GlobalRouterHandler
+(components/src/dynamo/global_router/handler.py): registers like a worker
+(the frontend can't tell), but ``generate`` picks a pool by the SLA grid and
+forwards the request to that pool's own namespace — where a local KV router /
+worker set handles it. Two-level routing: global (SLA/pool) then local
+(KV-overlap/load).
+
+SLA targets ride request annotations ``ttft_target_ms`` / ``itl_target_ms``
+(the reference reads them from nvext)."""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ..llm.protocols.common import BackendOutput, PreprocessedRequest
+from ..runtime.component import Client, RouterMode
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from .pool_selection import GlobalRouterConfig, PoolSpec
+
+log = get_logger("global_router")
+
+
+class GlobalRouterHandler:
+    def __init__(self, runtime, config: GlobalRouterConfig):
+        self.runtime = runtime
+        self.config = config
+        self._clients: Dict[str, Client] = {}
+        # observability: how many requests each pool received
+        self.pool_counts: Dict[str, int] = {}
+
+    async def _client(self, pool: PoolSpec) -> Client:
+        key = f"{pool.namespace}/{pool.component}/{pool.endpoint}"
+        c = self._clients.get(key)
+        if c is None:
+            c = await (
+                self.runtime.namespace(pool.namespace)
+                .component(pool.component)
+                .endpoint(pool.endpoint)
+                .client(RouterMode.ROUND_ROBIN)
+            )
+            self._clients[key] = c
+        return c
+
+    def _pick_pool(self, req: PreprocessedRequest) -> PoolSpec:
+        isl = len(req.token_ids)
+        ann = req.annotations or {}
+        if ann.get("disagg") == "prefill" and self.config.prefill_pools:
+            ttft = ann.get("ttft_target_ms", self.config.default_ttft_ms)
+            idx = (
+                self.config.prefill_strategy.select_pool(isl, ttft)
+                if self.config.prefill_strategy else 0
+            )
+            pools = self.config.prefill_pools
+        else:
+            itl = ann.get("itl_target_ms", self.config.default_itl_ms)
+            ctx = isl + (req.stop.max_tokens or 0)
+            idx = (
+                self.config.decode_strategy.select_pool(ctx, itl)
+                if self.config.decode_strategy else 0
+            )
+            pools = self.config.decode_pools
+        return pools[max(0, min(idx, len(pools) - 1))]
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[Any]:
+        req = (
+            request if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_obj(request)
+        )
+        pool = self._pick_pool(req)
+        self.pool_counts[pool.namespace] = self.pool_counts.get(pool.namespace, 0) + 1
+        log.debug(
+            "global route %s (isl=%d) -> pool %s",
+            req.request_id[:8], len(req.token_ids), pool.namespace,
+        )
+        client = await self._client(pool)
+        await client.wait_for_instances(1, timeout=10.0)
+        stream = await client.generate(req.to_obj(), context=context)
+        async for item in stream:
+            yield item
+
+    async def stop(self) -> None:
+        for c in self._clients.values():
+            await c.stop()

@@ -1,0 +1,118 @@
+"""Grid-based pool selection for hierarchical routing.
+
+Analog of the reference's global-router pool selection
+(components/src/dynamo/global_router/pool_selection.py): a config maps
+(ISL, TTFT-target) onto a prefill pool and (context_length, ITL-target) onto
+a decode pool via 2-D lookup grids, so SLA-differentiated traffic lands on
+pools provisioned for it (the hierarchical-planner story,
+examples/hierarchical_planner/global_router_config.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _clamp(value: float, resolution: int) -> int:
+    return max(0, min(int(value), resolution - 1))
+
+
+@dataclasses.dataclass
+class PrefillPoolSelectionStrategy:
+    """(ISL, TTFT-target-ms) -> prefill pool index."""
+
+    ttft_min: float
+    ttft_max: float
+    ttft_resolution: int
+    isl_min: int
+    isl_max: int
+    isl_resolution: int
+    prefill_pool_mapping: List[List[int]]  # [isl_idx][ttft_idx]
+
+    def select_pool(self, isl: int, ttft_target: Optional[float] = None) -> int:
+        if ttft_target is None:
+            ttft_target = (self.ttft_min + self.ttft_max) / 2
+        isl_step = (self.isl_max - self.isl_min) / self.isl_resolution
+        ttft_step = (self.ttft_max - self.ttft_min) / self.ttft_resolution
+        isl_idx = _clamp((isl - self.isl_min) / isl_step, self.isl_resolution)
+        ttft_idx = _clamp((ttft_target - self.ttft_min) / ttft_step, self.ttft_resolution)
+        return self.prefill_pool_mapping[isl_idx][ttft_idx]
+
+
+@dataclasses.dataclass
+class DecodePoolSelectionStrategy:
+    """(context_length, ITL-target-ms) -> decode pool index."""
+
+    itl_min: float
+    itl_max: float
+    itl_resolution: int
+    context_length_min: int
+    context_length_max: int
+    context_length_resolution: int
+    decode_pool_mapping: List[List[int]]  # [ctx_idx][itl_idx]
+
+    def select_pool(self, context_length: int, itl_target: Optional[float] = None) -> int:
+        if itl_target is None:
+            itl_target = (self.itl_min + self.itl_max) / 2
+        ctx_step = (
+            self.context_length_max - self.context_length_min
+        ) / self.context_length_resolution
+        itl_step = (self.itl_max - self.itl_min) / self.itl_resolution
+        ctx_idx = _clamp(
+            (context_length - self.context_length_min) / ctx_step,
+            self.context_length_resolution,
+        )
+        itl_idx = _clamp((itl_target - self.itl_min) / itl_step, self.itl_resolution)
+        return self.decode_pool_mapping[ctx_idx][itl_idx]
+
+
+@dataclasses.dataclass
+class PoolSpec:
+    """One pool: a namespace holding its own workers (+ local router)."""
+
+    namespace: str
+    component: str = "backend"
+    endpoint: str = "generate"
+
+
+@dataclasses.dataclass
+class GlobalRouterConfig:
+    prefill_pools: List[PoolSpec]
+    decode_pools: List[PoolSpec]
+    prefill_strategy: Optional[PrefillPoolSelectionStrategy]
+    decode_strategy: Optional[DecodePoolSelectionStrategy]
+    default_ttft_ms: Optional[float] = None
+    default_itl_ms: Optional[float] = None
+
+    @classmethod
+    def from_obj(cls, obj: Dict[str, Any]) -> "GlobalRouterConfig":
+        def pools(key: str) -> List[PoolSpec]:
+            out = []
+            for p in obj.get(key, []):
+                if isinstance(p, str):
+                    out.append(PoolSpec(namespace=p))
+                else:
+                    out.append(PoolSpec(**p))
+            return out
+
+        ps = obj.get("prefill_selection")
+        ds = obj.get("decode_selection")
+        return cls(
+            prefill_pools=pools("prefill_pools"),
+            decode_pools=pools("decode_pools"),
+            prefill_strategy=(
+                PrefillPoolSelectionStrategy(**ps) if ps else None
+            ),
+            decode_strategy=(
+                DecodePoolSelectionStrategy(**ds) if ds else None
+            ),
+            default_ttft_ms=obj.get("default_ttft_ms"),
+            default_itl_ms=obj.get("default_itl_ms"),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "GlobalRouterConfig":
+        with open(path) as f:
+            return cls.from_obj(json.load(f))

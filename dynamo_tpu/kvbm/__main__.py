@@ -1,0 +1,47 @@
+"""python -m dynamo_tpu.kvbm — standalone G4 remote block-store service.
+
+The fleet-shared KV tier (reference CacheLevel::G4 "Remote NVMe",
+lib/llm/src/block_manager.rs:63-77): workers point at it with
+``--kvbm-remote HOST:PORT`` and a prefix prefilled anywhere becomes
+onboardable everywhere.
+"""
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.kvbm.remote import RemoteBlockStoreServer
+from dynamo_tpu.runtime import init_logging
+
+
+def parse_args():
+    p = argparse.ArgumentParser("dynamo_tpu.kvbm")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7440)
+    p.add_argument("--capacity-gb", type=float, default=2.0)
+    p.add_argument("--disk", default=None,
+                   help="persist block payloads under this directory "
+                        "(RAM index over disk payloads)")
+    return p.parse_args()
+
+
+async def main() -> None:
+    args = parse_args()
+    init_logging()
+    server = RemoteBlockStoreServer(
+        host=args.host, port=args.port,
+        capacity_bytes=int(args.capacity_gb * (1 << 30)),
+        disk_path=args.disk,
+    )
+    addr = await server.start()
+    print(f"KVBM_REMOTE_READY {addr}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,10 +1,17 @@
-"""Multi-tier KV block pools: G2 host DRAM and G3 local disk.
+"""Multi-tier KV block pools: G2 host DRAM, G3 local disk, G4 remote.
 
 Analog of the reference's KVBM block manager (lib/llm/src/block_manager:
 G1 device / G2 host / G3 disk / G4 remote, block_manager.rs:63-77) built for
 the TPU engine: sealed device blocks are written through to a host pool
 asynchronously; host overflow spills to disk; a prefix lookup that misses HBM
-onboards from host/disk back into device pages before prefill.
+onboards from host/disk/remote back into device pages before prefill. The G4
+remote tier (kvbm/remote.py) is a fleet-shared block store.
+
+Offload ordering follows the reference's priority-queue design
+(lib/llm/src/block_manager/offload.rs:4-34): offloads enqueue with a
+priority; lower values transfer first, FIFO within a priority, and the
+bounded queue sheds the lowest-priority work under backpressure instead of
+stalling the engine.
 
 Storage layout per block: float32 array [L, 2, bs, kvh, d] (same shape the
 transfer plane uses) — one contiguous buffer per block keeps the host copy
@@ -13,6 +20,7 @@ a single memcpy and the disk tier a single file write.
 
 from __future__ import annotations
 
+import heapq
 import os
 import threading
 from collections import OrderedDict
@@ -138,8 +146,69 @@ class DiskBlockPool:
             return None
 
 
+class OffloadQueue:
+    """Bounded priority queue feeding one offload worker thread.
+
+    Reference analog: OffloadManager's priority queue (offload.rs:10-16) —
+    lower priority value first, FIFO within a priority (monotone sequence
+    number breaks ties). When full, the LOWEST-priority queued item is shed
+    (never the incoming one if it outranks something queued): bandwidth is
+    the scarce resource and the most reusable blocks should win it."""
+
+    def __init__(self, max_items: int = 512):
+        self.max_items = max_items
+        self._heap: List[tuple] = []  # (priority, seq, hash, block)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self.shed = 0
+        self.in_flight = 0  # popped but not yet written (flush waits on this)
+        self._closed = False
+
+    def put(self, h: SequenceHash, block: np.ndarray, priority: int) -> None:
+        with self._ready:
+            if self._closed:
+                return
+            heapq.heappush(self._heap, (priority, self._seq, h, block))
+            self._seq += 1
+            if len(self._heap) > self.max_items:
+                # shed the worst item: max priority, newest within it
+                worst = max(range(len(self._heap)), key=lambda i: (
+                    self._heap[i][0], self._heap[i][1]
+                ))
+                self._heap[worst] = self._heap[-1]
+                self._heap.pop()
+                heapq.heapify(self._heap)
+                self.shed += 1
+            self._ready.notify()
+
+    def get(self, timeout: Optional[float] = None):
+        with self._ready:
+            if not self._heap:
+                self._ready.wait(timeout)
+            if not self._heap:
+                return None
+            self.in_flight += 1
+            return heapq.heappop(self._heap)
+
+    def task_done(self) -> None:
+        with self._lock:
+            self.in_flight = max(0, self.in_flight - 1)
+
+    def close(self) -> None:
+        with self._ready:
+            self._closed = True
+            self._ready.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+
 class KvbmTiers:
-    """G2+G3 stack with write-through offload and prefix onboarding."""
+    """G2+G3+G4 stack with prioritized write-through offload and prefix
+    onboarding (G4 = kvbm/remote.py RemoteBlockPool, or anything with the
+    same store/get/__contains__ surface)."""
 
     def __init__(
         self,
@@ -147,6 +216,8 @@ class KvbmTiers:
         host_capacity_bytes: int = 1 << 30,
         disk_capacity_bytes: int = 0,
         disk_path: str = "/tmp/dtpu_kvbm",
+        remote=None,
+        offload_queue_depth: int = 512,
     ):
         self.host = HostBlockPool(host_capacity_bytes, block_nbytes)
         self.disk = (
@@ -154,14 +225,20 @@ class KvbmTiers:
             if disk_capacity_bytes > 0
             else None
         )
+        self.remote = remote
         self.offloaded = 0
         self.onboarded = 0
         # hashes evicted from every tier since the last drain (the engine
         # turns these into router 'removed' events so the index stays honest)
         self._evicted: List[SequenceHash] = []
         self._evicted_lock = threading.Lock()
+        self.queue = OffloadQueue(offload_queue_depth)
+        self._worker: Optional[threading.Thread] = None
 
     def __contains__(self, h: SequenceHash) -> bool:
+        # LOCAL tiers only: a remote round-trip per hash would put RPCs on
+        # whatever thread asks; remote membership is batched (match_prefix,
+        # filter_servable)
         return h in self.host or (self.disk is not None and h in self.disk)
 
     def _insert_host(self, h: SequenceHash, block: np.ndarray) -> None:
@@ -178,13 +255,70 @@ class KvbmTiers:
                 self._evicted.extend(gone)
 
     def store(self, h: SequenceHash, block: np.ndarray) -> None:
+        """Synchronous write-through (host + remote). Prefer ``offload``."""
         self._insert_host(h, block)
+        if self.remote is not None:
+            self.remote.store(h, block)
         self.offloaded += 1
+
+    # -- prioritized async offload (offload.rs analog) -----------------------
+    def offload(self, h: SequenceHash, block: np.ndarray, priority: int = 1) -> None:
+        """Enqueue a block for background write-through; lower priority value
+        transfers first. The engine uses priority 0 for prompt-prefix blocks
+        (highest reuse odds) and 1 for decode-sealed blocks."""
+        self._ensure_worker()
+        self.queue.put(h, block, priority)
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._offload_loop, name="kvbm-offload", daemon=True
+            )
+            self._worker.start()
+
+    def _offload_loop(self) -> None:
+        while True:
+            item = self.queue.get(timeout=1.0)
+            if item is None:
+                if self.queue._closed:
+                    return
+                continue
+            _prio, _seq, h, block = item
+            try:
+                self.store(h, block)
+            except Exception:
+                log.exception("kvbm offload of block %x failed", h)
+            finally:
+                self.queue.task_done()
+
+    def flush(self, timeout_s: float = 10.0) -> None:
+        """Wait until the offload queue drains (tests / orderly shutdown)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while (
+            (len(self.queue) or self.queue.in_flight)
+            and _time.monotonic() < deadline
+        ):
+            _time.sleep(0.005)
+
+    def close(self) -> None:
+        self.queue.close()
 
     def drain_evicted(self) -> List[SequenceHash]:
         with self._evicted_lock:
             out, self._evicted = self._evicted, []
         return out
+
+    def filter_servable(self, hashes: List[SequenceHash]) -> List[SequenceHash]:
+        """Subset of ``hashes`` still servable from ANY tier (remote queried
+        in one batch). Used to consolidate router 'removed' events."""
+        local = [h for h in hashes if h in self]
+        rest = [h for h in hashes if h not in self]
+        if rest and self.remote is not None:
+            have = self.remote.contains_many(rest)
+            local.extend(h for h, ok in zip(rest, have) if ok)
+        return local
 
     def match_prefix(self, hashes: List[SequenceHash]) -> int:
         n = 0
@@ -193,6 +327,13 @@ class KvbmTiers:
                 n += 1
             else:
                 break
+        if n < len(hashes) and self.remote is not None:
+            # extend the contiguous run from the fleet-shared tier
+            have = self.remote.contains_many(hashes[n:])
+            for ok in have:
+                if not ok:
+                    break
+                n += 1
         return n
 
     def load_prefix(self, hashes: List[SequenceHash]) -> Optional[np.ndarray]:
@@ -202,10 +343,12 @@ class KvbmTiers:
             b = self.host.get(h)
             if b is None and self.disk is not None:
                 b = self.disk.get(h)
-                if b is not None:
-                    self._insert_host(h, b)  # promote G3 -> G2 (with spill)
+            if b is None and self.remote is not None:
+                b = self.remote.get(h)
             if b is None:
                 break
+            if h not in self.host:
+                self._insert_host(h, b)  # promote G3/G4 -> G2 (with spill)
             blocks.append(b)
         if not blocks:
             return None
@@ -220,4 +363,6 @@ class KvbmTiers:
             "host_misses": self.host.misses,
             "offloaded": self.offloaded,
             "onboarded": self.onboarded,
+            "queue_depth": len(self.queue),
+            "queue_shed": self.queue.shed,
         }

@@ -32,12 +32,22 @@ class AuditPolicy:
 
     @classmethod
     def from_env(cls) -> "AuditPolicy":
-        sinks_env = os.environ.get("DYN_AUDIT_SINKS", "")
+        from ..runtime.config import (
+            ENV_AUDIT_FORCE_LOGGING,
+            ENV_AUDIT_SINKS,
+            is_truthy,
+        )
+
+        sinks_env = (
+            os.environ.get(ENV_AUDIT_SINKS) or os.environ.get("DYN_AUDIT_SINKS", "")
+        )
         sinks = [s.strip() for s in sinks_env.split(",") if s.strip()]
         return cls(
             enabled=bool(sinks),
-            force_logging=os.environ.get("DYN_AUDIT_FORCE_LOGGING", "").lower()
-            in ("1", "true", "yes"),
+            force_logging=is_truthy(
+                os.environ.get(ENV_AUDIT_FORCE_LOGGING)
+                or os.environ.get("DYN_AUDIT_FORCE_LOGGING")
+            ),
             sinks=sinks,
         )
 
@@ -91,8 +101,12 @@ class EventPlaneSink:
     name = "event"
 
     def __init__(self, event_plane, subject: Optional[str] = None):
+        from ..runtime.config import ENV_AUDIT_SUBJECT
+
         self.event_plane = event_plane
-        self.subject = subject or os.environ.get("DYN_AUDIT_SUBJECT", "dynamo.audit.v1")
+        self.subject = subject or os.environ.get(
+            ENV_AUDIT_SUBJECT, os.environ.get("DYN_AUDIT_SUBJECT", "dynamo.audit.v1")
+        )
         self._pending: List[AuditRecord] = []
 
     def emit(self, rec: AuditRecord) -> None:

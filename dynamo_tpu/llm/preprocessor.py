@@ -82,13 +82,16 @@ class OpenAIPreprocessor:
             stop_strings=request.stop_list(),
             ignore_eos=bool(request.ignore_eos),
         )
+        annotations = {ANNOTATION_INPUT_TOKENS: len(token_ids)}
+        if getattr(request, "lora", None):
+            annotations["lora"] = request.lora
         return PreprocessedRequest(
             request_id=request_id,
             model=request.model,
             token_ids=token_ids,
             stop=stop,
             sampling=sampling,
-            annotations={ANNOTATION_INPUT_TOKENS: len(token_ids)},
+            annotations=annotations,
         )
 
     def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:

@@ -96,6 +96,9 @@ class ChatCompletionRequest(SamplingFields):
     user: Optional[str] = None
     # routing extensions (reference nvext.rs): pin a worker / annotate
     routing: Optional[Dict[str, Any]] = None
+    # multi-LoRA: adapter name to apply (lora/adapters.py; reference routes
+    # adapter-named models via its LoraRoutingTable)
+    lora: Optional[str] = None
 
     @model_validator(mode="after")
     def _non_empty(self) -> "ChatCompletionRequest":
@@ -112,6 +115,7 @@ class CompletionRequest(SamplingFields):
     echo: bool = False
     user: Optional[str] = None
     routing: Optional[Dict[str, Any]] = None
+    lora: Optional[str] = None
 
 
 class EmbeddingRequest(_Lenient):

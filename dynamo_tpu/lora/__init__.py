@@ -1,0 +1,24 @@
+"""Multi-LoRA serving: device-resident adapter tables, cache/sources,
+fleet routing (reference lib/llm/src/lora/)."""
+
+from .adapters import LoraAdapterTable, make_lora_fn
+from .cache import LoRACache, LocalLoRASource, from_peft_dir, load_adapter
+from .routing import (
+    LoraReplicaConfig,
+    LoraRoutingTable,
+    RendezvousHasher,
+    allocate,
+)
+
+__all__ = [
+    "LoraAdapterTable",
+    "make_lora_fn",
+    "LoRACache",
+    "LocalLoRASource",
+    "from_peft_dir",
+    "load_adapter",
+    "LoraReplicaConfig",
+    "LoraRoutingTable",
+    "RendezvousHasher",
+    "allocate",
+]

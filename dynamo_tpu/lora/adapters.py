@@ -1,0 +1,197 @@
+"""Batched multi-LoRA: device-resident adapter tables, slot-indexed apply.
+
+Where the reference hands LoRA to its engines (vLLM owns the math;
+components/src/dynamo/vllm/main.py:712 load/unload endpoints), this framework
+owns the model — so multi-adapter serving is designed for XLA:
+
+- All adapters live in STACKED tables ``A[name]: [N, L, H, r]`` /
+  ``B[name]: [N, L, r, out]`` allocated once at engine build with static
+  shapes. Hot-loading adapter ``i`` is a functional ``.at[i].set`` rebind
+  with unchanged shapes — zero recompiles; serving programs pick the new
+  tables up at their next dispatch (tables are jit arguments, never
+  constants).
+- Per-request adapter selection is a gather: slot ``b`` uses
+  ``A[ids[b]]``, so one decode batch mixes adapters freely (the S-LoRA /
+  punica idea, expressed as plain einsums XLA fuses).
+- id 0 is reserved as the no-adapter identity (zero tables), so base-model
+  requests cost two zero-matmuls instead of a data-dependent branch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime.logging import get_logger
+
+log = get_logger("lora")
+
+# projection output sizes by target name, resolved from the model config
+_TARGET_OUT = {
+    "wq": lambda cfg: cfg.q_size,
+    "wk": lambda cfg: cfg.kv_size,
+    "wv": lambda cfg: cfg.kv_size,
+    "wo": lambda cfg: cfg.hidden_size,
+    "w_gate": lambda cfg: cfg.intermediate_size,
+    "w_up": lambda cfg: cfg.intermediate_size,
+    "w_down": lambda cfg: cfg.hidden_size,
+}
+_TARGET_IN = {
+    "wq": lambda cfg: cfg.hidden_size,
+    "wk": lambda cfg: cfg.hidden_size,
+    "wv": lambda cfg: cfg.hidden_size,
+    "wo": lambda cfg: cfg.q_size,
+    "w_gate": lambda cfg: cfg.hidden_size,
+    "w_up": lambda cfg: cfg.hidden_size,
+    "w_down": lambda cfg: cfg.intermediate_size,
+}
+
+
+class LoraAdapterTable:
+    """N-slot adapter store + name registry. Slot 0 = identity (no adapter)."""
+
+    def __init__(
+        self,
+        model_cfg,
+        max_adapters: int = 8,
+        rank: int = 16,
+        targets: Sequence[str] = ("wq", "wk", "wv", "wo"),
+        dtype=jnp.bfloat16,
+    ):
+        for t in targets:
+            if t not in _TARGET_OUT:
+                raise ValueError(f"unknown LoRA target {t!r}")
+        self.cfg = model_cfg
+        self.max_adapters = max_adapters
+        self.rank = rank
+        self.targets = tuple(targets)
+        self.dtype = dtype
+        N, L, r = max_adapters + 1, model_cfg.num_layers, rank
+        self.A: Dict[str, jax.Array] = {}
+        self.B: Dict[str, jax.Array] = {}
+        for t in targets:
+            self.A[t] = jnp.zeros((N, L, _TARGET_IN[t](model_cfg), r), dtype)
+            self.B[t] = jnp.zeros((N, L, r, _TARGET_OUT[t](model_cfg)), dtype)
+        self.scales = jnp.zeros((N,), jnp.float32)
+        self._names: List[Optional[str]] = [None] * N  # slot -> adapter name
+        self._lock = threading.Lock()
+
+    # -- registry ------------------------------------------------------------
+    def slot_of(self, name: Optional[str]) -> int:
+        """Adapter slot for a name; 0 (identity) when absent/None."""
+        if not name:
+            return 0
+        with self._lock:
+            try:
+                return self._names.index(name)
+            except ValueError:
+                return 0
+
+    def list_adapters(self) -> List[str]:
+        with self._lock:
+            return [n for n in self._names[1:] if n]
+
+    # -- lifecycle -----------------------------------------------------------
+    def load(
+        self,
+        name: str,
+        weights: Dict[str, np.ndarray],
+        alpha: Optional[float] = None,
+    ) -> int:
+        """Install adapter weights into a free slot (in-place device update —
+        serving programs keep running). ``weights`` maps
+        ``"<target>.A"``/``"<target>.B"`` to per-layer stacks [L, in, r] /
+        [L, r, out]. Returns the slot id."""
+        with self._lock:
+            if name in self._names:
+                slot = self._names.index(name)
+            else:
+                try:
+                    slot = self._names.index(None, 1)
+                except ValueError:
+                    raise RuntimeError(
+                        f"no free adapter slots (max {self.max_adapters})"
+                    ) from None
+            self._names[slot] = name
+        r_eff = self.rank
+        for t in self.targets:
+            a = weights.get(f"{t}.A")
+            b = weights.get(f"{t}.B")
+            if a is None or b is None:
+                # target absent in this adapter: identity (zeros)
+                a = np.zeros(self.A[t].shape[1:], np.float32)
+                b = np.zeros(self.B[t].shape[1:], np.float32)
+            r_eff = a.shape[-1]
+            a, b = self._fit_rank(np.asarray(a), np.asarray(b))
+            self.A[t] = self.A[t].at[slot].set(jnp.asarray(a, self.dtype))
+            self.B[t] = self.B[t].at[slot].set(jnp.asarray(b, self.dtype))
+        scale = (alpha if alpha is not None else float(r_eff)) / float(r_eff)
+        self.scales = self.scales.at[slot].set(scale)
+        log.info("lora adapter %r loaded into slot %d (scale %.3f)", name, slot, scale)
+        return slot
+
+    def _fit_rank(self, a: np.ndarray, b: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Pad (or reject) adapter rank into the static table rank."""
+        r = a.shape[-1]
+        if r > self.rank:
+            raise ValueError(f"adapter rank {r} exceeds table rank {self.rank}")
+        if r < self.rank:
+            pad_a = np.zeros((*a.shape[:-1], self.rank - r), a.dtype)
+            a = np.concatenate([a, pad_a], axis=-1)
+            pad_b = np.zeros((*b.shape[:-2], self.rank - r, b.shape[-1]), b.dtype)
+            b = np.concatenate([b, pad_b], axis=-2)
+        return a, b
+
+    def unload(self, name: str) -> bool:
+        with self._lock:
+            if name not in self._names:
+                return False
+            slot = self._names.index(name)
+            self._names[slot] = None
+        for t in self.targets:
+            self.A[t] = self.A[t].at[slot].set(0.0)
+            self.B[t] = self.B[t].at[slot].set(0.0)
+        self.scales = self.scales.at[slot].set(0.0)
+        log.info("lora adapter %r unloaded from slot %d", name, slot)
+        return True
+
+    # -- program inputs ------------------------------------------------------
+    def tables(self) -> Dict[str, jax.Array]:
+        """Flat dict handed into the jitted programs as arguments (never
+        closure constants — tables mutate across loads)."""
+        out: Dict[str, jax.Array] = {"scales": self.scales}
+        for t in self.targets:
+            out[f"{t}.A"] = self.A[t]
+            out[f"{t}.B"] = self.B[t]
+        return out
+
+
+def make_lora_fn(tables: Dict[str, jax.Array], adapter_ids: jax.Array):
+    """``lora(name, layer_idx, x) -> delta`` for llama.forward.
+
+    adapter_ids: [B] int32 for batched decode ([B, S, H] activations) or a
+    scalar for single-sequence prefill ([S, H] activations)."""
+    scales = tables["scales"]
+
+    def lora(name: str, layer_idx: int, x: jax.Array) -> Optional[jax.Array]:
+        a_key, b_key = f"{name}.A", f"{name}.B"
+        if a_key not in tables:
+            return None
+        A = tables[a_key][:, layer_idx]   # [N, in, r]
+        Bm = tables[b_key][:, layer_idx]  # [N, r, out]
+        if x.ndim == 2:  # prefill: [S, H], one adapter
+            s = scales[adapter_ids]
+            xa = x @ A[adapter_ids]
+            return ((xa @ Bm[adapter_ids]) * s).astype(x.dtype)
+        # decode: [B, S, H], per-slot adapters
+        Aslot = A[adapter_ids]            # [B, in, r]
+        Bslot = Bm[adapter_ids]           # [B, r, out]
+        s = scales[adapter_ids][:, None, None]
+        xa = jnp.einsum("bsh,bhr->bsr", x, Aslot)
+        return (jnp.einsum("bsr,bro->bso", xa, Bslot) * s).astype(x.dtype)
+
+    return lora

@@ -50,6 +50,9 @@ class MockEngineArgs:
     # (annotations["sim_ts"]) so benchmarks measure TTFT/ITL in simulated
     # time, immune to host asyncio jitter amplified by speedup_ratio
     emit_sim_ts: bool = False
+    # measured timing grid (.npz from the profiler) replacing the linear
+    # constants above — mocker/perf_model.py, reference perf_model.rs
+    perf_model_path: Optional[str] = None
     dp_size: int = 1
     startup_time_s: float = 0.0
     # timing model: per-iteration costs (seconds)
@@ -188,6 +191,9 @@ class MockerEngine:
         metrics_publisher: Optional[WorkerMetricsPublisher] = None,
     ):
         self.args = args or MockEngineArgs()
+        from .perf_model import load_perf_model
+
+        self.perf = load_perf_model(self.args.perf_model_path, self.args)
         self.kv = KvBlockState(self.args)
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
@@ -324,7 +330,7 @@ class MockerEngine:
                     continue
                 st.prefill_remaining -= chunk
                 prefill_budget -= chunk
-                duration += self.args.prefill_base_s + self.args.prefill_per_token_s * chunk
+                duration += self.perf.prefill_time(chunk)
                 if st.prefill_remaining == 0:
                     # first token arrives with prefill completion
                     self._emit_token(st)
@@ -337,7 +343,11 @@ class MockerEngine:
             if st.done:
                 finished.append(st)
 
-        duration += self.args.decode_base_s + self.args.decode_per_kv_block_s * decode_kv_blocks
+        n_decoding = sum(
+            1 for st in self._running
+            if st.prefill_remaining == 0 and not st.done
+        )
+        duration += self.perf.decode_time(n_decoding, decode_kv_blocks)
 
         for st in finished:
             self._running.remove(st)

@@ -1,0 +1,117 @@
+"""Mocker timing models: polynomial (linear) and NPZ-grid interpolation.
+
+Analog of the reference's mocker perf model (lib/mocker/src/perf_model.rs):
+two interchangeable timing sources for the simulated engine clock —
+
+1. ``PolynomialPerfModel``: the MockEngineArgs linear constants (default,
+   what ``profiler.sweep.calibrate_mocker_args`` fits from measurements);
+2. ``InterpolatedPerfModel``: grids measured by the profiler, loaded from an
+   ``.npz`` — 1-D linear interpolation over ISL for prefill, bilinear over
+   (active_seqs, kv_blocks) for decode — so the simulator reproduces a real
+   engine's measured timing surface, not a fitted line.
+
+NPZ schema (all float64):
+    prefill_isl [N], prefill_s [N]                 # chunk latency by length
+    decode_seqs [A], decode_blocks [B], decode_s [A, B]   # step latency grid
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class PolynomialPerfModel:
+    """Linear-coefficient model (perf_model.rs "Polynomial" analog)."""
+
+    def __init__(self, prefill_base_s: float, prefill_per_token_s: float,
+                 decode_base_s: float, decode_per_kv_block_s: float):
+        self.prefill_base_s = prefill_base_s
+        self.prefill_per_token_s = prefill_per_token_s
+        self.decode_base_s = decode_base_s
+        self.decode_per_kv_block_s = decode_per_kv_block_s
+
+    @classmethod
+    def from_args(cls, args) -> "PolynomialPerfModel":
+        return cls(args.prefill_base_s, args.prefill_per_token_s,
+                   args.decode_base_s, args.decode_per_kv_block_s)
+
+    def prefill_time(self, chunk_tokens: int) -> float:
+        return self.prefill_base_s + self.prefill_per_token_s * chunk_tokens
+
+    def decode_time(self, active_seqs: int, kv_blocks: int) -> float:
+        # matches the historical step formula exactly: the base is charged
+        # per iteration (covers dispatch overhead even in prefill-only steps)
+        return self.decode_base_s + self.decode_per_kv_block_s * kv_blocks
+
+
+class InterpolatedPerfModel:
+    """Measured-grid model (perf_model.rs "Interpolated" analog)."""
+
+    def __init__(self, prefill_isl: np.ndarray, prefill_s: np.ndarray,
+                 decode_seqs: np.ndarray, decode_blocks: np.ndarray,
+                 decode_s: np.ndarray):
+        order = np.argsort(prefill_isl)
+        self.prefill_isl = np.asarray(prefill_isl, np.float64)[order]
+        self.prefill_s = np.asarray(prefill_s, np.float64)[order]
+        self.decode_seqs = np.asarray(decode_seqs, np.float64)
+        self.decode_blocks = np.asarray(decode_blocks, np.float64)
+        self.decode_s = np.asarray(decode_s, np.float64)
+        if self.decode_s.shape != (len(self.decode_seqs), len(self.decode_blocks)):
+            raise ValueError(
+                f"decode grid {self.decode_s.shape} != "
+                f"({len(self.decode_seqs)}, {len(self.decode_blocks)})"
+            )
+
+    # -- io -------------------------------------------------------------------
+    @classmethod
+    def load(cls, path: str) -> "InterpolatedPerfModel":
+        with np.load(path, allow_pickle=False) as z:
+            return cls(z["prefill_isl"], z["prefill_s"],
+                       z["decode_seqs"], z["decode_blocks"], z["decode_s"])
+
+    def save(self, path: str) -> None:
+        np.savez(path, prefill_isl=self.prefill_isl, prefill_s=self.prefill_s,
+                 decode_seqs=self.decode_seqs, decode_blocks=self.decode_blocks,
+                 decode_s=self.decode_s)
+
+    # -- queries --------------------------------------------------------------
+    def prefill_time(self, chunk_tokens: int) -> float:
+        # clamped linear interpolation (np.interp clamps at the edges)
+        return float(np.interp(chunk_tokens, self.prefill_isl, self.prefill_s))
+
+    def decode_time(self, active_seqs: int, kv_blocks: int) -> float:
+        if active_seqs <= 0:
+            return 0.0
+        a = float(np.clip(active_seqs, self.decode_seqs[0], self.decode_seqs[-1]))
+        b = float(np.clip(kv_blocks, self.decode_blocks[0], self.decode_blocks[-1]))
+        # bilinear over the (seqs, blocks) grid
+        i = int(np.searchsorted(self.decode_seqs, a, side="right") - 1)
+        j = int(np.searchsorted(self.decode_blocks, b, side="right") - 1)
+        i = min(i, len(self.decode_seqs) - 2) if len(self.decode_seqs) > 1 else 0
+        j = min(j, len(self.decode_blocks) - 2) if len(self.decode_blocks) > 1 else 0
+        if len(self.decode_seqs) == 1 and len(self.decode_blocks) == 1:
+            return float(self.decode_s[0, 0])
+        if len(self.decode_seqs) == 1:
+            return float(np.interp(b, self.decode_blocks, self.decode_s[0]))
+        if len(self.decode_blocks) == 1:
+            return float(np.interp(a, self.decode_seqs, self.decode_s[:, 0]))
+        a0, a1 = self.decode_seqs[i], self.decode_seqs[i + 1]
+        b0, b1 = self.decode_blocks[j], self.decode_blocks[j + 1]
+        ta = (a - a0) / (a1 - a0) if a1 > a0 else 0.0
+        tb = (b - b0) / (b1 - b0) if b1 > b0 else 0.0
+        z = self.decode_s
+        return float(
+            z[i, j] * (1 - ta) * (1 - tb)
+            + z[i + 1, j] * ta * (1 - tb)
+            + z[i, j + 1] * (1 - ta) * tb
+            + z[i + 1, j + 1] * ta * tb
+        )
+
+
+def load_perf_model(path: Optional[str], args) -> object:
+    """NPZ path -> InterpolatedPerfModel; None -> the args' linear model."""
+    if path:
+        return InterpolatedPerfModel.load(path)
+    return PolynomialPerfModel.from_args(args)

@@ -177,12 +177,21 @@ def layer_forward(
     sin: jax.Array,
     attend: AttendFn,
     layer_idx: int,
+    lora: Optional[Callable] = None,
 ) -> jax.Array:
+    # optional batched LoRA (lora/adapters.py make_lora_fn): delta added to
+    # a projection's output; returns None for targets without adapters
+    def _lora(name: str, inp: jax.Array, out: jax.Array) -> jax.Array:
+        if lora is None:
+            return out
+        delta = lora(name, layer_idx, inp)
+        return out if delta is None else out + delta
+
     # attention
     h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-    q = h @ p["wq"]
-    k = h @ p["wk"]
-    v = h @ p["wv"]
+    q = _lora("wq", h, h @ p["wq"])
+    k = _lora("wk", h, h @ p["wk"])
+    v = _lora("wv", h, h @ p["wv"])
     if cfg.qkv_bias:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
     new_shape = h.shape[:-1]
@@ -196,12 +205,15 @@ def layer_forward(
     k = apply_rope(k, cos, sin)
     attn_out = attend(q, k, v, layer_idx)
     attn_out = attn_out.reshape(*new_shape, cfg.q_size)
-    x = x + attn_out @ p["wo"]
+    x = x + _lora("wo", attn_out, attn_out @ p["wo"])
     # mlp
     h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-    up = h @ p["w_up"]
-    x = x + (gate * up) @ p["w_down"]
+    gate = jax.nn.silu(
+        (_lora("w_gate", h, h @ p["w_gate"])).astype(jnp.float32)
+    ).astype(x.dtype)
+    up = _lora("w_up", h, h @ p["w_up"])
+    gu = gate * up
+    x = x + _lora("w_down", gu, gu @ p["w_down"])
     return x
 
 
@@ -211,13 +223,14 @@ def forward(
     token_ids: jax.Array,        # [..., S] int32
     positions: jax.Array,        # [..., S] int32
     attend: AttendFn,
+    lora: Optional[Callable] = None,
 ) -> jax.Array:
     """Full stack -> final hidden states [..., S, hidden] (pre-lm_head)."""
     x = params["embed"][token_ids]
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     cos, sin = cos[..., None, :], sin[..., None, :]  # broadcast over heads
     for i, layer in enumerate(params["layers"]):
-        x = layer_forward(layer, cfg, x, cos, sin, attend, i)
+        x = layer_forward(layer, cfg, x, cos, sin, attend, i, lora=lora)
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
 
 

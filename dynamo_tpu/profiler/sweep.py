@@ -144,6 +144,38 @@ async def profile_engine(
     return result
 
 
+def profile_to_npz(profile: ProfileResult, path: str, block_size: int = 16):
+    """Export a measured profile as the mocker's interpolated timing grid
+    (mocker/perf_model.py NPZ schema; reference perf_model.rs loads the
+    profiler's NPZ the same way).
+
+    prefill: (isl, rate) points -> chunk latency curve. decode: the sweep
+    measures aggregate rate per concurrency; each concurrency's step time
+    becomes one grid row, with the kv-blocks axis anchored at the sweep's
+    mean context (a single column — bilinear degrades to 1-D cleanly)."""
+    import numpy as np
+
+    from ..mocker.perf_model import InterpolatedPerfModel
+
+    isl = np.array([p[0] for p in profile.prefill_points], np.float64)
+    pre_s = isl / np.maximum(
+        np.array([p[1] for p in profile.prefill_points], np.float64), 1e-9
+    )
+    seqs = np.array([p[0] for p in profile.decode_points], np.float64)
+    step_s = seqs / np.maximum(
+        np.array([p[1] for p in profile.decode_points], np.float64), 1e-9
+    )
+    ctx = profile.meta.get("decode_isl", 0) + profile.meta.get("osl", 64) / 2
+    blocks = np.array([max(1.0, ctx / block_size)], np.float64)
+    model = InterpolatedPerfModel(
+        prefill_isl=isl, prefill_s=pre_s,
+        decode_seqs=seqs, decode_blocks=blocks,
+        decode_s=step_s[:, None],
+    )
+    model.save(path)
+    return model
+
+
 def calibrate_mocker_args(profile: ProfileResult, args=None):
     """Fit the mocker's linear timing model to a measured profile
     (perf_model.rs analog: the simulator reproduces real timing).

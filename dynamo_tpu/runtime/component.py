@@ -123,7 +123,12 @@ class Endpoint:
         """Start a request-plane server for ``handler`` and register it."""
         rt = self.runtime
         iid = instance_id if instance_id is not None else new_instance_id()
-        server = TcpRequestServer(handler, host=rt.config.host_ip)
+        if getattr(rt.config, "request_plane", "tcp") == "http":
+            from .request_plane.http import HttpRequestServer
+
+            server = HttpRequestServer(handler, host=rt.config.host_ip)
+        else:
+            server = TcpRequestServer(handler, host=rt.config.host_ip)
         address = await server.start()
         inst = Instance(
             instance_id=iid,
@@ -200,7 +205,7 @@ class Client:
         self._rr_index = 0
         self._watcher: Optional[Watcher] = None
         self._watch_task: Optional[asyncio.Task] = None
-        self._tcp = endpoint.runtime.tcp_client
+        self._rt = endpoint.runtime
         self._instances_event = asyncio.Event()
         self.kv_selector: Optional[KvSelector] = None
 
@@ -273,7 +278,9 @@ class Client:
         if self.router_mode == RouterMode.KV and instance_id is None and self.kv_selector:
             instance_id = await self.kv_selector(request, list(self.instances.values()))
         inst = self._select(request, instance_id)
-        return await self._tcp.call(inst.address, request, context)
+        return await self._rt.plane_client(inst.address).call(
+            inst.address, request, context
+        )
 
     async def stop(self) -> None:
         if self._watcher is not None:
@@ -289,6 +296,12 @@ class DistributedRuntimeBase:
     tcp_client: TcpClient
     lease_id: Optional[str]
     config: Any
+
+    def plane_client(self, address: str):
+        """Transport by address scheme: http(s):// -> HTTP plane, else TCP."""
+        if address.startswith("http"):
+            return self.http_client
+        return self.tcp_client
 
     def namespace(self, name: str) -> Namespace:
         return Namespace(self, name)

@@ -37,7 +37,17 @@ ENV_KVBM_DISK_CACHE_GB = "DTPU_KVBM_DISK_CACHE_GB"    # G3 local disk pool size
 ENV_KVBM_DISK_PATH = "DTPU_KVBM_DISK_PATH"
 ENV_HTTP_PORT = "DTPU_HTTP_PORT"
 ENV_BUSY_THRESHOLD = "DTPU_BUSY_THRESHOLD"
-ENV_AUDIT_SINKS = "DTPU_AUDIT_SINKS"
+# observability (runtime/tracing.py, llm/audit.py)
+ENV_AUDIT_SINKS = "DTPU_AUDIT_SINKS"                  # stderr,jsonl:<path>,event
+ENV_AUDIT_FORCE_LOGGING = "DTPU_AUDIT_FORCE_LOGGING"  # audit every request
+ENV_AUDIT_SUBJECT = "DTPU_AUDIT_SUBJECT"              # event-plane audit topic
+ENV_OTLP_ENDPOINT = "DTPU_OTLP_ENDPOINT"              # OTLP/HTTP collector
+ENV_TRACE_JSONL = "DTPU_TRACE_JSONL"                  # span JSONL file
+# lora (lora/cache.py)
+ENV_LORA_CACHE = "DTPU_LORA_CACHE"                    # adapter cache dir
+# kvbm remote tier (kvbm/remote.py)
+ENV_KVBM_REMOTE = "DTPU_KVBM_REMOTE"                  # G4 block store host:port
+ENV_CONFIG_FILE = "DTPU_CONFIG"                       # layered config file (json/toml)
 
 _TRUTHY = {"1", "true", "yes", "on", "enabled"}
 _FALSEY = {"0", "false", "no", "off", "disabled", ""}
@@ -102,16 +112,40 @@ class RuntimeConfig:
 
     @classmethod
     def from_env(cls, **overrides: Any) -> "RuntimeConfig":
+        """Layered resolution (figment analog, lib/runtime/src/config.rs):
+        defaults < config file (DTPU_CONFIG, json/toml) < env < kwargs."""
+        base: Dict[str, Any] = {}
+        cfg_file = os.environ.get(ENV_CONFIG_FILE)
+        if cfg_file:
+            base.update(load_config_file(cfg_file))
+        def layered(field: str, env_name: str, conv) -> Any:
+            default = getattr(cls, field)
+            if field in base:
+                # file values get the same coercion as env values (a JSON
+                # string "9100" for a port must not flow through as str)
+                try:
+                    default = conv(base[field])
+                except (TypeError, ValueError):
+                    pass
+            raw = os.environ.get(env_name)
+            if raw is None or raw == "":
+                return default
+            try:
+                return conv(raw)
+            except (TypeError, ValueError):
+                return default
+
         cfg = cls(
-            request_plane=env_str(ENV_REQUEST_PLANE, cls.request_plane),
-            event_plane=env_str(ENV_EVENT_PLANE, cls.event_plane),
-            store=env_str(ENV_STORE, cls.store),
-            store_path=env_str(ENV_STORE_PATH, cls.store_path),
-            host_ip=env_str(ENV_HOST_IP, cls.host_ip),
-            system_port=env_int(ENV_SYSTEM_PORT, cls.system_port),
-            lease_ttl_s=env_float(ENV_LEASE_TTL_S, cls.lease_ttl_s),
-            graceful_shutdown_timeout_s=env_float(
-                ENV_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT, cls.graceful_shutdown_timeout_s
+            request_plane=layered("request_plane", ENV_REQUEST_PLANE, str),
+            event_plane=layered("event_plane", ENV_EVENT_PLANE, str),
+            store=layered("store", ENV_STORE, str),
+            store_path=layered("store_path", ENV_STORE_PATH, str),
+            host_ip=layered("host_ip", ENV_HOST_IP, str),
+            system_port=layered("system_port", ENV_SYSTEM_PORT, int),
+            lease_ttl_s=layered("lease_ttl_s", ENV_LEASE_TTL_S, float),
+            graceful_shutdown_timeout_s=layered(
+                "graceful_shutdown_timeout_s",
+                ENV_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT, float,
             ),
         )
         for k, v in overrides.items():
@@ -121,3 +155,16 @@ class RuntimeConfig:
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
+
+
+def load_config_file(path: str) -> Dict[str, Any]:
+    """json or toml (stdlib tomllib); unknown keys are ignored by callers."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if path.endswith(".toml"):
+        import tomllib
+
+        return tomllib.loads(raw.decode())
+    import json
+
+    return json.loads(raw.decode())

@@ -390,4 +390,10 @@ def make_store(kind: str, path: str = "/tmp/dtpu_store") -> KVStore:
         return MemKVStore()
     if kind == "file":
         return FileKVStore(path)
-    raise ValueError(f"unknown store kind: {kind!r} (expected mem|file)")
+    if kind == "tcp":
+        # networked store service (etcd-analog; push watch, shared leases):
+        # path is HOST:PORT of a `python -m dynamo_tpu.runtime.discovery.netstore`
+        from .netstore import TcpKVStore
+
+        return TcpKVStore(path)
+    raise ValueError(f"unknown store kind: {kind!r} (expected mem|file|tcp)")

@@ -83,14 +83,26 @@ class EndpointCanary:
         self._fails: Dict[str, int] = {}
         self._down: set = set()
         self._client = TcpClient()
+        self._http_client = None  # lazy, for http:// request-plane addresses
         self._task: Optional[asyncio.Task] = None
         for name in self.targets:
             self.state.set(name, True, "not probed yet")
 
+    def _client_for(self, address: str):
+        if address.startswith("http"):
+            if self._http_client is None:
+                from .request_plane.http import HttpClient
+
+                self._http_client = HttpClient()
+            return self._http_client
+        return self._client
+
     async def probe_once(self) -> None:
         for name, address in list(self.targets.items()):
             try:
-                rtt = await self._client.ping(address, timeout=self.timeout_s)
+                rtt = await self._client_for(address).ping(
+                    address, timeout=self.timeout_s
+                )
                 self.last_rtt[name] = rtt
                 self._fails[name] = 0
                 self._down.discard(name)

@@ -1,0 +1,216 @@
+"""HTTP request plane: the reference's HTTP/2 transport alternative.
+
+Analog of lib/runtime's pluggable request plane (the reference offers NATS,
+TCP and an HTTP/2 gRPC-like plane; SURVEY §2.6). Same streaming-RPC contract
+as request_plane/tcp.py — one POST per request, the response streamed as
+``u32 length || msgpack`` frames over chunked transfer encoding:
+
+    POST /rpc          body: msgpack request           -> frame stream
+    POST /cancel/{id}                                  -> {"ok": true}
+    GET  /ping                                          -> {"ok": true}
+
+Request ids ride the ``x-dtpu-request-id`` header so cancel is addressable
+mid-stream from a second connection (HTTP has no in-band reverse channel).
+Addresses are ``http://host:port``; the component layer picks this plane by
+scheme (component.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional
+
+import msgpack
+from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
+from aiohttp.client_exceptions import ClientConnectorError, ClientError
+
+from ..engine import Context
+from ..logging import get_logger
+from .tcp import Handler, NoResponders, RequestPlaneError
+
+log = get_logger("runtime.http_plane")
+
+_LEN = struct.Struct(">I")
+
+REQUEST_ID_HEADER = "x-dtpu-request-id"
+
+
+def _frame(obj: Dict[str, Any]) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+class HttpRequestServer:
+    """Same surface as TcpRequestServer (start/stop/address/inflight)."""
+
+    def __init__(self, handler: Handler, host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._host = host
+        self._port = port
+        self._inflight: Dict[str, Context] = {}
+        self._runner: Optional[web.AppRunner] = None
+
+    @property
+    def address(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    async def start(self) -> str:
+        app = web.Application(client_max_size=512 * 1024 * 1024)
+        app.router.add_post("/rpc", self._rpc)
+        app.router.add_post("/cancel/{rid}", self._cancel)
+        app.router.add_get("/ping", self._ping)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.debug("http request server listening on %s", self.address)
+        return self.address
+
+    async def stop(self, graceful_timeout_s: float = 5.0) -> None:
+        deadline = asyncio.get_event_loop().time() + graceful_timeout_s
+        while self._inflight and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        for ctx in self._inflight.values():
+            ctx.kill()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _ping(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def _cancel(self, request: web.Request) -> web.Response:
+        ctx = self._inflight.get(request.match_info["rid"])
+        if ctx is not None:
+            ctx.stop_generating()
+        return web.json_response({"ok": ctx is not None})
+
+    async def _rpc(self, request: web.Request) -> web.StreamResponse:
+        rid = request.headers.get(REQUEST_ID_HEADER) or uuid.uuid4().hex
+        body = msgpack.unpackb(await request.read(), raw=False)
+        ctx = Context(rid)
+        self._inflight[rid] = ctx
+        resp = web.StreamResponse(headers={"Content-Type": "application/x-dtpu-frames"})
+        await resp.prepare(request)
+        try:
+            async for item in self._handler(body, ctx):
+                if ctx.is_killed():
+                    break
+                await resp.write(_frame({"t": "item", "body": item}))
+            await resp.write(_frame({"t": "end"}))
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()
+        except Exception as e:
+            log.exception("handler error for request %s", rid[:8])
+            try:
+                await resp.write(_frame({
+                    "t": "err", "error": str(e),
+                    "code": getattr(e, "code", "internal"),
+                }))
+            except ConnectionResetError:
+                pass
+        finally:
+            self._inflight.pop(rid, None)
+        try:
+            await resp.write_eof()
+        except ConnectionResetError:
+            pass
+        return resp
+
+
+class HttpClient:
+    """Same surface as TcpClient (call/ping/close); pooled sessions."""
+
+    def __init__(self):
+        self._session: Optional[ClientSession] = None
+
+    def _sess(self) -> ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = ClientSession(
+                connector=TCPConnector(limit=0),
+                timeout=ClientTimeout(total=None, connect=5.0),
+            )
+        return self._session
+
+    async def call(
+        self, address: str, request: Any, context: Optional[Context] = None
+    ) -> AsyncIterator[Any]:
+        ctx = context or Context()
+        rid = uuid.uuid4().hex
+        sess = self._sess()
+        try:
+            resp = await sess.post(
+                address.rstrip("/") + "/rpc",
+                data=msgpack.packb(request, use_bin_type=True),
+                headers={REQUEST_ID_HEADER: rid},
+            )
+        except (ClientConnectorError, OSError) as e:
+            raise NoResponders(f"connect {address}: {e}") from e
+
+        def on_cancel() -> None:
+            asyncio.ensure_future(self._send_cancel(address, rid))
+
+        ctx.on_cancel(on_cancel)
+
+        async def stream() -> AsyncIterator[Any]:
+            buf = b""
+            try:
+                async for chunk in resp.content.iter_any():
+                    buf += chunk
+                    while len(buf) >= _LEN.size:
+                        (n,) = _LEN.unpack(buf[:_LEN.size])
+                        if len(buf) < _LEN.size + n:
+                            break
+                        msg = msgpack.unpackb(buf[_LEN.size:_LEN.size + n], raw=False)
+                        buf = buf[_LEN.size + n:]
+                        t = msg.get("t")
+                        if t == "item":
+                            yield msg.get("body")
+                        elif t == "end":
+                            return
+                        elif t == "err":
+                            code = msg.get("code", "internal")
+                            if code == "no_responders":
+                                raise NoResponders(msg.get("error", ""))
+                            raise RequestPlaneError(msg.get("error", ""), code)
+                # server closed without an end frame: treat as gone
+                raise NoResponders(f"{address}: stream ended prematurely")
+            except (ClientError, ConnectionResetError) as e:
+                raise NoResponders(f"{address}: {e}") from e
+            finally:
+                resp.close()
+
+        return stream()
+
+    async def _send_cancel(self, address: str, rid: str) -> None:
+        try:
+            async with self._sess().post(
+                address.rstrip("/") + f"/cancel/{rid}"
+            ) as r:
+                await r.read()
+        except (ClientError, OSError):
+            pass
+
+    async def ping(self, address: str, timeout: float = 2.0) -> float:
+        t0 = asyncio.get_running_loop().time()
+        try:
+            async with self._sess().get(
+                address.rstrip("/") + "/ping",
+                timeout=ClientTimeout(total=timeout),
+            ) as r:
+                if r.status != 200:
+                    raise NoResponders(f"{address}: ping {r.status}")
+                await r.read()
+        except (ClientError, OSError, asyncio.TimeoutError) as e:
+            raise NoResponders(f"ping {address}: {e}") from e
+        return asyncio.get_running_loop().time() - t0
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

@@ -233,10 +233,17 @@ class Tracer:
 
     @classmethod
     def from_env(cls, service_name: str = "dynamo_tpu") -> "Tracer":
-        """DYN_OTLP_ENDPOINT -> OTLP/HTTP; DYN_TRACE_JSONL -> file; else
-        tracing is a no-op (spans still propagate context)."""
-        endpoint = os.environ.get("DYN_OTLP_ENDPOINT", "")
-        jsonl = os.environ.get("DYN_TRACE_JSONL", "")
+        """DTPU_OTLP_ENDPOINT -> OTLP/HTTP; DTPU_TRACE_JSONL -> file; else
+        tracing is a no-op (spans still propagate context). The DYN_-prefixed
+        spellings are accepted as aliases (the reference's catalog prefix)."""
+        from .config import ENV_OTLP_ENDPOINT, ENV_TRACE_JSONL
+
+        endpoint = (
+            os.environ.get(ENV_OTLP_ENDPOINT) or os.environ.get("DYN_OTLP_ENDPOINT", "")
+        )
+        jsonl = (
+            os.environ.get(ENV_TRACE_JSONL) or os.environ.get("DYN_TRACE_JSONL", "")
+        )
         if endpoint:
             return cls(OtlpHttpExporter(endpoint, service_name), service_name)
         if jsonl:

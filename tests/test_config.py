@@ -1,0 +1,61 @@
+"""Layered config resolution (runtime/config.py): defaults < file < env <
+kwargs — the figment analog (reference lib/runtime/src/config.rs)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.runtime.config import (
+    ENV_CONFIG_FILE,
+    ENV_STORE,
+    RuntimeConfig,
+    is_truthy,
+    load_config_file,
+)
+
+
+def test_defaults():
+    cfg = RuntimeConfig.from_env()
+    assert cfg.store == "mem"
+    assert cfg.request_plane == "tcp"
+
+
+def test_file_then_env_then_kwargs(tmp_path, monkeypatch):
+    f = tmp_path / "dtpu.json"
+    f.write_text(json.dumps({
+        "store": "file", "store_path": "/from/file", "lease_ttl_s": 3.5,
+    }))
+    monkeypatch.setenv(ENV_CONFIG_FILE, str(f))
+    cfg = RuntimeConfig.from_env()
+    assert cfg.store == "file"
+    assert cfg.store_path == "/from/file"
+    assert cfg.lease_ttl_s == 3.5
+
+    # env outranks the file
+    monkeypatch.setenv(ENV_STORE, "tcp")
+    cfg = RuntimeConfig.from_env()
+    assert cfg.store == "tcp"
+    assert cfg.store_path == "/from/file"
+
+    # explicit kwargs outrank everything
+    cfg = RuntimeConfig.from_env(store="mem")
+    assert cfg.store == "mem"
+
+
+def test_toml_config(tmp_path, monkeypatch):
+    f = tmp_path / "dtpu.toml"
+    f.write_text('store = "file"\nlease_ttl_s = 7.0\n')
+    monkeypatch.setenv(ENV_CONFIG_FILE, str(f))
+    cfg = RuntimeConfig.from_env()
+    assert cfg.store == "file" and cfg.lease_ttl_s == 7.0
+    assert load_config_file(str(f))["store"] == "file"
+
+
+def test_bad_env_value_falls_back(monkeypatch):
+    monkeypatch.setenv("DTPU_SYSTEM_PORT", "not-a-number")
+    assert RuntimeConfig.from_env().system_port == 0
+
+
+def test_truthy():
+    assert is_truthy("1") and is_truthy("True") and is_truthy("on")
+    assert not is_truthy("0") and not is_truthy(None) and not is_truthy("nope")

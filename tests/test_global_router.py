@@ -1,0 +1,141 @@
+"""Global router (dynamo_tpu/global_router/): SLA-grid pool selection +
+2-level forwarding over mocker pools.
+
+Reference analog: components/src/dynamo/global_router/{pool_selection,
+handler}.py.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.global_router import (
+    DecodePoolSelectionStrategy,
+    GlobalRouterConfig,
+    GlobalRouterHandler,
+    PrefillPoolSelectionStrategy,
+)
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+from dynamo_tpu.runtime.discovery.store import MemKVStore
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.event_plane.base import InProcEventPlane
+
+
+def test_grid_selection_math():
+    s = PrefillPoolSelectionStrategy(
+        ttft_min=0, ttft_max=100, ttft_resolution=2,
+        isl_min=0, isl_max=1000, isl_resolution=2,
+        prefill_pool_mapping=[[0, 1], [2, 3]],
+    )
+    assert s.select_pool(isl=100, ttft_target=10) == 0
+    assert s.select_pool(isl=100, ttft_target=90) == 1
+    assert s.select_pool(isl=900, ttft_target=10) == 2
+    assert s.select_pool(isl=900, ttft_target=90) == 3
+    # clamping outside the grid
+    assert s.select_pool(isl=10_000, ttft_target=10_000.0) == 3
+    assert s.select_pool(isl=-5, ttft_target=-5.0) == 0
+    # default target = midpoint
+    assert s.select_pool(isl=100) in (0, 1)
+
+    d = DecodePoolSelectionStrategy(
+        itl_min=0, itl_max=40, itl_resolution=2,
+        context_length_min=0, context_length_max=4096,
+        context_length_resolution=2,
+        decode_pool_mapping=[[0, 0], [1, 1]],
+    )
+    assert d.select_pool(context_length=100, itl_target=5) == 0
+    assert d.select_pool(context_length=4000, itl_target=5) == 1
+
+
+def test_config_from_obj():
+    cfg = GlobalRouterConfig.from_obj({
+        "prefill_pools": ["p0", {"namespace": "p1", "component": "be"}],
+        "decode_pools": ["d0"],
+        "decode_selection": {
+            "itl_min": 0, "itl_max": 40, "itl_resolution": 1,
+            "context_length_min": 0, "context_length_max": 4096,
+            "context_length_resolution": 1,
+            "decode_pool_mapping": [[0]],
+        },
+        "default_itl_ms": 20.0,
+    })
+    assert cfg.prefill_pools[1].namespace == "p1"
+    assert cfg.prefill_pools[1].component == "be"
+    assert cfg.decode_strategy.select_pool(10) == 0
+    assert cfg.prefill_strategy is None
+
+
+def _req(rid: str, isl: int, max_tokens: int = 4) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=list(range(isl)),
+        stop=StopConditions(max_tokens=max_tokens, min_tokens=max_tokens,
+                            ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+def test_two_level_forwarding_over_mocker_pools():
+    """Short-context requests land in pool 'fast', long-context in 'bulk' —
+    each pool a separate namespace with its own mocker worker."""
+    from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+
+    async def run():
+        store = MemKVStore()
+        plane = InProcEventPlane()
+        cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+
+        def rt():
+            return DistributedRuntime(cfg, store=store, event_plane=plane)
+
+        served_by: dict = {"fast": 0, "bulk": 0}
+        worker_rts = []
+        for ns in ("fast", "bulk"):
+            wrt = await rt().start()
+            worker_rts.append(wrt)
+            engine = MockerEngine(MockEngineArgs(speedup_ratio=100.0))
+
+            def make_handler(ns=ns, engine=engine):
+                async def handler(request, context):
+                    served_by[ns] += 1
+                    async for out in engine.generate(request, context):
+                        yield out.to_obj()
+                return handler
+
+            await (
+                wrt.namespace(ns).component("backend").endpoint("generate")
+                .serve(make_handler())
+            )
+
+        grt = await rt().start()
+        config = GlobalRouterConfig.from_obj({
+            "prefill_pools": [],
+            "decode_pools": ["fast", "bulk"],
+            "decode_selection": {
+                "itl_min": 0, "itl_max": 40, "itl_resolution": 1,
+                "context_length_min": 0, "context_length_max": 512,
+                "context_length_resolution": 2,
+                "decode_pool_mapping": [[0], [1]],
+            },
+        })
+        handler = GlobalRouterHandler(grt, config)
+        try:
+            # ctx < 256 -> pool 0 (fast); ctx >= 256 -> pool 1 (bulk)
+            for rid, isl in (("a", 32), ("b", 400), ("c", 64)):
+                toks = []
+                async for out in handler.generate(_req(rid, isl), Context(rid)):
+                    toks.extend(out.get("token_ids") or [])
+                assert len(toks) == 4
+            assert served_by == {"fast": 2, "bulk": 1}
+            assert handler.pool_counts == {"fast": 2, "bulk": 1}
+        finally:
+            await handler.stop()
+            for wrt in worker_rts:
+                await wrt.shutdown()
+            await grt.shutdown()
+
+    asyncio.run(run())

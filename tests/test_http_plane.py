@@ -1,0 +1,133 @@
+"""HTTP request plane (runtime/request_plane/http.py): the alternative
+transport behind the same streaming-RPC contract as TCP.
+
+Reference analog: the pluggable request plane (SURVEY §2.6 — NATS / TCP /
+HTTP/2 options)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.discovery.store import MemKVStore
+from dynamo_tpu.runtime.request_plane.http import HttpClient, HttpRequestServer
+from dynamo_tpu.runtime.request_plane.tcp import NoResponders
+
+
+async def _echo(request, context):
+    for i in range(request.get("n", 3)):
+        if context.is_stopped():
+            return
+        yield {"i": i, "x": request.get("x")}
+        await asyncio.sleep(0)
+
+
+def test_http_stream_roundtrip():
+    async def run():
+        server = HttpRequestServer(_echo, host="127.0.0.1")
+        addr = await server.start()
+        assert addr.startswith("http://")
+        client = HttpClient()
+        try:
+            items = [it async for it in await client.call(addr, {"n": 4, "x": "v"})]
+            assert items == [{"i": i, "x": "v"} for i in range(4)]
+            rtt = await client.ping(addr)
+            assert rtt < 2.0
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_http_handler_error_propagates():
+    async def boom(request, context):
+        yield {"ok": 1}
+        raise RuntimeError("kaput")
+
+    async def run():
+        server = HttpRequestServer(boom, host="127.0.0.1")
+        addr = await server.start()
+        client = HttpClient()
+        try:
+            stream = await client.call(addr, {})
+            got = [await stream.__anext__()]
+            with pytest.raises(Exception, match="kaput"):
+                await stream.__anext__()
+            assert got == [{"ok": 1}]
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_http_cancel_mid_stream():
+    started = asyncio.Event() if False else None
+
+    async def slow(request, context):
+        for i in range(1000):
+            if context.is_stopped():
+                return
+            yield {"i": i}
+            await asyncio.sleep(0.01)
+
+    async def run():
+        server = HttpRequestServer(slow, host="127.0.0.1")
+        addr = await server.start()
+        client = HttpClient()
+        ctx = Context("cancel-me")
+        try:
+            stream = await client.call(addr, {}, context=ctx)
+            first = await stream.__anext__()
+            assert first == {"i": 0}
+            ctx.stop_generating()
+            got = []
+            async for it in stream:
+                got.append(it)
+            # server observed the cancel and ended well before 1000 items
+            assert len(got) < 100
+            assert server.inflight == 0
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_http_no_responders():
+    async def run():
+        client = HttpClient()
+        try:
+            with pytest.raises(NoResponders):
+                await client.call("http://127.0.0.1:1", {})
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_runtime_served_over_http_plane():
+    """request_plane='http' end-to-end through Endpoint.serve + Client."""
+
+    async def run():
+        store = MemKVStore()
+        cfg = RuntimeConfig(store="mem", event_plane="inproc",
+                            request_plane="http", lease_ttl_s=2.0)
+        rt1 = await DistributedRuntime(cfg, store=store).start()
+        rt2 = await DistributedRuntime(cfg, store=store).start()
+        try:
+            served = await rt1.namespace("n").component("c").endpoint("e").serve(_echo)
+            assert served.instance.address.startswith("http://")
+            client = await rt2.namespace("n").component("c").endpoint("e").client()
+            await client.wait_for_instances(1, timeout=5.0)
+            out = [
+                it async for it in await client.generate({"n": 2, "x": 9}, context=Context())
+            ]
+            assert out == [{"i": 0, "x": 9}, {"i": 1, "x": 9}]
+        finally:
+            await rt1.shutdown()
+            await rt2.shutdown()
+
+    asyncio.run(run())

@@ -1,0 +1,158 @@
+"""G4 remote block tier (kvbm/remote.py) + priority offload queue
+(kvbm/pool.py OffloadQueue).
+
+Reference analogs: CacheLevel::G4 (lib/llm/src/block_manager.rs:63-77),
+OffloadManager priority queue (lib/llm/src/block_manager/offload.rs:4-34).
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.kvbm.pool import KvbmTiers, OffloadQueue
+from dynamo_tpu.kvbm.remote import RemoteBlockPool, RemoteBlockStoreServer
+
+
+def _block(seed: int, shape=(2, 2, 4, 2, 8)) -> np.ndarray:
+    return np.random.default_rng(seed).standard_normal(shape).astype(np.float32)
+
+
+class _ServerThread:
+    """Run the asyncio store server on its own loop so the client side can
+    use blocking sockets from the test thread (as the offload worker does)."""
+
+    def __init__(self, **kw):
+        self.kw = kw
+        self.address = None
+        self._loop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(5.0)
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self.server = RemoteBlockStoreServer(host="127.0.0.1", port=0, **self.kw)
+        self.address = self._loop.run_until_complete(self.server.start())
+        self._started.set()
+        self._loop.run_forever()
+
+    def stop(self):
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5.0)
+
+
+@pytest.fixture
+def server():
+    s = _ServerThread(capacity_bytes=1 << 20)
+    yield s
+    s.stop()
+
+
+def test_remote_store_get_roundtrip(server):
+    pool = RemoteBlockPool(server.address)
+    b = _block(1)
+    pool.store(0xABC, b)
+    assert 0xABC in pool
+    got = pool.get(0xABC)
+    np.testing.assert_array_equal(got, b)
+    assert pool.get(0xDEF) is None
+    assert pool.contains_many([0xABC, 0xDEF]) == [True, False]
+    st = pool.stats()
+    assert st["blocks"] == 1 and st["hits"] == 1 and st["misses"] == 1
+
+
+def test_remote_lru_eviction():
+    s = _ServerThread(capacity_bytes=3 * _block(0).nbytes)
+    try:
+        pool = RemoteBlockPool(s.address)
+        for i in range(5):
+            pool.store(i, _block(i))
+        have = pool.contains_many(list(range(5)))
+        assert sum(have) == 3
+        assert have[4] and have[3]  # newest survive
+        assert not have[0]
+    finally:
+        s.stop()
+
+
+def test_remote_disk_persistence(tmp_path):
+    s = _ServerThread(capacity_bytes=1 << 20, disk_path=str(tmp_path))
+    try:
+        pool = RemoteBlockPool(s.address)
+        b = _block(7)
+        pool.store(0x77, b)
+        np.testing.assert_array_equal(pool.get(0x77), b)
+        assert (tmp_path / "0000000000000077.kv").exists()
+    finally:
+        s.stop()
+
+
+def test_remote_unreachable_degrades():
+    pool = RemoteBlockPool("127.0.0.1:1", timeout_s=0.2, max_failures=2)
+    assert pool.get(1) is None
+    assert 1 not in pool
+    assert pool.disabled  # after max_failures, G4 turns itself off
+    assert pool.get(2) is None  # no further connection attempts / raises
+
+
+def test_offload_queue_priority_and_fifo():
+    q = OffloadQueue(max_items=16)
+    q.put(1, "d1", priority=1)
+    q.put(2, "p1", priority=0)
+    q.put(3, "d2", priority=1)
+    q.put(4, "p2", priority=0)
+    order = [q.get()[2] for _ in range(4)]
+    assert order == [2, 4, 1, 3]  # all prio-0 first, FIFO within each
+
+
+def test_offload_queue_sheds_lowest_priority():
+    q = OffloadQueue(max_items=2)
+    q.put(1, "p", priority=0)
+    q.put(2, "d", priority=5)
+    q.put(3, "p2", priority=0)  # overflow: the prio-5 item is shed
+    assert q.shed == 1
+    hashes = [q.get()[2] for _ in range(2)]
+    assert set(hashes) == {1, 3}
+
+
+def test_tiers_with_remote_prefix_and_priority(server):
+    bn = _block(0).nbytes
+    tiers = KvbmTiers(
+        bn, host_capacity_bytes=2 * bn, remote=RemoteBlockPool(server.address)
+    )
+    blocks = {h: _block(h) for h in [10, 11, 12, 13]}
+    # prefix blocks at priority 0, decode blocks at 1
+    for h in [12, 13]:
+        tiers.offload(h, blocks[h], priority=1)
+    for h in [10, 11]:
+        tiers.offload(h, blocks[h], priority=0)
+    tiers.flush()
+    # host LRU holds only 2; the rest must still match via remote
+    assert tiers.match_prefix([10, 11, 12, 13]) == 4
+    arr = tiers.load_prefix([10, 11, 12, 13])
+    assert arr.shape[0] == 4
+    for i, h in enumerate([10, 11, 12, 13]):
+        np.testing.assert_array_equal(arr[i], blocks[h])
+    # filter_servable sees remote membership in one batch
+    assert set(tiers.filter_servable([10, 11, 12, 13, 99])) == {10, 11, 12, 13}
+    tiers.close()
+
+
+def test_tiers_remote_only_onboarding(server):
+    """A block another worker offloaded is onboardable here (the G4 point)."""
+    bn = _block(0).nbytes
+    producer = KvbmTiers(bn, host_capacity_bytes=4 * bn,
+                         remote=RemoteBlockPool(server.address))
+    consumer = KvbmTiers(bn, host_capacity_bytes=4 * bn,
+                         remote=RemoteBlockPool(server.address))
+    b = _block(42)
+    producer.store(0x4242, b)
+    assert consumer.match_prefix([0x4242]) == 1
+    got = consumer.load_prefix([0x4242])
+    np.testing.assert_array_equal(got[0], b)
+    # promoted into the consumer's host tier
+    assert 0x4242 in consumer.host

@@ -1,0 +1,141 @@
+"""Networked discovery store (runtime/discovery/netstore.py): the
+etcd-analog backend with push watches and shared leases.
+
+Reference analog: lib/runtime/src/storage/kv/etcd.rs + discovery/kv_store.rs.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.discovery.netstore import KVStoreServer, TcpKVStore
+from dynamo_tpu.runtime.discovery.store import EventType
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _server():
+    s = KVStoreServer(host="127.0.0.1", port=0)
+    addr = await s.start()
+    return s, addr
+
+
+def test_put_get_delete_list_across_clients():
+    async def run():
+        server, addr = await _server()
+        a, b = TcpKVStore(addr), TcpKVStore(addr)
+        try:
+            await a.put("svc/x", b"1")
+            await a.put("svc/y", b"2")
+            await a.put("other/z", b"3")
+            assert await b.get("svc/x") == b"1"
+            assert await b.get("missing") is None
+            items = await b.list_prefix("svc/")
+            assert items == {"svc/x": b"1", "svc/y": b"2"}
+            await b.delete("svc/x")
+            assert await a.get("svc/x") is None
+        finally:
+            await a.close()
+            await b.close()
+            await server.stop()
+
+    _run(run())
+
+
+def test_watch_is_pushed_snapshot_then_live():
+    async def run():
+        server, addr = await _server()
+        a, b = TcpKVStore(addr), TcpKVStore(addr)
+        try:
+            await a.put("v1/k1", b"old")
+            w = await b.watch("v1/")
+            ev = await asyncio.wait_for(w.__anext__(), 2.0)
+            assert (ev.type, ev.key, ev.value) == (EventType.PUT, "v1/k1", b"old")
+            # live event pushed from another client, no polling interval
+            await a.put("v1/k2", b"new")
+            ev = await asyncio.wait_for(w.__anext__(), 2.0)
+            assert (ev.type, ev.key, ev.value) == (EventType.PUT, "v1/k2", b"new")
+            await a.delete("v1/k1")
+            ev = await asyncio.wait_for(w.__anext__(), 2.0)
+            assert (ev.type, ev.key) == (EventType.DELETE, "v1/k1")
+            w.cancel()
+        finally:
+            await a.close()
+            await b.close()
+            await server.stop()
+
+    _run(run())
+
+
+def test_lease_expiry_deletes_keys_and_notifies_watchers():
+    async def run():
+        server, addr = await _server()
+        owner, observer = TcpKVStore(addr), TcpKVStore(addr)
+        try:
+            lease = await owner.create_lease(ttl_s=0.4)
+            await owner.put("inst/w1", b"alive", lease_id=lease.id)
+            w = await observer.watch("inst/")
+            ev = await asyncio.wait_for(w.__anext__(), 2.0)
+            assert ev.type is EventType.PUT
+            # keepalive holds the key
+            assert await owner.keep_alive(lease.id)
+            await asyncio.sleep(0.25)
+            assert await observer.get("inst/w1") == b"alive"
+            # stop refreshing: server reaps, observer sees DELETE pushed
+            ev = await asyncio.wait_for(w.__anext__(), 3.0)
+            assert (ev.type, ev.key) == (EventType.DELETE, "inst/w1")
+            assert not await owner.keep_alive(lease.id)
+        finally:
+            await owner.close()
+            await observer.close()
+            await server.stop()
+
+    _run(run())
+
+
+def test_revoke_lease_immediate():
+    async def run():
+        server, addr = await _server()
+        c = TcpKVStore(addr)
+        try:
+            lease = await c.create_lease(ttl_s=30.0)
+            await c.put("a/b", b"v", lease_id=lease.id)
+            await c.revoke_lease(lease.id)
+            assert await c.get("a/b") is None
+        finally:
+            await c.close()
+            await server.stop()
+
+    _run(run())
+
+
+def test_make_store_tcp_and_runtime_integration():
+    """A component served via the tcp store is discoverable by a client in
+    another runtime (the cross-process wiring, single-process here)."""
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    async def run():
+        server, addr = await _server()
+        cfg = RuntimeConfig(store="tcp", store_path=addr, event_plane="inproc",
+                            lease_ttl_s=2.0)
+
+        async def handler(request, context):
+            yield {"echo": request["x"]}
+
+        rt1 = await DistributedRuntime(cfg).start()
+        rt2 = await DistributedRuntime(cfg).start()
+        try:
+            await rt1.namespace("ns").component("c").endpoint("e").serve(handler)
+            client = await rt2.namespace("ns").component("c").endpoint("e").client()
+            await client.wait_for_instances(1, timeout=5.0)
+            out = [item async for item in await client.generate({"x": 7}, context=Context())]
+            assert out and out[0]["echo"] == 7
+        finally:
+            await rt1.shutdown()
+            await rt2.shutdown()
+            await server.stop()
+
+    _run(run())

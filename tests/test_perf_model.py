@@ -1,0 +1,102 @@
+"""Mocker perf models (mocker/perf_model.py): polynomial + NPZ grid
+interpolation, and the profiler -> NPZ -> mocker pipeline.
+
+Reference analog: lib/mocker/src/perf_model.rs (Polynomial / Interpolated).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+from dynamo_tpu.mocker.perf_model import (
+    InterpolatedPerfModel,
+    PolynomialPerfModel,
+    load_perf_model,
+)
+from dynamo_tpu.profiler.sweep import ProfileResult, profile_to_npz
+
+
+def test_polynomial_matches_args():
+    args = MockEngineArgs()
+    m = PolynomialPerfModel.from_args(args)
+    assert m.prefill_time(100) == pytest.approx(0.02 + 0.0001 * 100)
+    assert m.decode_time(4, 50) == pytest.approx(0.005 + 0.000002 * 50)
+
+
+def test_interpolated_grid_and_io(tmp_path):
+    m = InterpolatedPerfModel(
+        prefill_isl=np.array([128.0, 1024.0]),
+        prefill_s=np.array([0.01, 0.08]),
+        decode_seqs=np.array([1.0, 8.0]),
+        decode_blocks=np.array([10.0, 100.0]),
+        decode_s=np.array([[0.002, 0.004], [0.006, 0.012]]),
+    )
+    # interior interpolation + edge clamping
+    assert m.prefill_time(128) == pytest.approx(0.01)
+    assert m.prefill_time(576) == pytest.approx(0.045)  # midpoint
+    assert m.prefill_time(10_000) == pytest.approx(0.08)  # clamped
+    assert m.decode_time(1, 10) == pytest.approx(0.002)
+    assert m.decode_time(8, 100) == pytest.approx(0.012)
+    mid = m.decode_time(4.5, 55)
+    assert 0.002 < mid < 0.012
+    assert m.decode_time(100, 10_000) == pytest.approx(0.012)  # clamped
+
+    path = str(tmp_path / "grid.npz")
+    m.save(path)
+    m2 = InterpolatedPerfModel.load(path)
+    assert m2.decode_time(4.5, 55) == pytest.approx(mid)
+    assert isinstance(load_perf_model(path, MockEngineArgs()), InterpolatedPerfModel)
+    assert isinstance(load_perf_model(None, MockEngineArgs()), PolynomialPerfModel)
+
+
+def test_grid_shape_validation():
+    with pytest.raises(ValueError, match="decode grid"):
+        InterpolatedPerfModel(
+            prefill_isl=np.array([1.0]), prefill_s=np.array([0.1]),
+            decode_seqs=np.array([1.0, 2.0]), decode_blocks=np.array([1.0]),
+            decode_s=np.zeros((1, 1)),
+        )
+
+
+def test_profile_to_npz_feeds_mocker(tmp_path):
+    """profiler sweep -> NPZ -> mocker timing: the simulated TTFT must track
+    the measured prefill curve, not the built-in defaults."""
+    profile = ProfileResult(
+        prefill_points=[(128, 128 / 0.5), (1024, 1024 / 2.0)],  # 0.5s / 2.0s
+        decode_points=[(1, 1 / 0.01), (8, 8 / 0.02)],           # 10ms / 20ms
+        meta={"decode_isl": 256, "osl": 64},
+    )
+    path = str(tmp_path / "measured.npz")
+    model = profile_to_npz(profile, path)
+    assert model.prefill_time(128) == pytest.approx(0.5, rel=1e-6)
+    assert model.prefill_time(1024) == pytest.approx(2.0, rel=1e-6)
+
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    async def run():
+        eng = MockerEngine(MockEngineArgs(
+            perf_model_path=path, speedup_ratio=1000.0, emit_sim_ts=True,
+        ))
+        req = PreprocessedRequest(
+            request_id="pm", model="m", token_ids=list(range(128)),
+            stop=StopConditions(max_tokens=2, min_tokens=2, ignore_eos=True),
+            sampling=SamplingOptions(temperature=0.0),
+        )
+        stamps = []
+        async for out in eng.generate(req, Context()):
+            if out.token_ids:
+                stamps.append(out.annotations["sim_ts"])
+        eng.stop()
+        return stamps
+
+    stamps = asyncio.run(run())
+    # first token lands after the MEASURED 0.5s prefill (defaults: ~0.03s)
+    assert stamps[0] >= 0.5
+    assert stamps[0] < 0.6

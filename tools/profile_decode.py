@@ -64,7 +64,7 @@ def paged(q, kc, vc):
     return pa.paged_decode_attention(q, kc, vc, tables, seq_lens, interpret=interp)
 
 
-def step_full(carry, _):
+def step_full(params, carry, _):
     tokens, kcs, vcs = carry
     positions = seq_lens - 1
 
@@ -78,7 +78,7 @@ def step_full(carry, _):
     return (toks, kcs, vcs), toks
 
 
-def step_fwd_only(carry, _):
+def step_fwd_only(params, carry, _):
     tokens, kcs, vcs = carry
     positions = seq_lens - 1
 
@@ -92,7 +92,7 @@ def step_fwd_only(carry, _):
     return (toks, kcs, vcs), toks
 
 
-def step_head_only(carry, _):
+def step_head_only(params, carry, _):
     h, = carry
     logits = lm_logits(params, cfg, h)
     toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -100,7 +100,7 @@ def step_head_only(carry, _):
     return (h,), toks
 
 
-def step_attn_only(carry, _):
+def step_attn_only(params, carry, _):
     q, = carry
     out = q
     for li in range(cfg.num_layers):
@@ -108,7 +108,7 @@ def step_attn_only(carry, _):
     return (out,), jnp.zeros((B,), jnp.int32)
 
 
-def step_noattn(carry, _):
+def step_noattn(params, carry, _):
     tokens, = carry
     positions = seq_lens - 1
 
@@ -121,13 +121,15 @@ def step_noattn(carry, _):
 
 
 def bench(name, fn, init):
-    jfn = jax.jit(lambda c: jax.lax.scan(fn, c, None, length=STEPS))
-    out = jfn(init)
+    # params enter as a jit ARGUMENT: a closure would bake them into the HLO
+    # as constants (1.2GB) and the tunneled remote-compile 413s
+    jfn = jax.jit(lambda p, c: jax.lax.scan(partial(fn, p), c, None, length=STEPS))
+    out = jfn(params, init)
     jax.block_until_ready(out)
     reps = 5
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = jfn(init)
+        out = jfn(params, init)
         jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / reps
     per_step = dt / STEPS * 1e3
