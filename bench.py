@@ -38,11 +38,12 @@ from dynamo_tpu.runtime.engine import Context  # noqa: E402
 BATCH = int(os.environ.get("BENCH_BATCH", "8"))
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "256"))
 DECODE_TOKENS = int(os.environ.get("BENCH_DECODE", "128"))
-# defaults are the *measured-best* config on a real v5e (r2 verdict: depth-1
-# pipelines beat deeper ones on both throughput and TTFT; never ship
+# defaults are the *measured-best* config on the real chip (r3 grid over
+# steps x pipeline x batch after pipelined prefill/fetch: steps=32
+# pipeline=2 measured 1267 tok/s / 0.30 of roofline at b8; never ship
 # defaults that regress the measured number)
-DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
-PIPELINE = int(os.environ.get("BENCH_PIPELINE", "1"))
+DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "32"))
+PIPELINE = int(os.environ.get("BENCH_PIPELINE", "2"))
 WARMUP_TOKENS = 16
 # batch sweep runs BY DEFAULT; set BENCH_SWEEP=8 (single config) to disable
 SWEEP = os.environ.get("BENCH_SWEEP", "8,16,32")

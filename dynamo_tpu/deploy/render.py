@@ -151,11 +151,12 @@ def _deployment(graph: GraphSpec, svc: ServiceSpec, container: Dict[str, Any],
 def _service(graph: GraphSpec, svc: ServiceSpec, port: int,
              headless: bool = False) -> Dict[str, Any]:
     labels = _labels(graph, svc)
-    spec: Dict[str, Any] = {
-        "selector": labels,
-        "ports": [{"port": port, "targetPort": port}],
-    }
+    spec: Dict[str, Any] = {"selector": labels}
+    if port > 0:
+        spec["ports"] = [{"port": port, "targetPort": port}]
     if headless:
+        # identity-only Service (StatefulSet serviceName); the API server
+        # rejects port 0, and a headless service needs no ports at all
         spec["clusterIP"] = "None"
     return {
         "apiVersion": "v1",
@@ -167,6 +168,13 @@ def _service(graph: GraphSpec, svc: ServiceSpec, port: int,
         },
         "spec": spec,
     }
+
+
+def _kvbm_address(graph: GraphSpec) -> Optional[str]:
+    for s in graph.services:
+        if s.kind == "kvbm":
+            return f"{graph.name}-{s.name}.{graph.namespace}.svc:7440"
+    return None
 
 
 def render_service(graph: GraphSpec, svc: ServiceSpec) -> List[Dict[str, Any]]:
@@ -215,6 +223,10 @@ def render_service(graph: GraphSpec, svc: ServiceSpec) -> List[Dict[str, Any]]:
             )
         cmd = ["python", "-m", "dynamo_tpu.engine", "--tp", str(svc.tp),
                "--sp", str(svc.sp), "--dp", str(svc.dp)]
+        kvbm_addr = _kvbm_address(graph)
+        if kvbm_addr:
+            # workers share the graph's G4 block store
+            cmd += ["--kvbm-remote", kvbm_addr]
         if svc.model:
             cmd += ["--model", svc.model]
         if svc.preset:

@@ -86,6 +86,8 @@ def parse_args():
                         "unload_lora/list_loras endpoints (reference "
                         "components/src/dynamo/vllm/main.py:712)")
     p.add_argument("--lora-rank", type=int, default=16)
+    p.add_argument("--no-warm-cache", action="store_true",
+                   help="disable the host weight cache (engine/warm.py)")
     p.add_argument(
         "--disagg",
         choices=["none", "prefill", "decode"],
@@ -111,7 +113,14 @@ async def main() -> None:
     params = None
     if args.model_path:
         mcfg = config_from_hf(args.model_path)
-        params = load_params(args.model_path, mcfg)
+        if args.no_warm_cache:
+            params = load_params(args.model_path, mcfg)
+        else:
+            # warm restore (engine/warm.py): restarted workers skip the
+            # checkpoint parse (chrek/CRIU analog, SURVEY §2.4)
+            from dynamo_tpu.engine.warm import load_params_warm
+
+            params = load_params_warm(args.model_path, mcfg)
         tokenizer_ref = args.tokenizer or args.model_path
     else:
         mcfg = PRESETS[args.preset]()
@@ -334,6 +343,10 @@ async def main() -> None:
                 "canary_rtt_s": canary.last_rtt,
             },
             port=args.status_port,
+            loras_fn=(
+                (lambda: engines[0].lora.list_adapters())
+                if engines[0].lora is not None else None
+            ),
         )
         await status_server.start()
     print(f"TPU_ENGINE_READY {args.model} tp={args.tp}", flush=True)

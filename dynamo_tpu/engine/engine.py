@@ -133,6 +133,9 @@ class _Seq:
     prefill_pos: int = 0                  # prompt tokens whose KV is written
     commit_upto: int = 0                  # prompt blocks content-addressed so far
     prefilled: bool = False               # prefill complete -> decode eligible
+    # final chunk dispatched, first-token readback in flight (the loop must
+    # neither prefill this sequence again nor decode it yet)
+    prefill_inflight: bool = False
     done: bool = False
 
 
@@ -148,6 +151,9 @@ class _Chain:
     seq_lens: jax.Array
     steps: jax.Array
     seqs: List[Optional["_Seq"]] = dataclasses.field(default_factory=list)
+    # fetch future (np.asarray on the fetch pool): started at dispatch so
+    # pipelined horizons' device->host RTTs overlap instead of serializing
+    fetch: Any = None
 
 
 class TpuEngine:
@@ -223,12 +229,21 @@ class TpuEngine:
         # tunneled TPUs: ~100ms per transfer vs ~0.03ms per dispatch)
         self._dev_cache: Dict[str, jax.Array] = {}
         self._loop_task: Optional[asyncio.Task] = None
+        self._prefill_tasks: set = set()  # in-flight first-token readbacks
+        self._last_published_load: Tuple[int, int] = (-1, -1)
         self._wake = asyncio.Event()
         # engine health: False after a step-loop crash (watchdog deregisters
         # the worker; reference components/src/dynamo/vllm/engine_monitor.py)
         self.healthy = True
         self.on_crash: Optional[Any] = None  # callback(exc) scheduled on loop crash
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-step")
+        # result readback pool: each in-flight horizon's packed fetch runs on
+        # its own thread; on tunneled devices the ~100ms RTT is latency, not
+        # bandwidth, so concurrent fetches pipeline and the loop consumes at
+        # device cadence
+        self._fetch_executor = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="tpu-fetch"
+        )
         self._offload_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-offload"
         )
@@ -646,6 +661,7 @@ class TpuEngine:
         if getattr(self, "_kv_transfer_srv", None) is not None:
             self._kv_transfer_srv.close()
         self._executor.shutdown(wait=False)
+        self._fetch_executor.shutdown(wait=False)
 
     # ------------------------------------------------------- kvbm offload/onboard
     def _enqueue_offload_gather(self, pending: List[Tuple[int, int]]):
@@ -736,15 +752,32 @@ class TpuEngine:
 
     # ------------------------------------------------------------- step loop
     async def _loop(self) -> None:
+        import os as _os
+
         loop = asyncio.get_event_loop()
+        trace = _os.environ.get("DTPU_LOOP_TRACE")
+        t_mark = time.perf_counter()
+
+        def mark(phase: str) -> None:
+            nonlocal t_mark
+            now = time.perf_counter()
+            if trace and now - t_mark > 0.002:
+                import sys as _sys
+
+                print(f"loop {phase:<10s} {(now - t_mark) * 1e3:6.1f} ms",
+                      file=_sys.stderr, flush=True)
+            t_mark = now
+
         try:
             while True:
                 if not self._waiting and all(s is None for s in self._slots):
                     self._chains.clear()  # all snapshot seqs are done by now
                     self._wake.clear()
                     await self._wake.wait()
+                mark("idle")
                 self._admit_cancelled()
                 self._try_admit()
+                mark("admit")
                 # chunked prefill: ONE bounded chunk per tick, so running
                 # decodes keep making progress under a long prefill; round-
                 # robin across prefilling sequences so a short prompt is not
@@ -752,6 +785,7 @@ class TpuEngine:
                 prefilling = [
                     s for s in self._slots
                     if s is not None and not s.done and not s.prefilled
+                    and not s.prefill_inflight
                 ]
                 if prefilling:
                     pick = prefilling[self._prefill_rr % len(prefilling)]
@@ -770,7 +804,15 @@ class TpuEngine:
                         )
                         self._commit_prefilled_blocks(pick)
                         if res is not None:
-                            self._accept_token(*res)
+                            fut = self._fetch_executor.submit(
+                                self._fetch_prefill_result, *res
+                            )
+                            task = asyncio.ensure_future(
+                                self._finish_prefill(res[0], fut)
+                            )
+                            self._prefill_tasks.add(task)
+                            task.add_done_callback(self._prefill_tasks.discard)
+                        mark("prefill")
                 has_active = any(
                     s is not None and not s.done and s.prefilled
                     for s in self._slots
@@ -788,23 +830,33 @@ class TpuEngine:
                     and self._prepare_horizon(depth=len(self._chains) + 1)
                 ):
                     prev = self._chains[-1] if self._chains else None
-                    self._chains.append(
-                        await loop.run_in_executor(
-                            self._executor, self._dispatch_horizon, prev
-                        )
+                    snapshot = self._decode_snapshot()
+                    chain = await loop.run_in_executor(
+                        self._executor, self._dispatch_horizon, prev, snapshot
                     )
+                    chain.fetch = self._fetch_executor.submit(np.asarray, chain.packed)
+                    self._chains.append(chain)
+                    mark("dispatch")
                 if self._chains:
                     chain = self._chains.popleft()
-                    packed = await loop.run_in_executor(
-                        self._executor, np.asarray, chain.packed
-                    )
+                    packed = await asyncio.wrap_future(chain.fetch)
+                    mark("fetch")
                     self._apply_packed(chain, packed)
+                    mark("apply")
                 elif has_active:
                     results = await loop.run_in_executor(
-                        self._executor, self._run_decode
+                        self._executor, self._run_decode, self._decode_snapshot()
                     )
                     for rst, tok, lp, tids, tvals in results:
                         self._accept_token(rst, tok, lp, tids, tvals)
+                elif self._prefill_tasks and not prefilling:
+                    # nothing to compute until a first-token readback lands:
+                    # park instead of busy-spinning through the loop
+                    self._wake.clear()
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), 0.05)
+                    except asyncio.TimeoutError:
+                        pass
                 self._reap_finished()
                 if self._offload_pending and self.kvbm is not None:
                     pending, self._offload_pending = self._offload_pending, []
@@ -818,6 +870,7 @@ class TpuEngine:
                         self._offload_fetch, pending, gathered
                     )
                 await self._publish_events()
+                mark("publish")
                 await asyncio.sleep(0)
         except asyncio.CancelledError:
             pass
@@ -1013,10 +1066,16 @@ class TpuEngine:
         st.prefill_pos = total_len
         if not is_final:
             return None
-        st.prefilled = True
-        if self._lp_ns[st.slot] > 0:
-            return (st, int(tok), float(lp), np.asarray(tlp_ids), np.asarray(tlp_vals))
-        return (st, int(tok), float(lp), None, None)
+        # NO sync readback here: converting tok/lp on this thread would pay
+        # a full device->host RTT per sequence, serializing admission (the
+        # dominant cost at batch>=16 on tunneled devices). The loop fetches
+        # on the fetch pool, overlapping RTTs across sequences.
+        st.prefill_inflight = True
+        tok.copy_to_host_async()
+        lp.copy_to_host_async()
+        want_tlp = self._lp_ns[st.slot] > 0
+        return (st, tok, lp, tlp_ids if want_tlp else None,
+                tlp_vals if want_tlp else None)
 
     def _run_embed(self, token_ids: List[int]) -> np.ndarray:
         S = len(token_ids)
@@ -1076,6 +1135,38 @@ class TpuEngine:
     def _lora_tables(self):
         return self.lora.tables() if self.lora is not None else {}
 
+    def _fetch_prefill_result(self, st, tok, lp, tlp_ids, tlp_vals):
+        """Fetch pool thread: the blocking device->host conversion."""
+        return (
+            st, int(tok), float(lp),
+            np.asarray(tlp_ids) if tlp_ids is not None else None,
+            np.asarray(tlp_vals) if tlp_vals is not None else None,
+        )
+
+    async def _finish_prefill(self, st: "_Seq", fut) -> None:
+        """Loop thread: apply a prefill's first token once its readback
+        lands; the sequence becomes decode-eligible here."""
+        try:
+            _st, tok, lp, tlp_ids, tlp_vals = await asyncio.wrap_future(fut)
+        except Exception:
+            # readback died: fail the request instead of wedging the slot
+            # (prefill_inflight stuck True would exclude it from every list
+            # forever and busy-spin the loop)
+            log.exception("prefill readback failed")
+            st.prefill_inflight = False
+            st.done = True
+            st.out_queue.put_nowait(BackendOutput(
+                finish_reason="error", cumulative_tokens=st.produced
+            ))
+            self._wake.set()
+            return
+        st.prefill_inflight = False
+        if st.done or self._slots[st.slot] is not st:
+            return  # cancelled/reaped while the fetch was in flight
+        st.prefilled = True
+        self._accept_token(st, tok, lp, tlp_ids, tlp_vals)
+        self._wake.set()
+
     def _dev(self, name: str, host_arr: np.ndarray) -> jax.Array:
         """Device-resident copy of a slot array, re-uploaded only on change
         (host<->device transfers are ~100ms RPCs on tunneled TPUs)."""
@@ -1087,22 +1178,35 @@ class TpuEngine:
             self._dev_cache[name + "/host"] = host_arr.copy()
         return self._dev_cache[name]
 
-    def _dispatch_horizon(self, chain: Optional[_Chain]) -> _Chain:
-        """Enqueue one multi-step decode. With ``chain`` given, the carry
-        (tokens/seq_lens/steps) comes straight from the in-flight dispatch —
-        no host round-trip; otherwise it is synced up from host state."""
+    def _decode_snapshot(self) -> List[Optional["_Seq"]]:
+        """Loop-thread snapshot of decode-eligible slots. MUST be taken on
+        the loop thread in the same tick as _can_chain/_prepare_horizon: an
+        async prefill finishing mid-dispatch would otherwise widen the
+        active mask after those checks (stale carry token -> wrong KV)."""
+        return [
+            st if (st is not None and not st.done and st.prefilled) else None
+            for st in self._slots
+        ]
+
+    def _dispatch_horizon(
+        self, chain: Optional[_Chain], seqs: List[Optional["_Seq"]]
+    ) -> _Chain:
+        """Enqueue one multi-step decode over the loop-thread ``seqs``
+        snapshot. With ``chain`` given, the carry (tokens/seq_lens/steps)
+        comes straight from the in-flight dispatch — no host round-trip;
+        otherwise it is synced up from host state."""
         B = self.cfg.max_batch_size
         active = np.zeros(B, bool)
-        for i, st in enumerate(self._slots):
-            if st is not None and not st.done and st.prefilled:
+        for i, st in enumerate(seqs):
+            if st is not None:
                 active[i] = True
         if chain is not None:
             tokens, seq_lens, steps = chain.tokens, chain.seq_lens, chain.steps
         else:
             seq_lens_np = np.zeros(B, np.int32)
             steps_np = np.zeros(B, np.int32)
-            for i, st in enumerate(self._slots):
-                if st is None or st.done or not st.prefilled:
+            for i, st in enumerate(seqs):
+                if st is None:
                     continue
                 seq_lens_np[i] = len(st.seq)
                 steps_np[i] = st.produced
@@ -1137,10 +1241,6 @@ class TpuEngine:
         # to be applied comes (decode_pipeline-1 horizons later) the bytes
         # are already on host and np.asarray is a no-wait copy
         packed.copy_to_host_async()
-        seqs = [
-            st if (st is not None and not st.done and st.prefilled) else None
-            for st in self._slots
-        ]
         return _Chain(packed, tokens, seq_lens, steps, seqs)
 
     def _can_chain(self, chain: _Chain) -> bool:
@@ -1158,7 +1258,10 @@ class TpuEngine:
     def _apply_packed(self, chain: _Chain, packed_np: np.ndarray) -> None:
         """Apply one consumed horizon [N, B, 2+2K]: feed each snapshot slot's
         tokens through stop handling in order; the speculated tail past a
-        finish is discarded."""
+        finish is discarded. Each sequence's surviving tokens leave as ONE
+        BackendOutput — per-token queue round-trips made horizon emission
+        the dominant serving cost at batch>=16 (~1ms/token of asyncio churn
+        against a ~0.9ms/token device program)."""
         K = TOP_LOGPROBS_K
         toks = packed_np[:, :, 0].astype(np.int32)
         lps = packed_np[:, :, 1]
@@ -1168,24 +1271,21 @@ class TpuEngine:
             if st is None or st.done:
                 continue
             want_tlp = st.req.sampling.logprobs > 0
-            for s in range(toks.shape[0]):
-                if st.done:
-                    break
-                self._accept_token(
-                    st, int(toks[s, i]), float(lps[s, i]),
-                    tlp_ids[s, i] if want_tlp else None,
-                    tlp_vals[s, i] if want_tlp else None,
-                )
+            self._accept_tokens(
+                st, [int(t) for t in toks[:, i]], [float(x) for x in lps[:, i]],
+                tlp_ids[:, i] if want_tlp else None,
+                tlp_vals[:, i] if want_tlp else None,
+            )
 
-    def _run_decode(self) -> List[Tuple[_Seq, int, float]]:
+    def _run_decode(self, seqs: List[Optional["_Seq"]]) -> List[Tuple[_Seq, int, float]]:
         bs = self.cfg.block_size
         B = self.cfg.max_batch_size
         write_blocks = np.zeros(B, np.int32)
         write_offsets = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         seq_lens = np.zeros(B, np.int32)
-        for i, st in enumerate(self._slots):
-            if st is None or st.done or not st.prefilled:
+        for i, st in enumerate(seqs):
+            if st is None:
                 continue
             L = len(st.seq)                    # includes the token being fed
             positions[i] = L - 1
@@ -1196,8 +1296,8 @@ class TpuEngine:
             write_offsets[i] = (L - 1) % bs
 
         steps = np.zeros(B, np.int32)
-        for i, st in enumerate(self._slots):
-            if st is not None and not st.done and st.prefilled:
+        for i, st in enumerate(seqs):
+            if st is not None:
                 steps[i] = st.produced
 
         lp_need = bool(np.any((self._lp_ns > 0) & (seq_lens > 0)))
@@ -1220,8 +1320,8 @@ class TpuEngine:
         tlp_ids_np = np.asarray(tlp_ids) if lp_need else None
         tlp_vals_np = np.asarray(tlp_vals) if lp_need else None
         results = []
-        for i, st in enumerate(self._slots):
-            if st is None or st.done or not st.prefilled:
+        for i, st in enumerate(seqs):
+            if st is None:
                 continue
             if self._lp_ns[i] > 0 and tlp_ids_np is not None:
                 results.append((st, int(toks_np[i]), float(lps_np[i]),
@@ -1239,66 +1339,94 @@ class TpuEngine:
         tlp_ids: Optional[np.ndarray] = None,
         tlp_vals: Optional[np.ndarray] = None,
     ) -> None:
-        """Runs in the executor thread: pure host state mutation."""
-        st.produced += 1
-        finish: Optional[str] = None
-        # engine-level stop ids only; the worker Backend layer enforces the
-        # tokenizer-specific EOS (llm/backend.py)
-        stop_ids = set(st.req.stop.stop_token_ids)
-        if tok in stop_ids and st.produced > st.req.stop.min_tokens:
-            finish = FINISH_STOP
-        limit = st.req.stop.max_tokens
-        if finish is None and limit is not None and st.produced >= limit:
-            finish = FINISH_LENGTH
-        if finish is None and st.context.is_stopped():
-            finish = "cancelled"
+        self._accept_tokens(
+            st, [tok], [logprob],
+            tlp_ids[None] if tlp_ids is not None else None,
+            tlp_vals[None] if tlp_vals is not None else None,
+        )
 
-        emit_ids = [] if finish == FINISH_STOP and tok in stop_ids else [tok]
+    def _accept_tokens(
+        self,
+        st: _Seq,
+        toks: List[int],
+        logprobs: List[float],
+        tlp_ids: Optional[np.ndarray] = None,   # [N, K]
+        tlp_vals: Optional[np.ndarray] = None,  # [N, K]
+    ) -> None:
+        """Runs in the executor thread: pure host state mutation. Processes a
+        run of sampled tokens for one sequence (a decode horizon, or a single
+        token) and emits ONE BackendOutput; tokens past a finish are the
+        discarded speculative tail."""
+        emit_ids: List[int] = []
+        emit_lps: List[float] = []
+        tlp: Optional[List[Dict[int, float]]] = None
+        n_tlp = min(st.req.sampling.logprobs, TOP_LOGPROBS_K)
+        if n_tlp > 0 and tlp_ids is not None:
+            tlp = []
+        finish: Optional[str] = None
+        first_ann = st.produced == 0
+        stop_ids = set(st.req.stop.stop_token_ids)
+        limit = st.req.stop.max_tokens
+        cancelled = st.context.is_stopped()
+
+        for n, tok in enumerate(toks):
+            st.produced += 1
+            # engine-level stop ids only; the worker Backend layer enforces
+            # the tokenizer-specific EOS (llm/backend.py)
+            if tok in stop_ids and st.produced > st.req.stop.min_tokens:
+                finish = FINISH_STOP
+                break  # stop token excluded from output
+            emit_ids.append(tok)
+            emit_lps.append(logprobs[n])
+            if tlp is not None:
+                tlp.append({
+                    int(i): float(v)
+                    for i, v in zip(tlp_ids[n][:n_tlp], tlp_vals[n][:n_tlp])
+                })
+            if limit is not None and st.produced >= limit:
+                finish = FINISH_LENGTH
+            elif cancelled:
+                finish = "cancelled"
+
+            if finish is None:
+                L_before = len(st.seq)
+                if L_before + 1 >= self.cfg.max_context:
+                    finish = FINISH_LENGTH
+                else:
+                    sealed = st.seq.append(tok)
+                    st.last_token = tok
+                    if sealed is not None:
+                        self.allocator.commit(
+                            st.block_ids[sealed.position], sealed.sequence_hash
+                        )
+                        if self.kvbm is not None:
+                            self._offload_pending.append(
+                                (st.block_ids[sealed.position], sealed.sequence_hash, 1)
+                            )
+                    # ensure a block exists for the NEXT token's write position
+                    needed_blocks = (L_before + 1) // self.cfg.block_size + 1
+                    if needed_blocks > len(st.block_ids):
+                        try:
+                            (new_id,) = self.allocator.allocate(1)
+                            st.block_ids.append(new_id)
+                            self._block_tables[st.slot, len(st.block_ids) - 1] = new_id
+                        except OutOfBlocks:
+                            finish = FINISH_LENGTH  # out of memory: end gracefully
+            if finish is not None:
+                break
+
         ann: Dict[str, Any] = {}
-        if st.produced == 1:
+        if first_ann:
             ann = {
                 "cached_tokens": st.cached_tokens,
                 "input_tokens": len(st.req.token_ids),
             }
-
-        if finish is None:
-            L_before = len(st.seq)
-            if L_before + 1 >= self.cfg.max_context:
-                finish = FINISH_LENGTH
-            else:
-                sealed = st.seq.append(tok)
-                st.last_token = tok
-                if sealed is not None:
-                    self.allocator.commit(
-                        st.block_ids[sealed.position], sealed.sequence_hash
-                    )
-                    if self.kvbm is not None:
-                        self._offload_pending.append(
-                            (st.block_ids[sealed.position], sealed.sequence_hash, 1)
-                        )
-                # ensure a block exists for the *next* token's write position
-                L_after = L_before + 1
-                needed_blocks = L_after // self.cfg.block_size + 1
-                if needed_blocks > len(st.block_ids):
-                    try:
-                        (new_id,) = self.allocator.allocate(1)
-                        st.block_ids.append(new_id)
-                        self._block_tables[st.slot, len(st.block_ids) - 1] = new_id
-                    except OutOfBlocks:
-                        finish = FINISH_LENGTH  # out of memory: end gracefully
-
-        tlp: Optional[List[Dict[int, float]]] = None
-        n_tlp = min(st.req.sampling.logprobs, TOP_LOGPROBS_K)
-        if emit_ids and n_tlp > 0 and tlp_ids is not None:
-            tlp = [
-                {int(i): float(v) for i, v in zip(tlp_ids[:n_tlp], tlp_vals[:n_tlp])}
-            ]
         out = BackendOutput(
             token_ids=emit_ids,
             finish_reason=finish,
             cumulative_tokens=st.produced,
-            logprobs=[logprob] if emit_ids else None,
-            top_logprobs=tlp,
+            logprobs=emit_lps if emit_ids else None,
+            top_logprobs=tlp if (tlp and emit_ids) else None,
             annotations=ann,
         )
         st.out_queue.put_nowait(out)
@@ -1333,24 +1461,36 @@ class TpuEngine:
             # onboard on demand): don't tell the router it's gone — the
             # consolidated view, like the reference's kv_consolidator
             # (lib/llm/src/block_manager/kv_consolidator). Remote membership
-            # is answered in one batched RPC per event batch.
-            removed = [
-                [h for h in batch if h not in servable]
-                for batch in removed
-                for servable in (set(self.kvbm.filter_servable(batch)),)
-            ]
-            removed = [b for b in removed if b]
+            # is one batched RPC per event batch, off the event loop (the G4
+            # socket blocks; same treatment as match_prefix above).
+            loop_ = asyncio.get_event_loop()
+            filtered = []
+            for batch in removed:
+                servable = set(await loop_.run_in_executor(
+                    None, self.kvbm.filter_servable, batch
+                ))
+                gone_batch = [h for h in batch if h not in servable]
+                if gone_batch:
+                    filtered.append(gone_batch)
+            removed = filtered
         if self.kv_publisher is not None:
             for batch in stored:
                 await self.kv_publisher.stored(batch)
             for batch in removed:
                 await self.kv_publisher.removed(batch)
-        if self.metrics_publisher is not None and (stored or removed):
-            await self.metrics_publisher.publish(
-                active_decode_blocks=self.allocator.active_blocks,
-                num_requests_waiting=len(self._waiting),
-                total_blocks=self.cfg.num_blocks,
-            )
+        if self.metrics_publisher is not None:
+            # publish on KV events AND whenever load changed: releases emit
+            # no events (blocks just move to the reusable cache), and a
+            # stale active-block report would leave the router seeing
+            # phantom load on an idle worker
+            load = (self.allocator.active_blocks, len(self._waiting))
+            if stored or removed or load != self._last_published_load:
+                self._last_published_load = load
+                await self.metrics_publisher.publish(
+                    active_decode_blocks=load[0],
+                    num_requests_waiting=load[1],
+                    total_blocks=self.cfg.num_blocks,
+                )
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:

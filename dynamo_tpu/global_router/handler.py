@@ -62,6 +62,11 @@ class GlobalRouterHandler:
                 if self.config.decode_strategy else 0
             )
             pools = self.config.decode_pools
+        if not pools:
+            raise ValueError(
+                "global router config defines no pool for this request kind "
+                "(decode_pools is empty)"
+            )
         return pools[max(0, min(idx, len(pools) - 1))]
 
     async def generate(

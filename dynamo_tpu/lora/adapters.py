@@ -78,6 +78,7 @@ class LoraAdapterTable:
             self.B[t] = jnp.zeros((N, L, r, _TARGET_OUT[t](model_cfg)), dtype)
         self.scales = jnp.zeros((N,), jnp.float32)
         self._names: List[Optional[str]] = [None] * N  # slot -> adapter name
+        self._loading: Dict[str, int] = {}  # name -> reserved slot (in-flight)
         self._lock = threading.Lock()
 
     # -- registry ------------------------------------------------------------
@@ -106,9 +107,14 @@ class LoraAdapterTable:
         serving programs keep running). ``weights`` maps
         ``"<target>.A"``/``"<target>.B"`` to per-layer stacks [L, in, r] /
         [L, r, out]. Returns the slot id."""
+        reserved = object()  # placeholder: slot taken, name not yet visible
         with self._lock:
             if name in self._names:
                 slot = self._names.index(name)
+            elif name in self._loading:
+                # concurrent load of the same name reuses the reserved slot
+                # (last writer wins on the tables; no second slot leaks)
+                slot = self._loading[name]
             else:
                 try:
                     slot = self._names.index(None, 1)
@@ -116,21 +122,40 @@ class LoraAdapterTable:
                     raise RuntimeError(
                         f"no free adapter slots (max {self.max_adapters})"
                     ) from None
+                self._names[slot] = reserved  # type: ignore[assignment]
+                self._loading[name] = slot
+        # adapter rank = rank of the PROVIDED matrices (absent targets are
+        # zero-filled at table rank and must not influence the scale)
+        ranks = {
+            weights[f"{t}.A"].shape[-1]
+            for t in self.targets if f"{t}.A" in weights
+        }
+        r_eff = ranks.pop() if len(ranks) == 1 else self.rank
+        try:
+            for t in self.targets:
+                a = weights.get(f"{t}.A")
+                b = weights.get(f"{t}.B")
+                if a is None or b is None:
+                    # target absent in this adapter: identity (zeros)
+                    a = np.zeros(self.A[t].shape[1:], np.float32)
+                    b = np.zeros(self.B[t].shape[1:], np.float32)
+                a, b = self._fit_rank(np.asarray(a), np.asarray(b))
+                self.A[t] = self.A[t].at[slot].set(jnp.asarray(a, self.dtype))
+                self.B[t] = self.B[t].at[slot].set(jnp.asarray(b, self.dtype))
+            scale = (alpha if alpha is not None else float(r_eff)) / float(r_eff)
+            self.scales = self.scales.at[slot].set(scale)
+        except Exception:
+            with self._lock:
+                if self._loading.get(name) == slot:
+                    del self._loading[name]
+                    if not isinstance(self._names[slot], str):
+                        self._names[slot] = None  # release the reserved slot
+            raise
+        # the name becomes routable only now, with every table written —
+        # a request racing the load sees "unknown adapter", never zeros
+        with self._lock:
             self._names[slot] = name
-        r_eff = self.rank
-        for t in self.targets:
-            a = weights.get(f"{t}.A")
-            b = weights.get(f"{t}.B")
-            if a is None or b is None:
-                # target absent in this adapter: identity (zeros)
-                a = np.zeros(self.A[t].shape[1:], np.float32)
-                b = np.zeros(self.B[t].shape[1:], np.float32)
-            r_eff = a.shape[-1]
-            a, b = self._fit_rank(np.asarray(a), np.asarray(b))
-            self.A[t] = self.A[t].at[slot].set(jnp.asarray(a, self.dtype))
-            self.B[t] = self.B[t].at[slot].set(jnp.asarray(b, self.dtype))
-        scale = (alpha if alpha is not None else float(r_eff)) / float(r_eff)
-        self.scales = self.scales.at[slot].set(scale)
+            self._loading.pop(name, None)
         log.info("lora adapter %r loaded into slot %d (scale %.3f)", name, slot, scale)
         return slot
 

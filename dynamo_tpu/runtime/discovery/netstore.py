@@ -189,13 +189,24 @@ class TcpKVStore(KVStore):
                     else:
                         fut.set_result(msg)
         except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
-            # sever every consumer so nobody awaits a dead connection
-            for fut in self._pending.values():
+            # sever every consumer so nobody awaits a dead connection, and
+            # drop the transport so the next op reconnects (watchers do not
+            # auto-resubscribe: their cancel tells consumers to re-watch)
+            for fut in list(self._pending.values()):
                 if not fut.done():
                     fut.set_exception(ConnectionError("kv store connection lost"))
             self._pending.clear()
-            for w in self._watchers.values():
+            # snapshot: a watcher's wrapped cancel() pops itself from the dict
+            for w in list(self._watchers.values()):
                 w.cancel()
+            self._watchers.clear()
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+            self._reader = self._writer = None
+            self._rx_task = None
 
     async def _call(self, obj: dict) -> dict:
         async with self._lock:

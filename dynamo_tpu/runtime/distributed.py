@@ -18,6 +18,7 @@ from .event_plane.base import EventPlane, InProcEventPlane
 from .logging import get_logger, init_logging
 from .metrics import MetricsScope
 from .request_plane.tcp import TcpClient
+from .tasks import TaskTracker
 
 log = get_logger("runtime.distributed")
 
@@ -38,6 +39,10 @@ class DistributedRuntime(DistributedRuntimeBase):
         self.tcp_client = TcpClient()
         self._http_client = None  # lazy: most deployments never use it
         self.metrics = MetricsScope()
+        # supervised background work (runtime/tasks.py; reference
+        # utils/tasks/tracker.rs): components spawn under runtime.tasks so
+        # shutdown() drains the whole tree
+        self.tasks = TaskTracker(name="runtime")
         self.lease_id: Optional[str] = None
         self._keepalive_task: Optional[asyncio.Task] = None
         self._started = False
@@ -101,6 +106,9 @@ class DistributedRuntime(DistributedRuntimeBase):
             pass
 
     async def shutdown(self) -> None:
+        await self.tasks.graceful_shutdown(
+            timeout=self.config.graceful_shutdown_timeout_s
+        )
         if self._keepalive_task is not None:
             self._keepalive_task.cancel()
         if self.lease_id is not None:

@@ -134,6 +134,8 @@ class EndpointCanary:
         if self._task is not None:
             self._task.cancel()
         await self._client.close()
+        if self._http_client is not None:
+            await self._http_client.close()
 
 
 class StatusServer:
@@ -144,6 +146,7 @@ class StatusServer:
       /live      process liveness (always 200 while serving)
       /metrics   Prometheus exposition from the runtime registry
       /metadata  caller-provided component metadata (model, config, snapshot)
+      /v1/loras  loaded LoRA adapters (system_status_server.rs:196-215)
     """
 
     def __init__(
@@ -154,10 +157,12 @@ class StatusServer:
         pre_expose: Optional[Callable[[], None]] = None,
         host: str = "0.0.0.0",
         port: int = 0,
+        loras_fn: Optional[Callable[[], list]] = None,
     ):
         self.state = state
         self.metrics = metrics_scope
         self.metadata_fn = metadata_fn
+        self.loras_fn = loras_fn
         self.pre_expose = pre_expose  # refresh gauges right before scraping
         self.host = host
         self.port = port
@@ -168,6 +173,7 @@ class StatusServer:
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/metadata", self._metadata)
+        app.router.add_get("/v1/loras", self._loras)
         self.app = app
 
     async def _health(self, request: web.Request) -> web.Response:
@@ -190,6 +196,10 @@ class StatusServer:
     async def _metadata(self, request: web.Request) -> web.Response:
         meta = self.metadata_fn() if self.metadata_fn is not None else {}
         return web.json_response(meta)
+
+    async def _loras(self, request: web.Request) -> web.Response:
+        names = self.loras_fn() if self.loras_fn is not None else []
+        return web.json_response({"data": [{"id": n} for n in names]})
 
     async def start(self) -> str:
         self._runner = web.AppRunner(self.app, access_log=None)

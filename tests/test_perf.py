@@ -1,0 +1,83 @@
+"""Perf stream recording + logprob sensitivity (llm/perf.py).
+
+Reference analog: lib/llm/src/perf.rs + perf/logprobs.rs.
+"""
+
+import asyncio
+import math
+
+from dynamo_tpu.llm.perf import (
+    RecordedStream,
+    analyze_logprobs,
+    record_stream,
+)
+from dynamo_tpu.llm.protocols.common import BackendOutput
+
+
+def test_record_and_analyze_stream():
+    async def run():
+        async def gen():
+            await asyncio.sleep(0.03)
+            yield BackendOutput(token_ids=[1])          # TTFT
+            for _ in range(3):
+                await asyncio.sleep(0.01)
+                yield BackendOutput(token_ids=[2, 3])   # horizon emission
+
+        rec = RecordedStream()
+        got = [o async for o in record_stream(gen(), rec)]
+        return rec, got
+
+    rec, got = asyncio.run(run())
+    assert rec.response_count == 4
+    assert [r.sequence_number for r in rec.responses] == [0, 1, 2, 3]
+    stats = rec.analyze()
+    assert stats["tokens"] == 7
+    assert stats["ttft_s"] >= 0.025
+    assert stats["itl_p95_s"] >= 0.005
+    assert stats["tokens_per_s"] > 0
+    # pass-through is faithful
+    assert sum(len(o.token_ids) for o in got) == 7
+
+
+def test_logprob_sensitivity():
+    entries = [
+        {"token_id": 5, "logprob": -0.1,
+         "top_logprobs": [{"token_id": 5, "logprob": -0.1},
+                          {"token_id": 9, "logprob": -0.2}]},   # close call
+        {"token_id": 7, "logprob": -0.01,
+         "top_logprobs": [{"token_id": 7, "logprob": -0.01},
+                          {"token_id": 2, "logprob": -8.0}]},   # decisive
+        {"token_id": 3, "logprob": -0.5, "top_logprobs": []},    # no alts
+    ]
+    a = analyze_logprobs(entries)
+    assert len(a.positions) == 3
+    p0, p1, p2 = a.positions
+    assert p0.runner_up_token == 9
+    assert p0.prob_ratio > 0.9            # nearly a coin flip
+    assert p1.prob_ratio < 0.001
+    assert p2.runner_up_token is None and p2.prob_ratio == 0.0
+    assert len(a.close_calls) == 1
+    s = a.summary()
+    assert s["positions"] == 3 and s["close_calls"] == 1
+
+
+def test_status_server_loras_route():
+    from dynamo_tpu.runtime.health import HealthState, StatusServer
+
+    async def run():
+        srv = StatusServer(
+            HealthState(), host="127.0.0.1", port=0,
+            loras_fn=lambda: ["ad-a", "ad-b"],
+        )
+        addr = await srv.start()
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://{addr}/v1/loras") as r:
+                assert r.status == 200
+                body = await r.json()
+        await srv.stop()
+        return body
+
+    body = asyncio.run(run())
+    assert body == {"data": [{"id": "ad-a"}, {"id": "ad-b"}]}

@@ -1,0 +1,40 @@
+"""dynamo-run CLI analog (dynamo_tpu/run.py): in=<input> out=<engine>.
+
+Reference analog: launch/dynamo-run (main.rs:30-33, opt.rs:6-17).
+"""
+
+import json
+import subprocess
+import sys
+
+
+def _run(args, input_text=None, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run", *args],
+        capture_output=True, text=True, timeout=timeout, input=input_text,
+        cwd="/root/repo",
+    )
+
+
+def test_text_in_echo_out():
+    """Echo engine + byte tokenizer: the output reproduces the prompt."""
+    r = _run(["in=text:hello", "out=echo", "--platform", "cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "hello" in r.stdout
+
+
+def test_batch_in_mocker_out(tmp_path):
+    f = tmp_path / "prompts.txt"
+    f.write_text("first prompt\nsecond prompt\n")
+    r = _run([f"in=batch:{f}", "out=mocker", "--max-tokens", "4",
+              "--speedup", "100", "--platform", "cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(l) for l in r.stdout.splitlines() if l.startswith("{")]
+    assert [l["index"] for l in lines] == [0, 1]
+    assert lines[0]["prompt"] == "first prompt"
+    assert all(l["text"] for l in lines)
+
+
+def test_bad_input_errors():
+    r = _run(["in=telepathy", "out=echo", "--platform", "cpu"])
+    assert r.returncode != 0

@@ -1,0 +1,137 @@
+"""TaskTracker (runtime/tasks.py): policies, hierarchy, graceful drain.
+
+Reference analog: lib/runtime/src/utils/tasks/tracker.rs + critical.rs.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.tasks import ErrorPolicy, TaskTracker
+
+
+def test_spawn_and_metrics():
+    async def run():
+        tr = TaskTracker()
+
+        async def work(x):
+            await asyncio.sleep(0.01)
+            return x * 2
+
+        handles = [tr.spawn(lambda x=i: work(x)) for i in range(5)]
+        results = await asyncio.gather(*handles)
+        assert sorted(results) == [0, 2, 4, 6, 8]
+        assert tr.metrics.ok == 5 and tr.metrics.failed == 0
+        assert tr.metrics.active == 0
+
+    asyncio.run(run())
+
+
+def test_concurrency_limit_is_enforced():
+    async def run():
+        tr = TaskTracker(max_concurrency=2)
+        running = [0]
+        peak = [0]
+
+        async def work():
+            running[0] += 1
+            peak[0] = max(peak[0], running[0])
+            await asyncio.sleep(0.02)
+            running[0] -= 1
+
+        await asyncio.gather(*[tr.spawn(work) for _ in range(8)])
+        assert peak[0] <= 2
+
+    asyncio.run(run())
+
+
+def test_fail_policy_records_and_continues():
+    async def run():
+        tr = TaskTracker(error_policy=ErrorPolicy.FAIL)
+
+        async def boom():
+            raise ValueError("x")
+
+        with pytest.raises(ValueError):
+            await tr.spawn(boom)
+        assert tr.metrics.failed == 1
+        assert not tr.closed  # FAIL does not close the tracker
+        ok = await tr.spawn(lambda: _ret(7))
+        assert ok == 7
+
+    async def _ret(v):
+        return v
+
+    asyncio.run(run())
+
+
+def test_shutdown_policy_cancels_tree():
+    """A critical task failing takes down the tracker AND its children."""
+
+    async def run():
+        tr = TaskTracker(error_policy=ErrorPolicy.SHUTDOWN)
+        child = tr.child("sub")
+        child_cancelled = asyncio.Event()
+
+        async def long_lived():
+            try:
+                await asyncio.sleep(30)
+            except asyncio.CancelledError:
+                child_cancelled.set()
+                raise
+
+        child.spawn(long_lived)
+
+        async def boom():
+            raise RuntimeError("critical")
+
+        with pytest.raises(RuntimeError):
+            await tr.spawn(boom)
+        await asyncio.wait_for(child_cancelled.wait(), 2.0)
+        assert tr.closed and child.closed
+        with pytest.raises(RuntimeError):
+            tr.spawn(long_lived)  # intake refused after shutdown
+        assert tr.metrics.rejected == 1
+
+    asyncio.run(run())
+
+
+def test_retry_policy():
+    async def run():
+        attempts = [0]
+        tr = TaskTracker(
+            error_policy=lambda e, tid: "retry", max_retries=3
+        )
+
+        async def flaky():
+            attempts[0] += 1
+            if attempts[0] < 3:
+                raise ValueError("flaky")
+            return "ok"
+
+        assert await tr.spawn(flaky) == "ok"
+        assert attempts[0] == 3
+
+    asyncio.run(run())
+
+
+def test_graceful_shutdown_drains_then_cancels():
+    async def run():
+        tr = TaskTracker()
+        finished = []
+
+        async def quick():
+            await asyncio.sleep(0.02)
+            finished.append("quick")
+
+        async def stuck():
+            await asyncio.sleep(60)
+
+        tr.spawn(quick)
+        tr.spawn(stuck)
+        ok = await tr.graceful_shutdown(timeout=0.2)
+        assert not ok               # the stuck task forced a cancel
+        assert finished == ["quick"]  # the quick one drained cleanly
+        assert tr.metrics.cancelled == 1
+
+    asyncio.run(run())

@@ -1,0 +1,96 @@
+"""Warm-restore weight cache (engine/warm.py): the chrek/CRIU analog.
+
+Reference analog: deploy/chrek (warmed-worker checkpoint/restore) +
+lib/gpu_memory_service crash-surviving weights; SURVEY §2.4 prescribes the
+host-cache + fast re-device_put design implemented here.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.warm import WarmWeightCache, _flatten, _unflatten
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+import jax
+
+
+def _cfg():
+    return LlamaConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        num_kv_heads=1, head_dim=16, intermediate_size=48,
+    )
+
+
+def test_flatten_roundtrip():
+    params = init_params(jax.random.PRNGKey(0), _cfg())
+    back = _unflatten(_flatten(params))
+    assert len(back["layers"]) == 2
+    np.testing.assert_array_equal(
+        np.asarray(params["embed"], np.float32),
+        np.asarray(back["embed"], np.float32),
+    )
+    for a, b in zip(params["layers"], back["layers"]):
+        assert set(a) == set(b)
+
+
+def test_save_load_roundtrip_bf16(tmp_path):
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    cache = WarmWeightCache(root=str(tmp_path))
+    assert not cache.has("src", cfg)
+    cache.save("src", cfg, params)
+    assert cache.has("src", cfg)
+
+    got = cache.load("src", cfg)
+    assert got is not None
+    # bf16 bytes survive exactly (stored as uint16 views)
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"][0]["wq"], np.float32),
+        np.asarray(jnp.asarray(got["layers"][0]["wq"]), np.float32),
+    )
+    assert got["layers"][0]["wq"].dtype == jnp.bfloat16.dtype
+
+    # a different config misses (no silent cross-model reuse)
+    other = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=3,
+                        num_heads=2, num_kv_heads=1, head_dim=16,
+                        intermediate_size=48)
+    assert cache.load("src", other) is None
+
+
+def test_corrupt_manifest_falls_back(tmp_path):
+    cfg = _cfg()
+    cache = WarmWeightCache(root=str(tmp_path))
+    cache.save("s", cfg, init_params(jax.random.PRNGKey(2), cfg))
+    # corrupt a tensor file
+    d = [p for p in tmp_path.iterdir() if p.is_dir()][0]
+    victim = next(p for p in d.iterdir() if p.name.endswith(".npy"))
+    victim.write_bytes(b"garbage")
+    assert cache.load("s", cfg) is None  # unreadable -> miss, not crash
+
+
+def test_load_params_warm_uses_cache(tmp_path, monkeypatch):
+    """Second load must come from the cache, not the checkpoint parser."""
+    import dynamo_tpu.engine.warm as warm
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    calls = []
+
+    def fake_load(path, c):
+        calls.append(path)
+        return params
+
+    monkeypatch.setattr("dynamo_tpu.engine.weights.load_params", fake_load)
+    cache = WarmWeightCache(root=str(tmp_path))
+    p1 = warm.load_params_warm("ckpt", cfg, cache)
+    assert calls == ["ckpt"]
+    p2 = warm.load_params_warm("ckpt", cfg, cache)
+    assert calls == ["ckpt"]  # no second parse
+    np.testing.assert_array_equal(
+        np.asarray(p1["final_norm"], np.float32),
+        np.asarray(jnp.asarray(p2["final_norm"]), np.float32),
+    )
