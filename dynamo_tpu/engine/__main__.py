@@ -88,6 +88,11 @@ def parse_args():
     p.add_argument("--lora-rank", type=int, default=16)
     p.add_argument("--no-warm-cache", action="store_true",
                    help="disable the host weight cache (engine/warm.py)")
+    p.add_argument("--logits-processors", default=None,
+                   help="named example processors to register, e.g. "
+                        "'ban=5,7,9;temperature=0.7;norepeat=2.0' — requests "
+                        "opt in via the logits_processors field "
+                        "(dynamo_tpu/logits_processing)")
     p.add_argument(
         "--disagg",
         choices=["none", "prefill", "decode"],
@@ -169,6 +174,28 @@ async def main() -> None:
             disk_path=args.kvbm_disk_path,
             remote=remote,
         )
+    logits_procs = ()
+    if args.logits_processors:
+        from dynamo_tpu.logits_processing import (
+            ban_tokens_processor,
+            repetition_window_processor,
+            temperature_processor,
+        )
+
+        built = []
+        for spec in args.logits_processors.split(";"):
+            pname, _, val = spec.strip().partition("=")
+            if pname == "ban":
+                built.append(("ban", ban_tokens_processor(
+                    [int(t) for t in val.split(",") if t]
+                )))
+            elif pname == "temperature":
+                built.append(("temperature", temperature_processor(float(val))))
+            elif pname == "norepeat":
+                built.append(("norepeat", repetition_window_processor(float(val))))
+            else:
+                raise SystemExit(f"unknown logits processor {pname!r}")
+        logits_procs = tuple(built)
     engine_cfg = TpuEngineConfig(
         model=mcfg,
         num_blocks=args.num_blocks,
@@ -180,6 +207,7 @@ async def main() -> None:
         prefill_buckets=buckets,
         lora_max_adapters=args.lora_max_adapters,
         lora_rank=args.lora_rank,
+        logits_processors=logits_procs,
     )
 
     import jax as _jax

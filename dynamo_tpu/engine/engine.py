@@ -101,6 +101,10 @@ class TpuEngineConfig:
     lora_max_adapters: int = 0
     lora_rank: int = 16
     lora_targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+    # pluggable logits processors (logits_processing/): STATIC (name, fn)
+    # pairs traced into the programs; requests opt in by name via the
+    # "logits_processors" annotation. () disables (zero hot-path cost).
+    logits_processors: Tuple[Tuple[str, Any], ...] = ()
 
     def __post_init__(self):
         bad = [b for b in self.prefill_buckets if b % self.block_size]
@@ -136,6 +140,10 @@ class _Seq:
     # final chunk dispatched, first-token readback in flight (the loop must
     # neither prefill this sequence again nor decode it yet)
     prefill_inflight: bool = False
+    # this request keeps output_counts maintained (penalties or an opted-in
+    # logits processor) — batchmates' rows accumulate too and must be reset
+    # before reuse
+    counting: bool = False
     done: bool = False
 
 
@@ -210,6 +218,9 @@ class TpuEngine:
         self._reps = np.ones(B, np.float32)
         self._lp_ns = np.zeros(B, np.int32)    # requested top-logprobs per slot
         self._lora_slots = np.zeros(B, np.int32)  # adapter slot per batch slot
+        self._lp_masks = np.zeros(
+            (B, max(1, len(config.logits_processors))), bool
+        )  # per-slot logits-processor opt-ins
         self._seeds = np.zeros(B, np.uint32)
         # penalty state tables (device-resident; see engine/sampling.py)
         V = self.mcfg.vocab_size
@@ -355,8 +366,28 @@ class TpuEngine:
         else:
             paged_attention = att.paged_decode_attention
 
+        procs = cfg.logits_processors
+
         def pen_need(pres, freqs, reps):
             return jnp.any((pres != 0.0) | (freqs != 0.0) | (reps != 1.0))
+
+        def counts_need(pres, freqs, reps, proc_masks):
+            """output_counts must be maintained for penalties AND for any
+            opted-in logits processor (processors read counts as documented
+            on-device state — logits_processing/)."""
+            need = pen_need(pres, freqs, reps)
+            if procs:
+                need = need | jnp.any(proc_masks)
+            return need
+
+        def run_procs(logits, masks, counts, steps, seq_lens):
+            if not procs:
+                return logits
+            from ..logits_processing import apply_processors
+
+            return apply_processors(procs, masks, logits, {
+                "output_counts": counts, "steps": steps, "seq_lens": seq_lens,
+            })
 
         def pack_step(toks, lps, tlp_vals, tlp_ids):
             """[B] toks/lps + [B,K] top-logprob rows -> one [B, 2+2K] f32 row
@@ -379,7 +410,7 @@ class TpuEngine:
                     block_table, new_block_ids, total_len, chunk_start, seeds,
                     steps, temp, top_k, top_p, min_p, pres, freq, rep,
                     prompt_masks, slot, lp_need, is_final, lora_tables,
-                    lora_id):
+                    lora_id, proc_masks):
             # tokens/positions: [S_pad] — ONE chunk of the prompt (the whole
             # prompt when it fits a bucket); block_table: [max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx):
@@ -409,11 +440,15 @@ class TpuEngine:
                     logits, jnp.zeros_like(logits, jnp.int32),
                     prompt_masks[slot][None], pres, freq, rep,
                 )
+                pen = run_procs(
+                    pen, proc_masks[slot][None],
+                    counts[slot][None], steps, total_len[None],
+                )
                 tok = sample_tokens(pen, seeds, steps, temp, top_k, top_p, min_p)
                 # the first generated token must enter the output counts, or
                 # the first decode step's penalties miss it
                 counts = jax.lax.cond(
-                    pen_need(pres, freq, rep),
+                    counts_need(pres, freq, rep, proc_masks[slot][None]),
                     lambda c: c.at[slot, tok[0]].add(1),
                     lambda c: c,
                     counts,
@@ -439,7 +474,7 @@ class TpuEngine:
         def decode(params, k_caches, v_caches, counts, tokens, positions,
                    block_tables, seq_lens, write_blocks, write_offsets, seeds,
                    steps, temps, top_ks, top_ps, min_ps, pres, freqs, reps,
-                   prompt_masks, lp_need, lora_tables, lora_ids):
+                   prompt_masks, lp_need, lora_tables, lora_ids, proc_masks):
             # tokens: [B]; block_tables: [B, max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx):
                 kc, vc = att.write_decode_kv(
@@ -456,9 +491,10 @@ class TpuEngine:
             )  # [B, 1, H]
             logits = logits_fn(params, mcfg, hidden[:, 0])  # [B, V]
             pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
+            pen = run_procs(pen, proc_masks, counts, steps, seq_lens)
             toks = sample_tokens(pen, seeds, steps, temps, top_ks, top_ps, min_ps)
             counts = update_counts(
-                counts, toks, seq_lens > 0, pen_need(pres, freqs, reps)
+                counts, toks, seq_lens > 0, counts_need(pres, freqs, reps, proc_masks)
             )
             lps = logprobs_of(logits, toks)
             tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
@@ -467,7 +503,7 @@ class TpuEngine:
         def decode_multi(params, k_caches, v_caches, counts, tokens, seq_lens,
                          block_tables, active, seeds, steps0, temps, top_ks,
                          top_ps, min_ps, pres, freqs, reps, prompt_masks,
-                         lp_need, lora_tables, lora_ids):
+                         lp_need, lora_tables, lora_ids, proc_masks):
             """cfg.decode_steps decode iterations in one program: each step
             writes the fed token's KV, attends, samples, and feeds the sample
             back — tokens only reach the host once per horizon. seq_lens==0
@@ -478,7 +514,7 @@ class TpuEngine:
             plus the device-resident carry (tokens/seq_lens/steps) that lets
             the loop dispatch the next horizon without any host round-trip."""
             bs = cfg.block_size
-            need_pen = pen_need(pres, freqs, reps)
+            need_pen = counts_need(pres, freqs, reps, proc_masks)
 
             def one_step(carry, s):
                 k_caches, v_caches, counts, tokens, seq_lens = carry
@@ -507,6 +543,7 @@ class TpuEngine:
                 )
                 logits = logits_fn(params, mcfg, hidden[:, 0])
                 pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
+                pen = run_procs(pen, proc_masks, counts, steps0 + s, seq_lens)
                 toks = sample_tokens(
                     pen, seeds, steps0 + s, temps, top_ks, top_ps, min_ps
                 )
@@ -582,6 +619,12 @@ class TpuEngine:
                 f"prompt {n_prompt} tokens cannot fit the KV pool "
                 f"({self.cfg.num_blocks} blocks x {self.cfg.block_size})"
             )
+        wanted_procs = req.annotations.get("logits_processors") or []
+        if wanted_procs:
+            known = {n for n, _ in self.cfg.logits_processors}
+            bad = [n for n in wanted_procs if n not in known]
+            if bad:
+                raise ValueError(f"unknown logits processors {bad!r}")
         lora_name = req.annotations.get("lora")
         if lora_name:
             if self.lora is None:
@@ -968,6 +1011,11 @@ class TpuEngine:
                 self.lora.slot_of(st.req.annotations.get("lora"))
                 if self.lora is not None else 0
             )
+            self._lp_masks[slot, :] = False
+            wanted = st.req.annotations.get("logits_processors") or []
+            for k, (pname, _fn) in enumerate(self.cfg.logits_processors):
+                if pname in wanted:
+                    self._lp_masks[slot, k] = True
             # penalty tables: reset the slot's rows when this request uses
             # penalties (needs a fresh prompt mask) or a prior occupant left
             # them dirty. One tiny async dispatch; skipped entirely on the
@@ -977,7 +1025,8 @@ class TpuEngine:
                 or s.frequency_penalty != 0.0
                 or s.repetition_penalty != 1.0
             )
-            if has_pen or self._slot_dirty[slot]:
+            st.counting = has_pen or bool(wanted)
+            if st.counting or self._slot_dirty[slot]:
                 row = np.zeros(self.mcfg.vocab_size, np.int8)
                 if has_pen:
                     row[np.asarray(st.seq.tokens(), np.int64)] = 1
@@ -985,7 +1034,18 @@ class TpuEngine:
                     self.prompt_masks, self.output_counts,
                     jnp.int32(slot), jnp.asarray(row),
                 )
-            self._slot_dirty[slot] = has_pen
+            # counts accumulate for EVERY active slot while anyone counts
+            # (update_counts scatters the full batch): a slot that shared a
+            # batch with a counting request holds stale counts the next
+            # occupant must not inherit
+            batch_counting = st.counting or any(
+                o is not None and o.counting for o in self._slots if o is not st
+            )
+            self._slot_dirty[slot] = batch_counting
+            if st.counting:
+                for j, other in enumerate(self._slots):
+                    if other is not None and other is not st:
+                        self._slot_dirty[j] = True
             admitted.append(st)
             log.debug(
                 "admit %s: %d tokens (%d cached), slot %d",
@@ -1062,6 +1122,7 @@ class TpuEngine:
             jnp.bool_(self._lp_ns[st.slot] > 0),
             jnp.bool_(is_final),
             self._lora_tables(), jnp.int32(self._lora_slots[st.slot]),
+            self._dev("proc_masks", self._lp_masks),
         )
         st.prefill_pos = total_len
         if not is_final:
@@ -1235,6 +1296,7 @@ class TpuEngine:
                 jnp.bool_(bool(np.any(self._lp_ns[active] > 0))),
                 self._lora_tables(),
                 self._dev("lora_slots", self._lora_slots),
+                self._dev("proc_masks", self._lp_masks),
             )
         )
         # start the D2H readback immediately: by the time this horizon's turn
@@ -1314,6 +1376,7 @@ class TpuEngine:
             jnp.asarray(self._freqs), jnp.asarray(self._reps),
             self.prompt_masks, jnp.bool_(lp_need),
             self._lora_tables(), jnp.asarray(self._lora_slots),
+            self._dev("proc_masks", self._lp_masks),
         )
         toks_np = np.asarray(toks)
         lps_np = np.asarray(lps)

@@ -85,6 +85,8 @@ class OpenAIPreprocessor:
         annotations = {ANNOTATION_INPUT_TOKENS: len(token_ids)}
         if getattr(request, "lora", None):
             annotations["lora"] = request.lora
+        if getattr(request, "logits_processors", None):
+            annotations["logits_processors"] = list(request.logits_processors)
         return PreprocessedRequest(
             request_id=request_id,
             model=request.model,

@@ -99,6 +99,8 @@ class ChatCompletionRequest(SamplingFields):
     # multi-LoRA: adapter name to apply (lora/adapters.py; reference routes
     # adapter-named models via its LoraRoutingTable)
     lora: Optional[str] = None
+    # named logits processors to enable (logits_processing/)
+    logits_processors: Optional[List[str]] = None
 
     @model_validator(mode="after")
     def _non_empty(self) -> "ChatCompletionRequest":
@@ -116,6 +118,7 @@ class CompletionRequest(SamplingFields):
     user: Optional[str] = None
     routing: Optional[Dict[str, Any]] = None
     lora: Optional[str] = None
+    logits_processors: Optional[List[str]] = None
 
 
 class EmbeddingRequest(_Lenient):

@@ -1,0 +1,91 @@
+"""Pluggable logits processors, redesigned for fused on-device sampling.
+
+Analog of the reference's ``dynamo.logits_processing`` (lib/bindings/python/
+src/dynamo/logits_processing/base.py): there, a processor is a host callback
+mutating one sequence's logits per step — viable when the engine round-trips
+logits to Python, impossible inside a fused XLA decode scan. The TPU-native
+contract instead:
+
+- a processor is a **jittable pure function** ``fn(logits, state) -> logits``
+  over the whole batch (``logits: [B, V] f32``); ``state`` exposes on-device
+  context (``output_counts [B, V]``, ``steps [B]``, ``seq_lens [B]``);
+- processors are registered at ENGINE BUILD (static set — XLA traces them
+  once into the prefill/decode programs);
+- requests opt in per processor by name (annotation
+  ``logits_processors: [names...]``); the engine turns that into a [B] mask
+  per processor and applies ``where(mask, fn(logits), logits)``, with the
+  whole thing behind one ``lax.cond`` so batches that use no processors pay
+  nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Protocol, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+
+
+@runtime_checkable
+class BaseLogitsProcessor(Protocol):
+    """``fn(logits [B, V] f32, state dict) -> logits`` — pure and jittable.
+
+    ``state`` keys: ``output_counts`` [B, V] int32, ``steps`` [B] int32
+    (tokens produced so far), ``seq_lens`` [B] int32."""
+
+    def __call__(self, logits: jax.Array, state: Dict[str, jax.Array]) -> jax.Array:
+        ...
+
+
+def apply_processors(
+    processors,                 # ((name, fn), ...) static
+    masks: jax.Array,           # [B, n_procs] bool — per-slot opt-in
+    logits: jax.Array,          # [B, V] f32
+    state: Dict[str, jax.Array],
+) -> jax.Array:
+    """Apply each enabled processor to its subscribing slots only."""
+    for k, (_name, fn) in enumerate(processors):
+        m = masks[:, k]
+
+        def on(l, m=m, fn=fn):
+            return jnp.where(m[:, None], fn(l, state), l)
+
+        logits = jax.lax.cond(jnp.any(m), on, lambda l: l, logits)
+    return logits
+
+
+# ---------------------------------------------------------------------------
+# example processors (reference examples/{temperature,hello_world}.py)
+# ---------------------------------------------------------------------------
+
+
+def temperature_processor(temperature: float) -> Callable:
+    """Extra temperature scaling ahead of the sampler (examples/temperature.py)."""
+    if temperature <= 0:
+        raise ValueError("temperature must be positive")
+
+    def fn(logits: jax.Array, state: Dict[str, jax.Array]) -> jax.Array:
+        return logits / temperature
+
+    return fn
+
+
+def ban_tokens_processor(token_ids) -> Callable:
+    """Hard-mask a fixed token set (the classic bad-words filter)."""
+    ids = jnp.asarray(list(token_ids), jnp.int32)
+
+    def fn(logits: jax.Array, state: Dict[str, jax.Array]) -> jax.Array:
+        return logits.at[:, ids].set(-1e30)
+
+    return fn
+
+
+def repetition_window_processor(penalty: float) -> Callable:
+    """Down-weight every token already generated (uses on-device counts —
+    context the reference's host callback gets via input_ids)."""
+
+    def fn(logits: jax.Array, state: Dict[str, jax.Array]) -> jax.Array:
+        seen = state["output_counts"] > 0
+        return jnp.where(seen, logits - penalty, logits)
+
+    return fn
