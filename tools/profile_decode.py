@@ -8,6 +8,12 @@ Times, on the real device, N-step scans of:
   - mlp+qkv matmuls only (no attention)
 
 Run: python tools/profile_decode.py [BATCH] [CTX]
+
+CAVEAT (measured on this axon-tunneled TPU): jax.block_until_ready() is
+effectively a no-op here, donated-arg jits compile a SECOND time on their
+second call, and readback RTT is ~70-170ms of pure latency. Numbers from
+this harness are only trustworthy when they force a data fetch (np.asarray)
+after a double warmup; prefer e2e bench.py or jax.profiler.trace.
 """
 
 import os

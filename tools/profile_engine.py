@@ -4,6 +4,12 @@ Unlike tools/profile_decode.py (a synthetic scan harness), this dispatches
 the exact production program with donation, measuring what serving pays.
 
 Env: B (batch), CTX, PALLAS=0/1, STEPS (horizon length).
+
+CAVEAT (measured on this axon-tunneled TPU): jax.block_until_ready() is
+effectively a no-op here, donated-arg jits compile a SECOND time on their
+second call, and readback RTT is ~70-170ms of pure latency. Numbers from
+this harness are only trustworthy when they force a data fetch (np.asarray)
+after a double warmup; prefer e2e bench.py or jax.profiler.trace.
 """
 
 import os
