@@ -60,6 +60,7 @@ class KVStoreServer:
         self.port = port
         self.store = MemKVStore()
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
 
     async def start(self) -> str:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -70,10 +71,20 @@ class KVStoreServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # py3.12 wait_closed() blocks until every connection handler
+            # returns, and clients hold connections open — cancel them
+            for t in list(self._conn_tasks):
+                t.cancel()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
         await self.store.close()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
         watch_tasks: Dict[int, asyncio.Task] = {}
         watchers: Dict[int, Watcher] = {}
         send_lock = asyncio.Lock()
@@ -147,6 +158,8 @@ class KVStoreServer:
                 w.cancel()
             for t in watch_tasks.values():
                 t.cancel()
+            if task is not None:
+                self._conn_tasks.discard(task)
             writer.close()
 
 

@@ -85,3 +85,23 @@ def test_disagg_example_has_both_pools():
     ]
     assert any("--disagg prefill" in c for c in cmds)
     assert any("--disagg decode" in c for c in cmds)
+
+
+def test_workers_wired_to_graph_blockstore():
+    """A graph declaring a kvbm service gets workers pointed at it
+    (--kvbm-remote), so the rendered deployment actually shares prefixes."""
+    g = GraphSpec.from_obj({
+        "name": "g2", "namespace": "ns",
+        "services": {
+            "w": {"kind": "worker", "tp": 1},
+            "blocks": {"kind": "kvbm"},
+        },
+    })
+    (ss,) = [o for o in render(g) if o["kind"] == "StatefulSet"]
+    cmd = ss["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--kvbm-remote" in cmd
+    assert "g2-blocks.ns.svc:7440" in cmd
+    # headless worker service carries no ports (API rejects port 0)
+    svcs = [o for o in render(g) if o["kind"] == "Service"
+            and o["spec"].get("clusterIP") == "None"]
+    assert svcs and all("ports" not in s["spec"] for s in svcs)

@@ -139,3 +139,33 @@ def test_make_store_tcp_and_runtime_integration():
             await server.stop()
 
     _run(run())
+
+
+def test_client_reconnects_after_server_restart():
+    """A dropped connection must not wedge the client: pending ops fail
+    fast, and the next op reconnects (review fix: the dead transport is
+    cleared even while watchers are registered)."""
+
+    async def run():
+        server, addr = await _server()
+        host, port = addr.rsplit(":", 1)
+        c = TcpKVStore(addr)
+        await c.put("k", b"v1")
+        w = await c.watch("k")  # active watcher exercises the cleanup path
+        ev = await asyncio.wait_for(w.__anext__(), 2.0)
+        assert ev.value == b"v1"
+        await server.stop()
+        await asyncio.sleep(0.1)
+        with pytest.raises((ConnectionError, OSError)):
+            await c.put("k", b"v2")
+        # server comes back on the same port
+        server2 = KVStoreServer(host="127.0.0.1", port=int(port))
+        await server2.start()
+        try:
+            await c.put("k", b"v3")          # transparent reconnect
+            assert await c.get("k") == b"v3"
+        finally:
+            await c.close()
+            await server2.stop()
+
+    _run(run())
