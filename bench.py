@@ -164,7 +164,26 @@ def fleet_metrics() -> dict:
 
 def main() -> None:
     batches = [int(b) for b in SWEEP.split(",") if b.strip()] or [BATCH]
-    results = [asyncio.run(run_bench(b)) for b in batches]
+    results = []
+    errors = []
+    for b in batches:
+        # a tunnel flake on one config must not sink the whole run: keep
+        # whatever measured and report the failures in detail
+        try:
+            results.append(asyncio.run(run_bench(b)))
+        except Exception as e:
+            errors.append({"batch": b, "error": repr(e)[:300]})
+            print(f"bench batch={b} failed: {e!r}", file=sys.stderr)
+    if not results:
+        print(json.dumps({
+            "metric": "decode_throughput_qwen3_0.6b",
+            "value": 0.0,
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,
+            "detail": {"errors": errors, "note": "all bench configs failed "
+                       "(device unreachable?); see errors"},
+        }))
+        return
     best = max(results, key=lambda r: r["vs_baseline"])
     best = dict(best)
     best["detail"] = dict(best["detail"])
@@ -178,6 +197,8 @@ def main() -> None:
             }
             for r in results
         ]
+    if errors:
+        best["detail"]["errors"] = errors
     if FLEET:
         try:
             best["detail"]["fleet"] = fleet_metrics()
