@@ -30,6 +30,14 @@ PRESETS = {
     "llama3-70b": LlamaConfig.llama3_70b,
     "tiny-moe": MoeConfig.tiny_moe,
     "qwen3-30b-a3b": MoeConfig.qwen3_30b_a3b,
+    "tiny-vl": lambda: LlamaConfig(),  # language side; vision below
+}
+
+from dynamo_tpu.models.vision import VisionConfig
+
+# vision towers paired with language presets (models/vision.py)
+VISION_PRESETS = {
+    "tiny-vl": lambda mcfg: VisionConfig.tiny(out_hidden_size=mcfg.hidden_size),
 }
 
 
@@ -130,6 +138,9 @@ async def main() -> None:
     else:
         mcfg = PRESETS[args.preset]()
         tokenizer_ref = args.tokenizer or "byte"
+    vcfg = None
+    if args.preset in VISION_PRESETS and not args.model_path:
+        vcfg = VISION_PRESETS[args.preset](mcfg)
 
     component = args.component
     model_type = ["chat", "completions", "embedding"]
@@ -208,6 +219,7 @@ async def main() -> None:
         lora_max_adapters=args.lora_max_adapters,
         lora_rank=args.lora_rank,
         logits_processors=logits_procs,
+        vision=vcfg,
     )
 
     import jax as _jax
@@ -277,6 +289,9 @@ async def main() -> None:
         context_length=args.max_context,
         kv_block_size=args.block_size,
         migration_limit=args.migration_limit,
+        image_tokens=(vcfg.num_patches if vcfg is not None else 0),
+        image_size=(vcfg.image_size if vcfg is not None else 0),
+        image_token_id=engine_cfg.image_token_id,
         runtime_config=ModelRuntimeConfig(
             total_kv_blocks=args.num_blocks,
             data_parallel_size=args.dp,

@@ -44,6 +44,7 @@ from ..llm.protocols.common import (
     PreprocessedRequest,
 )
 from ..models import llama, registry
+from ..models.vision import IMAGE_TOKEN_ID
 from ..ops import attention as att
 from ..parallel import mesh as meshlib
 from ..runtime.engine import Context
@@ -105,6 +106,11 @@ class TpuEngineConfig:
     # pairs traced into the programs; requests opt in by name via the
     # "logits_processors" annotation. () disables (zero hot-path cost).
     logits_processors: Tuple[Tuple[str, Any], ...] = ()
+    # multimodal: vision tower config (models/vision.py). Prompts carry
+    # image placeholder runs (image_token_id); prefill splices the encoded
+    # patch embeddings over them (inputs_embeds path in models/llama.py).
+    vision: Optional[Any] = None
+    image_token_id: int = IMAGE_TOKEN_ID
 
     def __post_init__(self):
         bad = [b for b in self.prefill_buckets if b % self.block_size]
@@ -144,6 +150,13 @@ class _Seq:
     # logits processor) — batchmates' rows accumulate too and must be reset
     # before reuse
     counting: bool = False
+    # multimodal: per-prompt-position soft-token override (image spans).
+    # mm_embeds [prompt_len, H] model-dtype, mm_mask [prompt_len] bool.
+    # Placeholder ids hash identically for different images, so mm requests
+    # opt out of the content-addressed prefix cache entirely (no_cache).
+    mm_embeds: Optional[np.ndarray] = None
+    mm_mask: Optional[np.ndarray] = None
+    no_cache: bool = False
     done: bool = False
 
 
@@ -258,6 +271,31 @@ class TpuEngine:
         self._offload_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-offload"
         )
+        # multimodal vision tower (models/vision.py) + encoder cache
+        self.vision_params = None
+        self._encode_image_fn = None
+        self.encoder_cache = None
+        if config.vision is not None:
+            if registry.is_moe(self.mcfg):
+                raise ValueError("multimodal serving covers the dense family only")
+            from ..llm.encoder_cache import EncoderCacheManager
+            from ..models import vision as vis
+
+            if config.vision.out_hidden_size != self.mcfg.hidden_size:
+                raise ValueError(
+                    "vision.out_hidden_size must match the language model "
+                    f"hidden size ({self.mcfg.hidden_size})"
+                )
+            with self.mesh:
+                self.vision_params = vis.init_params(
+                    jax.random.PRNGKey(config.seed + 1), config.vision
+                )
+            vcfg = config.vision
+            self._encode_image_fn = jax.jit(
+                lambda p, img: vis.encode(p, vcfg, img)
+            )
+            self.encoder_cache = EncoderCacheManager()
+        self._mm_zero: Dict[int, Tuple[jax.Array, jax.Array]] = {}
         # multi-LoRA adapter tables (static shapes; see lora/adapters.py)
         self.lora = None
         if config.lora_max_adapters > 0:
@@ -333,15 +371,27 @@ class TpuEngine:
         fwd, logits_fn = self._forward, self._lm_logits
         lora_enabled = self.lora is not None
 
-        def call_fwd(params, tokens, positions, attend, lora_tables, lora_ids):
-            if not lora_enabled:
-                return fwd(params, mcfg, tokens, positions, attend)
-            from ..lora import make_lora_fn
+        vision_enabled = cfg.vision is not None
 
-            return fwd(
-                params, mcfg, tokens, positions, attend,
-                lora=make_lora_fn(lora_tables, lora_ids),
-            )
+        def call_fwd(params, tokens, positions, attend, lora_tables, lora_ids,
+                     mm_embeds=None, mm_mask=None):
+            kw = {}
+            if lora_enabled:
+                from ..lora import make_lora_fn
+
+                kw["lora"] = make_lora_fn(lora_tables, lora_ids)
+            if mm_embeds is not None and vision_enabled:
+                # splice vision soft tokens over placeholder positions; the
+                # gather uses clipped ids (placeholders sit above the vocab)
+                safe = jnp.clip(tokens, 0, mcfg.vocab_size - 1)
+                base = params["embed"][safe]
+                kw["inputs_embeds"] = jnp.where(
+                    mm_mask[..., None], mm_embeds.astype(base.dtype), base
+                )
+                return fwd(params, mcfg, safe, positions, attend, **kw)
+            if not kw:
+                return fwd(params, mcfg, tokens, positions, attend)
+            return fwd(params, mcfg, tokens, positions, attend, **kw)
 
         use_pallas = cfg.use_pallas
         if use_pallas is None:
@@ -410,7 +460,7 @@ class TpuEngine:
                     block_table, new_block_ids, total_len, chunk_start, seeds,
                     steps, temp, top_k, top_p, min_p, pres, freq, rep,
                     prompt_masks, slot, lp_need, is_final, lora_tables,
-                    lora_id, proc_masks):
+                    lora_id, proc_masks, mm_embeds, mm_mask):
             # tokens/positions: [S_pad] — ONE chunk of the prompt (the whole
             # prompt when it fits a bucket); block_table: [max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx):
@@ -429,7 +479,10 @@ class TpuEngine:
                     )
                 return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
 
-            hidden = call_fwd(params, tokens, positions, attend, lora_tables, lora_id)
+            hidden = call_fwd(
+                params, tokens, positions, attend, lora_tables, lora_id,
+                mm_embeds=mm_embeds, mm_mask=mm_mask,
+            )
 
             def sample_branch(counts):
                 # logits at the last real token (positions are absolute; the
@@ -645,6 +698,9 @@ class TpuEngine:
             )
             return
         self._ensure_loop()
+        if req.annotations.get("images"):
+            if self.cfg.vision is None:
+                raise ValueError("engine built without a vision tower")
         all_tokens = list(req.token_ids) + list(req.prior_token_ids)
         st = _Seq(
             req=req,
@@ -653,6 +709,29 @@ class TpuEngine:
             seq=TokenBlockSequence(all_tokens, self.cfg.block_size),
             last_token=all_tokens[-1] if all_tokens else 0,
         )
+        if req.annotations.get("images"):
+            loop_mm = asyncio.get_event_loop()
+            st.mm_embeds, st.mm_mask = await loop_mm.run_in_executor(
+                self._executor, self._encode_images, req
+            )
+            # prior_token_ids (migration replay / disagg decode hop) extend
+            # the prompt past token_ids: pad the override arrays to the full
+            # prefill length (generated text is never an image span)
+            extra = len(all_tokens) - len(st.mm_mask)
+            if extra > 0:
+                st.mm_embeds = np.concatenate(
+                    [st.mm_embeds,
+                     np.zeros((extra, st.mm_embeds.shape[1]), np.float32)]
+                )
+                st.mm_mask = np.concatenate(
+                    [st.mm_mask, np.zeros(extra, bool)]
+                )
+            # placeholder ids hash identically across different images:
+            # never match or publish this prompt's blocks. (A future
+            # refinement: salt the block hashes with each image's content
+            # hash at its placeholder run, making mm prefixes cacheable
+            # instead of uncacheable.)
+            st.no_cache = True
         # disaggregated decode: pull the prefill worker's KV pages first so
         # admission sees them as a cached prefix (no recompute)
         if req.kv_transfer and req.kv_transfer.get("address"):
@@ -964,6 +1043,8 @@ class TpuEngine:
             # reuse at most the blocks strictly before the last prompt token so
             # prefill always has >=1 token to produce logits from
             reusable = min(len(hashes), (prompt_len - 1) // self.cfg.block_size)
+            if st.no_cache:
+                reusable = 0
             prefix_ids = self.allocator.acquire_prefix(hashes[:reusable])
             prefix_blocks = len(prefix_ids)
             blocks_needed = (
@@ -1029,7 +1110,10 @@ class TpuEngine:
             if st.counting or self._slot_dirty[slot]:
                 row = np.zeros(self.mcfg.vocab_size, np.int8)
                 if has_pen:
-                    row[np.asarray(st.seq.tokens(), np.int64)] = 1
+                    ids = np.asarray(st.seq.tokens(), np.int64)
+                    # image placeholders sit above the vocab: they are not
+                    # sampleable, so they simply don't enter the mask
+                    row[ids[ids < self.mcfg.vocab_size]] = 1
                 self.prompt_masks, self.output_counts = self._reset_slot_fn(
                     self.prompt_masks, self.output_counts,
                     jnp.int32(slot), jnp.asarray(row),
@@ -1067,6 +1151,8 @@ class TpuEngine:
         """Event-loop thread, after a chunk lands: content-address the prompt
         blocks whose KV the chunk just wrote (and queue their host-tier
         offload). Only written blocks ever become matchable."""
+        if st.no_cache:
+            return
         hashes = st.seq.sequence_hashes()
         upto = min(st.prefill_pos // self.cfg.block_size, len(hashes))
         for i in range(st.commit_upto, upto):
@@ -1123,6 +1209,7 @@ class TpuEngine:
             jnp.bool_(is_final),
             self._lora_tables(), jnp.int32(self._lora_slots[st.slot]),
             self._dev("proc_masks", self._lp_masks),
+            *self._mm_chunk(st, start, chunk_len, S_pad),
         )
         st.prefill_pos = total_len
         if not is_final:
@@ -1137,6 +1224,82 @@ class TpuEngine:
         want_tlp = self._lp_ns[st.slot] > 0
         return (st, tok, lp, tlp_ids if want_tlp else None,
                 tlp_vals if want_tlp else None)
+
+    def _mm_chunk(self, st: _Seq, start: int, chunk_len: int, S_pad: int):
+        """Per-chunk soft-token override arrays for the prefill program.
+        Tiny dummies when the engine has no vision tower (statically
+        ignored), zeros for text-only requests on a vision engine."""
+        if self.cfg.vision is None:
+            return (jnp.zeros((1, 1), self.mcfg.dtype), jnp.zeros((1,), bool))
+        H = self.mcfg.hidden_size
+        if st.mm_embeds is None:
+            # text-only request on a vision engine: reuse one cached zero
+            # pair per bucket instead of uploading S_pad x H zeros per chunk
+            cached = self._mm_zero.get(S_pad)
+            if cached is None:
+                cached = (
+                    jnp.zeros((S_pad, H), self.mcfg.dtype),
+                    jnp.zeros((S_pad,), bool),
+                )
+                self._mm_zero[S_pad] = cached
+            return cached
+        embeds = np.zeros((S_pad, H), np.float32)
+        mask = np.zeros((S_pad,), bool)
+        span = slice(start, start + chunk_len)
+        embeds[:chunk_len] = st.mm_embeds[span]
+        mask[:chunk_len] = st.mm_mask[span]
+        return (
+            jnp.asarray(embeds, self.mcfg.dtype), jnp.asarray(mask)
+        )
+
+    def _encode_images(self, req: PreprocessedRequest) -> Tuple[np.ndarray, np.ndarray]:
+        """Executor thread: decode+encode each image (through the encoder
+        cache) and splice the patch embeddings over the prompt's placeholder
+        runs. Returns (mm_embeds [L, H], mm_mask [L])."""
+        from ..llm.encoder_cache import content_hash
+
+        vcfg = self.cfg.vision
+        H = self.mcfg.hidden_size
+        tokens = np.asarray(req.token_ids, np.int64)
+        L = len(tokens)
+        embeds = np.zeros((L, H), np.float32)
+        mask = tokens == self.cfg.image_token_id
+        # contiguous placeholder runs, in order, one per image
+        runs: List[Tuple[int, int]] = []
+        i = 0
+        while i < L:
+            if mask[i]:
+                j = i
+                while j < L and mask[j]:
+                    j += 1
+                runs.append((i, j))
+                i = j
+            else:
+                i += 1
+        images = req.annotations.get("images") or []
+        if len(runs) != len(images):
+            raise ValueError(
+                f"prompt has {len(runs)} image placeholder runs but request "
+                f"carries {len(images)} images"
+            )
+        for (a, b), img in zip(runs, images):
+            data = img["data"]
+            key = content_hash(data)
+            feats = self.encoder_cache.get(key)
+            if feats is None:
+                arr = np.frombuffer(data, np.float32).reshape(img["shape"])
+                feats = np.asarray(
+                    self._encode_image_fn(self.vision_params, jnp.asarray(arr)),
+                    np.float32,
+                )
+                self.encoder_cache.set(key, feats)
+            if b - a != feats.shape[0]:
+                raise ValueError(
+                    f"image placeholder run of {b - a} tokens != "
+                    f"{feats.shape[0]} patch embeddings"
+                )
+            embeds[a:b] = feats
+        return embeds, mask
 
     def _run_embed(self, token_ids: List[int]) -> np.ndarray:
         S = len(token_ids)
@@ -1458,7 +1621,7 @@ class TpuEngine:
                 else:
                     sealed = st.seq.append(tok)
                     st.last_token = tok
-                    if sealed is not None:
+                    if sealed is not None and not st.no_cache:
                         self.allocator.commit(
                             st.block_ids[sealed.position], sealed.sequence_hash
                         )

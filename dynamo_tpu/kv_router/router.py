@@ -234,8 +234,15 @@ class KvRouter:
         token_ids: Sequence[int],
         candidates: Sequence[WorkerWithDpRank],
         request_id: Optional[str] = None,
+        cacheable: bool = True,
     ) -> SchedulingDecision:
+        """``cacheable=False`` (multimodal prompts: placeholder runs hash
+        identically across different images) keeps the request out of the
+        approx indexer and zeroes its overlap estimate — the engine will
+        never serve those blocks from cache."""
         hashes = compute_sequence_hashes(token_ids, self.block_size)
+        if not cacheable:
+            hashes = []
         overlaps = self.indexer.find_matches(hashes)
         tree_sizes = {c: self.indexer.tree.worker_block_count(c) for c in candidates}
         decision = self.scheduler.select_worker(
@@ -245,7 +252,7 @@ class KvRouter:
         self.scheduler.add_local_load(decision.worker, new_blocks)
         if request_id is not None:
             self._active[request_id] = (decision.worker, new_blocks)
-        if isinstance(self.indexer, ApproxKvIndexer):
+        if isinstance(self.indexer, ApproxKvIndexer) and cacheable:
             self.indexer.process_routed_request(hashes, decision.worker)
         if self.config.replica_sync and request_id is not None:
             msg = {

@@ -1,0 +1,62 @@
+"""Media decoding for multimodal requests.
+
+Analog of the reference's preprocessor media path (lib/llm/src/preprocessor/
+media/ — fetch + decode of image inputs before the engine sees them). Fully
+offline: ``data:`` URLs (base64 image bytes via PIL, or raw ``.npy``
+payloads) and local ``file://`` paths; remote http(s) fetch is refused (the
+serving tier has no egress by policy — front it with a fetcher if needed).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import urllib.parse
+
+import numpy as np
+
+from ..runtime.logging import get_logger
+
+log = get_logger("llm.media")
+
+
+def decode_image(url: str, image_size: int) -> np.ndarray:
+    """URL -> float32 RGB array [image_size, image_size, 3] in [0, 1]."""
+    if url.startswith("data:"):
+        header, _, b64 = url.partition(",")
+        raw = base64.b64decode(b64)
+        if "application/x-npy" in header:
+            arr = np.load(io.BytesIO(raw), allow_pickle=False)
+            return _normalize(arr, image_size)
+        return _decode_bytes(raw, image_size)
+    if url.startswith("file://"):
+        path = urllib.parse.urlparse(url).path
+        if path.endswith(".npy"):
+            return _normalize(np.load(path, allow_pickle=False), image_size)
+        with open(path, "rb") as f:
+            return _decode_bytes(f.read(), image_size)
+    raise ValueError(
+        f"unsupported image url scheme {url[:32]!r} (data: and file:// only)"
+    )
+
+
+def _decode_bytes(raw: bytes, image_size: int) -> np.ndarray:
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(raw)).convert("RGB")
+    img = img.resize((image_size, image_size), Image.BILINEAR)
+    return np.asarray(img, np.float32) / 255.0
+
+
+def _normalize(arr: np.ndarray, image_size: int) -> np.ndarray:
+    arr = np.asarray(arr, np.float32)
+    if arr.ndim != 3 or arr.shape[-1] != 3:
+        raise ValueError(f"expected [H, W, 3] image array, got {arr.shape}")
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if arr.shape[:2] != (image_size, image_size):
+        # nearest-neighbor resize without PIL dependency for arrays
+        ys = (np.arange(image_size) * arr.shape[0] / image_size).astype(int)
+        xs = (np.arange(image_size) * arr.shape[1] / image_size).astype(int)
+        arr = arr[ys][:, xs]
+    return np.ascontiguousarray(arr, np.float32)

@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
+from ..models.vision import IMAGE_TOKEN_ID as _IMAGE_TOKEN_ID
+
 MDC_PREFIX = "v1/mdc"
 
 MODEL_TYPE_CHAT = "chat"
@@ -67,6 +69,12 @@ class ModelDeploymentCard:
     # passes raw text through (reference: parser selection in lib/parsers)
     reasoning_parser: Optional[str] = None
     tool_parser: Optional[str] = None
+    # multimodal (models/vision.py): placeholder token id, soft tokens per
+    # image, and the square input size images are resized to. image_tokens=0
+    # means the model is text-only.
+    image_token_id: int = _IMAGE_TOKEN_ID
+    image_tokens: int = 0
+    image_size: int = 0
     runtime_config: ModelRuntimeConfig = dataclasses.field(default_factory=ModelRuntimeConfig)
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 

@@ -39,6 +39,50 @@ class OpenAIPreprocessor:
         prompt = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
         return self.tokenizer.encode(prompt)
 
+    def _has_images(self, request: ChatCompletionRequest) -> bool:
+        return self.card.image_tokens > 0 and any(
+            isinstance(m.content, list)
+            and any(p.get("type") == "image_url" for p in m.content)
+            for m in request.messages
+        )
+
+    def tokenize_chat_multimodal(self, request: ChatCompletionRequest):
+        """Chat messages with image parts -> (token_ids with placeholder
+        runs, decoded images). Multimodal prompts use plain role framing
+        (templates are text functions; image spans must stay byte-exact),
+        like the reference's media preprocessor path
+        (lib/llm/src/preprocessor/media/). Each image becomes
+        ``card.image_tokens`` placeholder ids; the engine splices the vision
+        tower's patch embeddings over them."""
+        from .media import decode_image
+
+        tokens: List[int] = []
+        images: List[dict] = []
+        for m in request.messages:
+            tokens.extend(self.tokenizer.encode(f"<|{m.role}|>\n"))
+            parts = m.content if isinstance(m.content, list) else [
+                {"type": "text", "text": m.content or ""}
+            ]
+            for part in parts:
+                if part.get("type") == "image_url":
+                    url = (part.get("image_url") or {}).get("url", "")
+                    arr = decode_image(url, self.card.image_size)
+                    images.append({
+                        "data": arr.tobytes(),
+                        "shape": list(arr.shape),
+                    })
+                    tokens.extend(
+                        [self.card.image_token_id] * self.card.image_tokens
+                    )
+                    # separator: adjacent image parts must stay distinct
+                    # placeholder RUNS (the engine maps one run per image)
+                    tokens.extend(self.tokenizer.encode("\n"))
+                elif part.get("type") == "text":
+                    tokens.extend(self.tokenizer.encode(part.get("text", "")))
+            tokens.extend(self.tokenizer.encode("\n"))
+        tokens.extend(self.tokenizer.encode("<|assistant|>\n"))
+        return tokens, images
+
     def tokenize_prompt(self, prompt: Union[str, List[int]]) -> List[int]:
         if isinstance(prompt, str):
             return self.tokenizer.encode(prompt)
@@ -98,6 +142,11 @@ class OpenAIPreprocessor:
 
     def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
         rid = new_request_id("chatcmpl")
+        if self._has_images(request):
+            tokens, images = self.tokenize_chat_multimodal(request)
+            preq = self._common(request, tokens, rid)
+            preq.annotations["images"] = images
+            return preq
         return self._common(request, self.tokenize_chat(request), rid)
 
     def preprocess_completion(

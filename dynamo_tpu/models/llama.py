@@ -224,9 +224,13 @@ def forward(
     positions: jax.Array,        # [..., S] int32
     attend: AttendFn,
     lora: Optional[Callable] = None,
+    inputs_embeds: Optional[jax.Array] = None,  # [..., S, hidden]
 ) -> jax.Array:
-    """Full stack -> final hidden states [..., S, hidden] (pre-lm_head)."""
-    x = params["embed"][token_ids]
+    """Full stack -> final hidden states [..., S, hidden] (pre-lm_head).
+
+    ``inputs_embeds`` replaces the embedding gather when given — the
+    multimodal path splices vision soft tokens in (models/vision.py)."""
+    x = params["embed"][token_ids] if inputs_embeds is None else inputs_embeds
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     cos, sin = cos[..., None, :], sin[..., None, :]  # broadcast over heads
     for i, layer in enumerate(params["layers"]):

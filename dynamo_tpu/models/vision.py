@@ -1,0 +1,129 @@
+"""Vision encoder for multimodal serving: ViT + projector, functional JAX.
+
+The reference serves vision-language models through its engines' multimodal
+paths (components/src/dynamo/vllm/main.py:887-1119 multimodal/encode inits,
+sglang/main.py:539-706); this framework owns the model, so the encoder is
+framework code: a standard ViT (patchify -> transformer -> per-patch
+features) plus a 2-layer MLP projector into the language model's hidden
+space — the LLaVA-style recipe. TPU notes: patchify is one reshape+matmul
+(MXU-friendly, no conv needed for square patches), everything bfloat16,
+static image size (resized host-side in the preprocessor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .llama import rms_norm
+
+Params = Dict[str, Any]
+
+# the placeholder token id marking image spans in prompts — one shared
+# sentinel well above any real vocab (engine config and model cards both
+# default to it)
+IMAGE_TOKEN_ID = 0x7F_FF_F0
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 256          # encoder width
+    num_layers: int = 6
+    num_heads: int = 4
+    intermediate_size: int = 688
+    out_hidden_size: int = 256      # language model hidden (projector out)
+    rms_norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return 3 * self.patch_size * self.patch_size
+
+    @classmethod
+    def tiny(cls, out_hidden_size: int = 256) -> "VisionConfig":
+        return cls(
+            image_size=28, patch_size=14, hidden_size=64, num_layers=2,
+            num_heads=2, intermediate_size=96, out_hidden_size=out_hidden_size,
+        )
+
+
+def init_params(rng: jax.Array, cfg: VisionConfig) -> Params:
+    ks = jax.random.split(rng, cfg.num_layers + 4)
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    s = 1.0 / math.sqrt(h)
+
+    def layer(k):
+        kk = jax.random.split(k, 4)
+        return {
+            "attn_norm": jnp.ones((h,), cfg.dtype),
+            "mlp_norm": jnp.ones((h,), cfg.dtype),
+            "wqkv": (jax.random.normal(kk[0], (h, 3 * h)) * s).astype(cfg.dtype),
+            "wo": (jax.random.normal(kk[1], (h, h)) * s).astype(cfg.dtype),
+            "w_up": (jax.random.normal(kk[2], (h, inter)) * s).astype(cfg.dtype),
+            "w_down": (
+                jax.random.normal(kk[3], (inter, h)) / math.sqrt(inter)
+            ).astype(cfg.dtype),
+        }
+
+    return {
+        "patch_embed": (
+            jax.random.normal(ks[0], (cfg.patch_dim, h)) / math.sqrt(cfg.patch_dim)
+        ).astype(cfg.dtype),
+        "pos_embed": (
+            jax.random.normal(ks[1], (cfg.num_patches, h)) * 0.02
+        ).astype(cfg.dtype),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "proj_up": (
+            jax.random.normal(ks[2], (h, cfg.out_hidden_size)) * s
+        ).astype(cfg.dtype),
+        "proj_down": (
+            jax.random.normal(ks[3], (cfg.out_hidden_size, cfg.out_hidden_size))
+            / math.sqrt(cfg.out_hidden_size)
+        ).astype(cfg.dtype),
+        "layers": [layer(ks[4 + i]) for i in range(cfg.num_layers)],
+    }
+
+
+def patchify(cfg: VisionConfig, image: jax.Array) -> jax.Array:
+    """[H, W, 3] float in [0,1] -> [num_patches, patch_dim]."""
+    p = cfg.patch_size
+    n = cfg.image_size // p
+    x = image.reshape(n, p, n, p, 3)
+    return x.transpose(0, 2, 1, 3, 4).reshape(n * n, 3 * p * p)
+
+
+def _attn(lp: Params, cfg: VisionConfig, x: jax.Array) -> jax.Array:
+    S = x.shape[0]
+    hd = cfg.hidden_size // cfg.num_heads
+    qkv = (x @ lp["wqkv"]).reshape(S, 3, cfg.num_heads, hd)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    scores = jnp.einsum("shd,thd->hst", q, k).astype(jnp.float32) / math.sqrt(hd)
+    w = jax.nn.softmax(scores, axis=-1)  # bidirectional: no causal mask
+    out = jnp.einsum("hst,thd->shd", w, v.astype(jnp.float32))
+    return (out.reshape(S, cfg.hidden_size).astype(x.dtype)) @ lp["wo"]
+
+
+def encode(params: Params, cfg: VisionConfig, image: jax.Array) -> jax.Array:
+    """[image_size, image_size, 3] -> projected patch features
+    [num_patches, out_hidden_size] (the language model's soft tokens)."""
+    x = patchify(cfg, image).astype(cfg.dtype) @ params["patch_embed"]
+    x = x + params["pos_embed"]
+    for lp in params["layers"]:
+        x = x + _attn(lp, cfg, rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps))
+        hmid = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + jax.nn.gelu((hmid @ lp["w_up"]).astype(jnp.float32)).astype(
+            x.dtype
+        ) @ lp["w_down"]
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    h = jax.nn.gelu((x @ params["proj_up"]).astype(jnp.float32)).astype(cfg.dtype)
+    return h @ params["proj_down"]

@@ -1,0 +1,286 @@
+"""Multimodal serving: vision tower (models/vision.py), encoder cache,
+media decoding, placeholder splice in the engine, and the HTTP chat path.
+
+Reference analogs: multimodal/encode worker inits
+(components/src/dynamo/vllm/main.py:887-1119, sglang/main.py:539-706),
+preprocessor media path (lib/llm/src/preprocessor/media/), encoder cache
+(components/src/dynamo/common/memory/encoder_cache_manager.py).
+"""
+
+import asyncio
+import base64
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.llm.encoder_cache import EncoderCacheManager, content_hash
+from dynamo_tpu.llm.media import decode_image
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import vision
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime.engine import Context
+
+IMG_TOK = 0x7F_FF_F0
+
+
+def _vcfg(h=64):
+    return vision.VisionConfig.tiny(out_hidden_size=h)
+
+
+def _mcfg():
+    return LlamaConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=96, dtype=jnp.float32,
+    )
+
+
+def _image(seed=0, size=28):
+    rng = np.random.default_rng(seed)
+    return rng.random((size, size, 3)).astype(np.float32)
+
+
+# ---------------------------------------------------------------- encoder
+def test_vision_encode_shapes_and_determinism():
+    vcfg = _vcfg()
+    params = vision.init_params(jax.random.PRNGKey(0), vcfg)
+    img = _image()
+    out = vision.encode(params, vcfg, jnp.asarray(img))
+    assert out.shape == (vcfg.num_patches, vcfg.out_hidden_size)
+    out2 = vision.encode(params, vcfg, jnp.asarray(img))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # different image -> different features
+    out3 = vision.encode(params, vcfg, jnp.asarray(_image(7)))
+    assert not np.array_equal(np.asarray(out), np.asarray(out3))
+
+
+def test_patchify_roundtrip_layout():
+    vcfg = _vcfg()
+    img = _image()
+    patches = vision.patchify(vcfg, jnp.asarray(img))
+    p = vcfg.patch_size
+    # first patch is the top-left block, row-major
+    np.testing.assert_allclose(
+        np.asarray(patches[0]), img[:p, :p, :].reshape(-1), rtol=1e-6
+    )
+
+
+# ---------------------------------------------------------------- cache
+def test_encoder_cache_lru_and_hash():
+    c = EncoderCacheManager(capacity_bytes=3000)
+    a = np.zeros((10, 25), np.float32)  # 1000 bytes
+    for i in range(4):
+        c.set(f"k{i}", a + i)
+    assert len(c) == 3          # capacity 3000 -> 3 entries
+    assert c.get("k0") is None  # evicted
+    assert c.get("k3") is not None
+    assert c.stats()["hits"] == 1 and c.stats()["misses"] == 1
+    d1, d2 = b"imgbytes", b"imgbytes2"
+    assert content_hash(d1) != content_hash(d2)
+    assert content_hash(d1) == content_hash(b"imgbytes")
+
+
+# ---------------------------------------------------------------- media
+def test_decode_image_data_urls():
+    # npy data url
+    arr = _image(3)
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    url = "data:application/x-npy;base64," + base64.b64encode(buf.getvalue()).decode()
+    got = decode_image(url, 28)
+    np.testing.assert_allclose(got, arr, rtol=1e-6)
+
+    # PNG via PIL
+    from PIL import Image
+
+    img8 = (arr * 255).astype(np.uint8)
+    pbuf = io.BytesIO()
+    Image.fromarray(img8).save(pbuf, format="PNG")
+    url = "data:image/png;base64," + base64.b64encode(pbuf.getvalue()).decode()
+    got = decode_image(url, 28)
+    assert got.shape == (28, 28, 3) and got.dtype == np.float32
+    assert 0.0 <= got.min() and got.max() <= 1.0
+
+    with pytest.raises(ValueError, match="scheme"):
+        decode_image("https://example.com/x.png", 28)
+
+
+# ---------------------------------------------------------------- engine
+def _engine():
+    return TpuEngine(TpuEngineConfig(
+        model=_mcfg(), num_blocks=128, block_size=16, max_batch_size=4,
+        max_context=128, prefill_buckets=(16, 32, 64), vision=_vcfg(64),
+    ))
+
+
+def _mm_req(rid, image, n_text=8, n_out=4):
+    vcfg = _vcfg()
+    tokens = list(range(n_text)) + [IMG_TOK] * vcfg.num_patches + [9, 10]
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=tokens,
+        stop=StopConditions(max_tokens=n_out, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+        annotations={"images": [
+            {"data": image.tobytes(), "shape": list(image.shape)}
+        ]},
+    )
+
+
+def test_engine_multimodal_changes_output_and_caches_encoder():
+    async def collect(engine, req):
+        toks = []
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.token_ids)
+        return toks
+
+    # strongly contrasting images: a tiny random tower's features for two
+    # near-identical noise images can legitimately pick the same argmax
+    img_a = np.zeros((28, 28, 3), np.float32)
+    img_b = np.ones((28, 28, 3), np.float32)
+
+    async def run():
+        engine = _engine()
+        try:
+            a = await collect(engine, _mm_req("a", img_a))
+            b = await collect(engine, _mm_req("b", img_b))
+            a2 = await collect(engine, _mm_req("a2", img_a))
+            stats = engine.encoder_cache.stats()
+            return a, b, a2, stats
+        finally:
+            engine.stop()
+
+    a, b, a2, stats = asyncio.run(run())
+    assert a != b, "different images must change the greedy stream"
+    assert a == a2, "same image must reproduce the stream"
+    assert stats["hits"] == 1 and stats["misses"] == 2
+
+
+def test_engine_multimodal_validation():
+    async def run():
+        # images on a text-only engine
+        text_engine = TpuEngine(TpuEngineConfig(
+            model=_mcfg(), num_blocks=64, block_size=16, max_batch_size=2,
+            max_context=64, prefill_buckets=(16, 32),
+        ))
+        with pytest.raises(ValueError, match="vision tower"):
+            async for _ in text_engine.generate(_mm_req("x", _image()), Context()):
+                pass
+        text_engine.stop()
+
+        # image count mismatch: an image supplied but no placeholder run
+        engine = _engine()
+        req = _mm_req("y", _image())
+        req.token_ids = list(range(10))  # placeholders stripped
+        with pytest.raises(ValueError, match="placeholder runs"):
+            async for _ in engine.generate(req, Context()):
+                pass
+        engine.stop()
+
+    asyncio.run(run())
+
+
+def test_multimodal_prompts_skip_prefix_cache():
+    """Identical placeholder prefixes with DIFFERENT images must not reuse
+    each other's KV (mm requests opt out of content addressing)."""
+
+    async def run():
+        engine = _engine()
+        try:
+            async for out in engine.generate(_mm_req("a", _image(1), n_text=16), Context()):
+                pass
+            assert engine.allocator.cached_blocks == 0, (
+                "mm prompt blocks must never become matchable"
+            )
+            cached = []
+            async for out in engine.generate(_mm_req("b", _image(2), n_text=16), Context()):
+                if out.annotations and "cached_tokens" in out.annotations:
+                    cached.append(out.annotations["cached_tokens"])
+            assert cached and cached[0] == 0
+        finally:
+            engine.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------- HTTP e2e
+async def test_vl_chat_over_http():
+    """Full path: chat message with an image_url part -> preprocessor
+    placeholder insertion + media decode -> worker engine splice -> the
+    image provably changes the completion."""
+    import aiohttp
+
+    from dynamo_tpu.llm import ModelDeploymentCard, register_llm
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+    from dynamo_tpu.runtime.discovery.store import MemKVStore
+    from dynamo_tpu.runtime.event_plane.base import InProcEventPlane
+
+    store = MemKVStore()
+
+    def rt():
+        cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+        return DistributedRuntime(cfg, store=store, event_plane=InProcEventPlane())
+
+    vcfg = _vcfg(64)
+    worker_rt = await rt().start()
+    frontend_rt = await rt().start()
+    card = ModelDeploymentCard(
+        name="vl-model", tokenizer="byte", context_length=128,
+        image_tokens=vcfg.num_patches, image_size=vcfg.image_size,
+        image_token_id=IMG_TOK,
+    )
+    served = await register_llm(worker_rt, _engine(), card)
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    for _ in range(100):
+        p = manager.get("vl-model")
+        if p and p.client.instances:
+            break
+        await asyncio.sleep(0.05)
+
+    def img_url(value: float) -> str:
+        buf = io.BytesIO()
+        np.save(buf, np.full((28, 28, 3), value, np.float32))
+        return "data:application/x-npy;base64," + base64.b64encode(
+            buf.getvalue()
+        ).decode()
+
+    async def ask(content):
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "vl-model", "max_tokens": 6, "ignore_eos": True,
+                      "messages": [{"role": "user", "content": content}]},
+            ) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+        return body["choices"][0]["message"]["content"]
+
+    try:
+        with_img0 = await ask([
+            {"type": "text", "text": "what is this?"},
+            {"type": "image_url", "image_url": {"url": img_url(0.0)}},
+        ])
+        with_img1 = await ask([
+            {"type": "text", "text": "what is this?"},
+            {"type": "image_url", "image_url": {"url": img_url(1.0)}},
+        ])
+        text_only = await ask("what is this?")
+        assert with_img0 != with_img1, "different images must change the reply"
+        assert with_img0 != text_only
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await served.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()
