@@ -760,6 +760,7 @@ class TpuEngine:
                 is_prefill_side
                 and item.finish_reason is not None
                 and self.transfer_address is not None
+                and not st.no_cache
             ):
                 prompt_blocks = len(req.token_ids) // self.cfg.block_size
                 item.kv_transfer = {

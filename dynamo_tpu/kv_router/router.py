@@ -234,16 +234,20 @@ class KvRouter:
         token_ids: Sequence[int],
         candidates: Sequence[WorkerWithDpRank],
         request_id: Optional[str] = None,
-        cacheable: bool = True,
+        cacheable: Optional[bool] = None,
     ) -> SchedulingDecision:
-        """``cacheable=False`` (multimodal prompts: placeholder runs hash
-        identically across different images) keeps the request out of the
-        approx indexer and zeroes its overlap estimate — the engine will
-        never serve those blocks from cache."""
+        """Multimodal prompts (image placeholder runs hash identically
+        across different images) must not produce overlap estimates or
+        enter the approx indexer — the engine never serves their blocks
+        from cache. Cacheability is derived from the tokens themselves
+        (placeholder sentinel present) unless the caller overrides; the
+        LOAD accounting keeps the true block count either way."""
+        if cacheable is None:
+            from ..models.vision import IMAGE_TOKEN_ID
+
+            cacheable = IMAGE_TOKEN_ID not in token_ids
         hashes = compute_sequence_hashes(token_ids, self.block_size)
-        if not cacheable:
-            hashes = []
-        overlaps = self.indexer.find_matches(hashes)
+        overlaps = self.indexer.find_matches(hashes if cacheable else [])
         tree_sizes = {c: self.indexer.tree.worker_block_count(c) for c in candidates}
         decision = self.scheduler.select_worker(
             candidates, overlaps, query_blocks=len(hashes), tree_sizes=tree_sizes

@@ -123,8 +123,7 @@ class ModelPipeline:
                 # attempt rather than round-robin back onto a dead worker
                 raise NoResponders(f"no non-excluded instances for {self.card.name}")
             decision = self.kv_router.schedule_tokens(
-                req.token_ids, cands, request_id=req.request_id,
-                cacheable=not req.annotations.get("images"),
+                req.token_ids, cands, request_id=req.request_id
             )
             instance_id = decision.worker.worker_id
             req.annotations[ANNOTATION_CACHED_TOKENS] = (

@@ -42,6 +42,11 @@ from ..protocols.openai import (
     EmbeddingResponse,
     ModelInfo,
     ModelList,
+    ResponseMessage,
+    ResponseObject,
+    ResponseOutputText,
+    ResponsesRequest,
+    ResponseUsage,
     Usage,
     new_request_id,
 )
@@ -126,6 +131,7 @@ class HttpService:
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/responses", self.responses)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/live", self.live)
@@ -439,6 +445,129 @@ class HttpService:
             self._inflight_g.set(self.inflight)
             self._requests.inc(model=model, status=status)
             self._input_tokens.inc(prompt_tokens, model=model)
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """/v1/responses adapter (reference openai.rs:1142): the request is
+        converted to a chat completion, run through the normal pipeline, and
+        the aggregated result converted back to a Response object. Streaming
+        emits Responses-style SSE events."""
+        busy = self._check_capacity()
+        if busy is not None:
+            return busy
+        try:
+            body = await request.json()
+            rreq = ResponsesRequest.model_validate(body)
+            chat = rreq.to_chat()
+        except (json.JSONDecodeError, ValueError) as e:
+            return _error(400, f"invalid request: {e}")
+        pipeline = self.manager.get(rreq.model)
+        if pipeline is None:
+            return _error(404, f"model '{rreq.model}' not found", "model_not_found")
+        try:
+            preq = pipeline.preprocessor.preprocess_chat(chat)
+        except ValueError as e:
+            return _error(400, str(e), "context_length_exceeded")
+        rid = preq.request_id.replace("chatcmpl-", "resp_")
+        ctx = Context(preq.request_id)
+        created = int(time.time())
+
+        def final_object(text: str, prompt_tokens: int, completion_tokens: int,
+                        status: str = "completed") -> ResponseObject:
+            return ResponseObject(
+                id=rid, created_at=created, model=rreq.model, status=status,
+                output=[ResponseMessage(
+                    id=rid + "-msg0",
+                    content=[ResponseOutputText(text=text)],
+                )],
+                usage=ResponseUsage(
+                    input_tokens=prompt_tokens, output_tokens=completion_tokens,
+                    total_tokens=prompt_tokens + completion_tokens,
+                ),
+            )
+
+        self.inflight += 1
+        self._inflight_g.set(self.inflight)
+        status = "200"
+        resp: Optional[web.StreamResponse] = None
+        prompt_tokens = completion_tokens = 0
+        span = self.tracer.span(
+            "http.responses",
+            traceparent=request.headers.get("traceparent"),
+            request_id=preq.request_id, model=rreq.model, streaming=rreq.stream,
+        )
+        preq.annotations["traceparent"] = span.traceparent()
+        span.__enter__()
+        try:
+            stream = self._observed(
+                pipeline.generate_tokens(preq, ctx), rreq.model, time.monotonic()
+            )
+            if not rreq.stream:
+                text = []
+                async for out in stream:
+                    if out.text:
+                        text.append(out.text)
+                    completion_tokens = out.cumulative_tokens or completion_tokens
+                    if out.annotations and "input_tokens" in out.annotations:
+                        prompt_tokens = out.annotations["input_tokens"]
+                obj = final_object("".join(text), prompt_tokens, completion_tokens)
+                return web.json_response(obj.model_dump(exclude_none=True))
+            resp = web.StreamResponse(headers=SSE_HEADERS)
+            await resp.prepare(request)
+
+            async def emit(event: str, data: dict) -> None:
+                await resp.write(
+                    f"event: {event}\ndata: {json.dumps(data)}\n\n".encode()
+                )
+
+            text = []
+            try:
+                await emit("response.created", {
+                    "type": "response.created",
+                    "response": {"id": rid, "object": "response",
+                                 "status": "in_progress", "model": rreq.model},
+                })
+                async for out in stream:
+                    if out.text:
+                        text.append(out.text)
+                        await emit("response.output_text.delta", {
+                            "type": "response.output_text.delta",
+                            "item_id": rid + "-msg0", "delta": out.text,
+                        })
+                    completion_tokens = out.cumulative_tokens or completion_tokens
+                    if out.annotations and "input_tokens" in out.annotations:
+                        prompt_tokens = out.annotations["input_tokens"]
+                obj = final_object("".join(text), prompt_tokens, completion_tokens)
+                await emit("response.completed", {
+                    "type": "response.completed",
+                    "response": obj.model_dump(exclude_none=True),
+                })
+                await resp.write_eof()
+            except _DISCONNECT:
+                status = "499"
+                ctx.kill()
+            return resp
+        except NoResponders:
+            status = "503"
+            return await self._fail(resp, 503, "no workers available", "service_unavailable")
+        except asyncio.CancelledError:
+            status = "499"
+            ctx.kill()
+            raise
+        except Exception as e:
+            log.exception("responses request %s failed", preq.request_id[:16])
+            status = "500"
+            return await self._fail(resp, 500, str(e), "internal_error")
+        finally:
+            self.inflight -= 1
+            self._inflight_g.set(self.inflight)
+            self._requests.inc(model=rreq.model, status=status)
+            self._input_tokens.inc(prompt_tokens, model=rreq.model)
+            self._output_tokens.inc(completion_tokens, model=rreq.model)
+            ctx.stop_generating()
+            span.set(status=status, completion_tokens=completion_tokens)
+            if status not in ("200", "499"):
+                span.status = "ERROR"
+            span.__exit__(None, None, None)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         busy = self._check_capacity()

@@ -30,7 +30,19 @@ def decode_image(url: str, image_size: int) -> np.ndarray:
             return _normalize(arr, image_size)
         return _decode_bytes(raw, image_size)
     if url.startswith("file://"):
-        path = urllib.parse.urlparse(url).path
+        import os
+
+        # arbitrary local reads driven by client URLs are a file-disclosure
+        # hole: file:// only works under an operator-allowlisted root
+        root = os.environ.get("DTPU_MEDIA_FILE_ROOT")
+        if not root:
+            raise ValueError(
+                "file:// image urls are disabled (set DTPU_MEDIA_FILE_ROOT "
+                "to an allowed directory to enable)"
+            )
+        path = os.path.realpath(urllib.parse.urlparse(url).path)
+        if not path.startswith(os.path.realpath(root) + os.sep):
+            raise ValueError("image path outside DTPU_MEDIA_FILE_ROOT")
         if path.endswith(".npy"):
             return _normalize(np.load(path, allow_pickle=False), image_size)
         with open(path, "rb") as f:

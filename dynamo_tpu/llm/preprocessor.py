@@ -40,11 +40,18 @@ class OpenAIPreprocessor:
         return self.tokenizer.encode(prompt)
 
     def _has_images(self, request: ChatCompletionRequest) -> bool:
-        return self.card.image_tokens > 0 and any(
+        has = any(
             isinstance(m.content, list)
             and any(p.get("type") == "image_url" for p in m.content)
             for m in request.messages
         )
+        if has and self.card.image_tokens <= 0:
+            # silently dropping the image would produce a confident answer
+            # about content the model never saw
+            raise ValueError(
+                f"model {self.card.name!r} does not accept image input"
+            )
+        return has
 
     def tokenize_chat_multimodal(self, request: ChatCompletionRequest):
         """Chat messages with image parts -> (token_ids with placeholder

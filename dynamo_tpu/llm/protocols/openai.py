@@ -121,6 +121,83 @@ class CompletionRequest(SamplingFields):
     logits_processors: Optional[List[str]] = None
 
 
+class ResponsesRequest(_Lenient):
+    """/v1/responses (reference openai.rs:1142 handler_responses): converted
+    to a chat request internally, text inputs only."""
+
+    model: str
+    # string, or a list of {role, content} items (content: string or
+    # [{type: "input_text"/"output_text"/"text", text}] parts)
+    input: Union[str, List[Dict[str, Any]]]
+    instructions: Optional[str] = None
+    max_output_tokens: Optional[int] = Field(default=None, ge=1)
+    temperature: Optional[float] = Field(default=None, ge=0.0, le=2.0)
+    top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
+    stream: bool = False
+    user: Optional[str] = None
+
+    def to_chat(self) -> "ChatCompletionRequest":
+        messages: List[ChatMessage] = []
+        if self.instructions:
+            messages.append(ChatMessage(role="system", content=self.instructions))
+        if isinstance(self.input, str):
+            messages.append(ChatMessage(role="user", content=self.input))
+        else:
+            for item in self.input:
+                content = item.get("content", "")
+                if isinstance(content, list):
+                    content = "".join(
+                        p.get("text", "") for p in content
+                        if p.get("type") in ("input_text", "output_text", "text")
+                    )
+                role = item.get("role", "user")
+                if role not in ("system", "user", "assistant", "tool", "developer"):
+                    role = "user"
+                messages.append(ChatMessage(role=role, content=content))
+        return ChatCompletionRequest(
+            model=self.model, messages=messages,
+            max_tokens=self.max_output_tokens,
+            temperature=self.temperature, top_p=self.top_p,
+            stream=self.stream, user=self.user,
+        )
+
+
+class ResponseOutputText(BaseModel):
+    type: Literal["output_text"] = "output_text"
+    text: str = ""
+    annotations: List[Any] = []
+
+
+class ResponseMessage(BaseModel):
+    id: str
+    type: Literal["message"] = "message"
+    role: str = "assistant"
+    status: str = "completed"
+    content: List[ResponseOutputText]
+
+
+class ResponseUsage(BaseModel):
+    input_tokens: int = 0
+    output_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ResponseObject(BaseModel):
+    id: str
+    object: Literal["response"] = "response"
+    created_at: int
+    status: str = "completed"
+    model: str
+    output: List[ResponseMessage]
+    usage: Optional[ResponseUsage] = None
+
+    @property
+    def output_text(self) -> str:
+        return "".join(
+            part.text for msg in self.output for part in msg.content
+        )
+
+
 class EmbeddingRequest(_Lenient):
     model: str
     input: Union[str, List[str], List[int], List[List[int]]]

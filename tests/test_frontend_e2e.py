@@ -346,3 +346,63 @@ async def test_kv_routing_mode_e2e():
         await s2.stop()
         await worker_rt.shutdown()
         await frontend_rt.shutdown()
+
+
+async def test_responses_endpoint():
+    """/v1/responses adapter (reference openai.rs:1142): aggregated and
+    streaming, converted through the chat pipeline."""
+    import aiohttp
+
+    store = MemKVStore()
+    stack = await start_stack(store)
+    base = stack[-1]
+    try:
+        async with aiohttp.ClientSession() as s:
+            # aggregated
+            async with s.post(f"{base}/v1/responses", json={
+                "model": "echo-model", "input": "hello resp",
+                "max_output_tokens": 64, "instructions": "be brief",
+            }) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            assert body["object"] == "response"
+            assert body["status"] == "completed"
+            assert body["id"].startswith("resp_")
+            text = body["output"][0]["content"][0]["text"]
+            assert "hello resp" in text  # echo engine returns the prompt
+            assert body["usage"]["output_tokens"] > 0
+
+            # structured input list form
+            async with s.post(f"{base}/v1/responses", json={
+                "model": "echo-model",
+                "input": [{"role": "user", "content": [
+                    {"type": "input_text", "text": "part one"},
+                ]}],
+                "max_output_tokens": 64,
+            }) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert "part one" in body["output"][0]["content"][0]["text"]
+
+            # streaming: typed SSE events
+            events = []
+            async with s.post(f"{base}/v1/responses", json={
+                "model": "echo-model", "input": "stream me",
+                "max_output_tokens": 16, "stream": True,
+            }) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("event: "):
+                        events.append(line[7:])
+            assert events[0] == "response.created"
+            assert "response.output_text.delta" in events
+            assert events[-1] == "response.completed"
+
+            # unknown model -> 404
+            async with s.post(f"{base}/v1/responses", json={
+                "model": "ghost", "input": "x",
+            }) as r:
+                assert r.status == 404
+    finally:
+        await stop_stack(*stack[:-1])
