@@ -284,3 +284,25 @@ async def test_vl_chat_over_http():
         await served.stop()
         await worker_rt.shutdown()
         await frontend_rt.shutdown()
+
+
+def test_multimodal_with_prior_tokens():
+    """Migration replay / disagg decode hop: prior_token_ids extend the
+    prompt past token_ids — the mm override arrays must cover the full
+    prefill length (regression: short-RHS numpy assignment crashed the
+    engine loop)."""
+
+    async def run():
+        engine = _engine()
+        try:
+            req = _mm_req("mig", np.ones((28, 28, 3), np.float32))
+            req.prior_token_ids = [7, 8, 9]  # replayed generated tokens
+            toks = []
+            async for out in engine.generate(req, Context()):
+                toks.extend(out.token_ids)
+            assert len(toks) == 4
+            assert engine.healthy
+        finally:
+            engine.stop()
+
+    asyncio.run(run())
