@@ -477,6 +477,23 @@ class TpuEngine:
                         self.mesh, q, k_new, v_new, k_ctx, v_ctx,
                         positions, chunk_start, chunk_start,
                     )
+                from ..ops import pallas_prefill as pf
+
+                if (
+                    use_pallas
+                    and meshlib.tp_size(self.mesh) == 1
+                    and q.shape[0] % pf.Q_TILE == 0
+                    and k_ctx.shape[0] % pf.KV_TILE == 0
+                ):
+                    # flash extend kernel (ops/pallas_prefill): O(tile) VMEM
+                    # vs the dense [S, h, T] score tensor. tp=1 only: GSPMD
+                    # cannot partition a pallas_call (the decode kernel
+                    # shard_maps for TP; prefill keeps the dense path there).
+                    # Shapes that miss the tile grid fall back too.
+                    return pf.flash_extend_attention(
+                        q, k_ctx, v_ctx, positions, total_len,
+                        interpret=interp,
+                    )
                 return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
 
             hidden = call_fwd(

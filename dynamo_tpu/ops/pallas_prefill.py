@@ -1,0 +1,160 @@
+"""Pallas TPU kernel: flash prefix-extend attention for chunked prefill.
+
+Drop-in replacement for ``ops.attention.extend_attention`` on the prefill hot
+path. The pure-JAX formulation materializes the full [S, h, T] score tensor
+(67 MB of f32 per head at an 8k context) and re-reads it for softmax and PV;
+this kernel streams KV tiles through VMEM with online-softmax accumulation —
+O(tile) VMEM at any context length, the standard flash-attention recipe
+tiled for the MXU.
+
+The TPU analog of the prefill-side flash kernels the reference's engines use
+internally (vLLM/TRT-LLM chunked-prefill attention; SURVEY §2.5). Shares the
+contiguous gathered-KV layout of ops/attention.py: the engine gathers pages
+once per chunk, and this kernel replaces only the attention math.
+
+Grid: (kv_heads, q_tiles, kv_tiles) — the LAST dim iterates sequentially on
+TPU, so the online-softmax state (m/l/acc) lives in VMEM scratch carried
+across kv steps; K/V arrive one (kv_tile, d) block at a time via BlockSpecs.
+Tiles entirely past this q-tile's attention limit skip their matmuls
+(``pl.when``). Causality is absolute-position based (``q_positions`` vs key
+index), so the same kernel serves first-chunk prefill, chunked continuation
+against a cached prefix, and prefix-cache-reuse suffixes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# default tile sizes; the engine's eligibility guard imports these so the
+# two never drift (engine/engine.py prefill attend)
+Q_TILE = 128
+KV_TILE = 256
+
+
+def _prefill_kernel(
+    qpos_ref,    # SMEM [S] int32 absolute q positions (scalar prefetch)
+    tlen_ref,    # SMEM [1] int32 valid context length (scalar prefetch)
+    q_ref,       # VMEM [1, TQ, g, d] this (kv_head, q_tile)'s queries
+    k_ref,       # VMEM [1, KT, d] one KV tile of this kv_head's context
+    v_ref,       # VMEM [1, KT, d]
+    o_ref,       # VMEM [1, TQ, g, d]
+    m_scr,       # VMEM [TQ*g, 1] f32 online-softmax running max
+    l_scr,       # VMEM [TQ*g, 1] f32 running denominator
+    acc_scr,     # VMEM [TQ*g, d] f32 running numerator
+):
+    qt = pl.program_id(1)
+    c = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    _, TQ, g, d = q_ref.shape
+    KT = k_ref.shape[1]
+
+    @pl.when(c == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # per-row attention limit: keys at index < min(q_pos+1, total_len)
+    row_pos = qpos_ref[pl.ds(qt * TQ, TQ)]                     # [TQ]
+    limit = jnp.minimum(row_pos + 1, tlen_ref[0])              # [TQ]
+    tile_hi = jnp.max(limit)                                   # scalar
+
+    @pl.when(c * KT < tile_hi)
+    def _tile():
+        scale = 1.0 / (d ** 0.5)
+        q2 = (q_ref[0].astype(jnp.float32) * scale).reshape(TQ * g, d)
+        lim2 = jnp.broadcast_to(limit[:, None], (TQ, g)).reshape(TQ * g, 1)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q2, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                      # [TQ*g, KT]
+        key_pos = c * KT + jax.lax.broadcasted_iota(jnp.int32, (1, KT), 1)
+        s = jnp.where(key_pos < lim2, s, NEG_INF)
+
+        m_prev, l_prev, acc = m_scr[...], l_scr[...], acc_scr[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = alpha * acc + jax.lax.dot_general(
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(c == n_kv - 1)
+    def _emit():
+        out = acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = out.reshape(TQ, g, d).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("q_tile", "kv_tile", "interpret")
+)
+def flash_extend_attention(
+    q: jax.Array,            # [S, h, d] new-chunk queries
+    k_ctx: jax.Array,        # [T, kvh, d] gathered context (padded)
+    v_ctx: jax.Array,
+    q_positions: jax.Array,  # [S] absolute positions
+    total_len: jax.Array,    # scalar valid context length
+    *,
+    q_tile: int = Q_TILE,
+    kv_tile: int = KV_TILE,
+    interpret: bool = False,
+) -> jax.Array:
+    """Same semantics as ``ops.attention.extend_attention``; S and T must be
+    multiples of the tile sizes (the engine's bucketed chunks are)."""
+    S, h, d = q.shape
+    T, kvh, _ = k_ctx.shape
+    g = h // kvh
+    if S % q_tile or T % kv_tile:
+        raise ValueError(
+            f"S={S} / T={T} not multiples of tiles ({q_tile}, {kv_tile})"
+        )
+    nq = S // q_tile
+    nkv = T // kv_tile
+
+    # [S, h, d] -> [kvh, S, g, d]: each kv head's q group contiguous
+    qg = q.reshape(S, kvh, g, d).transpose(1, 0, 2, 3)
+    kg = k_ctx.transpose(1, 0, 2)  # [kvh, T, d]
+    vg = v_ctx.transpose(1, 0, 2)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(kvh, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, q_tile, g, d), lambda kh, qt, c, *_: (kh, qt, 0, 0)),
+            pl.BlockSpec((1, kv_tile, d), lambda kh, qt, c, *_: (kh, c, 0)),
+            pl.BlockSpec((1, kv_tile, d), lambda kh, qt, c, *_: (kh, c, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, q_tile, g, d), lambda kh, qt, c, *_: (kh, qt, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((q_tile * g, 1), jnp.float32),
+            pltpu.VMEM((q_tile * g, 1), jnp.float32),
+            pltpu.VMEM((q_tile * g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        _prefill_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((kvh, S, g, d), q.dtype),
+        interpret=interpret,
+    )(
+        q_positions.astype(jnp.int32),
+        jnp.asarray(total_len, jnp.int32).reshape(1),
+        qg, kg, vg,
+    )
+    # [kvh, S, g, d] -> [S, h, d]
+    return out.transpose(1, 0, 2, 3).reshape(S, h, d)

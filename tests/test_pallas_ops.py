@@ -114,3 +114,52 @@ def test_sharded_wrapper_single_tp():
     )
     ref = att.paged_decode_attention(q, kc, vc, tables, lens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+class TestFlashExtendAttention:
+    """ops/pallas_prefill.py: flash chunked-prefill attention (interpreter
+    on CPU; the engine auto-enables it on TPU at tp=1 for tile-aligned
+    buckets)."""
+
+    def _data(self, S=128, T=256, h=8, kvh=4, d=32, seed=0):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.standard_normal((S, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((T, kvh, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((T, kvh, d)), jnp.float32)
+        return q, k, v
+
+    def test_matches_dense_first_chunk(self):
+        from dynamo_tpu.ops.attention import extend_attention
+        from dynamo_tpu.ops.pallas_prefill import flash_extend_attention
+
+        q, k, v = self._data()
+        qpos = jnp.arange(128, dtype=jnp.int32)
+        ref = extend_attention(q, k, v, qpos, jnp.int32(128))
+        got = flash_extend_attention(
+            q, k, v, qpos, jnp.int32(128), q_tile=64, kv_tile=64, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+    def test_matches_dense_chunked_continuation(self):
+        """Chunk starting mid-context against a cached prefix, with padded
+        (invalid) tail keys masked by total_len."""
+        from dynamo_tpu.ops.attention import extend_attention
+        from dynamo_tpu.ops.pallas_prefill import flash_extend_attention
+
+        q, k, v = self._data()
+        qpos = jnp.arange(100, 228, dtype=jnp.int32)
+        ref = extend_attention(q, k, v, qpos, jnp.int32(228))
+        got = flash_extend_attention(
+            q, k, v, qpos, jnp.int32(228), q_tile=64, kv_tile=64, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+    def test_rejects_unaligned_tiles(self):
+        from dynamo_tpu.ops.pallas_prefill import flash_extend_attention
+
+        q, k, v = self._data(S=100)
+        with pytest.raises(ValueError, match="multiples"):
+            flash_extend_attention(
+                q, k, v, jnp.arange(100, dtype=jnp.int32), jnp.int32(100),
+                q_tile=64, kv_tile=64, interpret=True,
+            )
