@@ -90,11 +90,12 @@ class TpuEngineConfig:
     # latency amortizes over N tokens. Stop conditions are applied host-side
     # post-hoc (at most N-1 speculatively-decoded tokens are discarded).
     decode_steps: int = 16
-    # in-flight decode horizons: results of horizon N are fetched only after
-    # horizon N+depth-1 is dispatched, so the device->host readback RTT
-    # (hundreds of ms on tunneled TPUs) hides behind `depth-1` horizons of
-    # device compute. Each extra slot adds decode_steps tokens of emission
-    # latency and speculation waste at stop.
+    # in-flight decode horizons: each horizon's result readback starts at
+    # dispatch on the fetch pool, so with depth>=2 the device->host RTT
+    # (measured ~70-170 ms on tunneled TPUs; latency, not bandwidth —
+    # concurrent fetches overlap) hides behind the next horizon's compute.
+    # Each extra slot adds decode_steps tokens of emission latency and
+    # speculation waste at stop; measured best on v5e: depth 2.
     decode_pipeline: int = 2
     # multi-LoRA serving (lora/adapters.py): N static adapter slots baked
     # into the programs at build; hot-load/unload are in-place table updates
