@@ -482,16 +482,15 @@ class TpuEngine:
 
                 if (
                     use_pallas
-                    and meshlib.tp_size(self.mesh) == 1
                     and q.shape[0] % pf.Q_TILE == 0
                     and k_ctx.shape[0] % pf.KV_TILE == 0
                 ):
                     # flash extend kernel (ops/pallas_prefill): O(tile) VMEM
-                    # vs the dense [S, h, T] score tensor. tp=1 only: GSPMD
-                    # cannot partition a pallas_call (the decode kernel
-                    # shard_maps for TP; prefill keeps the dense path there).
-                    # Shapes that miss the tile grid fall back too.
-                    return pf.flash_extend_attention(
+                    # vs the dense [S, h, T] score tensor; TP rides a
+                    # shard_map over heads (GSPMD cannot partition a custom
+                    # call). Shapes that miss the tile grid fall back.
+                    return pf.sharded_flash_extend_attention(
+                        self.mesh, meshlib.AXIS_TP,
                         q, k_ctx, v_ctx, positions, total_len,
                         interpret=interp,
                     )

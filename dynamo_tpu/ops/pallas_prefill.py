@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
 
@@ -158,3 +159,37 @@ def flash_extend_attention(
     )
     # [kvh, S, g, d] -> [S, h, d]
     return out.transpose(1, 0, 2, 3).reshape(S, h, d)
+
+
+def sharded_flash_extend_attention(
+    mesh: Mesh,
+    tp_axis: str,
+    q: jax.Array,
+    k_ctx: jax.Array,
+    v_ctx: jax.Array,
+    q_positions: jax.Array,
+    total_len: jax.Array,
+    **kw,
+) -> jax.Array:
+    """TP-sharded wrapper: extend attention is head-wise independent, so each
+    TP shard runs the kernel on its own heads (q sharded on h, context on
+    kvh). shard_map because GSPMD cannot partition a custom call — the same
+    treatment as pallas_attention.sharded_paged_decode_attention."""
+    if mesh.shape[tp_axis] == 1:
+        return flash_extend_attention(
+            q, k_ctx, v_ctx, q_positions, total_len, **kw
+        )
+    fn = jax.shard_map(
+        functools.partial(flash_extend_attention, **kw),
+        mesh=mesh,
+        in_specs=(
+            P(None, tp_axis, None),
+            P(None, tp_axis, None),
+            P(None, tp_axis, None),
+            P(None),
+            P(),
+        ),
+        out_specs=P(None, tp_axis, None),
+        check_vma=False,
+    )
+    return fn(q, k_ctx, v_ctx, q_positions, total_len)

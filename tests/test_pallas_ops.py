@@ -163,3 +163,20 @@ class TestFlashExtendAttention:
                 q, k, v, jnp.arange(100, dtype=jnp.int32), jnp.int32(100),
                 q_tile=64, kv_tile=64, interpret=True,
             )
+
+    def test_tp_sharded_matches_dense(self):
+        """shard_map'd flash extend over a tp=2 mesh == dense single-device
+        (heads split across shards; the engine uses this under TP)."""
+        from dynamo_tpu.ops.attention import extend_attention
+        from dynamo_tpu.ops.pallas_prefill import sharded_flash_extend_attention
+        from dynamo_tpu.parallel.mesh import AXIS_TP, make_mesh
+
+        q, k, v = self._data(h=8, kvh=4)
+        qpos = jnp.arange(100, 228, dtype=jnp.int32)
+        ref = extend_attention(q, k, v, qpos, jnp.int32(228))
+        mesh = make_mesh(tp=2)
+        got = sharded_flash_extend_attention(
+            mesh, AXIS_TP, q, k, v, qpos, jnp.int32(228),
+            q_tile=64, kv_tile=64, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
