@@ -108,49 +108,23 @@ def parse_args():
         help="prefill: join the prefill pool + serve kv_fetch; decode: serve "
         "decode with remote-KV import (also serves kv_fetch for peers)",
     )
+    p.add_argument(
+        "--multihost", default=None,
+        metavar="COORD:PORT,NPROCS,PROC_ID[,CONTROL:PORT]",
+        help="multi-process serving over one jax.distributed mesh: process 0 "
+        "owns the endpoint + scheduler and broadcasts every dispatch; other "
+        "processes replay them (runtime/multihost.py). tp*sp must equal the "
+        "GLOBAL device count. Reference analog: one logical worker per TP "
+        "group with non-leader ranks idling in the engine step loop "
+        "(components/src/dynamo/vllm/main.py:67)",
+    )
     return p.parse_args()
 
 
-async def main() -> None:
-    args = parse_args()
-    if args.platform:
-        import jax
-
-        jax.config.update("jax_platforms", args.platform)
-    init_logging()
-    cfg = RuntimeConfig.from_env(
-        store=args.store, store_path=args.store_path, event_plane=args.event_plane
-    )
-    runtime = await DistributedRuntime(cfg).start()
-
-    params = None
-    if args.model_path:
-        mcfg = config_from_hf(args.model_path)
-        if args.no_warm_cache:
-            params = load_params(args.model_path, mcfg)
-        else:
-            # warm restore (engine/warm.py): restarted workers skip the
-            # checkpoint parse (chrek/CRIU analog, SURVEY §2.4)
-            from dynamo_tpu.engine.warm import load_params_warm
-
-            params = load_params_warm(args.model_path, mcfg)
-        tokenizer_ref = args.tokenizer or args.model_path
-    else:
-        mcfg = PRESETS[args.preset]()
-        tokenizer_ref = args.tokenizer or "byte"
-    vcfg = None
-    if args.preset in VISION_PRESETS and not args.model_path:
-        vcfg = VISION_PRESETS[args.preset](mcfg)
-
-    component = args.component
-    model_type = ["chat", "completions", "embedding"]
-    if args.disagg == "prefill":
-        component = (
-            args.component + "_prefill" if args.component == "backend" else args.component
-        )
-        model_type = ["prefill"]
-
-    instance_id = new_instance_id()
+def make_engine_config(args, mcfg, vcfg=None, logits_procs=()):
+    """TpuEngineConfig from CLI args — ONE code path for every process of a
+    multihost group (leader/follower config drift would desync the replayed
+    XLA programs)."""
     bs = args.block_size
 
     def rnd(n):  # round up to a block multiple
@@ -166,6 +140,155 @@ async def main() -> None:
         if rnd(b) < chunk_cap
     ) + (chunk_cap,)
     args.max_context = ctx
+    return TpuEngineConfig(
+        model=mcfg,
+        num_blocks=args.num_blocks,
+        block_size=args.block_size,
+        max_batch_size=args.max_batch_size,
+        max_context=ctx,
+        tp=args.tp,
+        sp=args.sp,
+        prefill_buckets=buckets,
+        lora_max_adapters=args.lora_max_adapters,
+        lora_rank=args.lora_rank,
+        logits_processors=logits_procs,
+        vision=vcfg,
+    )
+
+
+def _build_logits_procs(args):
+    """Parse --logits-processors into static (name, fn) pairs. Shared by the
+    leader AND followers of a multihost group: the processors are traced into
+    the XLA programs, so a config drift would desync the replayed programs."""
+    if not args.logits_processors:
+        return ()
+    from dynamo_tpu.logits_processing import (
+        ban_tokens_processor,
+        repetition_window_processor,
+        temperature_processor,
+    )
+
+    built = []
+    for spec in args.logits_processors.split(";"):
+        pname, _, val = spec.strip().partition("=")
+        if pname == "ban":
+            built.append(("ban", ban_tokens_processor(
+                [int(t) for t in val.split(",") if t]
+            )))
+        elif pname == "temperature":
+            built.append(("temperature", temperature_processor(float(val))))
+        elif pname == "norepeat":
+            built.append(("norepeat", repetition_window_processor(float(val))))
+        else:
+            raise SystemExit(f"unknown logits processor {pname!r}")
+    return tuple(built)
+
+
+def _load_model(args):
+    """(mcfg, params, tokenizer_ref) from CLI args; shared by every process
+    of a multihost group (identical host weights on each process are what
+    make the collective device_put shards consistent)."""
+    params = None
+    if args.model_path:
+        mcfg = config_from_hf(args.model_path)
+        if args.no_warm_cache:
+            params = load_params(args.model_path, mcfg)
+        else:
+            # warm restore (engine/warm.py): restarted workers skip the
+            # checkpoint parse (chrek/CRIU analog, SURVEY §2.4)
+            from dynamo_tpu.engine.warm import load_params_warm
+
+            params = load_params_warm(args.model_path, mcfg)
+        tokenizer_ref = args.tokenizer or args.model_path
+    else:
+        mcfg = PRESETS[args.preset]()
+        tokenizer_ref = args.tokenizer or "byte"
+    return mcfg, params, tokenizer_ref
+
+
+def _multihost_mesh(args, mh):
+    """The one mesh every process of the group builds identically."""
+    import jax
+
+    from dynamo_tpu.parallel.mesh import make_mesh
+
+    n = jax.device_count()
+    if args.tp * args.sp != n:
+        raise SystemExit(
+            f"--multihost needs tp*sp == global device count: "
+            f"tp={args.tp} sp={args.sp} vs {n} devices over "
+            f"{mh.num_processes} processes"
+        )
+    return make_mesh(tp=args.tp, sp=args.sp, devices=jax.devices())
+
+
+async def main() -> None:
+    args = parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    init_logging()
+    mh = None
+    if args.multihost:
+        from dynamo_tpu.runtime.multihost import MultihostContext, MultihostSpec
+
+        if args.dp != 1 or args.disagg != "none":
+            raise SystemExit("--multihost serving covers dp=1, no disagg (yet)")
+        mh = MultihostContext(MultihostSpec.parse(args.multihost))
+        mh.initialize_jax()  # must precede any device use
+        mh.start_control()
+
+    if mh is not None and not mh.is_leader:
+        # follower: no endpoint, no discovery — join the mesh, build the
+        # SAME engine (params + caches are collective device_puts), replay
+        # the leader's dispatches until it stops
+        mcfg, params, _tok = _load_model(args)
+        engine_cfg = make_engine_config(
+            args, mcfg, logits_procs=_build_logits_procs(args)
+        )
+        engine = TpuEngine(
+            engine_cfg, params=params, mesh=_multihost_mesh(args, mh),
+            multihost=mh,
+        )
+        print(f"TPU_ENGINE_FOLLOWER_READY proc={mh.spec.process_id}", flush=True)
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, engine.follow)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            mh.close()
+            # skip the distributed-shutdown barrier: the leader is still
+            # serving and would never join it — exit hard so a supervisor
+            # can restart the group instead of wedging on a half-dead mesh
+            import os as _os
+
+            _os._exit(1)
+        mh.close()
+        mh.shutdown_jax()
+        return
+
+    cfg = RuntimeConfig.from_env(
+        store=args.store, store_path=args.store_path, event_plane=args.event_plane
+    )
+    runtime = await DistributedRuntime(cfg).start()
+
+    mcfg, params, tokenizer_ref = _load_model(args)
+    vcfg = None
+    if args.preset in VISION_PRESETS and not args.model_path:
+        vcfg = VISION_PRESETS[args.preset](mcfg)
+
+    component = args.component
+    model_type = ["chat", "completions", "embedding"]
+    if args.disagg == "prefill":
+        component = (
+            args.component + "_prefill" if args.component == "backend" else args.component
+        )
+        model_type = ["prefill"]
+
+    instance_id = new_instance_id()
     kvbm = None
     if args.kvbm_host_gb > 0 or args.kvbm_disk_gb > 0 or args.kvbm_remote:
         from dynamo_tpu.kvbm.pool import KvbmTiers
@@ -185,41 +308,8 @@ async def main() -> None:
             disk_path=args.kvbm_disk_path,
             remote=remote,
         )
-    logits_procs = ()
-    if args.logits_processors:
-        from dynamo_tpu.logits_processing import (
-            ban_tokens_processor,
-            repetition_window_processor,
-            temperature_processor,
-        )
-
-        built = []
-        for spec in args.logits_processors.split(";"):
-            pname, _, val = spec.strip().partition("=")
-            if pname == "ban":
-                built.append(("ban", ban_tokens_processor(
-                    [int(t) for t in val.split(",") if t]
-                )))
-            elif pname == "temperature":
-                built.append(("temperature", temperature_processor(float(val))))
-            elif pname == "norepeat":
-                built.append(("norepeat", repetition_window_processor(float(val))))
-            else:
-                raise SystemExit(f"unknown logits processor {pname!r}")
-        logits_procs = tuple(built)
-    engine_cfg = TpuEngineConfig(
-        model=mcfg,
-        num_blocks=args.num_blocks,
-        block_size=args.block_size,
-        max_batch_size=args.max_batch_size,
-        max_context=args.max_context,
-        tp=args.tp,
-        sp=args.sp,
-        prefill_buckets=buckets,
-        lora_max_adapters=args.lora_max_adapters,
-        lora_rank=args.lora_rank,
-        logits_processors=logits_procs,
-        vision=vcfg,
+    engine_cfg = make_engine_config(
+        args, mcfg, vcfg=vcfg, logits_procs=_build_logits_procs(args)
     )
 
     import jax as _jax
@@ -262,10 +352,12 @@ async def main() -> None:
             TpuEngine(
                 engine_cfg,
                 params=params,
-                mesh=rank_mesh(r),
+                mesh=(_multihost_mesh(args, mh) if mh is not None
+                      else rank_mesh(r)),
                 kv_publisher=kv_pub,
                 metrics_publisher=m_pub,
                 kvbm=kvbm if r == 0 else None,  # host tiers are rank-0 only
+                multihost=mh,
             )
         )
     if args.dp > 1:
@@ -408,6 +500,8 @@ async def main() -> None:
         await served.stop(graceful_timeout_s=args.graceful_timeout)
     engine.stop()
     await runtime.shutdown()
+    if mh is not None:
+        mh.shutdown_jax()
 
 
 if __name__ == "__main__":

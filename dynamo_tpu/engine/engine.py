@@ -189,9 +189,23 @@ class TpuEngine:
         kv_publisher: Optional[KvEventPublisher] = None,
         metrics_publisher: Optional[WorkerMetricsPublisher] = None,
         kvbm=None,
+        multihost=None,
     ):
         self.cfg = config
         self.mcfg = config.model
+        # multi-process execution (runtime/multihost.py): process 0 runs this
+        # engine normally but broadcasts every jit dispatch; followers hold
+        # their own handles of the same globally-sharded arrays and replay.
+        # v1 covers the core text serving path — the side paths that touch
+        # device state outside the registered ops are gated off.
+        self._mh = multihost
+        if multihost is not None:
+            if config.lora_max_adapters > 0:
+                raise ValueError("multihost serving does not cover LoRA yet")
+            if config.vision is not None:
+                raise ValueError("multihost serving does not cover vision yet")
+            if kvbm is not None:
+                raise ValueError("multihost serving does not cover kvbm tiers yet")
         self.mesh = mesh if mesh is not None else meshlib.make_mesh(tp=config.tp)
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
@@ -238,9 +252,15 @@ class TpuEngine:
         self._seeds = np.zeros(B, np.uint32)
         # penalty state tables (device-resident; see engine/sampling.py)
         V = self.mcfg.vocab_size
-        with self.mesh:
-            self.output_counts = jnp.zeros((B, V), jnp.int32)
-            self.prompt_masks = jnp.zeros((B, V), jnp.int8)
+        # device_put of HOST zeros with an explicit (replicated) sharding:
+        # in multi-controller JAX a committed single-device array cannot seed
+        # a mesh-spanning program, while an addressable-shard put works on
+        # every process; on a single-device mesh this is identical to
+        # jnp.zeros. XLA resharding on the first program call applies to both
+        # paths equally.
+        repl = NamedSharding(self.mesh, P())
+        self.output_counts = jax.device_put(np.zeros((B, V), np.int32), repl)
+        self.prompt_masks = jax.device_put(np.zeros((B, V), np.int8), repl)
         self._slot_dirty = np.zeros(B, bool)   # slot's penalty tables need reset
 
         self._waiting: List[_Seq] = []
@@ -318,6 +338,9 @@ class TpuEngine:
     # ------------------------------------------------------ kv transfer wiring
     async def serve_transfer(self, host: str = "127.0.0.1") -> str:
         """Start the kv_fetch endpoint (prefill side of disaggregation)."""
+        if self._mh is not None:
+            # the gather/scatter programs run outside the replay table
+            raise ValueError("multihost serving does not cover KV transfer yet")
         from ..runtime.request_plane.tcp import TcpRequestServer
         from .transfer import KvTransferServer
 
@@ -337,8 +360,15 @@ class TpuEngine:
     # ------------------------------------------------------------------ setup
     def _shard_params(self, params: llama.Params) -> llama.Params:
         specs = registry.param_specs(self.mcfg)
+        mh = self._mh is not None
 
         def put(x, spec):
+            if mh:
+                # route through host: every process uploads its own shards of
+                # the (identical) host weights; a committed device array from
+                # random-init/warm-load is process-local and cannot be put to
+                # a mesh that spans processes
+                x = np.asarray(x)
             return jax.device_put(x, NamedSharding(self.mesh, spec))
 
         out: llama.Params = {"layers": []}
@@ -362,7 +392,9 @@ class TpuEngine:
             self.mcfg.head_dim,
         )
         sharding = NamedSharding(self.mesh, meshlib.kv_cache_spec())
-        zeros = partial(jnp.zeros, shape, self.mcfg.dtype)
+        # host-side zeros: device_put shards them per-process (jnp.zeros would
+        # commit to the local default device — invalid for a multi-host mesh)
+        zeros = partial(np.zeros, shape, self.mcfg.dtype)
         k = [jax.device_put(zeros(), sharding) for _ in range(self.mcfg.num_layers)]
         v = [jax.device_put(zeros(), sharding) for _ in range(self.mcfg.num_layers)]
         return k, v
@@ -439,6 +471,16 @@ class TpuEngine:
             return apply_processors(procs, masks, logits, {
                 "output_counts": counts, "steps": steps, "seq_lens": seq_lens,
             })
+
+        # host-fetched outputs are pinned fully-replicated: on a single
+        # process any addressable layout can be np.asarray'd, but the leader
+        # of a multi-process mesh can only fetch data whose every shard is
+        # addressable locally. A no-op on one device; an all-gather of a few
+        # hundred bytes otherwise.
+        repl = NamedSharding(self.mesh, P())
+
+        def _fetchable(x):
+            return jax.lax.with_sharding_constraint(x, repl)
 
         def pack_step(toks, lps, tlp_vals, tlp_ids):
             """[B] toks/lps + [B,K] top-logprob rows -> one [B, 2+2K] f32 row
@@ -539,6 +581,7 @@ class TpuEngine:
             counts, tok, lp, tlp_vals, tlp_ids = jax.lax.cond(
                 is_final, sample_branch, no_sample, counts
             )
+            tok, lp, tlp_vals, tlp_ids = map(_fetchable, (tok, lp, tlp_vals, tlp_ids))
             return k_caches, v_caches, counts, tok, lp, tlp_vals, tlp_ids
 
         def decode(params, k_caches, v_caches, counts, tokens, positions,
@@ -568,6 +611,9 @@ class TpuEngine:
             )
             lps = logprobs_of(logits, toks)
             tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
+            toks, lps, tlp_vals, tlp_ids = map(
+                _fetchable, (toks, lps, tlp_vals, tlp_ids)
+            )
             return k_caches, v_caches, counts, toks, lps, tlp_vals, tlp_ids
 
         def decode_multi(params, k_caches, v_caches, counts, tokens, seq_lens,
@@ -634,7 +680,10 @@ class TpuEngine:
                 )
             )
             next_steps = steps0 + jnp.where(active, cfg.decode_steps, 0)
-            return k_caches, v_caches, counts, packed, tokens, seq_lens, next_steps
+            return (
+                k_caches, v_caches, counts, _fetchable(packed),
+                tokens, seq_lens, next_steps,
+            )
 
         def reset_slot(prompt_masks, counts, slot, row):
             return prompt_masks.at[slot].set(row), counts.at[slot].set(0)
@@ -651,13 +700,104 @@ class TpuEngine:
 
             hidden = fwd(params, mcfg, tokens, positions, attend)  # [S, H]
             h = hidden[last_idx].astype(jnp.float32)
-            return h / jnp.maximum(jnp.linalg.norm(h), 1e-9)
+            return _fetchable(h / jnp.maximum(jnp.linalg.norm(h), 1e-9))
 
         self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2, 3))
         self._decode_fn = jax.jit(decode, donate_argnums=(1, 2, 3))
         self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2, 3))
         self._reset_slot_fn = jax.jit(reset_slot, donate_argnums=(0, 1))
         self._embed_fn = jax.jit(embed)
+        if self._mh is not None:
+            self._wire_multihost()
+
+    def _wire_multihost(self) -> None:
+        """Register every jitted op with the dispatch-replay table.
+
+        ``state_in`` arg positions are the engine-owned globally-sharded
+        arrays a follower substitutes with its OWN handles; ``state_out``
+        output positions are what both sides store back (the donated caches
+        and the device-resident decode carry). Everything else crosses the
+        control channel as host numpy — in multi-controller JAX plain numpy
+        inputs shard consistently on every process, while a committed
+        single-device array cannot feed a mesh-spanning program (which is why
+        the leader wrapper also downgrades its own args to numpy).
+        """
+        from ..runtime.multihost import MultihostOps
+
+        def _set_k(v):
+            self.k_caches = v
+
+        def _set_v(v):
+            self.v_caches = v
+
+        def _set_counts(v):
+            self.output_counts = v
+
+        def _set_pmasks(v):
+            self.prompt_masks = v
+
+        ops = MultihostOps(
+            self._mh,
+            state_get={
+                "params": lambda: self.params,
+                "k": lambda: self.k_caches,
+                "v": lambda: self.v_caches,
+                "counts": lambda: self.output_counts,
+                "pmasks": lambda: self.prompt_masks,
+                "lora": self._lora_tables,
+            },
+            state_set={
+                "k": _set_k, "v": _set_v,
+                "counts": _set_counts, "pmasks": _set_pmasks,
+            },
+        )
+        ops.register(
+            "prefill", self._prefill_fn,
+            state_in={0: "params", 1: "k", 2: "v", 3: "counts",
+                      19: "pmasks", 23: "lora"},
+            state_out={0: "k", 1: "v", 2: "counts"},
+        )
+        ops.register(
+            "decode", self._decode_fn,
+            state_in={0: "params", 1: "k", 2: "v", 3: "counts",
+                      19: "pmasks", 21: "lora"},
+            state_out={0: "k", 1: "v", 2: "counts"},
+        )
+        ops.register(
+            "decode_multi", self._decode_multi_fn,
+            state_in={0: "params", 1: "k", 2: "v", 3: "counts",
+                      17: "pmasks", 19: "lora"},
+            state_out={0: "k", 1: "v", 2: "counts", 4: "carry_tokens",
+                       5: "carry_seq_lens", 6: "carry_steps"},
+            # tokens/seq_lens/steps arrive either as a host resync (numpy →
+            # by value) or as the previous horizon's device carry (jax.Array
+            # → sentinel; the follower substitutes its stored carry)
+            carry_in={4: "carry_tokens", 5: "carry_seq_lens", 9: "carry_steps"},
+        )
+        ops.register(
+            "reset_slot", self._reset_slot_fn,
+            state_in={0: "pmasks", 1: "counts"},
+            state_out={0: "pmasks", 1: "counts"},
+        )
+        ops.register("embed", self._embed_fn, state_in={0: "params"}, state_out={})
+        self._mh_ops = ops
+        if self._mh.is_leader:
+            self._prefill_fn = ops.leader_fn("prefill")
+            self._decode_fn = ops.leader_fn("decode")
+            self._decode_multi_fn = ops.leader_fn("decode_multi")
+            self._reset_slot_fn = ops.leader_fn("reset_slot")
+            self._embed_fn = ops.leader_fn("embed")
+
+    def follow(self) -> None:
+        """Follower process body: replay leader dispatches until stop/EOF.
+
+        The reference's analog is a non-leader TP rank blocking inside the
+        engine's collective step loop (components/src/dynamo/vllm/main.py:67);
+        here the loop is explicit because each JAX process must issue the
+        same XLA programs itself.
+        """
+        assert self._mh is not None and not self._mh.is_leader
+        self._mh_ops.follow()
 
     # ---------------------------------------------------------------- serving
     async def generate(
@@ -802,6 +942,8 @@ class TpuEngine:
             self._kv_transfer_srv.close()
         self._executor.shutdown(wait=False)
         self._fetch_executor.shutdown(wait=False)
+        if self._mh is not None and self._mh.is_leader:
+            self._mh.close()  # broadcasts __stop__ so followers exit follow()
 
     # ------------------------------------------------------- kvbm offload/onboard
     def _enqueue_offload_gather(self, pending: List[Tuple[int, int]]):
@@ -1134,7 +1276,7 @@ class TpuEngine:
                     row[ids[ids < self.mcfg.vocab_size]] = 1
                 self.prompt_masks, self.output_counts = self._reset_slot_fn(
                     self.prompt_masks, self.output_counts,
-                    jnp.int32(slot), jnp.asarray(row),
+                    self._j(np.int32(slot)), self._j(row),
                 )
             # counts accumulate for EVERY active slot while anyone counts
             # (update_counts scatters the full batch): a slot that shared a
@@ -1207,25 +1349,26 @@ class TpuEngine:
 
         s = st.req.sampling
         total_len = start + chunk_len
+        _j = self._j
         (self.k_caches, self.v_caches, self.output_counts, tok, lp, tlp_vals,
          tlp_ids) = self._prefill_fn(
             self.params, self.k_caches, self.v_caches, self.output_counts,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(self._block_tables[st.slot]),
-            jnp.asarray(new_block_ids), jnp.int32(total_len), jnp.int32(start),
-            jnp.asarray(np.array([self._seeds[st.slot]], np.uint32)),
-            jnp.asarray(np.array([0], np.int32)),
-            jnp.asarray(np.array([s.temperature], np.float32)),
-            jnp.asarray(np.array([s.top_k], np.int32)),
-            jnp.asarray(np.array([s.top_p], np.float32)),
-            jnp.asarray(np.array([s.min_p], np.float32)),
-            jnp.asarray(np.array([s.presence_penalty], np.float32)),
-            jnp.asarray(np.array([s.frequency_penalty], np.float32)),
-            jnp.asarray(np.array([s.repetition_penalty], np.float32)),
-            self.prompt_masks, jnp.int32(st.slot),
-            jnp.bool_(self._lp_ns[st.slot] > 0),
-            jnp.bool_(is_final),
-            self._lora_tables(), jnp.int32(self._lora_slots[st.slot]),
+            _j(tokens), _j(positions),
+            _j(self._block_tables[st.slot]),
+            _j(new_block_ids), _j(np.int32(total_len)), _j(np.int32(start)),
+            _j(np.array([self._seeds[st.slot]], np.uint32)),
+            _j(np.array([0], np.int32)),
+            _j(np.array([s.temperature], np.float32)),
+            _j(np.array([s.top_k], np.int32)),
+            _j(np.array([s.top_p], np.float32)),
+            _j(np.array([s.min_p], np.float32)),
+            _j(np.array([s.presence_penalty], np.float32)),
+            _j(np.array([s.frequency_penalty], np.float32)),
+            _j(np.array([s.repetition_penalty], np.float32)),
+            self.prompt_masks, _j(np.int32(st.slot)),
+            _j(np.bool_(self._lp_ns[st.slot] > 0)),
+            _j(np.bool_(is_final)),
+            self._lora_tables(), _j(np.int32(self._lora_slots[st.slot])),
             self._dev("proc_masks", self._lp_masks),
             *self._mm_chunk(st, start, chunk_len, S_pad),
         )
@@ -1248,6 +1391,8 @@ class TpuEngine:
         Tiny dummies when the engine has no vision tower (statically
         ignored), zeros for text-only requests on a vision engine."""
         if self.cfg.vision is None:
+            if self._mh is not None:  # host dummies: see _j
+                return (np.zeros((1, 1), self.mcfg.dtype), np.zeros((1,), bool))
             return (jnp.zeros((1, 1), self.mcfg.dtype), jnp.zeros((1,), bool))
         H = self.mcfg.hidden_size
         if st.mm_embeds is None:
@@ -1326,8 +1471,8 @@ class TpuEngine:
         tokens[:S] = token_ids
         positions = np.arange(S_pad, dtype=np.int32)
         vec = self._embed_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.int32(S - 1),
+            self.params, self._j(tokens), self._j(positions),
+            self._j(np.int32(S - 1)),
         )
         return np.asarray(vec)
 
@@ -1409,9 +1554,21 @@ class TpuEngine:
         self._accept_token(st, tok, lp, tlp_ids, tlp_vals)
         self._wake.set()
 
+    def _j(self, host_val):
+        """Dispatch-arg placement: single-process uploads eagerly
+        (jnp.asarray, the tuned tunnel path); multihost passes host numpy
+        through — the leader wrapper broadcasts host data, and pulling an
+        uploaded array straight back would pay a blocking D2H per arg."""
+        return host_val if self._mh is not None else jnp.asarray(host_val)
+
     def _dev(self, name: str, host_arr: np.ndarray) -> jax.Array:
         """Device-resident copy of a slot array, re-uploaded only on change
         (host<->device transfers are ~100ms RPCs on tunneled TPUs)."""
+        if self._mh is not None:
+            # multihost dispatches travel as host numpy anyway (the leader
+            # wrapper would immediately pull a device copy back); snapshot so
+            # later slot mutations can't race the in-flight frame
+            return host_arr.copy()
         cached = self._dev_cache.get(name)
         if cached is None or not np.array_equal(
             self._dev_cache.get(name + "/host"), host_arr
@@ -1453,9 +1610,13 @@ class TpuEngine:
                 seq_lens_np[i] = len(st.seq)
                 steps_np[i] = st.produced
                 self._tokens[i] = st.last_token
-            tokens = jnp.asarray(self._tokens)
-            seq_lens = jnp.asarray(seq_lens_np)
-            steps = jnp.asarray(steps_np)
+            # host numpy feeds jit directly (same H2D copy jnp.asarray paid);
+            # snapshot _tokens — the loop mutates it after dispatch. In
+            # multihost mode numpy-vs-jax.Array is also the carry/resync
+            # signal (engine _wire_multihost carry_in).
+            tokens = self._tokens.copy()
+            seq_lens = seq_lens_np
+            steps = steps_np
 
         (self.k_caches, self.v_caches, self.output_counts, packed, tokens,
          seq_lens, steps) = (
@@ -1544,19 +1705,20 @@ class TpuEngine:
                 steps[i] = st.produced
 
         lp_need = bool(np.any((self._lp_ns > 0) & (seq_lens > 0)))
+        _j = self._j
         (self.k_caches, self.v_caches, self.output_counts, toks, lps,
          tlp_vals, tlp_ids) = self._decode_fn(
             self.params, self.k_caches, self.v_caches, self.output_counts,
-            jnp.asarray(self._tokens), jnp.asarray(positions),
-            jnp.asarray(self._block_tables), jnp.asarray(seq_lens),
-            jnp.asarray(write_blocks), jnp.asarray(write_offsets),
-            jnp.asarray(self._seeds), jnp.asarray(steps),
-            jnp.asarray(self._temps),
-            jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
-            jnp.asarray(self._min_ps), jnp.asarray(self._pres),
-            jnp.asarray(self._freqs), jnp.asarray(self._reps),
-            self.prompt_masks, jnp.bool_(lp_need),
-            self._lora_tables(), jnp.asarray(self._lora_slots),
+            _j(self._tokens), _j(positions),
+            _j(self._block_tables), _j(seq_lens),
+            _j(write_blocks), _j(write_offsets),
+            _j(self._seeds), _j(steps),
+            _j(self._temps),
+            _j(self._top_ks), _j(self._top_ps),
+            _j(self._min_ps), _j(self._pres),
+            _j(self._freqs), _j(self._reps),
+            self.prompt_masks, _j(np.bool_(lp_need)),
+            self._lora_tables(), _j(self._lora_slots),
             self._dev("proc_masks", self._lp_masks),
         )
         toks_np = np.asarray(toks)

@@ -139,8 +139,8 @@ class ModelPipeline:
             instance_id = alive[0]
         try:
             return await self.client.generate(req.to_obj(), context, instance_id)
-        except NoResponders as e:
-            if instance_id is not None:
+        except (NoResponders, ConnectionError) as e:
+            if instance_id is not None and getattr(e, "instance_id", None) is None:
                 e.instance_id = instance_id  # type: ignore[attr-defined]
             raise
 

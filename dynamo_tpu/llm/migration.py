@@ -49,6 +49,14 @@ class Migration:
                 async for item in stream:
                     out = item if isinstance(item, BackendOutput) else BackendOutput.from_obj(item)
                     accumulated.extend(out.token_ids)
+                    # a resumed worker counts only ITS OWN tokens: normalize
+                    # to the original request so usage accounting survives
+                    # migration (completion = everything past the original
+                    # prior tokens)
+                    out.cumulative_tokens = max(
+                        out.cumulative_tokens,
+                        len(accumulated) - len(request.prior_token_ids),
+                    )
                     yield out
                     if out.finish_reason is not None:
                         return
@@ -61,10 +69,12 @@ class Migration:
                         raise
                     return
                 attempts_left -= 1
-                worker_id: Optional[int] = None
-                if isinstance(e, NoResponders):
-                    worker_id = getattr(e, "instance_id", None)
-                if worker_id is not None:
+                # exclude the failed worker on ANY transport loss — a
+                # ConnectionError retry that can re-route to the same dead
+                # instance defeats the whole operator (the request plane tags
+                # instance_id on the exception, runtime/component.py)
+                worker_id: Optional[int] = getattr(e, "instance_id", None)
+                if worker_id is not None and worker_id not in excluded:
                     excluded.append(worker_id)
                 log.info(
                     "migrating request %s (%d tokens so far, %d attempts left): %s",

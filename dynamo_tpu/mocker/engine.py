@@ -368,8 +368,12 @@ class MockerEngine:
         if limit is not None and st.produced >= limit:
             finish = FINISH_LENGTH
         # deterministic "natural" stop: ~1/128 chance per token via hash
-        elif _mock_token(st.req.request_id, st.produced - 1, 1 << 16) % 128 == 0 and (
-            st.produced > st.req.stop.min_tokens
+        # (suppressed by ignore_eos, like a real engine's EOS handling —
+        # benchmark sweeps rely on exact requested lengths)
+        elif (
+            not st.req.stop.ignore_eos
+            and _mock_token(st.req.request_id, st.produced - 1, 1 << 16) % 128 == 0
+            and st.produced > st.req.stop.min_tokens
         ):
             finish = FINISH_STOP
         ann = {}

@@ -274,13 +274,38 @@ class Client:
         context: Optional[Context] = None,
         instance_id: Optional[int] = None,
     ) -> AsyncIterator[Any]:
-        """Route a request and stream back responses."""
+        """Route a request and stream back responses.
+
+        Failures carry the chosen ``instance_id`` (set on the exception), at
+        call time AND mid-stream: the migration operator excludes that worker
+        on retry — without the tag, a "connection lost" mid-stream retry
+        could round-robin straight back onto the dead worker (reference
+        excludes on any mid-stream engine loss, lib/llm/src/migration.rs).
+        """
         if self.router_mode == RouterMode.KV and instance_id is None and self.kv_selector:
             instance_id = await self.kv_selector(request, list(self.instances.values()))
         inst = self._select(request, instance_id)
-        return await self._rt.plane_client(inst.address).call(
-            inst.address, request, context
-        )
+        try:
+            stream = await self._rt.plane_client(inst.address).call(
+                inst.address, request, context
+            )
+        except (NoResponders, ConnectionError) as e:
+            if getattr(e, "instance_id", None) is None:
+                e.instance_id = inst.instance_id  # type: ignore[attr-defined]
+            raise
+        return self._tag_stream_errors(stream, inst.instance_id)
+
+    @staticmethod
+    async def _tag_stream_errors(
+        stream: AsyncIterator[Any], iid: int
+    ) -> AsyncIterator[Any]:
+        try:
+            async for item in stream:
+                yield item
+        except (NoResponders, ConnectionError) as e:
+            if getattr(e, "instance_id", None) is None:
+                e.instance_id = iid  # type: ignore[attr-defined]
+            raise
 
     async def stop(self) -> None:
         if self._watcher is not None:

@@ -1,0 +1,344 @@
+"""Multi-process (multi-host) execution context.
+
+The TPU analog of the reference's one-logical-worker-per-parallel-group model
+(reference components/src/dynamo/vllm/main.py:67: non-leader ranks of a TP
+group idle inside the engine while rank 0 owns the endpoint): in JAX's
+multi-controller model EVERY process must issue the same XLA programs over the
+shared mesh, so "idling" followers are really a replay loop.
+
+  - process 0 (leader) owns the control plane: discovery registration, the
+    request plane endpoint, the scheduler, and every host-side decision.
+  - processes 1..N-1 (followers) join the same ``jax.distributed`` cluster,
+    hold their own handles of the globally-sharded state (params, KV caches,
+    sampling tables), and replay each dispatch the leader broadcasts so the
+    collective programs line up across processes.
+
+The broadcast channel is a plain TCP fan-out (length-prefixed msgpack), NOT
+the request plane: dispatch replay is a lockstep data-path concern, ordered
+and point-to-point, with no discovery or retry semantics — the same reason
+the reference runs NCCL alongside (not through) its NATS/etcd control plane.
+
+Wire format: one frame per dispatch ``{"op": name, "a": [encoded args]}``.
+numpy arrays ride as ``{"__nd__": [dtype.str, shape, bytes]}``; the sentinel
+``{"__carry__": key}`` tells the follower to substitute its device-resident
+carry state (decode horizon chaining never round-trips through the host —
+engine/engine.py _dispatch_horizon).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import msgpack
+import numpy as np
+
+from .logging import get_logger
+
+log = get_logger("runtime.multihost")
+
+_LEN = struct.Struct("!I")
+_TRACE = os.environ.get("DTPU_MH_TRACE") == "1"
+
+
+def _trace(fmt: str, *args) -> None:
+    if _TRACE:
+        import sys
+
+        print("[mh] " + (fmt % args), file=sys.stderr, flush=True)
+
+
+@dataclass
+class MultihostSpec:
+    """Parsed ``--multihost coord:port,nprocs,proc_id[,control:port]``."""
+
+    coordinator: str
+    num_processes: int
+    process_id: int
+    control: str  # host:port the leader's control channel binds/dials
+
+    @classmethod
+    def parse(cls, text: str) -> "MultihostSpec":
+        parts = text.split(",")
+        if len(parts) < 3:
+            raise ValueError(
+                "--multihost wants coord_host:port,num_processes,process_id"
+                "[,control_host:port]"
+            )
+        coord, nprocs, pid = parts[0], int(parts[1]), int(parts[2])
+        if len(parts) > 3:
+            control = parts[3]
+        else:
+            # default control port: coordinator port + 1 on the same host
+            host, _, port = coord.rpartition(":")
+            control = f"{host}:{int(port) + 1}"
+        return cls(coord, nprocs, pid, control)
+
+
+def _encode_arg(a: Any) -> Any:
+    # dtype.name (not .str): extension dtypes like ml_dtypes' bfloat16 have
+    # no char code — .str degrades to raw void ('|V2') which jit rejects —
+    # but their registered NAME round-trips through np.dtype()
+    if isinstance(a, np.ndarray):
+        return {"__nd__": [a.dtype.name, list(a.shape), a.tobytes()]}
+    if isinstance(a, (np.generic,)):  # 0-d scalar (np.int32(3), np.bool_(True))
+        arr = np.asarray(a)
+        return {"__nd0__": [arr.dtype.name, arr.tobytes()]}
+    return a
+
+
+def _decode_arg(a: Any) -> Any:
+    if isinstance(a, dict):
+        if "__nd__" in a:
+            dt, shape, raw = a["__nd__"]
+            return np.frombuffer(raw, dtype=np.dtype(dt)).reshape(shape)
+        if "__nd0__" in a:
+            dt, raw = a["__nd0__"]
+            return np.frombuffer(raw, dtype=np.dtype(dt))[0]
+    return a
+
+
+class MultihostContext:
+    """Owns the jax.distributed membership + the dispatch broadcast channel."""
+
+    def __init__(self, spec: MultihostSpec):
+        self.spec = spec
+        self._socks: List[socket.socket] = []  # leader: one per follower
+        self._sock: Optional[socket.socket] = None  # follower: to leader
+        self._rbuf = b""
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------ membership
+    @property
+    def is_leader(self) -> bool:
+        return self.spec.process_id == 0
+
+    @property
+    def num_processes(self) -> int:
+        return self.spec.num_processes
+
+    def initialize_jax(self) -> None:
+        """Join the jax.distributed cluster (must run before device use)."""
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.spec.coordinator,
+            num_processes=self.spec.num_processes,
+            process_id=self.spec.process_id,
+        )
+        log.info(
+            "joined jax cluster as process %d/%d (%d local / %d global devices)",
+            self.spec.process_id, self.spec.num_processes,
+            jax.local_device_count(), jax.device_count(),
+        )
+
+    # --------------------------------------------------------- control plane
+    def start_control(self, timeout_s: float = 60.0) -> None:
+        """Leader: accept one connection per follower. Follower: dial."""
+        host, _, port = self.spec.control.rpartition(":")
+        port = int(port)
+        if self.is_leader:
+            srv = socket.create_server((host, port), reuse_port=False)
+            srv.settimeout(timeout_s)
+            try:
+                pending = self.spec.num_processes - 1
+                seen: Dict[int, socket.socket] = {}
+                while len(seen) < pending:
+                    conn, _addr = srv.accept()
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    conn.settimeout(None)  # dispatch gaps are unbounded
+                    hello = b""
+                    while len(hello) < 4:
+                        part = conn.recv(4 - len(hello))
+                        if not part:
+                            raise ConnectionError("follower hello truncated")
+                        hello += part
+                    (pid,) = _LEN.unpack(hello)
+                    seen[pid] = conn
+                # deterministic fan-out order
+                self._socks = [seen[k] for k in sorted(seen)]
+            finally:
+                srv.close()
+        else:
+            deadline = time.monotonic() + timeout_s
+            last: Optional[Exception] = None
+            while time.monotonic() < deadline:
+                try:
+                    s = socket.create_connection((host, port), timeout=5.0)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    # connect timeout must NOT linger: recv() blocks across
+                    # arbitrarily long idle gaps between dispatches
+                    s.settimeout(None)
+                    s.sendall(_LEN.pack(self.spec.process_id))
+                    self._sock = s
+                    return
+                except OSError as e:  # leader not up yet
+                    last = e
+                    time.sleep(0.2)
+            raise TimeoutError(f"control channel dial failed: {last}")
+
+    def broadcast(self, op: str, args: List[Any]) -> None:
+        """Leader: fan one dispatch out to every follower, in order."""
+        payload = msgpack.packb(
+            {"op": op, "a": [_encode_arg(a) for a in args]}, use_bin_type=True
+        )
+        frame = _LEN.pack(len(payload)) + payload
+        with self._lock:
+            for s in self._socks:
+                s.sendall(frame)
+
+    def recv(self) -> Dict[str, Any]:
+        """Follower: block for the next dispatch frame."""
+        assert self._sock is not None
+        while True:
+            if len(self._rbuf) >= 4:
+                (n,) = _LEN.unpack(self._rbuf[:4])
+                if len(self._rbuf) >= 4 + n:
+                    raw = self._rbuf[4 : 4 + n]
+                    self._rbuf = self._rbuf[4 + n :]
+                    msg = msgpack.unpackb(raw, raw=False)
+                    msg["a"] = [_decode_arg(a) for a in msg.get("a", [])]
+                    return msg
+            chunk = self._sock.recv(1 << 20)
+            if not chunk:
+                raise ConnectionError("control channel closed by leader")
+            self._rbuf += chunk
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.is_leader:
+            try:
+                self.broadcast("__stop__", [])
+            except OSError:
+                pass
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def shutdown_jax(self) -> None:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # already torn down / never initialized
+            pass
+
+
+CARRY = "__carry__"
+
+
+class MultihostOps:
+    """Per-engine dispatch replay table.
+
+    Each op is registered with:
+      - ``state_in``:  {arg_pos: state_name} — args the follower substitutes
+        with its OWN handle of the shared global array (params, caches, ...)
+      - ``state_out``: {out_pos: state_name} — outputs both sides store back
+        (donated caches, penalty tables, the decode carry)
+      - ``carry_in``:  {arg_pos: state_name} — args that are EITHER a host
+        resync value (numpy → broadcast by value) or the device carry of the
+        previous dispatch (jax.Array → broadcast as a carry sentinel)
+
+    The leader-side wrapper converts every non-state arg to host numpy before
+    both the broadcast AND the local call: in multi-controller JAX a committed
+    single-device array cannot feed a mesh-spanning computation, while plain
+    numpy shards consistently on every process.
+    """
+
+    def __init__(self, mh: MultihostContext, state_get: Dict[str, Callable[[], Any]],
+                 state_set: Dict[str, Callable[[Any], None]]):
+        self.mh = mh
+        self._get = state_get
+        self._set = state_set
+        self._ops: Dict[str, tuple] = {}
+        self._carry: Dict[str, Any] = {}
+        # dispatches come from more than one thread (the engine's step
+        # executor AND its asyncio loop thread); broadcast + local XLA
+        # dispatch happen under ONE lock so every process executes the same
+        # total order — jit returns after async-enqueue, so the hold is ~ms
+        self._dispatch_lock = threading.Lock()
+
+    def register(self, name: str, fn: Callable, state_in: Dict[int, str],
+                 state_out: Dict[int, str], carry_in: Optional[Dict[int, str]] = None):
+        self._ops[name] = (fn, state_in, state_out, carry_in or {})
+
+    # ------------------------------------------------------------- leader side
+    def leader_fn(self, name: str) -> Callable:
+        fn, state_in, state_out, carry_in = self._ops[name]
+        mh = self.mh
+
+        def dispatch(*args):
+            import jax
+
+            send: List[Any] = []
+            call: List[Any] = list(args)
+            for i, a in enumerate(args):
+                if i in state_in:
+                    continue  # follower substitutes its own handle
+                if i in carry_in and isinstance(a, jax.Array):
+                    send.append({CARRY: carry_in[i]})
+                    continue
+                host = (
+                    a if isinstance(a, (int, float, bool, type(None)))
+                    else np.asarray(a)
+                )
+                send.append(
+                    _encode_arg(host)
+                    if isinstance(host, (np.ndarray, np.generic)) else host
+                )
+                call[i] = host
+            with self._dispatch_lock:
+                _trace("leader: broadcast %s", name)
+                mh.broadcast(name, send)
+                out = fn(*call)
+                _trace("leader: dispatched %s", name)
+                return out
+
+        return dispatch
+
+    # ----------------------------------------------------------- follower side
+    def follow(self) -> None:
+        """Replay dispatches until the leader says stop (or hangs up)."""
+        while True:
+            msg = self.mh.recv()
+            op = msg["op"]
+            _trace("follower: recv %s", op)
+            if op == "__stop__":
+                return
+            fn, state_in, state_out, carry_in = self._ops[op]
+            data = msg["a"]
+            n_args = len(data) + len(state_in)
+            args: List[Any] = [None] * n_args
+            it = iter(data)
+            for i in range(n_args):
+                if i in state_in:
+                    args[i] = self._get[state_in[i]]()
+                else:
+                    a = next(it)
+                    if isinstance(a, dict) and CARRY in a:
+                        args[i] = self._carry[a[CARRY]]
+                    else:
+                        args[i] = a
+            out = fn(*args)
+            _trace("follower: executed %s", op)
+            outs = out if isinstance(out, tuple) else (out,)
+            for pos, sname in state_out.items():
+                if sname.startswith("carry_"):
+                    self._carry[sname] = outs[pos]
+                else:
+                    self._set[sname](outs[pos])

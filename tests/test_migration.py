@@ -1,0 +1,89 @@
+"""Migration operator unit tests (llm/migration.py).
+
+Reference analog: lib/llm/src/migration.rs:24-43 — replay in-flight requests
+to another worker on transport loss, carrying generated tokens forward.
+"""
+
+import pytest
+
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.request_plane.tcp import NoResponders
+
+
+def _req(max_tokens=8):
+    return PreprocessedRequest(
+        request_id="r1", model="m", token_ids=[1, 2, 3],
+        stop=StopConditions(max_tokens=max_tokens),
+        sampling=SamplingOptions(),
+    )
+
+
+class _FlakySend:
+    """First call streams 3 tokens then dies; later calls finish the rest."""
+
+    def __init__(self, exc):
+        self.exc = exc
+        self.calls = []  # (excluded_snapshot, prior_token_ids)
+
+    async def __call__(self, req, context, excluded):
+        self.calls.append((list(excluded), list(req.prior_token_ids),
+                           req.stop.max_tokens))
+
+        async def first():
+            for t in (10, 11, 12):
+                yield BackendOutput(token_ids=[t], cumulative_tokens=1)
+            raise self.exc
+
+        async def rest():
+            n = len(req.prior_token_ids)
+            for t in range(20, 20 + (8 - n)):
+                yield BackendOutput(token_ids=[t], cumulative_tokens=1)
+            yield BackendOutput(finish_reason="length", cumulative_tokens=0)
+
+        return first() if len(self.calls) == 1 else rest()
+
+
+async def _collect(migration, req):
+    toks = []
+    async for out in migration.generate(req, Context()):
+        toks.extend(out.token_ids)
+    return toks
+
+
+async def test_migrates_on_tagged_connection_error():
+    """A mid-stream ConnectionError carrying instance_id excludes that worker
+    on the retry — the round-3 verdict's exclusion gap."""
+    exc = ConnectionError("connection lost")
+    exc.instance_id = 0xDEAD
+    send = _FlakySend(exc)
+    toks = await _collect(Migration(send, migration_limit=2), _req())
+    assert len(send.calls) == 2
+    # retry excluded the dead worker and replayed progress
+    assert send.calls[1][0] == [0xDEAD]
+    assert send.calls[1][1] == [10, 11, 12]
+    # max_tokens shrank by the tokens already delivered
+    assert send.calls[1][2] == 8 - 3
+    assert toks[:3] == [10, 11, 12] and len(toks) == 8
+
+
+async def test_migrates_on_no_responders():
+    exc = NoResponders("gone")
+    exc.instance_id = 7
+    send = _FlakySend(exc)
+    toks = await _collect(Migration(send, migration_limit=1), _req())
+    assert send.calls[1][0] == [7]
+    assert len(toks) == 8
+
+
+async def test_limit_zero_raises():
+    send = _FlakySend(ConnectionError("connection lost"))
+    with pytest.raises(ConnectionError):
+        await _collect(Migration(send, migration_limit=0), _req())
+    assert len(send.calls) == 1

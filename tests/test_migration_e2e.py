@@ -1,0 +1,136 @@
+"""Fault-tolerance e2e: kill a worker mid-stream; the client stream continues.
+
+Reference analog: tests/fault_tolerance/ — the frontend's Migration operator
+replays the in-flight request (with prior tokens) on a surviving worker after
+the serving worker dies, and the HTTP client sees ONE uninterrupted stream.
+
+Two mocker workers run as OS processes (so SIGKILL is a real transport loss,
+not a cooperative shutdown); the frontend runs in this process over a shared
+file store.
+"""
+
+import asyncio
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import aiohttp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_TOKENS = 400  # ~2s of simulated decode at 5ms/token — room to kill mid-way
+
+
+def _worker(store_path: str, log_path: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "dynamo_tpu.mocker",
+            "--model", "ft-model",
+            "--store", "file", "--store-path", store_path,
+            "--event-plane", "inproc",
+            "--migration-limit", "3",
+        ],
+        stdout=open(log_path, "wb"), stderr=subprocess.STDOUT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+    )
+
+
+def _instance_id(log_path: str, timeout: float = 30.0) -> int:
+    deadline = time.monotonic() + timeout
+    pat = re.compile(rb"as instance ([0-9a-f]{16})")
+    while time.monotonic() < deadline:
+        try:
+            m = pat.search(open(log_path, "rb").read())
+        except FileNotFoundError:
+            m = None
+        if m:
+            return int(m.group(1), 16)
+        time.sleep(0.1)
+    raise AssertionError(f"worker never registered ({log_path})")
+
+
+def test_kill_worker_mid_stream(tmp_path):
+    asyncio.run(asyncio.wait_for(_run(tmp_path), timeout=180))
+
+
+async def _run(tmp_path):
+    store_path = str(tmp_path / "store")
+    workers = {}
+    for i in (0, 1):
+        log = str(tmp_path / f"w{i}.log")
+        proc = _worker(store_path, log)
+        workers[_instance_id(log)] = proc
+
+    from dynamo_tpu.llm import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.runtime import (
+        DistributedRuntime,
+        InProcEventPlane,
+        RouterMode,
+        RuntimeConfig,
+    )
+
+    cfg = RuntimeConfig(
+        store="file", store_path=store_path, event_plane="inproc",
+        lease_ttl_s=2.0,
+    )
+    rt = await DistributedRuntime(cfg, event_plane=InProcEventPlane()).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager, RouterMode.ROUND_ROBIN).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        for _ in range(200):
+            entry = manager.get("ft-model")
+            if entry and len(entry.client.instances) == 2:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError("both workers never discovered")
+
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={
+                    "model": "ft-model",
+                    "messages": [{"role": "user", "content": "tell me a story"}],
+                    "max_tokens": MAX_TOKENS,
+                    "ignore_eos": True,  # mocker samples EOS like a real model
+                    "stream": True,
+                    "stream_options": {"include_usage": True},
+                },
+                timeout=aiohttp.ClientTimeout(total=120),
+            )
+            assert r.status == 200, await r.text()
+            chunks, killed, usage = 0, None, None
+            async for raw in r.content:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                c = json.loads(payload)
+                if c.get("usage"):
+                    usage = c["usage"]
+                if c.get("choices"):
+                    chunks += 1
+                if chunks == 3 and killed is None:
+                    # the first round-robin pick is the smallest instance id
+                    # (runtime/component.py _select sorts) — that's who is
+                    # serving this stream. SIGKILL = abrupt transport loss.
+                    killed = min(workers)
+                    workers[killed].kill()
+            assert killed is not None, "stream finished before the kill point"
+            assert usage is not None and usage["completion_tokens"] == MAX_TOKENS, usage
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await rt.shutdown()
+        for p in workers.values():
+            if p.poll() is None:
+                p.terminate()
+                p.wait(timeout=30)
