@@ -348,6 +348,11 @@ class TpuEngine:
         self._kv_transfer_srv = srv
         self._transfer_server = TcpRequestServer(srv.handle, host=host)
         self.transfer_address = await self._transfer_server.start()
+        # co-resident clients (same-slice xPyD) find us here and move pages
+        # device->device instead of over the wire (transfer.IciKvMover)
+        from .transfer import LOCAL_SERVERS
+
+        LOCAL_SERVERS[self.transfer_address] = srv
         return self.transfer_address
 
     def _get_transfer_client(self):
@@ -937,13 +942,22 @@ class TpuEngine:
         if self._loop_task is not None:
             self._loop_task.cancel()
         if self._transfer_server is not None:
-            asyncio.ensure_future(self._transfer_server.stop(0.5))
+            try:
+                asyncio.ensure_future(self._transfer_server.stop(0.5))
+            except RuntimeError:
+                pass  # no running loop (sync teardown): sockets close with us
         if getattr(self, "_kv_transfer_srv", None) is not None:
             self._kv_transfer_srv.close()
+            if self.transfer_address is not None:
+                from .transfer import LOCAL_SERVERS
+
+                LOCAL_SERVERS.pop(self.transfer_address, None)
         self._executor.shutdown(wait=False)
         self._fetch_executor.shutdown(wait=False)
         if self._mh is not None and self._mh.is_leader:
-            self._mh.close()  # broadcasts __stop__ so followers exit follow()
+            # broadcasts __stop__ under the dispatch lock so an in-flight
+            # dispatch can't slip a collective past the followers' exit
+            self._mh_ops.close()
 
     # ------------------------------------------------------- kvbm offload/onboard
     def _enqueue_offload_gather(self, pending: List[Tuple[int, int]]):

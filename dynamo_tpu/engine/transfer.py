@@ -4,17 +4,24 @@ The reference moves KV between prefill and decode GPUs over NIXL RDMA
 (lib/memory/src/nixl.rs, dynamo.nixl_connect, docs/design_docs/
 disagg_serving.md:20,54). On TPU the equivalent paths are:
 
-1. **DCN / host-staging (implemented here, works everywhere):** prefill
-   engine gathers the request's KV pages device->host, ships them over the
-   request plane (msgpack bytes on TCP), decode engine scatters host->device
-   into its own pages. Content addressing makes the protocol idempotent and
+1. **DCN / host-staging (works everywhere):** prefill engine gathers the
+   request's KV pages device->host, ships them over the request plane
+   (msgpack bytes on TCP), decode engine scatters host->device into its own
+   pages. Content addressing makes the protocol idempotent and
    failure-tolerant: blocks are requested *by sequence hash*; whatever the
    prefill side still holds is returned, and the decode side recomputes any
    missing suffix — no pinning handshake required.
-2. **ICI collective-permute (same-pod slices):** planned fast path —
-   jitted shard_map ppermute moving pages directly HBM->HBM across a shared
-   mesh; requires a multi-slice deployment (interface reserved via
-   TransferBackend).
+2. **ICI / device-to-device (same-slice xPyD, IciKvMover below):** when the
+   prefill and decode engines are co-resident (one process, device groups of
+   the same slice — the rank_mesh/dp layout engine/__main__.py builds), the
+   pages never touch the host: a jitted gather on the source mesh, a
+   ``jax.device_put`` reshard onto the destination mesh (PJRT issues direct
+   device-to-device copies — ICI on a TPU pod), and a jitted scatter into
+   the destination cache. ``KvTransferClient.fetch_and_import`` picks this
+   path automatically when the transfer address resolves to a server in
+   ``LOCAL_SERVERS`` (process-local registry), falling back to DCN on any
+   failure. Bit-equality with the DCN path is pinned by
+   tests/test_ici_transfer.py.
 
 Wire protocol (served as a normal endpoint, "kv_fetch"):
     request : {"hashes": [u64...], "native_ok": bool}
@@ -50,6 +57,10 @@ log = get_logger("engine.transfer")
 
 NATIVE_REGION = 1
 SLOT_LEASE_S = 30.0
+
+# process-local registry: transfer address -> KvTransferServer. A client
+# whose target lives here skips the wire entirely (ICI device path).
+LOCAL_SERVERS: Dict[str, "KvTransferServer"] = {}
 
 
 def _dtype_from_name(name: str) -> np.dtype:
@@ -223,6 +234,113 @@ class KvTransferServer:
             self._agent = None
 
 
+class IciKvMover:
+    """Device->device KV page movement between two co-resident engines.
+
+    The TPU analog of NIXL's GPU<->GPU RDMA leg (lib/memory/src/nixl.rs:13):
+    no host staging, no wire bytes. Three steps, each ordered against the
+    owning engine's dispatch stream by running on ITS step executor:
+
+      1. jitted gather on the SOURCE mesh: pages -> [L, n, bs, kvh, d] (cache
+         dtype preserved — no f32 round-trip)
+      2. ``jax.device_put`` onto the destination mesh's KV sharding: PJRT
+         emits direct device-to-device copies (ICI on a TPU pod; the source
+         and dest groups of one slice never bounce off DCN)
+      3. jitted scatter into the destination cache (donated, in-place)
+
+    The source blocks stay pinned (allocator.acquire_prefix) across the
+    gather so LRU eviction cannot rewrite them mid-copy.
+    """
+
+    def __init__(self, src_engine, dst_engine):
+        assert src_engine is not dst_engine
+        self.src = src_engine
+        self.dst = dst_engine
+
+    # jitted programs are cached on the engines (one per engine, reused by
+    # every mover that touches the engine)
+    @staticmethod
+    def _gather_fn(engine):
+        fn = getattr(engine, "_ici_gather_fn", None)
+        if fn is None:
+            def gather(k_caches, v_caches, ids):
+                k = jnp.stack([kc[ids] for kc in k_caches])  # [L, n, bs, kvh, d]
+                v = jnp.stack([vc[ids] for vc in v_caches])
+                return k, v
+
+            fn = engine._ici_gather_fn = jax.jit(gather)
+        return fn
+
+    @staticmethod
+    def _scatter_fn(engine):
+        fn = getattr(engine, "_ici_scatter_fn", None)
+        if fn is None:
+            def scatter(k_caches, v_caches, kp, vp, ids):
+                new_k = [kc.at[ids].set(kp[i]) for i, kc in enumerate(k_caches)]
+                new_v = [vc.at[ids].set(vp[i]) for i, vc in enumerate(v_caches)]
+                return new_k, new_v
+
+            fn = engine._ici_scatter_fn = jax.jit(scatter, donate_argnums=(0, 1))
+        return fn
+
+    async def move(self, hashes: List[SequenceHash]) -> Optional[int]:
+        """Copy the blocks for ``hashes`` src->dst device-side; returns blocks
+        imported, or None on failure (caller falls back to the DCN path)."""
+        import asyncio
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import mesh as meshlib
+
+        src, dst = self.src, self.dst
+        loop = asyncio.get_event_loop()
+        src_ids = src.allocator.acquire_prefix(hashes)  # pin (loop thread)
+        if not src_ids:
+            return 0
+        try:
+            n = len(src_ids)
+            from .allocator import OutOfBlocks
+
+            try:
+                dst_ids = dst.allocator.allocate(n)
+            except OutOfBlocks:
+                log.warning("ici move: no room for %d blocks on dest", n)
+                return 0
+
+            def gather():
+                ids = jnp.asarray(np.asarray(src_ids, np.int32))
+                return IciKvMover._gather_fn(src)(src.k_caches, src.v_caches, ids)
+
+            try:
+                kp, vp = await loop.run_in_executor(src._executor, gather)
+                # [L, n, bs, kvh, d]: kv heads keep their TP sharding, now on
+                # the destination mesh — the D2D hop. kv_cache_spec covers
+                # [nb, bs, kvh, d]; prepend the layer axis.
+                dst_sh = NamedSharding(
+                    dst.mesh, P(None, *meshlib.kv_cache_spec())
+                )
+
+                def scatter():
+                    kpd = jax.device_put(kp, dst_sh)
+                    vpd = jax.device_put(vp, dst_sh)
+                    ids = jnp.asarray(np.asarray(dst_ids, np.int32))
+                    dst.k_caches, dst.v_caches = IciKvMover._scatter_fn(dst)(
+                        dst.k_caches, dst.v_caches, kpd, vpd, ids
+                    )
+
+                await loop.run_in_executor(dst._executor, scatter)
+            except Exception:
+                log.exception("ici move failed; falling back to DCN")
+                dst.allocator.release(dst_ids)
+                return None
+            for bid, h in zip(dst_ids, hashes):
+                dst.allocator.commit(bid, h)
+            dst.allocator.release(dst_ids)
+            return n
+        finally:
+            src.allocator.release(src_ids)
+
+
 class KvTransferClient:
     """Fetches remote pages and imports them into a local engine's cache."""
 
@@ -243,6 +361,20 @@ class KvTransferClient:
         want = hashes[have:]
         if not want:
             return have * alloc.block_size
+        # same-process server (same-slice xPyD): pages move HBM->HBM over
+        # the device fabric, skipping the wire entirely. DTPU_ICI_TRANSFER=0
+        # forces the wire path (used by the DCN-protocol tests).
+        import os
+
+        local = (
+            LOCAL_SERVERS.get(address)
+            if os.environ.get("DTPU_ICI_TRANSFER", "1") != "0" else None
+        )
+        if local is not None and local.engine is not self.engine:
+            moved = await IciKvMover(local.engine, self.engine).move(list(want))
+            if moved is not None:
+                return (have + moved) * alloc.block_size
+            # device path failed: fall through to the DCN protocol
         from ..transfer import native_available
 
         stream = await self._tcp.call(

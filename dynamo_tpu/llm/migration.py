@@ -60,8 +60,15 @@ class Migration:
                     yield out
                     if out.finish_reason is not None:
                         return
-                # stream ended without finish_reason: worker died mid-request
-                raise NoResponders("stream ended without finish")
+                # stream ended without finish_reason: worker died mid-request.
+                # Attribute the instance (the request plane's _TaggedStream
+                # carries it) so the retry excludes the dead worker even on a
+                # clean EOF with no transport exception.
+                eof = NoResponders("stream ended without finish")
+                iid = getattr(stream, "instance_id", None)
+                if iid is not None:
+                    eof.instance_id = iid  # type: ignore[attr-defined]
+                raise eof
             except (NoResponders, ConnectionError) as e:
                 if context.is_stopped() or attempts_left <= 0:
                     if attempts_left <= 0 and not context.is_stopped():

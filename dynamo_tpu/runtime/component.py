@@ -195,6 +195,33 @@ class ServedEndpoint:
 KvSelector = Callable[[Any, List[Instance]], Awaitable[int]]
 
 
+class _TaggedStream:
+    """Response stream that knows which instance serves it.
+
+    Transport errors get ``instance_id`` stamped on them mid-iteration, and
+    the attribute itself lets consumers (the migration operator) attribute a
+    CLEAN stream EOF — a worker teardown that closes the stream without a
+    finish frame raises no exception, yet the retry still must exclude the
+    dead instance."""
+
+    def __init__(self, stream: AsyncIterator[Any], instance_id: int):
+        self._stream = stream
+        self.instance_id = instance_id
+
+    def __aiter__(self) -> "_TaggedStream":
+        return self
+
+    async def __anext__(self) -> Any:
+        try:
+            return await self._stream.__anext__()
+        except StopAsyncIteration:
+            raise
+        except (NoResponders, ConnectionError) as e:
+            if getattr(e, "instance_id", None) is None:
+                e.instance_id = self.instance_id  # type: ignore[attr-defined]
+            raise
+
+
 class Client:
     """Endpoint client with live instance tracking + push routing."""
 
@@ -293,19 +320,7 @@ class Client:
             if getattr(e, "instance_id", None) is None:
                 e.instance_id = inst.instance_id  # type: ignore[attr-defined]
             raise
-        return self._tag_stream_errors(stream, inst.instance_id)
-
-    @staticmethod
-    async def _tag_stream_errors(
-        stream: AsyncIterator[Any], iid: int
-    ) -> AsyncIterator[Any]:
-        try:
-            async for item in stream:
-                yield item
-        except (NoResponders, ConnectionError) as e:
-            if getattr(e, "instance_id", None) is None:
-                e.instance_id = iid  # type: ignore[attr-defined]
-            raise
+        return _TaggedStream(stream, inst.instance_id)
 
     async def stop(self) -> None:
         if self._watcher is not None:

@@ -145,21 +145,36 @@ class MultihostContext:
         port = int(port)
         if self.is_leader:
             srv = socket.create_server((host, port), reuse_port=False)
-            srv.settimeout(timeout_s)
+            deadline = time.monotonic() + timeout_s
             try:
                 pending = self.spec.num_processes - 1
                 seen: Dict[int, socket.socket] = {}
                 while len(seen) < pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"only {len(seen)}/{pending} followers dialed in"
+                        )
+                    srv.settimeout(remaining)
                     conn, _addr = srv.accept()
                     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    # bound the hello read too: a stray connection (port
+                    # scanner, dead follower) must not wedge startup — drop
+                    # it and keep accepting
+                    conn.settimeout(5.0)
+                    try:
+                        hello = b""
+                        while len(hello) < 4:
+                            part = conn.recv(4 - len(hello))
+                            if not part:
+                                raise ConnectionError("hello truncated")
+                            hello += part
+                        (pid,) = _LEN.unpack(hello)
+                    except (OSError, ConnectionError) as e:
+                        log.warning("control dial-in rejected: %s", e)
+                        conn.close()
+                        continue
                     conn.settimeout(None)  # dispatch gaps are unbounded
-                    hello = b""
-                    while len(hello) < 4:
-                        part = conn.recv(4 - len(hello))
-                        if not part:
-                            raise ConnectionError("follower hello truncated")
-                        hello += part
-                    (pid,) = _LEN.unpack(hello)
                     seen[pid] = conn
                 # deterministic fan-out order
                 self._socks = [seen[k] for k in sorted(seen)]
@@ -267,11 +282,24 @@ class MultihostOps:
         self._set = state_set
         self._ops: Dict[str, tuple] = {}
         self._carry: Dict[str, Any] = {}
+        self._closed = False
         # dispatches come from more than one thread (the engine's step
         # executor AND its asyncio loop thread); broadcast + local XLA
         # dispatch happen under ONE lock so every process executes the same
         # total order — jit returns after async-enqueue, so the hold is ~ms
         self._dispatch_lock = threading.Lock()
+
+    def close(self) -> None:
+        """Stop the group, serialized against in-flight dispatches.
+
+        Taking the dispatch lock means any dispatch racing this close either
+        fully broadcast+executed BEFORE the __stop__ frame (the follower
+        replays it, then exits) or is rejected after — a late collective
+        executed by the leader alone would block forever waiting for peers.
+        """
+        with self._dispatch_lock:
+            self._closed = True
+            self.mh.close()
 
     def register(self, name: str, fn: Callable, state_in: Dict[int, str],
                  state_out: Dict[int, str], carry_in: Optional[Dict[int, str]] = None):
@@ -303,6 +331,10 @@ class MultihostOps:
                 )
                 call[i] = host
             with self._dispatch_lock:
+                if self._closed:
+                    raise RuntimeError(
+                        f"multihost group stopped; dropping dispatch {name!r}"
+                    )
                 _trace("leader: broadcast %s", name)
                 mh.broadcast(name, send)
                 out = fn(*call)

@@ -1,0 +1,133 @@
+"""ICI device-to-device KV transfer (engine/transfer.py IciKvMover).
+
+The round-3 verdict's item #3: the same-slice fast path must move pages
+HBM->HBM (gather on the source mesh -> device_put reshard -> scatter on the
+destination mesh) and be BIT-IDENTICAL to the DCN host-staging protocol.
+Reference analog: NIXL GPU<->GPU RDMA (lib/memory/src/nixl.rs:13,
+docs/design_docs/disagg_serving.md:20,54).
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.engine.transfer import LOCAL_SERVERS
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.runtime import Context
+
+BS = 4
+
+
+def _cfg(tp=1, devices=None):
+    mcfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+    return TpuEngineConfig(
+        model=mcfg, num_blocks=32, block_size=BS, max_batch_size=2,
+        max_context=128, prefill_buckets=(16, 32, 64, 128), tp=tp,
+    )
+
+
+async def _prefill_src(src, prompt):
+    """Run one greedy request through src so it holds committed pages."""
+    req = PreprocessedRequest(
+        request_id="src", model="m", token_ids=prompt,
+        stop=StopConditions(max_tokens=2, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+    async for _ in src.generate(req, Context()):
+        pass
+
+
+def _block_bytes(engine, hashes):
+    """Concatenated bytes of every layer's K and V pages for ``hashes``."""
+    ids = engine.allocator.acquire_prefix(hashes)
+    assert len(ids) == len(hashes), (ids, hashes)
+    try:
+        out = b""
+        for kc, vc in zip(engine.k_caches, engine.v_caches):
+            out += np.asarray(kc[np.asarray(ids)]).tobytes()
+            out += np.asarray(vc[np.asarray(ids)]).tobytes()
+        return out
+    finally:
+        engine.allocator.release(ids)
+
+
+async def _run_bit_equality(monkeypatch):
+    prompt = list(range(50, 50 + 5 * BS))  # 5 full blocks, 4 committed
+    devs = jax.devices()
+    src = TpuEngine(_cfg(tp=2), mesh=make_mesh(tp=2, devices=devs[0:2]))
+    # dst engines live on a DIFFERENT device group: the device_put hop is a
+    # real cross-group copy (ICI on TPU hardware)
+    dst_ici = TpuEngine(_cfg(tp=2), mesh=make_mesh(tp=2, devices=devs[2:4]))
+    dst_dcn = TpuEngine(_cfg(tp=2), mesh=make_mesh(tp=2, devices=devs[4:6]))
+    addr = None
+    try:
+        await _prefill_src(src, prompt)
+        addr = await src.serve_transfer()
+        from dynamo_tpu.tokens import compute_sequence_hashes
+
+        hashes = compute_sequence_hashes(prompt, BS)[: (len(prompt) - 1) // BS]
+        assert hashes
+
+        # --- ICI path (default for a co-resident server) ---
+        assert addr in LOCAL_SERVERS
+        got = await dst_ici._get_transfer_client().fetch_and_import(addr, hashes)
+        assert got == len(hashes) * BS
+
+        # --- DCN path (forced over the wire) ---
+        monkeypatch.setenv("DTPU_ICI_TRANSFER", "0")
+        got = await dst_dcn._get_transfer_client().fetch_and_import(addr, hashes)
+        assert got == len(hashes) * BS
+
+        src_bytes = _block_bytes(src, hashes)
+        ici_bytes = _block_bytes(dst_ici, hashes)
+        dcn_bytes = _block_bytes(dst_dcn, hashes)
+        assert ici_bytes == src_bytes, "ICI-moved pages differ from source"
+        assert ici_bytes == dcn_bytes, "ICI and DCN paths disagree"
+    finally:
+        src.stop()
+        dst_ici.stop()
+        dst_dcn.stop()
+        if addr is not None:
+            assert addr not in LOCAL_SERVERS  # stop() deregisters
+
+
+async def test_ici_bit_equality_with_dcn(monkeypatch):
+    await _run_bit_equality(monkeypatch)
+
+
+async def test_ici_falls_back_when_dest_full(monkeypatch):
+    """Destination out of blocks: the mover returns 0/None gracefully and
+    the client reports only what was imported."""
+    prompt = list(range(10, 10 + 3 * BS))
+    devs = jax.devices()
+    src = TpuEngine(_cfg(), mesh=make_mesh(tp=1, devices=devs[0:1]))
+    dst = TpuEngine(_cfg(), mesh=make_mesh(tp=1, devices=devs[1:2]))
+    addr = None
+    try:
+        await _prefill_src(src, prompt)
+        addr = await src.serve_transfer()
+        from dynamo_tpu.tokens import compute_sequence_hashes
+
+        hashes = compute_sequence_hashes(prompt, BS)[: (len(prompt) - 1) // BS]
+        # exhaust the destination allocator
+        hog = dst.allocator.allocate(dst.allocator.free_blocks)
+        got = await dst._get_transfer_client().fetch_and_import(addr, hashes)
+        assert got == 0
+        dst.allocator.release(hog)
+        got = await dst._get_transfer_client().fetch_and_import(addr, hashes)
+        assert got == len(hashes) * BS
+    finally:
+        src.stop()
+        dst.stop()
