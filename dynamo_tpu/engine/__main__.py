@@ -190,16 +190,22 @@ def _load_model(args):
     make the collective device_put shards consistent)."""
     params = None
     if args.model_path:
-        mcfg = config_from_hf(args.model_path)
+        # --model-path accepts a local dir OR a hub reference ("org/name"
+        # resolved through the HF cache / optional download — llm/hub.py,
+        # reference lib/llm/src/hub.rs)
+        from dynamo_tpu.llm.hub import resolve_model_path
+
+        path = resolve_model_path(args.model_path)
+        mcfg = config_from_hf(path)
         if args.no_warm_cache:
-            params = load_params(args.model_path, mcfg)
+            params = load_params(path, mcfg)
         else:
             # warm restore (engine/warm.py): restarted workers skip the
             # checkpoint parse (chrek/CRIU analog, SURVEY §2.4)
             from dynamo_tpu.engine.warm import load_params_warm
 
-            params = load_params_warm(args.model_path, mcfg)
-        tokenizer_ref = args.tokenizer or args.model_path
+            params = load_params_warm(path, mcfg)
+        tokenizer_ref = args.tokenizer or path
     else:
         mcfg = PRESETS[args.preset]()
         tokenizer_ref = args.tokenizer or "byte"

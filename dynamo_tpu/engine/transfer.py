@@ -280,7 +280,11 @@ class IciKvMover:
                 new_v = [vc.at[ids].set(vp[i]) for i, vc in enumerate(v_caches)]
                 return new_k, new_v
 
-            fn = engine._ici_scatter_fn = jax.jit(scatter, donate_argnums=(0, 1))
+            # NOT donated: a dispatch failure after donation would leave the
+            # engine pointing at deleted cache buffers while the caller falls
+            # back to DCN and keeps serving. One cache copy per import — the
+            # same cost the DCN import path (_scatter_blocks) already pays.
+            fn = engine._ici_scatter_fn = jax.jit(scatter)
         return fn
 
     async def move(self, hashes: List[SequenceHash]) -> Optional[int]:

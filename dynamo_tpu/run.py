@@ -52,10 +52,12 @@ def _build_engine(out: str, args):
     if out in engine_main.PRESETS:
         mcfg = engine_main.PRESETS[out]()
         params, tokenizer = None, "byte"
-    else:  # a local HF checkpoint directory
+    else:  # a local HF checkpoint directory or hub reference (llm/hub.py)
         from .engine.warm import load_params_warm
         from .engine.weights import config_from_hf
+        from .llm.hub import resolve_model_path
 
+        out = resolve_model_path(out)
         mcfg = config_from_hf(out)
         params = load_params_warm(out, mcfg)
         tokenizer = out
