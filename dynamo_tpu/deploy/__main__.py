@@ -1,16 +1,47 @@
-"""python -m dynamo_tpu.deploy — render a graph spec to k8s manifests.
+"""python -m dynamo_tpu.deploy — render a graph spec, or reconcile it.
 
     python -m dynamo_tpu.deploy render deploy/examples/agg-serving.yaml
     python -m dynamo_tpu.deploy render spec.yaml -o manifests/
+    python -m dynamo_tpu.deploy controller spec.yaml --store file --store-path /tmp/s
+
+`controller` runs the operator's reconcile loop (deploy/controller.py):
+spawns/kills local worker processes to match the spec + live planner scale
+targets, restarts crashes, hot-reloads the spec, and writes status back to
+the store.
 """
 
 import argparse
+import asyncio
 import os
+import signal as _signal
 import sys
 
 import yaml
 
 from dynamo_tpu.deploy.render import GraphSpec, render, render_yaml
+
+
+async def _run_controller(args) -> None:
+    from dynamo_tpu.deploy.controller import GraphController, default_runner
+    from dynamo_tpu.runtime.discovery.store import make_store
+
+    store = make_store(args.store, args.store_path)
+    graph = GraphSpec.load(args.spec)
+    ctl = GraphController(
+        store, graph,
+        runner=default_runner(args.store, args.store_path),
+        namespace=args.namespace,
+        interval_s=args.interval,
+        spec_path=args.spec,
+    ).start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for s in (_signal.SIGINT, _signal.SIGTERM):
+        loop.add_signal_handler(s, stop.set)
+    print(f"CONTROLLER_READY {graph.name}", flush=True)
+    await stop.wait()
+    await ctl.stop()
+    await store.close()
 
 
 def main() -> None:
@@ -20,7 +51,17 @@ def main() -> None:
     r.add_argument("spec")
     r.add_argument("-o", "--out-dir", default=None,
                    help="write one file per object (default: stdout stream)")
+    c = sub.add_parser("controller", help="reconcile the spec with local processes")
+    c.add_argument("spec")
+    c.add_argument("--store", default="file")
+    c.add_argument("--store-path", default="/tmp/dtpu_store")
+    c.add_argument("--namespace", default="dynamo")
+    c.add_argument("--interval", type=float, default=1.0)
     args = p.parse_args()
+
+    if args.cmd == "controller":
+        asyncio.run(_run_controller(args))
+        return
 
     graph = GraphSpec.load(args.spec)
     if args.out_dir is None:

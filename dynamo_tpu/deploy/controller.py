@@ -1,0 +1,255 @@
+"""GraphController: the operator's reconcile loop, scaled to one host.
+
+Analog of the reference's DynamoGraphDeployment controller
+(deploy/operator/internal/controller/dynamographdeployment_controller.go):
+a level-triggered loop that drives ACTUAL worker processes toward DESIRED
+state, where desired = the rendered graph spec (deploy/render.py GraphSpec)
+overlaid with live scale targets written by the planner (the
+VirtualConnector's ``v1/scale/{ns}/{component}`` keys — the reference
+planner patches the CRD's replicas the same way).
+
+What reconciliation covers, mirroring the Go controller's behavior:
+  - spawn/kill to match replicas (scale subresource);
+  - restart crashed processes (pod restart policy);
+  - hot-reload of the spec file (CRD update events);
+  - a status object written back to the store (status subresource):
+    per-service desired/ready plus controller conditions.
+
+Processes are real OS processes (mocker / engine / frontend workers built
+from the ServiceSpec); on k8s the same spec renders to Deployments via
+deploy/render.py — the controller is what makes the single-host (and CI)
+story reconcile for real instead of pretending.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..planner.connectors import target_key
+from ..runtime.discovery.store import KVStore
+from ..runtime.logging import get_logger
+from .render import GraphSpec, ServiceSpec
+
+log = get_logger("deploy.controller")
+
+
+def status_key(namespace: str, graph: str) -> str:
+    return f"v1/controller/{namespace}/{graph}/status"
+
+
+def default_runner(store_kind: str, store_path: str):
+    """ServiceSpec -> argv for one local worker process of that service."""
+
+    def run(svc: ServiceSpec, index: int) -> List[str]:
+        base = [sys.executable, "-m"]
+        store = ["--store", store_kind, "--store-path", store_path]
+        if svc.kind == "frontend":
+            return base + ["dynamo_tpu.frontend"] + store + list(svc.args)
+        if svc.kind == "router":
+            return base + ["dynamo_tpu.router"] + store + list(svc.args)
+        if svc.kind == "kvbm":
+            return base + ["dynamo_tpu.kvbm"] + list(svc.args)
+        # worker: a real engine when a model/preset is pinned, else mocker
+        if svc.preset or svc.model:
+            cmd = base + ["dynamo_tpu.engine"] + store + [
+                "--component", svc.name, "--tp", str(svc.tp),
+                "--sp", str(svc.sp), "--dp", str(svc.dp),
+            ]
+            if svc.preset:
+                cmd += ["--preset", svc.preset]
+            if svc.model:
+                # spec `model` is a checkpoint reference (local dir or hub
+                # org/name) — the weights to LOAD, served under that name.
+                # --model alone would only rename a random-init preset.
+                cmd += ["--model-path", svc.model, "--model", svc.model]
+            if svc.disagg:
+                cmd += ["--disagg", svc.disagg]
+            return cmd + list(svc.args)
+        return base + ["dynamo_tpu.mocker"] + store + [
+            "--component", svc.name,
+        ] + list(svc.args)
+
+    return run
+
+
+@dataclasses.dataclass
+class _Proc:
+    popen: subprocess.Popen
+    started: float
+    restarts: int = 0
+
+
+class GraphController:
+    def __init__(
+        self,
+        store: KVStore,
+        graph: GraphSpec,
+        runner: Callable[[ServiceSpec, int], List[str]],
+        namespace: str = "dynamo",
+        interval_s: float = 1.0,
+        spec_path: Optional[str] = None,
+        restart_backoff_s: float = 1.0,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.store = store
+        self.graph = graph
+        self.runner = runner
+        self.namespace = namespace
+        self.interval_s = interval_s
+        self.spec_path = spec_path
+        self.restart_backoff_s = restart_backoff_s
+        self.env = env
+        self._procs: Dict[str, List[_Proc]] = {}
+        # scale-down victims: SIGTERM'd, awaiting exit; escalated to SIGKILL
+        # past their grace deadline and reaped (wait) so nothing zombies
+        self._stopping: List[tuple] = []  # (_Proc, kill_deadline)
+        self._stop_grace_s = 10.0
+        self._last_crash: Dict[str, float] = {}
+        self._spec_mtime = (
+            os.path.getmtime(spec_path) if spec_path else 0.0
+        )
+        self._task: Optional[asyncio.Task] = None
+        self.restarts_total = 0
+
+    # ------------------------------------------------------------ desired
+    async def _desired(self, svc: ServiceSpec) -> int:
+        """Spec replicas, overridden by a live planner scale target."""
+        obj = await self.store.get_obj(target_key(self.namespace, svc.name))
+        if obj and "target" in obj:
+            return max(0, int(obj["target"]))
+        return svc.replicas
+
+    def _maybe_reload_spec(self) -> None:
+        if not self.spec_path:
+            return
+        try:
+            mtime = os.path.getmtime(self.spec_path)
+        except OSError:
+            return
+        if mtime != self._spec_mtime:
+            self._spec_mtime = mtime
+            try:
+                self.graph = GraphSpec.load(self.spec_path)
+                log.info("spec reloaded from %s", self.spec_path)
+            except Exception:
+                log.exception("bad spec update ignored (keeping last good)")
+
+    # ---------------------------------------------------------- reconcile
+    def _drain_stopping(self) -> None:
+        """Reap terminated scale-down victims; SIGKILL stragglers."""
+        still: List[tuple] = []
+        for p, deadline in self._stopping:
+            if p.popen.poll() is not None:
+                p.popen.wait()  # reap
+                continue
+            if time.time() >= deadline:
+                log.warning("pid %d ignored SIGTERM; killing", p.popen.pid)
+                p.popen.kill()
+            still.append((p, deadline))
+        self._stopping = still
+
+    async def reconcile_once(self) -> Dict[str, Any]:
+        self._maybe_reload_spec()
+        self._drain_stopping()
+        status: Dict[str, Any] = {"services": {}, "ts": time.time()}
+        # garbage-collect services removed by a spec update (the k8s
+        # controller deletes their Deployments the same way)
+        live_names = {svc.name for svc in self.graph.services}
+        for name in list(self._procs):
+            if name not in live_names:
+                for p in self._procs.pop(name):
+                    if p.popen.poll() is None:
+                        log.info("service %s removed: stopping pid %d",
+                                 name, p.popen.pid)
+                        p.popen.send_signal(signal.SIGTERM)
+                        self._stopping.append(
+                            (p, time.time() + self._stop_grace_s)
+                        )
+        for svc in self.graph.services:
+            desired = await self._desired(svc)
+            procs = self._procs.setdefault(svc.name, [])
+            # reap exits; a crash (nonzero before teardown) counts toward
+            # the restart condition and is backed off, not hot-looped
+            alive: List[_Proc] = []
+            for p in procs:
+                if p.popen.poll() is None:
+                    alive.append(p)
+                else:
+                    rc = p.popen.returncode
+                    if rc != 0:
+                        log.warning(
+                            "%s worker pid %d crashed rc=%s",
+                            svc.name, p.popen.pid, rc,
+                        )
+                        self._last_crash[svc.name] = time.time()
+                        self.restarts_total += 1
+            procs[:] = alive
+            backoff_until = (
+                self._last_crash.get(svc.name, 0.0) + self.restart_backoff_s
+            )
+            while len(procs) < desired and time.time() >= backoff_until:
+                cmd = self.runner(svc, len(procs))
+                log.info("spawn %s[%d]: %s", svc.name, len(procs), " ".join(cmd))
+                procs.append(_Proc(
+                    subprocess.Popen(
+                        cmd,
+                        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                        env={**os.environ, **(self.env or {})},
+                    ),
+                    started=time.time(),
+                ))
+            while len(procs) > desired:
+                p = procs.pop()
+                log.info("scale down %s: stopping pid %d", svc.name, p.popen.pid)
+                p.popen.send_signal(signal.SIGTERM)
+                self._stopping.append((p, time.time() + self._stop_grace_s))
+            status["services"][svc.name] = {
+                "desired": desired,
+                "ready": len(procs),
+            }
+        try:
+            await self.store.put_obj(
+                status_key(self.namespace, self.graph.name), status
+            )
+        except Exception:
+            log.exception("status write failed")
+        return status
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "GraphController":
+        async def loop() -> None:
+            try:
+                while True:
+                    try:
+                        await self.reconcile_once()
+                    except Exception:
+                        log.exception("reconcile failed")
+                    await asyncio.sleep(self.interval_s)
+            except asyncio.CancelledError:
+                pass
+
+        self._task = asyncio.create_task(loop())
+        return self
+
+    async def stop(self, graceful_s: float = 5.0) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        everyone = [p for procs in self._procs.values() for p in procs]
+        everyone += [p for p, _ in self._stopping]
+        for p in everyone:
+            if p.popen.poll() is None:
+                p.popen.send_signal(signal.SIGTERM)
+        deadline = time.time() + graceful_s
+        for p in everyone:
+            while p.popen.poll() is None and time.time() < deadline:
+                await asyncio.sleep(0.1)
+            if p.popen.poll() is None:
+                p.popen.kill()
+            p.popen.wait()

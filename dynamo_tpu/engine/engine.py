@@ -275,7 +275,7 @@ class TpuEngine:
         self._dev_cache: Dict[str, jax.Array] = {}
         self._loop_task: Optional[asyncio.Task] = None
         self._prefill_tasks: set = set()  # in-flight first-token readbacks
-        self._last_published_load: Tuple[int, int] = (-1, -1)
+        self._last_published_load: Tuple[int, int, int] = (-1, -1, -1)
         self._wake = asyncio.Event()
         # engine health: False after a step-loop crash (watchdog deregisters
         # the worker; reference components/src/dynamo/vllm/engine_monitor.py)
@@ -1903,12 +1903,16 @@ class TpuEngine:
             # no events (blocks just move to the reusable cache), and a
             # stale active-block report would leave the router seeing
             # phantom load on an idle worker
-            load = (self.allocator.active_blocks, len(self._waiting))
+            running = sum(
+                1 for s in self._slots if s is not None and not s.done
+            )
+            load = (self.allocator.active_blocks, len(self._waiting), running)
             if stored or removed or load != self._last_published_load:
                 self._last_published_load = load
                 await self.metrics_publisher.publish(
                     active_decode_blocks=load[0],
                     num_requests_waiting=load[1],
+                    num_requests_active=running,
                     total_blocks=self.cfg.num_blocks,
                 )
 

@@ -22,6 +22,7 @@ def parse_args():
     p.add_argument(
         "--router-mode", choices=["round-robin", "random", "kv"], default="round-robin"
     )
+    p.add_argument("--namespace", default="dynamo")
     p.add_argument("--store", default=None, help="mem|file (default from DTPU_STORE)")
     p.add_argument("--store-path", default=None)
     p.add_argument("--event-plane", default=None, help="zmq|inproc")
@@ -50,9 +51,14 @@ async def main() -> None:
     watcher = await ModelWatcher(
         runtime, manager, RouterMode(args.router_mode), kv_cfg
     ).start()
+    # per-request stats onto the event plane: the planner's demand +
+    # correction-factor feed (planner/metrics_source.py)
+    from dynamo_tpu.planner.metrics_source import FrontendStatsPublisher
+
+    stats = FrontendStatsPublisher(runtime.event_plane, args.namespace)
     service = HttpService(
         manager, runtime.metrics, busy_threshold=args.busy_threshold,
-        host=args.host, port=args.port,
+        host=args.host, port=args.port, stats_hook=stats.on_request,
     )
     await service.start()
     grpc_service = None

@@ -115,6 +115,9 @@ class WorkerMetrics:
     active_decode_blocks: int = 0
     active_prefill_tokens: int = 0
     num_requests_waiting: int = 0
+    # running SEQUENCES (not blocks): the planner's ITL interpolation is
+    # keyed on decode concurrency, which blocks overstate by ctx/block_size
+    num_requests_active: int = 0
     total_blocks: int = 0
     ts: float = 0.0
 
@@ -124,6 +127,7 @@ class WorkerMetrics:
             "decode_blocks": self.active_decode_blocks,
             "prefill_tokens": self.active_prefill_tokens,
             "waiting": self.num_requests_waiting,
+            "active": self.num_requests_active,
             "total_blocks": self.total_blocks,
             "ts": self.ts,
         }
@@ -135,6 +139,7 @@ class WorkerMetrics:
             active_decode_blocks=obj.get("decode_blocks", 0),
             active_prefill_tokens=obj.get("prefill_tokens", 0),
             num_requests_waiting=obj.get("waiting", 0),
+            num_requests_active=obj.get("active", 0),
             total_blocks=obj.get("total_blocks", 0),
             ts=obj.get("ts", 0.0),
         )

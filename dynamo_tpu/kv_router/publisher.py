@@ -94,6 +94,7 @@ class WorkerMetricsPublisher:
         active_decode_blocks: int = 0,
         active_prefill_tokens: int = 0,
         num_requests_waiting: int = 0,
+        num_requests_active: int = 0,
         total_blocks: int = 0,
     ) -> None:
         m = WorkerMetrics(
@@ -101,6 +102,7 @@ class WorkerMetricsPublisher:
             active_decode_blocks=active_decode_blocks,
             active_prefill_tokens=active_prefill_tokens,
             num_requests_waiting=num_requests_waiting,
+            num_requests_active=num_requests_active,
             total_blocks=total_blocks,
             ts=time.time(),
         )

@@ -94,7 +94,12 @@ class HttpService:
         port: int = 8000,
         tracer: Optional[Tracer] = None,
         audit_bus: Optional[AuditBus] = None,
+        stats_hook=None,
     ):
+        # stats_hook(prompt_tokens, completion_tokens, ttft_s, itl_s) fires
+        # once per completed generation — the planner's demand/correction
+        # feed (planner/metrics_source.py FrontendStatsPublisher)
+        self.stats_hook = stats_hook
         self.manager = manager
         self.host = host
         self.port = port
@@ -172,23 +177,39 @@ class HttpService:
 
     # -- shared request path -------------------------------------------------
     def _observed(
-        self, stream: AsyncIterator[BackendOutput], model: str, t_start: float
+        self, stream: AsyncIterator[BackendOutput], model: str, t_start: float,
+        prompt_tokens: int = 0,
     ) -> AsyncIterator[BackendOutput]:
         """Wrap the token stream with TTFT/ITL observation."""
 
         async def gen():
             first_at = None
             last_at = None
-            async for out in stream:
-                now = time.monotonic()
-                if out.token_ids:
-                    if first_at is None:
-                        first_at = now
-                        self._ttft.observe(now - t_start, model=model)
-                    elif last_at is not None:
-                        self._itl.observe(now - last_at, model=model)
-                    last_at = now
-                yield out
+            n_tokens = 0
+            try:
+                async for out in stream:
+                    now = time.monotonic()
+                    if out.token_ids:
+                        n_tokens += len(out.token_ids)
+                        if first_at is None:
+                            first_at = now
+                            self._ttft.observe(now - t_start, model=model)
+                        elif last_at is not None:
+                            self._itl.observe(now - last_at, model=model)
+                        last_at = now
+                    yield out
+            finally:
+                if self.stats_hook is not None and first_at is not None:
+                    itl = (
+                        (last_at - first_at) / (n_tokens - 1)
+                        if last_at and n_tokens > 1 else 0.0
+                    )
+                    try:
+                        self.stats_hook(
+                            prompt_tokens, n_tokens, first_at - t_start, itl
+                        )
+                    except Exception:
+                        log.exception("stats hook failed")
 
         return gen()
 
@@ -221,7 +242,8 @@ class HttpService:
         span.__enter__()
         try:
             stream = self._observed(
-                pipeline.generate_tokens(preq, ctx), model, time.monotonic()
+                pipeline.generate_tokens(preq, ctx), model, time.monotonic(),
+                prompt_tokens=len(preq.token_ids),
             )
             if stream_mode:
                 resp = web.StreamResponse(headers=SSE_HEADERS)
@@ -499,7 +521,8 @@ class HttpService:
         span.__enter__()
         try:
             stream = self._observed(
-                pipeline.generate_tokens(preq, ctx), rreq.model, time.monotonic()
+                pipeline.generate_tokens(preq, ctx), rreq.model, time.monotonic(),
+                prompt_tokens=len(preq.token_ids),
             )
             if not rreq.stream:
                 text = []

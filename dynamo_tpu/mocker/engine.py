@@ -405,6 +405,7 @@ class MockerEngine:
             await self.metrics_publisher.publish(
                 active_decode_blocks=len(self.kv.active),
                 num_requests_waiting=len(self._waiting),
+                num_requests_active=len(self._running),
                 total_blocks=self.args.num_blocks,
             )
 

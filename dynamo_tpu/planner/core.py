@@ -45,6 +45,17 @@ class PerfInterpolator:
     def decode_capacity(self, active_seqs: float) -> float:
         return self._interp(self.decode_points, active_seqs, self.decode_tokens_per_s)
 
+    # -- expected latencies (the correction-factor reference curves) --------
+    # The profiled points already encode them: a prefill point is
+    # (isl, isl/ttft), a decode point is (concurrency, aggregate rate) so
+    # per-stream ITL = concurrency / rate. Mirrors the reference's
+    # interpolate_ttft / interpolate_itl (perf_interpolation.py).
+    def expected_ttft(self, isl: float) -> float:
+        return max(isl, 1.0) / max(self.prefill_capacity(isl), 1e-9)
+
+    def expected_itl(self, active_seqs: float) -> float:
+        return max(active_seqs, 1.0) / max(self.decode_capacity(active_seqs), 1e-9)
+
     # -- calibration from measured sweeps (profiler/sweep.py) ----------------
     def fit_prefill(self, points) -> "PerfInterpolator":
         self.prefill_points = [tuple(p) for p in points]
@@ -107,6 +118,9 @@ class PlannerConfig:
     # planner_core.py:132-256); 0 = unbounded
     total_budget: int = 0
     scale_down_headroom: float = 0.8   # only shrink when utilization < this
+    # EMA weight kept on the previous correction factor each window (0 =
+    # jump straight to the latest measurement)
+    correction_smoothing: float = 0.5
     sla: SlaTargets = dataclasses.field(default_factory=SlaTargets)
 
 
@@ -120,6 +134,10 @@ class LoadSnapshot:
     avg_isl: float = 0.0
     num_waiting: int = 0
     active_seqs: int = 0
+    # measured serving latencies over the window (0 = not observed): feed
+    # the correction factors (reference planner_core.py:766-820)
+    measured_ttft: float = 0.0
+    measured_itl: float = 0.0
     ts: float = dataclasses.field(default_factory=time.time)
 
 
@@ -141,13 +159,32 @@ class PoolPlanner:
         self.capacity_fn = capacity_fn  # (snapshot) -> tokens/s one worker sustains
         self.load_predictor = make_predictor(config.predictor)
         self.last_decision: Optional[int] = None
+        # measured-vs-profiled latency ratio, EMA-smoothed: >1 means the
+        # fleet runs slower than its profile (stale sweep, noisy neighbors,
+        # longer contexts), so every profiled capacity is scaled down by it.
+        # Reference: p_correction_factor / d_correction_factor
+        # (planner_core.py:766-829). Clamped — one bad window must not 4x
+        # the fleet.
+        self.correction = 1.0
 
     def observe(self, rate: float) -> None:
         self.load_predictor.observe(rate)
 
+    def update_correction(self, measured: float, expected: float) -> None:
+        if measured <= 0 or expected <= 0:
+            return
+        raw = min(max(measured / expected, 0.25), 4.0)
+        self.correction = (
+            self.config.correction_smoothing * self.correction
+            + (1.0 - self.config.correction_smoothing) * raw
+        )
+
+    def _capacity(self, snapshot: LoadSnapshot) -> float:
+        return max(self.capacity_fn(snapshot), 1e-9) / self.correction
+
     def desired_replicas(self, snapshot: LoadSnapshot) -> int:
         predicted = self.load_predictor.predict(1)
-        capacity = max(self.capacity_fn(snapshot), 1e-9)
+        capacity = self._capacity(snapshot)
         needed = math.ceil(predicted / capacity)
         # queue pressure bumps the floor: waiting work means we're behind
         if snapshot.num_waiting > 0:
@@ -160,7 +197,7 @@ class PoolPlanner:
         if desired < current:
             # hysteresis: only scale down with real headroom
             predicted = self.load_predictor.predict(1)
-            capacity = max(self.capacity_fn(snapshot), 1e-9)
+            capacity = self._capacity(snapshot)
             if predicted > capacity * desired * self.config.scale_down_headroom:
                 desired = current
         if desired != current:
@@ -200,6 +237,16 @@ class DisaggPlanner:
     def observe(self, snapshot: LoadSnapshot) -> None:
         self.prefill.observe(snapshot.prefill_tokens_rate)
         self.decode.observe(snapshot.decode_tokens_rate)
+        # close the loop on the profile: measured TTFT/ITL vs what the sweep
+        # predicted at this load (reference _update_correction_factor)
+        if snapshot.measured_ttft > 0:
+            self.prefill.update_correction(
+                snapshot.measured_ttft, self.interp.expected_ttft(snapshot.avg_isl)
+            )
+        if snapshot.measured_itl > 0:
+            self.decode.update_correction(
+                snapshot.measured_itl, self.interp.expected_itl(snapshot.active_seqs)
+            )
         self._last_snapshot = snapshot
 
     async def plan(self) -> Dict[str, int]:

@@ -1,9 +1,11 @@
 """Demand observation for the planner.
 
 The reference scrapes Prometheus (components/src/dynamo/planner/utils/
-prometheus.py); here the primary source is the event plane the workers
-already publish to (WorkerMetrics: waiting queue, active blocks), plus an
-optional Prometheus scrape of the frontend for request/token rates.
+prometheus.py); here the sources are the event plane the workers already
+publish to (WorkerMetrics: waiting queue, active sequences/blocks) plus a
+frontend stats topic (FrontendStatsPublisher below — per-request prompt/
+completion token counts and measured TTFT/ITL, the inputs to both the demand
+predictors and the correction factors).
 """
 
 from __future__ import annotations
@@ -23,8 +25,42 @@ from .core import LoadSnapshot
 log = get_logger("planner.metrics")
 
 
+def frontend_stats_topic(namespace: str) -> str:
+    return f"v1.frontend_stats.{namespace}"
+
+
+class FrontendStatsPublisher:
+    """Frontend side: publish one compact stats event per completed request.
+
+    Wired as the HttpService ``stats_hook`` (llm/http/service.py _observed):
+    the HTTP layer already measures TTFT/ITL per stream for its Prometheus
+    histograms; this fans the same numbers out to the planner."""
+
+    def __init__(self, plane: EventPlane, namespace: str = "dynamo"):
+        self.plane = plane
+        self.topic = frontend_stats_topic(namespace)
+
+    def on_request(self, prompt_tokens: int, completion_tokens: int,
+                   ttft_s: float, itl_s: float) -> None:
+        payload = msgpack.packb({
+            "pt": int(prompt_tokens), "ct": int(completion_tokens),
+            "ttft": float(ttft_s), "itl": float(itl_s), "ts": time.time(),
+        }, use_bin_type=True)
+
+        async def _send() -> None:
+            try:
+                await self.plane.publish(self.topic, payload)
+            except Exception:
+                log.exception("frontend stats publish failed")
+
+        try:
+            asyncio.get_running_loop().create_task(_send())
+        except RuntimeError:
+            pass  # no loop (teardown): stats are best-effort
+
+
 class EventPlaneMetricsSource:
-    """Aggregates worker metrics into LoadSnapshots."""
+    """Aggregates worker metrics + frontend stats into LoadSnapshots."""
 
     def __init__(self, plane: EventPlane, namespace: str, components: list):
         self.plane = plane
@@ -33,16 +69,22 @@ class EventPlaneMetricsSource:
         self._latest: Dict[WorkerWithDpRank, WorkerMetrics] = {}
         self._tasks = []
         self._subs = []
-        # cumulative token counters for rate estimation
+        # per-window accumulators for rate/latency estimation
         self._last_rate_calc = time.time()
         self._decode_tokens_window = 0
         self._prefill_tokens_window = 0
+        self._requests_window = 0
+        self._ttft_window: list = []
+        self._itl_window: list = []
 
     async def start(self) -> "EventPlaneMetricsSource":
         for comp in self.components:
             sub = await self.plane.subscribe(metrics_topic(self.namespace, comp))
             self._subs.append(sub)
             self._tasks.append(asyncio.create_task(self._consume(sub)))
+        stats_sub = await self.plane.subscribe(frontend_stats_topic(self.namespace))
+        self._subs.append(stats_sub)
+        self._tasks.append(asyncio.create_task(self._consume_stats(stats_sub)))
         return self
 
     async def _consume(self, sub) -> None:
@@ -53,25 +95,64 @@ class EventPlaneMetricsSource:
             except Exception:
                 log.exception("bad worker metrics")
 
+    async def _consume_stats(self, sub) -> None:
+        async for _topic, payload in sub:
+            try:
+                st = msgpack.unpackb(payload, raw=False)
+                self.record_request(int(st.get("pt", 0)))
+                self.record_decode_tokens(int(st.get("ct", 0)))
+                self.record_latency(
+                    ttft_s=float(st.get("ttft", 0.0)),
+                    itl_s=float(st.get("itl", 0.0)),
+                )
+            except Exception:
+                log.exception("bad frontend stats")
+
     def record_request(self, prefill_tokens: int) -> None:
         self._prefill_tokens_window += prefill_tokens
+        self._requests_window += 1
 
     def record_decode_tokens(self, n: int) -> None:
         self._decode_tokens_window += n
+
+    def record_latency(self, ttft_s: float = 0.0, itl_s: float = 0.0) -> None:
+        """Per-stream measured latencies, averaged per window into the
+        snapshot so the planner's correction factors track reality."""
+        if ttft_s > 0:
+            self._ttft_window.append(ttft_s)
+        if itl_s > 0:
+            self._itl_window.append(itl_s)
 
     def snapshot(self) -> LoadSnapshot:
         now = time.time()
         dt = max(now - self._last_rate_calc, 1e-6)
         fresh = [m for m in self._latest.values() if now - m.ts < 30.0]
+        n_req = self._requests_window
         snap = LoadSnapshot(
+            request_rate=n_req / dt,
             prefill_tokens_rate=self._prefill_tokens_window / dt,
             decode_tokens_rate=self._decode_tokens_window / dt,
+            # correction factors compare measured latency against the
+            # profile at THIS window's operating point: mean prompt length
+            # and live decode concurrency
+            avg_isl=(self._prefill_tokens_window / n_req) if n_req else 0.0,
             num_waiting=sum(m.num_requests_waiting for m in fresh),
-            active_seqs=sum(m.active_decode_blocks for m in fresh),
+            active_seqs=sum(m.num_requests_active for m in fresh),
+            measured_ttft=(
+                sum(self._ttft_window) / len(self._ttft_window)
+                if self._ttft_window else 0.0
+            ),
+            measured_itl=(
+                sum(self._itl_window) / len(self._itl_window)
+                if self._itl_window else 0.0
+            ),
         )
         self._last_rate_calc = now
         self._prefill_tokens_window = 0
         self._decode_tokens_window = 0
+        self._requests_window = 0
+        self._ttft_window = []
+        self._itl_window = []
         return snap
 
     def stop(self) -> None:

@@ -1,0 +1,168 @@
+"""Operator reconcile loop e2e: planner -> scale target -> controller ->
+real worker processes -> discovery.
+
+Round-3 verdict item #8: the controller (deploy/controller.py) is the
+DynamoGraphDeployment-controller analog — it must actually reconcile:
+spawn to spec, pick up planner scale targets, restart crashes, reap on
+scale-down, and report status. Reference:
+deploy/operator/internal/controller/dynamographdeployment_controller.go,
+tests/planner/test_scaling_e2e.py.
+"""
+
+import asyncio
+import os
+import signal
+
+from dynamo_tpu.deploy.controller import GraphController, default_runner, status_key
+from dynamo_tpu.deploy.render import GraphSpec, ServiceSpec
+from dynamo_tpu.planner.connectors import VirtualConnector
+from dynamo_tpu.planner.core import (
+    LoadSnapshot,
+    PerfInterpolator,
+    PlannerConfig,
+    PoolPlanner,
+)
+from dynamo_tpu.runtime import DistributedRuntime, InProcEventPlane, RuntimeConfig
+from dynamo_tpu.runtime.discovery.store import make_store
+
+
+def _graph() -> GraphSpec:
+    return GraphSpec(
+        name="op-e2e",
+        services=[ServiceSpec(
+            name="backend", kind="worker", replicas=1,
+            args=["--model", "op-model", "--event-plane", "inproc",
+                  "--migration-limit", "0"],
+        )],
+    )
+
+
+async def _wait(cond, timeout=60.0, every=0.2, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        v = cond()
+        if asyncio.iscoroutine(v):
+            v = await v
+        if v:
+            return
+        await asyncio.sleep(every)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_planner_scales_through_controller(tmp_path):
+    asyncio.run(asyncio.wait_for(_run(tmp_path), timeout=240))
+
+
+async def _run(tmp_path):
+    store_path = str(tmp_path / "store")
+    env = {"JAX_PLATFORMS": "cpu"}
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    store = make_store("file", store_path)
+    ctl = GraphController(
+        store, _graph(), runner=default_runner("file", store_path),
+        interval_s=0.3, restart_backoff_s=0.2, env=env,
+    ).start()
+
+    # a discovery-side client runtime, like a frontend would hold
+    rt = await DistributedRuntime(
+        RuntimeConfig(store="file", store_path=store_path,
+                      event_plane="inproc", lease_ttl_s=2.0),
+        event_plane=InProcEventPlane(),
+    ).start()
+    client = await rt.namespace("dynamo").component("backend").endpoint(
+        "generate"
+    ).client()
+    try:
+        # 1. spec replicas=1 -> one worker registers
+        await _wait(lambda: len(client.instances) == 1, msg="first worker")
+
+        # 2. the PLANNER raises the target: high observed load vs a profile
+        #    that sustains 1000 t/s/worker -> 3 workers; controller obeys
+        conn = VirtualConnector(store)
+        interp = PerfInterpolator()
+        interp.fit_prefill([(128.0, 1000.0)])
+        pool = PoolPlanner(
+            "prefill", "backend", conn,
+            PlannerConfig(min_replicas=1, max_replicas=8),
+            lambda s: interp.prefill_capacity(s.avg_isl),
+        )
+        for _ in range(5):
+            pool.observe(2500.0)
+        desired = await pool.plan_and_apply(LoadSnapshot(avg_isl=128.0))
+        assert desired == 3
+        await _wait(lambda: len(client.instances) == 3, msg="scale to 3")
+
+        # 3. crash one worker: the controller restarts it (pod restart)
+        victim = ctl._procs["backend"][0].popen
+        victim.send_signal(signal.SIGKILL)
+        await _wait(
+            lambda: ctl.restarts_total >= 1
+            and sum(
+                1 for p in ctl._procs["backend"] if p.popen.poll() is None
+            ) == 3,
+            msg="crash restart",
+        )
+
+        # 4. scale down to 1: processes reaped, status reflects it
+        await conn.set_replicas("backend", 1)
+        await _wait(
+            lambda: len([
+                p for p in ctl._procs["backend"] if p.popen.poll() is None
+            ]) == 1,
+            msg="scale down",
+        )
+        status = await store.get_obj(status_key("dynamo", "op-e2e"))
+        assert status and status["services"]["backend"]["desired"] == 1
+    finally:
+        await rt.shutdown()
+        await ctl.stop()
+        await store.close()
+
+
+def test_spec_hot_reload(tmp_path):
+    asyncio.run(asyncio.wait_for(_run_reload(tmp_path), timeout=120))
+
+
+async def _run_reload(tmp_path):
+    import yaml
+
+    store_path = str(tmp_path / "store")
+    spec_path = str(tmp_path / "graph.yaml")
+
+    def write_spec(replicas):
+        with open(spec_path, "w") as f:
+            yaml.safe_dump({
+                "name": "reload-e2e",
+                "services": {"backend": {
+                    "kind": "worker", "replicas": replicas,
+                    "args": ["--model", "r-model", "--event-plane", "inproc"],
+                }},
+            }, f)
+
+    write_spec(1)
+    store = make_store("file", store_path)
+    ctl = GraphController(
+        store, GraphSpec.load(spec_path),
+        runner=default_runner("file", store_path),
+        interval_s=0.3, spec_path=spec_path, env={"JAX_PLATFORMS": "cpu"},
+    ).start()
+    try:
+        await _wait(
+            lambda: len([
+                p for p in ctl._procs.get("backend", [])
+                if p.popen.poll() is None
+            ]) == 1,
+            msg="initial spawn",
+        )
+        await asyncio.sleep(0.1)
+        write_spec(2)  # CRD update analog
+        await _wait(
+            lambda: len([
+                p for p in ctl._procs.get("backend", [])
+                if p.popen.poll() is None
+            ]) == 2,
+            msg="hot reload to 2",
+        )
+    finally:
+        await ctl.stop()
+        await store.close()

@@ -131,3 +131,79 @@ async def test_virtual_connector_roundtrip():
     obj = await store.get_obj("v1/planner/ns/backend/target_replicas")
     assert obj == {"target": 5}
     await store.close()
+
+
+class TestCorrectionFactors:
+    """Measured TTFT/ITL feed back into capacity (reference
+    planner_core.py:766-829 _update_correction_factor)."""
+
+    def test_expected_latency_from_profile(self):
+        interp = PerfInterpolator()
+        interp.fit_prefill([(1000.0, 20000.0)])   # 1000-token prompt at 20k t/s
+        assert abs(interp.expected_ttft(1000.0) - 0.05) < 1e-9
+        interp.fit_decode([(8.0, 800.0)])         # 8 streams, 800 t/s aggregate
+        assert abs(interp.expected_itl(8.0) - 0.01) < 1e-9
+
+    async def test_miscalibrated_profile_converges(self):
+        """Profile claims 2x the real capacity; measured TTFT (2x expected)
+        corrects the replica count to what the true capacity needs."""
+        conn = FakeConnector()
+        cfg = PlannerConfig(
+            min_replicas=1, max_replicas=32, correction_smoothing=0.5,
+        )
+        interp = PerfInterpolator()
+        interp.fit_prefill([(500.0, 2000.0)])  # claimed; true capacity 1000 t/s
+        planner = DisaggPlanner(conn, cfg, interpolator=interp)
+
+        load = 4000.0  # needs 4 @ true capacity, profile says 2
+        for _ in range(12):
+            snap = LoadSnapshot(
+                prefill_tokens_rate=load, avg_isl=500.0,
+                # the engine is 2x slower than profiled at this ISL
+                measured_ttft=2.0 * interp.expected_ttft(500.0),
+            )
+            planner.observe(snap)
+        uncorrected = DisaggPlanner(conn, cfg, interpolator=interp)
+        for _ in range(12):
+            uncorrected.observe(LoadSnapshot(
+                prefill_tokens_rate=load, avg_isl=500.0,
+            ))
+        assert uncorrected.prefill.desired_replicas(LoadSnapshot(avg_isl=500.0)) == 2
+        # corrected: capacity 2000/2 = 1000 -> ceil(4000/1000) = 4
+        assert planner.prefill.correction > 1.9
+        assert planner.prefill.desired_replicas(LoadSnapshot(avg_isl=500.0)) == 4
+
+    def test_correction_is_clamped(self):
+        conn = FakeConnector()
+        pool = PoolPlanner("p", "c", conn, PlannerConfig(correction_smoothing=0.0),
+                           lambda s: 1000.0)
+        pool.update_correction(measured=100.0, expected=0.001)  # absurd window
+        assert pool.correction == 4.0
+        pool.update_correction(measured=0.0001, expected=10.0)
+        assert pool.correction == 0.25
+
+
+async def test_frontend_stats_feed_snapshot():
+    """HttpService stats hook -> event plane -> metrics source -> snapshot:
+    the correction-factor inputs actually flow in production wiring."""
+    from dynamo_tpu.planner.metrics_source import (
+        EventPlaneMetricsSource,
+        FrontendStatsPublisher,
+    )
+    from dynamo_tpu.runtime import InProcEventPlane
+
+    plane = InProcEventPlane()
+    source = await EventPlaneMetricsSource(plane, "dynamo", ["backend"]).start()
+    pub = FrontendStatsPublisher(plane, "dynamo")
+    pub.on_request(prompt_tokens=512, completion_tokens=64, ttft_s=0.2, itl_s=0.01)
+    pub.on_request(prompt_tokens=256, completion_tokens=32, ttft_s=0.1, itl_s=0.02)
+    for _ in range(50):
+        await asyncio.sleep(0.01)
+        if source._requests_window == 2:
+            break
+    snap = source.snapshot()
+    assert snap.avg_isl == 384.0
+    assert abs(snap.measured_ttft - 0.15) < 1e-9
+    assert abs(snap.measured_itl - 0.015) < 1e-9
+    assert snap.prefill_tokens_rate > 0 and snap.decode_tokens_rate > 0
+    source.stop()
