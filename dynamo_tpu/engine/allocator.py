@@ -12,7 +12,7 @@ Emits stored/removed events (sequence-hash space) for the KV router feed.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from ..tokens import SequenceHash
 

@@ -17,7 +17,7 @@ instance id.
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, List, Optional
+from typing import Any, AsyncIterator, List
 
 from ..runtime.engine import Context
 from ..runtime.logging import get_logger

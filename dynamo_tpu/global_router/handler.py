@@ -12,9 +12,9 @@ SLA targets ride request annotations ``ttft_target_ms`` / ``itl_target_ms``
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, Dict, Optional
+from typing import Any, AsyncIterator, Dict
 
-from ..llm.protocols.common import BackendOutput, PreprocessedRequest
+from ..llm.protocols.common import PreprocessedRequest
 from ..runtime.component import Client, RouterMode
 from ..runtime.engine import Context
 from ..runtime.logging import get_logger

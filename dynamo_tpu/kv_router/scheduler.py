@@ -18,7 +18,7 @@ import dataclasses
 import math
 import random
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from ..runtime.logging import get_logger
 from .protocols import OverlapScores, WorkerMetrics, WorkerWithDpRank

@@ -14,7 +14,7 @@ generated *_pb2_grpc stubs.
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, Optional
+from typing import AsyncIterator, Optional
 
 import grpc
 

@@ -348,6 +348,7 @@ class HttpService:
             preq.request_id, req.model, include_usage,
             reasoning_parser=_safe_parser(get_reasoning_parser, card.reasoning_parser),
             tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
+            tool_choice=req.tool_choice,
         )
         audit_handle = self.audit.create_handle(
             body, preq.request_id, req.model, req.stream
@@ -360,6 +361,7 @@ class HttpService:
                     get_reasoning_parser, card.reasoning_parser
                 ),
                 tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
+                tool_choice=req.tool_choice,
             ),
             audit_handle=audit_handle,
         )

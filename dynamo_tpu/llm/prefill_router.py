@@ -13,7 +13,7 @@ disaggregation, like the reference (disagg_serving.md:67-69).
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import Optional
 
 from ..kv_router import KvRouter, KvRouterConfig, WorkerWithDpRank
 from ..runtime.component import Client, RouterMode

@@ -31,6 +31,7 @@ class ChatDeltaGenerator:
         include_usage: bool = False,
         reasoning_parser=None,
         tool_parser=None,
+        tool_choice=None,
     ):
         self.id = request_id
         self.model = model
@@ -43,6 +44,22 @@ class ChatDeltaGenerator:
         self.reasoning_parser = reasoning_parser
         self.tool_parser = tool_parser
         self._tool_call_count = 0
+        # forced tool_choice = the reference jail's Immediate mode
+        # (jail.rs JailMode::Immediate): the WHOLE output is a tool call, so
+        # every token is jailed from the first and parsed at finish —
+        # "required" expects a JSON array of calls, a named choice expects
+        # that function's bare argument object
+        self._forced: Optional[tuple] = None
+        self._forced_buf = ""
+        if tool_choice == "required":
+            self._forced = ("required", None)
+        elif tool_choice == "none":
+            # explicit opt-out beats the model card: no tool parsing at all
+            self.tool_parser = None
+        elif isinstance(tool_choice, dict):
+            name = (tool_choice.get("function") or {}).get("name")
+            if name:
+                self._forced = ("named", name)
         # logprob entries not yet attached to an emitted content chunk (jail
         # holdback / parser diversion can delay the text they belong to)
         self._pending_logprobs: list = []
@@ -89,6 +106,34 @@ class ChatDeltaGenerator:
             self._tool_call_count += 1
         return text, reasoning, tool_calls
 
+    def _parse_forced(self):
+        """End-of-stream parse of the jailed buffer (reference
+        ToolChoiceFormat::{ArrayOfTools, SingleObject}). Malformed output
+        degrades to plain content rather than a dropped response."""
+        import json as _json
+
+        from ...parsers.tool_calls import _mk_call
+
+        mode, name = self._forced
+        text = self._forced_buf.strip()
+        self._forced_buf = ""
+        try:
+            obj = _json.loads(text)
+        except Exception:
+            return [], text
+        if mode == "named":
+            return [_mk_call(name, obj)], ""
+        calls = obj if isinstance(obj, list) else [obj]
+        try:
+            return [
+                _mk_call(
+                    c["name"], c.get("arguments", c.get("parameters", {}))
+                )
+                for c in calls
+            ], ""
+        except (KeyError, TypeError):
+            return [], text
+
     def on_output(self, out: BackendOutput):
         """Yields zero or more chunks for one backend step."""
         if out.annotations:
@@ -102,7 +147,27 @@ class ChatDeltaGenerator:
             chunks.append(self._chunk(ChatDelta(role="assistant", content="")))
         finished = out.finish_reason is not None
         step_entries = list(out.logprob_entries or [])
-        content, reasoning, tool_calls = self._parse(out.text or "", flush=finished)
+        if self._forced is not None:
+            # immediate jail: accumulate silently; parse everything at finish.
+            # logprob entries ride along so the malformed-output content
+            # fallback still carries every token's logprob
+            self._forced_buf += out.text or ""
+            self._pending_logprobs.extend(step_entries)
+            step_entries = []
+            if not finished:
+                return chunks
+            tool_calls, content = self._parse_forced()
+            for tc in tool_calls:
+                tc["index"] = self._tool_call_count
+                self._tool_call_count += 1
+            if tool_calls:
+                # OpenAI logprobs.content covers content tokens only
+                self._pending_logprobs = []
+            reasoning = ""
+        else:
+            content, reasoning, tool_calls = self._parse(
+                out.text or "", flush=finished
+            )
         if reasoning:
             chunks.append(self._chunk(ChatDelta(reasoning_content=reasoning)))
         if content:
@@ -154,11 +219,13 @@ async def aggregate_chat(
     stream: AsyncIterator[BackendOutput],
     reasoning_parser=None,
     tool_parser=None,
+    tool_choice=None,
 ) -> ChatCompletionResponse:
     """Non-streaming mode: fold the whole stream into one response."""
     gen = ChatDeltaGenerator(
         request_id, model,
         reasoning_parser=reasoning_parser, tool_parser=tool_parser,
+        tool_choice=tool_choice,
     )
     text_parts = []
     reasoning_parts = []

@@ -16,7 +16,6 @@ layout.rs) with heads minor to keep per-head slices dense for TP sharding.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax

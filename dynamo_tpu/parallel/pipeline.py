@@ -23,8 +23,7 @@ activation hops and grad psum over ICI neighbors, nothing bounces off DCN.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict
 
 import jax
 import jax.numpy as jnp

@@ -198,6 +198,95 @@ class PythonicToolParser:
         return ToolEvent(content=held)
 
 
+class HarmonyToolParser:
+    """gpt-oss harmony dialect (reference tool_calling/harmony/
+    harmony_parser.rs): commentary-channel messages addressed to a
+    ``functions.*`` recipient are tool calls —
+
+        <|channel|>commentary to=functions.get_weather <|constrain|>json
+        <|message|>{"location": "SF"}<|call|>
+
+    analysis/final channels are the reasoning parser's business (gpt_oss
+    entry in parsers/reasoning.py); this parser extracts only the
+    tool-call messages and passes everything else through, holding back
+    partial headers at chunk boundaries like every streaming parser here.
+    """
+
+    HEADER = "<|channel|>commentary to="
+    MSG = "<|message|>"
+    ENDS = ("<|call|>", "<|end|>", "<|return|>")
+
+    def __init__(self) -> None:
+        self._buf = ""
+
+    def _try_parse_call(self) -> Optional[Dict[str, Any]]:
+        """Parse one complete call at the head of ``_buf`` (which starts
+        right after HEADER); returns the call and consumes it, or None if
+        more text is needed (ValueError on malformed header)."""
+        midx = self._buf.find(self.MSG)
+        if midx < 0:
+            return None
+        header = self._buf[:midx]
+        recipient = header.split()[0] if header.split() else ""
+        if not recipient.startswith("functions."):
+            # NOT consumed: the caller re-emits the header and the message
+            # flows through as ordinary content
+            raise ValueError(f"commentary recipient {recipient!r} is not a function")
+        end_idx, end_len = -1, 0
+        for e in self.ENDS:
+            i = self._buf.find(e, midx + len(self.MSG))
+            if i >= 0 and (end_idx < 0 or i < end_idx):
+                end_idx, end_len = i, len(e)
+        if end_idx < 0:
+            return None
+        args = self._buf[midx + len(self.MSG):end_idx]
+        self._buf = self._buf[end_idx + end_len:]
+        return _mk_call(recipient[len("functions."):], args.strip())
+
+    def feed(self, text: str) -> ToolEvent:
+        self._buf += text
+        ev = ToolEvent()
+        while True:
+            idx = self._buf.find(self.HEADER)
+            if idx < 0:
+                safe, self._buf = split_safe(self._buf, [self.HEADER])
+                ev.content += safe
+                return ev
+            head, self._buf = self._buf[:idx], self._buf[idx + len(self.HEADER):]
+            try:
+                call = self._try_parse_call()
+            except ValueError:
+                # commentary to a non-function recipient: emit it verbatim
+                ev.content += head + self.HEADER
+                continue
+            if call is None:  # incomplete: restore and wait for more text
+                self._buf = self.HEADER + self._buf
+                ev.content += head
+                return ev
+            ev.content += head
+            ev.tool_calls.append(call)
+
+    def flush(self) -> ToolEvent:
+        held, self._buf = self._buf, ""
+        # end-of-stream may cut the terminator off a final call: accept a
+        # message that parses as JSON even without <|call|>
+        if held.startswith(self.HEADER):
+            body = held[len(self.HEADER):]
+            midx = body.find(self.MSG)
+            if midx >= 0:
+                recipient = body[:midx].split()[0] if body[:midx].split() else ""
+                args = body[midx + len(self.MSG):].strip()
+                if recipient.startswith("functions."):
+                    try:
+                        json.loads(args)
+                        return ToolEvent(tool_calls=[
+                            _mk_call(recipient[len("functions."):], args)
+                        ])
+                    except Exception:
+                        pass
+        return ToolEvent(content=held)
+
+
 _REGISTRY = {
     "json": JsonToolParser,
     "hermes": JsonToolParser,
@@ -205,6 +294,8 @@ _REGISTRY = {
     "pythonic": PythonicToolParser,
     "xml": XmlToolParser,
     "dsml": XmlToolParser,
+    "harmony": HarmonyToolParser,
+    "gpt_oss": HarmonyToolParser,
 }
 
 

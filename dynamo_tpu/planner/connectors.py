@@ -19,8 +19,7 @@ import asyncio
 import os
 import signal
 import subprocess
-import sys
-from typing import Dict, List, Optional, Protocol
+from typing import Dict, List, Protocol
 
 from ..runtime.discovery.store import KVStore
 from ..runtime.logging import get_logger
@@ -58,21 +57,38 @@ class VirtualConnector:
 
 
 class SubprocessConnector:
-    """Spawns real local workers to match the target (fleet-in-a-box)."""
+    """Spawns real local workers to match the target (fleet-in-a-box).
+
+    The minimal direct-drive connector for benches and tests. For the full
+    process lifecycle (crash restarts with backoff, SIGKILL escalation,
+    spec-driven fleets, status reporting) use the operator analog,
+    deploy/controller.py GraphController, with a VirtualConnector."""
 
     def __init__(self, make_cmd, poll_ready_s: float = 0.0):
         """make_cmd(component, index) -> argv list for one worker process."""
         self.make_cmd = make_cmd
         self.poll_ready_s = poll_ready_s
         self._procs: Dict[str, List[subprocess.Popen]] = {}
+        self._stopping: List[subprocess.Popen] = []
+
+    def _reap_stopping(self) -> None:
+        still = []
+        for p in self._stopping:
+            if p.poll() is None:
+                still.append(p)
+            else:
+                p.wait()  # reap: SIGTERM'd workers must not linger as zombies
+        self._stopping = still
 
     async def get_replicas(self, component: str) -> int:
+        self._reap_stopping()
         procs = self._procs.get(component, [])
         procs = [p for p in procs if p.poll() is None]
         self._procs[component] = procs
         return len(procs)
 
     async def set_replicas(self, component: str, n: int) -> None:
+        self._reap_stopping()
         procs = self._procs.setdefault(component, [])
         procs[:] = [p for p in procs if p.poll() is None]
         while len(procs) < n:
@@ -90,12 +106,19 @@ class SubprocessConnector:
             p = procs.pop()
             log.info("stopping %s worker pid %d", component, p.pid)
             p.send_signal(signal.SIGTERM)
+            self._stopping.append(p)
 
     def shutdown(self) -> None:
         for procs in self._procs.values():
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+                p.wait()
+        for p in self._stopping:
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+        self._stopping = []
 
 
 class KubernetesConnector:

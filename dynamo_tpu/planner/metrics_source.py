@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 import msgpack
 
@@ -39,6 +39,9 @@ class FrontendStatsPublisher:
     def __init__(self, plane: EventPlane, namespace: str = "dynamo"):
         self.plane = plane
         self.topic = frontend_stats_topic(namespace)
+        # strong refs: the loop only weak-refs tasks, and a GC'd publish
+        # task silently drops the stats event
+        self._inflight: set = set()
 
     def on_request(self, prompt_tokens: int, completion_tokens: int,
                    ttft_s: float, itl_s: float) -> None:
@@ -54,7 +57,9 @@ class FrontendStatsPublisher:
                 log.exception("frontend stats publish failed")
 
         try:
-            asyncio.get_running_loop().create_task(_send())
+            task = asyncio.get_running_loop().create_task(_send())
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
         except RuntimeError:
             pass  # no loop (teardown): stats are best-effort
 

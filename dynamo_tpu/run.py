@@ -21,7 +21,7 @@ import argparse
 import asyncio
 import json
 import sys
-from typing import Any, AsyncIterator, Optional
+from typing import Any, AsyncIterator
 
 from .llm import ModelDeploymentCard, register_llm
 from .llm.protocols.common import BackendOutput

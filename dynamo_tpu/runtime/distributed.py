@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 from typing import Optional
 
-from .component import DistributedRuntimeBase, Namespace
+from .component import DistributedRuntimeBase
 from .config import RuntimeConfig
 from .discovery.store import KVStore, make_store
 from .event_plane.base import EventPlane, InProcEventPlane

@@ -1,0 +1,45 @@
+"""Static analysis gate (tools/lint.py).
+
+The reference runs mypy inside pytest (pyproject.toml:155) so wiring bugs in
+rarely-executed paths fail CI. No mypy/ruff exists in this image, so the
+gate is the stdlib symtable/ast linter: undefined module-level names and
+unused imports across the whole package.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_lints_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         os.path.join(REPO, "dynamo_tpu")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, "\n" + r.stdout
+
+
+def test_linter_catches_undefined_name(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def handler(x):\n"
+        "    return undefined_helper(x)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "UNDEFINED: undefined_helper" in r.stdout
+
+
+def test_linter_catches_unused_import(tmp_path):
+    bad = tmp_path / "bad2.py"
+    bad.write_text("import json\nX = 1\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "UNUSED-IMPORT: json" in r.stdout

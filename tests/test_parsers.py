@@ -243,3 +243,158 @@ class TestReviewFixes:
         from dynamo_tpu.parsers import get_reasoning_parser as grp
         assert _safe_parser(grp, "definitely-not-a-parser") is None
         assert _safe_parser(grp, None) is None
+
+
+class TestHarmonyToolParser:
+    """gpt-oss harmony dialect (reference tool_calling/harmony/)."""
+
+    def _parser(self):
+        from dynamo_tpu.parsers.tool_calls import get_tool_parser
+
+        return get_tool_parser("harmony")
+
+    def test_single_call(self):
+        p = self._parser()
+        ev = p.feed(
+            '<|channel|>commentary to=functions.get_weather '
+            '<|constrain|>json<|message|>{"location": "SF"}<|call|>'
+        )
+        assert len(ev.tool_calls) == 1
+        f = ev.tool_calls[0]["function"]
+        assert f["name"] == "get_weather"
+        assert json.loads(f["arguments"]) == {"location": "SF"}
+        assert ev.content == ""
+
+    def test_chunked_across_boundaries(self):
+        p = self._parser()
+        text = (
+            'preamble <|channel|>commentary to=functions.search '
+            '<|message|>{"q": "tpu"}<|call|> after'
+        )
+        content = ""
+        calls = []
+        for i in range(0, len(text), 7):  # 7-byte chunks split every marker
+            ev = p.feed(text[i:i + 7])
+            content += ev.content
+            calls.extend(ev.tool_calls)
+        fin = p.flush()
+        content += fin.content
+        calls.extend(fin.tool_calls)
+        assert [c["function"]["name"] for c in calls] == ["search"]
+        assert content == "preamble  after"
+
+    def test_non_function_commentary_passes_through(self):
+        p = self._parser()
+        text = "<|channel|>commentary to=user <|message|>hello<|end|>"
+        ev = p.feed(text)
+        ev2 = p.flush()
+        assert not ev.tool_calls and not ev2.tool_calls
+        assert "hello" in (ev.content + ev2.content)
+
+    def test_flush_accepts_missing_terminator(self):
+        p = self._parser()
+        p.feed('<|channel|>commentary to=functions.f <|message|>{"a": 1}')
+        fin = p.flush()
+        assert len(fin.tool_calls) == 1
+        assert json.loads(fin.tool_calls[0]["function"]["arguments"]) == {"a": 1}
+
+    def test_with_gpt_oss_reasoning(self):
+        """Full gpt-oss route: analysis -> reasoning, commentary -> tool
+        call, final -> content."""
+        from dynamo_tpu.llm.protocols.common import BackendOutput
+        from dynamo_tpu.llm.protocols.delta import ChatDeltaGenerator
+        from dynamo_tpu.parsers import get_reasoning_parser, get_tool_parser
+
+        gen = ChatDeltaGenerator(
+            "r1", "m",
+            reasoning_parser=get_reasoning_parser("gpt_oss"),
+            tool_parser=get_tool_parser("harmony"),
+        )
+        text = (
+            "<|channel|>analysis<|message|>think hard<|end|>"
+            '<|channel|>commentary to=functions.calc <|message|>{"x": 2}<|call|>'
+            "<|channel|>final<|message|>done<|return|>"
+        )
+        chunks = list(gen.on_output(BackendOutput(text=text, token_ids=[1])))
+        chunks += list(gen.on_output(BackendOutput(finish_reason="stop")))
+        reasoning = "".join(
+            c.choices[0].delta.reasoning_content or ""
+            for c in chunks if c.choices
+        )
+        content = "".join(
+            c.choices[0].delta.content or "" for c in chunks if c.choices
+        )
+        calls = [
+            tc for c in chunks if c.choices
+            for tc in (c.choices[0].delta.tool_calls or [])
+        ]
+        finishes = [
+            c.choices[0].finish_reason for c in chunks
+            if c.choices and c.choices[0].finish_reason
+        ]
+        assert reasoning == "think hard"
+        assert content == "done"
+        assert [c["function"]["name"] for c in calls] == ["calc"]
+        assert finishes == ["tool_calls"]
+
+
+class TestForcedToolChoice:
+    """tool_choice=required/named -> immediate jail of the whole stream
+    (reference jail.rs JailMode::Immediate)."""
+
+    def _collect(self, gen, texts):
+        from dynamo_tpu.llm.protocols.common import BackendOutput
+
+        chunks = []
+        for t in texts[:-1]:
+            chunks += list(gen.on_output(BackendOutput(text=t, token_ids=[1])))
+        chunks += list(gen.on_output(
+            BackendOutput(text=texts[-1], token_ids=[1], finish_reason="stop")
+        ))
+        calls = [
+            tc for c in chunks if c.choices
+            for tc in (c.choices[0].delta.tool_calls or [])
+        ]
+        content = "".join(
+            c.choices[0].delta.content or "" for c in chunks if c.choices
+        )
+        finishes = [
+            c.choices[0].finish_reason for c in chunks
+            if c.choices and c.choices[0].finish_reason
+        ]
+        return calls, content, finishes
+
+    def test_required_array(self):
+        from dynamo_tpu.llm.protocols.delta import ChatDeltaGenerator
+
+        gen = ChatDeltaGenerator("r", "m", tool_choice="required")
+        calls, content, finishes = self._collect(
+            gen,
+            ['[{"name": "a", "argu', 'ments": {"x": 1}}, '
+             '{"name": "b", "parameters": {}}]'],
+        )
+        assert [c["function"]["name"] for c in calls] == ["a", "b"]
+        assert content == ""
+        assert finishes == ["tool_calls"]
+
+    def test_named_single_object(self):
+        from dynamo_tpu.llm.protocols.delta import ChatDeltaGenerator
+
+        gen = ChatDeltaGenerator(
+            "r", "m",
+            tool_choice={"type": "function", "function": {"name": "lookup"}},
+        )
+        calls, content, finishes = self._collect(gen, ['{"city": "Par', 'is"}'])
+        assert len(calls) == 1
+        assert calls[0]["function"]["name"] == "lookup"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Paris"}
+        assert finishes == ["tool_calls"]
+
+    def test_malformed_falls_back_to_content(self):
+        from dynamo_tpu.llm.protocols.delta import ChatDeltaGenerator
+
+        gen = ChatDeltaGenerator("r", "m", tool_choice="required")
+        calls, content, finishes = self._collect(gen, ["not json at all"])
+        assert calls == []
+        assert content == "not json at all"
+        assert finishes == ["stop"]

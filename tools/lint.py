@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Stdlib-only lint gate: undefined globals + unused imports.
+
+The reference runs mypy inside pytest (pyproject.toml:155) so wiring bugs in
+rarely-executed paths fail CI, not production. This image has no mypy/ruff
+and installs are off-limits, so this is the same class of check built on
+``symtable`` + ``ast``:
+
+  - UNDEFINED: a name used in some scope that resolves to the module global
+    namespace but is never assigned, imported, or a builtin — the classic
+    misspelled-call / forgotten-import bug in an error path no test runs.
+  - UNUSED-IMPORT: imported at module level, referenced nowhere (re-export
+    shims like __init__.py are exempt, as are ``as _``-style underscore
+    bindings and __future__).
+
+Usage: python tools/lint.py [paths...]  (default: dynamo_tpu/)
+Exit 1 on findings. tests/test_lint.py runs this in the suite.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import sys
+import symtable
+
+BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__",
+}
+
+
+def module_files(paths):
+    for root in paths:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for f in sorted(filenames):
+                # *_pb2.py is protoc output: it builds names via descriptor
+                # metaprogramming that static analysis can't see
+                if f.endswith(".py") and not f.endswith("_pb2.py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _collect_scopes(table, out):
+    out.append(table)
+    for child in table.get_children():
+        _collect_scopes(child, out)
+
+
+def undefined_globals(path: str, src: str):
+    """Names that resolve to module globals but are never bound there."""
+    table = symtable.symtable(src, path, "exec")
+    scopes: list = []
+    _collect_scopes(table, scopes)
+    module_scope = scopes[0]
+    defined = {
+        s.get_name()
+        for s in module_scope.get_symbols()
+        if s.is_assigned() or s.is_imported()
+    }
+    findings = []
+    seen = set()
+    for scope in scopes:
+        for sym in scope.get_symbols():
+            name = sym.get_name()
+            if not sym.is_referenced() or name in BUILTINS or name in seen:
+                continue
+            if scope is module_scope:
+                is_free_global = sym.is_global() or (
+                    not sym.is_assigned() and not sym.is_imported()
+                    and not sym.is_parameter()
+                )
+            else:
+                is_free_global = sym.is_global()
+            if is_free_global and name not in defined:
+                seen.add(name)
+                findings.append((path, name))
+    return findings
+
+
+def unused_imports(path: str, tree: ast.AST, src: str):
+    """Module-level imports never referenced anywhere in the file."""
+    imported = {}  # name -> lineno
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # the base Name node covers it
+    # names referenced only inside string annotations (from __future__)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for tok in _ident_tokens(node.value):
+                used.add(tok)
+    return [
+        (path, name, lineno)
+        for name, lineno in imported.items()
+        if name not in used and not name.startswith("_")
+    ]
+
+
+def _ident_tokens(text: str):
+    tok = ""
+    for ch in text:
+        if ch.isidentifier() or (tok and ch.isalnum()):
+            tok += ch
+        else:
+            if tok:
+                yield tok
+            tok = ""
+    if tok:
+        yield tok
+
+
+def main(argv) -> int:
+    paths = argv[1:] or [
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), "dynamo_tpu")
+    ]
+    bad = 0
+    for path in module_files(paths):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, path)
+        except SyntaxError as e:
+            print(f"{path}: SYNTAX: {e}")
+            bad += 1
+            continue
+        for p, name in undefined_globals(path, src):
+            print(f"{p}: UNDEFINED: {name}")
+            bad += 1
+        if os.path.basename(path) != "__init__.py":
+            for p, name, lineno in unused_imports(path, tree, src):
+                print(f"{p}:{lineno}: UNUSED-IMPORT: {name}")
+                bad += 1
+    if bad:
+        print(f"{bad} finding(s)")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
