@@ -80,6 +80,10 @@ def parse_args():
     p.add_argument("--sp", type=int, default=1,
                    help="context-parallel ring attention width for chunk "
                    "prefill (sequence sharded over the sp mesh axis)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages for serving: layers + "
+                   "paged KV shard over a pp mesh axis, activations ride a "
+                   "shard_map wavefront (parallel/pp_serving.py)")
     p.add_argument("--migration-limit", type=int, default=0)
     p.add_argument("--kvbm-host-gb", type=float, default=0.0,
                    help="host DRAM KV tier size (G2); 0 disables kvbm")
@@ -148,6 +152,7 @@ def make_engine_config(args, mcfg, vcfg=None, logits_procs=()):
         max_context=ctx,
         tp=args.tp,
         sp=args.sp,
+        pp=getattr(args, "pp", 1),
         prefill_buckets=buckets,
         lora_max_adapters=args.lora_max_adapters,
         lora_rank=args.lora_rank,
@@ -323,9 +328,18 @@ async def main() -> None:
     from dynamo_tpu.parallel.mesh import make_mesh
 
     def rank_mesh(rank: int):
-        """Each dp_rank serves from its own (tp*sp)-sized device group when
-        the host has enough chips; otherwise ranks share (CPU smoke / 1 chip)."""
+        """Each dp_rank serves from its own device group when the host has
+        enough chips; otherwise ranks share (CPU smoke / 1 chip)."""
         devs = _jax.devices()
+        if args.pp > 1:
+            # one factory, same group-selection math as the tp*sp path
+            from dynamo_tpu.parallel.pp_serving import make_pp_mesh
+
+            group = args.pp * args.tp
+            lo = rank * group if len(devs) >= args.dp * group else 0
+            return make_pp_mesh(
+                pp=args.pp, tp=args.tp, devices=devs[lo : lo + group]
+            )
         group = args.tp * args.sp
         lo = rank * group
         if len(devs) >= args.dp * group:

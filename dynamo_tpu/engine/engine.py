@@ -79,6 +79,13 @@ class TpuEngineConfig:
     # context parallelism: chunk prefill attention rides ring_extend_attention
     # over the sp mesh axis (parallel/ring.py) — the long-context scale path
     sp: int = 1
+    # pipeline parallelism for SERVING (parallel/pp_serving.py): layer params
+    # + paged KV stacked and sharded over a pp mesh axis, shard_map wavefront
+    # forward. The reference forwards pipeline_parallel_size into its engines
+    # (components/src/dynamo/trtllm/engine.py:118); here it is a first-class
+    # engine dimension. pp>1 covers the core dense text path (no LoRA/
+    # vision/sp/MoE/pallas yet).
+    pp: int = 1
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
     seed: int = 0
     # Pallas ragged decode kernel (ops/pallas_attention): None = auto-enable
@@ -206,7 +213,23 @@ class TpuEngine:
                 raise ValueError("multihost serving does not cover vision yet")
             if kvbm is not None:
                 raise ValueError("multihost serving does not cover kvbm tiers yet")
-        self.mesh = mesh if mesh is not None else meshlib.make_mesh(tp=config.tp)
+        if config.pp > 1:
+            from ..parallel import pp_serving
+
+            if (config.lora_max_adapters or config.vision is not None
+                    or config.sp > 1 or kvbm is not None
+                    or config.logits_processors
+                    or registry.is_moe(self.mcfg)
+                    or config.use_pallas):
+                raise ValueError(
+                    "pp serving covers the core dense text path (no LoRA/"
+                    "vision/sp/kvbm/logits-processors/MoE/pallas yet)"
+                )
+            if mesh is None:
+                mesh = pp_serving.make_pp_mesh(pp=config.pp, tp=config.tp)
+            self.mesh = mesh
+        else:
+            self.mesh = mesh if mesh is not None else meshlib.make_mesh(tp=config.tp)
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         self.allocator = BlockAllocator(config.num_blocks, config.block_size)
@@ -221,15 +244,30 @@ class TpuEngine:
         self._offload_pending: List[Tuple[int, int, int]] = []
 
         # --- place params + caches on the mesh ---
-        self._forward = registry.forward_fn(self.mcfg, self.mesh)
+        self._forward = (
+            None if config.pp > 1 else registry.forward_fn(self.mcfg, self.mesh)
+        )
         self._lm_logits = registry.lm_logits_fn(self.mcfg)
         with self.mesh:
             if params is None:
                 params = registry.init_params(
                     jax.random.PRNGKey(config.seed), self.mcfg
                 )
-            self.params = self._shard_params(params)
-            self.k_caches, self.v_caches = self._init_caches()
+            if config.pp > 1:
+                from ..parallel import pp_serving
+
+                self.params = pp_serving.place_serving_params(self.mesh, params)
+                k, v = pp_serving.init_pp_caches(
+                    self.mesh, self.mcfg.num_layers, config.num_blocks,
+                    config.block_size, self.mcfg.num_kv_heads,
+                    self.mcfg.head_dim, self.mcfg.dtype,
+                )
+                # ONE stacked array per list: donation, multihost state
+                # wiring and the decode_multi scan carry are unchanged
+                self.k_caches, self.v_caches = [k], [v]
+            else:
+                self.params = self._shard_params(params)
+                self.k_caches, self.v_caches = self._init_caches()
 
         # --- slot state (decode batch is fixed-width) ---
         B = config.max_batch_size
@@ -341,6 +379,9 @@ class TpuEngine:
         if self._mh is not None:
             # the gather/scatter programs run outside the replay table
             raise ValueError("multihost serving does not cover KV transfer yet")
+        if self.cfg.pp > 1:
+            # transfer gathers iterate per-layer cache lists; pp stacks them
+            raise ValueError("pp serving does not cover KV transfer yet")
         from ..runtime.request_plane.tcp import TcpRequestServer
         from .transfer import KvTransferServer
 
@@ -404,7 +445,179 @@ class TpuEngine:
         v = [jax.device_put(zeros(), sharding) for _ in range(self.mcfg.num_layers)]
         return k, v
 
+    def _build_programs_pp(self) -> None:
+        """pp>1 programs: same signatures/state layout as _build_programs so
+        every call site (and the multihost replay table) is oblivious; the
+        forward is the shard_map wavefront from parallel/pp_serving.py.
+        LoRA/vision/logits-processor args are accepted and ignored (their
+        features are gated off at construction)."""
+        cfg, mcfg = self.cfg, self.mcfg
+        from ..parallel import pp_serving
+
+        logits_fn = self._lm_logits
+        pf_fwd = pp_serving.make_pp_prefill_forward(
+            self.mesh, mcfg, cfg.pp, cfg.tp
+        )
+        dc_fwd = pp_serving.make_pp_decode_forward(
+            self.mesh, mcfg, cfg.pp, cfg.tp
+        )
+        repl = NamedSharding(self.mesh, P())
+
+        def _fetchable(x):
+            return jax.lax.with_sharding_constraint(x, repl)
+
+        def pack_step(toks, lps, tlp_vals, tlp_ids):
+            return jnp.concatenate(
+                [
+                    toks.astype(jnp.float32)[:, None],
+                    lps[:, None],
+                    tlp_ids.astype(jnp.float32),
+                    tlp_vals,
+                ],
+                axis=-1,
+            )
+
+        def pen_need(pres, freqs, reps):
+            return jnp.any((pres != 0.0) | (freqs != 0.0) | (reps != 1.0))
+
+        def prefill(params, k_caches, v_caches, counts, tokens, positions,
+                    block_table, new_block_ids, total_len, chunk_start, seeds,
+                    steps, temp, top_k, top_p, min_p, pres, freq, rep,
+                    prompt_masks, slot, lp_need, is_final, lora_tables,
+                    lora_id, proc_masks, mm_embeds, mm_mask):
+            hidden, k2, v2 = pf_fwd(
+                params, k_caches[0], v_caches[0], tokens, positions,
+                block_table, new_block_ids, total_len,
+            )
+
+            def sample_branch(counts):
+                last_idx = jnp.argmax(positions == total_len - 1)
+                logits = logits_fn(params, mcfg, hidden[last_idx][None])
+                pen = apply_penalties(
+                    logits, jnp.zeros_like(logits, jnp.int32),
+                    prompt_masks[slot][None], pres, freq, rep,
+                )
+                tok = sample_tokens(pen, seeds, steps, temp, top_k, top_p, min_p)
+                counts = jax.lax.cond(
+                    pen_need(pres, freq, rep),
+                    lambda c: c.at[slot, tok[0]].add(1),
+                    lambda c: c,
+                    counts,
+                )
+                lp = logprobs_of(logits, tok)
+                tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
+                return counts, tok[0], lp[0], tlp_vals[0], tlp_ids[0]
+
+            def no_sample(counts):
+                K = TOP_LOGPROBS_K
+                return (
+                    counts, jnp.int32(0), jnp.float32(0.0),
+                    jnp.zeros((K,), jnp.float32), jnp.zeros((K,), jnp.int32),
+                )
+
+            counts, tok, lp, tlp_vals, tlp_ids = jax.lax.cond(
+                is_final, sample_branch, no_sample, counts
+            )
+            tok, lp, tlp_vals, tlp_ids = map(_fetchable, (tok, lp, tlp_vals, tlp_ids))
+            return [k2], [v2], counts, tok, lp, tlp_vals, tlp_ids
+
+        def decode(params, k_caches, v_caches, counts, tokens, positions,
+                   block_tables, seq_lens, write_blocks, write_offsets, seeds,
+                   steps, temps, top_ks, top_ps, min_ps, pres, freqs, reps,
+                   prompt_masks, lp_need, lora_tables, lora_ids, proc_masks):
+            hidden, k2, v2 = dc_fwd(
+                params, k_caches[0], v_caches[0], tokens, positions,
+                block_tables, seq_lens, write_blocks, write_offsets,
+            )
+            logits = logits_fn(params, mcfg, hidden)
+            pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
+            toks = sample_tokens(pen, seeds, steps, temps, top_ks, top_ps, min_ps)
+            counts = update_counts(
+                counts, toks, seq_lens > 0, pen_need(pres, freqs, reps)
+            )
+            lps = logprobs_of(logits, toks)
+            tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
+            toks, lps, tlp_vals, tlp_ids = map(
+                _fetchable, (toks, lps, tlp_vals, tlp_ids)
+            )
+            return [k2], [v2], counts, toks, lps, tlp_vals, tlp_ids
+
+        def decode_multi(params, k_caches, v_caches, counts, tokens, seq_lens,
+                         block_tables, active, seeds, steps0, temps, top_ks,
+                         top_ps, min_ps, pres, freqs, reps, prompt_masks,
+                         lp_need, lora_tables, lora_ids, proc_masks):
+            bs = cfg.block_size
+            need_pen = pen_need(pres, freqs, reps)
+
+            def one_step(carry, s):
+                k_caches, v_caches, counts, tokens, seq_lens = carry
+                positions = jnp.maximum(seq_lens - 1, 0)
+                write_blocks = jnp.where(
+                    active,
+                    jnp.take_along_axis(
+                        block_tables, (positions // bs)[:, None], axis=1
+                    )[:, 0],
+                    0,
+                )
+                write_offsets = jnp.where(active, positions % bs, 0)
+                hidden, k2, v2 = dc_fwd(
+                    params, k_caches[0], v_caches[0], tokens, positions,
+                    block_tables, seq_lens, write_blocks, write_offsets,
+                )
+                logits = logits_fn(params, mcfg, hidden)
+                pen = apply_penalties(
+                    logits, counts, prompt_masks, pres, freqs, reps
+                )
+                toks = sample_tokens(
+                    pen, seeds, steps0 + s, temps, top_ks, top_ps, min_ps
+                )
+                counts = update_counts(counts, toks, active, need_pen)
+                lps = logprobs_of(logits, toks)
+                tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
+                seq_lens = seq_lens + active.astype(jnp.int32)
+                return (
+                    ([k2], [v2], counts, toks, seq_lens),
+                    pack_step(toks, lps, tlp_vals, tlp_ids),
+                )
+
+            (k_caches, v_caches, counts, tokens, seq_lens), packed = (
+                jax.lax.scan(
+                    one_step,
+                    (k_caches, v_caches, counts, tokens, seq_lens),
+                    jnp.arange(cfg.decode_steps),
+                )
+            )
+            next_steps = steps0 + jnp.where(active, cfg.decode_steps, 0)
+            return (
+                k_caches, v_caches, counts, _fetchable(packed),
+                tokens, seq_lens, next_steps,
+            )
+
+        def reset_slot(prompt_masks, counts, slot, row):
+            return prompt_masks.at[slot].set(row), counts.at[slot].set(0)
+
+        em_fwd = pp_serving.make_pp_embed_forward(
+            self.mesh, mcfg, cfg.pp, cfg.tp
+        )
+
+        def embed(params, tokens, positions, last_idx):
+            """Pooled dense-causal forward through the pipeline: no KV pages
+            touched (embeddings never pollute the generation cache)."""
+            hidden = em_fwd(params, tokens, positions)
+            h = hidden[last_idx].astype(jnp.float32)
+            return _fetchable(h / jnp.maximum(jnp.linalg.norm(h), 1e-9))
+
+        self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2, 3))
+        self._decode_fn = jax.jit(decode, donate_argnums=(1, 2, 3))
+        self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2, 3))
+        self._reset_slot_fn = jax.jit(reset_slot, donate_argnums=(0, 1))
+        self._embed_fn = jax.jit(embed)
+        if self._mh is not None:
+            self._wire_multihost()
+
     def _build_programs(self) -> None:
+        if self.cfg.pp > 1:
+            return self._build_programs_pp()
         cfg, mcfg = self.cfg, self.mcfg
         fwd, logits_fn = self._forward, self._lm_logits
         lora_enabled = self.lora is not None

@@ -148,12 +148,26 @@ class ChatDeltaGenerator:
         finished = out.finish_reason is not None
         step_entries = list(out.logprob_entries or [])
         if self._forced is not None:
-            # immediate jail: accumulate silently; parse everything at finish.
-            # logprob entries ride along so the malformed-output content
-            # fallback still carries every token's logprob
-            self._forced_buf += out.text or ""
+            # immediate jail: reasoning still streams (it is never part of
+            # the call JSON — reasoning models wrap the payload in think/
+            # channel markup that would break the end-of-stream parse), the
+            # rest accumulates silently for the finish-time parse. logprob
+            # entries ride along so the malformed-output content fallback
+            # still carries every token's logprob
+            text = out.text or ""
+            reasoning = ""
+            if self.reasoning_parser is not None:
+                ev = self.reasoning_parser.feed(text)
+                if finished:
+                    fin = self.reasoning_parser.flush()
+                    ev.content += fin.content
+                    ev.reasoning += fin.reasoning
+                text, reasoning = ev.content, ev.reasoning
+            self._forced_buf += text
             self._pending_logprobs.extend(step_entries)
             step_entries = []
+            if reasoning:
+                chunks.append(self._chunk(ChatDelta(reasoning_content=reasoning)))
             if not finished:
                 return chunks
             tool_calls, content = self._parse_forced()

@@ -398,3 +398,22 @@ class TestForcedToolChoice:
         assert calls == []
         assert content == "not json at all"
         assert finishes == ["stop"]
+
+    def test_forced_with_reasoning_model(self):
+        """A reasoning model under tool_choice=required: think markup streams
+        as reasoning, the remaining JSON parses into the forced call."""
+        from dynamo_tpu.llm.protocols.delta import ChatDeltaGenerator
+        from dynamo_tpu.parsers import get_reasoning_parser
+
+        gen = ChatDeltaGenerator(
+            "r", "m", tool_choice="required",
+            reasoning_parser=get_reasoning_parser("think"),
+        )
+        calls, content, finishes = self._collect(
+            gen,
+            ["<think>let me plan</think>",
+             '[{"name": "go", "arguments": {"n": 1}}]'],
+        )
+        assert [c["function"]["name"] for c in calls] == ["go"]
+        assert content == ""
+        assert finishes == ["tool_calls"]

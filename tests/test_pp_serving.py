@@ -1,0 +1,145 @@
+"""Pipeline-parallel SERVING (parallel/pp_serving.py + engine cfg.pp).
+
+Round-3 verdict item #5: pp must serve tokens (the old parallel/pipeline.py
+only trained). Golden correctness: a pp=2 x tp=2 engine produces the SAME
+greedy tokens as the plain single-device engine from the same weights —
+stage-sharded prefill, paged decode, the decode_multi horizon scan, and the
+chained-carry path all included.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import registry
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.parallel.pp_serving import make_pp_mesh
+from dynamo_tpu.runtime import Context
+
+
+def _mcfg():
+    return LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=4, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+
+
+def _cfg(**kw):
+    defaults = dict(
+        num_blocks=64, block_size=4, max_batch_size=2, max_context=128,
+        prefill_buckets=(16, 32, 64, 128), decode_steps=4,
+    )
+    defaults.update(kw)
+    return TpuEngineConfig(model=_mcfg(), **defaults)
+
+
+def _req(rid, tokens, max_tokens=10):
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=tokens,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+async def _run(engine, req):
+    toks = []
+    async for out in engine.generate(req, Context()):
+        toks.extend(out.token_ids)
+    return toks
+
+
+def _params():
+    return registry.init_params(jax.random.PRNGKey(3), _mcfg())
+
+
+async def test_pp_matches_single_device():
+    params = _params()
+    prompt = list(range(30, 53))  # 23 tokens: odd length, partial block
+
+    ref_engine = TpuEngine(_cfg(), params=params)
+    try:
+        ref = await _run(ref_engine, _req("ref", prompt))
+    finally:
+        ref_engine.stop()
+    assert len(ref) == 10
+
+    pp_engine = TpuEngine(
+        _cfg(tp=2, pp=2),
+        params=params,
+        mesh=make_pp_mesh(pp=2, tp=2, devices=jax.devices()[:4]),
+    )
+    try:
+        got = await _run(pp_engine, _req("pp", prompt))
+    finally:
+        pp_engine.stop()
+    assert got == ref, f"pp tokens {got} != single-device {ref}"
+
+
+async def test_pp_concurrent_streams_and_prefix_reuse():
+    """Two interleaved streams on the pp engine: slot isolation + the prefix
+    cache work across the stacked cache layout."""
+    params = _params()
+    engine = TpuEngine(
+        _cfg(tp=1, pp=2), params=params,
+        mesh=make_pp_mesh(pp=2, tp=1, devices=jax.devices()[:2]),
+    )
+    try:
+        a, b = await asyncio.gather(
+            _run(engine, _req("a", list(range(40, 60)), max_tokens=6)),
+            _run(engine, _req("b", list(range(200, 212)), max_tokens=6)),
+        )
+        assert len(a) == 6 and len(b) == 6
+        # same prompt again: the cached prefix must yield identical output
+        a2 = await _run(engine, _req("a2", list(range(40, 60)), max_tokens=6))
+        assert a2 == a
+        snap = engine.snapshot()
+        assert snap["cached_blocks"] > 0
+    finally:
+        engine.stop()
+
+
+async def test_pp_embeddings():
+    params = _params()
+    engine = TpuEngine(
+        _cfg(tp=1, pp=2), params=params,
+        mesh=make_pp_mesh(pp=2, tp=1, devices=jax.devices()[:2]),
+    )
+    ref_engine = TpuEngine(_cfg(), params=params)
+    try:
+        req = PreprocessedRequest(
+            request_id="e", model="m", token_ids=list(range(10, 26)),
+            annotations={"op": "embed"},
+        )
+        outs = []
+        async for out in engine.generate(req, Context()):
+            outs.append(out)
+        vec = outs[-1].annotations["embedding"]
+        req2 = PreprocessedRequest(
+            request_id="e2", model="m", token_ids=list(range(10, 26)),
+            annotations={"op": "embed"},
+        )
+        outs2 = []
+        async for out in ref_engine.generate(req2, Context()):
+            outs2.append(out)
+        ref_vec = outs2[-1].annotations["embedding"]
+        assert len(vec) == 64
+        import numpy as np
+
+        np.testing.assert_allclose(vec, ref_vec, atol=2e-3)
+    finally:
+        engine.stop()
+        ref_engine.stop()
+
+
+def test_pp_gates_unsupported_features():
+    import pytest
+
+    with pytest.raises(ValueError, match="pp serving"):
+        TpuEngine(_cfg(pp=2, lora_max_adapters=2))
