@@ -332,11 +332,25 @@ async def main() -> None:
         enough chips; otherwise ranks share (CPU smoke / 1 chip)."""
         devs = _jax.devices()
         if args.pp > 1:
-            # one factory, same group-selection math as the tp*sp path
             from dynamo_tpu.parallel.pp_serving import make_pp_mesh
 
             group = args.pp * args.tp
-            lo = rank * group if len(devs) >= args.dp * group else 0
+            if len(devs) < group:
+                raise SystemExit(
+                    f"--pp {args.pp} --tp {args.tp} needs {group} devices; "
+                    f"{len(devs)} available (pp stages cannot share a chip)"
+                )
+            if len(devs) >= args.dp * group:
+                lo = rank * group
+            else:
+                lo = 0
+                if rank == 0 and args.dp > 1 and _jax.default_backend() != "cpu":
+                    print(
+                        f"WARNING: {len(devs)} device(s) < dp*pp*tp="
+                        f"{args.dp * group}; all {args.dp} ranks share the "
+                        f"same chips (HBM use scales with dp).",
+                        flush=True,
+                    )
             return make_pp_mesh(
                 pp=args.pp, tp=args.tp, devices=devs[lo : lo + group]
             )

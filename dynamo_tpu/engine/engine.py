@@ -450,7 +450,13 @@ class TpuEngine:
         every call site (and the multihost replay table) is oblivious; the
         forward is the shard_map wavefront from parallel/pp_serving.py.
         LoRA/vision/logits-processor args are accepted and ignored (their
-        features are gated off at construction)."""
+        features are gated off at construction).
+
+        NOTE: the sampling/penalty/logprob epilogues deliberately mirror
+        _build_programs rather than sharing a parameterized builder — the
+        non-pp path is the measured-and-tuned TPU hot path and stays
+        refactor-free; test_pp_serving pins the two token-identical. A
+        sampling change must land in BOTH builders."""
         cfg, mcfg = self.cfg, self.mcfg
         from ..parallel import pp_serving
 

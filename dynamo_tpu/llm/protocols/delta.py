@@ -81,18 +81,22 @@ class ChatDeltaGenerator:
             ],
         )
 
+    def _split_reasoning(self, text: str, flush: bool):
+        """(content, reasoning) via the model card's reasoning parser."""
+        if self.reasoning_parser is None:
+            return text, ""
+        ev = self.reasoning_parser.feed(text)
+        if flush:
+            fin = self.reasoning_parser.flush()
+            ev.content += fin.content
+            ev.reasoning += fin.reasoning
+        return ev.content, ev.reasoning
+
     def _parse(self, text: str, flush: bool = False):
         """Pipe raw text through the reasoning then tool parsers; returns
         (content, reasoning, tool_calls). Tool markers never appear inside
         reasoning spans, so reasoning splits first."""
-        reasoning = ""
-        if self.reasoning_parser is not None:
-            ev = self.reasoning_parser.feed(text)
-            if flush:
-                fin = self.reasoning_parser.flush()
-                ev.content += fin.content
-                ev.reasoning += fin.reasoning
-            text, reasoning = ev.content, ev.reasoning
+        text, reasoning = self._split_reasoning(text, flush)
         tool_calls = []
         if self.tool_parser is not None:
             tev = self.tool_parser.feed(text)
@@ -154,15 +158,7 @@ class ChatDeltaGenerator:
             # rest accumulates silently for the finish-time parse. logprob
             # entries ride along so the malformed-output content fallback
             # still carries every token's logprob
-            text = out.text or ""
-            reasoning = ""
-            if self.reasoning_parser is not None:
-                ev = self.reasoning_parser.feed(text)
-                if finished:
-                    fin = self.reasoning_parser.flush()
-                    ev.content += fin.content
-                    ev.reasoning += fin.reasoning
-                text, reasoning = ev.content, ev.reasoning
+            text, reasoning = self._split_reasoning(out.text or "", finished)
             self._forced_buf += text
             self._pending_logprobs.extend(step_entries)
             step_entries = []

@@ -20,8 +20,8 @@ ENV_LOG = "DTPU_LOG"                                  # log level (debug/info/wa
 ENV_LOG_JSONL = "DTPU_LOGGING_JSONL"                  # structured JSONL logging on/off
 ENV_REQUEST_PLANE = "DTPU_REQUEST_PLANE"              # tcp | http | inproc
 ENV_EVENT_PLANE = "DTPU_EVENT_PLANE"                  # zmq | inproc
-ENV_STORE = "DTPU_STORE"                              # mem | file | etcd
-ENV_STORE_PATH = "DTPU_STORE_PATH"                    # path for the file store
+ENV_STORE = "DTPU_STORE"                              # mem | file | tcp | etcd
+ENV_STORE_PATH = "DTPU_STORE_PATH"                    # file path / tcp host:port / etcd endpoint
 ENV_SYSTEM_PORT = "DTPU_SYSTEM_PORT"                  # system status server port
 ENV_SYSTEM_HOST = "DTPU_SYSTEM_HOST"
 ENV_HOST_IP = "DTPU_HOST_IP"                          # advertised host for request plane

@@ -396,4 +396,12 @@ def make_store(kind: str, path: str = "/tmp/dtpu_store") -> KVStore:
         from .netstore import TcpKVStore
 
         return TcpKVStore(path)
-    raise ValueError(f"unknown store kind: {kind!r} (expected mem|file|tcp)")
+    if kind == "etcd":
+        # a real etcd cluster via its v3 JSON gateway; path is the client
+        # endpoint, e.g. http://etcd:2379 (discovery/etcd.py)
+        from .etcd import EtcdKVStore
+
+        return EtcdKVStore(path)
+    raise ValueError(
+        f"unknown store kind: {kind!r} (expected mem|file|tcp|etcd)"
+    )

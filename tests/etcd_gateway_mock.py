@@ -1,0 +1,200 @@
+"""In-process mock of the etcd v3 JSON gateway (test double).
+
+Implements exactly the wire surface EtcdKVStore speaks — /v3/kv/{put,range,
+deleterange}, /v3/lease/{grant,keepalive,revoke}, /v3/watch (newline-
+delimited JSON stream) — with real etcd semantics: revisions, lease TTL
+expiry deleting attached keys, prefix range_end queries, watch
+start_revision. The image cannot ship the etcd binary; against a real
+cluster the client code path is identical.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+class MockEtcdGateway:
+    def __init__(self):
+        self.kv: Dict[bytes, Tuple[bytes, Optional[int]]] = {}  # key -> (val, lease)
+        self.leases: Dict[int, Tuple[float, float]] = {}  # id -> (deadline, ttl)
+        self.revision = 1
+        self._lease_ctr = 1000
+        self._watchers: List[Tuple[bytes, bytes, asyncio.Queue]] = []
+        # (revision, type, key, value): replayed for start_revision watches
+        self.history: List[Tuple[int, str, bytes, bytes]] = []
+        self._runner = None
+        self.port = 0
+
+    # ------------------------------------------------------------- helpers
+    def _expire_leases(self) -> None:
+        now = time.monotonic()
+        dead = [lid for lid, (dl, _ttl) in self.leases.items() if dl < now]
+        for lid in dead:
+            del self.leases[lid]
+            for key in [k for k, (_v, kl) in self.kv.items() if kl == lid]:
+                self._delete(key)
+
+    def _event(self, ev_type: str, key: bytes, value: bytes, rev: int) -> dict:
+        return {
+            "type": ev_type,
+            "kv": {"key": _b64(key), "value": _b64(value),
+                   "mod_revision": str(rev)},
+        }
+
+    def _notify(self, ev_type: str, key: bytes, value: bytes) -> None:
+        self.history.append((self.revision, ev_type, key, value))
+        for lo, hi, q in self._watchers:
+            if lo <= key and (not hi or key < hi):
+                q.put_nowait(self._event(ev_type, key, value, self.revision))
+
+    def _delete(self, key: bytes) -> None:
+        if key in self.kv:
+            del self.kv[key]
+            self.revision += 1
+            self._notify("DELETE", key, b"")
+
+    def _in_range(self, key: bytes, lo: bytes, hi: bytes) -> bool:
+        return lo <= key and (not hi or key < hi)
+
+    # ------------------------------------------------------------ handlers
+    async def kv_put(self, request: web.Request) -> web.Response:
+        self._expire_leases()
+        body = await request.json()
+        key = _unb64(body["key"])
+        value = _unb64(body.get("value", ""))
+        lease = int(body["lease"]) if body.get("lease") else None
+        if lease is not None and lease not in self.leases:
+            return web.json_response(
+                {"error": "etcdserver: requested lease not found", "code": 5},
+                status=400,
+            )
+        self.kv[key] = (value, lease)
+        self.revision += 1
+        self._notify("PUT", key, value)
+        return web.json_response({"header": {"revision": str(self.revision)}})
+
+    async def kv_range(self, request: web.Request) -> web.Response:
+        self._expire_leases()
+        body = await request.json()
+        lo = _unb64(body["key"])
+        hi = _unb64(body["range_end"]) if body.get("range_end") else b""
+        kvs = []
+        for k in sorted(self.kv):
+            v, _lease = self.kv[k]
+            if (k == lo and not hi) or (hi and self._in_range(k, lo, hi)):
+                kvs.append({"key": _b64(k), "value": _b64(v)})
+        return web.json_response({
+            "header": {"revision": str(self.revision)}, "kvs": kvs,
+            "count": str(len(kvs)),
+        })
+
+    async def kv_deleterange(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        lo = _unb64(body["key"])
+        hi = _unb64(body["range_end"]) if body.get("range_end") else b""
+        victims = [
+            k for k in list(self.kv)
+            if (k == lo and not hi) or (hi and self._in_range(k, lo, hi))
+        ]
+        for k in victims:
+            self._delete(k)
+        return web.json_response({
+            "header": {"revision": str(self.revision)},
+            "deleted": str(len(victims)),
+        })
+
+    async def lease_grant(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        ttl = int(body.get("TTL", 10))
+        self._lease_ctr += 1
+        lid = self._lease_ctr
+        self.leases[lid] = (time.monotonic() + ttl, ttl)
+        return web.json_response({"ID": str(lid), "TTL": str(ttl)})
+
+    async def lease_keepalive(self, request: web.Request) -> web.Response:
+        self._expire_leases()
+        body = await request.json()
+        lid = int(body["ID"])
+        if lid not in self.leases:
+            return web.json_response(
+                {"result": {"ID": str(lid), "TTL": "0"}}
+            )
+        _dl, ttl = self.leases[lid]
+        self.leases[lid] = (time.monotonic() + ttl, ttl)
+        return web.json_response({"result": {"ID": str(lid), "TTL": str(int(ttl))}})
+
+    async def lease_revoke(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        lid = int(body["ID"])
+        self.leases.pop(lid, None)
+        for key in [k for k, (_v, kl) in self.kv.items() if kl == lid]:
+            self._delete(key)
+        return web.json_response({"header": {"revision": str(self.revision)}})
+
+    async def watch(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        cr = body["create_request"]
+        lo = _unb64(cr["key"])
+        hi = _unb64(cr["range_end"]) if cr.get("range_end") else b""
+        q: asyncio.Queue = asyncio.Queue()
+        # replay history from start_revision BEFORE going live, so no event
+        # between a snapshot and the stream attach is lost (etcd semantics)
+        start_rev = int(cr.get("start_revision", 0) or 0)
+        if start_rev:
+            for rev, ev_type, key, value in self.history:
+                if rev >= start_rev and self._in_range(key, lo, hi or b"\xff" * 64):
+                    q.put_nowait(self._event(ev_type, key, value, rev))
+        self._watchers.append((lo, hi, q))
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        await resp.write(
+            (json.dumps({"result": {"created": True, "events": []}}) + "\n").encode()
+        )
+        try:
+            while True:
+                ev = await q.get()
+                line = json.dumps({"result": {"events": [ev]}}) + "\n"
+                await resp.write(line.encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._watchers.remove((lo, hi, q))
+        return resp
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_post("/v3/kv/put", self.kv_put)
+        app.router.add_post("/v3/kv/range", self.kv_range)
+        app.router.add_post("/v3/kv/deleterange", self.kv_deleterange)
+        app.router.add_post("/v3/lease/grant", self.lease_grant)
+        app.router.add_post("/v3/lease/keepalive", self.lease_keepalive)
+        app.router.add_post("/v3/lease/revoke", self.lease_revoke)
+        app.router.add_post("/v3/watch", self.watch)
+        # shutdown_timeout: open watch streams are infinite handlers;
+        # cleanup() must cancel them, not wait out the 60s default
+        self._runner = web.AppRunner(app, access_log=None, shutdown_timeout=0.5)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
