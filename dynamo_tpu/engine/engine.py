@@ -926,6 +926,35 @@ class TpuEngine:
             h = hidden[last_idx].astype(jnp.float32)
             return _fetchable(h / jnp.maximum(jnp.linalg.norm(h), 1e-9))
 
+        def embed_chunk(params, k_caches, v_caches, tokens, positions,
+                        block_table, new_block_ids, total_len, last_idx,
+                        is_final):
+            """Chunked pooled forward: inputs past the largest prefill
+            bucket run like chunked prefill — each chunk writes its KV into
+            TEMPORARY pages (allocated, never committed, released after) and
+            attends over the gathered prefix — but no token is sampled; the
+            final chunk returns the normalized last-token hidden state."""
+
+            def attend(q, k_new, v_new, layer_idx):
+                kc, vc = att.write_prefill_kv(
+                    k_caches[layer_idx], v_caches[layer_idx],
+                    k_new, v_new, new_block_ids,
+                )
+                k_caches[layer_idx], v_caches[layer_idx] = kc, vc
+                k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
+                return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
+
+            hidden = fwd(params, mcfg, tokens, positions, attend)
+            vec = jax.lax.cond(
+                is_final,
+                lambda: (
+                    lambda h: h / jnp.maximum(jnp.linalg.norm(h), 1e-9)
+                )(hidden[last_idx].astype(jnp.float32)),
+                lambda: jnp.zeros((mcfg.hidden_size,), jnp.float32),
+            )
+            return k_caches, v_caches, _fetchable(vec)
+
+        self._embed_chunk_fn = jax.jit(embed_chunk, donate_argnums=(1, 2))
         self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2, 3))
         self._decode_fn = jax.jit(decode, donate_argnums=(1, 2, 3))
         self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2, 3))
@@ -1004,6 +1033,12 @@ class TpuEngine:
             state_out={0: "pmasks", 1: "counts"},
         )
         ops.register("embed", self._embed_fn, state_in={0: "params"}, state_out={})
+        if getattr(self, "_embed_chunk_fn", None) is not None:
+            ops.register(
+                "embed_chunk", self._embed_chunk_fn,
+                state_in={0: "params", 1: "k", 2: "v"},
+                state_out={0: "k", 1: "v"},
+            )
         self._mh_ops = ops
         if self._mh.is_leader:
             self._prefill_fn = ops.leader_fn("prefill")
@@ -1011,6 +1046,8 @@ class TpuEngine:
             self._decode_multi_fn = ops.leader_fn("decode_multi")
             self._reset_slot_fn = ops.leader_fn("reset_slot")
             self._embed_fn = ops.leader_fn("embed")
+            if getattr(self, "_embed_chunk_fn", None) is not None:
+                self._embed_chunk_fn = ops.leader_fn("embed_chunk")
 
     def follow(self) -> None:
         """Follower process body: replay leader dispatches until stop/EOF.
@@ -1039,12 +1076,13 @@ class TpuEngine:
         if (
             req.annotations.get("op") == "embed"
             and len(req.token_ids) > self.cfg.prefill_chunk
+            and self.cfg.pp > 1
         ):
-            # the pooled forward is a single dense-attention dispatch; it is
-            # bounded by the largest bucket, unlike chunked generation prefill
+            # the pp pooled forward is a single dense dispatch (no paged
+            # chunk variant yet); non-pp chunks below
             raise ValueError(
                 f"embedding input {len(req.token_ids)} tokens exceeds the "
-                f"largest prefill bucket {self.cfg.prefill_chunk}"
+                f"largest prefill bucket {self.cfg.prefill_chunk} (pp engine)"
             )
         if n_prompt // self.cfg.block_size + 2 > self.cfg.num_blocks:
             # would wait forever in admission — no amount of eviction frees
@@ -1067,9 +1105,27 @@ class TpuEngine:
                 raise ValueError(f"unknown LoRA adapter {lora_name!r}")
         if req.annotations.get("op") == "embed":
             loop = asyncio.get_event_loop()
-            vec = await loop.run_in_executor(
-                self._executor, self._run_embed, list(req.token_ids)
-            )
+            block_ids: Optional[List[int]] = None
+            S = len(req.token_ids)
+            if S > self.cfg.prefill_chunk:
+                # long input: temporary pages for the chunked pooled forward
+                # (allocated here on the loop thread — the allocator is
+                # single-threaded; never committed, released below)
+                need = (S + self.cfg.block_size - 1) // self.cfg.block_size
+                if not self.allocator.can_allocate(need):
+                    raise ValueError(
+                        f"no KV capacity for a {S}-token embedding "
+                        f"({need} blocks needed); retry later"
+                    )
+                block_ids = self.allocator.allocate(need)
+            try:
+                vec = await loop.run_in_executor(
+                    self._executor, self._run_embed, list(req.token_ids),
+                    block_ids,
+                )
+            finally:
+                if block_ids is not None:
+                    self.allocator.release(block_ids)
             yield BackendOutput(
                 finish_reason=FINISH_STOP,
                 annotations={
@@ -1555,30 +1611,39 @@ class TpuEngine:
         st.commit_upto = max(st.commit_upto, upto)
 
     # -- device calls (run in executor thread) -------------------------------
+    def _chunk_arrays(self, token_ids, start: int, chunk_len: int, block_ids):
+        """One prefill chunk's padded host arrays (shared by generation
+        prefill and chunked embeddings — the padding conventions MUST match:
+        pad positions pin to max_context-1, pad rows write scratch block 0).
+
+        Returns (tokens [S_pad], positions [S_pad], new_block_ids
+        [S_pad//bs])."""
+        bs = self.cfg.block_size
+        S_pad = self._bucket(chunk_len)
+        tokens = np.zeros(S_pad, np.int32)
+        tokens[:chunk_len] = token_ids[start : start + chunk_len]
+        positions = np.full(S_pad, self.cfg.max_context - 1, np.int32)
+        positions[:chunk_len] = np.arange(start, start + chunk_len)
+        new_block_ids = np.zeros(S_pad // bs, np.int32)
+        real = block_ids[start // bs :][: S_pad // bs]
+        new_block_ids[: len(real)] = real
+        return tokens, positions, new_block_ids
+
     def _run_prefill_chunk(self, st: _Seq):
         """Prefill ONE bounded chunk of st's prompt (reference chunked
         prefill, protocols.rs:112): writes the chunk's KV pages; the final
         chunk also samples the first token. Returns None for intermediate
         chunks, else the (st, tok, lp, tlp...) acceptance tuple."""
-        bs = self.cfg.block_size
         prompt = st.seq.tokens()
         start = st.prefill_pos
         remaining = len(prompt) - start
         cap = self.cfg.prefill_chunk
         is_final = remaining <= cap
         chunk_len = remaining if is_final else cap
-        suffix = prompt[start : start + chunk_len]
-        S_pad = self._bucket(chunk_len)
-        n_new_blocks = S_pad // bs
-
-        tokens = np.zeros(S_pad, np.int32)
-        tokens[:chunk_len] = suffix
-        positions = np.full(S_pad, self.cfg.max_context - 1, np.int32)
-        positions[:chunk_len] = np.arange(start, start + chunk_len)
-        # destinations: real blocks for this chunk's span, scratch elsewhere
-        new_block_ids = np.zeros(n_new_blocks, np.int32)
-        real_new = st.block_ids[start // bs :][: n_new_blocks]
-        new_block_ids[: len(real_new)] = real_new
+        tokens, positions, new_block_ids = self._chunk_arrays(
+            prompt, start, chunk_len, st.block_ids
+        )
+        S_pad = len(tokens)  # the bucketed width (_mm_chunk needs it)
 
         s = st.req.sampling
         total_len = start + chunk_len
@@ -1697,16 +1762,41 @@ class TpuEngine:
             embeds[a:b] = feats
         return embeds, mask
 
-    def _run_embed(self, token_ids: List[int]) -> np.ndarray:
+    def _run_embed(self, token_ids: List[int],
+                   block_ids: Optional[List[int]] = None) -> np.ndarray:
         S = len(token_ids)
-        S_pad = self._bucket(S)
-        tokens = np.zeros(S_pad, np.int32)
-        tokens[:S] = token_ids
-        positions = np.arange(S_pad, dtype=np.int32)
-        vec = self._embed_fn(
-            self.params, self._j(tokens), self._j(positions),
-            self._j(np.int32(S - 1)),
-        )
+        if block_ids is None:
+            # fits one dispatch: dense causal forward, no pages touched
+            S_pad = self._bucket(S)
+            tokens = np.zeros(S_pad, np.int32)
+            tokens[:S] = token_ids
+            positions = np.arange(S_pad, dtype=np.int32)
+            vec = self._embed_fn(
+                self.params, self._j(tokens), self._j(positions),
+                self._j(np.int32(S - 1)),
+            )
+            return np.asarray(vec)
+        # chunked: the caller pre-allocated temporary pages (loop thread
+        # owns the allocator); each chunk writes KV + attends over the
+        # gathered prefix, the final chunk yields the pooled vector
+        cap = self.cfg.prefill_chunk
+        table = np.zeros(self.cfg.max_blocks_per_seq, np.int32)
+        table[: len(block_ids)] = block_ids
+        vec = None
+        _j = self._j
+        for start in range(0, S, cap):
+            chunk_len = min(cap, S - start)
+            is_final = start + chunk_len >= S
+            tokens, positions, nbi = self._chunk_arrays(
+                token_ids, start, chunk_len, block_ids
+            )
+            (self.k_caches, self.v_caches, vec) = self._embed_chunk_fn(
+                self.params, self.k_caches, self.v_caches,
+                _j(tokens), _j(positions), _j(table), _j(nbi),
+                _j(np.int32(start + chunk_len)),
+                _j(np.int32(chunk_len - 1)),
+                _j(np.bool_(is_final)),
+            )
         return np.asarray(vec)
 
     def _prepare_horizon(self, depth: int = 1) -> bool:

@@ -21,8 +21,23 @@ import grpc
 from ...runtime.engine import Context
 from ...runtime.logging import get_logger
 from ..discovery import ModelManager
-from ..protocols.openai import CompletionRequest
+from ..protocols.openai import CompletionRequest, new_request_id
+from ..protocols.tensor import DTYPES, Tensor, TensorRequest, TensorResponse
 from . import kserve_pb2 as pb
+
+import numpy as np
+
+# InferTensorContents field per KServe datatype (BYTES handled separately)
+_CONTENTS_FIELD = {
+    "BOOL": "bool_contents",
+    "INT8": "int_contents", "INT16": "int_contents", "INT32": "int_contents",
+    "INT64": "int64_contents",
+    "UINT8": "uint_contents", "UINT16": "uint_contents",
+    "UINT32": "uint_contents", "UINT64": "uint64_contents",
+    # FP16 has NO typed contents field in the KServe v2 spec: conformant
+    # clients must ship it via raw_input_contents
+    "FP32": "fp32_contents", "FP64": "fp64_contents",
+}
 
 log = get_logger("llm.grpc")
 
@@ -72,21 +87,63 @@ class KserveGrpcService:
         out.shape.append(1)
         return resp
 
-    def _to_preq(self, request: pb.ModelInferRequest):
-        pipe = self.manager.get(request.model_name)
+    @staticmethod
+    def _is_tensor_model(pipe) -> bool:
+        return "tensor" in (pipe.card.model_type or [])
+
+    def _to_preq(self, request: pb.ModelInferRequest, pipe=None):
+        if pipe is None:
+            pipe = self.manager.get(request.model_name)
         if pipe is None:
             return None, None
         text = ""
+        input_ids = None
         max_tokens = _param(request.parameters, "max_tokens")
         temperature = _param(request.parameters, "temperature")
         ignore_eos = _param(request.parameters, "ignore_eos")
         for t in request.inputs:
             if t.name == "text_input" and t.contents.bytes_contents:
                 text = t.contents.bytes_contents[0].decode("utf-8", "replace")
+            elif t.name == "input_ids" and t.contents.int64_contents:
+                # pre-tokenized path: token ids skip the tokenizer entirely
+                input_ids = [int(v) for v in t.contents.int64_contents]
             elif t.name == "max_tokens" and t.contents.int_contents:
                 max_tokens = int(t.contents.int_contents[0])
             elif t.name == "temperature" and t.contents.fp32_contents:
                 temperature = float(t.contents.fp32_contents[0])
+        if input_ids is not None:
+            from ..protocols.common import (
+                PreprocessedRequest,
+                SamplingOptions,
+                StopConditions,
+            )
+
+            # the text path gets these from the preprocessor; the
+            # pre-tokenized path must enforce them itself so over-long
+            # inputs fail with INVALID_ARGUMENT, not a remote engine error
+            budget = pipe.card.context_length - len(input_ids)
+            if budget <= 0:
+                raise ValueError(
+                    f"input_ids length {len(input_ids)} exceeds model "
+                    f"context {pipe.card.context_length}"
+                )
+            preq = PreprocessedRequest(
+                request_id=request.id or new_request_id(),
+                model=request.model_name,
+                token_ids=input_ids,
+                stop=StopConditions(
+                    max_tokens=(
+                        min(int(max_tokens), budget) if max_tokens else budget
+                    ),
+                    ignore_eos=bool(ignore_eos) if ignore_eos is not None else False,
+                ),
+                sampling=SamplingOptions(
+                    temperature=(
+                        float(temperature) if temperature is not None else 1.0
+                    ),
+                ),
+            )
+            return pipe, preq
         oai = CompletionRequest(
             model=request.model_name,
             prompt=text,
@@ -98,6 +155,88 @@ class KserveGrpcService:
         if request.id:
             preq.request_id = request.id
         return pipe, preq
+
+    # -- generic tensor models (llm/protocols/tensor.py) ---------------------
+    @staticmethod
+    def _pb_to_tensor_request(request: pb.ModelInferRequest) -> TensorRequest:
+        tensors = []
+        raw = list(request.raw_input_contents)
+        if raw and len(raw) != len(request.inputs):
+            raise ValueError(
+                f"raw_input_contents has {len(raw)} entries for "
+                f"{len(request.inputs)} inputs"
+            )
+        for i, t in enumerate(request.inputs):
+            shape = [int(s) for s in t.shape]
+            if raw:
+                if t.datatype != "BYTES":
+                    dt = DTYPES.get(t.datatype)
+                    if dt is None:
+                        raise ValueError(f"unsupported datatype {t.datatype!r}")
+                    want = int(np.prod(shape)) * np.dtype(dt).itemsize
+                    if len(raw[i]) != want:
+                        raise ValueError(
+                            f"tensor {t.name!r}: raw payload {len(raw[i])}B "
+                            f"!= shape/dtype size {want}B"
+                        )
+                tensors.append(Tensor(t.name, t.datatype, shape, raw[i]))
+            elif t.datatype == "BYTES":
+                tensors.append(Tensor.from_bytes_list(
+                    t.name, list(t.contents.bytes_contents), shape
+                ))
+            else:
+                field = _CONTENTS_FIELD.get(t.datatype)
+                if field is None:
+                    raise ValueError(f"unsupported datatype {t.datatype!r}")
+                vals = getattr(t.contents, field)
+                arr = np.asarray(list(vals), DTYPES[t.datatype]).reshape(shape)
+                tensors.append(Tensor.from_numpy(t.name, arr))
+        params = {}
+        for name in request.parameters:
+            params[name] = _param(request.parameters, name)
+        return TensorRequest(
+            request_id=request.id or new_request_id(),
+            model=request.model_name, tensors=tensors, parameters=params,
+        )
+
+    @staticmethod
+    def _tensor_to_pb(
+        request: pb.ModelInferRequest, tresp: TensorResponse, set_raw: bool
+    ) -> pb.ModelInferResponse:
+        resp = pb.ModelInferResponse(
+            model_name=request.model_name, model_version="1", id=request.id
+        )
+        for t in tresp.tensors:
+            out = resp.outputs.add()
+            out.name, out.datatype = t.name, t.datatype
+            out.shape.extend(t.shape)
+            if set_raw:
+                resp.raw_output_contents.append(t.data)
+            elif t.datatype == "BYTES":
+                out.contents.bytes_contents.extend(t.to_bytes_list())
+            else:
+                field = _CONTENTS_FIELD[t.datatype]
+                getattr(out.contents, field).extend(
+                    t.to_numpy().reshape(-1).tolist()
+                )
+        return resp
+
+    async def _tensor_infer(
+        self, pipe, request: pb.ModelInferRequest
+    ) -> pb.ModelInferResponse:
+        treq = self._pb_to_tensor_request(request)
+        ctx = Context(treq.request_id)
+        tresp = TensorResponse()
+        try:
+            async for item in await pipe.client.generate(treq.to_obj(), ctx):
+                tresp = TensorResponse.from_obj(item)
+        finally:
+            ctx.stop_generating()
+        if tresp.error:
+            raise ValueError(tresp.error)
+        return self._tensor_to_pb(
+            request, tresp, set_raw=bool(request.raw_input_contents)
+        )
 
     @staticmethod
     def _text_response(request, text: str, finish: Optional[str]) -> pb.ModelInferResponse:
@@ -113,8 +252,16 @@ class KserveGrpcService:
         return resp
 
     async def ModelInfer(self, request, context) -> pb.ModelInferResponse:
+        pipe0 = self.manager.get(request.model_name)
+        if pipe0 is not None and self._is_tensor_model(pipe0):
+            # generic tensor model: tensors in, tensors out, no tokenizer
+            # (reference grpc/service/tensor.rs)
+            try:
+                return await self._tensor_infer(pipe0, request)
+            except (ValueError, KeyError) as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         try:
-            pipe, preq = self._to_preq(request)
+            pipe, preq = self._to_preq(request, pipe0)
         except ValueError as e:  # over-long prompt / bad params -> clean status
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         if pipe is None:
@@ -137,8 +284,16 @@ class KserveGrpcService:
     async def ModelStreamInfer(
         self, request, context
     ) -> AsyncIterator[pb.ModelStreamInferResponse]:
+        pipe0 = self.manager.get(request.model_name)
+        if pipe0 is not None and self._is_tensor_model(pipe0):
+            try:
+                reply = await self._tensor_infer(pipe0, request)
+                yield pb.ModelStreamInferResponse(infer_response=reply)
+            except (ValueError, KeyError) as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+            return
         try:
-            pipe, preq = self._to_preq(request)
+            pipe, preq = self._to_preq(request, pipe0)
         except ValueError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         if pipe is None:

@@ -84,6 +84,43 @@ def _sse_error_event(message: str, err_type: str) -> bytes:
     return f"data: {payload}\n\n".encode()
 
 
+def _openapi_spec() -> dict:
+    """OpenAPI 3.1 description of the serving surface (reference:
+    lib/llm/src/http/service/openapi_docs.rs). Request/response bodies are
+    the OpenAI-compatible schemas; kept summary-level here — the wire types
+    live in llm/protocols/openai.py (pydantic) and can regenerate full
+    schemas on demand."""
+
+    def op(summary, streaming=False, tag="openai"):
+        out = {
+            "summary": summary, "tags": [tag],
+            "responses": {"200": {"description": "success"}},
+        }
+        if streaming:
+            out["description"] = (
+                "Set stream=true for text/event-stream SSE chunks."
+            )
+        return out
+
+    return {
+        "openapi": "3.1.0",
+        "info": {"title": "dynamo-tpu OpenAI-compatible frontend",
+                 "version": "1.0"},
+        "paths": {
+            "/v1/chat/completions": {"post": op("Chat completion", True)},
+            "/v1/completions": {"post": op("Text completion", True)},
+            "/v1/embeddings": {"post": op("Embeddings")},
+            "/v1/responses": {"post": op("Responses API", True)},
+            "/v1/images/generations": {"post": op("Image generation")},
+            "/v1/models": {"get": op("List served models")},
+            "/health": {"get": op("Service + model health", tag="system")},
+            "/live": {"get": op("Liveness", tag="system")},
+            "/metrics": {"get": op("Prometheus metrics", tag="system")},
+            "/openapi.json": {"get": op("This document", tag="system")},
+        },
+    }
+
+
 class HttpService:
     def __init__(
         self,
@@ -137,10 +174,13 @@ class HttpService:
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/responses", self.responses)
+        app.router.add_post("/v1/images/generations", self.images)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/live", self.live)
         app.router.add_get("/metrics", self.metrics_handler)
+        app.router.add_get("/openapi.json", self.openapi)
+        app.router.add_get("/docs", self.docs)
         return app
 
     async def start(self) -> str:
@@ -174,6 +214,78 @@ class HttpService:
             data=[ModelInfo(id=m, created=int(time.time())) for m in self.manager.list_models()]
         )
         return web.json_response(data.model_dump())
+
+    async def openapi(self, request: web.Request) -> web.Response:
+        """Machine-readable API description (reference
+        http/service/openapi_docs.rs serves the same via utoipa)."""
+        return web.json_response(_openapi_spec())
+
+    async def docs(self, request: web.Request) -> web.Response:
+        """Minimal human-readable endpoint index (the swagger-ui analog
+        without vendored JS: zero-egress images cannot fetch the bundle)."""
+        spec = _openapi_spec()
+        rows = "".join(
+            f"<li><code>{method.upper()} {path}</code> — "
+            f"{op.get('summary', '')}</li>"
+            for path, ops in spec["paths"].items()
+            for method, op in ops.items()
+        )
+        return web.Response(
+            text=(
+                f"<html><body><h1>{spec['info']['title']}</h1>"
+                f"<p>spec: <a href='/openapi.json'>/openapi.json</a></p>"
+                f"<ul>{rows}</ul></body></html>"
+            ),
+            content_type="text/html",
+        )
+
+    async def images(self, request: web.Request) -> web.Response:
+        """/v1/images/generations (reference http/service/openai.rs:1638):
+        routes the prompt to a model registered with model_type 'images';
+        the worker returns base64 image payloads in annotations."""
+        busy = self._check_capacity()
+        if busy is not None:
+            return busy
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        try:
+            n = int(body.get("n", 1))
+            prompt = str(body.get("prompt", ""))
+            size = str(body.get("size", "1024x1024"))
+            if n < 1 or n > 16:
+                raise ValueError("n must be in [1, 16]")
+        except (TypeError, ValueError) as e:
+            return _error(400, f"invalid request: {e}")
+        model = body.get("model")
+        pipe = self.manager.get(model) if model else None
+        if pipe is None or "images" not in (pipe.card.model_type or []):
+            return _error(
+                404, f"no image-generation model named {model!r}", "not_found"
+            )
+        preq = PreprocessedRequest(
+            request_id=new_request_id("img"), model=model,
+            token_ids=[], annotations={
+                "op": "image", "prompt": prompt, "n": n, "size": size,
+            },
+        )
+        ctx = Context(preq.request_id)
+        self.inflight += 1
+        self._inflight_g.set(self.inflight)
+        data = []
+        try:
+            async for out in pipe.generate_tokens(preq, ctx):
+                for img in (out.annotations or {}).get("images", []):
+                    data.append({"b64_json": img})
+        except NoResponders:
+            return await self._fail(None, 503, "no workers available",
+                                    "service_unavailable")
+        finally:
+            ctx.stop_generating()
+            self.inflight -= 1
+            self._inflight_g.set(self.inflight)
+        return web.json_response({"created": int(time.time()), "data": data})
 
     # -- shared request path -------------------------------------------------
     def _observed(

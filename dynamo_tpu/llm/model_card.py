@@ -20,6 +20,11 @@ MODEL_TYPE_CHAT = "chat"
 MODEL_TYPE_COMPLETIONS = "completions"
 MODEL_TYPE_EMBEDDING = "embedding"
 MODEL_TYPE_PREFILL = "prefill"  # prefill-only pool member (disaggregation)
+# generic tensor-in/tensor-out model (llm/protocols/tensor.py; reference
+# protocols/tensor.rs + grpc/service/tensor.rs): served over KServe gRPC,
+# no tokenizer/OpenAI machinery
+MODEL_TYPE_TENSOR = "tensor"
+MODEL_TYPE_IMAGES = "images"  # image generation (/v1/images/generations)
 
 MODEL_INPUT_TEXT = "text"      # worker wants raw text (does its own tokenize)
 MODEL_INPUT_TOKENS = "tokens"  # worker wants token ids (frontend preprocesses)

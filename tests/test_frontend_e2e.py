@@ -406,3 +406,84 @@ async def test_responses_endpoint():
                 assert r.status == 404
     finally:
         await stop_stack(*stack[:-1])
+
+
+async def test_openapi_and_docs():
+    store = MemKVStore()
+    stack = await start_stack(store)
+    *handles, base = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(f"{base}/openapi.json")
+            assert r.status == 200
+            spec = await r.json()
+            assert spec["openapi"].startswith("3.")
+            assert "/v1/chat/completions" in spec["paths"]
+            r = await s.get(f"{base}/docs")
+            assert r.status == 200
+            assert "openapi.json" in await r.text()
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_images_endpoint():
+    """/v1/images/generations routes to an images-type worker (reference
+    http/service/openai.rs:1638); 404s when no such model exists."""
+    import base64
+
+    from dynamo_tpu.llm.protocols.common import BackendOutput
+
+    class TinyImageEngine:
+        async def generate(self, request, context):
+            ann = request.get("annotations", {})
+            assert ann.get("op") == "image"
+            fake_png = base64.b64encode(
+                b"\x89PNG fake:" + ann.get("prompt", "").encode()
+            ).decode()
+            yield BackendOutput(
+                finish_reason="stop",
+                annotations={"images": [fake_png] * int(ann.get("n", 1))},
+            ).to_obj()
+
+    store = MemKVStore()
+    worker_rt = await make_rt(store).start()
+    frontend_rt = await make_rt(store).start()
+    card = ModelDeploymentCard(
+        name="pix", tokenizer="byte", context_length=128,
+        model_type=["images"],
+    )
+    served = await register_llm(
+        worker_rt, TinyImageEngine(), card, raw_token_stream=True
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        for _ in range(100):
+            pipe = manager.get("pix")
+            if pipe and pipe.client.instances:
+                break
+            await asyncio.sleep(0.05)
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{service.port}/v1/images/generations",
+                json={"model": "pix", "prompt": "a tpu", "n": 2},
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert len(body["data"]) == 2
+            raw = base64.b64decode(body["data"][0]["b64_json"])
+            assert b"a tpu" in raw
+            # non-image model -> 404
+            r = await s.post(
+                f"http://127.0.0.1:{service.port}/v1/images/generations",
+                json={"model": "absent", "prompt": "x"},
+            )
+            assert r.status == 404
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await served.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()

@@ -236,3 +236,134 @@ async def test_engine_pooled_embedding():
         assert engine.allocator.active_blocks == 0  # no KV pages consumed
     finally:
         engine.stop()
+
+
+# ---------------------------------------------------------------- tensor model
+class DoublerTensorEngine:
+    """Generic tensor worker: doubles FP32 inputs, echoes BYTES inputs
+    (llm/protocols/tensor.py; reference grpc/service/tensor.rs)."""
+
+    async def generate(self, request, context):
+        from dynamo_tpu.llm.protocols.tensor import (
+            Tensor,
+            TensorRequest,
+            TensorResponse,
+        )
+
+        treq = TensorRequest.from_obj(request)
+        outs = []
+        for t in treq.tensors:
+            if t.datatype == "BYTES":
+                outs.append(Tensor.from_bytes_list(
+                    t.name + "_echo", t.to_bytes_list(), t.shape
+                ))
+            else:
+                outs.append(Tensor.from_numpy(t.name + "_x2", t.to_numpy() * 2))
+        yield TensorResponse(tensors=outs).to_obj()
+
+
+async def _tensor_stack(store):
+    worker_rt = await make_rt(store).start()
+    frontend_rt = await make_rt(store).start()
+    card = ModelDeploymentCard(
+        name="tensor-model", tokenizer="byte", context_length=16,
+        model_type=["tensor"],
+    )
+    served = await register_llm(
+        worker_rt, DoublerTensorEngine(), card, raw_token_stream=True
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager).start()
+    for _ in range(100):
+        pipe = manager.get("tensor-model")
+        if pipe and pipe.client.instances:
+            break
+        await asyncio.sleep(0.05)
+    return worker_rt, frontend_rt, served, watcher, manager
+
+
+async def test_tensor_model_infer_typed_contents():
+    """ModelInfer with typed tensor contents against a tensor model: real
+    FP32/INT64 payloads in, computed tensors out."""
+    store = MemKVStore()
+    worker_rt, frontend_rt, served, watcher, manager = await _tensor_stack(store)
+    svc = KserveGrpcService(manager, host="127.0.0.1", port=0)
+    addr = await svc.start()
+    try:
+        async with grpc.aio.insecure_channel(addr) as ch:
+            infer = ch.unary_unary(
+                f"/{SERVICE_NAME}/ModelInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )
+            req = pb.ModelInferRequest(model_name="tensor-model", id="t1")
+            t = req.inputs.add()
+            t.name, t.datatype = "x", "FP32"
+            t.shape.extend([2, 2])
+            t.contents.fp32_contents.extend([1.0, 2.0, 3.0, 4.0])
+            resp = await infer(req)
+        assert resp.id == "t1"
+        out = resp.outputs[0]
+        assert out.name == "x_x2" and out.datatype == "FP32"
+        assert list(out.shape) == [2, 2]
+        assert list(out.contents.fp32_contents) == [2.0, 4.0, 6.0, 8.0]
+    finally:
+        await svc.stop()
+        await stop_stack(worker_rt, frontend_rt, served, watcher)
+
+
+async def test_tensor_model_infer_raw_contents():
+    """raw_input_contents path: raw little-endian bytes in, raw bytes out."""
+    store = MemKVStore()
+    worker_rt, frontend_rt, served, watcher, manager = await _tensor_stack(store)
+    svc = KserveGrpcService(manager, host="127.0.0.1", port=0)
+    addr = await svc.start()
+    try:
+        async with grpc.aio.insecure_channel(addr) as ch:
+            infer = ch.unary_unary(
+                f"/{SERVICE_NAME}/ModelInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )
+            req = pb.ModelInferRequest(model_name="tensor-model", id="t2")
+            t = req.inputs.add()
+            t.name, t.datatype = "v", "INT64"
+            t.shape.extend([3])
+            arr = np.asarray([7, 8, 9], np.int64)
+            req.raw_input_contents.append(arr.tobytes())
+            resp = await infer(req)
+        out = resp.outputs[0]
+        assert out.name == "v_x2" and out.datatype == "INT64"
+        got = np.frombuffer(resp.raw_output_contents[0], np.int64)
+        assert got.tolist() == [14, 16, 18]
+    finally:
+        await svc.stop()
+        await stop_stack(worker_rt, frontend_rt, served, watcher)
+
+
+async def test_llm_input_ids_tensor():
+    """Pre-tokenized input_ids INT64 tensor drives an LLM model (echo):
+    the tokenizer is skipped, token ids flow straight to the engine."""
+    store = MemKVStore()
+    worker_rt, frontend_rt, served, watcher, manager = await start_stack(store)
+    svc = KserveGrpcService(manager, host="127.0.0.1", port=0)
+    addr = await svc.start()
+    try:
+        async with grpc.aio.insecure_channel(addr) as ch:
+            infer = ch.unary_unary(
+                f"/{SERVICE_NAME}/ModelInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )
+            req = pb.ModelInferRequest(model_name="echo-model", id="ids1")
+            t = req.inputs.add()
+            t.name, t.datatype = "input_ids", "INT64"
+            ids = [ord(c) for c in "hello"]
+            t.shape.extend([len(ids)])
+            t.contents.int64_contents.extend(ids)
+            resp = await infer(req)
+        text = resp.outputs[0].contents.bytes_contents[0].decode()
+        assert "hello" in text
+    finally:
+        await svc.stop()
+        await stop_stack(worker_rt, frontend_rt, served, watcher)
