@@ -1,0 +1,22 @@
+{{- define "dynamo-tpu.labels" -}}
+app.kubernetes.io/part-of: {{ .Values.graphName }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{- define "dynamo-tpu.storeEnv" -}}
+{{- if eq .Values.store.kind "etcd" }}
+- name: DTPU_STORE
+  value: etcd
+- name: DTPU_STORE_PATH
+  value: {{ .Values.store.etcdEndpoint | quote }}
+{{- else }}
+- name: DTPU_STORE
+  value: tcp
+- name: DTPU_STORE_PATH
+  value: {{ printf "%s-netstore:4222" .Values.graphName | quote }}
+{{- end }}
+{{- range $k, $v := .Values.env }}
+- name: {{ $k }}
+  value: {{ $v | quote }}
+{{- end }}
+{{- end }}

@@ -44,6 +44,28 @@ async def _run_controller(args) -> None:
     await store.close()
 
 
+async def _run_epp(args) -> None:
+    from dynamo_tpu.deploy.epp import EndpointPicker
+    from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig
+
+    rt = await DistributedRuntime(
+        RuntimeConfig.from_env(store=args.store, store_path=args.store_path)
+    ).start()
+    picker = EndpointPicker(
+        rt, host=args.host, port=args.port,
+        router_mode=RouterMode(args.router_mode),
+    )
+    await picker.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for s in (_signal.SIGINT, _signal.SIGTERM):
+        loop.add_signal_handler(s, stop.set)
+    print(f"EPP_READY {args.host}:{picker.port}", flush=True)
+    await stop.wait()
+    await picker.stop()
+    await rt.shutdown()
+
+
 def main() -> None:
     p = argparse.ArgumentParser("dynamo_tpu.deploy")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -57,10 +79,21 @@ def main() -> None:
     c.add_argument("--store-path", default="/tmp/dtpu_store")
     c.add_argument("--namespace", default="dynamo")
     c.add_argument("--interval", type=float, default=1.0)
+    e = sub.add_parser(
+        "epp", help="endpoint picker for inference gateways (deploy/epp.py)"
+    )
+    e.add_argument("--store", default="file")
+    e.add_argument("--store-path", default="/tmp/dtpu_store")
+    e.add_argument("--host", default="0.0.0.0")
+    e.add_argument("--port", type=int, default=9200)
+    e.add_argument("--router-mode", default="kv", choices=["kv", "round-robin"])
     args = p.parse_args()
 
     if args.cmd == "controller":
         asyncio.run(_run_controller(args))
+        return
+    if args.cmd == "epp":
+        asyncio.run(_run_epp(args))
         return
 
     graph = GraphSpec.load(args.spec)

@@ -1,0 +1,139 @@
+"""Endpoint picker (EPP): the inference-gateway extension, TPU-native.
+
+Analog of the reference's Gateway API Inference Extension endpoint picker
+(deploy/inference-gateway/epp + pkg/plugins/dynamo_kv_scorer): an external
+gateway asks "which backend should this request go to?" and the picker
+answers using the SAME KV-router scoring the frontend uses — prefix-cache
+overlap from live KV events plus load — so gateway-routed traffic lands on
+the worker already holding the prompt's KV.
+
+Where the reference plugs into Envoy ext-proc via a C API into the Rust
+router, this picker is a small HTTP service over the framework's own
+discovery + KvRouter:
+
+    POST /pick {"model": m, "text": ... | "token_ids": [...]}
+      -> {"address", "instance_id", "dp_rank", "overlap_blocks"}
+    GET  /models     -> served models
+    GET  /health
+
+The gateway forwards the request to `address` itself (the picker never
+proxies payloads — exactly the EPP contract).
+
+    python -m dynamo_tpu.deploy epp --store file --store-path $S --port 9200
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+
+from ..llm.discovery import ModelManager, ModelWatcher
+from ..runtime import DistributedRuntime, RouterMode
+from ..runtime.logging import get_logger
+
+log = get_logger("deploy.epp")
+
+
+class EndpointPicker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        host: str = "0.0.0.0",
+        port: int = 9200,
+        router_mode: RouterMode = RouterMode.KV,
+    ):
+        self.runtime = runtime
+        self.manager = ModelManager()
+        self.router_mode = router_mode
+        self.host = host
+        self.port = port
+        self._watcher: Optional[ModelWatcher] = None
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> str:
+        self._watcher = await ModelWatcher(
+            self.runtime, self.manager, self.router_mode
+        ).start()
+        app = web.Application()
+        app.router.add_post("/pick", self.pick)
+        app.router.add_get("/models", self.models)
+        app.router.add_get("/health", self.health)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore
+        log.info("endpoint picker on %s:%d", self.host, self.port)
+        return f"{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        if self._watcher is not None:
+            await self._watcher.stop()
+
+    # ---------------------------------------------------------------- handlers
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "healthy", "models": self.manager.list_models(),
+        })
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response({"models": self.manager.list_models()})
+
+    async def pick(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        model = body.get("model")
+        pipe = self.manager.get(model) if model else None
+        if pipe is None or pipe.client is None:
+            return web.json_response(
+                {"error": f"model {model!r} not found"}, status=404
+            )
+        token_ids = body.get("token_ids")
+        if token_ids is None and body.get("text"):
+            token_ids = pipe.preprocessor.tokenizer.encode(body["text"])
+        token_ids = [int(t) for t in (token_ids or [])]
+        try:
+            # /pick returns instance ids as 16-hex strings; accept them (or
+            # plain ints) back in `excluded`
+            excluded = [
+                int(x, 16) if isinstance(x, str) else int(x)
+                for x in body.get("excluded", [])
+            ]
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": f"bad excluded entry: {e}"}, status=400
+            )
+
+        pipe._prune_dead_workers()  # ghost state must not skew scoring
+        cands = pipe._candidates(excluded)
+        if not cands:
+            return web.json_response({"error": "no live workers"}, status=503)
+        if pipe.kv_router is not None and token_ids:
+            # stateless scoring: the gateway routes (and finishes) requests
+            # itself, so the picker never charges in-flight load it could
+            # not release
+            decision = pipe.kv_router.score_tokens(token_ids, cands)
+            worker_id = decision.worker.worker_id
+            dp_rank = decision.worker.dp_rank
+            overlap = decision.overlap_blocks
+        else:
+            # no KV signal: plain round robin over live instances
+            ids = sorted({c.worker_id for c in cands})
+            worker_id = ids[getattr(self, "_rr", 0) % len(ids)]
+            self._rr = getattr(self, "_rr", 0) + 1
+            dp_rank, overlap = 0, 0
+        inst = pipe.client.instances.get(worker_id)
+        if inst is None:
+            return web.json_response({"error": "picked worker vanished"}, status=503)
+        return web.json_response({
+            "address": inst.address,
+            "instance_id": f"{worker_id:016x}",
+            "dp_rank": dp_rank,
+            "overlap_blocks": overlap,
+            "transport": inst.transport,
+        })

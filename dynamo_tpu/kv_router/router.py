@@ -270,6 +270,27 @@ class KvRouter:
             self._publish_sync_soon(msg)
         return decision
 
+    def score_tokens(
+        self,
+        token_ids: Sequence[int],
+        candidates: Sequence[WorkerWithDpRank],
+    ) -> SchedulingDecision:
+        """Stateless pick: same overlap+load scoring as schedule_tokens but
+        NO side effects — no optimistic load charge, no in-flight tracking,
+        no approx-index update. For observers that only answer "where would
+        this go?" (the endpoint picker, deploy/epp.py): they have no
+        completion signal, so an optimistic charge could never be released
+        and would drift the scheduler into anti-affinity noise. Worker load
+        still tracks reality through the published WorkerMetrics."""
+        hashes = compute_sequence_hashes(token_ids, self.block_size)
+        overlaps = self.indexer.find_matches(hashes)
+        tree_sizes = {
+            c: self.indexer.tree.worker_block_count(c) for c in candidates
+        }
+        return self.scheduler.select_worker(
+            candidates, overlaps, query_blocks=len(hashes), tree_sizes=tree_sizes
+        )
+
     def complete(self, request_id: str) -> None:
         """Request finished: release its optimistic load contribution."""
         entry = self._active.pop(request_id, None)
