@@ -22,6 +22,15 @@ def parse_args():
     p.add_argument("--disk", default=None,
                    help="persist block payloads under this directory "
                         "(RAM index over disk payloads)")
+    p.add_argument("--store", default=None,
+                   help="register in this discovery store to join the "
+                        "DISTRIBUTED kvbm fleet (kvbm/distributed.py): "
+                        "clients consistent-hash blocks across members")
+    p.add_argument("--store-path", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--advertise", default=None,
+                   help="address to register (default: host:port with the "
+                        "bound port)")
     return p.parse_args()
 
 
@@ -34,6 +43,19 @@ async def main() -> None:
         disk_path=args.disk,
     )
     addr = await server.start()
+    runtime = None
+    if args.store:
+        from dynamo_tpu.kvbm.distributed import register_store
+        from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+        runtime = await DistributedRuntime(
+            RuntimeConfig.from_env(store=args.store, store_path=args.store_path)
+        ).start()
+        advertise = args.advertise or addr
+        await register_store(
+            runtime.store, args.namespace, advertise, runtime.lease_id
+        )
+        print(f"KVBM_FLEET_MEMBER {advertise}", flush=True)
     print(f"KVBM_REMOTE_READY {addr}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -41,6 +63,8 @@ async def main() -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     await server.stop()
+    if runtime is not None:
+        await runtime.shutdown()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,133 @@
+"""Block memory layouts: how one KV block's bytes are organized in a tier.
+
+Analog of the reference's layout abstraction
+(lib/llm/src/block_manager/layout.rs, FullyContiguous vs LayerSeparate):
+the LOGICAL block is always [num_layers, 2, block_size, kv_heads, head_dim]
+(K and V per layer), but tiers and transfer agents care about the physical
+arrangement:
+
+- **FullyContiguous** — one C-order buffer per block. What the wire formats
+  and the disk tier want: a block is a single read/write.
+- **LayerSeparate** — one buffer per layer (outer dim peeled off). What the
+  DEVICE side produces and consumes: engine gathers/scatters are per-layer
+  (k_caches/v_caches are per-layer arrays), so layer-separate storage avoids
+  the [L, ...] -> [n, L, ...] transpose copy on every offload.
+
+Both layouts expose the same views so tiers can store either way and
+transfer code can convert only when crossing a boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockShape:
+    num_layers: int
+    block_size: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: np.dtype = np.dtype(np.float32)
+
+    @property
+    def logical_shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.num_layers, 2, self.block_size, self.num_kv_heads,
+                self.head_dim)
+
+    @property
+    def layer_shape(self) -> Tuple[int, int, int, int]:
+        return (2, self.block_size, self.num_kv_heads, self.head_dim)
+
+    @property
+    def nbytes(self) -> int:
+        n = 1
+        for d in self.logical_shape:
+            n *= d
+        return n * self.dtype.itemsize
+
+    @property
+    def layer_nbytes(self) -> int:
+        return self.nbytes // self.num_layers
+
+
+class FullyContiguous:
+    """One buffer per block, logical C-order."""
+
+    def __init__(self, shape: BlockShape):
+        self.shape = shape
+
+    def pack(self, per_layer: Sequence[np.ndarray]) -> np.ndarray:
+        """[2, bs, kvh, d] x L -> one [L, 2, bs, kvh, d] buffer."""
+        assert len(per_layer) == self.shape.num_layers
+        return np.stack([np.asarray(p) for p in per_layer]).astype(
+            self.shape.dtype, copy=False
+        )
+
+    def unpack(self, block: np.ndarray) -> List[np.ndarray]:
+        block = block.reshape(self.shape.logical_shape)
+        return [block[i] for i in range(self.shape.num_layers)]
+
+    def layer_view(self, block: np.ndarray, layer: int) -> np.ndarray:
+        return block.reshape(self.shape.logical_shape)[layer]
+
+    def to_bytes(self, block: np.ndarray) -> bytes:
+        return np.ascontiguousarray(block).tobytes()
+
+    def from_bytes(self, raw: bytes) -> np.ndarray:
+        return np.frombuffer(raw, self.shape.dtype).reshape(
+            self.shape.logical_shape
+        )
+
+
+class LayerSeparate:
+    """One buffer per layer: matches the engine's per-layer cache arrays, so
+    device-side gathers land here without an extra stack/transpose."""
+
+    def __init__(self, shape: BlockShape):
+        self.shape = shape
+
+    def pack(self, per_layer: Sequence[np.ndarray]) -> List[np.ndarray]:
+        assert len(per_layer) == self.shape.num_layers
+        return [
+            np.ascontiguousarray(np.asarray(p), dtype=self.shape.dtype)
+            for p in per_layer
+        ]
+
+    def unpack(self, block: List[np.ndarray]) -> List[np.ndarray]:
+        return list(block)
+
+    def layer_view(self, block: List[np.ndarray], layer: int) -> np.ndarray:
+        return block[layer]
+
+    def to_bytes(self, block: List[np.ndarray]) -> bytes:
+        return b"".join(np.ascontiguousarray(p).tobytes() for p in block)
+
+    def from_bytes(self, raw: bytes) -> List[np.ndarray]:
+        n = self.shape.layer_nbytes
+        return [
+            np.frombuffer(raw[i * n:(i + 1) * n], self.shape.dtype).reshape(
+                self.shape.layer_shape
+            )
+            for i in range(self.shape.num_layers)
+        ]
+
+
+def convert(block, src, dst):
+    """Re-layout one block (copy only when crossing representations)."""
+    if type(src) is type(dst):
+        return block
+    return dst.pack(src.unpack(block)) if isinstance(dst, LayerSeparate) else (
+        np.stack(src.unpack(block))
+    )
+
+
+def make_layout(kind: str, shape: BlockShape):
+    if kind in ("contiguous", "fully_contiguous", "fc"):
+        return FullyContiguous(shape)
+    if kind in ("layer_separate", "ls"):
+        return LayerSeparate(shape)
+    raise ValueError(f"unknown layout {kind!r}")
