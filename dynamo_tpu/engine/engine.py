@@ -1218,9 +1218,14 @@ class TpuEngine:
             self._loop_task.cancel()
         if self._transfer_server is not None:
             try:
-                asyncio.ensure_future(self._transfer_server.stop(0.5))
+                loop = asyncio.get_running_loop()
             except RuntimeError:
-                pass  # no running loop (sync teardown): sockets close with us
+                loop = None  # no running loop (sync teardown): sockets close with us
+            if loop is not None:
+                # keep a ref: the loop only weak-refs tasks
+                self._transfer_stop_task = loop.create_task(
+                    self._transfer_server.stop(0.5)
+                )
         if getattr(self, "_kv_transfer_srv", None) is not None:
             self._kv_transfer_srv.close()
             if self.transfer_address is not None:

@@ -128,6 +128,12 @@ class DistributedBlockPool:
         if self._watch_task is not None:
             self._watcher.cancel()
             self._watch_task.cancel()
+        # Close member-client sockets so store servers' wait_closed() can
+        # complete (client half of the netstore 9634c67 hang fix).
+        with self._lock:
+            pools = list(self._pools.values())
+        for p in pools:
+            p.close()
 
     # ------------------------------------------------------- tier interface
     def _pool_for(self, h: int) -> Optional[RemoteBlockPool]:

@@ -85,6 +85,7 @@ class RemoteBlockStoreServer:
         self.hits = 0
         self.misses = 0
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
 
     # -- storage helpers -----------------------------------------------------
     def _disk_file(self, h: int) -> str:
@@ -136,6 +137,9 @@ class RemoteBlockStoreServer:
 
     # -- wire ----------------------------------------------------------------
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
         try:
             while True:
                 try:
@@ -168,6 +172,8 @@ class RemoteBlockStoreServer:
                     writer.write(_pack({"ok": False, "error": f"bad op {op!r}"}))
                 await writer.drain()
         finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
             writer.close()
 
     async def start(self) -> str:
@@ -179,7 +185,14 @@ class RemoteBlockStoreServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # py3.12 wait_closed() blocks until every connection handler
+            # returns, and pooled clients hold connections open — cancel them
+            for t in list(self._conn_tasks):
+                t.cancel()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
 
 
 class RemoteBlockPool:
@@ -193,6 +206,8 @@ class RemoteBlockPool:
         self.max_failures = max_failures
         self._failures = 0
         self._local = threading.local()
+        self._all_socks: set = set()  # every live socket across threads
+        self._socks_lock = threading.Lock()
         self.disabled = False
 
     # -- socket plumbing -----------------------------------------------------
@@ -201,6 +216,11 @@ class RemoteBlockPool:
         if s is None:
             s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._socks_lock:
+                if self.disabled:  # close() raced us: don't leak a live conn
+                    s.close()
+                    raise ConnectionError("remote block pool closed")
+                self._all_socks.add(s)
             self._local.sock = s
         return s
 
@@ -211,7 +231,29 @@ class RemoteBlockPool:
                 s.close()
             except OSError:
                 pass
+            with self._socks_lock:
+                self._all_socks.discard(s)
             self._local.sock = None
+
+    def close(self) -> None:
+        """Close every socket this pool ever opened, across all threads.
+
+        Servers awaiting wait_closed() depend on clients dropping their
+        connections — the same hang class the netstore fix (9634c67)
+        addressed server-side; this is the client half.
+        """
+        with self._socks_lock:
+            self.disabled = True
+            socks, self._all_socks = self._all_socks, set()
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)  # wakes a recv blocked elsewhere
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def _call(self, obj: dict, payload: bytes = b""):
         if self.disabled:
