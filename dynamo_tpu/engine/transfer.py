@@ -40,6 +40,8 @@ Wire protocol (served as a normal endpoint, "kv_fetch"):
 
 from __future__ import annotations
 
+import itertools
+import os
 import time
 import zlib
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
@@ -61,6 +63,118 @@ SLOT_LEASE_S = 30.0
 # process-local registry: transfer address -> KvTransferServer. A client
 # whose target lives here skips the wire entirely (ICI device path).
 LOCAL_SERVERS: Dict[str, "KvTransferServer"] = {}
+
+
+# -- cross-process device-to-device plane (jax.experimental.transfer) --------
+#
+# The true NIXL analog: PJRT's transfer server moves device buffers directly
+# between PROCESSES (ICI/DCN bulk transport on TPU pods, TCP on CPU), so
+# disaggregated prefill/decode engines in separate OS processes exchange KV
+# pages without host staging (reference lib/memory/src/nixl.rs:13,
+# docs/design_docs/disagg_serving.md:20,54). One transfer server per process,
+# shared by every engine in it; offers ride the existing kv_fetch control
+# protocol as {"device": {uuid, address, shape, dtype, shards}}.
+#
+# The pull is shard-for-shard: the destination spec must reproduce the
+# source's shard layout exactly (no implicit reshard on the wire). Pages are
+# therefore canonicalized before await_pull onto a 1-D mesh of `shards`
+# devices — [L, n, bs, kvh, d] sharded on kvh — where `shards` is negotiated
+# down to what the client can host (a single-chip decoder pulling from a
+# tp=8 prefill group gets a 1-shard layout; the reshard is a device_put on
+# the source's own fabric, never the wire).
+
+_DEVICE_PULL_CAP = 32   # outstanding un-pulled offers per server
+# The transfer runtime has no cancel/unregister: an offer whose client died
+# before pulling may keep its gathered page stacks alive runtime-side even
+# after we drop our refs at expiry. Bound that worst case: after this many
+# expired-unpulled offers the server stops making device offers entirely
+# (DCN keeps serving) instead of leaking HBM without limit.
+_DEVICE_LEAK_BUDGET = 128
+
+_pull_uuids = itertools.count(int(time.time()) << 20)
+_proc_xfer_server = None
+_proc_xfer_conns: Dict[str, Any] = {}
+
+
+def device_transfer_available() -> bool:
+    if os.environ.get("DTPU_DEVICE_TRANSFER", "1") == "0":
+        return False
+    try:
+        from jax.experimental import transfer  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def process_transfer_server(host: str = "127.0.0.1"):
+    """The per-process PJRT transfer server (serves pulls AND dials out).
+    First caller's host wins; DTPU_XFER_HOST overrides (multi-machine)."""
+    global _proc_xfer_server
+    if _proc_xfer_server is None:
+        from jax.experimental import transfer
+
+        host = os.environ.get("DTPU_XFER_HOST", host)
+        client = jax.local_devices()[0].client
+        _proc_xfer_server = transfer.start_transfer_server(
+            client, f"{host}:0", [f"{host}:0"]
+        )
+        log.info("device transfer server on %s", _proc_xfer_server.address())
+    return _proc_xfer_server
+
+
+def _xfer_connect(address: str):
+    conn = _proc_xfer_conns.get(address)
+    if conn is None:
+        conn = _proc_xfer_conns[address] = process_transfer_server().connect(address)
+    return conn
+
+
+def mesh_is_addressable(mesh) -> bool:
+    """True when every mesh device belongs to this process (single-process
+    engine). Multihost groups gather per-process shards instead."""
+    pi = jax.process_index()
+    return all(d.process_index == pi for d in mesh.devices.flat)
+
+
+async def import_pages_device(dst, hashes: List[SequenceHash], kp, vp) -> Optional[int]:
+    """Scatter on-device page stacks [L, n, bs, kvh, d] into ``dst``'s cache
+    as content-addressed blocks. Shared tail of the same-process ICI move and
+    the cross-process device pull. Returns blocks imported, None on scatter
+    failure (caller falls back / recomputes)."""
+    import asyncio
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel import mesh as meshlib
+    from .allocator import OutOfBlocks
+
+    loop = asyncio.get_event_loop()
+    n = int(kp.shape[1])
+    try:
+        dst_ids = dst.allocator.allocate(n)
+    except OutOfBlocks:
+        log.warning("device import: no room for %d blocks on dest", n)
+        return 0
+    dst_sh = NamedSharding(dst.mesh, P(None, *meshlib.kv_cache_spec()))
+
+    def scatter():
+        kpd = jax.device_put(kp, dst_sh)
+        vpd = jax.device_put(vp, dst_sh)
+        ids = jnp.asarray(np.asarray(dst_ids, np.int32))
+        dst.k_caches, dst.v_caches = IciKvMover._scatter_fn(dst)(
+            dst.k_caches, dst.v_caches, kpd, vpd, ids
+        )
+
+    try:
+        await loop.run_in_executor(dst._executor, scatter)
+    except Exception:
+        log.exception("device import scatter failed")
+        dst.allocator.release(dst_ids)
+        return None
+    for bid, h in zip(dst_ids, hashes):
+        dst.allocator.commit(bid, h)
+    dst.allocator.release(dst_ids)
+    return n
 
 
 def _dtype_from_name(name: str) -> np.dtype:
@@ -91,6 +205,10 @@ class KvTransferServer:
         bs = self.engine.cfg.block_size
         self._block_shape = [m.num_layers, 2, bs, m.num_kv_heads, m.head_dim]
         self._arena_dtype = np.dtype(m.dtype)  # cache dtype (bf16 halves bytes)
+        # cross-process device plane: uuid -> (expiry, (k, v) device arrays)
+        self._xfer = None
+        self._pull_pending: Dict[int, Tuple[float, tuple]] = {}
+        self._pull_leaked = 0  # expired-unpulled offers (see _DEVICE_LEAK_BUDGET)
 
     def _ensure_native(self) -> bool:
         """Lazy: the arena (GiB-scale for big models) and agent come up on
@@ -121,6 +239,84 @@ class KvTransferServer:
             self._agent = None
             return False
 
+    # -- device plane --------------------------------------------------------
+    def _ensure_device(self) -> bool:
+        if self._xfer is not None:
+            return True
+        if not device_transfer_available():
+            return False
+        if not mesh_is_addressable(self.engine.mesh):
+            return False  # multihost groups: per-process shard plumbing TBD
+        try:
+            self._xfer = process_transfer_server(self.host)
+        except Exception:
+            log.exception("device transfer server unavailable")
+            return False
+        return True
+
+    async def _offer_device(self, block_ids: List[int], client_shards: int):
+        """Gather pages onto a canonical pull layout and register the pull.
+        Returns the offer dict, or None (at capacity / gather failure)."""
+        import asyncio
+
+        now = time.monotonic()
+        expired = [u for u, (t, _) in self._pull_pending.items() if t <= now]
+        if expired:
+            self._pull_leaked += len(expired)
+            log.warning(
+                "%d device offer(s) expired unpulled (%d lifetime)",
+                len(expired), self._pull_leaked,
+            )
+            for u in expired:
+                self._pull_pending.pop(u, None)
+        if self._pull_leaked >= _DEVICE_LEAK_BUDGET:
+            return None  # leak budget exhausted: DCN from here on
+        if len(self._pull_pending) >= _DEVICE_PULL_CAP:
+            return None
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        eng = self.engine
+        kvh = eng.mcfg.num_kv_heads
+        tp = int(eng.mesh.shape.get("tp", 1))
+        shards = max(1, min(tp, int(client_shards), kvh))
+        while shards > 1 and kvh % shards:
+            shards -= 1
+        devs = list(eng.mesh.devices.flat)[:shards]
+        pull_sh = NamedSharding(
+            Mesh(np.array(devs), ("x",)), P(None, None, None, "x", None)
+        )
+        loop = asyncio.get_event_loop()
+        # reserve the slot BEFORE the gather await: concurrent fetches must
+        # not all pass the cap check and overshoot it together. The inf
+        # expiry keeps the in-flight reservation out of the expiry scan (a
+        # slow first-call compile must not be counted as a leak).
+        uuid = next(_pull_uuids)
+        self._pull_pending[uuid] = (float("inf"), ())
+
+        def gather():
+            ids = jnp.asarray(np.asarray(block_ids, np.int32))
+            k, v = IciKvMover._gather_fn(eng)(eng.k_caches, eng.v_caches, ids)
+            return jax.device_put(k, pull_sh), jax.device_put(v, pull_sh)
+
+        try:
+            k, v = await loop.run_in_executor(eng._executor, gather)
+        except Exception:
+            log.exception("device offer gather failed")
+            self._pull_pending.pop(uuid, None)
+            return None
+        self._xfer.await_pull(uuid, [k, v])
+        # hold refs until pulled+freed (or expiry drops ours; the transfer
+        # runtime keeps its own until the pull lands). Lease starts NOW —
+        # the gather above may have taken a compile-scale pause.
+        self._pull_pending[uuid] = (time.monotonic() + SLOT_LEASE_S, (k, v))
+        return {
+            "uuid": uuid,
+            "address": self._xfer.address(),
+            "shape": list(k.shape),
+            "dtype": k.dtype.name,
+            "shards": shards,
+        }
+
     def _lease_slots(self, n: int) -> Optional[Tuple[List[int], int]]:
         now = time.monotonic()
         free = [
@@ -145,8 +341,13 @@ class KvTransferServer:
                     self._slot_lease.pop(int(s), None)
             yield {"ok": True}
             return
+        if "free_pull" in request:
+            self._pull_pending.pop(int(request["free_pull"]), None)
+            yield {"ok": True}
+            return
         hashes: List[SequenceHash] = list(request.get("hashes", []))
         native_ok = bool(request.get("native_ok")) and self._ensure_native()
+        device_ok = bool(request.get("device_ok")) and self._ensure_device()
         alloc = self.engine.allocator
         # pin the matched prefix so eviction can't race the device gather
         block_ids = alloc.acquire_prefix(hashes)
@@ -155,6 +356,13 @@ class KvTransferServer:
             if n == 0:
                 yield {"matched": 0, "data": b"", "shape": []}
                 return
+            if device_ok:
+                offer = await self._offer_device(
+                    block_ids, int(request.get("device_shards", 1))
+                )
+                if offer is not None:
+                    yield {"matched": n, "device": offer}
+                    return
             leased = self._lease_slots(n) if native_ok else None
             if leased is not None:
                 slots, token = leased
@@ -292,10 +500,6 @@ class IciKvMover:
         imported, or None on failure (caller falls back to the DCN path)."""
         import asyncio
 
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from ..parallel import mesh as meshlib
-
         src, dst = self.src, self.dst
         loop = asyncio.get_event_loop()
         src_ids = src.allocator.acquire_prefix(hashes)  # pin (loop thread)
@@ -303,11 +507,9 @@ class IciKvMover:
             return 0
         try:
             n = len(src_ids)
-            from .allocator import OutOfBlocks
-
-            try:
-                dst_ids = dst.allocator.allocate(n)
-            except OutOfBlocks:
+            if not dst.allocator.can_allocate(n):
+                # cheap pre-gather bail: don't burn a source-side gather
+                # (stealing prefill step time) on a transfer that can't land
                 log.warning("ici move: no room for %d blocks on dest", n)
                 return 0
 
@@ -316,31 +518,17 @@ class IciKvMover:
                 return IciKvMover._gather_fn(src)(src.k_caches, src.v_caches, ids)
 
             try:
+                # [L, n, bs, kvh, d]: kv heads keep their TP sharding; the
+                # device_put inside import_pages_device reshards onto the
+                # destination mesh — the D2D hop.
                 kp, vp = await loop.run_in_executor(src._executor, gather)
-                # [L, n, bs, kvh, d]: kv heads keep their TP sharding, now on
-                # the destination mesh — the D2D hop. kv_cache_spec covers
-                # [nb, bs, kvh, d]; prepend the layer axis.
-                dst_sh = NamedSharding(
-                    dst.mesh, P(None, *meshlib.kv_cache_spec())
-                )
-
-                def scatter():
-                    kpd = jax.device_put(kp, dst_sh)
-                    vpd = jax.device_put(vp, dst_sh)
-                    ids = jnp.asarray(np.asarray(dst_ids, np.int32))
-                    dst.k_caches, dst.v_caches = IciKvMover._scatter_fn(dst)(
-                        dst.k_caches, dst.v_caches, kpd, vpd, ids
-                    )
-
-                await loop.run_in_executor(dst._executor, scatter)
             except Exception:
                 log.exception("ici move failed; falling back to DCN")
-                dst.allocator.release(dst_ids)
                 return None
-            for bid, h in zip(dst_ids, hashes):
-                dst.allocator.commit(bid, h)
-            dst.allocator.release(dst_ids)
-            return n
+            got = await import_pages_device(dst, list(hashes[:n]), kp, vp)
+            if got is None:
+                log.warning("ici move scatter failed; falling back to DCN")
+            return got
         finally:
             src.allocator.release(src_ids)
 
@@ -368,8 +556,6 @@ class KvTransferClient:
         # same-process server (same-slice xPyD): pages move HBM->HBM over
         # the device fabric, skipping the wire entirely. DTPU_ICI_TRANSFER=0
         # forces the wire path (used by the DCN-protocol tests).
-        import os
-
         local = (
             LOCAL_SERVERS.get(address)
             if os.environ.get("DTPU_ICI_TRANSFER", "1") != "0" else None
@@ -381,16 +567,40 @@ class KvTransferClient:
             # device path failed: fall through to the DCN protocol
         from ..transfer import native_available
 
-        stream = await self._tcp.call(
-            address,
-            {"hashes": [int(h) for h in want], "native_ok": native_available()},
+        # device offers are only solicited when the pull could land: room to
+        # allocate, local devices to land on (the offer gathers pages server-
+        # side; asking for one we'd discard wastes prefill step time)
+        device_ok = (
+            device_transfer_available()
+            and mesh_is_addressable(self.engine.mesh)
+            and alloc.can_allocate(len(want))
         )
+        req = {
+            "hashes": [int(h) for h in want],
+            "native_ok": native_available(),
+        }
+        if device_ok:
+            req["device_ok"] = True
+            req["device_shards"] = len(jax.local_devices())
+        stream = await self._tcp.call(address, req)
         item: Dict[str, Any] = {}
         async for it in stream:
             item = it
         matched = item.get("matched", 0)
         if matched == 0:
             return have * alloc.block_size
+        if "device" in item:
+            got = await self._device_pull(address, item, list(want[:matched]))
+            if got is not None:
+                return (have + got) * alloc.block_size
+            # cross-process device pull failed: one retry over the wire
+            req.pop("device_ok", None)
+            stream = await self._tcp.call(address, req)
+            async for it in stream:
+                item = it
+            matched = item.get("matched", 0)
+            if matched == 0 or "device" in item:
+                return have * alloc.block_size
         if "native" in item:
             block_major = await self._native_fetch(address, item, matched)
             if block_major is None:
@@ -404,6 +614,60 @@ class KvTransferClient:
             list(want[:matched]), block_major
         )
         return (have + imported) * alloc.block_size
+
+    async def _device_pull(
+        self, address: str, item: Dict[str, Any], hashes: List[SequenceHash]
+    ) -> Optional[int]:
+        """Pull offered pages device-to-device and scatter them in. Returns
+        blocks imported, or None on failure (caller retries over the wire)."""
+        import asyncio
+
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        dev = item["device"]
+        eng = self.engine
+        shards = int(dev["shards"])
+        # prefer the engine's own devices for the landing buffers; top up
+        # from other local devices if the pull layout is wider than its mesh
+        eng_devs = list(eng.mesh.devices.flat)
+        pool = eng_devs + [d for d in jax.local_devices() if d not in eng_devs]
+        if len(pool) < shards:
+            log.warning(
+                "device pull wants %d shards; only %d local devices",
+                shards, len(pool),
+            )
+            return None
+        pull_sh = NamedSharding(
+            Mesh(np.array(pool[:shards]), ("x",)), P(None, None, None, "x", None)
+        )
+        dtype = _dtype_from_name(dev["dtype"])
+        spec = jax.ShapeDtypeStruct(tuple(dev["shape"]), dtype, sharding=pull_sh)
+        loop = asyncio.get_event_loop()
+
+        def dial_and_pull():
+            # the dial (and the lazy local server start) blocks: keep it off
+            # the event loop that drives engine scheduling
+            conn = _xfer_connect(dev["address"])
+            return conn.pull(int(dev["uuid"]), [spec, spec])
+
+        try:
+            kp, vp = await loop.run_in_executor(None, dial_and_pull)
+        except Exception:
+            log.exception("device pull failed; retrying over the wire")
+            # drop the cached connection: a broken one would otherwise
+            # permanently disable the fast path to this address. Do NOT
+            # free_pull here — the server's expiry must count this offer
+            # toward its leak budget (freeing would hide every real leak).
+            _proc_xfer_conns.pop(dev["address"], None)
+            return None
+        # pull landed: release the server's refs
+        try:
+            stream = await self._tcp.call(address, {"free_pull": int(dev["uuid"])})
+            async for _ in stream:
+                pass
+        except Exception:
+            pass  # server-side expiry reclaims the offer
+        return await import_pages_device(eng, hashes, kp, vp)
 
     async def _native_fetch(
         self, address: str, item: Dict[str, Any], matched: int

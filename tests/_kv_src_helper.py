@@ -1,0 +1,61 @@
+"""Helper process for test_device_transfer_e2e: build a tiny engine, prefill
+a fixed prompt, serve kv_fetch with the device plane enabled, print the page
+checksum, then idle until killed. Run as `python tests/_kv_src_helper.py`."""
+
+import asyncio
+import sys
+import zlib
+
+import numpy as np
+
+PROMPT = list(range(50, 50 + 5 * 4))
+BS = 4
+
+
+async def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.parallel.mesh import make_mesh
+    from dynamo_tpu.runtime import Context
+    from dynamo_tpu.tokens import compute_sequence_hashes
+
+    mcfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+    cfg = TpuEngineConfig(
+        model=mcfg, num_blocks=32, block_size=BS, max_batch_size=2,
+        max_context=128, prefill_buckets=(16, 32, 64, 128), tp=2,
+    )
+    eng = TpuEngine(cfg, mesh=make_mesh(tp=2, devices=jax.devices()[:2]))
+    req = PreprocessedRequest(
+        request_id="src", model="m", token_ids=PROMPT,
+        stop=StopConditions(max_tokens=2, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+    async for _ in eng.generate(req, Context()):
+        pass
+    addr = await eng.serve_transfer()
+    hashes = compute_sequence_hashes(PROMPT, BS)[: (len(PROMPT) - 1) // BS]
+    ids = eng.allocator.acquire_prefix(hashes)
+    crc = 0
+    for kc, vc in zip(eng.k_caches, eng.v_caches):
+        crc = zlib.crc32(np.asarray(kc[np.asarray(ids)]).tobytes(), crc)
+        crc = zlib.crc32(np.asarray(vc[np.asarray(ids)]).tobytes(), crc)
+    eng.allocator.release(ids)
+    print(f"KV_SRC_READY {addr} {crc}", flush=True)
+    await asyncio.sleep(600)
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

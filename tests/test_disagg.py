@@ -171,8 +171,10 @@ async def test_disagg_uses_native_transfer(monkeypatch):
     """When the C++ agent is available, the KV bytes move over it (the
     request plane only carries slot metadata), and the decode side still
     imports rather than recomputes."""
-    # co-resident engines would take the ICI device path; force the wire
+    # co-resident engines would take the ICI device path; force the wire,
+    # and pin the NATIVE protocol (the cross-process device plane outranks it)
     monkeypatch.setenv("DTPU_ICI_TRANSFER", "0")
+    monkeypatch.setenv("DTPU_DEVICE_TRANSFER", "0")
     import dynamo_tpu.transfer as nt
 
     if not nt.native_available():
@@ -231,6 +233,7 @@ async def test_disagg_uses_native_transfer(monkeypatch):
 
 async def test_stale_lease_overwrite_never_imports_torn_bytes(monkeypatch):
     monkeypatch.setenv("DTPU_ICI_TRANSFER", "0")  # wire-protocol test
+    monkeypatch.setenv("DTPU_DEVICE_TRANSFER", "0")  # pin the native path
     """The slot-lease race (ADVICE r2): a fetch stalled past lease expiry
     whose slots were re-gathered for another request must NOT import the
     overwritten bytes — the gather-time checksums catch the tear and the
