@@ -217,20 +217,43 @@ def _load_model(args):
     return mcfg, params, tokenizer_ref
 
 
-def _multihost_mesh(args, mh):
-    """The one mesh every process of the group builds identically."""
+def _multihost_mesh(args, mh, rank: int = 0):
+    """Rank ``rank``'s mesh, built identically on every process of the group.
+
+    dp ranks take a STRIDED slice of the global device list
+    (``devices[rank::dp]``): with process-major global ordering every rank's
+    mesh spans every process, which is required — a process can only build /
+    replay an engine whose arrays have addressable shards on it. (Contiguous
+    slices would make each rank process-local; that layout is just N
+    independent workers and needs no multihost group.)"""
     import jax
 
     from dynamo_tpu.parallel.mesh import make_mesh
 
     n = jax.device_count()
-    if args.tp * args.sp != n:
+    group = (args.pp * args.tp) if args.pp > 1 else (args.tp * args.sp)
+    if args.dp * group != n:
         raise SystemExit(
-            f"--multihost needs tp*sp == global device count: "
-            f"tp={args.tp} sp={args.sp} vs {n} devices over "
-            f"{mh.num_processes} processes"
+            f"--multihost needs dp*(pp*)tp*sp == global device count: "
+            f"dp={args.dp} pp={args.pp} tp={args.tp} sp={args.sp} vs {n} "
+            f"devices over {mh.num_processes} processes"
         )
-    return make_mesh(tp=args.tp, sp=args.sp, devices=jax.devices())
+    if args.dp > 1 and jax.local_device_count() % args.dp:
+        raise SystemExit(
+            f"--multihost dp={args.dp} needs local device count "
+            f"({jax.local_device_count()}) divisible by dp so every rank "
+            f"spans every process"
+        )
+    devs = jax.devices()[rank :: args.dp]
+    if args.pp > 1:
+        from dynamo_tpu.parallel.pp_serving import make_pp_mesh
+
+        return make_pp_mesh(pp=args.pp, tp=args.tp, devices=devs)
+    return make_mesh(tp=args.tp, sp=args.sp, devices=devs)
+
+
+def _mh_ns(args, rank: int) -> str:
+    return f"dp{rank}" if args.dp > 1 else ""
 
 
 async def main() -> None:
@@ -244,28 +267,30 @@ async def main() -> None:
     if args.multihost:
         from dynamo_tpu.runtime.multihost import MultihostContext, MultihostSpec
 
-        if args.dp != 1 or args.disagg != "none":
-            raise SystemExit("--multihost serving covers dp=1, no disagg (yet)")
         mh = MultihostContext(MultihostSpec.parse(args.multihost))
         mh.initialize_jax()  # must precede any device use
         mh.start_control()
 
     if mh is not None and not mh.is_leader:
         # follower: no endpoint, no discovery — join the mesh, build the
-        # SAME engine (params + caches are collective device_puts), replay
+        # SAME engines (params + caches are collective device_puts), replay
         # the leader's dispatches until it stops
         mcfg, params, _tok = _load_model(args)
         engine_cfg = make_engine_config(
             args, mcfg, logits_procs=_build_logits_procs(args)
         )
-        engine = TpuEngine(
-            engine_cfg, params=params, mesh=_multihost_mesh(args, mh),
-            multihost=mh,
-        )
+        engines = [
+            TpuEngine(
+                engine_cfg, params=params, mesh=_multihost_mesh(args, mh, r),
+                multihost=mh, mh_ns=_mh_ns(args, r),
+            )
+            for r in range(args.dp)
+        ]
         print(f"TPU_ENGINE_FOLLOWER_READY proc={mh.spec.process_id}", flush=True)
         loop = asyncio.get_running_loop()
         try:
-            await loop.run_in_executor(None, engine.follow)
+            # ONE replay loop serves every rank's table (namespaced ops)
+            await loop.run_in_executor(None, mh.router.follow)
         except Exception:
             import traceback
 
@@ -386,12 +411,13 @@ async def main() -> None:
             TpuEngine(
                 engine_cfg,
                 params=params,
-                mesh=(_multihost_mesh(args, mh) if mh is not None
+                mesh=(_multihost_mesh(args, mh, r) if mh is not None
                       else rank_mesh(r)),
                 kv_publisher=kv_pub,
                 metrics_publisher=m_pub,
                 kvbm=kvbm if r == 0 else None,  # host tiers are rank-0 only
                 multihost=mh,
+                mh_ns=_mh_ns(args, r),
             )
         )
     if args.dp > 1:
@@ -400,6 +426,20 @@ async def main() -> None:
         engine = DpEngineGroup(engines)
     else:
         engine = engines[0]
+    if mh is not None:
+        # follower death is unrecoverable for the group (its mesh shards are
+        # gone): mark every engine unhealthy — the watchdog deregisters and
+        # exits us for a supervisor restart — and slam the group closed so a
+        # wedged dispatch raises instead of hanging. In-flight client streams
+        # drop with the process; the frontend's Migration replays them on
+        # another worker (llm/migration.py).
+        def _on_follower_death() -> None:
+            print("MULTIHOST_FOLLOWER_LOST", flush=True)
+            for e in engines:
+                e.healthy = False
+            mh.router.close(timeout_s=2.0)
+
+        mh.watch_followers(_on_follower_death)
     if args.disagg in ("prefill", "decode"):
         transfer_engine = engines[0]
         addr = await transfer_engine.serve_transfer(host=cfg.host_ip)
@@ -535,6 +575,14 @@ async def main() -> None:
     engine.stop()
     await runtime.shutdown()
     if mh is not None:
+        if any(not e.healthy for e in engines):
+            # dead group (follower lost / engine crash): the distributed-
+            # shutdown barrier would wait for a peer that isn't coming, and
+            # jax's atexit hook would do the same — exit hard so the
+            # supervisor restarts the whole group
+            import os as _os
+
+            _os._exit(2)
         mh.shutdown_jax()
 
 

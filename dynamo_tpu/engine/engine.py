@@ -197,9 +197,13 @@ class TpuEngine:
         metrics_publisher: Optional[WorkerMetricsPublisher] = None,
         kvbm=None,
         multihost=None,
+        mh_ns: str = "",
     ):
         self.cfg = config
         self.mcfg = config.model
+        # namespace on the multihost dispatch channel: dp ranks / disagg
+        # roles sharing one group each get their own replay table
+        self._mh_ns = mh_ns
         # multi-process execution (runtime/multihost.py): process 0 runs this
         # engine normally but broadcasts every jit dispatch; followers hold
         # their own handles of the same globally-sharded arrays and replay.
@@ -376,9 +380,6 @@ class TpuEngine:
     # ------------------------------------------------------ kv transfer wiring
     async def serve_transfer(self, host: str = "127.0.0.1") -> str:
         """Start the kv_fetch endpoint (prefill side of disaggregation)."""
-        if self._mh is not None:
-            # the gather/scatter programs run outside the replay table
-            raise ValueError("multihost serving does not cover KV transfer yet")
         if self.cfg.pp > 1:
             # transfer gathers iterate per-layer cache lists; pp stacks them
             raise ValueError("pp serving does not cover KV transfer yet")
@@ -975,7 +976,6 @@ class TpuEngine:
         single-device array cannot feed a mesh-spanning program (which is why
         the leader wrapper also downgrades its own args to numpy).
         """
-        from ..runtime.multihost import MultihostOps
 
         def _set_k(v):
             self.k_caches = v
@@ -989,8 +989,8 @@ class TpuEngine:
         def _set_pmasks(v):
             self.prompt_masks = v
 
-        ops = MultihostOps(
-            self._mh,
+        ops = self._mh.router.table(
+            ns=self._mh_ns,
             state_get={
                 "params": lambda: self.params,
                 "k": lambda: self.k_caches,
@@ -1039,6 +1039,39 @@ class TpuEngine:
                 state_in={0: "params", 1: "k", 2: "v"},
                 state_out={0: "k", 1: "v"},
             )
+
+        # KV transfer legs for disaggregation across a multihost group: the
+        # gather REPLICATES its output over the mesh (a collective all-gather
+        # of the tp shards) so the leader can read the page bytes host-side;
+        # the scatter is a replayed collective taking pages by value.
+        repl = NamedSharding(self.mesh, P())
+
+        def kv_gather(k_caches, v_caches, ids):
+            k = jnp.stack([kc[ids] for kc in k_caches])  # [L, n, bs, kvh, d]
+            v = jnp.stack([vc[ids] for vc in v_caches])
+            return k, v
+
+        def kv_scatter(k_caches, v_caches, kp, vp, ids):
+            new_k = [
+                kc.at[ids].set(kp[i].astype(kc.dtype))
+                for i, kc in enumerate(k_caches)
+            ]
+            new_v = [
+                vc.at[ids].set(vp[i].astype(vc.dtype))
+                for i, vc in enumerate(v_caches)
+            ]
+            return new_k, new_v
+
+        self._mh_kv_gather = jax.jit(kv_gather, out_shardings=(repl, repl))
+        self._mh_kv_scatter = jax.jit(kv_scatter)
+        ops.register(
+            "kv_gather", self._mh_kv_gather,
+            state_in={0: "k", 1: "v"}, state_out={},
+        )
+        ops.register(
+            "kv_scatter", self._mh_kv_scatter,
+            state_in={0: "k", 1: "v"}, state_out={0: "k", 1: "v"},
+        )
         self._mh_ops = ops
         if self._mh.is_leader:
             self._prefill_fn = ops.leader_fn("prefill")
@@ -1048,6 +1081,8 @@ class TpuEngine:
             self._embed_fn = ops.leader_fn("embed")
             if getattr(self, "_embed_chunk_fn", None) is not None:
                 self._embed_chunk_fn = ops.leader_fn("embed_chunk")
+            self._mh_kv_gather = ops.leader_fn("kv_gather")
+            self._mh_kv_scatter = ops.leader_fn("kv_scatter")
 
     def follow(self) -> None:
         """Follower process body: replay leader dispatches until stop/EOF.
@@ -1274,6 +1309,17 @@ class TpuEngine:
     def _scatter_blocks(self, local_ids: List[int], arr: np.ndarray) -> None:
         """Executor thread: device scatter only — no allocator access here
         (the allocator is single-threaded on the event loop)."""
+        if self._mh is not None:
+            # arr [n, L, 2, ...] -> kp/vp [L, n, ...] by value: the scatter
+            # is a replayed collective (eager .at[].set on a mesh spanning
+            # processes would be a leader-only dispatch and hang the group)
+            kp = np.ascontiguousarray(np.moveaxis(arr[:, :, 0], 0, 1))
+            vp = np.ascontiguousarray(np.moveaxis(arr[:, :, 1], 0, 1))
+            self.k_caches, self.v_caches = self._mh_kv_scatter(
+                self.k_caches, self.v_caches, kp, vp,
+                np.asarray(local_ids, np.int32),
+            )
+            return
         ids = jnp.asarray(np.asarray(local_ids, np.int32))
         dtype = self.mcfg.dtype
         for li in range(arr.shape[1]):

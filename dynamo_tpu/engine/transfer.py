@@ -300,11 +300,13 @@ class KvTransferServer:
 
         try:
             k, v = await loop.run_in_executor(eng._executor, gather)
+            self._xfer.await_pull(uuid, [k, v])
         except Exception:
-            log.exception("device offer gather failed")
+            # await_pull failures included: leaving the inf-expiry
+            # reservation behind would permanently burn a cap slot
+            log.exception("device offer failed; falling back to the wire")
             self._pull_pending.pop(uuid, None)
             return None
-        self._xfer.await_pull(uuid, [k, v])
         # hold refs until pulled+freed (or expiry drops ours; the transfer
         # runtime keeps its own until the pull lands). Lease starts NOW —
         # the gather above may have taken a compile-scale pause.
@@ -395,6 +397,16 @@ class KvTransferServer:
     def _gather_np(self, block_ids: List[int], dtype=np.float32) -> np.ndarray:
         """Executor thread: device gather -> [L, 2, n, bs, kvh, d]; dtype=None
         keeps the cache dtype (native path; bf16 halves the wire bytes)."""
+        eng = self.engine
+        if eng._mh is not None:
+            # multihost group: the gather is a replayed collective whose
+            # output is REPLICATED over the mesh, so this (leader) process
+            # can read the full page bytes from its local copy
+            k, v = eng._mh_kv_gather(
+                eng.k_caches, eng.v_caches, np.asarray(block_ids, np.int32)
+            )
+            arr = np.stack([np.asarray(k), np.asarray(v)], axis=1)
+            return arr if dtype is None else arr.astype(dtype)
         ids = jnp.asarray(np.asarray(block_ids, np.int32))
         layers = []
         for kc, vc in zip(self.engine.k_caches, self.engine.v_caches):
@@ -560,6 +572,14 @@ class KvTransferClient:
             LOCAL_SERVERS.get(address)
             if os.environ.get("DTPU_ICI_TRANSFER", "1") != "0" else None
         )
+        if local is not None and (
+            not mesh_is_addressable(local.engine.mesh)
+            or not mesh_is_addressable(self.engine.mesh)
+        ):
+            # a multihost engine's gather/scatter are group collectives; the
+            # in-process mover would dispatch them leader-only and hang the
+            # group — take the wire protocol instead
+            local = None
         if local is not None and local.engine is not self.engine:
             moved = await IciKvMover(local.engine, self.engine).move(list(want))
             if moved is not None:

@@ -48,6 +48,16 @@ class Migration:
                 stream = await self.send(req, context, excluded)
                 async for item in stream:
                     out = item if isinstance(item, BackendOutput) else BackendOutput.from_obj(item)
+                    if out.finish_reason == "error" and attempts_left > 0:
+                        # a worker-delivered error finish is the engine dying
+                        # with the courtesy of a last frame (loop crash,
+                        # multihost group teardown) — migrate like any other
+                        # worker loss instead of surfacing the error
+                        err = NoResponders("worker reported error finish")
+                        iid = getattr(stream, "instance_id", None)
+                        if iid is not None:
+                            err.instance_id = iid  # type: ignore[attr-defined]
+                        raise err
                     accumulated.extend(out.token_ids)
                     # a resumed worker counts only ITS OWN tokens: normalize
                     # to the original request so usage accounting survives

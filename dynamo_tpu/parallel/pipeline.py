@@ -65,6 +65,13 @@ _LAYER_TP_SPECS = {
     "wq": P(AXIS_PP, None, AXIS_TP),
     "wk": P(AXIS_PP, None, AXIS_TP),
     "wv": P(AXIS_PP, None, AXIS_TP),
+    # qkv biases shard like the matching projection's output dim
+    "bq": P(AXIS_PP, AXIS_TP),
+    "bk": P(AXIS_PP, AXIS_TP),
+    "bv": P(AXIS_PP, AXIS_TP),
+    # per-head q/k RMSNorm weights [L, head_dim]: replicated over tp
+    "q_norm": P(AXIS_PP, None),
+    "k_norm": P(AXIS_PP, None),
     "wo": P(AXIS_PP, AXIS_TP, None),
     "w_gate": P(AXIS_PP, None, AXIS_TP),
     "w_up": P(AXIS_PP, None, AXIS_TP),

@@ -84,8 +84,6 @@ def _check_cfg(mcfg: llama.LlamaConfig, pp: int, tp: int) -> None:
         raise ValueError(f"num_layers {mcfg.num_layers} not divisible by pp={pp}")
     if mcfg.num_kv_heads % tp or mcfg.num_heads % tp:
         raise ValueError(f"heads not divisible by tp={tp}")
-    if getattr(mcfg, "qkv_bias", False) or getattr(mcfg, "qk_norm", False):
-        raise ValueError("pp serving v1 covers the plain dense llama family")
 
 
 def _stage_scan(serve_layer, lp_local, k_local, v_local, x, attend_one):
@@ -107,16 +105,26 @@ def _stage_scan(serve_layer, lp_local, k_local, v_local, x, attend_one):
 
 
 def _make_serve_layer(mcfg: llama.LlamaConfig, tp: int, cos, sin):
-    """Returns serve_layer(lp, x, kc, vc, attend_one) for [S, H] inputs."""
+    """Returns serve_layer(lp, x, kc, vc, attend_one) for [S, H] inputs.
+    Covers the full dense family incl. Qwen2-style qkv_bias and Qwen3-style
+    per-head q/k RMSNorm (models/llama.py:195-203 is the non-pp original)."""
     d = mcfg.head_dim
     hl = mcfg.num_heads // tp
     kvl = mcfg.num_kv_heads // tp
+    qkv_bias = getattr(mcfg, "qkv_bias", False)
+    qk_norm = getattr(mcfg, "qk_norm", False)
 
     def serve_layer(lp, x, kc, vc, attend_one):
         h = _rms(x, lp["attn_norm"], mcfg.rms_norm_eps)
-        q = (h @ lp["wq"]).reshape(-1, hl, d)
-        k = (h @ lp["wk"]).reshape(-1, kvl, d)
-        v = (h @ lp["wv"]).reshape(-1, kvl, d)
+        q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        if qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(-1, hl, d)
+        k = k.reshape(-1, kvl, d)
+        v = v.reshape(-1, kvl, d)
+        if qk_norm:
+            q = _rms(q, lp["q_norm"], mcfg.rms_norm_eps)
+            k = _rms(k, lp["k_norm"], mcfg.rms_norm_eps)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         o, kc, vc = attend_one(q, k, v, kc, vc)

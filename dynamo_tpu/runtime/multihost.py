@@ -113,6 +113,14 @@ class MultihostContext:
         self._rbuf = b""
         self._lock = threading.Lock()
         self._closed = False
+        self._router: Optional["MultihostRouter"] = None
+
+    @property
+    def router(self) -> "MultihostRouter":
+        """The process-wide dispatch router (one per group membership)."""
+        if self._router is None:
+            self._router = MultihostRouter(self)
+        return self._router
 
     # ------------------------------------------------------------ membership
     @property
@@ -199,14 +207,65 @@ class MultihostContext:
             raise TimeoutError(f"control channel dial failed: {last}")
 
     def broadcast(self, op: str, args: List[Any]) -> None:
-        """Leader: fan one dispatch out to every follower, in order."""
+        """Leader: fan one dispatch out to every follower, in order.
+
+        Fails FAST on the first dead socket: the leader will not execute the
+        op either, so delivering the frame to later survivors would only
+        push them into a collective the leader (and the dead peer) never
+        join. Survivors that already received it may wedge mid-collective —
+        unrecoverable in-process (XLA collectives have no cancel); the
+        jax.distributed coordination-service timeout reaps them, and the
+        follower-death teardown (watch_followers → group close → supervisor
+        restart) handles the rest.
+        """
         payload = msgpack.packb(
             {"op": op, "a": [_encode_arg(a) for a in args]}, use_bin_type=True
         )
         frame = _LEN.pack(len(payload)) + payload
         with self._lock:
             for s in self._socks:
-                s.sendall(frame)
+                try:
+                    s.sendall(frame)
+                except OSError as e:
+                    raise ConnectionError(
+                        f"follower unreachable during broadcast of {op!r}: {e}"
+                    ) from e
+
+    def watch_followers(self, on_death: Callable[[], None]) -> None:
+        """Leader: detect follower death between dispatches.
+
+        Followers never send after their hello, so a readable control socket
+        means EOF (process died / connection reset). One background thread
+        select()s on all follower sockets; the first death fires ``on_death``
+        once and the thread exits — the group is unrecoverable (the dead
+        process held mesh shards; any later collective would hang), so the
+        caller's job is to deregister and exit for a supervisor restart.
+        Reference analog: vllm engine_monitor killing the worker when an
+        engine rank dies (components/src/dynamo/vllm/engine_monitor.py).
+        """
+        import select
+
+        def run() -> None:
+            socks = list(self._socks)
+            while not self._closed and socks:
+                try:
+                    r, _, x = select.select(socks, [], socks, 1.0)
+                except (OSError, ValueError):
+                    return  # sockets closed under us: normal group stop
+                dead = False
+                for s in set(r) | set(x):
+                    try:
+                        if not s.recv(1):
+                            dead = True
+                    except OSError:
+                        dead = True
+                if dead:
+                    if not self._closed:
+                        log.error("multihost follower died; tearing down group")
+                        on_death()
+                    return
+
+        threading.Thread(target=run, daemon=True, name="mh-follower-watch").start()
 
     def recv(self) -> Dict[str, Any]:
         """Follower: block for the next dispatch frame."""
@@ -257,8 +316,77 @@ class MultihostContext:
 CARRY = "__carry__"
 
 
+class MultihostRouter:
+    """Process-level dispatch fabric: ONE broadcast channel, one total order,
+    any number of engine replay tables (dp ranks, disagg roles) multiplexed
+    by a namespace prefix on the op name (``dp1:decode``).
+
+    Dispatches come from more than one thread (each engine's step executor
+    AND the asyncio loop thread); broadcast + local XLA dispatch happen under
+    ONE process-wide lock so every process executes the same total order —
+    jit returns after async-enqueue, so the hold is ~ms.
+    """
+
+    def __init__(self, mh: MultihostContext):
+        self.mh = mh
+        self._tables: Dict[str, "MultihostOps"] = {}
+        self._closed = False
+        self.dispatch_lock = threading.Lock()
+
+    def table(
+        self,
+        state_get: Dict[str, Callable[[], Any]],
+        state_set: Dict[str, Callable[[Any], None]],
+        ns: str = "",
+    ) -> "MultihostOps":
+        if ns in self._tables:
+            raise ValueError(f"multihost namespace {ns!r} already registered")
+        ops = MultihostOps(self, ns, state_get, state_set)
+        self._tables[ns] = ops
+        return ops
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop the group, serialized against in-flight dispatches.
+
+        Taking the dispatch lock means any dispatch racing this close either
+        fully broadcast+executed BEFORE the __stop__ frame (the follower
+        replays it, then exits) or is rejected after — a late collective
+        executed by the leader alone would block forever waiting for peers.
+        Idempotent: every engine of a dp group calls it on stop.
+
+        The lock acquire is BOUNDED: on a follower-death teardown a dispatch
+        may be wedged mid-broadcast holding the lock; after ``timeout_s`` we
+        close anyway (slamming the sockets makes the wedged sendall raise,
+        failing that dispatch — correct in a death scenario).
+        """
+        got = self.dispatch_lock.acquire(timeout=timeout_s)
+        try:
+            if self._closed:
+                return
+            self._closed = True
+            self.mh.close()
+        finally:
+            if got:
+                self.dispatch_lock.release()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def follow(self) -> None:
+        """Follower body: replay dispatches (all namespaces) until stop."""
+        while True:
+            msg = self.mh.recv()
+            op = msg["op"]
+            _trace("follower: recv %s", op)
+            if op == "__stop__":
+                return
+            ns, _, name = op.rpartition(":")
+            self._tables[ns].replay(name, msg)
+
+
 class MultihostOps:
-    """Per-engine dispatch replay table.
+    """Per-engine dispatch replay table (one namespace of the router).
 
     Each op is registered with:
       - ``state_in``:  {arg_pos: state_name} — args the follower substitutes
@@ -275,31 +403,19 @@ class MultihostOps:
     numpy shards consistently on every process.
     """
 
-    def __init__(self, mh: MultihostContext, state_get: Dict[str, Callable[[], Any]],
+    def __init__(self, router: MultihostRouter, ns: str,
+                 state_get: Dict[str, Callable[[], Any]],
                  state_set: Dict[str, Callable[[Any], None]]):
-        self.mh = mh
+        self.router = router
+        self.ns = ns
+        self.mh = router.mh
         self._get = state_get
         self._set = state_set
         self._ops: Dict[str, tuple] = {}
         self._carry: Dict[str, Any] = {}
-        self._closed = False
-        # dispatches come from more than one thread (the engine's step
-        # executor AND its asyncio loop thread); broadcast + local XLA
-        # dispatch happen under ONE lock so every process executes the same
-        # total order — jit returns after async-enqueue, so the hold is ~ms
-        self._dispatch_lock = threading.Lock()
 
     def close(self) -> None:
-        """Stop the group, serialized against in-flight dispatches.
-
-        Taking the dispatch lock means any dispatch racing this close either
-        fully broadcast+executed BEFORE the __stop__ frame (the follower
-        replays it, then exits) or is rejected after — a late collective
-        executed by the leader alone would block forever waiting for peers.
-        """
-        with self._dispatch_lock:
-            self._closed = True
-            self.mh.close()
+        self.router.close()
 
     def register(self, name: str, fn: Callable, state_in: Dict[int, str],
                  state_out: Dict[int, str], carry_in: Optional[Dict[int, str]] = None):
@@ -309,6 +425,7 @@ class MultihostOps:
     def leader_fn(self, name: str) -> Callable:
         fn, state_in, state_out, carry_in = self._ops[name]
         mh = self.mh
+        wire_name = f"{self.ns}:{name}"
 
         def dispatch(*args):
             import jax
@@ -330,47 +447,45 @@ class MultihostOps:
                     if isinstance(host, (np.ndarray, np.generic)) else host
                 )
                 call[i] = host
-            with self._dispatch_lock:
-                if self._closed:
+            with self.router.dispatch_lock:
+                if self.router.closed:
                     raise RuntimeError(
                         f"multihost group stopped; dropping dispatch {name!r}"
                     )
-                _trace("leader: broadcast %s", name)
-                mh.broadcast(name, send)
+                _trace("leader: broadcast %s", wire_name)
+                mh.broadcast(wire_name, send)
                 out = fn(*call)
-                _trace("leader: dispatched %s", name)
+                _trace("leader: dispatched %s", wire_name)
                 return out
 
         return dispatch
 
     # ----------------------------------------------------------- follower side
+    def replay(self, op: str, msg: Dict[str, Any]) -> None:
+        fn, state_in, state_out, carry_in = self._ops[op]
+        data = msg["a"]
+        n_args = len(data) + len(state_in)
+        args: List[Any] = [None] * n_args
+        it = iter(data)
+        for i in range(n_args):
+            if i in state_in:
+                args[i] = self._get[state_in[i]]()
+            else:
+                a = next(it)
+                if isinstance(a, dict) and CARRY in a:
+                    args[i] = self._carry[a[CARRY]]
+                else:
+                    args[i] = a
+        out = fn(*args)
+        _trace("follower: executed %s:%s", self.ns, op)
+        outs = out if isinstance(out, tuple) else (out,)
+        for pos, sname in state_out.items():
+            if sname.startswith("carry_"):
+                self._carry[sname] = outs[pos]
+            else:
+                self._set[sname](outs[pos])
+
     def follow(self) -> None:
-        """Replay dispatches until the leader says stop (or hangs up)."""
-        while True:
-            msg = self.mh.recv()
-            op = msg["op"]
-            _trace("follower: recv %s", op)
-            if op == "__stop__":
-                return
-            fn, state_in, state_out, carry_in = self._ops[op]
-            data = msg["a"]
-            n_args = len(data) + len(state_in)
-            args: List[Any] = [None] * n_args
-            it = iter(data)
-            for i in range(n_args):
-                if i in state_in:
-                    args[i] = self._get[state_in[i]]()
-                else:
-                    a = next(it)
-                    if isinstance(a, dict) and CARRY in a:
-                        args[i] = self._carry[a[CARRY]]
-                    else:
-                        args[i] = a
-            out = fn(*args)
-            _trace("follower: executed %s", op)
-            outs = out if isinstance(out, tuple) else (out,)
-            for pos, sname in state_out.items():
-                if sname.startswith("carry_"):
-                    self._carry[sname] = outs[pos]
-                else:
-                    self._set[sname](outs[pos])
+        """Single-table convenience: replay until stop (delegates to the
+        router; valid when this is the only namespace)."""
+        self.router.follow()
