@@ -1,3 +1,34 @@
+{{- /* chips requested by one worker pod = tp*sp*pp (must stay the ONE
+      source for both the topology selector and the google.com/tpu ask) */ -}}
+{{- define "dynamo-tpu.chips" -}}
+{{- mul (.tp | default 1) (.sp | default 1) (.pp | default 1) -}}
+{{- end }}
+
+{{- /* single-host v5e slice topology for a chip count — same map as
+      deploy/render.py _V5E_TOPO, and like it REJECTS counts with no
+      single-host slice (a rounded-up topology would disagree with the
+      google.com/tpu request and leave the pod Pending forever).
+      Override per-worker with tpuTopology for multi-host shapes. */ -}}
+{{- define "dynamo-tpu.topology" -}}
+{{- $chips := int . -}}
+{{- if eq $chips 1 -}}1x1
+{{- else if eq $chips 4 -}}2x2
+{{- else if eq $chips 8 -}}2x4
+{{- else -}}{{ fail (printf "no single-host v5e topology for %d chips (1|4|8); set tpuTopology explicitly" $chips) }}
+{{- end -}}
+{{- end }}
+
+{{- /* GKE accelerator label value per TPU generation (the label is NOT
+      the generation string: v5e nodes carry tpu-v5-lite-podslice) */ -}}
+{{- define "dynamo-tpu.accelerator" -}}
+{{- $gen := . | default "v5e" -}}
+{{- if eq $gen "v5e" -}}tpu-v5-lite-podslice
+{{- else if eq $gen "v5p" -}}tpu-v5p-slice
+{{- else if eq $gen "v4" -}}tpu-v4-podslice
+{{- else -}}{{ fail (printf "unknown tpuGeneration %q (v5e|v5p|v4)" $gen) }}
+{{- end -}}
+{{- end }}
+
 {{- define "dynamo-tpu.labels" -}}
 app.kubernetes.io/part-of: {{ .Values.graphName }}
 app.kubernetes.io/managed-by: {{ .Release.Service }}

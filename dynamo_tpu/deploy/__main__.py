@@ -3,11 +3,13 @@
     python -m dynamo_tpu.deploy render deploy/examples/agg-serving.yaml
     python -m dynamo_tpu.deploy render spec.yaml -o manifests/
     python -m dynamo_tpu.deploy controller spec.yaml --store file --store-path /tmp/s
+    python -m dynamo_tpu.deploy controller spec.yaml --backend kube --kube-url http://...
 
-`controller` runs the operator's reconcile loop (deploy/controller.py):
-spawns/kills local worker processes to match the spec + live planner scale
-targets, restarts crashes, hot-reloads the spec, and writes status back to
-the store.
+`controller` runs the operator's reconcile loop against one of two backends:
+local (deploy/controller.py) spawns/kills worker OS processes; kube
+(deploy/kube.py) creates/patches/garbage-collects Deployments and
+StatefulSets through the kubernetes API. Both overlay live planner scale
+targets, hot-reload the spec, and write status back to the store.
 """
 
 import argparse
@@ -22,18 +24,30 @@ from dynamo_tpu.deploy.render import GraphSpec, render, render_yaml
 
 
 async def _run_controller(args) -> None:
-    from dynamo_tpu.deploy.controller import GraphController, default_runner
     from dynamo_tpu.runtime.discovery.store import make_store
 
     store = make_store(args.store, args.store_path)
     graph = GraphSpec.load(args.spec)
-    ctl = GraphController(
-        store, graph,
-        runner=default_runner(args.store, args.store_path),
-        namespace=args.namespace,
-        interval_s=args.interval,
-        spec_path=args.spec,
-    ).start()
+    if args.backend == "kube":
+        from dynamo_tpu.deploy.kube import KubeClient, KubeGraphController
+
+        ctl = KubeGraphController(
+            KubeClient(args.kube_url, args.kube_token),
+            store, graph,
+            namespace=args.namespace,
+            interval_s=args.interval,
+            spec_path=args.spec,
+        ).start()
+    else:
+        from dynamo_tpu.deploy.controller import GraphController, default_runner
+
+        ctl = GraphController(
+            store, graph,
+            runner=default_runner(args.store, args.store_path),
+            namespace=args.namespace,
+            interval_s=args.interval,
+            spec_path=args.spec,
+        ).start()
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for s in (_signal.SIGINT, _signal.SIGTERM):
@@ -73,12 +87,23 @@ def main() -> None:
     r.add_argument("spec")
     r.add_argument("-o", "--out-dir", default=None,
                    help="write one file per object (default: stdout stream)")
-    c = sub.add_parser("controller", help="reconcile the spec with local processes")
+    c = sub.add_parser(
+        "controller",
+        help="reconcile the spec (local OS processes, or Deployments "
+        "through the kube API with --backend kube)",
+    )
     c.add_argument("spec")
     c.add_argument("--store", default="file")
     c.add_argument("--store-path", default="/tmp/dtpu_store")
     c.add_argument("--namespace", default="dynamo")
     c.add_argument("--interval", type=float, default=1.0)
+    c.add_argument("--backend", default="local", choices=["local", "kube"],
+                   help="local: reconcile OS processes; kube: reconcile "
+                   "Deployments/StatefulSets through the kube API "
+                   "(deploy/kube.py)")
+    c.add_argument("--kube-url", default=None,
+                   help="kube API base URL (default: in-cluster config)")
+    c.add_argument("--kube-token", default=None)
     e = sub.add_parser(
         "epp", help="endpoint picker for inference gateways (deploy/epp.py)"
     )

@@ -9,8 +9,9 @@ kubernetes_connector.py:48,333 and virtual_connector.py:28):
 - SubprocessConnector: actually spawns/stops local worker processes (mocker
   or TPU engine) to match the target — the fleet-in-a-box used by scaling
   e2e tests (reference tests/planner/test_scaling_e2e.py runs on mockers).
-- KubernetesConnector: patches deployment replicas via the k8s API (gated:
-  no cluster in this environment; import kubernetes lazily).
+- KubernetesConnector: patches Deployment replicas through the in-repo kube
+  API client (deploy/kube.py) — no kubernetes-package dependency; CI drives
+  it against the mock API server (tests/kube_mock.py).
 """
 
 from __future__ import annotations
@@ -19,7 +20,7 @@ import asyncio
 import os
 import signal
 import subprocess
-from typing import Dict, List, Protocol
+from typing import Dict, List, Optional, Protocol
 
 from ..runtime.discovery.store import KVStore
 from ..runtime.logging import get_logger
@@ -122,34 +123,43 @@ class SubprocessConnector:
 
 
 class KubernetesConnector:
-    """Patch deployment/scale subresource (reference kubernetes_connector.py).
+    """Patch Deployment replicas straight through the kube API (reference
+    components/src/dynamo/planner/kubernetes_connector.py:48,333).
 
-    Gated: requires the `kubernetes` package + in-cluster/SA config, neither
-    of which exists in this image; construction raises a clear error so the
-    planner falls back to the virtual connector."""
+    Built on the in-repo KubeClient (deploy/kube.py) — no `kubernetes`
+    package dependency; in-cluster service-account config is picked up
+    automatically when base_url is omitted, and CI drives the same code
+    against the mock API server (tests/kube_mock.py)."""
 
-    def __init__(self, namespace: str = "default", deployment_prefix: str = "dynamo-"):
-        try:
-            import kubernetes  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "kubernetes client not available; use VirtualConnector and an "
-                "external operator instead"
-            ) from e
-        from kubernetes import client, config
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        kube_namespace: str = "default",
+        deployment_prefix: str = "dynamo-",
+    ):
+        from ..deploy.kube import KubeClient
 
-        config.load_incluster_config()
-        self._apps = client.AppsV1Api()
-        self.namespace = namespace
+        self.kube = KubeClient(base_url, token)
+        self.kube_namespace = kube_namespace
         self.prefix = deployment_prefix
 
+    def _name(self, component: str) -> str:
+        return f"{self.prefix}{component}"
+
     async def get_replicas(self, component: str) -> int:
-        dep = self._apps.read_namespaced_deployment_scale(
-            f"{self.prefix}{component}", self.namespace
+        dep = await self.kube.get(
+            "apps/v1", self.kube_namespace, "deployments", self._name(component)
         )
-        return dep.status.replicas or 0
+        if dep is None:
+            return 0
+        return int((dep.get("spec") or {}).get("replicas") or 0)
 
     async def set_replicas(self, component: str, n: int) -> None:
-        self._apps.patch_namespaced_deployment_scale(
-            f"{self.prefix}{component}", self.namespace, {"spec": {"replicas": n}}
+        await self.kube.patch(
+            "apps/v1", self.kube_namespace, "deployments", self._name(component),
+            {"spec": {"replicas": int(n)}},
         )
+
+    async def close(self) -> None:
+        await self.kube.close()

@@ -1,0 +1,198 @@
+"""In-process mock Kubernetes API server (the endpoints KubeClient uses).
+
+Mirrors how the etcd backend is tested against a mock gateway: the
+controller's HTTP contract (list with labelSelector, get, create, JSON
+merge-patch, delete, chunked watch streams) runs against this server in CI;
+the same client hits a real apiserver in production. Deployments become
+"ready" (status.readyReplicas = spec.replicas) after a configurable delay so
+tests can observe rollout states.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+
+def _merge(base: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict) or not isinstance(base, dict):
+        return patch
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge(out.get(k), v)
+    return out
+
+
+def _match_selector(obj: Dict[str, Any], selector: Optional[str]) -> bool:
+    if not selector:
+        return True
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for clause in selector.split(","):
+        k, _, v = clause.partition("=")
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+class MockKubeApi:
+    def __init__(self, ready_delay_s: float = 0.0):
+        # (plural, namespace, name) -> object
+        self.objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        self.ready_delay_s = ready_delay_s
+        self._rv = 0
+        self._watchers: List[Tuple[str, str, Optional[str], asyncio.Queue]] = []
+        self._runner: Optional[web.AppRunner] = None
+        self.port = 0
+        # request log for assertions: (verb, plural, name)
+        self.log: List[Tuple[str, str, str]] = []
+
+    # ----------------------------------------------------------- plumbing
+    def _bump(self, obj: Dict[str, Any]) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+
+    def _emit(self, ev_type: str, plural: str, ns: str, obj: Dict[str, Any]):
+        for (wp, wns, sel, q) in self._watchers:
+            if wp == plural and wns == ns and _match_selector(obj, sel):
+                q.put_nowait({"type": ev_type, "object": obj})
+
+    async def _make_ready(self, plural: str, ns: str, name: str) -> None:
+        if self.ready_delay_s:
+            await asyncio.sleep(self.ready_delay_s)
+        obj = self.objects.get((plural, ns, name))
+        if obj is None:
+            return
+        replicas = (obj.get("spec") or {}).get("replicas", 1)
+        obj.setdefault("status", {})["readyReplicas"] = replicas
+        obj["status"]["replicas"] = replicas
+        self._bump(obj)
+        self._emit("MODIFIED", plural, ns, obj)
+
+    # ----------------------------------------------------------- handlers
+    async def _list_or_watch(self, request: web.Request) -> web.StreamResponse:
+        plural, ns = request.match_info["plural"], request.match_info["ns"]
+        selector = request.query.get("labelSelector")
+        items = [
+            o for (p, n, _), o in self.objects.items()
+            if p == plural and n == ns and _match_selector(o, selector)
+        ]
+        if request.query.get("watch") != "true":
+            self.log.append(("list", plural, ""))
+            return web.json_response(
+                {"kind": "List", "items": items,
+                 "metadata": {"resourceVersion": str(self._rv)}}
+            )
+        # watch: chunked JSON-lines stream until client disconnect
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        q: asyncio.Queue = asyncio.Queue()
+        entry = (plural, ns, selector, q)
+        self._watchers.append(entry)
+        try:
+            for o in items:  # initial state as ADDED, like resourceVersion=0
+                await resp.write(
+                    json.dumps({"type": "ADDED", "object": o}).encode() + b"\n"
+                )
+            while True:
+                ev = await q.get()
+                await resp.write(json.dumps(ev).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._watchers.remove(entry)
+        return resp
+
+    async def _create(self, request: web.Request) -> web.Response:
+        plural, ns = request.match_info["plural"], request.match_info["ns"]
+        obj = await request.json()
+        name = obj["metadata"]["name"]
+        self.log.append(("create", plural, name))
+        if (plural, ns, name) in self.objects:
+            return web.json_response(
+                {"kind": "Status", "code": 409, "reason": "AlreadyExists"},
+                status=409,
+            )
+        self._bump(obj)
+        self.objects[(plural, ns, name)] = obj
+        self._emit("ADDED", plural, ns, obj)
+        if plural in ("deployments", "statefulsets"):
+            asyncio.ensure_future(self._make_ready(plural, ns, name))
+        return web.json_response(obj, status=201)
+
+    async def _get(self, request: web.Request) -> web.Response:
+        plural, ns = request.match_info["plural"], request.match_info["ns"]
+        name = request.match_info["name"]
+        obj = self.objects.get((plural, ns, name))
+        if obj is None:
+            return web.json_response(
+                {"kind": "Status", "code": 404, "reason": "NotFound"}, status=404
+            )
+        return web.json_response(obj)
+
+    async def _patch(self, request: web.Request) -> web.Response:
+        plural, ns = request.match_info["plural"], request.match_info["ns"]
+        name = request.match_info["name"]
+        self.log.append(("patch", plural, name))
+        obj = self.objects.get((plural, ns, name))
+        if obj is None:
+            return web.json_response(
+                {"kind": "Status", "code": 404, "reason": "NotFound"}, status=404
+            )
+        patch = json.loads(await request.text())
+        merged = _merge(obj, patch)
+        self._bump(merged)
+        self.objects[(plural, ns, name)] = merged
+        self._emit("MODIFIED", plural, ns, merged)
+        if plural in ("deployments", "statefulsets"):
+            asyncio.ensure_future(self._make_ready(plural, ns, name))
+        return web.json_response(merged)
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        plural, ns = request.match_info["plural"], request.match_info["ns"]
+        name = request.match_info["name"]
+        self.log.append(("delete", plural, name))
+        obj = self.objects.pop((plural, ns, name), None)
+        if obj is None:
+            return web.json_response(
+                {"kind": "Status", "code": 404, "reason": "NotFound"}, status=404
+            )
+        self._emit("DELETED", plural, ns, obj)
+        return web.json_response({"kind": "Status", "status": "Success"})
+
+    # ----------------------------------------------------------- lifecycle
+    async def start(self) -> str:
+        app = web.Application()
+        for root in ("/apis/apps/v1", "/api/v1"):
+            app.router.add_get(
+                root + "/namespaces/{ns}/{plural}", self._list_or_watch
+            )
+            app.router.add_post(root + "/namespaces/{ns}/{plural}", self._create)
+            app.router.add_get(
+                root + "/namespaces/{ns}/{plural}/{name}", self._get
+            )
+            app.router.add_patch(
+                root + "/namespaces/{ns}/{plural}/{name}", self._patch
+            )
+            app.router.add_delete(
+                root + "/namespaces/{ns}/{plural}/{name}", self._delete
+            )
+        # bounded shutdown: open watch streams (handlers parked on q.get)
+        # must not wedge cleanup
+        self._runner = web.AppRunner(app, shutdown_timeout=0.5)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
