@@ -25,6 +25,7 @@ from ..runtime.engine import Context
 from ..runtime.logging import get_logger
 from ..tokens import SequenceHash, TokenBlockSequence
 from ..llm.protocols.common import (
+    FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_STOP,
     BackendOutput,
@@ -204,6 +205,7 @@ class MockerEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._started_at = time.monotonic()
+        self._stopped = False
 
     # -- engine interface ----------------------------------------------------
     async def generate(
@@ -214,6 +216,12 @@ class MockerEngine:
         startup_left = self.args.startup_time_s - (time.monotonic() - self._started_at)
         if startup_left > 0:
             await asyncio.sleep(startup_left / self.args.speedup_ratio)
+        if self._stopped:
+            # stop() ran during the startup sleep: the loop's stranded-
+            # consumer flush already happened, so erroring here is the only
+            # way this request ever finishes
+            yield BackendOutput(finish_reason=FINISH_ERROR, cumulative_tokens=0)
+            return
         seq = TokenBlockSequence(req.token_ids, self.args.block_size)
         state = _Running(
             req=req,
@@ -234,10 +242,13 @@ class MockerEngine:
 
     # -- simulation loop -----------------------------------------------------
     def _ensure_loop(self) -> None:
+        if self._stopped:
+            return  # a stopped engine must not resurrect its loop
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.create_task(self._loop())
 
     def stop(self) -> None:
+        self._stopped = True
         if self._loop_task is not None:
             self._loop_task.cancel()
 
@@ -272,6 +283,18 @@ class MockerEngine:
             for q, item in self._outbox:
                 q.put_nowait(item)
             self._outbox = []
+            # ...nor one whose request was still queued/running: deliver an
+            # error finish so generate() returns (the engine-side loop-crash
+            # path does the same; without this, stop() mid-request hangs the
+            # consumer forever)
+            for st in self._waiting + self._running:
+                st.out_queue.put_nowait(
+                    BackendOutput(
+                        finish_reason=FINISH_ERROR, cumulative_tokens=st.produced
+                    )
+                )
+            self._waiting = []
+            self._running = []
 
     def _admit(self) -> None:
         still_waiting: List[_Running] = []

@@ -121,6 +121,11 @@ class PlannerConfig:
     # EMA weight kept on the previous correction factor each window (0 =
     # jump straight to the latest measurement)
     correction_smoothing: float = 0.5
+    # queue-pressure floor: num_waiting/divisor extra replicas when work is
+    # queued (0 disables). Justified by the burst-recovery loadgen
+    # validation (profiler/loadgen.py planner_sim; tests/test_loadgen.py
+    # pins that recovery with the bump beats without under a step burst).
+    queue_bump_divisor: float = 4.0
     sla: SlaTargets = dataclasses.field(default_factory=SlaTargets)
 
 
@@ -187,8 +192,9 @@ class PoolPlanner:
         capacity = self._capacity(snapshot)
         needed = math.ceil(predicted / capacity)
         # queue pressure bumps the floor: waiting work means we're behind
-        if snapshot.num_waiting > 0:
-            needed = max(needed, math.ceil(snapshot.num_waiting / 4) + 1)
+        div = self.config.queue_bump_divisor
+        if snapshot.num_waiting > 0 and div > 0:
+            needed = max(needed, math.ceil(snapshot.num_waiting / div) + 1)
         return max(self.config.min_replicas, min(self.config.max_replicas, max(needed, 1)))
 
     async def plan_and_apply(self, snapshot: LoadSnapshot) -> int:

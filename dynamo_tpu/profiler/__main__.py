@@ -1,20 +1,100 @@
-"""python -m dynamo_tpu.profiler — measure a worker's capacity envelope.
+"""python -m dynamo_tpu.profiler — measure a worker's capacity envelope,
+or replay a load trace for SLA attainment.
 
 Analog of the reference's `profile_sla.py` entrypoint: sweeps (isl, batch)
 on a real engine (or the mocker), writes a profile JSON the planner loads
 via `--profile` / PerfInterpolator.from_profile and the mocker loads for
 timing calibration.
+
+Trace replay (reference burstgpt/sin loadgens + aiperf wrapper):
+
+    python -m dynamo_tpu.profiler replay --shape sin --duration 60 --rate 20
+    python -m dynamo_tpu.profiler replay --trace trace.jsonl --workers 4
+
+prints one JSON line of SLA attainment (profiler/loadgen.py) measured on a
+mocker fleet's simulated clocks.
 """
 
 import argparse
 import asyncio
 import json
+import sys
 
 from dynamo_tpu.profiler.sweep import calibrate_mocker_args, profile_engine
 
 
+async def _replay_main(argv) -> None:
+    p = argparse.ArgumentParser("dynamo_tpu.profiler replay")
+    p.add_argument("--trace", default=None, help="JSONL trace to replay "
+                   "(default: synthesize from --shape)")
+    p.add_argument("--shape", default="sin", choices=["sin", "burst", "poisson"])
+    p.add_argument("--duration", type=float, default=60.0)
+    p.add_argument("--rate", type=float, default=20.0)
+    p.add_argument("--amplitude", type=float, default=0.8)
+    p.add_argument("--period", type=float, default=30.0)
+    p.add_argument("--burst-rate", type=float, default=80.0)
+    p.add_argument("--burst-len", type=float, default=3.0)
+    p.add_argument("--isl", type=int, default=256)
+    p.add_argument("--osl", type=int, default=64)
+    p.add_argument("--prefix-share", type=float, default=0.5)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--speedup", type=float, default=20.0)
+    p.add_argument("--ttft", type=float, default=0.5, help="TTFT SLA (s)")
+    p.add_argument("--itl", type=float, default=0.05, help="ITL SLA (s)")
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+    from dynamo_tpu.profiler import loadgen
+
+    if args.trace:
+        trace = loadgen.load_trace(args.trace)
+    elif args.shape == "sin":
+        trace = loadgen.sinusoidal_trace(
+            args.duration, args.rate, args.amplitude, args.period,
+            isl=args.isl, osl=args.osl,
+        )
+    elif args.shape == "burst":
+        trace = loadgen.bursty_trace(
+            args.duration, args.rate, args.burst_rate, args.burst_len,
+            args.period, isl=args.isl, osl=args.osl,
+        )
+    else:
+        trace = loadgen.poisson_trace(
+            int(args.duration * args.rate), args.rate,
+            isl=args.isl, osl=args.osl,
+        )
+    engines = [
+        MockerEngine(MockEngineArgs(
+            emit_sim_ts=True, speedup_ratio=args.speedup,
+        ))
+        for _ in range(args.workers)
+    ]
+    try:
+        rep = await loadgen.replay(
+            trace, engines, args.ttft, args.itl,
+            prefix_share=args.prefix_share, speedup=args.speedup,
+        )
+    finally:
+        for e in engines:
+            e.stop()
+    print(json.dumps({
+        "requests": rep.completed,
+        "workers": args.workers,
+        "ttft_attainment": round(rep.ttft_attainment, 4),
+        "itl_attainment": round(rep.itl_attainment, 4),
+        "ttft_p95_s": round(rep.ttft_p95_s, 4),
+        "itl_p95_s": round(rep.itl_p95_s, 4),
+        "cache_hit_ratio": round(rep.cache_hit_ratio, 4),
+    }))
+
+
 def parse_args():
-    p = argparse.ArgumentParser("dynamo_tpu.profiler")
+    p = argparse.ArgumentParser(
+        "dynamo_tpu.profiler",
+        epilog="subcommand: 'python -m dynamo_tpu.profiler replay ...' "
+        "replays a load trace (sin/burst/poisson or a JSONL file) against "
+        "a mocker fleet and prints SLA attainment; see 'replay --help'.",
+    )
     p.add_argument("--engine", default="tpu", choices=["tpu", "mocker"])
     p.add_argument("--preset", default="tiny")
     p.add_argument("--model-path", default=None)
@@ -97,4 +177,7 @@ async def main() -> None:
 
 
 if __name__ == "__main__":
-    asyncio.run(main())
+    if len(sys.argv) > 1 and sys.argv[1] == "replay":
+        asyncio.run(_replay_main(sys.argv[2:]))
+    else:
+        asyncio.run(main())

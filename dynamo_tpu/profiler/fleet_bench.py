@@ -38,10 +38,12 @@ from ..runtime.event_plane.base import InProcEventPlane
 
 
 def _prompt(group: int, i: int, prompt_len: int, shared_len: int) -> List[int]:
-    """Group members share the first ``shared_len`` tokens exactly."""
-    shared = [(group * 37 + j * 3) % 512 for j in range(shared_len)]
-    unique = [(group * 37 + i * 101 + j * 7 + 1) % 512 for j in range(prompt_len - shared_len)]
-    return shared + unique
+    """Group members share the first ``shared_len`` tokens exactly (thin
+    adapter over loadgen.prefix_prompt, the one shared-prefix generator)."""
+    from .loadgen import TraceItem, prefix_prompt
+
+    item = TraceItem(t=0.0, isl=prompt_len, osl=0, group=group)
+    return prefix_prompt(item, i, share=shared_len / max(prompt_len, 1))
 
 
 def _req(rid: str, tokens: List[int], max_tokens: int) -> PreprocessedRequest:
@@ -53,7 +55,9 @@ def _req(rid: str, tokens: List[int], max_tokens: int) -> PreprocessedRequest:
 
 
 def _pct(xs: List[float], p: float) -> float:
-    return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0
+    from .loadgen import pct
+
+    return pct(xs, p)
 
 
 def _stats(ttfts: List[float], itls: List[float], cached: int, inputs: int) -> Dict[str, float]:

@@ -1,0 +1,362 @@
+"""Trace and synthetic load generation: arrival processes, SLA replay, and a
+planner-in-the-loop simulator.
+
+Reference analogs: ``benchmarks/sin_load_generator`` (sinusoidal request
+rate), ``benchmarks/burstgpt_loadgen`` (trace replay with bursty arrivals),
+``prefix_data_generator`` (controlled shared-prefix share), and the router
+prefix-ratio benchmark's workload synthesis. Where the reference validates
+its planner with manual aiperf sweeps, ``planner_sim`` closes the loop in
+one process: generated load drives a mocker fleet whose snapshots feed a
+real PoolPlanner, whose decisions resize the fleet — so planner heuristics
+(correction factors, the queue bump) are validated against load shapes
+instead of being constants taken on faith.
+
+All latencies are SIMULATED-clock quantities (mocker sim_ts); arrivals are
+paced in wall time and scaled by speedup_ratio, so a minutes-long diurnal
+trace replays in CI seconds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import math
+import random
+from typing import Callable, List, Optional, Sequence
+
+from ..llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from ..mocker.engine import MockEngineArgs, MockerEngine
+from ..planner.core import LoadSnapshot, PoolPlanner
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+
+log = get_logger("profiler.loadgen")
+
+
+@dataclasses.dataclass
+class TraceItem:
+    """One request of a workload trace."""
+
+    t: float                 # arrival time (seconds from trace start)
+    isl: int                 # input sequence length (tokens)
+    osl: int                 # output sequence length (tokens)
+    group: int = 0           # prefix group (members share a prompt prefix)
+
+
+# --------------------------------------------------------------------------
+# arrival processes
+# --------------------------------------------------------------------------
+
+
+def poisson_trace(
+    n: int, rate: float, isl: int = 256, osl: int = 64,
+    num_groups: int = 8, seed: int = 0,
+) -> List[TraceItem]:
+    """Memoryless arrivals at ``rate`` req/s."""
+    rng = random.Random(seed)
+    t = 0.0
+    out = []
+    for _ in range(n):
+        t += rng.expovariate(rate)
+        out.append(TraceItem(t, isl, osl, rng.randrange(num_groups)))
+    return out
+
+
+def sinusoidal_trace(
+    duration_s: float, mean_rate: float, amplitude: float, period_s: float,
+    isl: int = 256, osl: int = 64, num_groups: int = 8, seed: int = 0,
+) -> List[TraceItem]:
+    """Diurnal-style rate: ``mean_rate * (1 + amplitude*sin(2πt/period))``,
+    realized as a thinned Poisson process (reference sin_load_generator)."""
+    rng = random.Random(seed)
+    peak = mean_rate * (1 + abs(amplitude))
+    t = 0.0
+    out = []
+    while t < duration_s:
+        t += rng.expovariate(peak)
+        rate = mean_rate * (1 + amplitude * math.sin(2 * math.pi * t / period_s))
+        if rng.random() < max(rate, 0.0) / peak:  # thinning
+            out.append(TraceItem(t, isl, osl, rng.randrange(num_groups)))
+    return out
+
+
+def bursty_trace(
+    duration_s: float, base_rate: float, burst_rate: float,
+    burst_len_s: float, cycle_s: float,
+    isl: int = 256, osl: int = 64, num_groups: int = 8, seed: int = 0,
+) -> List[TraceItem]:
+    """On/off bursts (burstgpt-style): ``burst_rate`` for ``burst_len_s`` at
+    the top of every ``cycle_s``, ``base_rate`` otherwise."""
+    rng = random.Random(seed)
+    t = 0.0
+    out = []
+    while t < duration_s:
+        in_burst = (t % cycle_s) < burst_len_s
+        rate = burst_rate if in_burst else base_rate
+        t += rng.expovariate(max(rate, 1e-9))
+        out.append(TraceItem(t, isl, osl, rng.randrange(num_groups)))
+    return out
+
+
+def save_trace(path: str, trace: Sequence[TraceItem]) -> None:
+    with open(path, "w") as f:
+        for it in trace:
+            f.write(json.dumps(dataclasses.asdict(it)) + "\n")
+
+
+def load_trace(path: str) -> List[TraceItem]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                obj = json.loads(line)
+                out.append(TraceItem(
+                    t=float(obj["t"]), isl=int(obj["isl"]),
+                    osl=int(obj["osl"]), group=int(obj.get("group", 0)),
+                ))
+    return out
+
+
+def prefix_prompt(item: TraceItem, idx: int, share: float, vocab: int = 512) -> List[int]:
+    """Prompt with the first ``share`` fraction shared by the whole group
+    (prefix_data_generator concept: controllable cache-hit opportunity)."""
+    shared_len = int(item.isl * share)
+    g = item.group
+    shared = [(g * 131 + j * 3) % vocab for j in range(shared_len)]
+    unique = [(g * 131 + idx * 101 + j * 7 + 1) % vocab
+              for j in range(item.isl - shared_len)]
+    return shared + unique
+
+
+# --------------------------------------------------------------------------
+# SLA replay
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SlaReport:
+    completed: int
+    ttft_attainment: float     # fraction of requests with TTFT <= target
+    itl_attainment: float      # fraction of ITL gaps <= target
+    ttft_p95_s: float
+    itl_p95_s: float
+    cache_hit_ratio: float
+    sim_busy_s: float
+
+
+def pct(xs: List[float], p: float) -> float:
+    """Nearest-rank percentile (ceil(p*n)-1), shared with fleet_bench."""
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    return xs[min(len(xs) - 1, max(0, math.ceil(p * len(xs)) - 1))]
+
+
+async def replay(
+    trace: Sequence[TraceItem],
+    engines: List[MockerEngine],
+    ttft_target_s: float,
+    itl_target_s: float,
+    prefix_share: float = 0.5,
+    speedup: float = 1.0,
+    route_fn: Optional[Callable[[int, List[int]], int]] = None,
+    on_arrival: Optional[Callable[[TraceItem], None]] = None,
+) -> SlaReport:
+    """Replay ``trace`` against a mocker fleet at arrival-time pacing
+    (wall-clock, divided by ``speedup``), reporting SLA attainment measured
+    on the engines' simulated clocks. ``route_fn(idx, tokens)`` picks the
+    worker (default round-robin over the CURRENT fleet, so a resize mid-
+    replay shifts traffic — what planner_sim exercises)."""
+    ttfts: List[float] = []
+    itls: List[float] = []
+    cached = [0]
+    inputs = [0]
+    tasks = []
+
+    async def one(idx: int, item: TraceItem) -> None:
+        tokens = prefix_prompt(item, idx, prefix_share)
+        widx = (route_fn(idx, tokens) if route_fn is not None
+                else idx % max(len(engines), 1))
+        eng = engines[widx % len(engines)]
+        req = PreprocessedRequest(
+            request_id=f"lg-{idx}", model="loadgen", token_ids=tokens,
+            stop=StopConditions(max_tokens=item.osl, min_tokens=item.osl,
+                                ignore_eos=True),
+            sampling=SamplingOptions(temperature=0.0),
+        )
+        t0 = eng.sim_time
+        t_prev: Optional[float] = None
+        async for out in eng.generate(req, Context()):
+            if not out.token_ids:
+                continue
+            ts = out.annotations.get("sim_ts", eng.sim_time)
+            if t_prev is None:
+                ttfts.append(ts - t0)
+                cached[0] += out.annotations.get("cached_tokens", 0)
+                inputs[0] += out.annotations.get("input_tokens", 0)
+            else:
+                itls.append(ts - t_prev)
+            t_prev = ts
+
+    t_prev_arrival = 0.0
+    for idx, item in enumerate(trace):
+        dt = (item.t - t_prev_arrival) / speedup
+        t_prev_arrival = item.t
+        if dt > 0:
+            await asyncio.sleep(dt)
+        if on_arrival is not None:
+            on_arrival(item)
+        tasks.append(asyncio.create_task(one(idx, item)))
+    await asyncio.gather(*tasks)
+    return SlaReport(
+        completed=len(trace),
+        ttft_attainment=(
+            sum(1 for x in ttfts if x <= ttft_target_s) / max(len(ttfts), 1)
+        ),
+        itl_attainment=(
+            sum(1 for x in itls if x <= itl_target_s) / max(len(itls), 1)
+        ),
+        ttft_p95_s=pct(ttfts, 0.95),
+        itl_p95_s=pct(itls, 0.95),
+        cache_hit_ratio=cached[0] / max(inputs[0], 1),
+        sim_busy_s=sum(e.sim_time for e in engines),
+    )
+
+
+# --------------------------------------------------------------------------
+# planner-in-the-loop simulation
+# --------------------------------------------------------------------------
+
+
+class FleetConnector:
+    """Planner connector that resizes an in-process mocker fleet."""
+
+    def __init__(self, engines: List[MockerEngine], make_engine: Callable[[], MockerEngine]):
+        self.engines = engines
+        self.make_engine = make_engine
+        self.drain_tasks: List[asyncio.Task] = []
+
+    async def get_replicas(self, component: str) -> int:
+        return len(self.engines)
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        while len(self.engines) < n:
+            self.engines.append(self.make_engine())
+        while len(self.engines) > n > 0:
+            # drain, don't kill: popping stops new routing immediately; the
+            # engine is stopped once its in-flight requests finish
+            self.drain_tasks.append(
+                asyncio.create_task(self._drain_stop(self.engines.pop()))
+            )
+
+    @staticmethod
+    async def _drain_stop(engine: MockerEngine) -> None:
+        while True:
+            s = engine.snapshot()
+            if not s["waiting"] and not s["running"]:
+                break
+            await asyncio.sleep(0.05)
+        engine.stop()
+
+
+@dataclasses.dataclass
+class PlannerSimResult:
+    report: SlaReport
+    replica_timeline: List[int]        # fleet size per planner tick
+    correction_timeline: List[float]   # correction factor per tick
+
+
+async def planner_sim(
+    trace: Sequence[TraceItem],
+    planner_factory: Callable[[FleetConnector], PoolPlanner],
+    engine_args: Optional[MockEngineArgs] = None,
+    initial_replicas: int = 1,
+    tick_s: float = 0.25,
+    speedup: float = 20.0,
+    ttft_target_s: float = 0.5,
+    itl_target_s: float = 0.05,
+    prefix_share: float = 0.3,
+) -> PlannerSimResult:
+    """Closed loop: replay ``trace`` while a real PoolPlanner observes fleet
+    snapshots every ``tick_s`` wall-seconds and resizes the fleet through a
+    FleetConnector. Returns the SLA report plus the replica/correction
+    timelines for convergence assertions."""
+    args = engine_args or MockEngineArgs(
+        emit_sim_ts=True, speedup_ratio=speedup, num_blocks=512,
+    )
+
+    def make_engine() -> MockerEngine:
+        return MockerEngine(dataclasses.replace(args))
+
+    engines = [make_engine() for _ in range(initial_replicas)]
+    conn = FleetConnector(engines, make_engine)
+    planner = planner_factory(conn)
+
+    arrivals: List[float] = []   # wall-clock arrival stamps (for rate calc)
+    isls: List[int] = []
+    replica_timeline: List[int] = []
+    correction_timeline: List[float] = []
+    loop = asyncio.get_event_loop()
+
+    def on_arrival(item: TraceItem) -> None:
+        arrivals.append(loop.time())
+        isls.append(item.isl)
+
+    rr = [0]
+
+    def route(idx: int, tokens: List[int]) -> int:
+        rr[0] = (rr[0] + 1) % max(len(engines), 1)
+        return rr[0]
+
+    stop = asyncio.Event()
+
+    async def planner_loop() -> None:
+        window_start = loop.time()
+        seen = 0
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), tick_s)
+            except asyncio.TimeoutError:
+                pass
+            now = loop.time()
+            new = arrivals[seen:]
+            seen = len(arrivals)
+            window = max(now - window_start, 1e-6)
+            window_start = now
+            # rates are in SIMULATED seconds (wall * speedup)
+            rate = len(new) / (window * speedup)
+            snaps = [e.snapshot() for e in engines]
+            snapshot = LoadSnapshot(
+                request_rate=rate,
+                avg_isl=(sum(isls) / len(isls)) if isls else 0.0,
+                num_waiting=sum(s["waiting"] for s in snaps),
+                active_seqs=sum(s["running"] for s in snaps),
+            )
+            planner.observe(rate)
+            try:
+                await planner.plan_and_apply(snapshot)
+            except Exception:
+                log.exception("planner tick failed")
+            replica_timeline.append(len(engines))
+            correction_timeline.append(getattr(planner, "correction", 1.0))
+
+    ptask = asyncio.create_task(planner_loop())
+    try:
+        report = await replay(
+            trace, engines, ttft_target_s, itl_target_s,
+            prefix_share=prefix_share, speedup=speedup,
+            route_fn=route, on_arrival=on_arrival,
+        )
+    finally:
+        stop.set()
+        await ptask
+        if conn.drain_tasks:
+            await asyncio.gather(*conn.drain_tasks, return_exceptions=True)
+        for e in engines:
+            e.stop()
+    return PlannerSimResult(report, replica_timeline, correction_timeline)
