@@ -12,6 +12,7 @@ Model selection:
 
 import argparse
 import asyncio
+import os
 import signal
 
 from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
@@ -100,6 +101,12 @@ def parse_args():
     p.add_argument("--lora-rank", type=int, default=16)
     p.add_argument("--no-warm-cache", action="store_true",
                    help="disable the host weight cache (engine/warm.py)")
+    p.add_argument("--weight-service", default=None, metavar="SOCK",
+                   help="unix socket of a weight owner process "
+                        "(engine/weight_service.py; reference "
+                        "lib/gpu_memory_service): import weights from host "
+                        "shared memory instead of parsing the checkpoint; "
+                        "also honors $DTPU_WEIGHT_SERVICE")
     p.add_argument("--logits-processors", default=None,
                    help="named example processors to register, e.g. "
                         "'ban=5,7,9;temperature=0.7;norepeat=2.0' — requests "
@@ -202,7 +209,21 @@ def _load_model(args):
 
         path = resolve_model_path(args.model_path)
         mcfg = config_from_hf(path)
-        if args.no_warm_cache:
+        ws_sock = getattr(args, "weight_service", None) or os.environ.get(
+            "DTPU_WEIGHT_SERVICE"
+        )
+        if ws_sock:
+            # out-of-process weight import (engine/weight_service.py,
+            # gpu_memory_service analog): zero-copy mmap from the owner's
+            # tmpfs; the client connection is the lease — parked on args so
+            # it lives as long as the process
+            from dynamo_tpu.engine.weight_service import load_params_served
+
+            params, args._weight_lease = load_params_served(
+                path, mcfg, ws_sock,
+                warm_fallback=not args.no_warm_cache,
+            )
+        elif args.no_warm_cache:
             params = load_params(path, mcfg)
         else:
             # warm restore (engine/warm.py): restarted workers skip the

@@ -92,26 +92,36 @@ class WarmWeightCache:
     def load(self, source: str, cfg: Any) -> Optional[Dict[str, Any]]:
         """mmap every tensor and rebuild the pytree (host arrays; the engine
         device_puts them with its shardings). None on miss/corruption."""
-        import jax.numpy as jnp
-
         d = self._dir(_fingerprint(source, cfg))
-        mpath = os.path.join(d, "MANIFEST.json")
-        if not os.path.exists(mpath):
-            return None
+        if not os.path.exists(os.path.join(d, "MANIFEST.json")):
+            return None  # plain miss
         try:
-            with open(mpath) as f:
-                manifest = json.load(f)
-            flat: Dict[str, Any] = {}
-            for t in manifest["tensors"]:
-                arr = np.load(os.path.join(d, t["file"]), mmap_mode="r",
-                              allow_pickle=False)
-                if t["dtype"] == "bfloat16":
-                    arr = np.asarray(arr).view(jnp.bfloat16.dtype)
-                flat[t["name"]] = arr
-            return _unflatten(flat)
+            return load_manifest_dir(d)
         except Exception:
+            # manifest present but tensors unreadable (partial cleanup,
+            # tmpfs pressure): that's corruption, not a miss — say so
             log.exception("warm cache at %s unreadable; falling back to source", d)
             return None
+
+
+def load_manifest_dir(d: str) -> Dict[str, Any]:
+    """mmap every tensor of one manifest directory (the warm-cache / weight-
+    service on-disk format) and rebuild the param pytree. Zero-copy: arrays
+    are views over the mapped files, so a tmpfs-resident directory is a
+    shared-memory import."""
+    import jax.numpy as jnp
+
+    with open(os.path.join(d, "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    flat: Dict[str, Any] = {}
+    for t in manifest["tensors"]:
+        arr = np.load(os.path.join(d, t["file"]), mmap_mode="r",
+                      allow_pickle=False)
+        if t["dtype"] == "bfloat16":
+            # view, not copy: reinterpret the mmap'd uint16 buffer
+            arr = arr.view(jnp.bfloat16.dtype)
+        flat[t["name"]] = arr
+    return _unflatten(flat)
 
 
 def _flatten(params: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:

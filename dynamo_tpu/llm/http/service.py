@@ -325,55 +325,135 @@ class HttpService:
 
         return gen()
 
+    @staticmethod
+    def _fan_choices(preq: PreprocessedRequest, n: int) -> list:
+        """One PreprocessedRequest per choice. Each choice is an independent
+        engine stream with its own request id (routing/migration track per
+        stream); a set seed is offset per choice so choices actually differ
+        (same-seed fan-out would sample n identical completions)."""
+        if n <= 1:
+            return [preq]
+        import copy
+
+        preqs = []
+        for i in range(n):
+            p = copy.deepcopy(preq)
+            p.request_id = f"{preq.request_id}-c{i}"
+            if p.sampling.seed is not None:
+                p.sampling.seed += i
+            preqs.append(p)
+        return preqs
+
+    @staticmethod
+    async def _merged(streams):
+        """Interleave n token streams as (stream_index, output) pairs in
+        arrival order. One failing stream fails the merge (the caller's
+        error path kills the surviving contexts)."""
+        q: asyncio.Queue = asyncio.Queue()
+        _DONE = object()
+
+        async def pump(i, s):
+            try:
+                async for out in s:
+                    await q.put((i, out, None))
+            except BaseException as e:  # noqa: BLE001 — relayed, not dropped
+                await q.put((i, _DONE, e))
+            else:
+                await q.put((i, _DONE, None))
+
+        tasks = [asyncio.create_task(pump(i, s)) for i, s in enumerate(streams)]
+        done = 0
+        try:
+            while done < len(streams):
+                i, out, err = await q.get()
+                if out is _DONE:
+                    if err is not None:
+                        raise err
+                    done += 1
+                    continue
+                yield i, out
+        finally:
+            for t in tasks:
+                t.cancel()
+
     async def _run(
         self,
         request: web.Request,
-        preq: PreprocessedRequest,
+        preqs,
         pipeline: ModelPipeline,
         model: str,
         stream_mode: bool,
-        delta_gen,
+        delta_gens,
         aggregator,
         audit_handle=None,
+        usage_chunk_factory=None,
     ) -> web.StreamResponse:
-        """Execute one generation request: routing, streaming, metrics, errors."""
-        ctx = Context(preq.request_id)
+        """Execute one generation request: routing, streaming, metrics, errors.
+
+        ``preqs``/``delta_gens`` are parallel lists, one entry per choice
+        (n>1 requests fan into n engine streams; reference delta.rs/jail.rs
+        hold per-choice state). ``aggregator`` receives the list of streams.
+        ``usage_chunk_factory`` builds the single trailing usage chunk for
+        multi-choice streaming (single-choice generators emit their own)."""
+        ctxs = [Context(p.request_id) for p in preqs]
         self.inflight += 1
         self._inflight_g.set(self.inflight)
         status = "200"
         resp: Optional[web.StreamResponse] = None
         prompt_tokens = completion_tokens = 0
+        rid = preqs[0].request_id
         # span parents on the client's traceparent header when present;
         # downstream hops (request plane -> worker) get it via annotations
         span = self.tracer.span(
             "http.generate",
             traceparent=request.headers.get("traceparent"),
-            request_id=preq.request_id, model=model, streaming=stream_mode,
+            request_id=rid, model=model, streaming=stream_mode,
+            n=len(preqs),
         )
-        preq.annotations["traceparent"] = span.traceparent()
+        for p in preqs:
+            p.annotations["traceparent"] = span.traceparent()
         span.__enter__()
         try:
-            stream = self._observed(
-                pipeline.generate_tokens(preq, ctx), model, time.monotonic(),
-                prompt_tokens=len(preq.token_ids),
-            )
+            t0 = time.monotonic()
+            streams = [
+                self._observed(
+                    pipeline.generate_tokens(p, c), model, t0,
+                    prompt_tokens=len(p.token_ids),
+                )
+                for p, c in zip(preqs, ctxs)
+            ]
             if stream_mode:
                 resp = web.StreamResponse(headers=SSE_HEADERS)
                 await resp.prepare(request)
                 try:
-                    async for out in stream:
-                        for chunk in delta_gen.on_output(out):
-                            await resp.write(
-                                f"data: {chunk.model_dump_json(exclude_none=True)}\n\n".encode()
-                            )
+                    if len(streams) == 1:
+                        # hot path: no queue hop per token
+                        async for out in streams[0]:
+                            for chunk in delta_gens[0].on_output(out):
+                                await resp.write(
+                                    f"data: {chunk.model_dump_json(exclude_none=True)}\n\n".encode()
+                                )
+                    else:
+                        async for i, out in self._merged(streams):
+                            for chunk in delta_gens[i].on_output(out):
+                                await resp.write(
+                                    f"data: {chunk.model_dump_json(exclude_none=True)}\n\n".encode()
+                                )
+                        if usage_chunk_factory is not None:
+                            chunk = usage_chunk_factory()
+                            if chunk is not None:
+                                await resp.write(
+                                    f"data: {chunk.model_dump_json(exclude_none=True)}\n\n".encode()
+                                )
                     await resp.write(b"data: [DONE]\n\n")
                     await resp.write_eof()
                 except _DISCONNECT:
                     status = "499"
-                    ctx.kill()
+                    for c in ctxs:
+                        c.kill()
                 finally:
-                    prompt_tokens = delta_gen.prompt_tokens
-                    completion_tokens = delta_gen.completion_tokens
+                    prompt_tokens = max(g.prompt_tokens for g in delta_gens)
+                    completion_tokens = sum(g.completion_tokens for g in delta_gens)
                     if audit_handle is not None:
                         audit_handle.set_response({
                             "streamed": True,
@@ -381,7 +461,7 @@ class HttpService:
                             "prompt_tokens": prompt_tokens,
                         })
                 return resp
-            result = await aggregator(stream)
+            result = await aggregator(streams)
             usage = result.usage
             if usage is not None:
                 prompt_tokens, completion_tokens = usage.prompt_tokens, usage.completion_tokens
@@ -393,10 +473,11 @@ class HttpService:
             return await self._fail(resp, 503, "no workers available", "service_unavailable")
         except asyncio.CancelledError:
             status = "499"
-            ctx.kill()
+            for c in ctxs:
+                c.kill()
             raise
         except Exception as e:
-            log.exception("request %s failed", preq.request_id[:16])
+            log.exception("request %s failed", rid[:16])
             status = "500"
             return await self._fail(resp, 500, str(e), "internal_error")
         finally:
@@ -405,7 +486,8 @@ class HttpService:
             self._requests.inc(model=model, status=status)
             self._input_tokens.inc(prompt_tokens, model=model)
             self._output_tokens.inc(completion_tokens, model=model)
-            ctx.stop_generating()
+            for c in ctxs:
+                c.stop_generating()
             span.set(status=status, completion_tokens=completion_tokens)
             if status not in ("200", "499"):
                 # the handler converts errors to responses before the span
@@ -456,26 +538,55 @@ class HttpService:
 
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
         card = pipeline.card
-        gen = ChatDeltaGenerator(
-            preq.request_id, req.model, include_usage,
-            reasoning_parser=_safe_parser(get_reasoning_parser, card.reasoning_parser),
-            tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
-            tool_choice=req.tool_choice,
-        )
-        audit_handle = self.audit.create_handle(
-            body, preq.request_id, req.model, req.stream
-        )
-        return await self._run(
-            request, preq, pipeline, req.model, req.stream, gen,
-            lambda s: aggregate_chat(
-                preq.request_id, req.model, s,
-                reasoning_parser=_safe_parser(
-                    get_reasoning_parser, card.reasoning_parser
-                ),
-                tool_parser=_safe_parser(get_tool_parser, card.tool_parser),
+        rid = preq.request_id
+        preqs = self._fan_choices(preq, req.n)
+        # parsers are stateful stream machines: one instance per choice
+        reasoning_factory = lambda: _safe_parser(get_reasoning_parser, card.reasoning_parser)  # noqa: E731
+        tool_factory = lambda: _safe_parser(get_tool_parser, card.tool_parser)  # noqa: E731
+        gens = [
+            ChatDeltaGenerator(
+                rid, req.model,
+                # multi-choice: one merged usage chunk at stream end instead
+                # of one per choice
+                include_usage and len(preqs) == 1,
+                reasoning_parser=reasoning_factory(),
+                tool_parser=tool_factory(),
                 tool_choice=req.tool_choice,
-            ),
+                index=i,
+            )
+            for i in range(len(preqs))
+        ]
+        usage_chunk_factory = None
+        if include_usage and len(preqs) > 1:
+            from ..protocols.delta import merge_usage
+            from ..protocols.openai import ChatCompletionChunk
+
+            usage_chunk_factory = lambda: ChatCompletionChunk(  # noqa: E731
+                id=rid, created=gens[0].created, model=req.model, choices=[],
+                usage=merge_usage(gens),
+            )
+        if len(preqs) == 1:
+            aggregator = lambda ss: aggregate_chat(  # noqa: E731
+                rid, req.model, ss[0],
+                reasoning_parser=reasoning_factory(),
+                tool_parser=tool_factory(),
+                tool_choice=req.tool_choice,
+            )
+        else:
+            from ..protocols.delta import aggregate_chat_multi
+
+            aggregator = lambda ss: aggregate_chat_multi(  # noqa: E731
+                rid, req.model, ss,
+                reasoning_parser_factory=reasoning_factory,
+                tool_parser_factory=tool_factory,
+                tool_choice=req.tool_choice,
+            )
+        audit_handle = self.audit.create_handle(body, rid, req.model, req.stream)
+        return await self._run(
+            request, preqs, pipeline, req.model, req.stream, gens,
+            aggregator,
             audit_handle=audit_handle,
+            usage_chunk_factory=usage_chunk_factory,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -729,12 +840,40 @@ class HttpService:
             return _error(400, str(e), "context_length_exceeded")
 
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
-        gen = CompletionDeltaGenerator(preq.request_id, req.model, include_usage)
+        rid = preq.request_id
+        preqs = self._fan_choices(preq, req.n)
         echo_text = prompt if (req.echo and isinstance(prompt, str)) else ""
+        gens = [
+            CompletionDeltaGenerator(
+                rid, req.model, include_usage and len(preqs) == 1,
+                text_offset=len(echo_text), index=i,
+            )
+            for i in range(len(preqs))
+        ]
+        usage_chunk_factory = None
+        if include_usage and len(preqs) > 1:
+            from ..protocols.delta import merge_usage
+            from ..protocols.openai import CompletionResponse
+
+            usage_chunk_factory = lambda: CompletionResponse(  # noqa: E731
+                id=rid, created=gens[0].created, model=req.model, choices=[],
+                usage=merge_usage(gens),
+            )
+        if len(preqs) == 1:
+            aggregator = lambda ss: aggregate_completion(  # noqa: E731
+                rid, req.model, ss[0], echo_text
+            )
+        else:
+            from ..protocols.delta import aggregate_completion_multi
+
+            aggregator = lambda ss: aggregate_completion_multi(  # noqa: E731
+                rid, req.model, ss, echo_text
+            )
         return await self._run(
-            request, preq, pipeline, req.model, req.stream, gen,
-            lambda s: aggregate_completion(preq.request_id, req.model, s, echo_text),
+            request, preqs, pipeline, req.model, req.stream, gens,
+            aggregator,
+            usage_chunk_factory=usage_chunk_factory,
             audit_handle=self.audit.create_handle(
-                body, preq.request_id, req.model, req.stream
+                body, rid, req.model, req.stream
             ),
         )

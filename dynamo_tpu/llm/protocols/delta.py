@@ -32,9 +32,11 @@ class ChatDeltaGenerator:
         reasoning_parser=None,
         tool_parser=None,
         tool_choice=None,
+        index: int = 0,
     ):
         self.id = request_id
         self.model = model
+        self.index = index
         self.created = now_ts()
         self.include_usage = include_usage
         self.prompt_tokens = 0
@@ -76,7 +78,8 @@ class ChatDeltaGenerator:
             model=self.model,
             choices=[
                 ChatChunkChoice(
-                    index=0, delta=delta, finish_reason=finish, logprobs=logprobs
+                    index=self.index, delta=delta, finish_reason=finish,
+                    logprobs=logprobs,
                 )
             ],
         )
@@ -230,12 +233,13 @@ async def aggregate_chat(
     reasoning_parser=None,
     tool_parser=None,
     tool_choice=None,
+    index: int = 0,
 ) -> ChatCompletionResponse:
     """Non-streaming mode: fold the whole stream into one response."""
     gen = ChatDeltaGenerator(
         request_id, model,
         reasoning_parser=reasoning_parser, tool_parser=tool_parser,
-        tool_choice=tool_choice,
+        tool_choice=tool_choice, index=index,
     )
     text_parts = []
     reasoning_parts = []
@@ -261,7 +265,7 @@ async def aggregate_chat(
         model=model,
         choices=[
             ChatChoice(
-                index=0,
+                index=index,
                 message=ChatResponseMessage(
                     content="".join(text_parts),
                     reasoning_content="".join(reasoning_parts) or None,
@@ -287,9 +291,11 @@ class CompletionDeltaGenerator:
         model: str,
         include_usage: bool = False,
         text_offset: int = 0,
+        index: int = 0,
     ):
         self.id = request_id
         self.model = model
+        self.index = index
         self.created = now_ts()
         self.include_usage = include_usage
         self.prompt_tokens = 0
@@ -335,7 +341,7 @@ class CompletionDeltaGenerator:
             resp = CompletionResponse(
                 id=self.id, created=self.created, model=self.model,
                 choices=[CompletionChoice(
-                    index=0, text=text, finish_reason=out.finish_reason,
+                    index=self.index, text=text, finish_reason=out.finish_reason,
                     logprobs=self._completion_logprobs(entries, text),
                 )],
             )
@@ -360,9 +366,12 @@ class CompletionDeltaGenerator:
 
 
 async def aggregate_completion(
-    request_id: str, model: str, stream: AsyncIterator[BackendOutput], echo_text: str = ""
+    request_id: str, model: str, stream: AsyncIterator[BackendOutput],
+    echo_text: str = "", index: int = 0,
 ) -> CompletionResponse:
-    gen = CompletionDeltaGenerator(request_id, model, text_offset=len(echo_text))
+    gen = CompletionDeltaGenerator(
+        request_id, model, text_offset=len(echo_text), index=index
+    )
     parts = [echo_text] if echo_text else []
     finish = None
     logprobs: Optional[dict] = None
@@ -383,8 +392,98 @@ async def aggregate_completion(
         created=gen.created,
         model=model,
         choices=[CompletionChoice(
-            index=0, text="".join(parts), finish_reason=finish or "stop",
+            index=index, text="".join(parts), finish_reason=finish or "stop",
             logprobs=logprobs,
         )],
         usage=gen.usage(),
+    )
+
+
+# -- multi-choice (n > 1) ----------------------------------------------------
+# The reference's delta generator and jail operate per-choice
+# (lib/llm/src/protocols/openai/chat_completions/{delta,jail}.rs): each choice
+# is an independent engine stream with its own parser/jail state, re-indexed
+# into one response. Same here: callers fan one request into n streams and
+# these helpers fold them back together.
+
+
+def merge_usage(gens) -> Usage:
+    """One Usage covering all choices: the prompt is billed once, completion
+    tokens sum across choices (OpenAI semantics for n>1)."""
+    prompt = max((g.prompt_tokens for g in gens), default=0)
+    cached = next((g.cached_tokens for g in gens if g.cached_tokens is not None), None)
+    completion = sum(g.completion_tokens for g in gens)
+    return Usage(
+        prompt_tokens=prompt,
+        completion_tokens=completion,
+        total_tokens=prompt + completion,
+        cached_tokens=cached,
+    )
+
+
+async def aggregate_chat_multi(
+    request_id: str,
+    model: str,
+    streams,
+    reasoning_parser_factory=None,
+    tool_parser_factory=None,
+    tool_choice=None,
+) -> ChatCompletionResponse:
+    """Aggregate n independent streams into one multi-choice response.
+
+    Parser *factories* (not instances): streaming parsers are stateful, so
+    every choice needs its own."""
+    import asyncio
+
+    results = await asyncio.gather(*[
+        aggregate_chat(
+            request_id, model, s,
+            reasoning_parser=reasoning_parser_factory() if reasoning_parser_factory else None,
+            tool_parser=tool_parser_factory() if tool_parser_factory else None,
+            tool_choice=tool_choice,
+            index=i,
+        )
+        for i, s in enumerate(streams)
+    ])
+    base = results[0]
+    prompt = max(r.usage.prompt_tokens for r in results if r.usage)
+    completion = sum(r.usage.completion_tokens for r in results if r.usage)
+    cached = next(
+        (r.usage.cached_tokens for r in results
+         if r.usage and r.usage.cached_tokens is not None),
+        None,
+    )
+    return ChatCompletionResponse(
+        id=request_id,
+        created=base.created,
+        model=model,
+        choices=[r.choices[0] for r in results],
+        usage=Usage(
+            prompt_tokens=prompt, completion_tokens=completion,
+            total_tokens=prompt + completion, cached_tokens=cached,
+        ),
+    )
+
+
+async def aggregate_completion_multi(
+    request_id: str, model: str, streams, echo_text: str = ""
+) -> CompletionResponse:
+    import asyncio
+
+    results = await asyncio.gather(*[
+        aggregate_completion(request_id, model, s, echo_text, index=i)
+        for i, s in enumerate(streams)
+    ])
+    base = results[0]
+    prompt = max(r.usage.prompt_tokens for r in results if r.usage)
+    completion = sum(r.usage.completion_tokens for r in results if r.usage)
+    return CompletionResponse(
+        id=request_id,
+        created=base.created,
+        model=model,
+        choices=[r.choices[0] for r in results],
+        usage=Usage(
+            prompt_tokens=prompt, completion_tokens=completion,
+            total_tokens=prompt + completion,
+        ),
     )

@@ -59,7 +59,9 @@ class SamplingFields(_Lenient):
     frequency_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
     presence_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
     repetition_penalty: Optional[float] = Field(default=None, gt=0.0)
-    n: int = Field(default=1, ge=1, le=1)  # n>1 unsupported (one stream per request)
+    # n>1 fans the request into n independent engine streams with per-choice
+    # delta/jail state (reference delta.rs/jail.rs are per-choice)
+    n: int = Field(default=1, ge=1, le=16)
     logprobs: Optional[Union[bool, int]] = None
     top_logprobs: Optional[int] = Field(default=None, ge=0, le=20)
     ignore_eos: Optional[bool] = None  # extension, matches reference nvext

@@ -40,7 +40,7 @@ KV_TILE = 256
 
 
 def _prefill_kernel(
-    qpos_ref,    # SMEM [S] int32 absolute q positions (scalar prefetch)
+    start_ref,   # SMEM [1] int32 absolute position of q row 0 (scalar prefetch)
     tlen_ref,    # SMEM [1] int32 valid context length (scalar prefetch)
     q_ref,       # VMEM [1, TQ, g, d] this (kv_head, q_tile)'s queries
     k_ref,       # VMEM [1, KT, d] one KV tile of this kv_head's context
@@ -50,11 +50,17 @@ def _prefill_kernel(
     l_scr,       # VMEM [TQ*g, 1] f32 running denominator
     acc_scr,     # VMEM [TQ*g, d] f32 running numerator
 ):
+    # Mosaic only loads SCALARS from SMEM, so q positions can't arrive as a
+    # prefetched vector; they're derived from start_ref + the row iota
+    # instead (engine chunks are contiguous — _chunk_arrays). Both the
+    # per-row mask and the tile-skip bound are then scalar-rooted.
     qt = pl.program_id(1)
     c = pl.program_id(2)
     n_kv = pl.num_programs(2)
     _, TQ, g, d = q_ref.shape
     KT = k_ref.shape[1]
+    start = start_ref[0]
+    tlen = tlen_ref[0]
 
     @pl.when(c == 0)
     def _init():
@@ -62,16 +68,17 @@ def _prefill_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # per-row attention limit: keys at index < min(q_pos+1, total_len)
-    row_pos = qpos_ref[pl.ds(qt * TQ, TQ)]                     # [TQ]
-    limit = jnp.minimum(row_pos + 1, tlen_ref[0])              # [TQ]
-    tile_hi = jnp.max(limit)                                   # scalar
+    # per-row attention limit: keys at index < min(q_pos+1, total_len);
+    # rows past the real chunk clamp to tlen (their output is discarded)
+    tile_hi = jnp.minimum(start + (qt + 1) * TQ, tlen)         # scalar
 
     @pl.when(c * KT < tile_hi)
     def _tile():
         scale = 1.0 / (d ** 0.5)
         q2 = (q_ref[0].astype(jnp.float32) * scale).reshape(TQ * g, d)
-        lim2 = jnp.broadcast_to(limit[:, None], (TQ, g)).reshape(TQ * g, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (TQ, g), 0)  # row idx per (q, g)
+        pos = start + qt * TQ + row
+        lim2 = jnp.minimum(pos + 1, tlen).reshape(TQ * g, 1)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -113,8 +120,11 @@ def flash_extend_attention(
     kv_tile: int = KV_TILE,
     interpret: bool = False,
 ) -> jax.Array:
-    """Same semantics as ``ops.attention.extend_attention``; S and T must be
-    multiples of the tile sizes (the engine's bucketed chunks are)."""
+    """Same semantics as ``ops.attention.extend_attention`` for CONTIGUOUS
+    q_positions (the engine's chunks are: row i sits at q_positions[0]+i;
+    padded tail rows may carry arbitrary positions — their output is
+    discarded by the caller). S and T must be multiples of the tile sizes
+    (the engine's bucketed chunks are)."""
     S, h, d = q.shape
     T, kvh, _ = k_ctx.shape
     g = h // kvh
@@ -153,7 +163,7 @@ def flash_extend_attention(
         out_shape=jax.ShapeDtypeStruct((kvh, S, g, d), q.dtype),
         interpret=interpret,
     )(
-        q_positions.astype(jnp.int32),
+        q_positions[:1].astype(jnp.int32),  # chunk start (row 0's position)
         jnp.asarray(total_len, jnp.int32).reshape(1),
         qg, kg, vg,
     )

@@ -213,15 +213,47 @@ class EtcdKVStore(KVStore):
             self.endpoint + "/v3/watch", json=body,
             timeout=aiohttp.ClientTimeout(total=None),
         ) as r:
-            buf = b""
+            # Frame-robust parse: the gRPC-gateway usually emits one JSON
+            # object per line, but nothing in HTTP chunking guarantees a
+            # frame boundary per read — an object can arrive split across
+            # iter_any() chunks or concatenated with the next one on one
+            # line. raw_decode consumes complete objects wherever they end;
+            # an incomplete tail just waits for more bytes. The incremental
+            # UTF-8 decoder keeps a multi-byte codepoint split across chunks
+            # from blowing up the str conversion.
+            import codecs
+
+            udec = codecs.getincrementaldecoder("utf-8")()
+            jdec = json.JSONDecoder()
+            # an unparsed tail larger than any sane watch frame means the
+            # body is garbage (proxy error page, corrupted stream), not a
+            # split frame — raise so the watch loop reconnects instead of
+            # buffering forever in silence
+            max_frame = 8 * 1024 * 1024
+            text = ""
             async for chunk in r.content.iter_any():
-                buf += chunk
-                # the gateway emits newline-delimited JSON objects
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
-                    if not line.strip():
-                        continue
-                    msg = json.loads(line)
+                text += udec.decode(chunk)
+                idx = 0
+                while True:
+                    while idx < len(text) and text[idx] in " \t\r\n":
+                        idx += 1
+                    if idx >= len(text):
+                        break
+                    try:
+                        msg, idx = jdec.raw_decode(text, idx)
+                    except json.JSONDecodeError:
+                        if text[idx] not in "{[":
+                            # can't be the start of a gateway frame: garbage
+                            # (e.g. a proxy's HTML error page) — reconnect
+                            raise ValueError(
+                                f"non-JSON watch data: {text[idx:idx + 80]!r}"
+                            )
+                        if len(text) - idx > max_frame:
+                            raise ValueError(
+                                f"unparseable watch frame ({len(text) - idx} "
+                                "buffered bytes with no JSON object)"
+                            )
+                        break  # incomplete object: need more bytes
                     result = msg.get("result", msg)
                     for ev in result.get("events") or []:
                         kind = (
@@ -238,6 +270,7 @@ class EtcdKVStore(KVStore):
                         mod = int(kv.get("mod_revision", 0) or 0)
                         next_rev = max(next_rev, mod + 1)
                         watcher._emit(WatchEvent(kind, key, val))
+                text = text[idx:]
         return next_rev
 
     async def close(self) -> None:

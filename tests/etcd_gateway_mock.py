@@ -28,7 +28,10 @@ def _b64(b: bytes) -> str:
 
 
 class MockEtcdGateway:
-    def __init__(self):
+    def __init__(self, fragment_frames: bool = False):
+        # fragment_frames: emit watch responses as torn, newline-free chunks
+        # (tests the client's frame-reassembly, VERDICT r4 #10)
+        self.fragment_frames = fragment_frames
         self.kv: Dict[bytes, Tuple[bytes, Optional[int]]] = {}  # key -> (val, lease)
         self.leases: Dict[int, Tuple[float, float]] = {}  # id -> (deadline, ttl)
         self.revision = 1
@@ -168,8 +171,19 @@ class MockEtcdGateway:
         try:
             while True:
                 ev = await q.get()
-                line = json.dumps({"result": {"events": [ev]}}) + "\n"
-                await resp.write(line.encode())
+                line = json.dumps({"result": {"events": [ev]}})
+                if self.fragment_frames:
+                    # pathological HTTP chunking: no newline framing, each
+                    # object torn into byte-level chunks and glued to the
+                    # next — what a proxy or TCP segmentation may legally do
+                    data = line.encode()
+                    cut = max(1, len(data) // 3)
+                    for piece in (data[:cut], data[cut:2 * cut], data[2 * cut:]):
+                        if piece:
+                            await resp.write(piece)
+                            await asyncio.sleep(0)
+                else:
+                    await resp.write((line + "\n").encode())
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         finally:

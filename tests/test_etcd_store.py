@@ -127,3 +127,73 @@ async def test_serves_through_etcd_discovery():
         await rt.shutdown()
         await store.close()  # runtime does not own an injected store
         await gw.stop()
+
+
+async def test_watch_survives_fragmented_frames():
+    """The gateway's newline framing is a convention, not a guarantee: HTTP
+    chunking may tear one JSON object across reads or glue objects without
+    newlines. The client must reassemble (VERDICT r4 #10)."""
+    gw = MockEtcdGateway(fragment_frames=True)
+    url = await gw.start()
+    store = EtcdKVStore(url)
+    try:
+        watcher = await store.watch("v1/f/")
+        await asyncio.sleep(0.1)
+        for i in range(5):
+            await store.put(f"v1/f/{i}", str(i).encode())
+        got = []
+        for _ in range(5):
+            ev = await asyncio.wait_for(watcher.__anext__(), 5)
+            got.append((ev.key, ev.value))
+        assert got == [(f"v1/f/{i}", str(i).encode()) for i in range(5)]
+        watcher.cancel()
+    finally:
+        await store.close()
+        await gw.stop()
+
+
+# -- opt-in: the same contract against a REAL etcd ---------------------------
+# The mock above was written from the same spec as the client, so a spec
+# misreading would pass both. Set ETCD_URL (e.g. http://127.0.0.1:2379) to
+# prove the contract against a real server; skipped when absent (this image
+# ships no etcd binary). Mirrors the reference's etcd-gated test fixtures
+# (tests/conftest.py spawning real etcd, lib/runtime/src/storage/kv/etcd.rs).
+import os  # noqa: E402
+
+import pytest  # noqa: E402
+
+ETCD_URL = os.environ.get("ETCD_URL")
+
+
+@pytest.mark.skipif(not ETCD_URL, reason="ETCD_URL not set (no real etcd)")
+async def test_real_etcd_full_contract():
+    store = EtcdKVStore(ETCD_URL)
+    pfx = f"dtpu-test/{os.getpid()}/"
+    try:
+        # kv + prefix
+        await store.put(pfx + "a/x", b"1")
+        await store.put(pfx + "a/y", b"2")
+        assert await store.get(pfx + "a/x") == b"1"
+        got = await store.list_prefix(pfx + "a/")
+        assert got == {pfx + "a/x": b"1", pfx + "a/y": b"2"}
+        # lease lifecycle: grant, keepalive, revoke deletes keys
+        lease = await store.create_lease(ttl_s=5.0)
+        await store.put(pfx + "inst/1", b"alive", lease_id=lease.id)
+        assert await store.keep_alive(lease.id) is True
+        await store.revoke_lease(lease.id)
+        assert await store.get(pfx + "inst/1") is None
+        # snapshot-then-stream watch
+        watcher = await store.watch(pfx + "w/")
+        await asyncio.sleep(0.2)
+        await store.put(pfx + "w/k", b"v")
+        ev = await asyncio.wait_for(watcher.__anext__(), 10)
+        assert (ev.type, ev.key, ev.value) == (EventType.PUT, pfx + "w/k", b"v")
+        await store.delete(pfx + "w/k")
+        ev = await asyncio.wait_for(watcher.__anext__(), 10)
+        assert (ev.type, ev.key) == (EventType.DELETE, pfx + "w/k")
+        watcher.cancel()
+        # cleanup
+        for k in list((await store.list_prefix(pfx)).keys()):
+            await store.delete(k)
+    finally:
+        await store.close()

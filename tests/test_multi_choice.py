@@ -1,0 +1,218 @@
+"""Multi-choice (n>1) chat/completions: per-choice streams, jail, usage.
+
+Reference parity: the delta generator and jail operate per-choice
+(lib/llm/src/protocols/openai/chat_completions/{delta,jail}.rs); n>1 fans one
+request into n engine streams folded into indexed choices.
+"""
+
+import json
+
+import aiohttp
+
+from dynamo_tpu.llm import (
+    EchoEngine,
+    ModelDeploymentCard,
+    ModelManager,
+    ModelWatcher,
+    register_llm,
+)
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    InProcEventPlane,
+    MemKVStore,
+    RouterMode,
+    RuntimeConfig,
+)
+import asyncio
+
+
+def make_rt(store):
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    return DistributedRuntime(cfg, store=store, event_plane=InProcEventPlane())
+
+
+async def start_stack(card=None):
+    store = MemKVStore()
+    worker_rt = await make_rt(store).start()
+    frontend_rt = await make_rt(store).start()
+    card = card or ModelDeploymentCard(
+        name="echo-model", tokenizer="byte", context_length=4096
+    )
+    served = await register_llm(worker_rt, EchoEngine(), card)
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager, RouterMode.ROUND_ROBIN).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    for _ in range(100):
+        p = manager.get(card.name)
+        if p and p.client.instances:
+            break
+        await asyncio.sleep(0.05)
+    handles = (worker_rt, frontend_rt, served, watcher, service)
+    return handles, f"http://127.0.0.1:{service.port}", card.name
+
+
+async def stop_stack(worker_rt, frontend_rt, served, watcher, service):
+    await service.stop()
+    await watcher.stop()
+    await served.stop()
+    await worker_rt.shutdown()
+    await frontend_rt.shutdown()
+
+
+async def _sse_chunks(resp):
+    chunks = []
+    done = 0
+    async for raw in resp.content:
+        line = raw.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            done += 1
+            continue
+        chunks.append(json.loads(payload))
+    return chunks, done
+
+
+async def test_chat_n2_aggregated():
+    handles, base, model = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": model, "n": 2,
+                    "messages": [{"role": "user", "content": "fanout"}],
+                },
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+        choices = body["choices"]
+        assert [c["index"] for c in choices] == [0, 1]
+        for c in choices:
+            assert "fanout" in c["message"]["content"]
+        # prompt billed once, completion summed across choices
+        per_choice = body["usage"]["completion_tokens"] // 2
+        assert body["usage"]["completion_tokens"] == 2 * per_choice > 0
+        assert body["usage"]["total_tokens"] == (
+            body["usage"]["prompt_tokens"] + body["usage"]["completion_tokens"]
+        )
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_chat_n3_streaming_interleaves_and_merges_usage():
+    handles, base, model = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": model, "n": 3, "stream": True,
+                    "stream_options": {"include_usage": True},
+                    "messages": [{"role": "user", "content": "abc"}],
+                },
+            )
+            assert r.status == 200, await r.text()
+            chunks, done = await _sse_chunks(r)
+        assert done == 1
+        seen = {}
+        finishes = set()
+        usage_chunks = [c for c in chunks if not c["choices"] and c.get("usage")]
+        for c in chunks:
+            for ch in c["choices"]:
+                i = ch["index"]
+                seen.setdefault(i, []).append(ch["delta"].get("content") or "")
+                if ch.get("finish_reason"):
+                    finishes.add(i)
+        assert set(seen) == {0, 1, 2}
+        assert finishes == {0, 1, 2}
+        texts = {i: "".join(parts) for i, parts in seen.items()}
+        for i in range(3):
+            assert "abc" in texts[i]
+        # exactly one merged usage chunk covering all choices
+        assert len(usage_chunks) == 1
+        u = usage_chunks[0]["usage"]
+        per = u["completion_tokens"] // 3
+        assert u["completion_tokens"] == 3 * per > 0
+        # all chunks share one response id
+        assert len({c["id"] for c in chunks}) == 1
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_chat_n2_streaming_tool_call_per_choice_jail():
+    """Each choice runs its own tool parser/jail: a tool-call in the stream
+    must come out as a parsed tool_calls delta on BOTH choice indexes with
+    no cross-choice state bleed."""
+    card = ModelDeploymentCard(
+        name="tool-echo", tokenizer="byte", context_length=4096,
+        tool_parser="hermes",
+    )
+    handles, base, model = await start_stack(card)
+    payload = '<tool_call>{"name": "get_w", "arguments": {"city": "SF"}}</tool_call>'
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": model, "n": 2, "stream": True,
+                    "messages": [{"role": "user", "content": payload}],
+                },
+            )
+            assert r.status == 200, await r.text()
+            chunks, _ = await _sse_chunks(r)
+        calls = {0: [], 1: []}
+        finishes = {}
+        for c in chunks:
+            for ch in c["choices"]:
+                if ch["delta"].get("tool_calls"):
+                    calls[ch["index"]].extend(ch["delta"]["tool_calls"])
+                if ch.get("finish_reason"):
+                    finishes[ch["index"]] = ch["finish_reason"]
+        for i in (0, 1):
+            assert len(calls[i]) == 1, (i, calls)
+            assert calls[i][0]["function"]["name"] == "get_w"
+            assert json.loads(calls[i][0]["function"]["arguments"]) == {"city": "SF"}
+            # per-choice tool-call indexes restart at 0
+            assert calls[i][0]["index"] == 0
+            assert finishes[i] == "tool_calls"
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_completions_n2_aggregated():
+    handles, base, model = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/completions",
+                json={"model": model, "prompt": "hello", "n": 2},
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1]
+        for c in body["choices"]:
+            assert "hello" in c["text"]
+        per = body["usage"]["completion_tokens"] // 2
+        assert body["usage"]["completion_tokens"] == 2 * per > 0
+    finally:
+        await stop_stack(*handles)
+
+
+async def test_chat_n_cap_enforced():
+    handles, base, model = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": model, "n": 64,
+                    "messages": [{"role": "user", "content": "x"}],
+                },
+            )
+            assert r.status == 400
+    finally:
+        await stop_stack(*handles)

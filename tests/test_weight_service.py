@@ -1,0 +1,256 @@
+"""Out-of-process weight service (engine/weight_service.py).
+
+gpu_memory_service analog (reference lib/gpu_memory_service/README.md):
+weights live in an owner process' tmpfs manifest; workers import zero-copy
+over a unix socket, crashes return leases, restore beats disk reload.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.weight_service import (
+    WeightOwner,
+    WeightServiceClient,
+    load_params_served,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+
+from test_hub_checkpoint import build_checkpoint
+
+
+def _flat_equal(a, b):
+    from dynamo_tpu.engine.warm import _flatten
+
+    fa, fb = _flatten(a), _flatten(b)
+    assert set(fa) == set(fb)
+    for k in fa:
+        np.testing.assert_array_equal(
+            np.asarray(fa[k], dtype=np.float32), np.asarray(fb[k], dtype=np.float32)
+        )
+
+
+async def test_import_matches_direct_load_and_survives_source_deletion(tmp_path):
+    """First import parses the checkpoint; afterwards the disk copy is not
+    needed at all — deleting it and importing again must still succeed
+    (weights are owner-resident, the gms crash-survival property)."""
+    ckpt = str(tmp_path / "ckpt")
+    build_checkpoint(ckpt)
+    from dynamo_tpu.engine.weights import config_from_hf, load_params
+
+    cfg = config_from_hf(ckpt)
+    direct = load_params(ckpt, cfg)
+
+    sock = str(tmp_path / "wo.sock")
+    owner = await WeightOwner(sock, root=str(tmp_path / "shm")).start()
+    try:
+        c1 = await asyncio.to_thread(WeightServiceClient, sock)
+        params, info = await asyncio.to_thread(c1.import_params, ckpt, cfg)
+        _flat_equal(params, direct)
+        assert info["refs"] == 1
+
+        # wipe the disk checkpoint: imports must keep working
+        import shutil
+
+        shutil.rmtree(ckpt)
+        c2 = await asyncio.to_thread(WeightServiceClient, sock)
+        params2, info2 = await asyncio.to_thread(c2.import_params, ckpt, cfg)
+        _flat_equal(params2, direct)
+        assert info2["refs"] == 2
+
+        # live references refuse eviction; released ones don't
+        with pytest.raises(RuntimeError, match="live references"):
+            await asyncio.to_thread(c1.evict, ckpt)
+        await asyncio.to_thread(c1.release, ckpt)
+        await asyncio.to_thread(c2.release, ckpt)
+        await asyncio.to_thread(c2.evict, ckpt)
+        assert await asyncio.to_thread(c1.stat) == []
+        c1.close()
+        c2.close()
+    finally:
+        await owner.stop()
+
+
+async def test_sigkill_worker_returns_lease(tmp_path):
+    """A worker killed with SIGKILL never sends release; its socket EOF must
+    reclaim every reference it held (connection-is-the-lease)."""
+    ckpt = str(tmp_path / "ckpt")
+    build_checkpoint(ckpt)
+    sock = str(tmp_path / "wo.sock")
+    owner = await WeightOwner(sock, root=str(tmp_path / "shm")).start()
+    try:
+        # a real OS process imports and then parks
+        code = f"""
+import sys, time
+sys.path.insert(0, {json.dumps(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!s})
+from dynamo_tpu.engine.weight_service import WeightServiceClient
+c = WeightServiceClient({json.dumps(sock)})
+params, info = c.import_params({json.dumps(ckpt)})
+print("IMPORTED", info["refs"], flush=True)
+time.sleep(600)
+"""
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        line = await asyncio.wait_for(
+            asyncio.to_thread(proc.stdout.readline), timeout=120
+        )
+        assert b"IMPORTED" in line, proc.stderr.read().decode()
+
+        admin = await asyncio.to_thread(WeightServiceClient, sock)
+        sets = await asyncio.to_thread(admin.stat)
+        assert sets[0]["refs"] == 1
+
+        proc.kill()
+        proc.wait()
+        for _ in range(100):
+            sets = await asyncio.to_thread(admin.stat)
+            if sets[0]["refs"] == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert sets[0]["refs"] == 0
+        admin.close()
+    finally:
+        await owner.stop()
+
+
+def _build_big_checkpoint(path: str, hidden=512, layers=6, inter=1536,
+                          vocab=4096, heads=8, kvh=4, head_dim=64):
+    """A checkpoint big enough (~tens of MB) that disk parse time dominates
+    socket round-trip noise — the tiny hub-test checkpoint loads in ~2ms
+    either way."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "llama", "vocab_size": vocab, "hidden_size": hidden,
+            "num_hidden_layers": layers, "num_attention_heads": heads,
+            "num_key_value_heads": kvh, "head_dim": head_dim,
+            "intermediate_size": inter, "rope_theta": 10000.0,
+            "rms_norm_eps": 1e-6, "max_position_embeddings": 512,
+            "tie_word_embeddings": False,
+        }, f)
+    rng = np.random.default_rng(7)
+
+    def w(*shape):
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    q = heads * head_dim
+    kv = kvh * head_dim
+    tensors = {
+        "model.embed_tokens.weight": w(vocab, hidden),
+        "model.norm.weight": w(hidden),
+        "lm_head.weight": w(vocab, hidden),
+    }
+    for i in range(layers):
+        p = f"model.layers.{i}."
+        tensors.update({
+            p + "input_layernorm.weight": w(hidden),
+            p + "post_attention_layernorm.weight": w(hidden),
+            p + "self_attn.q_proj.weight": w(q, hidden),
+            p + "self_attn.k_proj.weight": w(kv, hidden),
+            p + "self_attn.v_proj.weight": w(kv, hidden),
+            p + "self_attn.o_proj.weight": w(hidden, q),
+            p + "mlp.gate_proj.weight": w(inter, hidden),
+            p + "mlp.up_proj.weight": w(inter, hidden),
+            p + "mlp.down_proj.weight": w(hidden, inter),
+        })
+    save_file(tensors, os.path.join(path, "model.safetensors"))
+
+
+async def test_shm_restore_beats_disk_reload(tmp_path):
+    """The VERDICT contract: respawned worker's weight restore via the
+    service must beat re-parsing the checkpoint from disk. The import is a
+    manifest read + mmap (no byte copies); the disk path re-parses
+    safetensors and re-casts dtypes."""
+    ckpt = str(tmp_path / "ckpt")
+    _build_big_checkpoint(ckpt)
+    from dynamo_tpu.engine.weights import config_from_hf, load_params
+
+    cfg = config_from_hf(ckpt)
+
+    sock = str(tmp_path / "wo.sock")
+    owner = await WeightOwner(sock, root=str(tmp_path / "shm")).start()
+    try:
+        # owner pays the parse once
+        c = await asyncio.to_thread(WeightServiceClient, sock)
+        await asyncio.to_thread(c.import_params, ckpt, cfg)
+
+        t0 = time.perf_counter()
+        disk = load_params(ckpt, cfg)
+        t_disk = time.perf_counter() - t0
+
+        def respawn_import():
+            cc = WeightServiceClient(sock)
+            t1 = time.perf_counter()
+            params, _ = cc.import_params(ckpt, cfg)
+            dt = time.perf_counter() - t1
+            cc.close()
+            return params, dt
+
+        params, t_shm = await asyncio.to_thread(respawn_import)
+        _flat_equal(params, disk)
+        # generous margin: mmap import is ~2 orders faster; assert 1x
+        assert t_disk > 0.02, f"checkpoint too small to measure ({t_disk})"
+        assert t_shm < t_disk, (t_shm, t_disk)
+        c.close()
+    finally:
+        await owner.stop()
+
+
+async def test_load_params_served_falls_back_without_owner(tmp_path, monkeypatch):
+    ckpt = str(tmp_path / "ckpt")
+    build_checkpoint(ckpt)
+    from dynamo_tpu.engine.weights import config_from_hf
+
+    cfg = config_from_hf(ckpt)
+    monkeypatch.setenv("DTPU_WARM_CACHE", str(tmp_path / "warm"))
+    params, lease = load_params_served(
+        ckpt, cfg, sock_path=str(tmp_path / "missing.sock")
+    )
+    assert lease is None
+    assert "layers" in params
+
+
+async def test_cli_owner_process_serves_imports(tmp_path):
+    """The ``python -m dynamo_tpu.engine.weight_service`` entry: spawn a
+    real owner process, import against it, shut it down over the wire."""
+    ckpt = str(tmp_path / "ckpt")
+    build_checkpoint(ckpt)
+    sock = str(tmp_path / "wo.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.engine.weight_service",
+         "--sock", sock, "--root", str(tmp_path / "shm")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        # cold jax import in the owner process can take 30s+ under load
+        for _ in range(180):
+            if os.path.exists(sock) or proc.poll() is not None:
+                break
+            await asyncio.sleep(0.5)
+        assert os.path.exists(sock), proc.stderr.read().decode()
+        c = await asyncio.to_thread(WeightServiceClient, sock)
+        params, info = await asyncio.to_thread(c.import_params, ckpt)
+        assert info["bytes"] > 0
+        assert "layers" in params
+        c.shutdown_owner()
+        c.close()
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
