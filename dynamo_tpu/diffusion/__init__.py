@@ -1,0 +1,13 @@
+"""Image diffusion serving: DiT denoiser + DDIM sampler + worker main."""
+
+from .engine import DiffusionEngine
+from .model import DiffusionConfig, encode_png, hash_prompt, init_params, make_sampler
+
+__all__ = [
+    "DiffusionConfig",
+    "DiffusionEngine",
+    "encode_png",
+    "hash_prompt",
+    "init_params",
+    "make_sampler",
+]

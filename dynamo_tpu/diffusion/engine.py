@@ -1,0 +1,79 @@
+"""DiffusionEngine: serves image-generation requests (op == "image").
+
+The worker half behind /v1/images/generations — the analog of the
+reference's SGLang diffusion serving (components/src/dynamo/sglang/
+main.py:309,458), engine-owned here: the sampler is a single jitted XLA
+program per (batch, size) bucket.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+from typing import Any, AsyncIterator, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..llm.protocols.common import FINISH_STOP, BackendOutput, PreprocessedRequest
+from ..runtime.engine import Context
+from ..runtime.logging import get_logger
+from .model import DiffusionConfig, encode_png, hash_prompt, init_params, make_sampler
+
+log = get_logger("diffusion.engine")
+
+
+class DiffusionEngine:
+    """AsyncEngine serving op=image requests; register with
+    ``register_llm(..., raw_token_stream=True)`` and model_type ["images"]."""
+
+    def __init__(
+        self,
+        cfg: Optional[DiffusionConfig] = None,
+        params: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg or DiffusionConfig()
+        self.params = params if params is not None else init_params(self.cfg, seed)
+        self._sampler = make_sampler(self.params, self.cfg)
+        self._seed = seed
+        self._req_counter = 0
+        self.healthy = True
+
+    def _render(self, prompt: str, n: int) -> list:
+        cond = np.tile(hash_prompt(prompt, self.cfg), (n, 1))
+        self._req_counter += 1
+        key = jax.random.PRNGKey(self._seed + self._req_counter)
+        imgs = np.asarray(self._sampler(key, cond))
+        return [
+            base64.b64encode(encode_png(imgs[i])).decode() for i in range(n)
+        ]
+
+    async def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[Dict[str, Any]]:
+        req = (
+            request
+            if isinstance(request, PreprocessedRequest)
+            else PreprocessedRequest.from_obj(request)
+        )
+        ann = req.annotations or {}
+        if ann.get("op") != "image":
+            yield BackendOutput(
+                finish_reason="error",
+                annotations={"error": "diffusion engine serves op=image only"},
+            ).to_obj()
+            return
+        prompt = str(ann.get("prompt", ""))
+        n = max(1, int(ann.get("n", 1)))
+        # size is advisory: the compiled sampler has a fixed resolution; the
+        # reference's workers likewise serve the deployed model's native size
+        images = await asyncio.get_running_loop().run_in_executor(
+            None, self._render, prompt, n
+        )
+        if context.is_stopped():
+            return
+        yield BackendOutput(
+            finish_reason=FINISH_STOP,
+            annotations={"images": images, "input_tokens": 0},
+        ).to_obj()

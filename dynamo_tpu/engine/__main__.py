@@ -531,6 +531,28 @@ async def main() -> None:
         ):
             lora_served.append(await comp.endpoint(ep_name).serve(handler))
 
+    # runtime cache reset, served beside generate under the SAME instance id
+    # so the frontend's per-worker fan-out targets line up (reference
+    # http/clear_kv_blocks.rs + block_manager/controller.rs)
+    async def handle_clear_kv(request, context):
+        levels = (request or {}).get("levels")
+        results = []
+        for e in engines:  # dp>1: every rank owns its own caches
+            results.append(await e.clear_kv_blocks(levels))
+        out = {k: v for k, v in results[0].items() if isinstance(v, int)}
+        for r in results[1:]:
+            for k, v in r.items():
+                if isinstance(v, int):
+                    out[k] = out.get(k, 0) + v
+        out["snapshot"] = results[0]["snapshot"]
+        yield out
+
+    clear_served = await (
+        runtime.namespace(args.namespace).component(component)
+        .endpoint("clear_kv_blocks")
+        .serve(handle_clear_kv, instance_id=served.instance_id)
+    )
+
     # health: engine watchdog + endpoint canary + status side-port
     # (reference: engine_monitor.py, health_check.rs, system_status_server.rs)
     from dynamo_tpu.engine.monitor import EngineWatchdog
@@ -593,6 +615,9 @@ async def main() -> None:
         await status_server.stop()
     if not watchdog.fired:
         await served.stop(graceful_timeout_s=args.graceful_timeout)
+    await clear_served.stop()
+    for s in lora_served:
+        await s.stop()
     engine.stop()
     await runtime.shutdown()
     if mh is not None:

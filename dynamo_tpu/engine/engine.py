@@ -2278,10 +2278,46 @@ class TpuEngine:
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
-        return {
+        snap = {
             "running": sum(1 for s in self._slots if s is not None),
             "waiting": len(self._waiting),
             "active_blocks": self.allocator.active_blocks,
             "cached_blocks": self.allocator.cached_blocks,
             "free_blocks": self.allocator.free_blocks,
         }
+        if self.kvbm is not None:
+            snap["kvbm"] = {
+                "g2_blocks": len(self.kvbm.host),
+                "g3_blocks": len(self.kvbm.disk) if self.kvbm.disk is not None else 0,
+                "offloaded": self.kvbm.offloaded,
+                "onboarded": self.kvbm.onboarded,
+            }
+        return snap
+
+    async def clear_kv_blocks(self, levels: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Runtime cache reset (reference block_manager/controller.rs
+        cache-level commands + http/clear_kv_blocks.rs): drop the device
+        prefix cache (g1) and/or the KVBM offload tiers (g2 host, g3 disk).
+        Active requests keep their pinned blocks — only reusable cache is
+        dropped. The router view stays honest: a g1 clear publishes a
+        wholesale CLEARED event for this worker; tier clears ride the
+        consolidated removed-event path."""
+        levels = [lv.lower() for lv in (levels or ["g1", "g2", "g3"])]
+        result: Dict[str, Any] = {}
+        if "g1" in levels:
+            before = self.allocator.cached_blocks
+            self.allocator.clear()
+            # clear() intentionally emits no per-hash events (comment there):
+            # the wholesale CLEARED event resets this worker in the indexer
+            if self.kv_publisher is not None:
+                await self.kv_publisher.cleared()
+            result["g1"] = before
+        if self.kvbm is not None and ("g2" in levels or "g3" in levels):
+            counts = self.kvbm.clear(
+                host="g2" in levels, disk="g3" in levels
+            )
+            result.update({k: v for k, v in counts.items() if k in levels})
+            # push the eviction notifications out now, not at the next step
+            await self._publish_events()
+        result["snapshot"] = self.snapshot()
+        return result

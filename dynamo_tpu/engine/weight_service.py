@@ -51,6 +51,7 @@ def _cfg_to_obj(cfg: Any) -> Optional[Dict[str, Any]]:
     if cfg is None:
         return None
     d = dataclasses.asdict(cfg)
+    d["__kind__"] = type(cfg).__name__  # LlamaConfig / MoeConfig dispatch
     dt = d.get("dtype")
     if dt is not None and not isinstance(dt, str):
         import numpy as np
@@ -62,16 +63,19 @@ def _cfg_to_obj(cfg: Any) -> Optional[Dict[str, Any]]:
 def _cfg_from_obj(obj: Optional[Dict[str, Any]]) -> Any:
     if obj is None:
         return None
-    from ..models.llama import LlamaConfig
-
     d = dict(obj)
+    kind = d.pop("__kind__", "LlamaConfig")
+    if kind == "MoeConfig":
+        from ..models.moe import MoeConfig as cls
+    else:
+        from ..models.llama import LlamaConfig as cls
     dt = d.get("dtype")
     if isinstance(dt, str):
         import jax.numpy as jnp
 
         d["dtype"] = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                       "float16": jnp.float16}.get(dt, jnp.bfloat16)
-    return LlamaConfig(**d)
+    return cls(**d)
 
 
 @dataclasses.dataclass

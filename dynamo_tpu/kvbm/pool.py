@@ -80,6 +80,14 @@ class HostBlockPool:
         with self._lock:
             self._data.pop(h, None)
 
+    def clear(self) -> List[SequenceHash]:
+        """Drop every block; returns the evicted hashes (controller reset,
+        reference block_manager/controller.rs cache-level commands)."""
+        with self._lock:
+            gone = list(self._data)
+            self._data.clear()
+        return gone
+
 
 class DiskBlockPool:
     """G3: one file per block under a spill directory, LRU by access order."""
@@ -144,6 +152,22 @@ class DiskBlockPool:
             with self._lock:
                 self._lru.pop(h, None)
             return None
+
+    def clear(self) -> List[SequenceHash]:
+        """Drop every block and its spill file (controller reset). Unlinks
+        happen under the lock: a concurrent offload-worker store() re-writing
+        one of these hashes must either complete before the snapshot (file
+        deleted, hash reported gone) or after the clear (fresh file, fresh
+        LRU entry) — never lose a freshly re-stored block's file."""
+        with self._lock:
+            gone = list(self._lru)
+            self._lru.clear()
+            for h in gone:
+                try:
+                    os.unlink(self._file(h))
+                except FileNotFoundError:
+                    pass
+        return gone
 
 
 class OffloadQueue:
@@ -309,6 +333,25 @@ class KvbmTiers:
         with self._evicted_lock:
             out, self._evicted = self._evicted, []
         return out
+
+    def clear(self, host: bool = True, disk: bool = True) -> Dict[str, int]:
+        """Controller reset of local tiers (G2/G3). Evicted hashes feed the
+        normal consolidated-event path (drain_evicted), so the router only
+        learns 'removed' for blocks no longer servable from ANY tier."""
+        counts = {"g2": 0, "g3": 0}
+        gone: List[SequenceHash] = []
+        if host:
+            dropped = self.host.clear()
+            counts["g2"] = len(dropped)
+            gone.extend(dropped)
+        if disk and self.disk is not None:
+            dropped = self.disk.clear()
+            counts["g3"] = len(dropped)
+            gone.extend(dropped)
+        if gone:
+            with self._evicted_lock:
+                self._evicted.extend(gone)
+        return counts
 
     def filter_servable(self, hashes: List[SequenceHash]) -> List[SequenceHash]:
         """Subset of ``hashes`` still servable from ANY tier (remote queried

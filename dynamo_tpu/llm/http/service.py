@@ -107,6 +107,7 @@ def _openapi_spec() -> dict:
         "info": {"title": "dynamo-tpu OpenAI-compatible frontend",
                  "version": "1.0"},
         "paths": {
+            "/clear_kv_blocks": {"post": op("Reset worker KV caches (g1/g2/g3)", tag="admin")},
             "/v1/chat/completions": {"post": op("Chat completion", True)},
             "/v1/completions": {"post": op("Text completion", True)},
             "/v1/embeddings": {"post": op("Embeddings")},
@@ -175,6 +176,7 @@ class HttpService:
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/responses", self.responses)
         app.router.add_post("/v1/images/generations", self.images)
+        app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/live", self.live)
@@ -214,6 +216,61 @@ class HttpService:
             data=[ModelInfo(id=m, created=int(time.time())) for m in self.manager.list_models()]
         )
         return web.json_response(data.model_dump())
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Runtime cache reset across workers (reference
+        lib/llm/src/http/clear_kv_blocks.rs + block_manager/controller.rs).
+        Body (all optional): {"model": name, "levels": ["g1","g2","g3"]}.
+        Fans out to every instance's ``clear_kv_blocks`` endpoint (served
+        beside generate under the same instance id) and reports per-worker
+        results; workers without the endpoint are reported, not fatal."""
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        model = body.get("model")
+        levels = body.get("levels")
+        pipelines = (
+            [self.manager.get(model)] if model else self.manager.pipelines()
+        )
+        if model and pipelines[0] is None:
+            return _error(404, f"model {model!r} not found", "model_not_found")
+        results: dict = {}
+        for pipe in pipelines:
+            if pipe is None or pipe.client is None:
+                continue
+            card = pipe.card
+            endpoint = (
+                pipe.runtime.namespace(card.namespace)
+                .component(card.component)
+                .endpoint("clear_kv_blocks")
+            )
+            client = await endpoint.client()
+            per_worker: dict = {}
+            try:
+                targets = pipe.client.instance_ids()
+                # the fresh client's discovery snapshot arrives async; give
+                # it a moment to see the instances the generate client sees
+                try:
+                    await client.wait_for_instances(len(targets), timeout=5.0)
+                except TimeoutError:
+                    pass
+                for iid in targets:
+                    wk = f"{iid:016x}"
+                    if iid not in client.instances:
+                        per_worker[wk] = {"error": "no clear_kv_blocks endpoint"}
+                        continue
+                    try:
+                        async for item in await client.generate(
+                            {"levels": levels}, instance_id=iid
+                        ):
+                            per_worker[wk] = item
+                    except (NoResponders, ConnectionError, OSError) as e:
+                        per_worker[wk] = {"error": str(e)}
+            finally:
+                await client.stop()
+            results[card.name] = per_worker
+        return web.json_response({"cleared": results})
 
     async def openapi(self, request: web.Request) -> web.Response:
         """Machine-readable API description (reference

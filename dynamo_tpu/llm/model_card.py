@@ -80,6 +80,9 @@ class ModelDeploymentCard:
     image_token_id: int = _IMAGE_TOKEN_ID
     image_tokens: int = 0
     image_size: int = 0
+    # audio capability (reference async-openai audio types): False means
+    # audio parts / modalities=["audio"] requests get a clear 400
+    audio: bool = False
     runtime_config: ModelRuntimeConfig = dataclasses.field(default_factory=ModelRuntimeConfig)
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 

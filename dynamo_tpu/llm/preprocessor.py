@@ -53,6 +53,22 @@ class OpenAIPreprocessor:
             )
         return has
 
+    def _check_audio(self, request: ChatCompletionRequest) -> None:
+        """Audio requests against a non-audio model fail loudly (reference
+        async-openai carries the types; serving needs a capable model)."""
+        if self.card.audio:
+            return
+        wants_audio = "audio" in (request.modalities or [])
+        has_audio_part = any(
+            isinstance(m.content, list)
+            and any(p.get("type") in ("input_audio", "audio") for p in m.content)
+            for m in request.messages
+        )
+        if wants_audio or has_audio_part:
+            raise ValueError(
+                f"model {self.card.name!r} does not support audio input/output"
+            )
+
     def tokenize_chat_multimodal(self, request: ChatCompletionRequest):
         """Chat messages with image parts -> (token_ids with placeholder
         runs, decoded images). Multimodal prompts use plain role framing
@@ -149,6 +165,7 @@ class OpenAIPreprocessor:
 
     def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
         rid = new_request_id("chatcmpl")
+        self._check_audio(request)
         if self._has_images(request):
             tokens, images = self.tokenize_chat_multimodal(request)
             preq = self._common(request, tokens, rid)

@@ -477,6 +477,11 @@ async def aggregate_completion_multi(
     base = results[0]
     prompt = max(r.usage.prompt_tokens for r in results if r.usage)
     completion = sum(r.usage.completion_tokens for r in results if r.usage)
+    cached = next(
+        (r.usage.cached_tokens for r in results
+         if r.usage and r.usage.cached_tokens is not None),
+        None,
+    )
     return CompletionResponse(
         id=request_id,
         created=base.created,
@@ -484,6 +489,6 @@ async def aggregate_completion_multi(
         choices=[r.choices[0] for r in results],
         usage=Usage(
             prompt_tokens=prompt, completion_tokens=completion,
-            total_tokens=prompt + completion,
+            total_tokens=prompt + completion, cached_tokens=cached,
         ),
     )

@@ -87,6 +87,15 @@ class SamplingFields(_Lenient):
         return self.max_completion_tokens or self.max_tokens
 
 
+class ChatAudioParams(_Lenient):
+    """Request-side audio output options (reference async-openai
+    ChatCompletionAudio types): which voice/format an audio-capable model
+    should answer in."""
+
+    voice: str = "alloy"
+    format: Literal["wav", "mp3", "flac", "opus", "pcm16"] = "wav"
+
+
 class ChatCompletionRequest(SamplingFields):
     model: str
     messages: List[ChatMessage]
@@ -96,6 +105,11 @@ class ChatCompletionRequest(SamplingFields):
     tool_choice: Optional[Union[str, Dict[str, Any]]] = None
     response_format: Optional[Dict[str, Any]] = None
     user: Optional[str] = None
+    # audio I/O (reference async-openai audio types): accepted and validated;
+    # serving them requires an audio-capable model card (none ships yet —
+    # requests against text models get a clear 400, not silent drop)
+    modalities: Optional[List[Literal["text", "audio"]]] = None
+    audio: Optional[ChatAudioParams] = None
     # routing extensions (reference nvext.rs): pin a worker / annotate
     routing: Optional[Dict[str, Any]] = None
     # multi-LoRA: adapter name to apply (lora/adapters.py; reference routes
@@ -200,6 +214,35 @@ class ResponseObject(BaseModel):
         )
 
 
+class SpeechRequest(_Lenient):
+    """/v1/audio/speech wire type (reference async-openai CreateSpeechRequest
+    — the vendored fork carries audio types; serving needs a TTS model)."""
+
+    model: str
+    input: str
+    voice: str = "alloy"
+    response_format: Literal["wav", "mp3", "flac", "opus", "pcm16"] = "wav"
+    speed: float = Field(default=1.0, ge=0.25, le=4.0)
+
+
+class TranscriptionRequest(_Lenient):
+    """/v1/audio/transcriptions wire type (async-openai
+    CreateTranscriptionRequest; file rides as base64 in the JSON shape)."""
+
+    model: str
+    file: Optional[str] = None  # base64 audio payload
+    language: Optional[str] = None
+    prompt: Optional[str] = None
+    response_format: Literal["json", "text", "srt", "verbose_json", "vtt"] = "json"
+    temperature: float = Field(default=0.0, ge=0.0, le=1.0)
+
+
+class TranscriptionResponse(BaseModel):
+    text: str
+    language: Optional[str] = None
+    duration: Optional[float] = None
+
+
 class EmbeddingRequest(_Lenient):
     model: str
     input: Union[str, List[str], List[int], List[List[int]]]
@@ -220,11 +263,22 @@ class Usage(BaseModel):
     cached_tokens: Optional[int] = None
 
 
+class ChatAudioResponse(BaseModel):
+    """Response-side audio payload (async-openai ChatCompletionAudio):
+    base64 data + transcript, with an expiry for the audio id."""
+
+    id: str
+    data: Optional[str] = None       # base64-encoded audio
+    transcript: Optional[str] = None
+    expires_at: Optional[int] = None
+
+
 class ChatResponseMessage(BaseModel):
     role: str = "assistant"
     content: Optional[str] = None
     reasoning_content: Optional[str] = None
     tool_calls: Optional[List[Dict[str, Any]]] = None
+    audio: Optional[ChatAudioResponse] = None
 
 
 class ChatChoice(BaseModel):
@@ -248,6 +302,7 @@ class ChatDelta(BaseModel):
     content: Optional[str] = None
     reasoning_content: Optional[str] = None
     tool_calls: Optional[List[Dict[str, Any]]] = None
+    audio: Optional[Dict[str, Any]] = None  # streamed audio chunk fields
 
 
 class ChatChunkChoice(BaseModel):

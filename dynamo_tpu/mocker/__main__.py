@@ -71,6 +71,7 @@ async def main() -> None:
         )
 
     served = []
+    aux_served = []
     for _ in range(args.num_workers):
         instance_id = new_instance_id()
         engine_args = base_args
@@ -98,6 +99,18 @@ async def main() -> None:
         )
         s = await register_llm(runtime, engine, card, instance_id=instance_id)
         served.append(s)
+
+        # cache reset beside generate, same instance id (frontend fan-out
+        # targets generate-endpoint ids; reference /clear_kv_blocks works
+        # against every worker type)
+        async def handle_clear_kv(request, context, _e=engine):
+            yield await _e.clear_kv_blocks((request or {}).get("levels"))
+
+        aux_served.append(await (
+            runtime.namespace(args.namespace).component(args.component)
+            .endpoint("clear_kv_blocks")
+            .serve(handle_clear_kv, instance_id=instance_id)
+        ))
     canary = status_server = None
     if args.status_port >= 0:
         from dynamo_tpu.runtime.health import EndpointCanary, HealthState, StatusServer
@@ -125,6 +138,8 @@ async def main() -> None:
     if status_server is not None:
         await status_server.stop()
     for s in served:
+        await s.stop()
+    for s in aux_served:
         await s.stop()
     await runtime.shutdown()
 

@@ -441,3 +441,18 @@ class MockerEngine:
             "cached_blocks": len(self.kv.cached),
             "free_blocks": self.kv.free_blocks,
         }
+
+    async def clear_kv_blocks(self, levels=None) -> Dict[str, Any]:
+        """Runtime prefix-cache reset (reference /clear_kv_blocks against any
+        worker type — the mocker honors it like the real engine). Active
+        (pinned) blocks stay; the evictable cache empties and the router gets
+        a wholesale CLEARED for this worker. The mocker only has a g1: a
+        levels list that excludes g1 is a no-op, same as the real engine."""
+        result: Dict[str, Any] = {}
+        if levels is None or "g1" in [lv.lower() for lv in levels]:
+            result["g1"] = len(self.kv.cached)
+            self.kv.cached.clear()
+            if self.kv_publisher is not None:
+                await self.kv_publisher.cleared()
+        result["snapshot"] = self.snapshot()
+        return result

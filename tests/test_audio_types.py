@@ -1,0 +1,98 @@
+"""Audio protocol types (reference async-openai audio request/response types)
+and the loud-failure rule for audio requests against text models."""
+
+import pytest
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.openai import (
+    ChatAudioParams,
+    ChatAudioResponse,
+    ChatCompletionRequest,
+    ChatResponseMessage,
+    SpeechRequest,
+    TranscriptionRequest,
+    TranscriptionResponse,
+)
+
+
+def test_chat_request_audio_fields_parse():
+    req = ChatCompletionRequest.model_validate({
+        "model": "m",
+        "messages": [{"role": "user", "content": "speak"}],
+        "modalities": ["text", "audio"],
+        "audio": {"voice": "verse", "format": "wav"},
+    })
+    assert req.modalities == ["text", "audio"]
+    assert req.audio == ChatAudioParams(voice="verse", format="wav")
+
+
+def test_chat_request_rejects_bad_audio_format():
+    with pytest.raises(ValueError):
+        ChatCompletionRequest.model_validate({
+            "model": "m",
+            "messages": [{"role": "user", "content": "x"}],
+            "audio": {"voice": "alloy", "format": "ogg-vorbis"},
+        })
+
+
+def test_response_message_carries_audio():
+    msg = ChatResponseMessage(
+        content=None,
+        audio=ChatAudioResponse(id="audio_1", data="UklGRg==", transcript="hi"),
+    )
+    d = msg.model_dump(exclude_none=True)
+    assert d["audio"]["transcript"] == "hi"
+
+
+def test_speech_and_transcription_types():
+    s = SpeechRequest.model_validate({
+        "model": "tts", "input": "hello", "voice": "alloy", "speed": 1.5,
+    })
+    assert s.response_format == "wav"
+    with pytest.raises(ValueError):
+        SpeechRequest.model_validate({"model": "tts", "input": "x", "speed": 9.0})
+    t = TranscriptionRequest.model_validate({"model": "stt", "file": "AAAA"})
+    assert t.response_format == "json"
+    assert TranscriptionResponse(text="ok").text == "ok"
+
+
+def _pre(audio: bool = False) -> OpenAIPreprocessor:
+    card = ModelDeploymentCard(
+        name="m", tokenizer="byte", context_length=2048, audio=audio
+    )
+    return OpenAIPreprocessor(card)
+
+
+def test_text_model_rejects_audio_modality():
+    req = ChatCompletionRequest.model_validate({
+        "model": "m",
+        "messages": [{"role": "user", "content": "x"}],
+        "modalities": ["audio"],
+    })
+    with pytest.raises(ValueError, match="does not support audio"):
+        _pre().preprocess_chat(req)
+
+
+def test_text_model_rejects_input_audio_part():
+    req = ChatCompletionRequest.model_validate({
+        "model": "m",
+        "messages": [{
+            "role": "user",
+            "content": [{"type": "input_audio",
+                         "input_audio": {"data": "AAAA", "format": "wav"}}],
+        }],
+    })
+    with pytest.raises(ValueError, match="does not support audio"):
+        _pre().preprocess_chat(req)
+
+
+def test_audio_capable_card_passes_validation():
+    req = ChatCompletionRequest.model_validate({
+        "model": "m",
+        "messages": [{"role": "user", "content": "x"}],
+        "modalities": ["text", "audio"],
+        "audio": {"voice": "alloy", "format": "wav"},
+    })
+    preq = _pre(audio=True).preprocess_chat(req)
+    assert preq.token_ids

@@ -1,0 +1,150 @@
+"""KVBM runtime controller: clear_kv_blocks across tiers + HTTP fan-out.
+
+Reference parity: lib/llm/src/block_manager/controller.rs (runtime reset /
+cache-level commands) and lib/llm/src/http/clear_kv_blocks.rs (frontend op
+fanning to every worker).
+"""
+
+import asyncio
+import sys
+
+import aiohttp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+from test_engine import greedy_req, run_req, tiny_engine
+
+from dynamo_tpu.kvbm.pool import KvbmTiers
+
+
+def _block(i):
+    return np.full((4, 2, 8), i, np.float32)
+
+
+def test_tiers_clear_drops_host_and_disk(tmp_path):
+    tiers = KvbmTiers(
+        block_nbytes=_block(0).nbytes,
+        host_capacity_bytes=_block(0).nbytes * 4,
+        disk_capacity_bytes=_block(0).nbytes * 8,
+        disk_path=str(tmp_path / "kv"),
+    )
+    for i in range(10):
+        tiers.store(i + 1, _block(i))  # host spills oldest to disk
+    assert len(tiers.host) > 0 and len(tiers.disk) > 0
+    counts = tiers.clear()
+    assert counts["g2"] > 0 and counts["g3"] > 0
+    assert len(tiers.host) == 0 and len(tiers.disk) == 0
+    # dropped hashes flow to the consolidated removed-event path
+    evicted = set(tiers.drain_evicted())
+    assert evicted.issuperset(set(range(1, counts["g2"] + 1)) - evicted or set())
+    assert len(evicted) > 0
+    # spill files are gone from disk
+    assert not any(f.suffix == ".kv" for f in (tmp_path / "kv").iterdir())
+    tiers.close()
+
+
+async def test_engine_clear_kv_blocks_drops_prefix_cache():
+    engine = tiny_engine()
+    try:
+        prompt = list(range(40, 60))
+        await run_req(engine, greedy_req("a", prompt))
+        assert engine.allocator.cached_blocks > 0
+        res = await engine.clear_kv_blocks()
+        assert res["g1"] > 0
+        assert res["snapshot"]["cached_blocks"] == 0
+        # second identical request: no cached prefix, but still serves
+        t2, cached = await run_req(engine, greedy_req("b", prompt))
+        assert len(t2) == 8
+        assert not cached
+        # cache rebuilds after the clear
+        assert engine.allocator.cached_blocks > 0
+    finally:
+        engine.stop()
+
+
+async def test_frontend_clear_fans_to_workers():
+    """Full path: frontend POST /clear_kv_blocks -> every worker's clear
+    endpoint (mocker fleet) -> per-worker results; caches actually empty."""
+    from dynamo_tpu.llm import (
+        ModelDeploymentCard,
+        ModelManager,
+        ModelWatcher,
+        register_llm,
+    )
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+    from dynamo_tpu.runtime import (
+        DistributedRuntime,
+        InProcEventPlane,
+        MemKVStore,
+        RouterMode,
+        RuntimeConfig,
+    )
+    from dynamo_tpu.runtime.component import new_instance_id
+
+    store = MemKVStore()
+    plane = InProcEventPlane()
+
+    def make_rt():
+        cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+        return DistributedRuntime(cfg, store=store, event_plane=plane)
+
+    worker_rt = await make_rt().start()
+    frontend_rt = await make_rt().start()
+    engines = []
+    served = []
+    for _ in range(2):
+        iid = new_instance_id()
+        eng = MockerEngine(MockEngineArgs(speedup_ratio=50.0))
+        engines.append(eng)
+        card = ModelDeploymentCard(
+            name="clear-model", tokenizer="byte", context_length=4096,
+        )
+        s = await register_llm(worker_rt, eng, card, instance_id=iid)
+        served.append(s)
+
+        async def handle_clear(request, context, _e=eng):
+            yield await _e.clear_kv_blocks((request or {}).get("levels"))
+
+        served.append(await (
+            worker_rt.namespace(card.namespace).component(card.component)
+            .endpoint("clear_kv_blocks").serve(handle_clear, instance_id=iid)
+        ))
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager, RouterMode.ROUND_ROBIN).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        for _ in range(100):
+            p = manager.get("clear-model")
+            if p and len(p.client.instances) == 2:
+                break
+            await asyncio.sleep(0.05)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as s:
+            # populate both workers' caches (round robin)
+            for i in range(4):
+                r = await s.post(
+                    f"{base}/v1/chat/completions",
+                    json={"model": "clear-model", "max_tokens": 8,
+                          "messages": [{"role": "user", "content": f"warm {i % 2}"}]},
+                )
+                assert r.status == 200
+            assert any(len(e.kv.cached) > 0 for e in engines)
+            r = await s.post(f"{base}/clear_kv_blocks", json={})
+            assert r.status == 200, await r.text()
+            body = await r.json()
+        workers = body["cleared"]["clear-model"]
+        assert len(workers) == 2
+        for res in workers.values():
+            assert "error" not in res, workers
+            assert res["snapshot"]["cached_blocks"] == 0
+        assert all(len(e.kv.cached) == 0 for e in engines)
+    finally:
+        await service.stop()
+        await watcher.stop()
+        for s in served:
+            await s.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()
