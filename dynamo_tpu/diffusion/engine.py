@@ -37,13 +37,16 @@ class DiffusionEngine:
         self.params = params if params is not None else init_params(self.cfg, seed)
         self._sampler = make_sampler(self.params, self.cfg)
         self._seed = seed
-        self._req_counter = 0
+        # itertools.count: one atomic C call per draw, so concurrent renders
+        # on executor threads never reuse a PRNG key
+        import itertools
+
+        self._req_counter = itertools.count(1)
         self.healthy = True
 
     def _render(self, prompt: str, n: int) -> list:
         cond = np.tile(hash_prompt(prompt, self.cfg), (n, 1))
-        self._req_counter += 1
-        key = jax.random.PRNGKey(self._seed + self._req_counter)
+        key = jax.random.PRNGKey(self._seed + next(self._req_counter))
         imgs = np.asarray(self._sampler(key, cond))
         return [
             base64.b64encode(encode_png(imgs[i])).decode() for i in range(n)

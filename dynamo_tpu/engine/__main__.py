@@ -101,6 +101,14 @@ def parse_args():
     p.add_argument("--lora-rank", type=int, default=16)
     p.add_argument("--no-warm-cache", action="store_true",
                    help="disable the host weight cache (engine/warm.py)")
+    p.add_argument("--decode-steps", type=int, default=None,
+                   help="decode iterations per compiled horizon; default "
+                        "auto-tunes from the measured device RTT (multihost "
+                        "pins 32 — per-process autotune would desync the "
+                        "replayed programs)")
+    p.add_argument("--decode-pipeline", type=int, default=None,
+                   help="in-flight decode horizons; default auto-tunes with "
+                        "--decode-steps (multihost pins 2)")
     p.add_argument("--weight-service", default=None, metavar="SOCK",
                    help="unix socket of a weight owner process "
                         "(engine/weight_service.py; reference "
@@ -151,7 +159,19 @@ def make_engine_config(args, mcfg, vcfg=None, logits_procs=()):
         if rnd(b) < chunk_cap
     ) + (chunk_cap,)
     args.max_context = ctx
+    # decode schedule: per-process RTT autotune is NOT multihost-safe (the
+    # horizon length is baked into the compiled program; leader/follower
+    # resolving different steps from noisy RTT medians would desync the
+    # replayed dispatches) — multihost pins the measured tunneled-TPU
+    # defaults unless the flags say otherwise
+    decode_steps = getattr(args, "decode_steps", None)
+    decode_pipeline = getattr(args, "decode_pipeline", None)
+    if getattr(args, "multihost", None):
+        decode_steps = decode_steps if decode_steps is not None else 32
+        decode_pipeline = decode_pipeline if decode_pipeline is not None else 2
     return TpuEngineConfig(
+        decode_steps=decode_steps,
+        decode_pipeline=decode_pipeline,
         model=mcfg,
         num_blocks=args.num_blocks,
         block_size=args.block_size,
@@ -531,26 +551,12 @@ async def main() -> None:
         ):
             lora_served.append(await comp.endpoint(ep_name).serve(handler))
 
-    # runtime cache reset, served beside generate under the SAME instance id
-    # so the frontend's per-worker fan-out targets line up (reference
-    # http/clear_kv_blocks.rs + block_manager/controller.rs)
-    async def handle_clear_kv(request, context):
-        levels = (request or {}).get("levels")
-        results = []
-        for e in engines:  # dp>1: every rank owns its own caches
-            results.append(await e.clear_kv_blocks(levels))
-        out = {k: v for k, v in results[0].items() if isinstance(v, int)}
-        for r in results[1:]:
-            for k, v in r.items():
-                if isinstance(v, int):
-                    out[k] = out.get(k, 0) + v
-        out["snapshot"] = results[0]["snapshot"]
-        yield out
+    # runtime cache reset (reference http/clear_kv_blocks.rs); dp>1 fans to
+    # every rank's engine
+    from dynamo_tpu.llm.serve import serve_clear_endpoint
 
-    clear_served = await (
-        runtime.namespace(args.namespace).component(component)
-        .endpoint("clear_kv_blocks")
-        .serve(handle_clear_kv, instance_id=served.instance_id)
+    clear_served = await serve_clear_endpoint(
+        runtime, args.namespace, component, engines, served.instance_id
     )
 
     # health: engine watchdog + endpoint canary + status side-port

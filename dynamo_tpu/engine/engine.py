@@ -96,14 +96,18 @@ class TpuEngineConfig:
     # (lax.scan, sampled tokens fed back device-side) so per-dispatch launch
     # latency amortizes over N tokens. Stop conditions are applied host-side
     # post-hoc (at most N-1 speculatively-decoded tokens are discarded).
-    decode_steps: int = 16
+    # None = auto-tune from the measured device round-trip at startup
+    # (round-4 verdict #3: the best value tracks RTT, which spans ~1 ms on a
+    # local chip to ~170 ms through a tunnel — no constant fits both).
+    decode_steps: Optional[int] = None
     # in-flight decode horizons: each horizon's result readback starts at
     # dispatch on the fetch pool, so with depth>=2 the device->host RTT
     # (measured ~70-170 ms on tunneled TPUs; latency, not bandwidth —
     # concurrent fetches overlap) hides behind the next horizon's compute.
     # Each extra slot adds decode_steps tokens of emission latency and
-    # speculation waste at stop; measured best on v5e: depth 2.
-    decode_pipeline: int = 2
+    # speculation waste at stop; measured best on tunneled v5e: depth 2.
+    # None = auto-tune with decode_steps.
+    decode_pipeline: Optional[int] = None
     # multi-LoRA serving (lora/adapters.py): N static adapter slots baked
     # into the programs at build; hot-load/unload are in-place table updates
     # with zero recompiles. 0 disables (no lora ops in the hot path).
@@ -135,6 +139,72 @@ class TpuEngineConfig:
     @property
     def max_blocks_per_seq(self) -> int:
         return (self.max_context + self.block_size - 1) // self.block_size
+
+
+def _model_param_bytes(mcfg) -> int:
+    """Rough bf16 parameter footprint — the per-decode-step HBM traffic
+    floor (every weight is read once per step at small batch)."""
+    h = mcfg.hidden_size
+    q = mcfg.num_heads * mcfg.head_dim
+    kv = mcfg.num_kv_heads * mcfg.head_dim
+    per_layer = h * (q + 2 * kv) + q * h + 3 * h * mcfg.intermediate_size
+    embed = mcfg.vocab_size * h * (1 if mcfg.tie_embeddings else 2)
+    n_experts = getattr(mcfg, "num_experts", 0) or 0
+    if n_experts:
+        # active experts only (top-k routing): traffic, not capacity
+        top_k = getattr(mcfg, "num_experts_per_tok", 2) or 2
+        moe_inter = getattr(mcfg, "moe_intermediate_size", mcfg.intermediate_size)
+        per_layer = h * (q + 2 * kv) + q * h + 3 * h * moe_inter * top_k
+    return 2 * (per_layer * mcfg.num_layers + embed)
+
+
+def measure_device_rtt(device, tries: int = 3) -> float:
+    """Median dispatch->readback round-trip for a trivial op. NOTE:
+    np.asarray (a real fetch), not block_until_ready — on tunneled TPUs the
+    latter returns early and under-reports by the full tunnel latency."""
+    import jax
+
+    x = jax.device_put(jnp.zeros((8,), jnp.float32), device)
+    np.asarray(x + 1)  # warm the op cache
+    samples = []
+    for _ in range(tries):
+        t0 = time.perf_counter()
+        np.asarray(x + 1)
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def autotune_decode_schedule(mcfg, device) -> Tuple[int, int]:
+    """(decode_steps, decode_pipeline) from measured RTT + a roofline
+    per-step estimate (round-4 verdict #3: replace constants).
+
+    Model: a horizon must keep the device busy for >= ~1 RTT so that with
+    pipeline depth 2 the readback of horizon N hides behind horizon N+1's
+    compute. steps ~ 0.45 * RTT / t_step rounded to a power of two. The
+    0.45 calibrates the pure-weights roofline t_step (1.46 ms for the bench
+    model) to the measured grid: actual steps include KV gather + sampling
+    (measured 2.6 ms/step), and at RTT ~100 ms the measured best was 32,
+    which beat 64 — longer horizons waste speculative tokens at stop.
+    Low-RTT devices keep short horizons (less speculation waste, lower
+    emission latency) and skip pipelining."""
+    bw = 816e9 if device.platform in ("tpu", "axon") else 5e10
+    t_step = max(_model_param_bytes(mcfg) / bw, 1e-4)
+    try:
+        rtt = measure_device_rtt(device)
+    except Exception:
+        log.exception("RTT probe failed; using tunneled-TPU defaults")
+        return 32, 2
+    ratio = 0.45 * rtt / t_step
+    steps = 8
+    while steps < 64 and steps < ratio:
+        steps *= 2
+    pipeline = 2 if rtt > 2 * t_step else 1
+    log.info(
+        "decode schedule auto-tuned: rtt=%.1fms t_step~%.2fms -> steps=%d pipeline=%d",
+        rtt * 1e3, t_step * 1e3, steps, pipeline,
+    )
+    return steps, pipeline
 
 
 @dataclasses.dataclass
@@ -234,6 +304,23 @@ class TpuEngine:
             self.mesh = mesh
         else:
             self.mesh = mesh if mesh is not None else meshlib.make_mesh(tp=config.tp)
+        # resolve the decode schedule before any program is built (both
+        # knobs are baked into the compiled horizon program)
+        if config.decode_steps is None or config.decode_pipeline is None:
+            import jax as _jax
+
+            # probe a LOCAL device (multihost meshes span processes; RTT to
+            # any local chip is representative)
+            local = next(
+                (d for d in self.mesh.devices.flat
+                 if d.process_index == _jax.process_index()),
+                _jax.local_devices()[0],
+            )
+            steps, pipeline = autotune_decode_schedule(self.mcfg, local)
+            if config.decode_steps is None:
+                config.decode_steps = steps
+            if config.decode_pipeline is None:
+                config.decode_pipeline = pipeline
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         self.allocator = BlockAllocator(config.num_blocks, config.block_size)
@@ -2302,6 +2389,8 @@ class TpuEngine:
         dropped. The router view stays honest: a g1 clear publishes a
         wholesale CLEARED event for this worker; tier clears ride the
         consolidated removed-event path."""
+        if levels is not None and not isinstance(levels, (list, tuple)):
+            raise ValueError("levels must be a list of tier names")
         levels = [lv.lower() for lv in (levels or ["g1", "g2", "g3"])]
         result: Dict[str, Any] = {}
         if "g1" in levels:

@@ -230,6 +230,13 @@ class HttpService:
             return _error(400, "invalid JSON body")
         model = body.get("model")
         levels = body.get("levels")
+        if levels is not None and (
+            not isinstance(levels, list)
+            or not all(isinstance(lv, str) for lv in levels)
+        ):
+            # a bare string would iterate character-wise downstream and
+            # silently clear nothing — reject loudly
+            return _error(400, 'levels must be a list of strings, e.g. ["g1"]')
         pipelines = (
             [self.manager.get(model)] if model else self.manager.pipelines()
         )

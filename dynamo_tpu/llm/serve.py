@@ -48,3 +48,37 @@ async def register_llm(
     key = mdc_key(card.namespace, card.slug, served.instance_id)
     await served.publish_extra(key, card.to_obj())
     return served
+
+
+async def serve_clear_endpoint(
+    runtime: DistributedRuntime,
+    namespace: str,
+    component: str,
+    engines,
+    instance_id: int,
+) -> ServedEndpoint:
+    """Serve a ``clear_kv_blocks`` admin endpoint beside generate, under the
+    SAME instance id so the frontend's per-worker fan-out targets line up
+    (reference http/clear_kv_blocks.rs + block_manager/controller.rs). One
+    shared shim for every worker main: engines is the list of engine objects
+    whose caches this worker owns (dp>1 = one per rank); integer tier counts
+    sum across them."""
+
+    async def handle_clear_kv(request, context):
+        levels = (request or {}).get("levels")
+        results = []
+        for e in engines:
+            results.append(await e.clear_kv_blocks(levels))
+        out = {k: v for k, v in results[0].items() if isinstance(v, int)}
+        for r in results[1:]:
+            for k, v in r.items():
+                if isinstance(v, int):
+                    out[k] = out.get(k, 0) + v
+        out["snapshot"] = results[0].get("snapshot")
+        yield out
+
+    return await (
+        runtime.namespace(namespace).component(component)
+        .endpoint("clear_kv_blocks")
+        .serve(handle_clear_kv, instance_id=instance_id)
+    )

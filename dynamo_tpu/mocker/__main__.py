@@ -12,6 +12,7 @@ import signal
 
 from dynamo_tpu.kv_router import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm import ModelDeploymentCard, ModelRuntimeConfig, register_llm
+from dynamo_tpu.llm.serve import serve_clear_endpoint
 from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
 from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
 from dynamo_tpu.runtime.component import new_instance_id
@@ -99,17 +100,10 @@ async def main() -> None:
         )
         s = await register_llm(runtime, engine, card, instance_id=instance_id)
         served.append(s)
-
-        # cache reset beside generate, same instance id (frontend fan-out
-        # targets generate-endpoint ids; reference /clear_kv_blocks works
+        # cache reset beside generate (reference /clear_kv_blocks works
         # against every worker type)
-        async def handle_clear_kv(request, context, _e=engine):
-            yield await _e.clear_kv_blocks((request or {}).get("levels"))
-
-        aux_served.append(await (
-            runtime.namespace(args.namespace).component(args.component)
-            .endpoint("clear_kv_blocks")
-            .serve(handle_clear_kv, instance_id=instance_id)
+        aux_served.append(await serve_clear_endpoint(
+            runtime, args.namespace, args.component, [engine], instance_id
         ))
     canary = status_server = None
     if args.status_port >= 0:

@@ -103,13 +103,10 @@ async def test_frontend_clear_fans_to_workers():
         )
         s = await register_llm(worker_rt, eng, card, instance_id=iid)
         served.append(s)
+        from dynamo_tpu.llm.serve import serve_clear_endpoint
 
-        async def handle_clear(request, context, _e=eng):
-            yield await _e.clear_kv_blocks((request or {}).get("levels"))
-
-        served.append(await (
-            worker_rt.namespace(card.namespace).component(card.component)
-            .endpoint("clear_kv_blocks").serve(handle_clear, instance_id=iid)
+        served.append(await serve_clear_endpoint(
+            worker_rt, card.namespace, card.component, [eng], iid
         ))
     manager = ModelManager()
     watcher = await ModelWatcher(frontend_rt, manager, RouterMode.ROUND_ROBIN).start()
@@ -132,6 +129,9 @@ async def test_frontend_clear_fans_to_workers():
                 )
                 assert r.status == 200
             assert any(len(e.kv.cached) > 0 for e in engines)
+            # a bare-string levels is a 400, not a silent no-op
+            r = await s.post(f"{base}/clear_kv_blocks", json={"levels": "g1"})
+            assert r.status == 400
             r = await s.post(f"{base}/clear_kv_blocks", json={})
             assert r.status == 200, await r.text()
             body = await r.json()

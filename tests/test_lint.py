@@ -43,3 +43,47 @@ def test_linter_catches_unused_import(tmp_path):
         capture_output=True, text=True, timeout=60,
     )
     assert r.returncode == 1 and "UNUSED-IMPORT: json" in r.stdout
+
+
+def test_linter_catches_wrong_arity(tmp_path):
+    bad = tmp_path / "bad3.py"
+    bad.write_text(
+        "def f(a, b, *, c=1):\n"
+        "    return a + b + c\n"
+        "def g():\n"
+        "    return f(1, 2, 3) + f(1) + f(1, 2, d=4)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "too many positional args for f()" in r.stdout
+    assert "missing required arg(s) for f(): ['b']" in r.stdout
+    assert "unknown kwarg(s) for f(): ['d']" in r.stdout
+
+
+def test_arity_checker_skips_dynamic_patterns(tmp_path):
+    """Decorated defs, rebound names, and unpacked calls must not flag."""
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import functools\n"
+        "@functools.lru_cache\n"
+        "def cached(a):\n"
+        "    return a\n"
+        "def h(a):\n"
+        "    return a\n"
+        "h = print\n"
+        "def use():\n"
+        "    args = (1, 2, 3)\n"
+        "    cached(1, 2)\n"      # decorated: skipped
+        "    h(1, 2, 3)\n"        # rebound: skipped
+        "    real(*args)\n"       # unpacking: skipped
+        "def real(x):\n"
+        "    return x\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(ok)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "ARITY" not in r.stdout

@@ -8,8 +8,6 @@ over a unix socket, crashes return leases, restore beats disk reload.
 import asyncio
 import json
 import os
-import signal
-import socket
 import subprocess
 import sys
 import time
@@ -22,7 +20,6 @@ from dynamo_tpu.engine.weight_service import (
     WeightServiceClient,
     load_params_served,
 )
-from dynamo_tpu.models.llama import LlamaConfig
 
 from test_hub_checkpoint import build_checkpoint
 

@@ -114,6 +114,94 @@ def unused_imports(path: str, tree: ast.AST, src: str):
     ]
 
 
+def call_arity(path: str, tree: ast.AST):
+    """Wrong-arity calls to same-module top-level functions — the cheap,
+    high-precision slice of what mypy would catch (reference runs mypy in
+    pytest, pyproject.toml:155). Conservative by construction: only checks
+    calls to undecorated module-level ``def``s whose name is never rebound,
+    and skips any call using *args/**kwargs unpacking."""
+    funcs = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.decorator_list:
+                continue
+            funcs[node.name] = (node.args, node.lineno)
+
+    # a name bound anywhere beyond its single top-level def (assignment,
+    # nested def, import alias, loop var, lambda param...) may not be that
+    # function at the call site — drop it
+    bound_counts: dict = {}
+
+    def bind(name):
+        bound_counts[name] = bound_counts.get(name, 0) + 1
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            bind(node.name)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                for arg in (
+                    a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])
+                ):
+                    bind(arg.arg)
+        elif isinstance(node, ast.Lambda):
+            a = node.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                bind(arg.arg)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            bind(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in getattr(node, "names", []):
+                if alias.name != "*":
+                    bind((alias.asname or alias.name).split(".")[0])
+    checkable = {
+        name: spec for name, spec in funcs.items() if bound_counts.get(name) == 1
+    }
+
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+            continue
+        entry = checkable.get(node.func.id)
+        if entry is None:
+            continue
+        a, _def_line = entry
+        if any(isinstance(x, ast.Starred) for x in node.args):
+            continue
+        if any(kw.arg is None for kw in node.keywords):
+            continue
+        pos_params = [p.arg for p in a.posonlyargs + a.args]
+        n_defaults = len(a.defaults)
+        required_pos = pos_params[: len(pos_params) - n_defaults]
+        kwonly = {p.arg for p in a.kwonlyargs}
+        kwonly_required = {
+            p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults) if d is None
+        }
+        kw_names = {kw.arg for kw in node.keywords}
+        msg = None
+        if a.vararg is None and len(node.args) > len(pos_params):
+            msg = (
+                f"too many positional args for {node.func.id}() "
+                f"({len(node.args)} > {len(pos_params)})"
+            )
+        elif a.kwarg is None:
+            byname = set(p.arg for p in a.args) | kwonly
+            unknown = kw_names - byname
+            if unknown:
+                msg = f"unknown kwarg(s) for {node.func.id}(): {sorted(unknown)}"
+        if msg is None:
+            covered = set(pos_params[: len(node.args)]) | kw_names
+            missing = [p for p in required_pos if p not in covered]
+            missing += sorted(kwonly_required - kw_names)
+            if missing:
+                msg = f"missing required arg(s) for {node.func.id}(): {missing}"
+        if msg:
+            findings.append((path, node.lineno, msg))
+    return findings
+
+
 def _ident_tokens(text: str):
     tok = ""
     for ch in text:
@@ -148,6 +236,9 @@ def main(argv) -> int:
             for p, name, lineno in unused_imports(path, tree, src):
                 print(f"{p}:{lineno}: UNUSED-IMPORT: {name}")
                 bad += 1
+        for p, lineno, msg in call_arity(path, tree):
+            print(f"{p}:{lineno}: ARITY: {msg}")
+            bad += 1
     if bad:
         print(f"{bad} finding(s)")
     return 1 if bad else 0
