@@ -162,8 +162,6 @@ def measure_device_rtt(device, tries: int = 3) -> float:
     """Median dispatch->readback round-trip for a trivial op. NOTE:
     np.asarray (a real fetch), not block_until_ready — on tunneled TPUs the
     latter returns early and under-reports by the full tunnel latency."""
-    import jax
-
     x = jax.device_put(jnp.zeros((8,), jnp.float32), device)
     np.asarray(x + 1)  # warm the op cache
     samples = []
@@ -307,14 +305,12 @@ class TpuEngine:
         # resolve the decode schedule before any program is built (both
         # knobs are baked into the compiled horizon program)
         if config.decode_steps is None or config.decode_pipeline is None:
-            import jax as _jax
-
             # probe a LOCAL device (multihost meshes span processes; RTT to
             # any local chip is representative)
             local = next(
                 (d for d in self.mesh.devices.flat
-                 if d.process_index == _jax.process_index()),
-                _jax.local_devices()[0],
+                 if d.process_index == jax.process_index()),
+                jax.local_devices()[0],
             )
             steps, pipeline = autotune_decode_schedule(self.mcfg, local)
             if config.decode_steps is None:
@@ -2389,9 +2385,17 @@ class TpuEngine:
         dropped. The router view stays honest: a g1 clear publishes a
         wholesale CLEARED event for this worker; tier clears ride the
         consolidated removed-event path."""
-        if levels is not None and not isinstance(levels, (list, tuple)):
+        if levels is not None and (
+            not isinstance(levels, (list, tuple))
+            or any(not isinstance(lv, str) for lv in levels)
+        ):
             raise ValueError("levels must be a list of tier names")
-        levels = [lv.lower() for lv in (levels or ["g1", "g2", "g3"])]
+        # None = clear everything; an explicit empty list clears nothing
+        # (same semantics as the mocker)
+        levels = [
+            lv.lower()
+            for lv in (levels if levels is not None else ["g1", "g2", "g3"])
+        ]
         result: Dict[str, Any] = {}
         if "g1" in levels:
             before = self.allocator.cached_blocks

@@ -448,7 +448,10 @@ class MockerEngine:
         (pinned) blocks stay; the evictable cache empties and the router gets
         a wholesale CLEARED for this worker. The mocker only has a g1: a
         levels list that excludes g1 is a no-op, same as the real engine."""
-        if levels is not None and not isinstance(levels, (list, tuple)):
+        if levels is not None and (
+            not isinstance(levels, (list, tuple))
+            or any(not isinstance(lv, str) for lv in levels)
+        ):
             raise ValueError("levels must be a list of tier names")
         result: Dict[str, Any] = {}
         if levels is None or "g1" in [lv.lower() for lv in levels]:

@@ -76,9 +76,13 @@ def _prefill_kernel(
     def _tile():
         scale = 1.0 / (d ** 0.5)
         q2 = (q_ref[0].astype(jnp.float32) * scale).reshape(TQ * g, d)
-        row = jax.lax.broadcasted_iota(jnp.int32, (TQ, g), 0)  # row idx per (q, g)
+        # row index per flattened (q, g) pair, built directly in the
+        # [TQ*g, 1] layout: reshaping a (TQ, g) iota would shape-cast across
+        # the lane dim, which Mosaic rejects (infer-vector-layout error on
+        # real TPU); iota//g keeps the lane dim fixed at 1 throughout
+        row = jax.lax.broadcasted_iota(jnp.int32, (TQ * g, 1), 0) // g
         pos = start + qt * TQ + row
-        lim2 = jnp.minimum(pos + 1, tlen).reshape(TQ * g, 1)
+        lim2 = jnp.minimum(pos + 1, tlen)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
