@@ -32,7 +32,13 @@ def parse_args():
     p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     p.add_argument("--router-temperature", type=float, default=0.0)
     p.add_argument("--no-kv-events", action="store_true")
-    return p.parse_args()
+    p.add_argument("--tls-cert-path", default=None,
+                   help="serve HTTPS with this PEM cert (requires --tls-key-path)")
+    p.add_argument("--tls-key-path", default=None)
+    args = p.parse_args()
+    if bool(args.tls_cert_path) != bool(args.tls_key_path):
+        p.error("--tls-cert-path and --tls-key-path must be given together")
+    return args
 
 
 async def main() -> None:
@@ -59,6 +65,7 @@ async def main() -> None:
     service = HttpService(
         manager, runtime.metrics, busy_threshold=args.busy_threshold,
         host=args.host, port=args.port, stats_hook=stats.on_request,
+        tls_cert=args.tls_cert_path, tls_key=args.tls_key_path,
     )
     await service.start()
     grpc_service = None

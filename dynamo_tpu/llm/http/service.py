@@ -133,6 +133,8 @@ class HttpService:
         tracer: Optional[Tracer] = None,
         audit_bus: Optional[AuditBus] = None,
         stats_hook=None,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
     ):
         # stats_hook(prompt_tokens, completion_tokens, ttft_s, itl_s) fires
         # once per completed generation — the planner's demand/correction
@@ -166,6 +168,12 @@ class HttpService:
         self._output_tokens = self.metrics.counter(
             M.OUTPUT_TOKENS, "output tokens", extra_labels=(M.LABEL_MODEL,)
         )
+        # HTTPS serving (reference frontend --tls-cert-path/--tls-key-path):
+        # both paths or neither; the context is built at start()
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError("tls_cert and tls_key must be given together")
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         self._runner: Optional[web.AppRunner] = None
         self.app = self._build_app()
 
@@ -186,13 +194,22 @@ class HttpService:
         return app
 
     async def start(self) -> str:
+        ssl_ctx = None
+        if self.tls_cert:
+            import ssl
+
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self.tls_cert, self.tls_key)
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port, ssl_context=ssl_ctx)
         await site.start()
         actual = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
         self.port = actual
-        log.info("OpenAI HTTP frontend listening on %s:%d", self.host, actual)
+        log.info(
+            "OpenAI %s frontend listening on %s:%d",
+            "HTTPS" if ssl_ctx else "HTTP", self.host, actual,
+        )
         return f"{self.host}:{actual}"
 
     async def stop(self) -> None:

@@ -487,3 +487,53 @@ async def test_images_endpoint():
         await served.stop()
         await worker_rt.shutdown()
         await frontend_rt.shutdown()
+
+
+async def test_https_serving(tmp_path):
+    """TLS termination at the frontend (reference frontend/main.py
+    --tls-cert-path/--tls-key-path): self-signed cert, HTTPS round-trip."""
+    import shutil
+    import ssl
+    import subprocess
+
+    import pytest
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl binary not available")
+    cert, key = tmp_path / "crt.pem", tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    store = MemKVStore()
+    worker_rt, frontend_rt, served, watcher, plain, _ = await start_stack(store)
+    service = HttpService(
+        manager=watcher.manager, host="127.0.0.1", port=0,
+        tls_cert=str(cert), tls_key=str(key),
+    )
+    await service.start()
+    try:
+        ctx = ssl.create_default_context(cafile=str(cert))
+        ctx.check_hostname = False
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(
+                f"https://127.0.0.1:{service.port}/v1/models", ssl=ctx
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["data"][0]["id"] == "echo-model"
+        # plain HTTP against the TLS port must fail
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(
+                    f"http://127.0.0.1:{service.port}/v1/models",
+                    timeout=aiohttp.ClientTimeout(total=3),
+                )
+                assert r.status >= 400
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass
+    finally:
+        await service.stop()
+        await stop_stack(worker_rt, frontend_rt, served, watcher, plain)
