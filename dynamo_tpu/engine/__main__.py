@@ -20,6 +20,7 @@ from dynamo_tpu.engine.weights import config_from_hf, load_params
 from dynamo_tpu.kv_router import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm import ModelDeploymentCard, ModelRuntimeConfig, register_llm
 from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.models.mla import MlaConfig
 from dynamo_tpu.models.moe import MoeConfig
 from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
 from dynamo_tpu.runtime.component import new_instance_id
@@ -31,6 +32,10 @@ PRESETS = {
     "llama3-70b": LlamaConfig.llama3_70b,
     "tiny-moe": MoeConfig.tiny_moe,
     "qwen3-30b-a3b": MoeConfig.qwen3_30b_a3b,
+    "tiny-mla": MlaConfig.tiny_mla,
+    "tiny-mla-moe": MlaConfig.tiny_mla_moe,
+    "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,
+    "deepseek-v3": MlaConfig.deepseek_v3,
     "tiny-vl": lambda: LlamaConfig(),  # language side; vision below
 }
 

@@ -292,10 +292,11 @@ class TpuEngine:
                     or config.sp > 1 or kvbm is not None
                     or config.logits_processors
                     or registry.is_moe(self.mcfg)
+                    or registry.is_mla(self.mcfg)
                     or config.use_pallas):
                 raise ValueError(
                     "pp serving covers the core dense text path (no LoRA/"
-                    "vision/sp/kvbm/logits-processors/MoE/pallas yet)"
+                    "vision/sp/kvbm/logits-processors/MoE/MLA/pallas yet)"
                 )
             if mesh is None:
                 mesh = pp_serving.make_pp_mesh(pp=config.pp, tp=config.tp)
@@ -445,7 +446,7 @@ class TpuEngine:
         # multi-LoRA adapter tables (static shapes; see lora/adapters.py)
         self.lora = None
         if config.lora_max_adapters > 0:
-            if registry.is_moe(self.mcfg):
+            if registry.is_moe(self.mcfg) or registry.is_mla(self.mcfg):
                 raise ValueError("LoRA serving covers the dense family only")
             from ..lora import LoraAdapterTable
 
@@ -521,7 +522,10 @@ class TpuEngine:
             self.mcfg.num_kv_heads,
             self.mcfg.head_dim,
         )
-        sharding = NamedSharding(self.mesh, meshlib.kv_cache_spec())
+        sharding = NamedSharding(
+            self.mesh,
+            registry.kv_cache_spec(self.mcfg, meshlib.tp_size(self.mesh)),
+        )
         # host-side zeros: device_put shards them per-process (jnp.zeros would
         # commit to the local default device — invalid for a multi-host mesh)
         zeros = partial(np.zeros, shape, self.mcfg.dtype)
@@ -737,9 +741,14 @@ class TpuEngine:
         use_pallas = cfg.use_pallas
         if use_pallas is None:
             # Mosaic DMA slices need the minor dim 128-aligned; head_dim is
-            # the page's minor dim, so odd head sizes fall back to pure JAX
+            # the page's minor dim, so odd head sizes fall back to pure JAX.
+            # The shard_map'd kernel also shards the cache on kv_heads, so a
+            # cache with fewer kv heads than TP shards (MQA / MLA latent)
+            # falls back to the GSPMD pure-JAX path.
             use_pallas = (
-                jax.default_backend() == "tpu" and mcfg.head_dim % 128 == 0
+                jax.default_backend() == "tpu"
+                and mcfg.head_dim % 128 == 0
+                and mcfg.num_kv_heads % meshlib.tp_size(self.mesh) == 0
             )
         if use_pallas:
             from ..ops import pallas_attention as pa

@@ -145,6 +145,7 @@ async def import_pages_device(dst, hashes: List[SequenceHash], kp, vp) -> Option
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..models import registry
     from ..parallel import mesh as meshlib
     from .allocator import OutOfBlocks
 
@@ -155,7 +156,10 @@ async def import_pages_device(dst, hashes: List[SequenceHash], kp, vp) -> Option
     except OutOfBlocks:
         log.warning("device import: no room for %d blocks on dest", n)
         return 0
-    dst_sh = NamedSharding(dst.mesh, P(None, *meshlib.kv_cache_spec()))
+    dst_sh = NamedSharding(
+        dst.mesh,
+        P(None, *registry.kv_cache_spec(dst.mcfg, meshlib.tp_size(dst.mesh))),
+    )
 
     def scatter():
         kpd = jax.device_put(kp, dst_sh)

@@ -67,6 +67,8 @@ def _cfg_from_obj(obj: Optional[Dict[str, Any]]) -> Any:
     kind = d.pop("__kind__", "LlamaConfig")
     if kind == "MoeConfig":
         from ..models.moe import MoeConfig as cls
+    elif kind == "MlaConfig":
+        from ..models.mla import MlaConfig as cls
     else:
         from ..models.llama import LlamaConfig as cls
     dt = d.get("dtype")

@@ -12,6 +12,7 @@ import signal
 from dynamo_tpu.kv_router import KvRouterConfig
 from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
 from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.request_template import RequestTemplate
 from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig, init_logging
 
 
@@ -35,6 +36,10 @@ def parse_args():
     p.add_argument("--tls-cert-path", default=None,
                    help="serve HTTPS with this PEM cert (requires --tls-key-path)")
     p.add_argument("--tls-key-path", default=None)
+    p.add_argument("--request-template", default=None,
+                   help="JSON file with default model/temperature/"
+                        "max_completion_tokens applied to requests that "
+                        "omit them")
     args = p.parse_args()
     if bool(args.tls_cert_path) != bool(args.tls_key_path):
         p.error("--tls-cert-path and --tls-key-path must be given together")
@@ -66,6 +71,10 @@ async def main() -> None:
         manager, runtime.metrics, busy_threshold=args.busy_threshold,
         host=args.host, port=args.port, stats_hook=stats.on_request,
         tls_cert=args.tls_cert_path, tls_key=args.tls_key_path,
+        request_template=(
+            RequestTemplate.load(args.request_template)
+            if args.request_template else None
+        ),
     )
     await service.start()
     grpc_service = None

@@ -135,6 +135,7 @@ class HttpService:
         stats_hook=None,
         tls_cert: Optional[str] = None,
         tls_key: Optional[str] = None,
+        request_template=None,
     ):
         # stats_hook(prompt_tokens, completion_tokens, ttft_s, itl_s) fires
         # once per completed generation — the planner's demand/correction
@@ -174,6 +175,9 @@ class HttpService:
             raise ValueError("tls_cert and tls_key must be given together")
         self.tls_cert = tls_cert
         self.tls_key = tls_key
+        # optional llm.request_template.RequestTemplate: fills model /
+        # temperature / max_completion_tokens on requests that omit them
+        self.request_template = request_template
         self._runner: Optional[web.AppRunner] = None
         self.app = self._build_app()
 
@@ -606,6 +610,8 @@ class HttpService:
             return busy
         try:
             body = await request.json()
+            if self.request_template is not None:
+                body = self.request_template.apply(body)
             req = ChatCompletionRequest.model_validate(body)
         except (json.JSONDecodeError, ValueError) as e:
             return _error(400, f"invalid request: {e}")
@@ -904,6 +910,8 @@ class HttpService:
             return busy
         try:
             body = await request.json()
+            if self.request_template is not None:
+                body = self.request_template.apply(body)
             req = CompletionRequest.model_validate(body)
         except (json.JSONDecodeError, ValueError) as e:
             return _error(400, f"invalid request: {e}")

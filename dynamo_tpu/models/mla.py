@@ -1,0 +1,405 @@
+"""Multi-head Latent Attention (MLA) family: DeepSeek V2/V3/R1-style models
+in functional JAX.
+
+What the reference serves through engine adapters (recipes/deepseek-r1/,
+trtllm/sglang workers), this framework owns as first-class model code, the
+same way models/llama.py owns the dense family.
+
+TPU-first design — the KV cache holds the COMPRESSED latent:
+
+MLA projects hidden states down to a small shared latent ``c`` (kv_lora_rank
+floats) plus one decoupled RoPE key ``k_pe`` (qk_rope_head_dim floats) per
+token; per-head K/V are up-projections of ``c``. The serving win is the
+"weight absorption" identity: folding the K up-projection into the query and
+the V up-projection past the softmax turns attention into **MQA over the
+latent**, so the cache per token is ``kv_lora_rank + qk_rope_head_dim``
+floats instead of ``2 * heads * head_dim`` (DeepSeek V3: 576 vs 32768 — a
+57x smaller cache, and decode on TPU is HBM-bandwidth-bound on exactly that
+gather traffic):
+
+    score_h(i) = q_nope_h . (W_uk_h c_i) + q_pe_h . k_pe_i
+               = concat(W_uk_h^T q_nope_h, q_pe_h) . concat(c_i, k_pe_i)
+    out_h      = W_uv_h (sum_i p_i c_i)
+
+This maps onto the engine's existing attend contract with no engine changes:
+``num_kv_heads = 1`` and ``head_dim = kv_lora_rank + qk_rope_head_dim``; the
+cached "k" is ``concat(c, k_pe)``, the cached "v" is ``c`` zero-padded to
+the same width, and the model applies ``W_uv`` to the attend output's first
+``kv_lora_rank`` lanes. All paged/chunked/ring attention paths work
+unchanged. Two subtleties:
+
+- softmax scale: the engine's attention ops scale by 1/sqrt(q.shape[-1]);
+  MLA wants 1/sqrt(qk_nope_head_dim + qk_rope_head_dim). The query is
+  pre-multiplied by the ratio so the net scale is correct.
+- TP: q heads (w_uq/w_uk/w_uv/wo) shard over the tp axis; the latent
+  projections and the 1-head latent cache are replicated (an MQA cache
+  cannot shard on heads — same layout real MLA deployments use).
+
+FFN is the dense SwiGLU for ``num_experts == 0``, otherwise DeepSeek-MoE
+style: ``first_dense_layers`` leading dense layers, sigmoid-or-softmax
+top-k routing with ``routed_scaling_factor``, optional always-on shared
+experts, reusing models/moe.py's expert kernels (gather / dense / EP-psum).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .llama import (
+    AttendFn,
+    LlamaConfig,
+    Params,
+    apply_rope,
+    rms_norm,
+    rope_cos_sin,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MlaConfig(LlamaConfig):
+    # attention (latent) dims
+    q_lora_rank: int = 0            # 0 = full-rank q projection (V2-Lite)
+    kv_lora_rank: int = 64
+    qk_nope_head_dim: int = 32
+    qk_rope_head_dim: int = 16
+    v_head_dim: int = 32
+    # MoE FFN (num_experts == 0 -> dense SwiGLU everywhere)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+    moe_scoring: str = "softmax"    # "sigmoid" = DeepSeek-V3 style
+    routed_scaling_factor: float = 1.0
+    num_shared_experts: int = 0
+    first_dense_layers: int = 0
+
+    def __post_init__(self):
+        # the engine reads num_kv_heads/head_dim as the KV-cache layout;
+        # for MLA that layout IS the latent — pin it so presets can't drift
+        object.__setattr__(self, "num_kv_heads", 1)
+        object.__setattr__(
+            self, "head_dim", self.kv_lora_rank + self.qk_rope_head_dim
+        )
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def q_size(self) -> int:  # true q projection width (lora sizing etc.)
+        return self.num_heads * self.qk_head_dim
+
+    @classmethod
+    def tiny_mla(cls, **kw) -> "MlaConfig":
+        defaults = dict(
+            vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+            kv_lora_rank=64, qk_nope_head_dim=32, qk_rope_head_dim=16,
+            v_head_dim=32, intermediate_size=256, dtype=jnp.float32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def tiny_mla_moe(cls, **kw) -> "MlaConfig":
+        defaults = dict(
+            vocab_size=512, hidden_size=128, num_layers=3, num_heads=4,
+            kv_lora_rank=64, qk_nope_head_dim=32, qk_rope_head_dim=16,
+            v_head_dim=32, intermediate_size=256, q_lora_rank=96,
+            num_experts=4, num_experts_per_tok=2, moe_intermediate_size=64,
+            moe_scoring="sigmoid", routed_scaling_factor=2.0,
+            num_shared_experts=1, first_dense_layers=1, dtype=jnp.float32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def deepseek_v2_lite(cls, vocab_size: int = 102400) -> "MlaConfig":
+        """DeepSeek-V2-Lite (15.7B total / 2.4B active)."""
+        return cls(
+            vocab_size=vocab_size, hidden_size=2048, num_layers=27,
+            num_heads=16, q_lora_rank=0, kv_lora_rank=512,
+            qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+            intermediate_size=10944, num_experts=64, num_experts_per_tok=6,
+            moe_intermediate_size=1408, num_shared_experts=2,
+            norm_topk_prob=False,  # V2-Lite uses unnormalized top-k weights
+            first_dense_layers=1, rope_theta=10000.0, tie_embeddings=False,
+        )
+
+    @classmethod
+    def deepseek_v3(cls, vocab_size: int = 129280) -> "MlaConfig":
+        """DeepSeek-V3 / R1 (671B total / 37B active). head_dim = 576 is not
+        128-aligned, so attention runs the pure-JAX paged path (the Pallas
+        eligibility guard falls back automatically)."""
+        return cls(
+            vocab_size=vocab_size, hidden_size=7168, num_layers=61,
+            num_heads=128, q_lora_rank=1536, kv_lora_rank=512,
+            qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+            intermediate_size=18432, num_experts=256, num_experts_per_tok=8,
+            moe_intermediate_size=2048, moe_scoring="sigmoid",
+            routed_scaling_factor=2.5, norm_topk_prob=True,
+            num_shared_experts=1, first_dense_layers=3,
+            rope_theta=10000.0, tie_embeddings=False,
+        )
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def _is_moe_layer(cfg: MlaConfig, layer_idx: int) -> bool:
+    return cfg.num_experts > 0 and layer_idx >= cfg.first_dense_layers
+
+
+def init_layer_params(rng: jax.Array, cfg: MlaConfig, layer_idx: int) -> Params:
+    k = jax.random.split(rng, 16)
+    h = cfg.hidden_size
+    nh, rank = cfg.num_heads, cfg.kv_lora_rank
+    nope, rope, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    scale = 1.0 / math.sqrt(h)
+    p: Params = {
+        "attn_norm": jnp.ones((h,), cfg.dtype),
+        "mlp_norm": jnp.ones((h,), cfg.dtype),
+        # KV latent: one down-projection emitting [c (rank) | k_pe (rope)]
+        "w_dkv": (jax.random.normal(k[0], (h, rank + rope)) * scale).astype(cfg.dtype),
+        "kv_norm": jnp.ones((rank,), cfg.dtype),
+        # per-head up-projections, head-stacked so TP shards the head dim
+        "w_uk": (
+            jax.random.normal(k[1], (nh, nope, rank)) / math.sqrt(rank)
+        ).astype(cfg.dtype),
+        "w_uv": (
+            jax.random.normal(k[2], (nh, rank, vd)) / math.sqrt(rank)
+        ).astype(cfg.dtype),
+        "wo": (jax.random.normal(k[3], (nh * vd, h)) * scale).astype(cfg.dtype),
+    }
+    if cfg.q_lora_rank > 0:
+        p["w_dq"] = (
+            jax.random.normal(k[4], (h, cfg.q_lora_rank)) * scale
+        ).astype(cfg.dtype)
+        p["q_norm"] = jnp.ones((cfg.q_lora_rank,), cfg.dtype)
+        p["w_uq"] = (
+            jax.random.normal(k[5], (cfg.q_lora_rank, nh * (nope + rope)))
+            / math.sqrt(cfg.q_lora_rank)
+        ).astype(cfg.dtype)
+    else:
+        p["wq"] = (
+            jax.random.normal(k[5], (h, nh * (nope + rope))) * scale
+        ).astype(cfg.dtype)
+    if _is_moe_layer(cfg, layer_idx):
+        E, inter = cfg.num_experts, cfg.moe_intermediate_size
+        iscale = 1.0 / math.sqrt(inter)
+        p["w_router"] = (jax.random.normal(k[6], (h, E)) * scale).astype(cfg.dtype)
+        p["w_gate"] = (jax.random.normal(k[7], (E, h, inter)) * scale).astype(cfg.dtype)
+        p["w_up"] = (jax.random.normal(k[8], (E, h, inter)) * scale).astype(cfg.dtype)
+        p["w_down"] = (jax.random.normal(k[9], (E, inter, h)) * iscale).astype(cfg.dtype)
+        if cfg.num_shared_experts > 0:
+            si = inter * cfg.num_shared_experts
+            p["w_shared_gate"] = (
+                jax.random.normal(k[10], (h, si)) * scale
+            ).astype(cfg.dtype)
+            p["w_shared_up"] = (
+                jax.random.normal(k[11], (h, si)) * scale
+            ).astype(cfg.dtype)
+            p["w_shared_down"] = (
+                jax.random.normal(k[12], (si, h)) / math.sqrt(si)
+            ).astype(cfg.dtype)
+    else:
+        inter = cfg.intermediate_size
+        iscale = 1.0 / math.sqrt(inter)
+        p["w_gate"] = (jax.random.normal(k[7], (h, inter)) * scale).astype(cfg.dtype)
+        p["w_up"] = (jax.random.normal(k[8], (h, inter)) * scale).astype(cfg.dtype)
+        p["w_down"] = (jax.random.normal(k[9], (inter, h)) * iscale).astype(cfg.dtype)
+    return p
+
+
+def init_params(rng: jax.Array, cfg: MlaConfig) -> Params:
+    keys = jax.random.split(rng, cfg.num_layers + 2)
+    params: Params = {
+        "embed": (
+            jax.random.normal(keys[0], (cfg.vocab_size, cfg.hidden_size)) * 0.02
+        ).astype(cfg.dtype),
+        "final_norm": jnp.ones((cfg.hidden_size,), cfg.dtype),
+        "layers": [
+            init_layer_params(keys[i + 2], cfg, i) for i in range(cfg.num_layers)
+        ],
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(keys[1], (cfg.hidden_size, cfg.vocab_size)) * 0.02
+        ).astype(cfg.dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# routing (DeepSeek flavors) + FFN
+# ---------------------------------------------------------------------------
+
+
+def route(p: Params, cfg: MlaConfig, x: jax.Array):
+    """Top-k router: softmax (V2) or sigmoid with normalized top-k weights
+    (V3), times routed_scaling_factor. x [T, H] -> (weights [T,K] f32,
+    idx [T,K])."""
+    logits = (x @ p["w_router"]).astype(jnp.float32)
+    if cfg.moe_scoring == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+    topw, topi = jax.lax.top_k(scores, cfg.num_experts_per_tok)
+    if cfg.norm_topk_prob:
+        topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+    return topw * cfg.routed_scaling_factor, topi
+
+
+def _moe_ffn(p: Params, cfg: MlaConfig, x: jax.Array) -> jax.Array:
+    """Routed experts (moe.py gather kernel under this module's router) +
+    the always-on shared-expert SwiGLU."""
+    topw, topi = route(p, cfg, x)
+    y = jnp.zeros_like(x)
+    for k in range(cfg.num_experts_per_tok):
+        idx = topi[:, k]
+        gate = jnp.einsum("th,thi->ti", x, p["w_gate"][idx])
+        up = jnp.einsum("th,thi->ti", x, p["w_up"][idx])
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        y = y + topw[:, k, None].astype(x.dtype) * jnp.einsum(
+            "ti,tih->th", act, p["w_down"][idx]
+        )
+    if cfg.num_shared_experts > 0:
+        sg = jax.nn.silu((x @ p["w_shared_gate"]).astype(jnp.float32)).astype(x.dtype)
+        y = y + (sg * (x @ p["w_shared_up"])) @ p["w_shared_down"]
+    return y
+
+
+def _dense_ffn(p: Params, cfg: MlaConfig, x: jax.Array) -> jax.Array:
+    gate = jax.nn.silu((x @ p["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    return (gate * (x @ p["w_up"])) @ p["w_down"]
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def layer_forward(
+    p: Params,
+    cfg: MlaConfig,
+    x: jax.Array,                 # [..., S, hidden]
+    cos: jax.Array,               # [..., S, 1, rope/2]
+    sin: jax.Array,
+    attend: AttendFn,
+    layer_idx: int,
+) -> jax.Array:
+    nh, rank = cfg.num_heads, cfg.kv_lora_rank
+    nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    lead = x.shape[:-1]           # [..., S]
+
+    h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    # -- queries
+    if cfg.q_lora_rank > 0:
+        q = rms_norm(h @ p["w_dq"], p["q_norm"], cfg.rms_norm_eps) @ p["w_uq"]
+    else:
+        q = h @ p["wq"]
+    q = q.reshape(*lead, nh, nope + rope)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    q_pe = apply_rope(q_pe, cos, sin)
+    # -- latent KV
+    ckv = h @ p["w_dkv"]                                   # [..., rank+rope]
+    c = rms_norm(ckv[..., :rank], p["kv_norm"], cfg.rms_norm_eps)
+    k_pe = apply_rope(ckv[..., None, rank:], cos, sin)     # [..., 1, rope]
+    # -- absorb W_uk into q: MQA over the latent
+    q_abs = jnp.einsum("...hn,hnr->...hr", q_nope, p["w_uk"])
+    q_prime = jnp.concatenate([q_abs, q_pe], axis=-1)      # [..., nh, rank+rope]
+    # attend ops scale by 1/sqrt(rank+rope); MLA wants 1/sqrt(nope+rope)
+    q_prime = q_prime * math.sqrt((rank + rope) / (nope + rope))
+    k_prime = jnp.concatenate([c[..., None, :], k_pe], axis=-1)
+    cl = c[..., None, :]                                   # [..., 1, rank]
+    v_prime = jnp.pad(
+        cl, [(0, 0)] * (cl.ndim - 1) + [(0, rope)]
+    )
+    o = attend(
+        q_prime.astype(cfg.dtype), k_prime.astype(cfg.dtype),
+        v_prime.astype(cfg.dtype), layer_idx,
+    )                                                      # [..., nh, rank+rope]
+    # -- un-absorb W_uv past the softmax
+    attn = jnp.einsum("...hr,hrv->...hv", o[..., :rank], p["w_uv"])
+    x = x + attn.reshape(*lead, nh * cfg.v_head_dim) @ p["wo"]
+    # -- FFN
+    h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+    if _is_moe_layer(cfg, layer_idx):
+        # routing indexes per token: flatten leading dims to [T, H]
+        flat = h.reshape(-1, h.shape[-1])
+        return x + _moe_ffn(p, cfg, flat).reshape(h.shape)
+    return x + _dense_ffn(p, cfg, h)
+
+
+def forward(
+    params: Params,
+    cfg: MlaConfig,
+    token_ids: jax.Array,        # [S] int32
+    positions: jax.Array,        # [S] int32
+    attend: AttendFn,
+    lora: Optional[Callable] = None,
+    inputs_embeds: Optional[jax.Array] = None,
+) -> jax.Array:
+    if lora is not None:
+        raise NotImplementedError("LoRA is not supported for the MLA family")
+    x = params["embed"][token_ids] if inputs_embeds is None else inputs_embeds
+    cos, sin = rope_cos_sin(positions, cfg.qk_rope_head_dim, cfg.rope_theta)
+    cos, sin = cos[..., None, :], sin[..., None, :]
+    for i, layer in enumerate(params["layers"]):
+        x = layer_forward(layer, cfg, x, cos, sin, attend, i)
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+def lm_logits(params: Params, cfg: MlaConfig, hidden: jax.Array) -> jax.Array:
+    if cfg.tie_embeddings:
+        return (hidden @ params["embed"].T).astype(jnp.float32)
+    return (hidden @ params["lm_head"]).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# reference (uncompressed) attention — test oracle
+# ---------------------------------------------------------------------------
+
+
+def reference_attention(
+    p: Params, cfg: MlaConfig, h_normed: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Causal MLA attention with K/V fully materialized per head (no
+    absorption, no latent cache) — the semantics the absorbed/MQA serving
+    path must reproduce. Returns the post-``wo`` projection delta [S, H]."""
+    nh, rank = cfg.num_heads, cfg.kv_lora_rank
+    nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    S = h_normed.shape[0]
+    cos, sin = rope_cos_sin(positions, rope, cfg.rope_theta)
+    cos, sin = cos[..., None, :], sin[..., None, :]
+    if cfg.q_lora_rank > 0:
+        q = rms_norm(h_normed @ p["w_dq"], p["q_norm"], cfg.rms_norm_eps) @ p["w_uq"]
+    else:
+        q = h_normed @ p["wq"]
+    q = q.reshape(S, nh, nope + rope)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    q_pe = apply_rope(q_pe, cos, sin)
+    ckv = h_normed @ p["w_dkv"]
+    c = rms_norm(ckv[..., :rank], p["kv_norm"], cfg.rms_norm_eps)
+    k_pe = apply_rope(ckv[..., None, rank:], cos, sin)[:, 0]   # [S, rope]
+    # materialize per-head K (nope part) and V from the latent
+    k_nope = jnp.einsum("sr,hnr->shn", c, p["w_uk"])           # [S, nh, nope]
+    v = jnp.einsum("sr,hrv->shv", c, p["w_uv"])                # [S, nh, vd]
+    k = jnp.concatenate(
+        [k_nope, jnp.broadcast_to(k_pe[:, None, :], (S, nh, rope))], axis=-1
+    )
+    qf = jnp.concatenate([q_nope, q_pe], axis=-1).astype(jnp.float32)
+    s = jnp.einsum("shd,thd->hst", qf, k.astype(jnp.float32))
+    s = s / math.sqrt(nope + rope)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask[None], s, -1e30)
+    pattn = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("hst,thv->shv", pattn, v.astype(jnp.float32))
+    return (
+        o.astype(cfg.dtype).reshape(S, nh * cfg.v_head_dim) @ p["wo"]
+    )

@@ -12,15 +12,27 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import AXIS_TP
-from . import llama, moe
+from . import llama, mla, moe
 
 
 def is_moe(cfg) -> bool:
-    return isinstance(cfg, moe.MoeConfig)
+    # MLA carries its own (DeepSeek-style) MoE FFN; only MoeConfig routes
+    # through moe.py's forward here
+    return isinstance(cfg, moe.MoeConfig) and not is_mla(cfg)
+
+
+def is_mla(cfg) -> bool:
+    return isinstance(cfg, mla.MlaConfig)
+
+
+def family(cfg):
+    if is_mla(cfg):
+        return mla
+    return moe if is_moe(cfg) else llama
 
 
 def init_params(rng, cfg):
-    return (moe if is_moe(cfg) else llama).init_params(rng, cfg)
+    return family(cfg).init_params(rng, cfg)
 
 
 def forward_fn(cfg, mesh=None):
@@ -33,6 +45,11 @@ def forward_fn(cfg, mesh=None):
       moe_ffn_ep_psum — each shard computes only its local experts, one
       psum combines (same collective as a TP row matmul)
     """
+    if is_mla(cfg):
+        # MLA's MoE layers use the per-token gather kernel (exact, sparse);
+        # experts stay replicated — the latent-MQA cache already binds the
+        # family to replicated-KV TP, and EP sharding can follow later
+        return mla.forward
     if not is_moe(cfg):
         return llama.forward
     # the gather path materializes [T, H, I] per-token weight copies: a win
@@ -68,7 +85,7 @@ def forward_fn(cfg, mesh=None):
 
 
 def lm_logits_fn(cfg):
-    return (moe if is_moe(cfg) else llama).lm_logits
+    return family(cfg).lm_logits
 
 
 def param_specs(cfg) -> dict:
@@ -93,7 +110,38 @@ def param_specs(cfg) -> dict:
         "bk": P(AXIS_TP),
         "bv": P(AXIS_TP),
     }
-    if is_moe(cfg):
+    if is_mla(cfg):
+        # q heads shard over TP (head-stacked w_uk/w_uv, column-parallel
+        # w_uq/wq, row-parallel wo); the shared latent projections and the
+        # 1-head latent KV stay replicated. Experts replicated (gather FFN).
+        layer.update({
+            "wq": P(None, AXIS_TP),
+            "w_uq": P(None, AXIS_TP),
+            "w_dq": P(),
+            "w_dkv": P(),
+            "w_uk": P(AXIS_TP, None, None),
+            "w_uv": P(AXIS_TP, None, None),
+            "wo": P(AXIS_TP, None),
+            "w_router": P(),
+            "w_shared_gate": P(None, AXIS_TP),
+            "w_shared_up": P(None, AXIS_TP),
+            "w_shared_down": P(AXIS_TP, None),
+        })
+        if cfg.num_experts > 0:
+            # dense first_dense_layers use 2-D gate/up/down, MoE layers 3-D
+            # expert stacks; both replicated is the safe common spec — the
+            # per-layer dict can't distinguish, and the gather FFN reads
+            # full expert tables anyway
+            layer.update({
+                "w_gate": P(), "w_up": P(), "w_down": P(),
+            })
+        else:
+            layer.update({
+                "w_gate": P(None, AXIS_TP),
+                "w_up": P(None, AXIS_TP),
+                "w_down": P(AXIS_TP, None),
+            })
+    elif is_moe(cfg):
         layer.update({
             "w_router": P(None, None),
             "w_gate": P(AXIS_TP, None, None),
@@ -107,3 +155,17 @@ def param_specs(cfg) -> dict:
             "w_down": P(AXIS_TP, None),
         })
     return {"top": top, "layer": layer, "default": P()}
+
+
+def kv_cache_spec(cfg, tp: int = 1) -> P:
+    """Paged-KV sharding for the family. Caches shard kv_heads over TP when
+    they divide evenly; otherwise (MQA / MLA-latent 1-head caches, or GQA
+    with fewer kv heads than TP shards) the cache replicates — the layout
+    real MLA deployments use, and the same condition the engine's Pallas
+    eligibility check uses."""
+    from ..parallel import mesh as meshlib
+
+    kvh = getattr(cfg, "num_kv_heads", 0)
+    if kvh == 1 or (tp > 1 and kvh % tp != 0):
+        return P(None, None, None, None)
+    return meshlib.kv_cache_spec()

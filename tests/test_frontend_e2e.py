@@ -537,3 +537,51 @@ async def test_https_serving(tmp_path):
     finally:
         await service.stop()
         await stop_stack(worker_rt, frontend_rt, served, watcher, plain)
+
+
+async def test_request_template(tmp_path):
+    """Template defaults (reference request_template.rs +
+    openai.rs:892-901): fills model/temperature/max_completion_tokens only
+    when the request omits them."""
+    from dynamo_tpu.llm.request_template import RequestTemplate
+
+    tpl_file = tmp_path / "tpl.json"
+    tpl_file.write_text(json.dumps(
+        {"model": "echo-model", "temperature": 0.5, "max_completion_tokens": 4}
+    ))
+    tpl = RequestTemplate.load(str(tpl_file))
+    # unit: request wins over template
+    assert tpl.apply({"model": "other"})["model"] == "other"
+    assert tpl.apply({})["model"] == "echo-model"
+    assert tpl.apply({"temperature": 0.0})["temperature"] == 0.0
+    assert tpl.apply({"max_tokens": 9}).get("max_completion_tokens") is None
+    # unknown template keys are a load error
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"model": "m", "stop": ["x"]}))
+    try:
+        RequestTemplate.load(str(bad))
+        raise AssertionError("unknown keys accepted")
+    except ValueError:
+        pass
+
+    store = MemKVStore()
+    worker_rt, frontend_rt, served, watcher, plain, _ = await start_stack(store)
+    service = HttpService(
+        manager=watcher.manager, host="127.0.0.1", port=0, request_template=tpl
+    )
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            # request with no model at all: template routes it
+            r = await s.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi there"}]},
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["model"] == "echo-model"
+            # max_completion_tokens=4 capped the echo
+            assert body["usage"]["completion_tokens"] <= 4
+    finally:
+        await service.stop()
+        await stop_stack(worker_rt, frontend_rt, served, watcher, plain)
