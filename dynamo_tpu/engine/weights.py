@@ -24,6 +24,8 @@ log = get_logger("engine.weights")
 def config_from_hf(path: str) -> LlamaConfig:
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
+    if hf.get("model_type", "") in ("deepseek_v2", "deepseek_v3"):
+        return _mla_config_from_hf(hf)
     head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
     return LlamaConfig(
         vocab_size=hf["vocab_size"],
@@ -43,6 +45,39 @@ def config_from_hf(path: str) -> LlamaConfig:
     )
 
 
+def _mla_config_from_hf(hf: dict):
+    """DeepSeek V2/V3 config.json -> MlaConfig (models/mla.py)."""
+    from ..models.mla import MlaConfig
+
+    return MlaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        q_lora_rank=hf.get("q_lora_rank") or 0,
+        kv_lora_rank=hf["kv_lora_rank"],
+        qk_nope_head_dim=hf["qk_nope_head_dim"],
+        qk_rope_head_dim=hf["qk_rope_head_dim"],
+        v_head_dim=hf["v_head_dim"],
+        intermediate_size=hf["intermediate_size"],
+        num_experts=hf.get("n_routed_experts") or 0,
+        num_experts_per_tok=hf.get("num_experts_per_tok") or 2,
+        moe_intermediate_size=hf.get("moe_intermediate_size") or 0,
+        norm_topk_prob=hf.get("norm_topk_prob", True),
+        moe_scoring=hf.get("scoring_func", "sigmoid"),
+        routed_scaling_factor=hf.get("routed_scaling_factor", 1.0),
+        num_shared_experts=hf.get("n_shared_experts") or 0,
+        first_dense_layers=hf.get("first_k_dense_replace", 0),
+        n_group=hf.get("n_group") or 1,
+        topk_group=hf.get("topk_group") or 1,
+        rope_interleave=hf.get("rope_interleave", True),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        max_position=hf.get("max_position_embeddings", 8192),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+
 def _open_safetensors(path: str):
     """Yields (name, np.ndarray) from all safetensors shards in ``path``."""
     from safetensors import safe_open  # available via transformers dep
@@ -57,8 +92,12 @@ def _open_safetensors(path: str):
 
 
 def load_params(path: str, cfg: Optional[LlamaConfig] = None) -> Dict[str, Any]:
-    """Map HF llama/qwen tensor names onto our pytree."""
+    """Map HF llama/qwen (or deepseek-MLA) tensor names onto our pytree."""
+    from ..models.mla import MlaConfig
+
     cfg = cfg or config_from_hf(path)
+    if isinstance(cfg, MlaConfig):
+        return _load_params_mla(path, cfg)
     layers: list = [dict() for _ in range(cfg.num_layers)]
     params: Dict[str, Any] = {"layers": layers}
     dt = cfg.dtype
@@ -106,4 +145,118 @@ def load_params(path: str, cfg: Optional[LlamaConfig] = None) -> Dict[str, Any]:
     if missing:
         raise ValueError(f"checkpoint at {path} missing layers {missing[:4]}...")
     log.info("loaded %d layers from %s", cfg.num_layers, path)
+    return params
+
+
+def _deinterleave_rope_rows(w: np.ndarray, nope: int, rope: int, heads: int) -> np.ndarray:
+    """DeepSeek checkpoints store rope projections in interleaved pair
+    layout (HF applies apply_rotary_pos_emb_interleave when
+    config.rope_interleave); our apply_rope is rotate-half. Permute each
+    head's rope OUTPUT rows [0,1,2,...] -> [evens..., odds...] so the
+    rotate-half pairing reproduces the interleaved semantics exactly.
+
+    ``w`` is HF [out, in] with out = heads * (nope + rope)."""
+    out, inner = w.shape
+    w = w.reshape(heads, nope + rope, inner)
+    rot = w[:, nope:, :]
+    perm = np.concatenate([np.arange(0, rope, 2), np.arange(1, rope, 2)])
+    w = np.concatenate([w[:, :nope, :], rot[:, perm, :]], axis=1)
+    return w.reshape(out, inner)
+
+
+def _load_params_mla(path: str, cfg) -> Dict[str, Any]:
+    """Map HF DeepSeek V2/V3 tensors onto the models/mla.py pytree.
+
+    kv_b_proj [heads*(nope+v), rank] splits into the absorbed per-head
+    up-projections: rows [:nope] -> w_uk [h, nope, rank] (index-identical),
+    rows [nope:] -> w_uv [h, rank, v] (transposed). Rope output rows of
+    q(_b)_proj and kv_a_proj_with_mqa are de-interleaved (see above)."""
+    layers: list = [dict() for _ in range(cfg.num_layers)]
+    params: Dict[str, Any] = {"layers": layers}
+    experts: Dict[int, Dict[str, Dict[int, np.ndarray]]] = {}
+    dt = cfg.dtype
+    nope, rope, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    nh, rank = cfg.num_heads, cfg.kv_lora_rank
+    interleave = cfg.rope_interleave
+
+    def deint(w: np.ndarray, pre: int, heads: int) -> np.ndarray:
+        return _deinterleave_rope_rows(w, pre, rope, heads) if interleave else w
+
+    def put(arr: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(arr, dt)
+
+    for name, w in _open_safetensors(path):
+        if name == "model.embed_tokens.weight":
+            params["embed"] = put(w)
+            continue
+        if name == "model.norm.weight":
+            params["final_norm"] = put(w)
+            continue
+        if name == "lm_head.weight":
+            params["lm_head"] = put(w.T)
+            continue
+        if not name.startswith("model.layers."):
+            log.debug("ignoring unmapped tensor %s", name)
+            continue
+        parts = name.split(".")
+        li = int(parts[2])
+        rest = ".".join(parts[3:])
+        lp = layers[li]
+        simple = {
+            "input_layernorm.weight": ("attn_norm", False),
+            "post_attention_layernorm.weight": ("mlp_norm", False),
+            "self_attn.q_a_layernorm.weight": ("q_norm", False),
+            "self_attn.kv_a_layernorm.weight": ("kv_norm", False),
+            "self_attn.q_a_proj.weight": ("w_dq", True),
+            "self_attn.o_proj.weight": ("wo", True),
+            "mlp.gate_proj.weight": ("w_gate", True),
+            "mlp.up_proj.weight": ("w_up", True),
+            "mlp.down_proj.weight": ("w_down", True),
+            "mlp.shared_experts.gate_proj.weight": ("w_shared_gate", True),
+            "mlp.shared_experts.up_proj.weight": ("w_shared_up", True),
+            "mlp.shared_experts.down_proj.weight": ("w_shared_down", True),
+            "mlp.gate.weight": ("w_router", True),
+        }
+        if rest in simple:
+            ours, transpose = simple[rest]
+            lp[ours] = put(w.T if transpose else w)
+        elif rest == "mlp.gate.e_score_correction_bias":
+            lp["router_bias"] = jnp.asarray(w, jnp.float32)
+        elif rest in ("self_attn.q_proj.weight", "self_attn.q_b_proj.weight"):
+            ours = "wq" if rest == "self_attn.q_proj.weight" else "w_uq"
+            lp[ours] = put(deint(w, nope, nh).T)
+        elif rest == "self_attn.kv_a_proj_with_mqa.weight":
+            # out rows = [latent (rank) | k_pe (rope)] — one "head" of rope
+            lp["w_dkv"] = put(deint(w, rank, 1).T)
+        elif rest == "self_attn.kv_b_proj.weight":
+            kvb = w.reshape(nh, nope + vd, rank)
+            lp["w_uk"] = put(kvb[:, :nope, :])
+            lp["w_uv"] = put(np.swapaxes(kvb[:, nope:, :], 1, 2))
+        elif parts[3] == "mlp" and parts[4] == "experts":
+            ei, pname = int(parts[5]), parts[6]
+            experts.setdefault(li, {}).setdefault(pname, {})[ei] = w
+        else:
+            log.debug("ignoring unmapped tensor %s", name)
+
+    # stack per-expert FFN weights into [E, in, out]
+    for li, groups in experts.items():
+        for pname, ours in (
+            ("gate_proj", "w_gate"), ("up_proj", "w_up"), ("down_proj", "w_down")
+        ):
+            tensors = groups.get(pname, {})
+            if len(tensors) != cfg.num_experts:
+                raise ValueError(
+                    f"layer {li}: {len(tensors)}/{cfg.num_experts} "
+                    f"{pname} expert shards in checkpoint"
+                )
+            layers[li][ours] = put(
+                np.stack([tensors[e].T for e in range(cfg.num_experts)])
+            )
+    missing = [
+        i for i, lp in enumerate(layers)
+        if ("wq" not in lp and "w_uq" not in lp) or "w_dkv" not in lp
+    ]
+    if missing:
+        raise ValueError(f"checkpoint at {path} missing MLA layers {missing[:4]}...")
+    log.info("loaded %d MLA layers from %s", cfg.num_layers, path)
     return params

@@ -50,6 +50,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from . import moe as moelib
 from .llama import (
     AttendFn,
     LlamaConfig,
@@ -77,6 +78,14 @@ class MlaConfig(LlamaConfig):
     routed_scaling_factor: float = 1.0
     num_shared_experts: int = 0
     first_dense_layers: int = 0
+    # group-limited routing (V3 noaux_tc): experts partitioned into n_group
+    # groups; selection first keeps the topk_group best groups (scored by
+    # their top-2 expert sum), then top-k within the survivors
+    n_group: int = 1
+    topk_group: int = 1
+    # checkpoint rope layout: True = interleaved pairs (HF rope_interleave,
+    # the DeepSeek default) — the loader de-interleaves to rotate-half
+    rope_interleave: bool = True
 
     def __post_init__(self):
         # the engine reads num_kv_heads/head_dim as the KV-cache layout;
@@ -143,6 +152,7 @@ class MlaConfig(LlamaConfig):
             moe_intermediate_size=2048, moe_scoring="sigmoid",
             routed_scaling_factor=2.5, norm_topk_prob=True,
             num_shared_experts=1, first_dense_layers=3,
+            n_group=8, topk_group=4,
             rope_theta=10000.0, tie_embeddings=False,
         )
 
@@ -194,6 +204,10 @@ def init_layer_params(rng: jax.Array, cfg: MlaConfig, layer_idx: int) -> Params:
         E, inter = cfg.num_experts, cfg.moe_intermediate_size
         iscale = 1.0 / math.sqrt(inter)
         p["w_router"] = (jax.random.normal(k[6], (h, E)) * scale).astype(cfg.dtype)
+        if cfg.moe_scoring == "sigmoid":
+            # aux-free load-balancing bias (updated out-of-band in training;
+            # inference just reads it — HF e_score_correction_bias)
+            p["router_bias"] = jnp.zeros((E,), jnp.float32)
         p["w_gate"] = (jax.random.normal(k[7], (E, h, inter)) * scale).astype(cfg.dtype)
         p["w_up"] = (jax.random.normal(k[8], (E, h, inter)) * scale).astype(cfg.dtype)
         p["w_down"] = (jax.random.normal(k[9], (E, inter, h)) * iscale).astype(cfg.dtype)
@@ -241,33 +255,40 @@ def init_params(rng: jax.Array, cfg: MlaConfig) -> Params:
 
 
 def route(p: Params, cfg: MlaConfig, x: jax.Array):
-    """Top-k router: softmax (V2) or sigmoid with normalized top-k weights
-    (V3), times routed_scaling_factor. x [T, H] -> (weights [T,K] f32,
+    """Top-k router matching HF DeepseekV3TopkRouter semantics: sigmoid (V3)
+    or softmax (V2) scores; SELECTION uses scores + the aux-free balancing
+    bias (e_score_correction_bias) and optional group-limited top-k, while
+    the combine WEIGHTS are the unbiased scores gathered at the selected
+    indices, normalized then scaled. x [T, H] -> (weights [T,K] f32,
     idx [T,K])."""
-    logits = (x @ p["w_router"]).astype(jnp.float32)
+    logits = (x.astype(jnp.float32) @ p["w_router"].astype(jnp.float32))
     if cfg.moe_scoring == "sigmoid":
         scores = jax.nn.sigmoid(logits)
     else:
         scores = jax.nn.softmax(logits, axis=-1)
-    topw, topi = jax.lax.top_k(scores, cfg.num_experts_per_tok)
+    sel = scores
+    bias = p.get("router_bias")
+    if bias is not None:
+        sel = sel + bias.astype(jnp.float32)
+    if cfg.n_group > 1:
+        T = sel.shape[0]
+        G, Eg = cfg.n_group, cfg.num_experts // cfg.n_group
+        group_scores = jax.lax.top_k(sel.reshape(T, G, Eg), 2)[0].sum(-1)
+        _, gidx = jax.lax.top_k(group_scores, cfg.topk_group)        # [T, tg]
+        gmask = jax.nn.one_hot(gidx, G, dtype=jnp.float32).sum(1)    # [T, G]
+        emask = jnp.repeat(gmask, Eg, axis=-1)                       # [T, E]
+        sel = jnp.where(emask > 0, sel, 0.0)  # HF masked_fill(~mask, 0.0)
+    _, topi = jax.lax.top_k(sel, cfg.num_experts_per_tok)
+    topw = jnp.take_along_axis(scores, topi, axis=-1)
     if cfg.norm_topk_prob:
-        topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+        topw = topw / (topw.sum(-1, keepdims=True) + 1e-20)
     return topw * cfg.routed_scaling_factor, topi
 
 
 def _moe_ffn(p: Params, cfg: MlaConfig, x: jax.Array) -> jax.Array:
-    """Routed experts (moe.py gather kernel under this module's router) +
-    the always-on shared-expert SwiGLU."""
-    topw, topi = route(p, cfg, x)
-    y = jnp.zeros_like(x)
-    for k in range(cfg.num_experts_per_tok):
-        idx = topi[:, k]
-        gate = jnp.einsum("th,thi->ti", x, p["w_gate"][idx])
-        up = jnp.einsum("th,thi->ti", x, p["w_up"][idx])
-        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-        y = y + topw[:, k, None].astype(x.dtype) * jnp.einsum(
-            "ti,tih->th", act, p["w_down"][idx]
-        )
+    """Routed experts (moe.py gather kernel fed by this module's DeepSeek
+    router) + the always-on shared-expert SwiGLU."""
+    y = moelib.moe_ffn_gather(p, cfg, x, routed=route(p, cfg, x))
     if cfg.num_shared_experts > 0:
         sg = jax.nn.silu((x @ p["w_shared_gate"]).astype(jnp.float32)).astype(x.dtype)
         y = y + (sg * (x @ p["w_shared_up"])) @ p["w_shared_down"]

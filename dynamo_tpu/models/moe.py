@@ -170,17 +170,22 @@ def moe_ffn(p: Params, cfg: MoeConfig, x: jax.Array) -> jax.Array:
     return jnp.einsum("te,eth->th", weights.astype(x.dtype), out_all)
 
 
-def moe_ffn_gather(p: Params, cfg: MoeConfig, x: jax.Array) -> jax.Array:
+def moe_ffn_gather(
+    p: Params, cfg: MoeConfig, x: jax.Array, routed=None
+) -> jax.Array:
     """Sparse exact serving path (replicated experts): compute only the K
     routed experts per token via per-slot weight gathers.
 
     FLOPs are T*K*3HI vs the dense reference's T*E*3HI (16x less for a
     128-expert/top-8 model), and HBM reads touch only the selected experts'
     weights — the decode-step win for high-E/low-K models. K is static and
-    small, so the loop unrolls under jit into K gather+einsum chains."""
-    topw, topi = route(p, cfg, x)                        # [T, K]
+    small, so the loop unrolls under jit into K gather+einsum chains.
+
+    ``routed`` overrides the router output (topw, topi) — the MLA family
+    passes its DeepSeek-style routing through the same kernel."""
+    topw, topi = routed if routed is not None else route(p, cfg, x)
     y = jnp.zeros_like(x)
-    for k in range(cfg.num_experts_per_tok):
+    for k in range(topi.shape[1]):
         idx = topi[:, k]                                 # [T]
         gate = jnp.einsum("th,thi->ti", x, p["w_gate"][idx])
         up = jnp.einsum("th,thi->ti", x, p["w_up"][idx])

@@ -16,9 +16,7 @@ from . import llama, mla, moe
 
 
 def is_moe(cfg) -> bool:
-    # MLA carries its own (DeepSeek-style) MoE FFN; only MoeConfig routes
-    # through moe.py's forward here
-    return isinstance(cfg, moe.MoeConfig) and not is_mla(cfg)
+    return isinstance(cfg, moe.MoeConfig)
 
 
 def is_mla(cfg) -> bool:
