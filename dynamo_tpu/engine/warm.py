@@ -33,13 +33,21 @@ DEFAULT_CACHE_ROOT = os.environ.get(
 )
 
 
+# bump when the param-pytree layout changes (key names / shapes), so caches
+# written by older code are invalidated instead of loaded under wrong specs
+# (v2: MLA expert stacks renamed w_gate -> w_egate etc.)
+PARAM_LAYOUT_VERSION = 2
+
+
 def _fingerprint(source: str, cfg: Any) -> str:
-    """Cache key: checkpoint path + mtime + model-config repr."""
+    """Cache key: checkpoint path + mtime + model-config repr + layout ver."""
     try:
         mtime = str(os.path.getmtime(source))
     except OSError:
         mtime = "0"
-    blob = json.dumps([source, mtime, repr(cfg)], sort_keys=True).encode()
+    blob = json.dumps(
+        [source, mtime, repr(cfg), PARAM_LAYOUT_VERSION], sort_keys=True
+    ).encode()
     return hashlib.sha256(blob).hexdigest()[:16]
 
 

@@ -241,7 +241,8 @@ def _load_params_mla(path: str, cfg) -> Dict[str, Any]:
     # stack per-expert FFN weights into [E, in, out]
     for li, groups in experts.items():
         for pname, ours in (
-            ("gate_proj", "w_gate"), ("up_proj", "w_up"), ("down_proj", "w_down")
+            ("gate_proj", "w_egate"), ("up_proj", "w_eup"),
+            ("down_proj", "w_edown"),
         ):
             tensors = groups.get(pname, {})
             if len(tensors) != cfg.num_experts:

@@ -208,9 +208,12 @@ def init_layer_params(rng: jax.Array, cfg: MlaConfig, layer_idx: int) -> Params:
             # aux-free load-balancing bias (updated out-of-band in training;
             # inference just reads it — HF e_score_correction_bias)
             p["router_bias"] = jnp.zeros((E,), jnp.float32)
-        p["w_gate"] = (jax.random.normal(k[7], (E, h, inter)) * scale).astype(cfg.dtype)
-        p["w_up"] = (jax.random.normal(k[8], (E, h, inter)) * scale).astype(cfg.dtype)
-        p["w_down"] = (jax.random.normal(k[9], (E, inter, h)) * iscale).astype(cfg.dtype)
+        # expert stacks use their own names (w_e*) so the TP partition spec
+        # can shard the expert dim without colliding with the 2-D dense-layer
+        # w_gate/w_up/w_down sharing the per-layer spec table
+        p["w_egate"] = (jax.random.normal(k[7], (E, h, inter)) * scale).astype(cfg.dtype)
+        p["w_eup"] = (jax.random.normal(k[8], (E, h, inter)) * scale).astype(cfg.dtype)
+        p["w_edown"] = (jax.random.normal(k[9], (E, inter, h)) * iscale).astype(cfg.dtype)
         if cfg.num_shared_experts > 0:
             si = inter * cfg.num_shared_experts
             p["w_shared_gate"] = (
@@ -285,10 +288,22 @@ def route(p: Params, cfg: MlaConfig, x: jax.Array):
     return topw * cfg.routed_scaling_factor, topi
 
 
-def _moe_ffn(p: Params, cfg: MlaConfig, x: jax.Array) -> jax.Array:
+def expert_params(p: Params) -> Params:
+    """Expert stacks under the names moe.py's kernels expect."""
+    return {"w_gate": p["w_egate"], "w_up": p["w_eup"], "w_down": p["w_edown"]}
+
+
+def _moe_ffn(
+    p: Params, cfg: MlaConfig, x: jax.Array, expert_fn=None
+) -> jax.Array:
     """Routed experts (moe.py gather kernel fed by this module's DeepSeek
-    router) + the always-on shared-expert SwiGLU."""
-    y = moelib.moe_ffn_gather(p, cfg, x, routed=route(p, cfg, x))
+    router, or a mesh-aware ``expert_fn`` injected by the registry for EP)
+    + the always-on shared-expert SwiGLU."""
+    routed = route(p, cfg, x)
+    if expert_fn is not None:
+        y = expert_fn(expert_params(p), x, routed)
+    else:
+        y = moelib.moe_ffn_gather(expert_params(p), cfg, x, routed=routed)
     if cfg.num_shared_experts > 0:
         sg = jax.nn.silu((x @ p["w_shared_gate"]).astype(jnp.float32)).astype(x.dtype)
         y = y + (sg * (x @ p["w_shared_up"])) @ p["w_shared_down"]
@@ -313,6 +328,7 @@ def layer_forward(
     sin: jax.Array,
     attend: AttendFn,
     layer_idx: int,
+    expert_fn=None,
 ) -> jax.Array:
     nh, rank = cfg.num_heads, cfg.kv_lora_rank
     nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
@@ -353,7 +369,7 @@ def layer_forward(
     if _is_moe_layer(cfg, layer_idx):
         # routing indexes per token: flatten leading dims to [T, H]
         flat = h.reshape(-1, h.shape[-1])
-        return x + _moe_ffn(p, cfg, flat).reshape(h.shape)
+        return x + _moe_ffn(p, cfg, flat, expert_fn=expert_fn).reshape(h.shape)
     return x + _dense_ffn(p, cfg, h)
 
 
@@ -365,6 +381,7 @@ def forward(
     attend: AttendFn,
     lora: Optional[Callable] = None,
     inputs_embeds: Optional[jax.Array] = None,
+    expert_fn=None,
 ) -> jax.Array:
     if lora is not None:
         raise NotImplementedError("LoRA is not supported for the MLA family")
@@ -372,7 +389,7 @@ def forward(
     cos, sin = rope_cos_sin(positions, cfg.qk_rope_head_dim, cfg.rope_theta)
     cos, sin = cos[..., None, :], sin[..., None, :]
     for i, layer in enumerate(params["layers"]):
-        x = layer_forward(layer, cfg, x, cos, sin, attend, i)
+        x = layer_forward(layer, cfg, x, cos, sin, attend, i, expert_fn=expert_fn)
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
 
 

@@ -201,15 +201,17 @@ def moe_ffn_gather(
 
 
 def moe_ffn_ep_psum(
-    p: Params, cfg: MoeConfig, x: jax.Array, axis_name: str
+    p: Params, cfg: MoeConfig, x: jax.Array, axis_name: str, routed=None
 ) -> jax.Array:
     """Inside shard_map: tokens replicated on ``axis_name``, expert-stacked
     weights sharded on their leading dim. Each shard computes its local
-    experts' weighted contribution; psum combines."""
+    experts' weighted contribution; psum combines. ``routed`` injects
+    precomputed (topw, topi) — used by the MLA family's DeepSeek router,
+    whose routing runs outside the shard_map."""
     T, H = x.shape
     E_loc = p["w_gate"].shape[0]
     me = jax.lax.axis_index(axis_name)
-    topw, topi = route(p, cfg, x)                        # router is replicated
+    topw, topi = routed if routed is not None else route(p, cfg, x)
     out_all = _expert_mlp(
         p["w_gate"], p["w_up"], p["w_down"],
         jnp.broadcast_to(x, (E_loc, T, H)), x.dtype,

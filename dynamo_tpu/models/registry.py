@@ -44,10 +44,40 @@ def forward_fn(cfg, mesh=None):
       psum combines (same collective as a TP row matmul)
     """
     if is_mla(cfg):
-        # MLA's MoE layers use the per-token gather kernel (exact, sparse);
-        # experts stay replicated — the latent-MQA cache already binds the
-        # family to replicated-KV TP, and EP sharding can follow later
-        return mla.forward
+        if cfg.num_experts == 0 or mesh is None or mesh.shape.get(AXIS_TP, 1) == 1:
+            # per-token gather kernel (exact, sparse) on replicated experts
+            return mla.forward
+
+        # EP: expert stacks shard on the expert dim over the tp axis (same
+        # devices as attention TP); the DeepSeek router runs OUTSIDE the
+        # shard_map (it is replicated), each shard computes its local
+        # experts' contribution, one psum combines — identical collective
+        # shape to the MoeConfig path. Specs come from param_specs (one
+        # source of truth with how the engine placed the weights), remapped
+        # to the kernel's w_gate/w_up/w_down names (mla.expert_params).
+        layer_specs = param_specs(cfg)["layer"]
+        ep_spec = (
+            {
+                "w_gate": layer_specs["w_egate"],
+                "w_up": layer_specs["w_eup"],
+                "w_down": layer_specs["w_edown"],
+            },
+            P(), (P(), P()),
+        )
+
+        def mla_expert_fn(ep, x, routed):
+            fn = jax.shard_map(
+                lambda sp, sx, srouted: moe.moe_ffn_ep_psum(
+                    sp, cfg, sx, AXIS_TP, routed=srouted
+                ),
+                mesh=mesh,
+                in_specs=ep_spec,
+                out_specs=P(),
+                check_vma=False,
+            )
+            return fn(ep, x, routed)
+
+        return partial(mla.forward, expert_fn=mla_expert_fn)
     if not is_moe(cfg):
         return llama.forward
     # the gather path materializes [T, H, I] per-token weight copies: a win
@@ -111,7 +141,7 @@ def param_specs(cfg) -> dict:
     if is_mla(cfg):
         # q heads shard over TP (head-stacked w_uk/w_uv, column-parallel
         # w_uq/wq, row-parallel wo); the shared latent projections and the
-        # 1-head latent KV stay replicated. Experts replicated (gather FFN).
+        # 1-head latent KV stay replicated.
         layer.update({
             "wq": P(None, AXIS_TP),
             "w_uq": P(None, AXIS_TP),
@@ -125,20 +155,17 @@ def param_specs(cfg) -> dict:
             "w_shared_up": P(None, AXIS_TP),
             "w_shared_down": P(AXIS_TP, None),
         })
-        if cfg.num_experts > 0:
-            # dense first_dense_layers use 2-D gate/up/down, MoE layers 3-D
-            # expert stacks; both replicated is the safe common spec — the
-            # per-layer dict can't distinguish, and the gather FFN reads
-            # full expert tables anyway
-            layer.update({
-                "w_gate": P(), "w_up": P(), "w_down": P(),
-            })
-        else:
-            layer.update({
-                "w_gate": P(None, AXIS_TP),
-                "w_up": P(None, AXIS_TP),
-                "w_down": P(AXIS_TP, None),
-            })
+        # dense-layer FFN (and first_dense_layers of MoE models) keep the
+        # megatron column/row specs; expert stacks live under their own
+        # names (w_e*) and shard on the EXPERT dim over tp
+        layer.update({
+            "w_gate": P(None, AXIS_TP),
+            "w_up": P(None, AXIS_TP),
+            "w_down": P(AXIS_TP, None),
+            "w_egate": P(AXIS_TP, None, None),
+            "w_eup": P(AXIS_TP, None, None),
+            "w_edown": P(AXIS_TP, None, None),
+        })
     elif is_moe(cfg):
         layer.update({
             "w_router": P(None, None),

@@ -77,7 +77,7 @@ class TestMlaMath:
         p = mla.init_params(jax.random.PRNGKey(0), cfg)
         # layer 0 dense (2-D ffn weights), layer >=1 MoE (3-D expert stacks)
         assert p["layers"][0]["w_gate"].ndim == 2
-        assert p["layers"][1]["w_gate"].ndim == 3
+        assert p["layers"][1]["w_egate"].ndim == 3
         assert "w_shared_gate" in p["layers"][1]
         x = jax.random.normal(jax.random.PRNGKey(2), (6, cfg.hidden_size), cfg.dtype)
         topw, topi = mla.route(p["layers"][1], cfg, x)
@@ -87,7 +87,7 @@ class TestMlaMath:
         )
         assert int(topi.max()) < cfg.num_experts
         # zeroing the shared expert changes the output (it is always on)
-        y1 = mla._moe_ffn(p["layers"][1], cfg, x)
+        y1 = mla._moe_ffn(p["layers"][1], cfg, x)  # noqa: SLF001
         p2 = dict(p["layers"][1])
         p2["w_shared_down"] = jnp.zeros_like(p2["w_shared_down"])
         y2 = mla._moe_ffn(p2, cfg, x)
@@ -161,6 +161,25 @@ async def test_engine_mla_tp2_matches_tp1():
     finally:
         e1.stop()
     e2 = mla_engine(tp=2)
+    try:
+        t2 = await _run(e2, greedy_req("b", prompt))
+    finally:
+        e2.stop()
+    assert t1 == t2
+
+
+async def test_engine_mla_moe_ep_tp2_matches_tp1():
+    """MoE MLA under tp=2: expert stacks shard on the expert dim (EP via
+    shard_map psum, registry mla_expert_fn) — same greedy tokens as the
+    replicated-expert gather path at tp=1."""
+    cfg = mla.MlaConfig.tiny_mla_moe()
+    prompt = list(range(25, 49))
+    e1 = mla_engine(cfg=cfg)
+    try:
+        t1 = await _run(e1, greedy_req("a", prompt))
+    finally:
+        e1.stop()
+    e2 = mla_engine(cfg=cfg, tp=2)
     try:
         t2 = await _run(e2, greedy_req("b", prompt))
     finally:
