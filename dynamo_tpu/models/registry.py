@@ -33,6 +33,31 @@ def init_params(rng, cfg):
     return family(cfg).init_params(rng, cfg)
 
 
+def _ep_psum_shard_map(cfg, mesh, weight_specs, with_routed):
+    """One shard_map wrapper for both families' EP path: expert-stacked
+    weights sharded per ``weight_specs``, tokens (and, for MLA, the
+    precomputed routing) replicated, moe.moe_ffn_ep_psum per shard, psum
+    combine. Keeping a single construction site means the collective shape
+    cannot drift between the MoeConfig and MLA families."""
+    if with_routed:
+        return jax.shard_map(
+            lambda sp, sx, srouted: moe.moe_ffn_ep_psum(
+                sp, cfg, sx, AXIS_TP, routed=srouted
+            ),
+            mesh=mesh,
+            in_specs=(weight_specs, P(), (P(), P())),
+            out_specs=P(),
+            check_vma=False,
+        )
+    return jax.shard_map(
+        lambda sp, sx: moe.moe_ffn_ep_psum(sp, cfg, sx, AXIS_TP),
+        mesh=mesh,
+        in_specs=(weight_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
 def forward_fn(cfg, mesh=None):
     """Forward pass for the family. For MoE the FFN strategy is picked here
     so serving never pays dense all-expert FLOPs (ADVICE r2):
@@ -56,26 +81,16 @@ def forward_fn(cfg, mesh=None):
         # source of truth with how the engine placed the weights), remapped
         # to the kernel's w_gate/w_up/w_down names (mla.expert_params).
         layer_specs = param_specs(cfg)["layer"]
-        ep_spec = (
-            {
-                "w_gate": layer_specs["w_egate"],
-                "w_up": layer_specs["w_eup"],
-                "w_down": layer_specs["w_edown"],
-            },
-            P(), (P(), P()),
-        )
+        weight_specs = {
+            "w_gate": layer_specs["w_egate"],
+            "w_up": layer_specs["w_eup"],
+            "w_down": layer_specs["w_edown"],
+        }
 
         def mla_expert_fn(ep, x, routed):
-            fn = jax.shard_map(
-                lambda sp, sx, srouted: moe.moe_ffn_ep_psum(
-                    sp, cfg, sx, AXIS_TP, routed=srouted
-                ),
-                mesh=mesh,
-                in_specs=ep_spec,
-                out_specs=P(),
-                check_vma=False,
+            return _ep_psum_shard_map(cfg, mesh, weight_specs, True)(
+                ep, x, routed
             )
-            return fn(ep, x, routed)
 
         return partial(mla.forward, expert_fn=mla_expert_fn)
     if not is_moe(cfg):
@@ -100,14 +115,7 @@ def forward_fn(cfg, mesh=None):
 
     def ffn(p, _cfg, x):
         sub = {k: p[k] for k in ep_keys}
-        fn = jax.shard_map(
-            lambda sp, sx: moe.moe_ffn_ep_psum(sp, _cfg, sx, AXIS_TP),
-            mesh=mesh,
-            in_specs=ep_specs,
-            out_specs=P(),
-            check_vma=False,
-        )
-        return fn(sub, x)
+        return _ep_psum_shard_map(_cfg, mesh, ep_specs[0], False)(sub, x)
 
     return partial(moe.forward, ffn_fn=ffn)
 

@@ -69,7 +69,8 @@ def test_yaml_roundtrips_and_example_specs_render():
     assert len(docs) == len(render(_graph()))
 
     for example in ("deploy/examples/agg-serving.yaml",
-                    "deploy/examples/disagg-serving.yaml"):
+                    "deploy/examples/disagg-serving.yaml",
+                    "deploy/examples/deepseek-v3-disagg.yaml"):
         objs = render(GraphSpec.load(example))
         assert objs
         names = {o["metadata"]["name"] for o in objs}
