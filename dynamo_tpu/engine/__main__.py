@@ -20,6 +20,7 @@ from dynamo_tpu.engine.weights import config_from_hf, load_params
 from dynamo_tpu.kv_router import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm import ModelDeploymentCard, ModelRuntimeConfig, register_llm
 from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.models.gptoss import GptOssConfig
 from dynamo_tpu.models.mla import MlaConfig
 from dynamo_tpu.models.moe import MoeConfig
 from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
@@ -32,6 +33,9 @@ PRESETS = {
     "llama3-70b": LlamaConfig.llama3_70b,
     "tiny-moe": MoeConfig.tiny_moe,
     "qwen3-30b-a3b": MoeConfig.qwen3_30b_a3b,
+    "tiny-gptoss": GptOssConfig.tiny_gptoss,
+    "gpt-oss-20b": GptOssConfig.gpt_oss_20b,
+    "gpt-oss-120b": GptOssConfig.gpt_oss_120b,
     "tiny-mla": MlaConfig.tiny_mla,
     "tiny-mla-moe": MlaConfig.tiny_mla_moe,
     "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,

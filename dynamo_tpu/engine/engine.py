@@ -293,10 +293,12 @@ class TpuEngine:
                     or config.logits_processors
                     or registry.is_moe(self.mcfg)
                     or registry.is_mla(self.mcfg)
+                    or registry.is_gptoss(self.mcfg)
                     or config.use_pallas):
                 raise ValueError(
                     "pp serving covers the core dense text path (no LoRA/"
-                    "vision/sp/kvbm/logits-processors/MoE/MLA/pallas yet)"
+                    "vision/sp/kvbm/logits-processors/MoE/MLA/gpt-oss/"
+                    "pallas yet)"
                 )
             if mesh is None:
                 mesh = pp_serving.make_pp_mesh(pp=config.pp, tp=config.tp)
@@ -318,6 +320,17 @@ class TpuEngine:
                 config.decode_steps = steps
             if config.decode_pipeline is None:
                 config.decode_pipeline = pipeline
+        if registry.is_gptoss(self.mcfg):
+            if config.sp > 1:
+                raise ValueError(
+                    "gpt-oss sliding-window/sink attention does not ride the"
+                    " ring (sp) path yet; use chunked prefill on sp=1"
+                )
+            if config.use_pallas:
+                raise ValueError(
+                    "gpt-oss attention (window + sinks) runs the pure-JAX"
+                    " paths; the Pallas kernels do not support it"
+                )
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         self.allocator = BlockAllocator(config.num_blocks, config.block_size)
@@ -446,7 +459,8 @@ class TpuEngine:
         # multi-LoRA adapter tables (static shapes; see lora/adapters.py)
         self.lora = None
         if config.lora_max_adapters > 0:
-            if registry.is_moe(self.mcfg) or registry.is_mla(self.mcfg):
+            if (registry.is_moe(self.mcfg) or registry.is_mla(self.mcfg)
+                    or registry.is_gptoss(self.mcfg)):
                 raise ValueError("LoRA serving covers the dense family only")
             from ..lora import LoraAdapterTable
 
@@ -749,6 +763,8 @@ class TpuEngine:
                 jax.default_backend() == "tpu"
                 and mcfg.head_dim % 128 == 0
                 and mcfg.num_kv_heads % meshlib.tp_size(self.mesh) == 0
+                # windowed/sink attention (gpt-oss) rides the pure-JAX ops
+                and not registry.is_gptoss(mcfg)
             )
         if use_pallas:
             from ..ops import pallas_attention as pa
@@ -823,7 +839,10 @@ class TpuEngine:
                     lora_id, proc_masks, mm_embeds, mm_mask):
             # tokens/positions: [S_pad] — ONE chunk of the prompt (the whole
             # prompt when it fits a bucket); block_table: [max_blocks_per_seq]
-            def attend(q, k_new, v_new, layer_idx):
+            def attend(q, k_new, v_new, layer_idx, **extra):
+                # extra: per-layer attention variants the model opts into
+                # (sliding ``window``, per-head ``sinks`` — models/gptoss.py);
+                # plain families pass nothing and nothing changes
                 kc, vc = att.write_prefill_kv(
                     k_caches[layer_idx], v_caches[layer_idx], k_new, v_new, new_block_ids
                 )
@@ -841,6 +860,7 @@ class TpuEngine:
 
                 if (
                     use_pallas
+                    and not extra
                     and q.shape[0] % pf.Q_TILE == 0
                     and k_ctx.shape[0] % pf.KV_TILE == 0
                 ):
@@ -853,7 +873,9 @@ class TpuEngine:
                         q, k_ctx, v_ctx, positions, total_len,
                         interpret=interp,
                     )
-                return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
+                return att.extend_attention(
+                    q, k_ctx, v_ctx, positions, total_len, **extra
+                )
 
             hidden = call_fwd(
                 params, tokens, positions, attend, lora_tables, lora_id,
@@ -906,13 +928,15 @@ class TpuEngine:
                    steps, temps, top_ks, top_ps, min_ps, pres, freqs, reps,
                    prompt_masks, lp_need, lora_tables, lora_ids, proc_masks):
             # tokens: [B]; block_tables: [B, max_blocks_per_seq]
-            def attend(q, k_new, v_new, layer_idx):
+            def attend(q, k_new, v_new, layer_idx, **extra):
                 kc, vc = att.write_decode_kv(
                     k_caches[layer_idx], v_caches[layer_idx],
                     k_new[:, 0], v_new[:, 0], write_blocks, write_offsets,
                 )
                 k_caches[layer_idx], v_caches[layer_idx] = kc, vc
-                out = paged_attention(q[:, 0], kc, vc, block_tables, seq_lens)
+                out = paged_attention(
+                    q[:, 0], kc, vc, block_tables, seq_lens, **extra
+                )
                 return out[:, None]
 
             hidden = call_fwd(
@@ -961,13 +985,15 @@ class TpuEngine:
                 )
                 write_offsets = jnp.where(active, positions % bs, 0)
 
-                def attend(q, k_new, v_new, layer_idx):
+                def attend(q, k_new, v_new, layer_idx, **extra):
                     kc, vc = att.write_decode_kv(
                         k_caches[layer_idx], v_caches[layer_idx],
                         k_new[:, 0], v_new[:, 0], write_blocks, write_offsets,
                     )
                     k_caches[layer_idx], v_caches[layer_idx] = kc, vc
-                    out = paged_attention(q[:, 0], kc, vc, block_tables, seq_lens)
+                    out = paged_attention(
+                        q[:, 0], kc, vc, block_tables, seq_lens, **extra
+                    )
                     return out[:, None]
 
                 hidden = call_fwd(
@@ -1012,8 +1038,8 @@ class TpuEngine:
             generation cache), last-token hidden state, L2-normalized.
             Padded tail positions can't affect earlier queries (causal)."""
 
-            def attend(q, k_new, v_new, layer_idx):
-                return att.causal_attention(q, k_new, v_new)
+            def attend(q, k_new, v_new, layer_idx, **extra):
+                return att.causal_attention(q, k_new, v_new, **extra)
 
             hidden = fwd(params, mcfg, tokens, positions, attend)  # [S, H]
             h = hidden[last_idx].astype(jnp.float32)
@@ -1028,14 +1054,16 @@ class TpuEngine:
             attends over the gathered prefix — but no token is sampled; the
             final chunk returns the normalized last-token hidden state."""
 
-            def attend(q, k_new, v_new, layer_idx):
+            def attend(q, k_new, v_new, layer_idx, **extra):
                 kc, vc = att.write_prefill_kv(
                     k_caches[layer_idx], v_caches[layer_idx],
                     k_new, v_new, new_block_ids,
                 )
                 k_caches[layer_idx], v_caches[layer_idx] = kc, vc
                 k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
-                return att.extend_attention(q, k_ctx, v_ctx, positions, total_len)
+                return att.extend_attention(
+                    q, k_ctx, v_ctx, positions, total_len, **extra
+                )
 
             hidden = fwd(params, mcfg, tokens, positions, attend)
             vec = jax.lax.cond(

@@ -69,6 +69,8 @@ def _cfg_from_obj(obj: Optional[Dict[str, Any]]) -> Any:
         from ..models.moe import MoeConfig as cls
     elif kind == "MlaConfig":
         from ..models.mla import MlaConfig as cls
+    elif kind == "GptOssConfig":
+        from ..models.gptoss import GptOssConfig as cls
     else:
         from ..models.llama import LlamaConfig as cls
     dt = d.get("dtype")

@@ -26,6 +26,8 @@ def config_from_hf(path: str) -> LlamaConfig:
         hf = json.load(f)
     if hf.get("model_type", "") in ("deepseek_v2", "deepseek_v3"):
         return _mla_config_from_hf(hf)
+    if hf.get("model_type", "") == "gpt_oss":
+        return _gptoss_config_from_hf(hf)
     head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
     return LlamaConfig(
         vocab_size=hf["vocab_size"],
@@ -78,6 +80,41 @@ def _mla_config_from_hf(hf: dict):
     )
 
 
+def _gptoss_config_from_hf(hf: dict):
+    """gpt-oss config.json -> GptOssConfig (models/gptoss.py)."""
+    from ..models.gptoss import GptOssConfig
+
+    rs = hf.get("rope_scaling") or {}
+    yarn = rs.get("rope_type") == "yarn"
+    return GptOssConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf["num_key_value_heads"],
+        head_dim=hf.get("head_dim")
+        or hf["hidden_size"] // hf["num_attention_heads"],
+        intermediate_size=hf["intermediate_size"],
+        num_experts=hf["num_local_experts"],
+        num_experts_per_tok=hf["num_experts_per_tok"],
+        sliding_window=hf.get("sliding_window") or 128,
+        layer_types=tuple(hf.get("layer_types") or ()),
+        rope_theta=hf.get("rope_theta", 150000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        max_position=hf.get("max_position_embeddings", 131072),
+        qkv_bias=hf.get("attention_bias", True),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        rope_scaling_factor=rs.get("factor", 0.0) if yarn else 0.0,
+        rope_beta_fast=rs.get("beta_fast", 32.0),
+        rope_beta_slow=rs.get("beta_slow", 1.0),
+        rope_truncate=rs.get("truncate", True),
+        rope_original_max_position=rs.get(
+            "original_max_position_embeddings",
+            hf.get("max_position_embeddings", 4096),
+        ),
+    )
+
+
 def _open_safetensors(path: str):
     """Yields (name, np.ndarray) from all safetensors shards in ``path``."""
     from safetensors import safe_open  # available via transformers dep
@@ -95,9 +132,13 @@ def load_params(path: str, cfg: Optional[LlamaConfig] = None) -> Dict[str, Any]:
     """Map HF llama/qwen (or deepseek-MLA) tensor names onto our pytree."""
     from ..models.mla import MlaConfig
 
+    from ..models.gptoss import GptOssConfig
+
     cfg = cfg or config_from_hf(path)
     if isinstance(cfg, MlaConfig):
         return _load_params_mla(path, cfg)
+    if isinstance(cfg, GptOssConfig):
+        return _load_params_gptoss(path, cfg)
     layers: list = [dict() for _ in range(cfg.num_layers)]
     params: Dict[str, Any] = {"layers": layers}
     dt = cfg.dtype
@@ -260,4 +301,108 @@ def _load_params_mla(path: str, cfg) -> Dict[str, Any]:
     if missing:
         raise ValueError(f"checkpoint at {path} missing MLA layers {missing[:4]}...")
     log.info("loaded %d MLA layers from %s", cfg.num_layers, path)
+    return params
+
+
+# OCP MXFP4 e2m1 value table (public microscaling spec; also
+# transformers.integrations.mxfp4.FP4_VALUES)
+FP4_VALUES = (
+    +0.0, +0.5, +1.0, +1.5, +2.0, +3.0, +4.0, +6.0,
+    -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0,
+)
+
+
+def dequant_mxfp4(blocks: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Dequantize MXFP4 expert weights (the released gpt-oss checkpoints):
+    ``blocks`` uint8 [E, out, G, B] packs two FP4 e2m1 nibbles per byte
+    (low nibble first), ``scales`` uint8 [E, out, G] are e8m0 block
+    exponents (bias 127). Returns float32 [E, in, out] — the input-major
+    layout the bf16 checkpoints use."""
+    lut = np.asarray(FP4_VALUES, np.float32)
+    out = np.empty((*blocks.shape[:-1], blocks.shape[-1] * 2), np.float32)
+    out[..., 0::2] = lut[blocks & 0x0F]
+    out[..., 1::2] = lut[blocks >> 4]
+    out *= np.exp2(scales.astype(np.int32) - 127)[..., None]
+    out = out.reshape(*blocks.shape[:-2], -1)   # [E, out, in]
+    return out.swapaxes(1, 2)                   # [E, in, out]
+
+
+def _load_params_gptoss(path: str, cfg) -> Dict[str, Any]:
+    """Map HF gpt-oss tensors onto the models/gptoss.py pytree. The fused
+    per-expert projections (mlp.experts.gate_up_proj [E, H, 2I],
+    down_proj [E, I, H]) are stored input-major in HF (used as x @ W), so
+    they load without transposition; gate/up lanes stay interleaved (the
+    expert kernel slices ::2 / 1::2 like the HF forward)."""
+    layers: list = [dict() for _ in range(cfg.num_layers)]
+    params: Dict[str, Any] = {"layers": layers}
+    dt = cfg.dtype
+
+    def put(arr: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(arr, dt)
+
+    mapping = {
+        "input_layernorm.weight": ("attn_norm", False),
+        "post_attention_layernorm.weight": ("mlp_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.bias": ("bv", False),
+        "self_attn.o_proj.bias": ("bo", False),
+        "mlp.router.weight": ("w_router", True),
+        "mlp.router.bias": ("b_router", False),
+        "mlp.experts.gate_up_proj": ("w_gateup", False),
+        "mlp.experts.gate_up_proj_bias": ("b_gateup", False),
+        "mlp.experts.down_proj": ("w_edown", False),
+        "mlp.experts.down_proj_bias": ("b_edown", False),
+    }
+    mx: Dict[int, Dict[str, np.ndarray]] = {}
+    for name, w in _open_safetensors(path):
+        if name == "model.embed_tokens.weight":
+            params["embed"] = put(w)
+        elif name == "model.norm.weight":
+            params["final_norm"] = put(w)
+        elif name == "lm_head.weight":
+            params["lm_head"] = put(w.T)
+        elif name.startswith("model.layers."):
+            parts = name.split(".")
+            li = int(parts[2])
+            rest = ".".join(parts[3:])
+            if rest == "self_attn.sinks":
+                layers[li]["sinks"] = jnp.asarray(w, jnp.float32)
+            elif rest in mapping:
+                ours, transpose = mapping[rest]
+                layers[li][ours] = put(w.T if transpose else w)
+            elif rest.startswith("mlp.experts.") and (
+                rest.endswith("_blocks") or rest.endswith("_scales")
+            ):
+                # MXFP4-quantized release: stash blocks+scales, dequantize
+                # once both halves of a tensor arrived
+                mx.setdefault(li, {})[rest.removeprefix("mlp.experts.")] = w
+            else:
+                log.debug("ignoring unmapped tensor %s", name)
+        else:
+            log.debug("ignoring unmapped tensor %s", name)
+    for li, parts_d in mx.items():
+        for hf_name, ours in (
+            ("gate_up_proj", "w_gateup"), ("down_proj", "w_edown")
+        ):
+            b, sc = parts_d.get(f"{hf_name}_blocks"), parts_d.get(f"{hf_name}_scales")
+            if b is None or sc is None:
+                raise ValueError(
+                    f"layer {li}: MXFP4 tensor {hf_name} missing its "
+                    f"{'scales' if sc is None else 'blocks'} half"
+                )
+            layers[li][ours] = put(dequant_mxfp4(b, sc))
+    missing = [
+        i for i, lp in enumerate(layers)
+        if "wq" not in lp or "sinks" not in lp or "w_gateup" not in lp
+    ]
+    if missing:
+        raise ValueError(
+            f"checkpoint at {path} missing gpt-oss layers {missing[:4]}..."
+        )
+    log.info("loaded %d gpt-oss layers from %s", cfg.num_layers, path)
     return params

@@ -1,0 +1,331 @@
+"""gpt-oss family (OpenAI gpt-oss-20b/120b) in functional JAX.
+
+The reference serves gpt-oss through its engine adapters (recipes/
+gpt-oss-120b, TRT-LLM/vLLM workers) and parses its harmony dialect
+(our parsers/tool_calls.py already speaks it); this module owns the model
+itself, like models/llama.py owns the dense family. Architecture:
+
+- GQA attention with **per-head attention sinks**: a learned logit joins
+  the softmax as a virtual key whose probability mass is dropped, damping
+  every real attention weight (ops/attention.py _sink_softmax).
+- **Alternating sliding-window / full attention** layers
+  (layer_types, window 128): handled by the paged attention ops'
+  ``window`` argument — the engine's paged cache is unchanged, masks do
+  the windowing. Head_dim 64 keeps these layers on the pure-JAX attention
+  path automatically (the Pallas kernels require 128-aligned heads).
+- MoE FFN in every layer: router = top-k over plain logits then softmax
+  over the SELECTED logits; experts use a fused, biased gate_up projection
+  with interleaved gate/up lanes and the clamped swiglu
+  ``(up+1) * gate * sigmoid(alpha*gate)``.
+- YaRN rope scaling (the released models run 4k->128k contexts).
+
+Weights load from HF checkpoints (engine/weights.py) with logits parity
+pinned against transformers in tests/test_gptoss_parity.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, Params, apply_rope, rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class GptOssConfig(LlamaConfig):
+    num_experts: int = 32
+    num_experts_per_tok: int = 4
+    sliding_window: int = 128
+    # per-layer attention kind; empty = the released pattern (alternating,
+    # even layers sliding). Tuple of "sliding_attention" / "full_attention".
+    layer_types: Tuple[str, ...] = ()
+    swiglu_alpha: float = 1.702
+    swiglu_limit: float = 7.0
+    # YaRN (factor 0 disables scaling)
+    rope_scaling_factor: float = 0.0
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    rope_truncate: bool = False
+    rope_original_max_position: int = 4096
+
+    def window_for_layer(self, layer_idx: int) -> Optional[int]:
+        if self.layer_types:
+            kind = self.layer_types[layer_idx]
+        else:
+            kind = "sliding_attention" if layer_idx % 2 == 0 else "full_attention"
+        return self.sliding_window if kind == "sliding_attention" else None
+
+    @classmethod
+    def tiny_gptoss(cls, **kw) -> "GptOssConfig":
+        defaults = dict(
+            vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=64,
+            num_experts=4, num_experts_per_tok=2, sliding_window=8,
+            qkv_bias=True, tie_embeddings=False, dtype=jnp.float32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def gpt_oss_20b(cls, vocab_size: int = 201088) -> "GptOssConfig":
+        return cls(
+            vocab_size=vocab_size, hidden_size=2880, num_layers=24,
+            num_heads=64, num_kv_heads=8, head_dim=64,
+            intermediate_size=2880, num_experts=32, num_experts_per_tok=4,
+            sliding_window=128, rope_theta=150000.0, qkv_bias=True,
+            tie_embeddings=False, max_position=131072,
+            rope_scaling_factor=32.0, rope_original_max_position=4096,
+        )
+
+    @classmethod
+    def gpt_oss_120b(cls, vocab_size: int = 201088) -> "GptOssConfig":
+        return cls(
+            vocab_size=vocab_size, hidden_size=2880, num_layers=36,
+            num_heads=64, num_kv_heads=8, head_dim=64,
+            intermediate_size=2880, num_experts=128, num_experts_per_tok=4,
+            sliding_window=128, rope_theta=150000.0, qkv_bias=True,
+            tie_embeddings=False, max_position=131072,
+            rope_scaling_factor=32.0, rope_original_max_position=4096,
+        )
+
+
+# ---------------------------------------------------------------------------
+# rope (YaRN)
+# ---------------------------------------------------------------------------
+
+
+def yarn_inv_freq(cfg: GptOssConfig) -> Tuple[jax.Array, float]:
+    """(inv_freq [d/2], attention_factor) per the YaRN recipe
+    (transformers _compute_yarn_parameters semantics: interpolated and
+    extrapolated frequencies blended over a linear ramp between the
+    beta_fast/beta_slow correction dims; cos/sin scaled by
+    0.1*ln(factor)+1)."""
+    d, base = cfg.head_dim, cfg.rope_theta
+    pos_freqs = base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    inv_extra = 1.0 / pos_freqs
+    factor = cfg.rope_scaling_factor
+    if factor <= 1.0:
+        return inv_extra, 1.0
+    inv_interp = 1.0 / (factor * pos_freqs)
+
+    def corr_dim(rot):
+        return (d * math.log(cfg.rope_original_max_position / (rot * 2 * math.pi))) / (
+            2 * math.log(base)
+        )
+
+    low, high = corr_dim(cfg.rope_beta_fast), corr_dim(cfg.rope_beta_slow)
+    if cfg.rope_truncate:
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, d - 1)
+    if low == high:
+        high += 0.001
+    ramp = jnp.clip(
+        (jnp.arange(d // 2, dtype=jnp.float32) - low) / (high - low), 0, 1
+    )
+    extra_factor = 1.0 - ramp
+    inv_freq = inv_interp * (1 - extra_factor) + inv_extra * extra_factor
+    return inv_freq, 0.1 * math.log(factor) + 1.0
+
+
+def rope_tables(cfg: GptOssConfig, positions: jax.Array):
+    inv_freq, att_factor = yarn_inv_freq(cfg)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles) * att_factor, jnp.sin(angles) * att_factor
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_layer_params(rng: jax.Array, cfg: GptOssConfig) -> Params:
+    k = jax.random.split(rng, 10)
+    h, qd, kvd = cfg.hidden_size, cfg.q_size, cfg.kv_size
+    E, inter = cfg.num_experts, cfg.intermediate_size
+    scale = 1.0 / math.sqrt(h)
+    iscale = 1.0 / math.sqrt(inter)
+    return {
+        "attn_norm": jnp.ones((h,), cfg.dtype),
+        "mlp_norm": jnp.ones((h,), cfg.dtype),
+        "wq": (jax.random.normal(k[0], (h, qd)) * scale).astype(cfg.dtype),
+        "wk": (jax.random.normal(k[1], (h, kvd)) * scale).astype(cfg.dtype),
+        "wv": (jax.random.normal(k[2], (h, kvd)) * scale).astype(cfg.dtype),
+        "wo": (jax.random.normal(k[3], (qd, h)) * scale).astype(cfg.dtype),
+        "bq": jnp.zeros((qd,), cfg.dtype),
+        "bk": jnp.zeros((kvd,), cfg.dtype),
+        "bv": jnp.zeros((kvd,), cfg.dtype),
+        "bo": jnp.zeros((h,), cfg.dtype),
+        "sinks": jnp.zeros((cfg.num_heads,), jnp.float32),
+        "w_router": (jax.random.normal(k[4], (h, E)) * scale).astype(cfg.dtype),
+        "b_router": jnp.zeros((E,), cfg.dtype),
+        # fused per-expert projections, HF layout: gate/up lanes interleaved
+        "w_gateup": (
+            jax.random.normal(k[5], (E, h, 2 * inter)) * scale
+        ).astype(cfg.dtype),
+        "b_gateup": jnp.zeros((E, 2 * inter), cfg.dtype),
+        "w_edown": (
+            jax.random.normal(k[6], (E, inter, h)) * iscale
+        ).astype(cfg.dtype),
+        "b_edown": jnp.zeros((E, h), cfg.dtype),
+    }
+
+
+def init_params(rng: jax.Array, cfg: GptOssConfig) -> Params:
+    keys = jax.random.split(rng, cfg.num_layers + 2)
+    params: Params = {
+        "embed": (
+            jax.random.normal(keys[0], (cfg.vocab_size, cfg.hidden_size)) * 0.02
+        ).astype(cfg.dtype),
+        "final_norm": jnp.ones((cfg.hidden_size,), cfg.dtype),
+        "layers": [init_layer_params(keys[i + 2], cfg) for i in range(cfg.num_layers)],
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(keys[1], (cfg.hidden_size, cfg.vocab_size)) * 0.02
+        ).astype(cfg.dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# router + experts
+# ---------------------------------------------------------------------------
+
+
+def route(p: Params, cfg: GptOssConfig, x: jax.Array):
+    """gpt-oss router: top-k over raw logits, softmax over the SELECTED
+    logits (not over all experts). x [T, H] -> (weights [T,K] f32, idx)."""
+    logits = (
+        x.astype(jnp.float32) @ p["w_router"].astype(jnp.float32)
+        + p["b_router"].astype(jnp.float32)
+    )
+    topv, topi = jax.lax.top_k(logits, cfg.num_experts_per_tok)
+    return jax.nn.softmax(topv, axis=-1), topi
+
+
+def _expert_apply(cfg: GptOssConfig, w_gu, b_gu, w_dn, b_dn, x):
+    """One selected expert per token: fused clamped-swiglu MLP.
+    x [T, H]; w_gu [T, H, 2I]; returns [T, H] (float32 activations)."""
+    gu = jnp.einsum("th,thi->ti", x.astype(jnp.float32), w_gu.astype(jnp.float32))
+    gu = gu + b_gu.astype(jnp.float32)
+    gate, up = gu[..., ::2], gu[..., 1::2]
+    gate = jnp.minimum(gate, cfg.swiglu_limit)
+    up = jnp.clip(up, -cfg.swiglu_limit, cfg.swiglu_limit)
+    glu = gate * jax.nn.sigmoid(gate * cfg.swiglu_alpha)
+    act = (up + 1.0) * glu
+    out = jnp.einsum("ti,tih->th", act, w_dn.astype(jnp.float32))
+    return out + b_dn.astype(jnp.float32)
+
+
+def experts_gather(p: Params, cfg: GptOssConfig, x: jax.Array, routed) -> jax.Array:
+    """Sparse exact path (replicated experts): per-slot weight gathers, K
+    static — the same shape as moe.moe_ffn_gather but with gpt-oss's fused
+    biased projections and clamped swiglu."""
+    topw, topi = routed
+    y = jnp.zeros(x.shape, jnp.float32)
+    for k in range(cfg.num_experts_per_tok):
+        idx = topi[:, k]
+        out = _expert_apply(
+            cfg, p["w_gateup"][idx], p["b_gateup"][idx],
+            p["w_edown"][idx], p["b_edown"][idx], x,
+        )
+        y = y + topw[:, k, None] * out
+    return y.astype(x.dtype)
+
+
+def experts_ep_psum(
+    p: Params, cfg: GptOssConfig, x: jax.Array, routed, axis_name: str
+) -> jax.Array:
+    """Inside shard_map: expert stacks sharded on the leading dim, tokens
+    and routing replicated. Each shard computes only the selected experts it
+    owns (masked gather), one psum combines."""
+    topw, topi = routed
+    E_loc = p["w_gateup"].shape[0]
+    me = jax.lax.axis_index(axis_name)
+    local = topi - me * E_loc
+    y = jnp.zeros(x.shape, jnp.float32)
+    for k in range(cfg.num_experts_per_tok):
+        idx = jnp.clip(local[:, k], 0, E_loc - 1)
+        mine = (local[:, k] >= 0) & (local[:, k] < E_loc)
+        out = _expert_apply(
+            cfg, p["w_gateup"][idx], p["b_gateup"][idx],
+            p["w_edown"][idx], p["b_edown"][idx], x,
+        )
+        y = y + jnp.where(mine, topw[:, k], 0.0)[:, None] * out
+    return jax.lax.psum(y, axis_name).astype(x.dtype)
+
+
+def expert_params(p: Params) -> Params:
+    return {k: p[k] for k in ("w_gateup", "b_gateup", "w_edown", "b_edown")}
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+# attend(q, k, v, layer_idx, window=..., sinks=...) — the engine's attends
+# accept the extra kwargs and thread them into ops/attention.py
+AttendFn = Callable[..., jax.Array]
+
+
+def layer_forward(
+    p: Params,
+    cfg: GptOssConfig,
+    x: jax.Array,                 # [..., S, hidden]
+    cos: jax.Array,
+    sin: jax.Array,
+    attend: AttendFn,
+    layer_idx: int,
+    expert_fn=None,
+) -> jax.Array:
+    lead = x.shape[:-1]
+    h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ p["wq"] + p["bq"]).reshape(*lead, cfg.num_heads, cfg.head_dim)
+    k = (h @ p["wk"] + p["bk"]).reshape(*lead, cfg.num_kv_heads, cfg.head_dim)
+    v = (h @ p["wv"] + p["bv"]).reshape(*lead, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attend(
+        q, k, v, layer_idx,
+        window=cfg.window_for_layer(layer_idx), sinks=p["sinks"],
+    )
+    attn = attn.reshape(*lead, cfg.q_size)
+    x = x + (attn @ p["wo"] + p["bo"])
+    # MoE FFN in every layer
+    hn = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+    flat = hn.reshape(-1, hn.shape[-1])
+    routed = route(p, cfg, flat)
+    if expert_fn is not None:
+        y = expert_fn(expert_params(p), flat, routed)
+    else:
+        y = experts_gather(p, cfg, flat, routed)
+    return x + y.reshape(hn.shape)
+
+
+def forward(
+    params: Params,
+    cfg: GptOssConfig,
+    token_ids: jax.Array,
+    positions: jax.Array,
+    attend: AttendFn,
+    lora: Optional[Callable] = None,
+    inputs_embeds: Optional[jax.Array] = None,
+    expert_fn=None,
+) -> jax.Array:
+    if lora is not None:
+        raise NotImplementedError("LoRA is not supported for the gpt-oss family")
+    x = params["embed"][token_ids] if inputs_embeds is None else inputs_embeds
+    cos, sin = rope_tables(cfg, positions)
+    cos, sin = cos[..., None, :], sin[..., None, :]
+    for i, layer in enumerate(params["layers"]):
+        x = layer_forward(layer, cfg, x, cos, sin, attend, i, expert_fn=expert_fn)
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+def lm_logits(params: Params, cfg: GptOssConfig, hidden: jax.Array) -> jax.Array:
+    if cfg.tie_embeddings:
+        return (hidden @ params["embed"].T).astype(jnp.float32)
+    return (hidden @ params["lm_head"]).astype(jnp.float32)

@@ -12,7 +12,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import AXIS_TP
-from . import llama, mla, moe
+from . import gptoss, llama, mla, moe
 
 
 def is_moe(cfg) -> bool:
@@ -23,9 +23,15 @@ def is_mla(cfg) -> bool:
     return isinstance(cfg, mla.MlaConfig)
 
 
+def is_gptoss(cfg) -> bool:
+    return isinstance(cfg, gptoss.GptOssConfig)
+
+
 def family(cfg):
     if is_mla(cfg):
         return mla
+    if is_gptoss(cfg):
+        return gptoss
     return moe if is_moe(cfg) else llama
 
 
@@ -33,26 +39,18 @@ def init_params(rng, cfg):
     return family(cfg).init_params(rng, cfg)
 
 
-def _ep_psum_shard_map(cfg, mesh, weight_specs, with_routed):
-    """One shard_map wrapper for both families' EP path: expert-stacked
-    weights sharded per ``weight_specs``, tokens (and, for MLA, the
-    precomputed routing) replicated, moe.moe_ffn_ep_psum per shard, psum
-    combine. Keeping a single construction site means the collective shape
-    cannot drift between the MoeConfig and MLA families."""
-    if with_routed:
-        return jax.shard_map(
-            lambda sp, sx, srouted: moe.moe_ffn_ep_psum(
-                sp, cfg, sx, AXIS_TP, routed=srouted
-            ),
-            mesh=mesh,
-            in_specs=(weight_specs, P(), (P(), P())),
-            out_specs=P(),
-            check_vma=False,
-        )
+def _ep_psum_shard_map(mesh, weight_specs, kernel, n_extra_args):
+    """THE shard_map construction site for every family's EP path:
+    expert-stacked weights sharded per ``weight_specs``, tokens (and any
+    precomputed routing) replicated, ``kernel`` per shard with a psum
+    combine inside. One site = the collective shape cannot drift between
+    the MoeConfig, MLA, and gpt-oss families. ``n_extra_args``: 0 for
+    kernel(shard_params, x), 1 for kernel(shard_params, x, routed)."""
+    extra = ((P(), P()),) * n_extra_args
     return jax.shard_map(
-        lambda sp, sx: moe.moe_ffn_ep_psum(sp, cfg, sx, AXIS_TP),
+        kernel,
         mesh=mesh,
-        in_specs=(weight_specs, P()),
+        in_specs=(weight_specs, P(), *extra),
         out_specs=P(),
         check_vma=False,
     )
@@ -68,6 +66,30 @@ def forward_fn(cfg, mesh=None):
       moe_ffn_ep_psum — each shard computes only its local experts, one
       psum combines (same collective as a TP row matmul)
     """
+    if is_gptoss(cfg):
+        if mesh is None or mesh.shape.get(AXIS_TP, 1) == 1:
+            return gptoss.forward
+
+        # EP: gpt-oss's own expert kernel (fused biased gate_up, clamped
+        # swiglu) sharded on the expert dim; router replicated outside
+        gu_specs = {
+            "w_gateup": P(AXIS_TP, None, None),
+            "b_gateup": P(AXIS_TP, None),
+            "w_edown": P(AXIS_TP, None, None),
+            "b_edown": P(AXIS_TP, None),
+        }
+
+        def gptoss_expert_fn(ep, x, routed):
+            fn = _ep_psum_shard_map(
+                mesh, gu_specs,
+                lambda sp, sx, srouted: gptoss.experts_ep_psum(
+                    sp, cfg, sx, srouted, AXIS_TP
+                ),
+                1,
+            )
+            return fn(ep, x, routed)
+
+        return partial(gptoss.forward, expert_fn=gptoss_expert_fn)
     if is_mla(cfg):
         if cfg.num_experts == 0 or mesh is None or mesh.shape.get(AXIS_TP, 1) == 1:
             # per-token gather kernel (exact, sparse) on replicated experts
@@ -88,9 +110,14 @@ def forward_fn(cfg, mesh=None):
         }
 
         def mla_expert_fn(ep, x, routed):
-            return _ep_psum_shard_map(cfg, mesh, weight_specs, True)(
-                ep, x, routed
+            fn = _ep_psum_shard_map(
+                mesh, weight_specs,
+                lambda sp, sx, srouted: moe.moe_ffn_ep_psum(
+                    sp, cfg, sx, AXIS_TP, routed=srouted
+                ),
+                1,
             )
+            return fn(ep, x, routed)
 
         return partial(mla.forward, expert_fn=mla_expert_fn)
     if not is_moe(cfg):
@@ -115,7 +142,12 @@ def forward_fn(cfg, mesh=None):
 
     def ffn(p, _cfg, x):
         sub = {k: p[k] for k in ep_keys}
-        return _ep_psum_shard_map(_cfg, mesh, ep_specs[0], False)(sub, x)
+        fn = _ep_psum_shard_map(
+            mesh, ep_specs[0],
+            lambda sp, sx: moe.moe_ffn_ep_psum(sp, _cfg, sx, AXIS_TP),
+            0,
+        )
+        return fn(sub, x)
 
     return partial(moe.forward, ffn_fn=ffn)
 
@@ -146,6 +178,18 @@ def param_specs(cfg) -> dict:
         "bk": P(AXIS_TP),
         "bv": P(AXIS_TP),
     }
+    if is_gptoss(cfg):
+        layer.update({
+            "bo": P(None),
+            "sinks": P(None),
+            "w_router": P(),
+            "b_router": P(),
+            "w_gateup": P(AXIS_TP, None, None),
+            "b_gateup": P(AXIS_TP, None),
+            "w_edown": P(AXIS_TP, None, None),
+            "b_edown": P(AXIS_TP, None),
+        })
+        return {"top": top, "layer": layer, "default": P()}
     if is_mla(cfg):
         # q heads shard over TP (head-stacked w_uk/w_uv, column-parallel
         # w_uq/wq, row-parallel wo); the shared latent projections and the

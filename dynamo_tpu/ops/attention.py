@@ -16,7 +16,7 @@ layout.rs) with heads minor to keep per-head slices dense for TP sharding.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,16 +44,40 @@ def _gqa_values(weights: jax.Array, v: jax.Array) -> jax.Array:
     return out.reshape(S, h, d)
 
 
-def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+def _sink_softmax(scores: jax.Array, sinks: jax.Array) -> jax.Array:
+    """Softmax over the key axis with attention-sink logits in the
+    DENOMINATOR only (gpt-oss: a virtual key whose probability mass is
+    dropped, damping every real weight). scores [..., T]; ``sinks``
+    broadcastable to scores' leading dims."""
+    m = jnp.maximum(jnp.max(scores, axis=-1), sinks)
+    p = jnp.exp(scores - m[..., None])
+    denom = jnp.sum(p, axis=-1) + jnp.exp(sinks - m)
+    return p / denom[..., None]
+
+
+def causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    window: Optional[int] = None,
+    sinks: Optional[jax.Array] = None,
+) -> jax.Array:
     """Plain causal self-attention for a single contiguous sequence.
 
-    q,k,v: [S, heads/kv_heads, head_dim] -> [S, heads, head_dim]."""
+    q,k,v: [S, heads/kv_heads, head_dim] -> [S, heads, head_dim].
+    ``window``: sliding-window attention — key j visible to query i iff
+    i - window < j <= i. ``sinks``: per-head [h] attention-sink logits
+    (gpt-oss) folded into the softmax denominator."""
     S = q.shape[0]
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     scores = _gqa_scores(q, k) * scale
-    causal = jnp.tril(jnp.ones((S, S), bool))
+    qi, kj = jnp.arange(S)[:, None], jnp.arange(S)[None, :]
+    causal = kj <= qi
+    if window is not None:
+        causal &= kj > qi - window
     scores = jnp.where(causal[:, None, :], scores, NEG_INF)
-    weights = jax.nn.softmax(scores, axis=-1)
+    if sinks is None:
+        weights = jax.nn.softmax(scores, axis=-1)
+    else:
+        weights = _sink_softmax(scores, sinks.astype(jnp.float32))
     return _gqa_values(weights, v).astype(q.dtype)
 
 
@@ -63,18 +87,27 @@ def extend_attention(
     v_ctx: jax.Array,        # [T_max, kvh, d]
     q_positions: jax.Array,  # [S_new] absolute positions of the queries
     total_len: jax.Array,    # scalar: valid length of the context
+    window: Optional[int] = None,
+    sinks: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Prefix-extend attention: new tokens attend causally over (cached prefix
     + themselves). Used for prefill with device-side prefix-cache reuse and
     for chunked prefill continuation. Context is padded to T_max; invalid
-    positions masked."""
+    positions masked. ``window``/``sinks``: see causal_attention (the
+    context layout is positional, so the window mask is absolute-position
+    based)."""
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     T = k_ctx.shape[0]
     scores = _gqa_scores(q, k_ctx) * scale  # [S,h,T]
     key_pos = jnp.arange(T)
     valid = key_pos[None, :] < jnp.minimum(q_positions[:, None] + 1, total_len)
+    if window is not None:
+        valid &= key_pos[None, :] > q_positions[:, None] - window
     scores = jnp.where(valid[:, None, :], scores, NEG_INF)
-    weights = jax.nn.softmax(scores, axis=-1)
+    if sinks is None:
+        weights = jax.nn.softmax(scores, axis=-1)
+    else:
+        weights = _sink_softmax(scores, sinks.astype(jnp.float32))
     return _gqa_values(weights, v_ctx).astype(q.dtype)
 
 
@@ -100,15 +133,38 @@ def paged_decode_attention(
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, max_blocks] int32
     seq_lens: jax.Array,      # [B] int32 context length incl. current token
+    window: Optional[int] = None,
+    sinks: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Paged decode attention, batched: each query attends over its own pages.
 
     Pure-JAX formulation: per-sequence page gather via vmap; masked softmax.
+    ``window``/``sinks``: see causal_attention. The decode query sits at
+    position length-1, so the window admits key indices >= length - window.
+    Sliding-window layers gather ONLY the window's trailing blocks (a
+    static ceil(window/bs)+1 slice of the block table), so a 128-token
+    window over a 128k context reads ~window keys, not the whole cache.
     """
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    bs = k_cache.shape[1]
+    if window is not None:
+        wb = min((window + bs - 1) // bs + 1, block_tables.shape[1])
 
     def one(qb, table, length):
-        k, v = gather_kv(k_cache, v_cache, table)      # [T, kvh, d]
+        if window is None:
+            k, v = gather_kv(k_cache, v_cache, table)  # [T, kvh, d]
+            key_pos = jnp.arange(k.shape[0])
+            valid = key_pos < length
+        else:
+            # trailing-window gather: last wb table entries that cover
+            # [length - window, length)
+            nblocks = jnp.maximum((length + bs - 1) // bs, 1)
+            start = jnp.maximum(nblocks - wb, 0)
+            idx = start + jnp.arange(wb)
+            sub = table[jnp.clip(idx, 0, table.shape[0] - 1)]
+            k, v = gather_kv(k_cache, v_cache, sub)    # [wb*bs, kvh, d]
+            key_pos = start * bs + jnp.arange(wb * bs)
+            valid = (key_pos < length) & (key_pos >= length - window)
         h, d = qb.shape
         kvh = k.shape[1]
         g = h // kvh
@@ -116,10 +172,13 @@ def paged_decode_attention(
         scores = jnp.einsum(
             "kgd,tkd->kgt", qg.astype(jnp.float32), k.astype(jnp.float32)
         ) * scale                                       # [kvh, g, T]
-        T = k.shape[0]
-        valid = jnp.arange(T) < length
         scores = jnp.where(valid[None, None, :], scores, NEG_INF)
-        weights = jax.nn.softmax(scores, axis=-1)
+        if sinks is None:
+            weights = jax.nn.softmax(scores, axis=-1)
+        else:
+            weights = _sink_softmax(
+                scores, sinks.astype(jnp.float32).reshape(kvh, g)
+            )
         out = jnp.einsum("kgt,tkd->kgd", weights, v.astype(jnp.float32))
         return out.reshape(h, d)
 
