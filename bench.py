@@ -165,18 +165,21 @@ def fleet_metrics() -> dict:
     }
 
 
-def main() -> None:
-    batches = [int(b) for b in SWEEP.split(",") if b.strip()] or [BATCH]
-    results = []
-    errors = []
-    for b in batches:
-        # a tunnel flake on one config must not sink the whole run: keep
-        # whatever measured and report the failures in detail
-        try:
-            results.append(asyncio.run(run_bench(b)))
-        except Exception as e:
-            errors.append({"batch": b, "error": repr(e)[:300]})
-            print(f"bench batch={b} failed: {e!r}", file=sys.stderr)
+# a dead TPU tunnel HANGS ops (no exception to catch), which historically
+# turned the driver run into rc=124 with no JSON at all (BENCH_r03/r04).
+# The watchdog guarantees ONE JSON line: at the deadline it emits the best
+# result measured so far (or the unreachable-error record) and hard-exits.
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE", "1200"))
+# exactly one JSON line ever reaches stdout: main and the watchdog race to
+# claim the emit (threading primitives imported lazily with the watchdog)
+_emit_claimed = None
+
+
+def _claim_emit() -> bool:
+    return _emit_claimed.acquire(blocking=False)
+
+
+def _emit(results, errors) -> None:
     if not results:
         print(json.dumps({
             "metric": "decode_throughput_qwen3_0.6b",
@@ -185,7 +188,7 @@ def main() -> None:
             "vs_baseline": 0.0,
             "detail": {"errors": errors, "note": "all bench configs failed "
                        "(device unreachable?); see errors"},
-        }))
+        }), flush=True)
         return
     best = max(results, key=lambda r: r["vs_baseline"])
     best = dict(best)
@@ -207,7 +210,45 @@ def main() -> None:
             best["detail"]["fleet"] = fleet_metrics()
         except Exception as e:  # fleet benches must never sink the TPU number
             best["detail"]["fleet"] = {"error": repr(e)}
-    print(json.dumps(best))
+    print(json.dumps(best), flush=True)
+
+
+def _watchdog(results, errors) -> None:
+    import threading
+
+    global _emit_claimed
+    _emit_claimed = threading.Lock()
+
+    def fire():
+        time.sleep(DEADLINE_S)
+        if not _claim_emit():
+            return  # main already emitted (or is emitting)
+        errors.append({
+            "error": f"watchdog: device ops still hung after {DEADLINE_S}s "
+                     "(TPU tunnel down?); emitting best-so-far"
+        })
+        _emit(list(results), list(errors))
+        os._exit(0)
+
+    threading.Thread(target=fire, daemon=True).start()
+
+
+def main() -> None:
+    batches = [int(b) for b in SWEEP.split(",") if b.strip()] or [BATCH]
+    results = []
+    errors = []
+    _watchdog(results, errors)
+    for b in batches:
+        # a tunnel flake on one config must not sink the whole run: keep
+        # whatever measured and report the failures in detail
+        try:
+            results.append(asyncio.run(run_bench(b)))
+        except Exception as e:
+            errors.append({"batch": b, "error": repr(e)[:300]})
+            print(f"bench batch={b} failed: {e!r}", file=sys.stderr)
+    if not _claim_emit():
+        return  # watchdog emitted and is exiting
+    _emit(results, errors)
 
 
 if __name__ == "__main__":

@@ -378,24 +378,29 @@ def _load_params_gptoss(path: str, cfg) -> Dict[str, Any]:
             elif rest.startswith("mlp.experts.") and (
                 rest.endswith("_blocks") or rest.endswith("_scales")
             ):
-                # MXFP4-quantized release: stash blocks+scales, dequantize
-                # once both halves of a tensor arrived
-                mx.setdefault(li, {})[rest.removeprefix("mlp.experts.")] = w
+                # MXFP4-quantized release: dequantize the moment both halves
+                # of a tensor arrive and DROP the raw halves — peak host
+                # memory stays one tensor, not the whole quantized model
+                part = rest.removeprefix("mlp.experts.")
+                lay = mx.setdefault(li, {})
+                lay[part] = w
+                base = part.rsplit("_", 1)[0]
+                b = lay.get(f"{base}_blocks")
+                sc = lay.get(f"{base}_scales")
+                if b is not None and sc is not None:
+                    ours = {"gate_up_proj": "w_gateup", "down_proj": "w_edown"}[base]
+                    layers[li][ours] = put(dequant_mxfp4(b, sc))
+                    del lay[f"{base}_blocks"], lay[f"{base}_scales"]
             else:
                 log.debug("ignoring unmapped tensor %s", name)
         else:
             log.debug("ignoring unmapped tensor %s", name)
     for li, parts_d in mx.items():
-        for hf_name, ours in (
-            ("gate_up_proj", "w_gateup"), ("down_proj", "w_edown")
-        ):
-            b, sc = parts_d.get(f"{hf_name}_blocks"), parts_d.get(f"{hf_name}_scales")
-            if b is None or sc is None:
-                raise ValueError(
-                    f"layer {li}: MXFP4 tensor {hf_name} missing its "
-                    f"{'scales' if sc is None else 'blocks'} half"
-                )
-            layers[li][ours] = put(dequant_mxfp4(b, sc))
+        if parts_d:  # an unpaired half means a truncated/corrupt checkpoint
+            raise ValueError(
+                f"layer {li}: MXFP4 tensors missing their other half: "
+                f"{sorted(parts_d)}"
+            )
     missing = [
         i for i, lp in enumerate(layers)
         if "wq" not in lp or "sinks" not in lp or "w_gateup" not in lp

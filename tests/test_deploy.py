@@ -70,7 +70,8 @@ def test_yaml_roundtrips_and_example_specs_render():
 
     for example in ("deploy/examples/agg-serving.yaml",
                     "deploy/examples/disagg-serving.yaml",
-                    "deploy/examples/deepseek-v3-disagg.yaml"):
+                    "deploy/examples/deepseek-v3-disagg.yaml",
+                    "deploy/examples/gpt-oss-120b.yaml"):
         objs = render(GraphSpec.load(example))
         assert objs
         names = {o["metadata"]["name"] for o in objs}
