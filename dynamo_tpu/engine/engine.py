@@ -48,6 +48,7 @@ from ..models.vision import IMAGE_TOKEN_ID
 from ..ops import attention as att
 from ..parallel import mesh as meshlib
 from ..runtime.engine import Context
+from ..runtime.tasks import spawn_bg
 from ..runtime.logging import get_logger
 from ..tokens import TokenBlockSequence
 from .allocator import BlockAllocator, OutOfBlocks
@@ -1620,7 +1621,7 @@ class TpuEngine:
             log.exception("engine loop crashed")
             self.healthy = False
             if self.on_crash is not None:
-                asyncio.ensure_future(self.on_crash(crash))
+                spawn_bg(self.on_crash(crash))
             for st in list(self._waiting) + [s for s in self._slots if s]:
                 st.done = True
                 st.out_queue.put_nowait(

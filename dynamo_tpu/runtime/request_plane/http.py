@@ -28,6 +28,7 @@ from aiohttp.client_exceptions import ClientConnectorError, ClientError
 
 from ..engine import Context
 from ..logging import get_logger
+from ..tasks import spawn_bg
 from .tcp import Handler, NoResponders, RequestPlaneError
 
 log = get_logger("runtime.http_plane")
@@ -154,7 +155,7 @@ class HttpClient:
             raise NoResponders(f"connect {address}: {e}") from e
 
         def on_cancel() -> None:
-            asyncio.ensure_future(self._send_cancel(address, rid))
+            spawn_bg(self._send_cancel(address, rid))
 
         ctx.on_cancel(on_cancel)
 

@@ -31,6 +31,7 @@ import msgpack
 
 from ..engine import Context
 from ..logging import get_logger
+from ..tasks import spawn_bg
 
 log = get_logger("runtime.tcp")
 
@@ -287,7 +288,7 @@ class TcpClient:
                     pass
 
         def on_cancel() -> None:
-            asyncio.ensure_future(send_cancel())
+            spawn_bg(send_cancel())
 
         ctx.on_cancel(on_cancel)
         try:

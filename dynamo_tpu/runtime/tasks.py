@@ -30,6 +30,26 @@ from .logging import get_logger
 log = get_logger("runtime.tasks")
 
 
+# fire-and-forget background tasks: the event loop holds tasks only by WEAK
+# reference, so a task whose handle is discarded can be garbage-collected
+# mid-flight and silently die. spawn_bg pins the task until it completes
+# (tools/lint.py DROPPED-TASK enforces its use over bare ensure_future).
+_BG_TASKS: set = set()
+
+
+def _bg_done(task: "asyncio.Task") -> None:
+    _BG_TASKS.discard(task)
+    if not task.cancelled() and task.exception() is not None:
+        log.error("background task failed: %r", task.exception())
+
+
+def spawn_bg(coro) -> "asyncio.Task":
+    task = asyncio.ensure_future(coro)
+    _BG_TASKS.add(task)
+    task.add_done_callback(_bg_done)
+    return task
+
+
 class ErrorPolicy(enum.Enum):
     FAIL = "fail"          # record + continue
     SHUTDOWN = "shutdown"  # any failure cancels the tracker tree

@@ -87,3 +87,26 @@ def test_arity_checker_skips_dynamic_patterns(tmp_path):
         capture_output=True, text=True, timeout=60,
     )
     assert "ARITY" not in r.stdout
+
+
+def test_dropped_task_pass():
+    import ast
+
+    from tools.lint import dropped_tasks
+
+    src = """
+import asyncio
+
+async def bad():
+    asyncio.create_task(work())       # discarded -> flagged
+    asyncio.ensure_future(work())     # discarded -> flagged
+
+async def good():
+    t = asyncio.create_task(work())   # kept
+    ts = [asyncio.create_task(work()) for _ in range(2)]  # kept via list
+    await asyncio.gather(asyncio.ensure_future(work()))   # kept via gather
+    return t, ts
+"""
+    found = dropped_tasks("x.py", ast.parse(src))
+    assert len(found) == 2
+    assert {f[1] for f in found} == {5, 6}

@@ -202,6 +202,44 @@ def call_arity(path: str, tree: ast.AST):
     return findings
 
 
+def dropped_tasks(path: str, tree: ast.AST):
+    """Fire-and-forget ``asyncio.create_task`` / ``loop.create_task`` /
+    ``ensure_future`` calls whose result is DISCARDED (an expression
+    statement). The event loop holds tasks only by weak reference, so a
+    dropped task can be garbage-collected mid-flight and silently die —
+    the bug class behind the kvbm shutdown hang (round 4) and the
+    transfer-server stop task (engine.stop keeps an explicit ref). Store
+    the task (assign it, append it, pass it to a tracker) or use a
+    TaskTracker. A bare ``create_task(...)`` inside a larger expression
+    (gather, list, call argument) keeps a reference and is fine."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Expr) or not isinstance(node.value, ast.Call):
+            continue
+        fn = node.value.func
+        name = None
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+            # receivers that HOLD their tasks are fine: TaskGroup-style
+            # (tg.create_task) and tracker objects; only flag the asyncio
+            # module / event-loop spellings
+            recv = fn.value
+            recv_name = recv.id if isinstance(recv, ast.Name) else (
+                recv.attr if isinstance(recv, ast.Attribute) else None
+            )
+            if name == "create_task" and recv_name not in (
+                "asyncio", "loop", "_loop", "event_loop"
+            ):
+                continue
+        elif isinstance(fn, ast.Name):
+            name = fn.id
+        if name in ("create_task", "ensure_future"):
+            out.append((path, node.lineno,
+                        f"{name}(...) result discarded — the loop only "
+                        "weak-refs tasks; keep a reference"))
+    return out
+
+
 def _ident_tokens(text: str):
     tok = ""
     for ch in text:
@@ -238,6 +276,9 @@ def main(argv) -> int:
                 bad += 1
         for p, lineno, msg in call_arity(path, tree):
             print(f"{p}:{lineno}: ARITY: {msg}")
+            bad += 1
+        for p, lineno, msg in dropped_tasks(path, tree):
+            print(f"{p}:{lineno}: DROPPED-TASK: {msg}")
             bad += 1
     if bad:
         print(f"{bad} finding(s)")
