@@ -57,6 +57,15 @@ def parse_args():
     p.add_argument("--model-path", default=None, help="local HF checkpoint dir")
     p.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
     p.add_argument("--tokenizer", default=None, help="tokenizer path (default: model-path or byte)")
+    p.add_argument("--tool-parser", default=None,
+                   help="streaming tool-call dialect for this model's card "
+                        "(parsers/tool_calls.py registry); default: harmony "
+                        "for gpt-oss presets, else none")
+    p.add_argument("--reasoning-parser", default=None,
+                   help="reasoning-block parser for the card "
+                        "(e.g. deepseek_r1, qwen3, gpt_oss; "
+                        "parsers/reasoning.py registry); default: gpt_oss "
+                        "for gpt-oss presets, else none")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--component", default="backend")
     p.add_argument("--endpoint", default="generate")
@@ -495,6 +504,22 @@ async def main() -> None:
         addr = await transfer_engine.serve_transfer(host=cfg.host_ip)
         print(f"KV_TRANSFER at {addr}", flush=True)
 
+    # parser names fail FAST at worker startup (the frontend's _safe_parser
+    # degrades unknown names to pass-through with only a warning); gpt-oss
+    # presets default to the harmony dialect + its reasoning channels
+    is_oss = isinstance(mcfg, GptOssConfig)
+    tool_parser = args.tool_parser if args.tool_parser is not None else (
+        "harmony" if is_oss else None
+    )
+    reasoning_parser = (
+        args.reasoning_parser if args.reasoning_parser is not None
+        else ("gpt_oss" if is_oss else None)
+    )
+    from dynamo_tpu.parsers import get_reasoning_parser, get_tool_parser
+
+    get_tool_parser(tool_parser)
+    get_reasoning_parser(reasoning_parser)
+
     card = ModelDeploymentCard(
         name=args.model,
         namespace=args.namespace,
@@ -508,6 +533,8 @@ async def main() -> None:
         image_tokens=(vcfg.num_patches if vcfg is not None else 0),
         image_size=(vcfg.image_size if vcfg is not None else 0),
         image_token_id=engine_cfg.image_token_id,
+        tool_parser=tool_parser,
+        reasoning_parser=reasoning_parser,
         runtime_config=ModelRuntimeConfig(
             total_kv_blocks=args.num_blocks,
             data_parallel_size=args.dp,

@@ -185,3 +185,18 @@ async def test_engine_mla_moe_ep_tp2_matches_tp1():
     finally:
         e2.stop()
     assert t1 == t2
+
+
+def test_kv_cache_spec_gqa_fallback():
+    """GQA caches shard kv_heads over TP only when they divide; otherwise
+    (and always for 1-head MQA/latent caches) they replicate — matching the
+    engine's Pallas eligibility condition."""
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.parallel.mesh import AXIS_TP
+
+    gqa = LlamaConfig(num_kv_heads=2)
+    assert registry.kv_cache_spec(gqa, tp=2) == P(None, None, AXIS_TP, None)
+    # 2 kv heads on 4 TP shards cannot lay out: replicate
+    assert registry.kv_cache_spec(gqa, tp=4) == P(None, None, None, None)
