@@ -160,3 +160,26 @@ def test_unsupported_paths_fail_fast():
                             prefill_buckets=(16, 32), use_pallas=True),
             mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
         )
+
+
+async def test_engine_gptoss_prefix_reuse_matches():
+    """Second request sharing a long prefix reuses cached blocks; the
+    windowed extend attention over the cached prefix must produce the same
+    greedy continuation as the cold path."""
+    cfg = _cfg()
+    engine = engine_for(cfg)
+    try:
+        prefix = [int(x) for x in
+                  jax.random.randint(jax.random.PRNGKey(11), (24,), 5, 500)]
+        cold = await _run(engine, greedy_req("cold", prefix))
+        cached = None
+        req = greedy_req("warm", prefix)
+        toks = []
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.annotations:
+                cached = out.annotations.get("cached_tokens", cached)
+        assert toks == cold
+        assert cached and cached > 0
+    finally:
+        engine.stop()

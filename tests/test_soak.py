@@ -1,0 +1,126 @@
+"""Concurrency soak: many streams, random mid-stream disconnects, no leaks.
+
+The behavioral race-detection analog of the reference's determinism tests
+(tests/kvbm_integration/test_determinism_*.py) plus its cancellation docs:
+under churn, every request must either complete or cancel cleanly — the
+worker must end with zero running/waiting sequences and all blocks freed,
+and the frontend must keep serving afterward.
+"""
+
+import asyncio
+import random
+
+import aiohttp
+
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+from dynamo_tpu.llm import (
+    ModelDeploymentCard,
+    ModelManager,
+    ModelWatcher,
+    register_llm,
+)
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    InProcEventPlane,
+    MemKVStore,
+    RouterMode,
+    RuntimeConfig,
+)
+
+N_REQUESTS = 40
+DISCONNECT_EVERY = 3   # every 3rd request disconnects mid-stream
+
+
+def make_rt(store):
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    return DistributedRuntime(cfg, store=store, event_plane=InProcEventPlane())
+
+
+async def test_soak_streams_with_random_disconnects():
+    random.seed(7)
+    store = MemKVStore()
+    worker_rt = await make_rt(store).start()
+    frontend_rt = await make_rt(store).start()
+    engine = MockerEngine(MockEngineArgs(speedup_ratio=20.0))
+    card = ModelDeploymentCard(name="soak", tokenizer="byte", context_length=4096)
+    served = await register_llm(worker_rt, engine, card)
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager, RouterMode.ROUND_ROBIN).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    for _ in range(100):
+        if manager.get("soak") and manager.get("soak").client.instances:
+            break
+        await asyncio.sleep(0.05)
+
+    completed, disconnected, failed = 0, 0, []
+    # randomized per-request disconnect points (seeded for reproducibility)
+    drop_at = {
+        i: random.randint(1, 6)
+        for i in range(N_REQUESTS) if i % DISCONNECT_EVERY == 0
+    }
+
+    async def one(i: int):
+        nonlocal completed, disconnected
+        body = {
+            "model": "soak",
+            "messages": [{"role": "user", "content": f"prompt {i} " + "x" * (i % 37)}],
+            "max_tokens": 24 + (i % 40),
+            "stream": True,
+        }
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                    assert r.status == 200, await r.text()
+                    seen = 0
+                    async for line in r.content:
+                        line = line.decode().strip()
+                        if not line.startswith("data: "):
+                            continue
+                        if line == "data: [DONE]":
+                            completed += 1
+                            return
+                        seen += 1
+                        if i in drop_at and seen >= drop_at[i]:
+                            disconnected += 1
+                            return  # closing the session mid-stream = disconnect
+        except Exception as e:  # noqa: BLE001 — collect, assert at end
+            failed.append((i, repr(e)))
+
+    try:
+        await asyncio.gather(*(one(i) for i in range(N_REQUESTS)))
+        assert not failed, failed[:5]
+        assert completed + disconnected == N_REQUESTS
+        assert disconnected > 0 and completed > 0
+
+        # teardown must fully drain: no running/waiting sequences, all KV
+        # blocks back, within a cancellation-propagation grace period
+        for _ in range(80):
+            snap = engine.snapshot()
+            if (snap["running"] == 0 and snap["waiting"] == 0
+                    and snap["active_blocks"] == 0):
+                break
+            await asyncio.sleep(0.05)
+        snap = engine.snapshot()
+        assert snap["running"] == 0, snap
+        assert snap["waiting"] == 0, snap
+        assert snap["active_blocks"] == 0, snap
+
+        # and the stack still serves
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "soak",
+                      "messages": [{"role": "user", "content": "after the storm"}]},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["usage"]["completion_tokens"] > 0
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await served.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()
