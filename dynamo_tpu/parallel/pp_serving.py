@@ -14,13 +14,20 @@ tp) and runs cache-aware attention — ``write_prefill_kv``/``gather_kv``/
 ``extend_attention`` for prefill chunks, ``write_decode_kv``/
 ``paged_decode_attention`` for decode — on its local shards.
 
-Schedule (correctness-first v1): ONE microbatch rides a pp-tick wavefront;
-every rank computes every tick (SPMD) but commits KV only on its own tick by
-masking write targets to scratch block 0 otherwise (the engine's existing
-inactive-slot convention — block 0 is never allocated). The final stage's
-hidden state is psum-broadcast so sampling outside the shard_map sees a
-replicated value. Microbatched decode (batch split across ticks, bubble
-amortized) is the perf refinement; the interface doesn't change.
+Schedules: prefill (one sequence per dispatch) rides a one-microbatch
+pp-tick wavefront; DECODE runs a generalized (M + pp - 1)-tick schedule
+where rank s owns microbatch t - s on tick t, and INVALID ticks skip their
+stage compute entirely via lax.cond (safe: a TP group shares its pp rank,
+so the stage psum stays collective-uniform). Decode at serving batch sizes
+is weight-bandwidth bound — a stage tick costs ~one read of the stage's
+weights regardless of rows — so the default is M = 1 (pp ticks, one real
+stage execution per rank per step); DTPU_PP_MICROBATCHES=<M> opts into
+GPipe bubble amortization for compute-bound regimes (large B), where work
+drops from pp x B rows to (M + pp - 1) x B/M.
+profiler/fleet_bench.pp_bubble_bench measures both schedules. KV commits
+are additionally masked to scratch block 0 on invalid ticks (block 0 is
+never allocated). The final stage's outputs are psum-broadcast so sampling
+outside the shard_map sees replicated values.
 
 The engine plugs these in as drop-in forwards (engine/engine.py
 _build_programs, cfg.pp > 1): the surrounding program — sampling, penalties,
@@ -30,6 +37,7 @@ with the stacked caches living as 1-element k_caches/v_caches lists.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Tuple
 
@@ -265,13 +273,47 @@ def make_pp_embed_forward(mesh: Mesh, mcfg: llama.LlamaConfig, pp: int, tp: int)
 def make_pp_decode_forward(mesh: Mesh, mcfg: llama.LlamaConfig, pp: int, tp: int):
     """fwd(stacked_params, k_stack, v_stack, tokens [B], positions [B],
     block_tables, seq_lens, write_blocks, write_offsets)
-    -> (hidden [B, H] replicated, k', v')."""
+    -> (hidden [B, H] replicated, k', v').
+
+    MICROBATCHED wavefront: the decode batch splits into M = pp microbatches
+    (when B divides evenly; M = 1 otherwise) and rank ``s`` processes
+    microbatch ``t - s`` on tick ``t`` over ``M + pp - 1`` ticks — every
+    stage is busy on the steady-state ticks, so per-step stage work drops
+    from pp x B rows (the one-microbatch wavefront's bubble) to
+    (M + pp - 1) x B/M rows: ~2x B at M = pp instead of pp x B. Invalid
+    (rank, tick) pairs mask their KV writes to scratch block 0 and their
+    garbage activations only ever flow into ticks that are also invalid
+    (the microbatch index m = t - s is ppermute-invariant)."""
     _check_cfg(mcfg, pp, tp)
 
     def fwd(params, k_stack, v_stack, tokens, positions, block_tables,
             seq_lens, write_blocks, write_offsets):
         specs = stacked_param_specs(params)
         cache = pp_cache_spec()
+        B = tokens.shape[0]
+        # Decode at serving batch sizes is WEIGHT-bandwidth bound: a stage
+        # tick costs ~one read of the stage's weights regardless of rows, so
+        # splitting the batch into M microbatches trades pp ticks for
+        # M + pp - 1 ticks of weight reads — a LOSS unless row compute
+        # dominates (large B). Default M = 1; DTPU_PP_MICROBATCHES=<M> opts
+        # into bubble amortization for compute-bound regimes
+        # (fleet_bench.pp_bubble_bench measures both). Invalid ticks skip
+        # their stage compute entirely via lax.cond (per-pp-rank branch;
+        # the TP group shares the pp rank, so the psum inside the stage
+        # stays collective-uniform).
+        try:
+            want = int(os.environ.get("DTPU_PP_MICROBATCHES", "1").strip())
+        except ValueError:
+            want = 1
+        M = want if (want > 0 and B % want == 0 and B >= want) else 1
+        mb = B // M
+        # escape hatch: DTPU_PP_COND_SKIP=0 reverts invalid ticks to
+        # always-compute-with-masked-writes (no lax.cond around the cache
+        # stacks). cond-skip measured 1.5x faster per step on the CPU mesh;
+        # whether XLA aliases the conditional's cache outputs (vs copying
+        # multi-GB stacks per skip tick) on real TPU is unprofiled — flip
+        # this off if a TPU profile shows copy-insertion costs.
+        cond_skip = os.environ.get("DTPU_PP_COND_SKIP", "1") != "0"
 
         @partial(
             jax.shard_map, mesh=mesh,
@@ -281,37 +323,79 @@ def make_pp_decode_forward(mesh: Mesh, mcfg: llama.LlamaConfig, pp: int, tp: int
         )
         def run(params, k_stack, v_stack, tokens, positions, block_tables,
                 seq_lens, write_blocks, write_offsets):
-            cos, sin = llama.rope_cos_sin(
-                positions, mcfg.head_dim, mcfg.rope_theta
-            )
-            cos, sin = cos[:, None, :], sin[:, None, :]
-            serve_layer = _make_serve_layer(mcfg, tp, cos, sin)
-            x = params["embed"][tokens]  # [B, H]
+            rank = jax.lax.axis_index(AXIS_PP)
+            # per-microbatch views [M, mb, ...]
+            toks_mb = tokens.reshape(M, mb)
+            pos_mb = positions.reshape(M, mb)
+            bt_mb = block_tables.reshape(M, mb, -1)
+            sl_mb = seq_lens.reshape(M, mb)
+            wb_mb = write_blocks.reshape(M, mb)
+            wo_mb = write_offsets.reshape(M, mb)
+            cos_all, sin_all = llama.rope_cos_sin(
+                pos_mb, mcfg.head_dim, mcfg.rope_theta
+            )                                         # [M, mb, d/2]
+            xs = params["embed"][toks_mb]             # [M, mb, H]
 
-            def run_stage(inp, valid, _state):
-                wb = jnp.where(valid, write_blocks, jnp.zeros_like(write_blocks))
-                wo = jnp.where(valid, write_offsets, jnp.zeros_like(write_offsets))
+            caches = [k_stack, v_stack]
+            ys = jnp.zeros_like(xs)
+            recv = jnp.zeros_like(xs[0])
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            for t in range(M + pp - 1):
+                m = t - rank                          # this rank's microbatch
+                mc = jnp.clip(m, 0, M - 1)
+                valid = (m >= 0) & (m < M)
+                x_own = jax.lax.dynamic_index_in_dim(
+                    xs, jnp.minimum(t, M - 1), 0, keepdims=False
+                )
+                inp = jnp.where(rank == 0, x_own, recv)
+                wb = jnp.where(valid, wb_mb[mc], jnp.zeros_like(wb_mb[0]))
+                wo = jnp.where(valid, wo_mb[mc], jnp.zeros_like(wo_mb[0]))
+                bt, sl = bt_mb[mc], sl_mb[mc]
+                serve_layer = _make_serve_layer(
+                    mcfg, tp, cos_all[mc][:, None, :], sin_all[mc][:, None, :]
+                )
 
-                def attend_one(q, k_new, v_new, kc, vc):
+                def attend_one(q, k_new, v_new, kc, vc, wb=wb, wo=wo,
+                               bt=bt, sl=sl):
                     kc, vc = att.write_decode_kv(kc, vc, k_new, v_new, wb, wo)
-                    out = att.paged_decode_attention(
-                        q, kc, vc, block_tables, seq_lens
-                    )
+                    out = att.paged_decode_attention(q, kc, vc, bt, sl)
                     return out, kc, vc
 
-                nonlocal_k, nonlocal_v = run_stage.caches
-                out, k2, v2 = _stage_scan(
-                    serve_layer, params["layers"], nonlocal_k, nonlocal_v,
-                    inp, attend_one,
-                )
-                run_stage.caches = (k2, v2)
-                return out, None
+                if cond_skip:
+                    def do_stage(args):
+                        x_in, kl, vl = args
+                        return _stage_scan(
+                            serve_layer, params["layers"], kl, vl, x_in,
+                            attend_one,
+                        )
 
-            run_stage.caches = (k_stack, v_stack)
-            hidden, _ = _wavefront(pp, x, run_stage)
-            k2, v2 = run_stage.caches
+                    def skip_stage(args):
+                        return args  # activation + caches through untouched
+
+                    out, k2, v2 = jax.lax.cond(
+                        valid, do_stage, skip_stage,
+                        (inp, caches[0], caches[1]),
+                    )
+                else:
+                    # masked-write schedule: every tick computes; invalid
+                    # ticks write scratch block 0 (wb/wo already masked)
+                    out, k2, v2 = _stage_scan(
+                        serve_layer, params["layers"], caches[0], caches[1],
+                        inp, attend_one,
+                    )
+                caches = [k2, v2]
+                # rank pp-1's tick-t output is microbatch t-(pp-1)
+                m_out = t - (pp - 1)
+                if 0 <= m_out < M:
+                    ys = ys.at[m_out].set(
+                        jnp.where(rank == pp - 1, out, ys[m_out])
+                    )
+                recv = jax.lax.ppermute(out, AXIS_PP, perm)
+            # only rank pp-1 holds real outputs; broadcast them
+            final = jnp.where(rank == pp - 1, ys, jnp.zeros_like(ys))
+            hidden = jax.lax.psum(final, AXIS_PP).reshape(B, -1)
             hidden = _rms(hidden, params["final_norm"], mcfg.rms_norm_eps)
-            return hidden, k2, v2
+            return hidden, caches[0], caches[1]
 
         return run(params, k_stack, v_stack, tokens, positions, block_tables,
                    seq_lens, write_blocks, write_offsets)

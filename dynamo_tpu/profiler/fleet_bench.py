@@ -306,3 +306,81 @@ async def disagg_vs_agg_bench(
             agg["decode_itl_p95_ms"] / max(dis["decode_itl_p95_ms"], 1e-9), 3
         ),
     }
+
+
+def pp_bubble_bench(
+    pp: int = 2, batch: int = 8, steps: int = 6, layers: int = 4,
+) -> Dict[str, float]:
+    """Measure both pipeline-parallel decode schedules: wall time per step
+    at M = 1 (default; invalid ticks lax.cond-skipped, one real stage
+    execution per rank — the weight-bandwidth-bound regime's best) vs
+    M = pp (GPipe bubble amortization for compute-bound/large-batch
+    regimes). The FLOP-model ratio pp*B : (2pp-1)*B/pp is reported so the
+    measurement can be compared against the compute-bound prediction; in
+    the weight-bound regime the observed ratio inverts (more ticks = more
+    weight reads), which is exactly why M = 1 is the default."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from ..models import llama
+    from ..parallel import pp_serving
+    from ..parallel.pipeline import make_pp_mesh
+
+    devs = jax.devices()
+    if len(devs) < pp:
+        return {"error": f"need {pp} devices, have {len(devs)}"}
+    mesh = make_pp_mesh(pp=pp, tp=1, devices=devs[:pp])
+    # shapes large enough that stage compute dominates dispatch overhead
+    mcfg = llama.LlamaConfig(
+        vocab_size=4096, hidden_size=1024, num_layers=layers, num_heads=8,
+        num_kv_heads=4, head_dim=128, intermediate_size=4096,
+    )
+    params = pp_serving.place_serving_params(
+        mesh, llama.init_params(jax.random.PRNGKey(0), mcfg)
+    )
+    nb, bs = 64, 4
+    k, v = pp_serving.init_pp_caches(
+        mesh, layers, nb, bs, mcfg.num_kv_heads, mcfg.head_dim, mcfg.dtype
+    )
+    import jax.numpy as jnp
+
+    tokens = jnp.arange(batch, dtype=jnp.int32)
+    positions = jnp.full((batch,), 3, jnp.int32)
+    tables = jnp.tile(jnp.arange(1, 9, dtype=jnp.int32), (batch, 1))
+    lens = jnp.full((batch,), 4, jnp.int32)
+    wb = jnp.arange(1, batch + 1, dtype=jnp.int32)
+    wo = jnp.full((batch,), 3, jnp.int32)
+
+    def timed(mb_env: str) -> float:
+        prior = os.environ.get("DTPU_PP_MICROBATCHES")
+        os.environ["DTPU_PP_MICROBATCHES"] = mb_env
+        try:
+            fwd = jax.jit(pp_serving.make_pp_decode_forward(mesh, mcfg, pp, 1))
+            h, k2, v2 = fwd(params, k, v, tokens, positions, tables, lens, wb, wo)
+            np.asarray(h)  # compile + settle
+            t0 = time.perf_counter()
+            kk, vv = k, v
+            for _ in range(steps):
+                h, kk, vv = fwd(
+                    params, kk, vv, tokens, positions, tables, lens, wb, wo
+                )
+            np.asarray(h)
+            return (time.perf_counter() - t0) / steps
+        finally:
+            if prior is None:
+                os.environ.pop("DTPU_PP_MICROBATCHES", None)
+            else:
+                os.environ["DTPU_PP_MICROBATCHES"] = prior
+
+    t_m1 = timed("1")
+    t_mpp = timed(str(pp))
+    model_ratio = (pp * batch) / ((2 * pp - 1) * batch / pp)
+    return {
+        "pp": pp, "batch": batch,
+        "step_ms_m1_cond_skip": round(t_m1 * 1e3, 3),
+        "step_ms_microbatched": round(t_mpp * 1e3, 3),
+        "m1_over_mpp": round(t_m1 / max(t_mpp, 1e-9), 3),
+        "flop_model_mpp_speedup_if_compute_bound": round(model_ratio, 3),
+    }

@@ -173,3 +173,39 @@ def test_pp_gates_unsupported_features():
 
     with pytest.raises(ValueError, match="pp serving"):
         TpuEngine(_cfg(pp=2, lora_max_adapters=2))
+
+
+async def test_pp_microbatched_decode_matches_default(monkeypatch):
+    """DTPU_PP_MICROBATCHES=pp (GPipe bubble amortization) and the
+    masked-write schedule (DTPU_PP_COND_SKIP=0) both produce the exact
+    greedy tokens of the default M=1 cond-skip schedule — the three decode
+    schedules are numerically interchangeable. Two concurrent streams keep
+    the full decode batch (B=2 -> M=2) live."""
+    import asyncio
+
+    params = _params()
+    prompts = [list(range(20, 44)), list(range(60, 76))]
+
+    async def run_with(env: dict):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        try:
+            eng = TpuEngine(
+                _cfg(tp=1, pp=2), params=params,
+                mesh=make_pp_mesh(pp=2, tp=1, devices=jax.devices()[:2]),
+            )
+            try:
+                return list(await asyncio.gather(*(
+                    _run(eng, _req(f"r{i}", p)) for i, p in enumerate(prompts)
+                )))
+            finally:
+                eng.stop()
+        finally:
+            for k in env:
+                monkeypatch.delenv(k, raising=False)
+
+    base = await run_with({})
+    mb = await run_with({"DTPU_PP_MICROBATCHES": "2"})
+    masked = await run_with({"DTPU_PP_COND_SKIP": "0"})
+    assert mb == base
+    assert masked == base

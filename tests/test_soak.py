@@ -12,6 +12,8 @@ import random
 
 import aiohttp
 
+from test_frontend_e2e import make_rt  # shared stack helpers
+
 from dynamo_tpu.llm.http.service import HttpService
 from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
 from dynamo_tpu.llm import (
@@ -20,21 +22,10 @@ from dynamo_tpu.llm import (
     ModelWatcher,
     register_llm,
 )
-from dynamo_tpu.runtime import (
-    DistributedRuntime,
-    InProcEventPlane,
-    MemKVStore,
-    RouterMode,
-    RuntimeConfig,
-)
+from dynamo_tpu.runtime import MemKVStore, RouterMode
 
 N_REQUESTS = 40
 DISCONNECT_EVERY = 3   # every 3rd request disconnects mid-stream
-
-
-def make_rt(store):
-    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
-    return DistributedRuntime(cfg, store=store, event_plane=InProcEventPlane())
 
 
 async def test_soak_streams_with_random_disconnects():
