@@ -96,6 +96,12 @@ def parse_args():
         "a mocker fleet and prints SLA attainment; see 'replay --help'.",
     )
     p.add_argument("--engine", default="tpu", choices=["tpu", "mocker"])
+    p.add_argument("--pp-bubble", action="store_true",
+                   help="instead of a capacity sweep, measure the PP decode "
+                        "schedules (M=1 cond-skip vs microbatched; "
+                        "fleet_bench.pp_bubble_bench) and exit")
+    p.add_argument("--pp", type=int, default=2,
+                   help="pipeline width for --pp-bubble")
     p.add_argument("--preset", default="tiny")
     p.add_argument("--model-path", default=None)
     p.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"])
@@ -115,6 +121,26 @@ def parse_args():
 
 async def main() -> None:
     args = parse_args()
+    if args.pp_bubble:
+        import json
+        import os
+
+        if args.platform == "cpu":
+            # the accelerator-free path needs pp virtual devices BEFORE the
+            # backend initializes (same trick as tests/conftest.py)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={args.pp}"
+                ).strip()
+        if args.platform:
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
+        from dynamo_tpu.profiler.fleet_bench import pp_bubble_bench
+
+        print(json.dumps(pp_bubble_bench(pp=args.pp), indent=2))
+        return
     isl_list = [int(x) for x in args.isl.split(",")]
     batch_list = [int(x) for x in args.batch.split(",")]
 

@@ -328,6 +328,9 @@ def pp_bubble_bench(
     from ..parallel import pp_serving
     from ..parallel.pipeline import make_pp_mesh
 
+    if batch % pp:
+        return {"error": f"batch {batch} must divide by pp {pp} for the "
+                         "microbatched schedule to engage"}
     devs = jax.devices()
     if len(devs) < pp:
         return {"error": f"need {pp} devices, have {len(devs)}"}
@@ -355,6 +358,7 @@ def pp_bubble_bench(
 
     def timed(mb_env: str) -> float:
         prior = os.environ.get("DTPU_PP_MICROBATCHES")
+        prior_skip = os.environ.pop("DTPU_PP_COND_SKIP", None)  # pin cond-skip
         os.environ["DTPU_PP_MICROBATCHES"] = mb_env
         try:
             fwd = jax.jit(pp_serving.make_pp_decode_forward(mesh, mcfg, pp, 1))
@@ -373,6 +377,8 @@ def pp_bubble_bench(
                 os.environ.pop("DTPU_PP_MICROBATCHES", None)
             else:
                 os.environ["DTPU_PP_MICROBATCHES"] = prior
+            if prior_skip is not None:
+                os.environ["DTPU_PP_COND_SKIP"] = prior_skip
 
     t_m1 = timed("1")
     t_mpp = timed(str(pp))
