@@ -38,3 +38,21 @@ def test_batch_in_mocker_out(tmp_path):
 def test_bad_input_errors():
     r = _run(["in=telepathy", "out=echo", "--platform", "cpu"])
     assert r.returncode != 0
+
+
+def test_text_in_mla_preset_out():
+    """One-shot generation through a real MLA (DeepSeek-style) engine
+    preset — the latent-KV serving path reachable from the CLI."""
+    r = _run(["in=text:hi", "out=tiny-mla", "--max-tokens", "3",
+              "--platform", "cpu"], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert r.stdout.strip()
+
+
+def test_text_in_gptoss_preset_out():
+    """One-shot generation through the gpt-oss preset (sinks + sliding
+    window attention) from the CLI."""
+    r = _run(["in=text:hi", "out=tiny-gptoss", "--max-tokens", "3",
+              "--platform", "cpu"], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert r.stdout.strip()
