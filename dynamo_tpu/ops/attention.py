@@ -217,3 +217,56 @@ def write_decode_kv(
         k_cache.at[block_ids, offsets].set(k_new),
         v_cache.at[block_ids, offsets].set(v_new),
     )
+
+
+def paged_extend_attention(
+    q: jax.Array,             # [B, S_new, h, d] candidate-token queries
+    k_cache: jax.Array,       # [num_blocks, bs, kvh, d]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    start_pos: jax.Array,     # [B] absolute position of each row's q[0]
+    total_lens: jax.Array,    # [B] context length incl. the S_new candidates
+    window: Optional[int] = None,
+    sinks: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Batched paged prefix-extend: every row attends its S_new new tokens
+    causally over its OWN pages (which must already contain the new tokens'
+    KV). The verify pass of speculative decoding
+    (docs/speculative_decoding.md) — one main-model forward over the k+1
+    candidate positions per sequence — is exactly this shape; it is also a
+    batched generalization of the engine's per-sequence chunk-extend path.
+
+    vmap of gather_kv + extend_attention: pure JAX, any head layout the
+    single-sequence ops accept (GQA, MQA/MLA-latent), window/sinks
+    supported. Windowed rows gather only the trailing blocks covering
+    [start - window + 1, start + S_new) — the queries all sit at the tail,
+    so like paged_decode_attention a 128-token window over a long context
+    reads ~window + S_new keys, not the whole table."""
+    S_new = q.shape[1]
+    bs = k_cache.shape[1]
+    if window is not None:
+        wb = min(
+            (window + S_new + bs - 1) // bs + 1, block_tables.shape[1]
+        )
+
+    def one(qb, table, start, tlen):
+        positions = start + jnp.arange(S_new)
+        if window is None:
+            k_ctx, v_ctx = gather_kv(k_cache, v_cache, table)
+            return extend_attention(
+                qb, k_ctx, v_ctx, positions, tlen, sinks=sinks
+            )
+        nblocks = jnp.maximum((tlen + bs - 1) // bs, 1)
+        first = jnp.maximum(nblocks - wb, 0)
+        sub = table[jnp.clip(first + jnp.arange(wb), 0, table.shape[0] - 1)]
+        k_ctx, v_ctx = gather_kv(k_cache, v_cache, sub)   # [wb*bs, kvh, d]
+        # extend_attention masks by ABSOLUTE key position; the gathered
+        # window starts at first*bs, so shift the query positions and the
+        # valid length into the gathered frame
+        off = first * bs
+        return extend_attention(
+            qb, k_ctx, v_ctx, positions - off, tlen - off,
+            window=window, sinks=sinks,
+        )
+
+    return jax.vmap(one)(q, block_tables, start_pos, total_lens)
