@@ -150,3 +150,39 @@ async def test_kvbm_write_through_is_async():
     finally:
         engine.stop()
         engine_plain.stop()
+
+
+async def test_offload_onboard_mla_latent_blocks():
+    """The KVBM tiers are family-agnostic bytes: MLA's 1-head latent blocks
+    offload to G2 and onboard back after device eviction with identical
+    greedy output (same flow as the llama test, latent cache layout)."""
+    from dynamo_tpu.models.mla import MlaConfig
+
+    mcfg = MlaConfig.tiny_mla()
+    bs = 4
+    block_nbytes = (
+        4 * mcfg.num_layers * 2 * bs * mcfg.num_kv_heads * mcfg.head_dim
+    )
+    kvbm = KvbmTiers(block_nbytes, host_capacity_bytes=64 * block_nbytes)
+    cfg = TpuEngineConfig(
+        model=mcfg, num_blocks=14, block_size=bs, max_batch_size=2,
+        max_context=64, prefill_buckets=(16, 32, 64),
+    )
+    engine = TpuEngine(cfg, kvbm=kvbm)
+    try:
+        prompt_a = list(range(100, 124))
+        t1, cached1 = await run(engine, preq("a", prompt_a))
+        assert cached1 == 0
+        await asyncio.sleep(0.05)
+        assert kvbm.stats()["offloaded"] >= 6
+        for i in range(4):
+            await run(
+                engine,
+                preq(f"churn{i}", list(range(200 + 30 * i, 224 + 30 * i))),
+            )
+        t2, cached2 = await run(engine, preq("a2", prompt_a))
+        assert t2 == t1
+        assert cached2 and cached2 > 0
+        assert kvbm.stats()["onboarded"] > 0
+    finally:
+        engine.stop()
