@@ -76,8 +76,8 @@ class TestTiers:
 
 
 # ------------------------------------------------------------------- engine
-def tiny_engine_with_kvbm(num_blocks=16, host_blocks=64):
-    mcfg = LlamaConfig(
+def tiny_engine_with_kvbm(num_blocks=16, host_blocks=64, mcfg=None):
+    mcfg = mcfg or LlamaConfig(
         vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
         num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
     )
@@ -108,11 +108,11 @@ async def run(engine, r):
     return toks, cached
 
 
-async def test_offload_then_onboard_after_device_eviction():
+async def _offload_onboard_roundtrip(mcfg=None):
     """Fill the tiny device cache until the first prompt's blocks are evicted
     from HBM, then re-send it: the engine must onboard from the host tier and
     produce identical output with cached_tokens > 0."""
-    engine, kvbm = tiny_engine_with_kvbm(num_blocks=14)
+    engine, kvbm = tiny_engine_with_kvbm(num_blocks=14, mcfg=mcfg)
     try:
         prompt_a = list(range(100, 124))  # 24 tokens = 6 blocks
         t1, cached1 = await run(engine, preq("a", prompt_a))
@@ -131,6 +131,10 @@ async def test_offload_then_onboard_after_device_eviction():
         assert kvbm.stats()["onboarded"] > 0
     finally:
         engine.stop()
+
+
+async def test_offload_then_onboard_after_device_eviction():
+    await _offload_onboard_roundtrip()
 
 
 async def test_kvbm_write_through_is_async():
@@ -158,31 +162,4 @@ async def test_offload_onboard_mla_latent_blocks():
     greedy output (same flow as the llama test, latent cache layout)."""
     from dynamo_tpu.models.mla import MlaConfig
 
-    mcfg = MlaConfig.tiny_mla()
-    bs = 4
-    block_nbytes = (
-        4 * mcfg.num_layers * 2 * bs * mcfg.num_kv_heads * mcfg.head_dim
-    )
-    kvbm = KvbmTiers(block_nbytes, host_capacity_bytes=64 * block_nbytes)
-    cfg = TpuEngineConfig(
-        model=mcfg, num_blocks=14, block_size=bs, max_batch_size=2,
-        max_context=64, prefill_buckets=(16, 32, 64),
-    )
-    engine = TpuEngine(cfg, kvbm=kvbm)
-    try:
-        prompt_a = list(range(100, 124))
-        t1, cached1 = await run(engine, preq("a", prompt_a))
-        assert cached1 == 0
-        await asyncio.sleep(0.05)
-        assert kvbm.stats()["offloaded"] >= 6
-        for i in range(4):
-            await run(
-                engine,
-                preq(f"churn{i}", list(range(200 + 30 * i, 224 + 30 * i))),
-            )
-        t2, cached2 = await run(engine, preq("a2", prompt_a))
-        assert t2 == t1
-        assert cached2 and cached2 > 0
-        assert kvbm.stats()["onboarded"] > 0
-    finally:
-        engine.stop()
+    await _offload_onboard_roundtrip(mcfg=MlaConfig.tiny_mla())

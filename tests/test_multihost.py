@@ -33,12 +33,13 @@ def _free_port() -> int:
     return port
 
 
-def _engine_cmd(store_path: str, mh_spec: str) -> list:
+def _engine_cmd(store_path: str, mh_spec: str, preset: str = "tiny",
+                model: str = "mh-model") -> list:
     return [
         sys.executable, "-m", "dynamo_tpu.engine",
         "--platform", "cpu",
-        "--preset", "tiny",
-        "--model", "mh-model",
+        "--preset", preset,
+        "--model", model,
         "--tp", "2",
         "--max-batch-size", "2",
         "--num-blocks", "64",
@@ -59,11 +60,12 @@ def _env() -> dict:
     return env
 
 
-def _spawn(store_path: str, mh_spec: str, log_path: str) -> subprocess.Popen:
+def _spawn(store_path: str, mh_spec: str, log_path: str,
+           preset: str = "tiny", model: str = "mh-model") -> subprocess.Popen:
     # log to a FILE: an undrained 64KB pipe would wedge a chatty child
     # mid-collective and hang the whole mesh
     return subprocess.Popen(
-        _engine_cmd(store_path, mh_spec),
+        _engine_cmd(store_path, mh_spec, preset=preset, model=model),
         stdout=open(log_path, "wb"), stderr=subprocess.STDOUT,
         env=_env(), cwd=REPO,
     )
@@ -96,14 +98,17 @@ def test_two_process_mesh_serves_through_frontend(tmp_path):
     asyncio.run(asyncio.wait_for(_run_e2e(tmp_path), timeout=560))
 
 
-async def _run_e2e(tmp_path):
+async def _run_e2e(tmp_path, preset="tiny", model="mh-model",
+                   prompt="hi there", max_tokens=8):
     store_path = str(tmp_path / "store")
     coord, control = _free_port(), _free_port()
     mh = f"127.0.0.1:{coord},2,{{pid}},127.0.0.1:{control}"
     flog, llog = str(tmp_path / "follower.log"), str(tmp_path / "leader.log")
 
-    follower = _spawn(store_path, mh.format(pid=1), flog)
-    leader = _spawn(store_path, mh.format(pid=0), llog)
+    follower = _spawn(store_path, mh.format(pid=1), flog,
+                      preset=preset, model=model)
+    leader = _spawn(store_path, mh.format(pid=0), llog,
+                    preset=preset, model=model)
     frontend_rt = watcher = service = None
     try:
         await _wait_marker(leader, llog, b"TPU_ENGINE_READY", 300)
@@ -132,20 +137,20 @@ async def _run_e2e(tmp_path):
         service = HttpService(manager, host="127.0.0.1", port=0)
         await service.start()
         for _ in range(200):
-            entry = manager.get("mh-model")
+            entry = manager.get(model)
             if entry and entry.client.instances:
                 break
             await asyncio.sleep(0.05)
         else:
-            raise AssertionError("mh-model never appeared in discovery")
+            raise AssertionError(f"{model} never appeared in discovery")
 
         async with aiohttp.ClientSession() as s:
             r = await s.post(
                 f"http://127.0.0.1:{service.port}/v1/chat/completions",
                 json={
-                    "model": "mh-model",
-                    "messages": [{"role": "user", "content": "hi there"}],
-                    "max_tokens": 8,
+                    "model": model,
+                    "messages": [{"role": "user", "content": prompt}],
+                    "max_tokens": max_tokens,
                     "temperature": 0.0,
                 },
                 timeout=aiohttp.ClientTimeout(total=240),
@@ -174,3 +179,14 @@ async def _run_e2e(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
+
+
+def test_two_process_mesh_serves_mla(tmp_path):
+    """Multihost x MLA: the replicated latent-MQA cache spans a 2-process
+    jax.distributed mesh (tp=2 q-head sharding, kv replicated) and serves a
+    request through the leader/follower dispatch replay."""
+    asyncio.run(asyncio.wait_for(
+        _run_e2e(tmp_path, preset="tiny-mla", model="mh-mla",
+                 prompt="latent hi", max_tokens=6),
+        timeout=560,
+    ))
