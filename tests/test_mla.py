@@ -200,3 +200,28 @@ def test_kv_cache_spec_gqa_fallback():
     assert registry.kv_cache_spec(gqa, tp=2) == P(None, None, AXIS_TP, None)
     # 2 kv heads on 4 TP shards cannot lay out: replicate
     assert registry.kv_cache_spec(gqa, tp=4) == P(None, None, None, None)
+
+
+async def test_engine_mla_ring_chunked_prefill():
+    """MLA + context parallelism: a prompt longer than every prefill bucket
+    runs chunked through ring_extend attention on an sp=2 x tp=2 mesh with
+    the 1-head latent KV — same greedy output as the plain engine."""
+    cfg = _cfg()
+    prompt = list(range(100, 250))  # 150 tokens; buckets force 3 chunks
+    plain = mla_engine(cfg=cfg, max_context=512, prefill_buckets=(32, 64))
+    try:
+        want = await _run(plain, greedy_req("a", prompt, max_tokens=2))
+    finally:
+        plain.stop()
+    ring = TpuEngine(
+        TpuEngineConfig(
+            model=cfg, num_blocks=64, block_size=16, max_batch_size=2,
+            max_context=512, prefill_buckets=(32, 64), sp=2, tp=2,
+        ),
+        mesh=make_mesh(tp=2, sp=2, devices=jax.devices()[:4]),
+    )
+    try:
+        got = await _run(ring, greedy_req("b", prompt, max_tokens=2))
+    finally:
+        ring.stop()
+    assert got == want
