@@ -57,10 +57,23 @@ def preq(rid, tokens, max_tokens=8):
 
 
 async def test_disagg_matches_aggregated():
+    await _disagg_matches_aggregated()
+
+
+async def test_disagg_matches_aggregated_gptoss():
+    """Disaggregated prefill/decode with gpt-oss: the transferred KV pages
+    carry windowed+sink attention context; the decode engine's import must
+    reproduce the aggregated greedy output exactly."""
+    from dynamo_tpu.models.gptoss import GptOssConfig
+
+    await _disagg_matches_aggregated(mcfg=GptOssConfig.tiny_gptoss())
+
+
+async def _disagg_matches_aggregated(mcfg=None):
     prompt = list(range(100, 130))  # 30 tokens
 
     # ---- golden: aggregated single engine ----
-    agg = TpuEngine(tiny_cfg())
+    agg = TpuEngine(tiny_cfg(model=mcfg))
     golden = []
     try:
         async for out in agg.generate(preq("golden", prompt), Context()):
@@ -76,9 +89,9 @@ async def test_disagg_matches_aggregated():
     decode_rt = await make_rt(store, plane).start()
     frontend_rt = await make_rt(store, plane).start()
 
-    prefill_engine = TpuEngine(tiny_cfg())
+    prefill_engine = TpuEngine(tiny_cfg(model=mcfg))
     await prefill_engine.serve_transfer()
-    decode_engine = TpuEngine(tiny_cfg())
+    decode_engine = TpuEngine(tiny_cfg(model=mcfg))
 
     prefill_card = ModelDeploymentCard(
         name="disagg-model", component="backend_prefill",
