@@ -138,6 +138,18 @@ def parse_args():
                         "'ban=5,7,9;temperature=0.7;norepeat=2.0' — requests "
                         "opt in via the logits_processors field "
                         "(dynamo_tpu/logits_processing)")
+    p.add_argument("--spec-draft", default=None, choices=sorted(PRESETS),
+                   help="enable speculative decoding with this draft "
+                        "architecture (random-init unless --spec-draft-path; "
+                        "docs/speculative_decoding.md). Greedy requests are "
+                        "served spec; sampled ones fall back per dispatch")
+    p.add_argument("--spec-draft-path", default=None,
+                   help="local HF checkpoint (or hub ref) for the draft "
+                        "model; implies --spec-draft semantics with the "
+                        "checkpoint's architecture")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens per speculative round (clamped to "
+                        "decode-steps)")
     p.add_argument(
         "--disagg",
         choices=["none", "prefill", "decode"],
@@ -158,7 +170,26 @@ def parse_args():
     return p.parse_args()
 
 
-def make_engine_config(args, mcfg, vcfg=None, logits_procs=()):
+def _load_draft(args):
+    """(draft_cfg, draft_params) for --spec-draft/--spec-draft-path, or
+    (None, None). Checkpoint drafts ride the same warm-cache path as the
+    main model."""
+    if getattr(args, "spec_draft_path", None):
+        from dynamo_tpu.llm.hub import resolve_model_path
+
+        path = resolve_model_path(args.spec_draft_path)
+        dcfg = config_from_hf(path)
+        if args.no_warm_cache:
+            return dcfg, load_params(path, dcfg)
+        from dynamo_tpu.engine.warm import load_params_warm
+
+        return dcfg, load_params_warm(path, dcfg)
+    if getattr(args, "spec_draft", None):
+        return PRESETS[args.spec_draft](), None
+    return None, None
+
+
+def make_engine_config(args, mcfg, vcfg=None, logits_procs=(), spec_draft=None):
     """TpuEngineConfig from CLI args — ONE code path for every process of a
     multihost group (leader/follower config drift would desync the replayed
     XLA programs)."""
@@ -203,6 +234,8 @@ def make_engine_config(args, mcfg, vcfg=None, logits_procs=()):
         lora_rank=args.lora_rank,
         logits_processors=logits_procs,
         vision=vcfg,
+        spec_draft=spec_draft,
+        spec_k=getattr(args, "spec_k", 4),
     )
 
 
@@ -403,8 +436,10 @@ async def main() -> None:
             disk_path=args.kvbm_disk_path,
             remote=remote,
         )
+    draft_cfg, draft_params = _load_draft(args)
     engine_cfg = make_engine_config(
-        args, mcfg, vcfg=vcfg, logits_procs=_build_logits_procs(args)
+        args, mcfg, vcfg=vcfg, logits_procs=_build_logits_procs(args),
+        spec_draft=draft_cfg,
     )
 
     import jax as _jax
@@ -470,6 +505,7 @@ async def main() -> None:
             TpuEngine(
                 engine_cfg,
                 params=params,
+                draft_params=draft_params,
                 mesh=(_multihost_mesh(args, mh, r) if mh is not None
                       else rank_mesh(r)),
                 kv_publisher=kv_pub,

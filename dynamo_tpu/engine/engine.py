@@ -124,6 +124,19 @@ class TpuEngineConfig:
     # patch embeddings over them (inputs_embeds path in models/llama.py).
     vision: Optional[Any] = None
     image_token_id: int = IMAGE_TOKEN_ID
+    # speculative decoding (docs/speculative_decoding.md; the reference
+    # exposes it through its vLLM adapter — draft-model speculation,
+    # docs/features/speculative_decoding). A draft model config enables it:
+    # the draft keeps a SHADOW paged KV cache addressed by the same block
+    # tables as the main cache, drafts spec_k greedy tokens per round, and
+    # ONE main-model forward over the k candidate positions verifies them
+    # (ops/attention.paged_extend_attention). Greedy-equality is the
+    # invariant: output is token-identical to the plain engine; the draft
+    # only ever changes the acceptance rate. Eligible rows: temperature 0,
+    # no penalties, no logprobs, no logits processors (mixed batches fall
+    # back to the normal horizon program for the whole dispatch).
+    spec_draft: Optional[llama.LlamaConfig] = None
+    spec_k: int = 4
 
     def __post_init__(self):
         bad = [b for b in self.prefill_buckets if b % self.block_size]
@@ -234,6 +247,11 @@ class _Seq:
     mm_embeds: Optional[np.ndarray] = None
     mm_mask: Optional[np.ndarray] = None
     no_cache: bool = False
+    # speculative decoding: prompt positions whose DRAFT KV is written.
+    # Independent of prefill_pos — the draft re-prefills from token ids even
+    # over regions whose MAIN KV arrived by prefix-cache hit or disagg/kvbm
+    # import, so draft coverage of the whole prompt is an invariant.
+    draft_prefill_pos: int = 0
     done: bool = False
 
 
@@ -252,6 +270,11 @@ class _Chain:
     # fetch future (np.asarray on the fetch pool): started at dispatch so
     # pipelined horizons' device->host RTTs overlap instead of serializing
     fetch: Any = None
+    # None => normal horizon ([N, B, 2+2K]); k => speculative horizon
+    # ([rounds, B, 1+2k]: advance count + k candidate tokens + k logprobs).
+    # The device carry (tokens/seq_lens/steps) means the same thing either
+    # way, so spec and normal horizons chain on each other freely.
+    spec_k: Optional[int] = None
 
 
 class TpuEngine:
@@ -261,6 +284,7 @@ class TpuEngine:
         self,
         config: TpuEngineConfig,
         params: Optional[llama.Params] = None,
+        draft_params: Optional[llama.Params] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
         kv_publisher: Optional[KvEventPublisher] = None,
         metrics_publisher: Optional[WorkerMetricsPublisher] = None,
@@ -321,6 +345,31 @@ class TpuEngine:
                 config.decode_steps = steps
             if config.decode_pipeline is None:
                 config.decode_pipeline = pipeline
+        if config.spec_draft is not None:
+            if config.pp > 1 or config.sp > 1:
+                raise ValueError(
+                    "speculative decoding covers the non-pp, non-sp engine"
+                )
+            if multihost is not None:
+                raise ValueError(
+                    "speculative decoding is not in the multihost replay"
+                    " table yet"
+                )
+            if config.vision is not None or config.lora_max_adapters > 0:
+                raise ValueError(
+                    "speculative decoding covers the text path (no vision/"
+                    "LoRA yet)"
+                )
+            if config.spec_draft.vocab_size != config.model.vocab_size:
+                raise ValueError(
+                    "draft and main model must share a vocabulary"
+                    f" ({config.spec_draft.vocab_size} != "
+                    f"{config.model.vocab_size})"
+                )
+            # a spec horizon advances at most rounds*k <= decode_steps
+            # tokens, so _prepare_horizon's block booking (decode_steps per
+            # horizon) covers it; k beyond the horizon budget can't be used
+            config.spec_k = max(1, min(config.spec_k, config.decode_steps))
         if registry.is_gptoss(self.mcfg):
             if config.sp > 1:
                 raise ValueError(
@@ -370,6 +419,35 @@ class TpuEngine:
             else:
                 self.params = self._shard_params(params)
                 self.k_caches, self.v_caches = self._init_caches()
+
+        # --- speculative decoding: draft model + shadow paged cache ---
+        # The draft cache mirrors the main cache's block geometry and is
+        # addressed by the SAME block tables: content-addressed sharing is
+        # safe (same block id => same token content => same draft KV, so
+        # concurrent writes are idempotent), and block lifecycle needs no
+        # second allocator.
+        self.draft_params = None
+        self.draft_k_caches = self.draft_v_caches = None
+        self._spec_rounds = 0
+        if config.spec_draft is not None:
+            dcfg = config.spec_draft
+            self._draft_forward = registry.forward_fn(dcfg, self.mesh)
+            self._draft_logits = registry.lm_logits_fn(dcfg)
+            self._spec_rounds = max(1, config.decode_steps // config.spec_k)
+            with self.mesh:
+                if draft_params is None:
+                    draft_params = registry.init_params(
+                        jax.random.PRNGKey(config.seed + 2), dcfg
+                    )
+                self.draft_params = self._shard_params(draft_params, dcfg)
+                self.draft_k_caches, self.draft_v_caches = self._init_caches(dcfg)
+        # acceptance telemetry (reference reports spec acceptance through
+        # its engine metrics). rounds = per-ROW rounds applied (a horizon
+        # with A active rows and R rounds adds A*R); emitted = tokens
+        # advanced on device, BEFORE host-side stop truncation (the
+        # discarded tail past a finish is included). acceptance rate =
+        # emitted / (rounds * k), in (0, 1]; a perfect draft measures 1.0.
+        self.spec_stats = {"rounds": 0, "emitted": 0, "k": config.spec_k}
 
         # --- slot state (decode batch is fixed-width) ---
         B = config.max_batch_size
@@ -504,8 +582,8 @@ class TpuEngine:
         return self._transfer_client
 
     # ------------------------------------------------------------------ setup
-    def _shard_params(self, params: llama.Params) -> llama.Params:
-        specs = registry.param_specs(self.mcfg)
+    def _shard_params(self, params: llama.Params, mcfg=None) -> llama.Params:
+        specs = registry.param_specs(mcfg if mcfg is not None else self.mcfg)
         mh = self._mh is not None
 
         def put(x, spec):
@@ -530,22 +608,23 @@ class TpuEngine:
             out["layers"].append(slp)
         return out
 
-    def _init_caches(self) -> Tuple[List[jax.Array], List[jax.Array]]:
+    def _init_caches(self, mcfg=None) -> Tuple[List[jax.Array], List[jax.Array]]:
+        mcfg = mcfg if mcfg is not None else self.mcfg
         shape = (
             self.cfg.num_blocks,
             self.cfg.block_size,
-            self.mcfg.num_kv_heads,
-            self.mcfg.head_dim,
+            mcfg.num_kv_heads,
+            mcfg.head_dim,
         )
         sharding = NamedSharding(
             self.mesh,
-            registry.kv_cache_spec(self.mcfg, meshlib.tp_size(self.mesh)),
+            registry.kv_cache_spec(mcfg, meshlib.tp_size(self.mesh)),
         )
         # host-side zeros: device_put shards them per-process (jnp.zeros would
         # commit to the local default device — invalid for a multi-host mesh)
-        zeros = partial(np.zeros, shape, self.mcfg.dtype)
-        k = [jax.device_put(zeros(), sharding) for _ in range(self.mcfg.num_layers)]
-        v = [jax.device_put(zeros(), sharding) for _ in range(self.mcfg.num_layers)]
+        zeros = partial(np.zeros, shape, mcfg.dtype)
+        k = [jax.device_put(zeros(), sharding) for _ in range(mcfg.num_layers)]
+        v = [jax.device_put(zeros(), sharding) for _ in range(mcfg.num_layers)]
         return k, v
 
     def _build_programs_pp(self) -> None:
@@ -1075,6 +1154,203 @@ class TpuEngine:
                 lambda: jnp.zeros((mcfg.hidden_size,), jnp.float32),
             )
             return k_caches, v_caches, _fetchable(vec)
+
+        # ---- speculative decoding programs (docs/speculative_decoding.md) --
+        # Correctness rests on two paged-cache properties: (a) overwrite-is-
+        # rollback — rejected candidate positions hold stale KV that is never
+        # attended (every mask keys off seq_lens) and is overwritten in place
+        # when the sequence reaches that position for real; (b) the bonus
+        # token is capped so the advance per round is <= spec_k, which keeps
+        # the draft cache's coverage invariant (the draft writes positions
+        # start..start+k-1 each round, so the next round's reads never
+        # outrun its writes) and keeps a horizon's total advance within
+        # _prepare_horizon's decode_steps block booking.
+        if self.cfg.spec_draft is not None:
+            dcfg = self.cfg.spec_draft
+            draft_fwd = self._draft_forward
+            draft_logits = self._draft_logits
+            sk = self.cfg.spec_k
+            R = self._spec_rounds
+            B = self.cfg.max_batch_size
+            draft_use_pallas = (
+                use_pallas
+                and dcfg.head_dim % 128 == 0
+                and dcfg.num_kv_heads % meshlib.tp_size(self.mesh) == 0
+                and not registry.is_gptoss(dcfg)
+            )
+            if draft_use_pallas:
+                from ..ops import pallas_attention as dpa
+
+                d_mesh = self.mesh
+                d_interp = jax.default_backend() != "tpu"
+
+                def draft_paged_attention(q, kc, vc, tables, lens, **extra):
+                    return dpa.sharded_paged_decode_attention(
+                        d_mesh, meshlib.AXIS_TP, q, kc, vc, tables, lens,
+                        interpret=d_interp, **extra,
+                    )
+            else:
+                draft_paged_attention = att.paged_decode_attention
+
+            def draft_prefill_chunk(draft_params, dkc, dvc, tokens, positions,
+                                    block_table, new_block_ids, total_len):
+                """Write one bucketed chunk of the prompt's DRAFT KV (no
+                sampling): same chunk/padding conventions as the main
+                prefill so the host reuses _chunk_arrays verbatim."""
+
+                def attend(q, k_new, v_new, layer_idx, **extra):
+                    kc, vc = att.write_prefill_kv(
+                        dkc[layer_idx], dvc[layer_idx], k_new, v_new,
+                        new_block_ids,
+                    )
+                    dkc[layer_idx], dvc[layer_idx] = kc, vc
+                    k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
+                    return att.extend_attention(
+                        q, k_ctx, v_ctx, positions, total_len, **extra
+                    )
+
+                draft_fwd(draft_params, dcfg, tokens, positions, attend)
+                return dkc, dvc
+
+            def spec_multi(params, draft_params, k_caches, v_caches, dkc, dvc,
+                           tokens, seq_lens, block_tables, active, steps0,
+                           lora_tables, lora_ids):
+                """R speculative rounds in one program. Each round: sk greedy
+                draft steps over the shadow cache, ONE main forward verifying
+                the sk+1 candidate positions (paged_extend_attention), then
+                vectorized accept — advance n_match+1 capped at sk tokens per
+                row. Packed result [R, B, 1+2sk]: advance count, the sk
+                verified tokens, their logprobs. Carry (tokens/seq_lens/
+                steps) matches decode_multi's, so spec horizons chain with
+                normal ones."""
+                bs = cfg.block_size
+
+                def one_round(carry, _):
+                    k_caches, v_caches, dkc, dvc, tokens, seq_lens = carry
+
+                    def draft_step(dc, j):
+                        dkc, dvc, dt = dc
+                        pos = jnp.maximum(seq_lens - 1, 0) + j
+                        wb = jnp.where(
+                            active,
+                            jnp.take_along_axis(
+                                block_tables, (pos // bs)[:, None], axis=1
+                            )[:, 0],
+                            0,
+                        )
+                        wo = jnp.where(active, pos % bs, 0)
+
+                        def attend(q, k_new, v_new, layer_idx, **extra):
+                            kc2, vc2 = att.write_decode_kv(
+                                dkc[layer_idx], dvc[layer_idx],
+                                k_new[:, 0], v_new[:, 0], wb, wo,
+                            )
+                            dkc[layer_idx], dvc[layer_idx] = kc2, vc2
+                            out = draft_paged_attention(
+                                q[:, 0], kc2, vc2, block_tables,
+                                seq_lens + j, **extra
+                            )
+                            return out[:, None]
+
+                        hidden = draft_fwd(
+                            draft_params, dcfg, dt[:, None], pos[:, None],
+                            attend,
+                        )
+                        logits = draft_logits(draft_params, dcfg, hidden[:, 0])
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        return (dkc, dvc, nxt), nxt
+
+                    (dkc, dvc, _), drafts = jax.lax.scan(
+                        draft_step, (dkc, dvc, tokens), jnp.arange(sk)
+                    )
+                    cand = jnp.concatenate(
+                        [tokens[:, None], drafts.T], axis=1
+                    )  # [B, sk+1]
+                    start = jnp.maximum(seq_lens - 1, 0)
+                    pos = start[:, None] + jnp.arange(sk + 1)[None, :]
+
+                    def attend(q, k_new, v_new, layer_idx, **extra):
+                        kc2, vc2 = k_caches[layer_idx], v_caches[layer_idx]
+                        for s in range(sk + 1):
+                            ps = start + s
+                            wb = jnp.where(
+                                active,
+                                jnp.take_along_axis(
+                                    block_tables, (ps // bs)[:, None], axis=1
+                                )[:, 0],
+                                0,
+                            )
+                            wo = jnp.where(active, ps % bs, 0)
+                            kc2, vc2 = att.write_decode_kv(
+                                kc2, vc2, k_new[:, s], v_new[:, s], wb, wo
+                            )
+                        k_caches[layer_idx], v_caches[layer_idx] = kc2, vc2
+                        return att.paged_extend_attention(
+                            q, kc2, vc2, block_tables, start,
+                            seq_lens + sk, **extra
+                        )
+
+                    hidden = call_fwd(
+                        params, cand, pos, attend, lora_tables, lora_ids
+                    )  # [B, sk+1, H]
+                    logits = logits_fn(
+                        params, mcfg, hidden.reshape(B * (sk + 1), -1)
+                    ).reshape(B, sk + 1, -1)
+                    m = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    lps = jnp.max(
+                        jax.nn.log_softmax(
+                            logits.astype(jnp.float32), axis=-1
+                        ),
+                        axis=-1,
+                    )  # logprob of the greedy token at each position
+                    match = m[:, :sk] == drafts.T
+                    n_acc = jnp.sum(
+                        jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+                    )
+                    adv = jnp.where(active, jnp.minimum(n_acc + 1, sk), 0)
+                    carry_tok = jnp.where(
+                        active,
+                        jnp.take_along_axis(
+                            m, jnp.maximum(adv - 1, 0)[:, None], axis=1
+                        )[:, 0],
+                        tokens,
+                    )
+                    packed_round = jnp.concatenate(
+                        [
+                            adv.astype(jnp.float32)[:, None],
+                            m[:, :sk].astype(jnp.float32),
+                            lps[:, :sk],
+                        ],
+                        axis=-1,
+                    )  # [B, 1+2sk]
+                    return (
+                        (k_caches, v_caches, dkc, dvc, carry_tok,
+                         seq_lens + adv),
+                        packed_round,
+                    )
+
+                (k_caches, v_caches, dkc, dvc, tokens, seq_lens), packed = (
+                    jax.lax.scan(
+                        one_round,
+                        (k_caches, v_caches, dkc, dvc, tokens, seq_lens),
+                        None,
+                        length=R,
+                    )
+                )
+                next_steps = steps0 + jnp.sum(
+                    packed[..., 0], axis=0
+                ).astype(jnp.int32)
+                return (
+                    k_caches, v_caches, dkc, dvc, _fetchable(packed),
+                    tokens, seq_lens, next_steps,
+                )
+
+            self._draft_prefill_fn = jax.jit(
+                draft_prefill_chunk, donate_argnums=(1, 2)
+            )
+            self._spec_multi_fn = jax.jit(
+                spec_multi, donate_argnums=(2, 3, 4, 5)
+            )
 
         self._embed_chunk_fn = jax.jit(embed_chunk, donate_argnums=(1, 2))
         self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2, 3))
@@ -1843,6 +2119,29 @@ class TpuEngine:
             *self._mm_chunk(st, start, chunk_len, S_pad),
         )
         st.prefill_pos = total_len
+        # speculative decoding: bring the DRAFT cache's prompt coverage up to
+        # the main cache's. Driven off prefill_pos rather than the chunk just
+        # dispatched so regions the main cache acquired WITHOUT compute
+        # (prefix-cache hit, disagg/kvbm import set prefill_pos past 0) are
+        # draft-prefilled too — shared cached blocks get idempotent rewrites
+        # (same tokens => same draft KV). Draft coverage of the whole prompt
+        # is what keeps acceptance up; correctness never depends on it.
+        if self.cfg.spec_draft is not None:
+            while st.draft_prefill_pos < st.prefill_pos:
+                dstart = st.draft_prefill_pos
+                dlen = min(st.prefill_pos - dstart, cap)
+                dtok, dpos, dnb = self._chunk_arrays(
+                    prompt, dstart, dlen, st.block_ids
+                )
+                self.draft_k_caches, self.draft_v_caches = (
+                    self._draft_prefill_fn(
+                        self.draft_params, self.draft_k_caches,
+                        self.draft_v_caches, _j(dtok), _j(dpos),
+                        _j(self._block_tables[st.slot]), _j(dnb),
+                        _j(np.int32(dstart + dlen)),
+                    )
+                )
+                st.draft_prefill_pos = dstart + dlen
         if not is_final:
             return None
         # NO sync readback here: converting tok/lp on this thread would pay
@@ -2113,6 +2412,26 @@ class TpuEngine:
             seq_lens = seq_lens_np
             steps = steps_np
 
+        if self.cfg.spec_draft is not None and self._spec_eligible(seqs):
+            (self.k_caches, self.v_caches, self.draft_k_caches,
+             self.draft_v_caches, packed, tokens, seq_lens, steps) = (
+                self._spec_multi_fn(
+                    self.params, self.draft_params, self.k_caches,
+                    self.v_caches, self.draft_k_caches, self.draft_v_caches,
+                    tokens, seq_lens,
+                    self._dev("tables", self._block_tables),
+                    self._dev("active", active),
+                    steps,
+                    self._lora_tables(),
+                    self._dev("lora_slots", self._lora_slots),
+                )
+            )
+            packed.copy_to_host_async()
+            return _Chain(
+                packed, tokens, seq_lens, steps, seqs,
+                spec_k=self.cfg.spec_k,
+            )
+
         (self.k_caches, self.v_caches, self.output_counts, packed, tokens,
          seq_lens, steps) = (
             self._decode_multi_fn(
@@ -2142,6 +2461,28 @@ class TpuEngine:
         packed.copy_to_host_async()
         return _Chain(packed, tokens, seq_lens, steps, seqs)
 
+    def _spec_eligible(self, seqs: List[Optional["_Seq"]]) -> bool:
+        """Every active row must be greedy with no sampling-state coupling:
+        temperature 0 (verify argmax == sample_tokens at temp 0), no
+        penalties / logits processors (spec skips the counts machinery), no
+        top-logprobs (the packed spec format carries token logprobs only).
+        Mixed batches fall back to the normal horizon for the whole dispatch
+        — eligibility is per-request-static, so the set only changes on
+        admission/finish, which already breaks chains via _can_chain."""
+        for i, st in enumerate(seqs):
+            if st is None:
+                continue
+            if (
+                self._temps[i] != 0.0
+                or self._lp_ns[i] != 0
+                or self._pres[i] != 0.0
+                or self._freqs[i] != 0.0
+                or self._reps[i] != 1.0
+                or bool(self._lp_masks[i].any())
+            ):
+                return False
+        return True
+
     def _can_chain(self, chain: _Chain) -> bool:
         """A new horizon may ride on ``chain``'s device carry only if every
         currently-active slot holds the same sequence it held at dispatch —
@@ -2161,6 +2502,8 @@ class TpuEngine:
         BackendOutput — per-token queue round-trips made horizon emission
         the dominant serving cost at batch>=16 (~1ms/token of asyncio churn
         against a ~0.9ms/token device program)."""
+        if chain.spec_k is not None:
+            return self._apply_packed_spec(chain, packed_np)
         K = TOP_LOGPROBS_K
         toks = packed_np[:, :, 0].astype(np.int32)
         lps = packed_np[:, :, 1]
@@ -2175,6 +2518,27 @@ class TpuEngine:
                 tlp_ids[:, i] if want_tlp else None,
                 tlp_vals[:, i] if want_tlp else None,
             )
+
+    def _apply_packed_spec(self, chain: _Chain, packed_np: np.ndarray) -> None:
+        """Apply one speculative horizon [R, B, 1+2k]: each round contributed
+        a variable 1..k tokens per row (the advance count in column 0); the
+        rest flows through the same _accept_tokens stop handling as a normal
+        horizon."""
+        sk = chain.spec_k
+        R = packed_np.shape[0]
+        for i, st in enumerate(chain.seqs):
+            if st is None or st.done:
+                continue
+            toks: List[int] = []
+            lps: List[float] = []
+            for r in range(R):
+                adv = int(packed_np[r, i, 0])
+                row = packed_np[r, i]
+                toks.extend(int(t) for t in row[1 : 1 + adv])
+                lps.extend(float(x) for x in row[1 + sk : 1 + sk + adv])
+            self.spec_stats["rounds"] += R
+            self.spec_stats["emitted"] += len(toks)
+            self._accept_tokens(st, toks, lps, None, None)
 
     def _run_decode(self, seqs: List[Optional["_Seq"]]) -> List[Tuple[_Seq, int, float]]:
         bs = self.cfg.block_size
@@ -2406,6 +2770,8 @@ class TpuEngine:
             "cached_blocks": self.allocator.cached_blocks,
             "free_blocks": self.allocator.free_blocks,
         }
+        if self.cfg.spec_draft is not None:
+            snap["spec"] = dict(self.spec_stats)
         if self.kvbm is not None:
             snap["kvbm"] = {
                 "g2_blocks": len(self.kvbm.host),
