@@ -150,6 +150,13 @@ def parse_args():
     p.add_argument("--spec-k", type=int, default=4,
                    help="draft tokens per speculative round (clamped to "
                         "decode-steps)")
+    p.add_argument("--guided-max-states", type=int, default=1024,
+                   help="guided decoding automaton cap (dynamo_tpu/guided): "
+                        "grammars compile to per-slot device tables "
+                        "[states x classes]; 0 disables guided decoding "
+                        "entirely (no mask ops in the decode programs)")
+    p.add_argument("--guided-max-classes", type=int, default=320,
+                   help="guided decoding token-class cap (see above)")
     p.add_argument(
         "--disagg",
         choices=["none", "prefill", "decode"],
@@ -236,6 +243,15 @@ def make_engine_config(args, mcfg, vcfg=None, logits_procs=(), spec_draft=None):
         vision=vcfg,
         spec_draft=spec_draft,
         spec_k=getattr(args, "spec_k", 4),
+        # multihost replays can't carry the guided tables yet, and the pp
+        # sampling epilogues don't carry the mask ops — force off for both
+        # rather than fail construction on default flags
+        guided_max_states=(
+            0 if (getattr(args, "multihost", None)
+                  or getattr(args, "pp", 1) > 1)
+            else getattr(args, "guided_max_states", 0)
+        ),
+        guided_max_classes=getattr(args, "guided_max_classes", 320),
     )
 
 
@@ -441,6 +457,20 @@ async def main() -> None:
         args, mcfg, vcfg=vcfg, logits_procs=_build_logits_procs(args),
         spec_draft=draft_cfg,
     )
+    guided_vocab = None
+    if engine_cfg.guided_max_states > 0:
+        from dynamo_tpu.guided import vocab_bytes_from_tokenizer
+        from dynamo_tpu.llm.tokenizer import load_tokenizer
+
+        try:
+            guided_vocab = vocab_bytes_from_tokenizer(
+                load_tokenizer(tokenizer_ref)
+            )
+        except ValueError as e:
+            # e.g. a tokenizer without an EOS id: guided decoding cannot
+            # terminate grammars, so disable it rather than refuse to serve
+            print(f"guided decoding disabled: {e}", flush=True)
+            engine_cfg.guided_max_states = 0
 
     import jax as _jax
 
@@ -506,6 +536,7 @@ async def main() -> None:
                 engine_cfg,
                 params=params,
                 draft_params=draft_params,
+                guided_vocab=guided_vocab,
                 mesh=(_multihost_mesh(args, mh, r) if mh is not None
                       else rank_mesh(r)),
                 kv_publisher=kv_pub,

@@ -38,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from ..llm.protocols.common import (
+    FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_STOP,
     BackendOutput,
@@ -137,6 +138,16 @@ class TpuEngineConfig:
     # back to the normal horizon program for the whole dispatch).
     spec_draft: Optional[llama.LlamaConfig] = None
     spec_k: int = 4
+    # guided (grammar-constrained) decoding (dynamo_tpu/guided; reference
+    # nvext guided_json/regex/choice + response_format). Grammars compile to
+    # token-class tables applied INSIDE the decode programs; the FSM state
+    # rides the horizon scan carry, so guided rows keep full pipelining.
+    # 0 disables (no guided ops in the hot path). The caps bound the
+    # per-slot device tables [B, states, classes]; grammars that compile
+    # beyond them are rejected per request. Requires the engine to be
+    # constructed with guided_vocab=(vocab byte forms, eos_id).
+    guided_max_states: int = 0
+    guided_max_classes: int = 320
 
     def __post_init__(self):
         bad = [b for b in self.prefill_buckets if b % self.block_size]
@@ -252,6 +263,16 @@ class _Seq:
     # over regions whose MAIN KV arrived by prefix-cache hit or disagg/kvbm
     # import, so draft coverage of the whole prompt is an invariant.
     draft_prefill_pos: int = 0
+    # guided decoding: compiled token tables + current FSM state (host view;
+    # the device copy rides the horizon carry and resyncs from this on every
+    # chain break)
+    guided_tables: Optional[Any] = None
+    guided_state: int = 0
+    # speculative decoding: this request can ride spec rounds (greedy, no
+    # penalties/logprobs/processors/guidance — the same per-request-static
+    # predicate _spec_eligible applies batch-wide). Ineligible requests skip
+    # draft prefill: their draft KV would never be read.
+    spec_ok: bool = True
     done: bool = False
 
 
@@ -275,6 +296,9 @@ class _Chain:
     # The device carry (tokens/seq_lens/steps) means the same thing either
     # way, so spec and normal horizons chain on each other freely.
     spec_k: Optional[int] = None
+    # guided decoding: device-resident FSM states after this horizon (chained
+    # dispatches carry it forward without a host round-trip)
+    g_state: Optional[jax.Array] = None
 
 
 class TpuEngine:
@@ -285,6 +309,7 @@ class TpuEngine:
         config: TpuEngineConfig,
         params: Optional[llama.Params] = None,
         draft_params: Optional[llama.Params] = None,
+        guided_vocab: Optional[Tuple[List[Optional[bytes]], int]] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
         kv_publisher: Optional[KvEventPublisher] = None,
         metrics_publisher: Optional[WorkerMetricsPublisher] = None,
@@ -370,6 +395,22 @@ class TpuEngine:
             # tokens, so _prepare_horizon's block booking (decode_steps per
             # horizon) covers it; k beyond the horizon budget can't be used
             config.spec_k = max(1, min(config.spec_k, config.decode_steps))
+        self.guided_enabled = config.guided_max_states > 0
+        if self.guided_enabled:
+            if config.pp > 1:
+                raise ValueError(
+                    "guided decoding covers the non-pp engine (the pp "
+                    "sampling epilogues do not carry the mask ops)"
+                )
+            if multihost is not None:
+                raise ValueError(
+                    "guided decoding is not in the multihost replay table yet"
+                )
+            if guided_vocab is None:
+                raise ValueError(
+                    "guided decoding needs guided_vocab=(vocab byte forms, "
+                    "eos_id) — see guided.vocab_bytes_from_tokenizer"
+                )
         if registry.is_gptoss(self.mcfg):
             if config.sp > 1:
                 raise ValueError(
@@ -480,6 +521,27 @@ class TpuEngine:
         self.output_counts = jax.device_put(np.zeros((B, V), np.int32), repl)
         self.prompt_masks = jax.device_put(np.zeros((B, V), np.int8), repl)
         self._slot_dirty = np.zeros(B, bool)   # slot's penalty tables need reset
+
+        # --- guided decoding slot state ---
+        # Per-slot compressed automaton tables (guided/tokens.py): class map
+        # [B, V] + transitions [B, S, C], uploaded as one versioned unit (the
+        # tables only change on admission/release, never per step).
+        if self.guided_enabled:
+            S_cap, C_cap = config.guided_max_states, config.guided_max_classes
+            self._g_vocab, self._g_eos = guided_vocab
+            self._g_active = np.zeros(B, bool)
+            self._g_state = np.zeros(B, np.int32)
+            self._g_class = np.zeros((B, V), np.int32)
+            self._g_trans = np.full((B, S_cap, C_cap), -1, np.int32)
+            # upload bookkeeping: the [B] active mask changes on every
+            # guided admission AND release (cheap re-upload, own version);
+            # the big [B, V] / [B, S_cap, C_cap] tables change only when a
+            # guided request is ADMITTED, and then only one slot's rows —
+            # tracked per slot so _guided_dev scatters rows into the device
+            # copies instead of re-uploading the whole unit
+            self._g_active_version = 0
+            self._g_dirty_slots: set = set()
+            self._g_cache: Dict[Any, Any] = {}  # grammar key -> TokenTables
 
         self._waiting: List[_Seq] = []
         self._prefill_rr = 0  # round-robin cursor over prefilling sequences
@@ -909,6 +971,29 @@ class TpuEngine:
                 axis=-1,
             )
 
+        # guided decoding ops (cfg.guided_max_states > 0): one [B, C] row
+        # gather + one [B, V] class lookup per step. Callers pass g_* only
+        # when guidance is built in — `is None` is a TRACE-time branch, so
+        # the disabled engine's programs are bit-identical to before.
+        GNEG = jnp.float32(-1e30)
+
+        def gmask(logits, g_active, g_state, g_class, g_trans):
+            """Mask logits to the tokens legal from each row's FSM state."""
+            row = jnp.take_along_axis(
+                g_trans, g_state[:, None, None], axis=1
+            )[:, 0]                                             # [B, C]
+            ok = jnp.take_along_axis(row, g_class, axis=1) >= 0  # [B, V]
+            return jnp.where(g_active[:, None] & ~ok, GNEG, logits)
+
+        def gstep(g_state, toks, g_active, g_class, g_trans):
+            """Advance each row's FSM by its sampled token."""
+            cls = jnp.take_along_axis(g_class, toks[:, None], axis=1)[:, 0]
+            row = jnp.take_along_axis(
+                g_trans, g_state[:, None, None], axis=1
+            )[:, 0]
+            nxt = jnp.take_along_axis(row, cls[:, None], axis=1)[:, 0]
+            return jnp.where(g_active, jnp.maximum(nxt, 0), g_state)
+
         if cfg.sp > 1:
             from ..parallel import ring as ringlib
 
@@ -916,7 +1001,8 @@ class TpuEngine:
                     block_table, new_block_ids, total_len, chunk_start, seeds,
                     steps, temp, top_k, top_p, min_p, pres, freq, rep,
                     prompt_masks, slot, lp_need, is_final, lora_tables,
-                    lora_id, proc_masks, mm_embeds, mm_mask):
+                    lora_id, proc_masks, mm_embeds, mm_mask,
+                    g_active=None, g_class=None, g_trans=None):
             # tokens/positions: [S_pad] — ONE chunk of the prompt (the whole
             # prompt when it fits a bucket); block_table: [max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx, **extra):
@@ -975,6 +1061,12 @@ class TpuEngine:
                     pen, proc_masks[slot][None],
                     counts[slot][None], steps, total_len[None],
                 )
+                if g_active is not None:
+                    # first generated token: FSM is at the start state (0)
+                    pen = gmask(
+                        pen, g_active[None], jnp.zeros((1,), jnp.int32),
+                        g_class[None], g_trans[None],
+                    )
                 tok = sample_tokens(pen, seeds, steps, temp, top_k, top_p, min_p)
                 # the first generated token must enter the output counts, or
                 # the first decode step's penalties miss it
@@ -1006,7 +1098,8 @@ class TpuEngine:
         def decode(params, k_caches, v_caches, counts, tokens, positions,
                    block_tables, seq_lens, write_blocks, write_offsets, seeds,
                    steps, temps, top_ks, top_ps, min_ps, pres, freqs, reps,
-                   prompt_masks, lp_need, lora_tables, lora_ids, proc_masks):
+                   prompt_masks, lp_need, lora_tables, lora_ids, proc_masks,
+                   g_active=None, g_state=None, g_class=None, g_trans=None):
             # tokens: [B]; block_tables: [B, max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx, **extra):
                 kc, vc = att.write_decode_kv(
@@ -1026,6 +1119,8 @@ class TpuEngine:
             logits = logits_fn(params, mcfg, hidden[:, 0])  # [B, V]
             pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
             pen = run_procs(pen, proc_masks, counts, steps, seq_lens)
+            if g_active is not None:
+                pen = gmask(pen, g_active, g_state, g_class, g_trans)
             toks = sample_tokens(pen, seeds, steps, temps, top_ks, top_ps, min_ps)
             counts = update_counts(
                 counts, toks, seq_lens > 0, counts_need(pres, freqs, reps, proc_masks)
@@ -1040,7 +1135,9 @@ class TpuEngine:
         def decode_multi(params, k_caches, v_caches, counts, tokens, seq_lens,
                          block_tables, active, seeds, steps0, temps, top_ks,
                          top_ps, min_ps, pres, freqs, reps, prompt_masks,
-                         lp_need, lora_tables, lora_ids, proc_masks):
+                         lp_need, lora_tables, lora_ids, proc_masks,
+                         g_active=None, g_state=None, g_class=None,
+                         g_trans=None):
             """cfg.decode_steps decode iterations in one program: each step
             writes the fed token's KV, attends, samples, and feeds the sample
             back — tokens only reach the host once per horizon. seq_lens==0
@@ -1054,7 +1151,7 @@ class TpuEngine:
             need_pen = counts_need(pres, freqs, reps, proc_masks)
 
             def one_step(carry, s):
-                k_caches, v_caches, counts, tokens, seq_lens = carry
+                k_caches, v_caches, counts, tokens, seq_lens, g_st = carry
                 positions = jnp.maximum(seq_lens - 1, 0)
                 write_blocks = jnp.where(
                     active,
@@ -1083,30 +1180,36 @@ class TpuEngine:
                 logits = logits_fn(params, mcfg, hidden[:, 0])
                 pen = apply_penalties(logits, counts, prompt_masks, pres, freqs, reps)
                 pen = run_procs(pen, proc_masks, counts, steps0 + s, seq_lens)
+                if g_active is not None:
+                    pen = gmask(pen, g_active, g_st, g_class, g_trans)
                 toks = sample_tokens(
                     pen, seeds, steps0 + s, temps, top_ks, top_ps, min_ps
                 )
+                if g_active is not None:
+                    g_st = gstep(g_st, toks, g_active, g_class, g_trans)
                 counts = update_counts(counts, toks, active, need_pen)
                 lps = logprobs_of(logits, toks)
                 tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
                 seq_lens = seq_lens + active.astype(jnp.int32)
                 return (
-                    (k_caches, v_caches, counts, toks, seq_lens),
+                    (k_caches, v_caches, counts, toks, seq_lens, g_st),
                     pack_step(toks, lps, tlp_vals, tlp_ids),
                 )
 
-            (k_caches, v_caches, counts, tokens, seq_lens), packed = (
+            g0 = g_state if g_state is not None else jnp.zeros_like(tokens)
+            (k_caches, v_caches, counts, tokens, seq_lens, g_out), packed = (
                 jax.lax.scan(
                     one_step,
-                    (k_caches, v_caches, counts, tokens, seq_lens),
+                    (k_caches, v_caches, counts, tokens, seq_lens, g0),
                     jnp.arange(cfg.decode_steps),
                 )
             )
             next_steps = steps0 + jnp.where(active, cfg.decode_steps, 0)
-            return (
+            out = (
                 k_caches, v_caches, counts, _fetchable(packed),
                 tokens, seq_lens, next_steps,
             )
+            return out + (g_out,) if g_active is not None else out
 
         def reset_slot(prompt_masks, counts, slot, row):
             return prompt_masks.at[slot].set(row), counts.at[slot].set(0)
@@ -1535,6 +1638,30 @@ class TpuEngine:
                 raise ValueError("engine built without LoRA support")
             if self.lora.slot_of(lora_name) == 0:
                 raise ValueError(f"unknown LoRA adapter {lora_name!r}")
+        guided_tables = None
+        if req.sampling.guided is not None:
+            if not self.guided_enabled:
+                # soft specs (derived, e.g. from a forced tool_choice —
+                # llm/preprocessor.py) degrade to unconstrained sampling;
+                # explicit guided_* options fail loudly
+                if not req.sampling.guided.get("soft"):
+                    raise ValueError(
+                        "engine built without guided decoding "
+                        "(guided_max_states=0)"
+                    )
+            else:
+                try:
+                    guided_tables = await self._compile_guided(
+                        req.sampling.guided
+                    )
+                except ValueError:
+                    if not req.sampling.guided.get("soft"):
+                        raise
+                    # reference behavior: a failed tool-choice derivation
+                    # logs and serves unconstrained (common_ext.rs:190)
+                    log.warning(
+                        "soft guided grammar rejected; serving unconstrained"
+                    )
         if req.annotations.get("op") == "embed":
             loop = asyncio.get_event_loop()
             block_ids: Optional[List[int]] = None
@@ -1577,7 +1704,19 @@ class TpuEngine:
             out_queue=asyncio.Queue(),
             seq=TokenBlockSequence(all_tokens, self.cfg.block_size),
             last_token=all_tokens[-1] if all_tokens else 0,
+            guided_tables=guided_tables,
         )
+        if self.cfg.spec_draft is not None:
+            s = req.sampling
+            st.spec_ok = (
+                s.temperature == 0.0
+                and s.logprobs == 0
+                and s.presence_penalty == 0.0
+                and s.frequency_penalty == 0.0
+                and s.repetition_penalty == 1.0
+                and not wanted_procs
+                and guided_tables is None
+            )
         if req.annotations.get("images"):
             loop_mm = asyncio.get_event_loop()
             st.mm_embeds, st.mm_mask = await loop_mm.run_in_executor(
@@ -1994,6 +2133,30 @@ class TpuEngine:
             for k, (pname, _fn) in enumerate(self.cfg.logits_processors):
                 if pname in wanted:
                     self._lp_masks[slot, k] = True
+            if self.guided_enabled:
+                if st.guided_tables is not None:
+                    tt = st.guided_tables
+                    S_g, C_g = tt.trans.shape
+                    self._g_active[slot] = True
+                    self._g_state[slot] = 0
+                    st.guided_state = 0
+                    V_model = self._g_class.shape[1]
+                    n = min(len(tt.class_of), V_model)
+                    self._g_class[slot, :n] = tt.class_of[:n]
+                    # model vocab beyond the tokenizer vocab has no byte
+                    # form: map those ids to column C_g, which stays all -1
+                    # (always-reject; the compile gate enforces C_g < cap)
+                    self._g_class[slot, n:] = C_g
+                    self._g_trans[slot].fill(-1)
+                    self._g_trans[slot, :S_g, :C_g] = tt.trans
+                    self._g_dirty_slots.add(slot)
+                    self._g_active_version += 1
+                elif self._g_active[slot]:
+                    # previous occupant was guided: drop its mask before the
+                    # new request's first dispatch. Non-guided -> non-guided
+                    # turnover touches nothing (no upload on plain traffic).
+                    self._g_active[slot] = False
+                    self._g_active_version += 1
             # penalty tables: reset the slot's rows when this request uses
             # penalties (needs a fresh prompt mask) or a prior occupant left
             # them dirty. One tiny async dispatch; skipped entirely on the
@@ -2096,6 +2259,13 @@ class TpuEngine:
         s = st.req.sampling
         total_len = start + chunk_len
         _j = self._j
+        g_args = ()
+        if self.guided_enabled:
+            # per-slot rows of the versioned device tables (lazy device
+            # slices; the FSM starts at state 0 for the first token, so no
+            # state arg — the program pins it)
+            ga, gc, gt = self._guided_dev()
+            g_args = (ga[st.slot], gc[st.slot], gt[st.slot])
         (self.k_caches, self.v_caches, self.output_counts, tok, lp, tlp_vals,
          tlp_ids) = self._prefill_fn(
             self.params, self.k_caches, self.v_caches, self.output_counts,
@@ -2117,6 +2287,7 @@ class TpuEngine:
             self._lora_tables(), _j(np.int32(self._lora_slots[st.slot])),
             self._dev("proc_masks", self._lp_masks),
             *self._mm_chunk(st, start, chunk_len, S_pad),
+            *g_args,
         )
         st.prefill_pos = total_len
         # speculative decoding: bring the DRAFT cache's prompt coverage up to
@@ -2126,7 +2297,9 @@ class TpuEngine:
         # draft-prefilled too — shared cached blocks get idempotent rewrites
         # (same tokens => same draft KV). Draft coverage of the whole prompt
         # is what keeps acceptance up; correctness never depends on it.
-        if self.cfg.spec_draft is not None:
+        # Spec-ineligible requests skip it: their draft KV is never read
+        # (eligible batchmates cover shared prefix blocks themselves).
+        if self.cfg.spec_draft is not None and st.spec_ok:
             while st.draft_prefill_pos < st.prefill_pos:
                 dstart = st.draft_prefill_pos
                 dlen = min(st.prefill_pos - dstart, cap)
@@ -2371,6 +2544,85 @@ class TpuEngine:
             self._dev_cache[name + "/host"] = host_arr.copy()
         return self._dev_cache[name]
 
+    async def _compile_guided(self, spec: Dict[str, Any]):
+        """Grammar spec -> TokenTables, compiled off the event loop and
+        cached by content (concurrent requests overwhelmingly share one
+        schema). Raises ValueError for malformed grammars or ones whose
+        automaton exceeds the engine's device-table caps."""
+        import json as _json
+
+        from ..guided import (
+            RegexError, SchemaError, build_token_tables, compile_regex,
+            guided_regex_pattern,
+        )
+
+        kind = spec.get("kind")
+        key = _json.dumps(spec, sort_keys=True, default=str)
+        hit = self._g_cache.get(key)
+        if hit is not None:
+            return hit
+
+        def compile_():
+            pattern = guided_regex_pattern(kind, spec.get("value"))
+            # construction bound: subset construction can overshoot before
+            # minimization shrinks it (generic JSON: ~5x), so allow headroom
+            # over the engine cap — but check the MINIMIZED count before the
+            # O(S x V) token product materializes anything vocab-sized
+            dfa = compile_regex(
+                pattern,
+                max_states=min(32768, 32 * self.cfg.guided_max_states),
+            )
+            if dfa.num_states > self.cfg.guided_max_states:
+                raise ValueError(
+                    f"guided grammar needs {dfa.num_states} states > engine "
+                    f"cap {self.cfg.guided_max_states}"
+                )
+            return build_token_tables(dfa, self._g_vocab, self._g_eos)
+
+        loop = asyncio.get_event_loop()
+        try:
+            tt = await loop.run_in_executor(self._fetch_executor, compile_)
+        except (RegexError, SchemaError, ValueError) as e:
+            raise ValueError(f"guided grammar rejected: {e}") from e
+        if tt.num_classes >= self.cfg.guided_max_classes:
+            # strict: column C_g of the padded table is the always-reject
+            # class for model-vocab ids beyond the tokenizer vocab
+            raise ValueError(
+                f"guided grammar needs {tt.num_classes} token classes >= "
+                f"engine cap {self.cfg.guided_max_classes}"
+            )
+        if len(self._g_cache) > 32:
+            self._g_cache.pop(next(iter(self._g_cache)))
+        self._g_cache[key] = tt
+        return tt
+
+    def _guided_dev(self):
+        """Device copies of the guided tables. The [B] active mask
+        re-uploads on its own version (admissions AND releases move it);
+        the big tables upload once, then changed SLOTS scatter in as row
+        updates (.at[slot].set — only the row crosses host->device, the
+        rest is an on-device copy). [B, S, C] is far too big for _dev's
+        per-dispatch content compare or per-admission full re-upload."""
+        if self._dev_cache.get("g/aver") != self._g_active_version:
+            self._dev_cache["g/active"] = jnp.asarray(self._g_active)
+            self._dev_cache["g/aver"] = self._g_active_version
+        if self._dev_cache.get("g/class") is None:
+            self._dev_cache["g/class"] = jnp.asarray(self._g_class)
+            self._dev_cache["g/trans"] = jnp.asarray(self._g_trans)
+            self._g_dirty_slots.clear()
+        elif self._g_dirty_slots:
+            gc, gt = self._dev_cache["g/class"], self._dev_cache["g/trans"]
+            for slot in sorted(self._g_dirty_slots):
+                gc = gc.at[slot].set(jnp.asarray(self._g_class[slot]))
+                gt = gt.at[slot].set(jnp.asarray(self._g_trans[slot]))
+            self._dev_cache["g/class"], self._dev_cache["g/trans"] = gc, gt
+            self._g_dirty_slots.clear()
+        return (
+            self._dev_cache["g/active"],
+            self._dev_cache["g/class"],
+            self._dev_cache["g/trans"],
+        )
+
     def _decode_snapshot(self) -> List[Optional["_Seq"]]:
         """Loop-thread snapshot of decode-eligible slots. MUST be taken on
         the loop thread in the same tick as _can_chain/_prepare_horizon: an
@@ -2432,34 +2684,50 @@ class TpuEngine:
                 spec_k=self.cfg.spec_k,
             )
 
-        (self.k_caches, self.v_caches, self.output_counts, packed, tokens,
-         seq_lens, steps) = (
-            self._decode_multi_fn(
-                self.params, self.k_caches, self.v_caches, self.output_counts,
-                tokens, seq_lens,
-                self._dev("tables", self._block_tables),
-                self._dev("active", active),
-                self._dev("seeds", self._seeds),
-                steps,
-                self._dev("temps", self._temps),
-                self._dev("top_ks", self._top_ks),
-                self._dev("top_ps", self._top_ps),
-                self._dev("min_ps", self._min_ps),
-                self._dev("pres", self._pres),
-                self._dev("freqs", self._freqs),
-                self._dev("reps", self._reps),
-                self.prompt_masks,
-                jnp.bool_(bool(np.any(self._lp_ns[active] > 0))),
-                self._lora_tables(),
-                self._dev("lora_slots", self._lora_slots),
-                self._dev("proc_masks", self._lp_masks),
+        g_args = ()
+        if self.guided_enabled:
+            g_active, g_class, g_trans = self._guided_dev()
+            g_state = (
+                chain.g_state
+                if chain is not None and chain.g_state is not None
+                else self._g_state.copy()
             )
+            g_args = (g_active, g_state, g_class, g_trans)
+        res = self._decode_multi_fn(
+            self.params, self.k_caches, self.v_caches, self.output_counts,
+            tokens, seq_lens,
+            self._dev("tables", self._block_tables),
+            self._dev("active", active),
+            self._dev("seeds", self._seeds),
+            steps,
+            self._dev("temps", self._temps),
+            self._dev("top_ks", self._top_ks),
+            self._dev("top_ps", self._top_ps),
+            self._dev("min_ps", self._min_ps),
+            self._dev("pres", self._pres),
+            self._dev("freqs", self._freqs),
+            self._dev("reps", self._reps),
+            self.prompt_masks,
+            jnp.bool_(bool(np.any(self._lp_ns[active] > 0))),
+            self._lora_tables(),
+            self._dev("lora_slots", self._lora_slots),
+            self._dev("proc_masks", self._lp_masks),
+            *g_args,
         )
+        g_state_out = None
+        if self.guided_enabled:
+            (self.k_caches, self.v_caches, self.output_counts, packed,
+             tokens, seq_lens, steps, g_state_out) = res
+        else:
+            (self.k_caches, self.v_caches, self.output_counts, packed,
+             tokens, seq_lens, steps) = res
         # start the D2H readback immediately: by the time this horizon's turn
         # to be applied comes (decode_pipeline-1 horizons later) the bytes
         # are already on host and np.asarray is a no-wait copy
         packed.copy_to_host_async()
-        return _Chain(packed, tokens, seq_lens, steps, seqs)
+        return _Chain(
+            packed, tokens, seq_lens, steps, seqs, g_state=g_state_out
+        )
 
     def _spec_eligible(self, seqs: List[Optional["_Seq"]]) -> bool:
         """Every active row must be greedy with no sampling-state coupling:
@@ -2479,6 +2747,9 @@ class TpuEngine:
                 or self._freqs[i] != 0.0
                 or self._reps[i] != 1.0
                 or bool(self._lp_masks[i].any())
+                # guided rows need the per-step FSM mask, which the spec
+                # draft/verify programs do not carry
+                or (self.guided_enabled and bool(self._g_active[i]))
             ):
                 return False
         return True
@@ -2565,6 +2836,12 @@ class TpuEngine:
 
         lp_need = bool(np.any((self._lp_ns > 0) & (seq_lens > 0)))
         _j = self._j
+        g_args = ()
+        if self.guided_enabled:
+            g_active, g_class, g_trans = self._guided_dev()
+            # single-step dispatches are never chained: the host FSM state
+            # (walked in _accept_tokens) is authoritative
+            g_args = (g_active, _j(self._g_state.copy()), g_class, g_trans)
         (self.k_caches, self.v_caches, self.output_counts, toks, lps,
          tlp_vals, tlp_ids) = self._decode_fn(
             self.params, self.k_caches, self.v_caches, self.output_counts,
@@ -2579,6 +2856,7 @@ class TpuEngine:
             self.prompt_masks, _j(np.bool_(lp_need)),
             self._lora_tables(), _j(self._lora_slots),
             self._dev("proc_masks", self._lp_masks),
+            *g_args,
         )
         toks_np = np.asarray(toks)
         lps_np = np.asarray(lps)
@@ -2680,6 +2958,22 @@ class TpuEngine:
             if finish is not None:
                 break
 
+        if st.guided_tables is not None and emit_ids:
+            # host replay of the device FSM over the tokens that survived
+            # stop handling: authoritative for the next unchained dispatch.
+            # Device-sampled tokens are always legal under the mask, so a
+            # step failure means table corruption — fail the request, not
+            # the engine loop.
+            try:
+                st.guided_state = st.guided_tables.walk(
+                    st.guided_state, emit_ids
+                )
+                if 0 <= st.slot < len(self._g_state):
+                    self._g_state[st.slot] = st.guided_state
+            except ValueError:
+                log.exception("guided FSM desync")
+                finish = FINISH_ERROR
+
         ann: Dict[str, Any] = {}
         if first_ann:
             ann = {
@@ -2706,6 +3000,12 @@ class TpuEngine:
                 self.allocator.release(st.block_ids)
                 self._slots[i] = None
                 self._seq_lens[i] = 0
+                if self.guided_enabled and self._g_active[i]:
+                    # freed slot must not mask the next occupant's first
+                    # dispatch (admission overwrites the tables, but a
+                    # non-guided successor would otherwise inherit them)
+                    self._g_active[i] = False
+                    self._g_active_version += 1
                 if not st.done:
                     st.out_queue.put_nowait(
                         BackendOutput(finish_reason="cancelled", cumulative_tokens=st.produced)

@@ -1,0 +1,37 @@
+"""Guided (grammar-constrained) decoding.
+
+Reference parity: nvext guided_json / guided_regex / guided_choice /
+response_format json_schema|json_object, forwarded per request and
+enforced during sampling (lib/llm/src/protocols/openai/common_ext.rs:
+175-219, lib/llm/src/protocols/common.rs:336 GuidedDecodingOptions).
+
+TPU-native shape: grammar -> byte DFA (regex.py) -> token-class-compressed
+tables (tokens.py) that live on device and are applied INSIDE the jitted
+decode programs — the FSM state rides the decode-horizon scan carry, so
+constrained rows keep full horizon pipelining (no per-token host sync).
+"""
+
+from .regex import Dfa, RegexError, compile_regex, escape_literal
+from .schema import (
+    SchemaError,
+    choice_regex,
+    guided_regex_pattern,
+    json_value_regex,
+    schema_to_regex,
+)
+from .tokens import TokenTables, build_token_tables, vocab_bytes_from_tokenizer
+
+__all__ = [
+    "Dfa",
+    "RegexError",
+    "SchemaError",
+    "TokenTables",
+    "build_token_tables",
+    "choice_regex",
+    "compile_regex",
+    "escape_literal",
+    "guided_regex_pattern",
+    "json_value_regex",
+    "schema_to_regex",
+    "vocab_bytes_from_tokenizer",
+]

@@ -73,6 +73,27 @@ def _safe_parser(factory, name):
         return None
 
 
+def _stream_fail_status(e: Exception) -> tuple:
+    """(status, err_type) for a request that died before/while streaming.
+    Engine-side guided rejections — grammar caps/vocab checks only the
+    worker can make, or an engine built without guidance — are client
+    errors, not 500s."""
+    msg = str(e)
+    if "guided grammar" in msg or "without guided decoding" in msg:
+        return 400, "invalid_request_error"
+    return 500, "internal_error"
+
+
+def _preprocess_err_type(e: Exception) -> str:
+    """OpenAI-style error type for a preprocess-stage ValueError: length
+    errors keep the code clients switch on; everything else (bad guided
+    grammar, unsupported modality, ...) is a generic invalid request."""
+    msg = str(e)
+    if "context" in msg or "prompt length" in msg:
+        return "context_length_exceeded"
+    return "invalid_request_error"
+
+
 def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": err_type, "code": status}}, status=status
@@ -563,8 +584,9 @@ class HttpService:
             raise
         except Exception as e:
             log.exception("request %s failed", rid[:16])
-            status = "500"
-            return await self._fail(resp, 500, str(e), "internal_error")
+            code, etype = _stream_fail_status(e)
+            status = str(code)
+            return await self._fail(resp, code, str(e), etype)
         finally:
             self.inflight -= 1
             self._inflight_g.set(self.inflight)
@@ -621,7 +643,7 @@ class HttpService:
         try:
             preq = pipeline.preprocessor.preprocess_chat(req)
         except ValueError as e:
-            return _error(400, str(e), "context_length_exceeded")
+            return _error(400, str(e), _preprocess_err_type(e))
 
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
         card = pipeline.card
@@ -710,7 +732,7 @@ class HttpService:
                 preq.annotations["op"] = "embed"
                 preqs.append(preq)
         except ValueError as e:
-            return _error(400, str(e), "context_length_exceeded")
+            return _error(400, str(e), _preprocess_err_type(e))
         self.inflight += 1
         self._inflight_g.set(self.inflight)
         status = "200"
@@ -800,7 +822,7 @@ class HttpService:
         try:
             preq = pipeline.preprocessor.preprocess_chat(chat)
         except ValueError as e:
-            return _error(400, str(e), "context_length_exceeded")
+            return _error(400, str(e), _preprocess_err_type(e))
         rid = preq.request_id.replace("chatcmpl-", "resp_")
         ctx = Context(preq.request_id)
         created = int(time.time())
@@ -890,8 +912,9 @@ class HttpService:
             raise
         except Exception as e:
             log.exception("responses request %s failed", preq.request_id[:16])
-            status = "500"
-            return await self._fail(resp, 500, str(e), "internal_error")
+            code, etype = _stream_fail_status(e)
+            status = str(code)
+            return await self._fail(resp, code, str(e), etype)
         finally:
             self.inflight -= 1
             self._inflight_g.set(self.inflight)
@@ -926,7 +949,7 @@ class HttpService:
         try:
             preq = pipeline.preprocessor.preprocess_completion(req, prompt)
         except ValueError as e:
-            return _error(400, str(e), "context_length_exceeded")
+            return _error(400, str(e), _preprocess_err_type(e))
 
         include_usage = bool(req.stream_options and req.stream_options.include_usage)
         rid = preq.request_id

@@ -8,7 +8,7 @@ tokens once routing decides).
 
 from __future__ import annotations
 
-from typing import List, Union
+from typing import Any, Dict, List, Optional, Union
 
 from ..runtime.logging import get_logger
 from .model_card import ModelDeploymentCard
@@ -111,6 +111,66 @@ class OpenAIPreprocessor:
             return self.tokenizer.encode(prompt)
         return list(prompt)
 
+    @staticmethod
+    def _guided_spec(request) -> Optional[Dict[str, Any]]:
+        """Guided-decoding spec from the request, in the reference's
+        precedence (common_ext.rs:175-219): explicit guided_json, then
+        tool_choice-derived schema, then guided_regex/choice, then chat
+        response_format. Tool-derived specs are marked soft=True — engines
+        without guidance compiled in serve them unconstrained (the
+        tool-call jail still enforces the framing) instead of erroring.
+
+        Explicit specs are syntax-validated here so malformed grammars fail
+        as 400s at the frontend (reference openai/validate.rs); the engine
+        still enforces its own automaton caps at compile time."""
+
+        def _checked(spec):
+            if not spec.get("soft"):
+                from ..guided import guided_regex_pattern
+                from ..guided.regex import validate_pattern
+
+                try:
+                    validate_pattern(
+                        guided_regex_pattern(spec["kind"], spec["value"])
+                    )
+                except Exception as e:
+                    raise ValueError(f"invalid guided grammar: {e}") from e
+            return spec
+
+        if getattr(request, "guided_json", None) is not None:
+            return _checked({"kind": "json", "value": request.guided_json})
+        tc = getattr(request, "tool_choice", None)
+        if isinstance(tc, dict) and (tc.get("function") or {}).get("name"):
+            name = tc["function"]["name"]
+            for tool in getattr(request, "tools", None) or []:
+                fn = tool.get("function") or {}
+                if fn.get("name") == name:
+                    params = fn.get("parameters") or {"type": "object"}
+                    return {
+                        "kind": "json",
+                        "value": {
+                            "type": "object",
+                            "properties": {
+                                "name": {"const": name},
+                                "arguments": params,
+                            },
+                            "required": ["name", "arguments"],
+                        },
+                        "soft": True,
+                    }
+        if getattr(request, "guided_regex", None) is not None:
+            return _checked({"kind": "regex", "value": request.guided_regex})
+        if getattr(request, "guided_choice", None) is not None:
+            return _checked({"kind": "choice", "value": list(request.guided_choice)})
+        rf = getattr(request, "response_format", None) or {}
+        if rf.get("type") == "json_schema":
+            schema = (rf.get("json_schema") or {}).get("schema")
+            if schema is not None:
+                return _checked({"kind": "json", "value": schema})
+        if rf.get("type") == "json_object":
+            return {"kind": "json_object", "value": None}  # built-in grammar, always valid
+        return None
+
     # -- request conversion --------------------------------------------------
     def _common(
         self,
@@ -141,6 +201,7 @@ class OpenAIPreprocessor:
                 else int(request.top_logprobs or 0)
             ),
             want_logprobs=request.logprobs is not None and request.logprobs is not False,
+            guided=self._guided_spec(request),
         )
         max_new = request.effective_max_tokens()
         budget = self.card.context_length - len(token_ids)

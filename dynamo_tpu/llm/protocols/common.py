@@ -47,6 +47,11 @@ class SamplingOptions:
     # top_logprobs; completions logprobs:0) — the chosen token's logprob is
     # still returned, so a separate enable flag is needed
     want_logprobs: bool = False
+    # guided decoding (dynamo_tpu/guided; reference GuidedDecodingOptions,
+    # lib/llm/src/protocols/common.rs:336): {"kind": "regex"|"json"|
+    # "choice"|"json_object", "value": ...} — compiled to on-device token
+    # masks by the engine
+    guided: Optional[Dict[str, Any]] = None
 
     def to_obj(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

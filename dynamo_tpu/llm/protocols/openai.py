@@ -65,6 +65,23 @@ class SamplingFields(_Lenient):
     logprobs: Optional[Union[bool, int]] = None
     top_logprobs: Optional[int] = Field(default=None, ge=0, le=20)
     ignore_eos: Optional[bool] = None  # extension, matches reference nvext
+    # guided decoding extensions (reference nvext guided_* fields,
+    # lib/llm/src/protocols/openai/common_ext.rs:175-219): at most one may
+    # be set; chat requests can also use response_format json_schema /
+    # json_object (mapped in llm/preprocessor.py)
+    guided_regex: Optional[str] = None
+    guided_json: Optional[Union[Dict[str, Any], str]] = None
+    guided_choice: Optional[List[str]] = None
+
+    @model_validator(mode="after")
+    def _guided_exclusive(self) -> "SamplingFields":
+        set_ = [
+            n for n in ("guided_regex", "guided_json", "guided_choice")
+            if getattr(self, n) is not None
+        ]
+        if len(set_) > 1:
+            raise ValueError(f"only one guided option may be set, got {set_}")
+        return self
 
     @model_validator(mode="after")
     def _logprob_bounds(self) -> "SamplingFields":

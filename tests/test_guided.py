@@ -1,0 +1,409 @@
+"""Guided (grammar-constrained) decoding: compiler units + engine e2e.
+
+Reference parity: nvext guided_json/guided_regex/guided_choice +
+response_format, forwarded per request and enforced during sampling
+(lib/llm/src/protocols/openai/common_ext.rs:175-219,
+lib/llm/src/protocols/common.rs:336). Here the constraint runs INSIDE the
+jitted decode programs: grammar -> byte DFA -> token-class tables on
+device, FSM state in the horizon scan carry (dynamo_tpu/guided,
+engine/engine.py gmask/gstep).
+"""
+
+import asyncio
+import json
+import re as pyre
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.guided import (
+    RegexError,
+    build_token_tables,
+    compile_regex,
+    json_value_regex,
+    schema_to_regex,
+)
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.runtime import Context
+
+# ------------------------------------------------------------ compiler units
+
+
+def test_regex_dfa_matches_python_re():
+    cases = [
+        (r"abc", ["abc"], ["ab", "abcd", ""]),
+        (r"a+b?", ["a", "aab", "aaaa"], ["b", "ba", ""]),
+        (r"(foo|bar)+", ["foo", "barfoo"], ["fo", "foob"]),
+        (r"[a-z]{2,4}", ["ab", "abcd"], ["a", "abcde", "AB"]),
+        (r"-?(0|[1-9][0-9]*)(\.[0-9]+)?", ["0", "-12", "3.14"], ["00", "1.", "-"]),
+        (r"[^x]+", ["abc", "yz"], ["x", "axb", ""]),
+        (r"\d{3}-\d{4}", ["555-1234"], ["5551234", "55-1234"]),
+        (r'"([^"\\]|\\.)*"', ['"hi"', '""', '"a\\"b"'], ['"', "hi"]),
+        (r"(?:ab)*c", ["c", "ababc"], ["ac", "abc "[:-1] + "x"]),
+    ]
+    for pat, yes, no in cases:
+        d = compile_regex(pat)
+        for s in yes:
+            assert d.matches(s.encode()), (pat, s)
+            assert pyre.fullmatch(pat, s), ("python-re sanity", pat, s)
+        for s in no:
+            assert not d.matches(s.encode()), (pat, s)
+            assert not pyre.fullmatch(pat, s), ("python-re sanity", pat, s)
+
+
+def test_minimization_equivalence_randomized():
+    import dynamo_tpu.guided.regex as R
+
+    raw_minimize = R._minimize
+    R._minimize = lambda d: d
+    try:
+        raw = compile_regex(json_value_regex(2), max_states=100000)
+    finally:
+        R._minimize = raw_minimize
+    mini = raw_minimize(raw)
+    assert mini.num_states < raw.num_states
+    rng = np.random.default_rng(0)
+    alpha = list(b'{}[]",:0123456789.eE+- \ntruefalsnl')
+    for _ in range(1500):
+        s = bytes(rng.choice(alpha, rng.integers(0, 20)))
+        assert raw.matches(s) == mini.matches(s), s
+    # random accepted walks stay equivalent
+    for _ in range(300):
+        st, out = 0, []
+        for _ in range(24):
+            allowed = np.nonzero(raw.trans[st] >= 0)[0]
+            if len(allowed) == 0:
+                break
+            b = int(rng.choice(allowed))
+            out.append(b)
+            st = int(raw.trans[st, b])
+        bs = bytes(out)
+        assert raw.matches(bs) == mini.matches(bs), bs
+
+
+def test_schema_regex():
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "age": {"type": "integer"},
+            "tags": {"type": "array", "items": {"type": "string"}},
+            "mood": {"enum": ["happy", "sad"]},
+        },
+        "required": ["name", "age", "mood"],
+    }
+    d = compile_regex(schema_to_regex(schema))
+    assert d.matches(b'{"name":"bob","age":3,"mood":"happy","tags":["a","b"]}')
+    assert d.matches(b'{ "name" : "x" , "age" : -2 , "mood" : "sad" }')
+    assert not d.matches(b'{"name":"bob","age":"x","mood":"sad"}')
+    assert not d.matches(b'{"name":"bob"}')
+    assert not d.matches(b'{"name":"bob","age":3,"mood":"angry"}')
+
+
+def test_json_object_grammar():
+    d = compile_regex(json_value_regex())
+    for s in ['{"a":1}', "[1,2,3]", '"x"', "null", "true",
+              '{"a":{"b":[1,"c"]}}', "[[1,2],[3]]", "-3.5e2"]:
+        assert d.matches(s.encode()), s
+    for s in ['{"a":}', "[1,]", "{'a':1}", "01", "tru"]:
+        assert not d.matches(s.encode()), s
+
+
+def test_unproductive_pattern_rejected():
+    with pytest.raises(RegexError, match="matches nothing"):
+        compile_regex(r"a[^\x00-\xff]b")
+
+
+BYTE_VOCAB = [bytes([i]) for i in range(256)] + [None, None]  # 257 = eos
+EOS = 257
+
+
+def test_token_tables_force_eos_at_completion():
+    tt = build_token_tables(compile_regex(r"(cat|car)s?"), BYTE_VOCAB, EOS)
+    s = 0
+    for b in b"cat":
+        assert tt.allowed(s)[b]
+        s = tt.step(s, b)
+    assert tt.allowed(s)[EOS]           # accepting: eos legal
+    assert tt.allowed(s)[ord("s")]      # and 's' continues
+    s2 = tt.step(s, ord("s"))
+    assert tt.allowed(s2)[EOS] and tt.allowed(s2).sum() == 1  # only EOS left
+
+
+# --------------------------------------------------------------- engine e2e
+
+MODEL = LlamaConfig(
+    vocab_size=260, hidden_size=64, num_layers=2, num_heads=4,
+    num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+)
+
+
+def engine(**kw):
+    defaults = dict(
+        num_blocks=128, block_size=4, max_batch_size=4, max_context=512,
+        prefill_buckets=(16, 32, 64), decode_steps=6, decode_pipeline=2,
+        guided_max_states=256, guided_max_classes=128,
+    )
+    defaults.update(kw)
+    cfg = TpuEngineConfig(model=MODEL, **defaults)
+    return TpuEngine(
+        cfg, guided_vocab=(BYTE_VOCAB[:260], EOS),
+        mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+    )
+
+
+def preq(rid, guided=None, n=48, temperature=0.0, prompt=None):
+    return PreprocessedRequest(
+        request_id=rid, model="m",
+        token_ids=prompt or [104, 105, 32],  # "hi "
+        stop=StopConditions(max_tokens=n, stop_token_ids=[EOS]),
+        sampling=SamplingOptions(temperature=temperature, guided=guided),
+    )
+
+
+async def collect(eng, req):
+    toks, finish = [], None
+    async for out in eng.generate(req, Context()):
+        toks.extend(out.token_ids)
+        if out.finish_reason:
+            finish = out.finish_reason
+    return toks, finish
+
+
+def text(toks):
+    return bytes(t for t in toks if t < 256).decode("utf-8", "replace")
+
+
+async def test_guided_regex_exact_language():
+    """A finite pattern: the masked engine (random weights!) must produce a
+    full match and then the forced EOS ends the stream."""
+    e = engine()
+    try:
+        toks, finish = await collect(
+            e, preq("r1", guided={"kind": "regex", "value": r"(cat|car)s?"})
+        )
+        out = text(toks)
+        assert pyre.fullmatch(r"(cat|car)s?", out), out
+        assert finish == "stop"
+        # sampled (temperature 1) is constrained identically
+        toks2, _ = await collect(
+            e, preq("r2", guided={"kind": "regex", "value": r"(cat|car)s?"},
+                    temperature=1.0)
+        )
+        assert pyre.fullmatch(r"(cat|car)s?", text(toks2)), text(toks2)
+    finally:
+        e.stop()
+
+
+async def test_guided_choice():
+    e = engine()
+    try:
+        toks, finish = await collect(
+            e, preq("c1", guided={"kind": "choice",
+                                  "value": ["alpha", "beta", "gamma"]})
+        )
+        assert text(toks) in {"alpha", "beta", "gamma"}
+        assert finish == "stop"
+    finally:
+        e.stop()
+
+
+async def test_guided_json_schema():
+    schema = {
+        "type": "object",
+        "properties": {"ok": {"type": "boolean"},
+                       "mood": {"enum": ["happy", "sad"]}},
+        "required": ["ok", "mood"],
+    }
+    e = engine()
+    try:
+        toks, finish = await collect(
+            e, preq("j1", guided={"kind": "json", "value": schema}, n=96)
+        )
+        obj = json.loads(text(toks))
+        assert isinstance(obj["ok"], bool)
+        assert obj["mood"] in {"happy", "sad"}
+        assert finish == "stop"
+    finally:
+        e.stop()
+
+
+async def test_guided_and_plain_batchmates():
+    """A guided row and an unguided row decode in the same batch: the mask
+    applies per row."""
+    e = engine()
+    try:
+        (g_toks, _), (p_toks, _) = await asyncio.gather(
+            collect(e, preq("g", guided={"kind": "choice",
+                                         "value": ["yes", "no"]})),
+            collect(e, preq("p", n=12)),
+        )
+        assert text(g_toks) in {"yes", "no"}
+        assert len(p_toks) == 12  # ran unguided to its token limit
+    finally:
+        e.stop()
+
+
+async def test_unguided_rows_identical_to_disabled_engine():
+    """With no guided row active the mask is where(False, ...): a
+    guided-capable engine must emit byte-identical greedy output to one
+    built without guidance."""
+    e_plain = TpuEngine(
+        TpuEngineConfig(
+            model=MODEL, num_blocks=128, block_size=4, max_batch_size=4,
+            max_context=512, prefill_buckets=(16, 32, 64), decode_steps=6,
+            decode_pipeline=2,
+        ),
+        mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+    )
+    try:
+        ref, _ = await collect(e_plain, preq("ref", n=16))
+    finally:
+        e_plain.stop()
+    e = engine()
+    try:
+        got, _ = await collect(e, preq("cmp", n=16))
+    finally:
+        e.stop()
+    assert got == ref
+
+
+async def test_guided_multi_step_state_chains():
+    """Long guided generation crosses many horizons (decode_steps=6,
+    pipeline=2): the FSM state must survive device-side chaining."""
+    pat = r"[ab]{40}"
+    e = engine()
+    try:
+        toks, finish = await collect(
+            e, preq("long", guided={"kind": "regex", "value": pat}, n=64,
+                    temperature=1.0)
+        )
+        assert pyre.fullmatch(pat, text(toks)), text(toks)
+        assert finish == "stop"
+    finally:
+        e.stop()
+
+
+async def test_guided_rejections():
+    e = engine()
+    try:
+        with pytest.raises(ValueError, match="rejected"):
+            await collect(e, preq("bad", guided={"kind": "regex",
+                                                 "value": "(["}))
+        with pytest.raises(ValueError, match="states > engine cap"):
+            await collect(e, preq("big", guided={
+                "kind": "regex", "value": "a{500}"}))
+    finally:
+        e.stop()
+    e2 = TpuEngine(
+        TpuEngineConfig(
+            model=MODEL, num_blocks=64, block_size=4, max_batch_size=2,
+            max_context=256, prefill_buckets=(16, 32), decode_steps=4,
+            decode_pipeline=1,
+        ),
+        mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+    )
+    try:
+        with pytest.raises(ValueError, match="without guided"):
+            await collect(e2, preq("off", guided={"kind": "json_object"}))
+    finally:
+        e2.stop()
+
+
+def test_preprocessor_guided_mapping():
+    """Request-surface mapping (reference precedence, common_ext.rs:175):
+    guided_json > tool_choice-derived (soft) > guided_regex/choice >
+    response_format."""
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+    spec = OpenAIPreprocessor._guided_spec
+
+    def chat(**kw):
+        return ChatCompletionRequest(
+            model="m", messages=[{"role": "user", "content": "x"}], **kw
+        )
+
+    assert spec(chat()) is None
+    assert spec(chat(guided_regex="a+")) == {"kind": "regex", "value": "a+"}
+    assert spec(chat(guided_choice=["x", "y"])) == {
+        "kind": "choice", "value": ["x", "y"]}
+    assert spec(chat(guided_json={"type": "object"})) == {
+        "kind": "json", "value": {"type": "object"}}
+    assert spec(chat(response_format={"type": "json_object"})) == {
+        "kind": "json_object", "value": None}
+    sch = {"type": "object", "properties": {"a": {"type": "integer"}}}
+    assert spec(chat(response_format={
+        "type": "json_schema", "json_schema": {"name": "s", "schema": sch}}
+    )) == {"kind": "json", "value": sch}
+    # forced tool_choice derives a SOFT json grammar over the tool schema
+    tools = [{"type": "function", "function": {
+        "name": "get_weather",
+        "parameters": {"type": "object",
+                       "properties": {"city": {"type": "string"}},
+                       "required": ["city"]},
+    }}]
+    got = spec(chat(tools=tools, tool_choice={
+        "type": "function", "function": {"name": "get_weather"}}))
+    assert got["kind"] == "json" and got["soft"] is True
+    assert got["value"]["properties"]["name"] == {"const": "get_weather"}
+    # explicit guided_json outranks the tool derivation
+    got2 = spec(chat(tools=tools,
+                     tool_choice={"type": "function",
+                                  "function": {"name": "get_weather"}},
+                     guided_json={"type": "object"}))
+    assert "soft" not in got2
+    # exclusivity is validated at the protocol layer
+    with pytest.raises(Exception):
+        chat(guided_regex="a", guided_choice=["b"])
+
+
+async def test_soft_guided_degrades_on_disabled_engine():
+    """A tool_choice-derived (soft) spec on a guidance-disabled engine
+    serves unconstrained instead of erroring."""
+    e = TpuEngine(
+        TpuEngineConfig(
+            model=MODEL, num_blocks=64, block_size=4, max_batch_size=2,
+            max_context=256, prefill_buckets=(16, 32), decode_steps=4,
+            decode_pipeline=1,
+        ),
+        mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+    )
+    try:
+        toks, _ = await collect(e, preq(
+            "soft", n=8,
+            guided={"kind": "json", "value": {"type": "object"},
+                    "soft": True},
+        ))
+        assert len(toks) == 8  # unconstrained: ran to its token limit
+    finally:
+        e.stop()
+
+
+async def test_guided_with_spec_engine_falls_back():
+    """On an engine with BOTH speculative decoding and guidance, a guided
+    row makes the dispatch spec-ineligible; output still honors the
+    grammar."""
+    draft = LlamaConfig(
+        vocab_size=260, hidden_size=32, num_layers=1, num_heads=2,
+        num_kv_heads=1, head_dim=16, intermediate_size=64, dtype=jnp.float32,
+    )
+    e = engine(spec_draft=draft, spec_k=3)
+    try:
+        toks, finish = await collect(
+            e, preq("gs", guided={"kind": "choice", "value": ["left", "right"]})
+        )
+        assert text(toks) in {"left", "right"}
+        assert finish == "stop"
+        assert e.spec_stats["rounds"] == 0  # guided row blocked spec dispatch
+    finally:
+        e.stop()
