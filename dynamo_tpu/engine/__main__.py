@@ -177,6 +177,25 @@ def parse_args():
     return p.parse_args()
 
 
+def _load_guided_vocab(engine_cfg, tokenizer_ref):
+    """(vocab byte forms, eos_id) when the config enables guided decoding,
+    else None. Shared by leader AND followers of a multihost group (the
+    guided programs are traced on every process; a vocab drift would desync
+    the replayed dispatches). A tokenizer without an EOS id cannot terminate
+    grammars — guided is disabled rather than refusing to serve."""
+    if engine_cfg.guided_max_states <= 0:
+        return None
+    from dynamo_tpu.guided import vocab_bytes_from_tokenizer
+    from dynamo_tpu.llm.tokenizer import load_tokenizer
+
+    try:
+        return vocab_bytes_from_tokenizer(load_tokenizer(tokenizer_ref))
+    except ValueError as e:
+        print(f"guided decoding disabled: {e}", flush=True)
+        engine_cfg.guided_max_states = 0
+        return None
+
+
 def _load_draft(args):
     """(draft_cfg, draft_params) for --spec-draft/--spec-draft-path, or
     (None, None). Checkpoint drafts ride the same warm-cache path as the
@@ -243,12 +262,10 @@ def make_engine_config(args, mcfg, vcfg=None, logits_procs=(), spec_draft=None):
         vision=vcfg,
         spec_draft=spec_draft,
         spec_k=getattr(args, "spec_k", 4),
-        # multihost replays can't carry the guided tables yet, and the pp
-        # sampling epilogues don't carry the mask ops — force off for both
-        # rather than fail construction on default flags
+        # the pp sampling epilogues don't carry the mask ops — force guided
+        # off rather than fail construction on default flags
         guided_max_states=(
-            0 if (getattr(args, "multihost", None)
-                  or getattr(args, "pp", 1) > 1)
+            0 if getattr(args, "pp", 1) > 1
             else getattr(args, "guided_max_states", 0)
         ),
         guided_max_classes=getattr(args, "guided_max_classes", 320),
@@ -383,13 +400,18 @@ async def main() -> None:
         # follower: no endpoint, no discovery — join the mesh, build the
         # SAME engines (params + caches are collective device_puts), replay
         # the leader's dispatches until it stops
-        mcfg, params, _tok = _load_model(args)
+        mcfg, params, follower_tok = _load_model(args)
+        draft_cfg, draft_params = _load_draft(args)
         engine_cfg = make_engine_config(
-            args, mcfg, logits_procs=_build_logits_procs(args)
+            args, mcfg, logits_procs=_build_logits_procs(args),
+            spec_draft=draft_cfg,
         )
+        follower_gv = _load_guided_vocab(engine_cfg, follower_tok)
         engines = [
             TpuEngine(
-                engine_cfg, params=params, mesh=_multihost_mesh(args, mh, r),
+                engine_cfg, params=params, draft_params=draft_params,
+                guided_vocab=follower_gv,
+                mesh=_multihost_mesh(args, mh, r),
                 multihost=mh, mh_ns=_mh_ns(args, r),
             )
             for r in range(args.dp)
@@ -457,20 +479,7 @@ async def main() -> None:
         args, mcfg, vcfg=vcfg, logits_procs=_build_logits_procs(args),
         spec_draft=draft_cfg,
     )
-    guided_vocab = None
-    if engine_cfg.guided_max_states > 0:
-        from dynamo_tpu.guided import vocab_bytes_from_tokenizer
-        from dynamo_tpu.llm.tokenizer import load_tokenizer
-
-        try:
-            guided_vocab = vocab_bytes_from_tokenizer(
-                load_tokenizer(tokenizer_ref)
-            )
-        except ValueError as e:
-            # e.g. a tokenizer without an EOS id: guided decoding cannot
-            # terminate grammars, so disable it rather than refuse to serve
-            print(f"guided decoding disabled: {e}", flush=True)
-            engine_cfg.guided_max_states = 0
+    guided_vocab = _load_guided_vocab(engine_cfg, tokenizer_ref)
 
     import jax as _jax
 

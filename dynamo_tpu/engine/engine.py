@@ -375,11 +375,6 @@ class TpuEngine:
                 raise ValueError(
                     "speculative decoding covers the non-pp, non-sp engine"
                 )
-            if multihost is not None:
-                raise ValueError(
-                    "speculative decoding is not in the multihost replay"
-                    " table yet"
-                )
             if config.vision is not None or config.lora_max_adapters > 0:
                 raise ValueError(
                     "speculative decoding covers the text path (no vision/"
@@ -401,10 +396,6 @@ class TpuEngine:
                 raise ValueError(
                     "guided decoding covers the non-pp engine (the pp "
                     "sampling epilogues do not carry the mask ops)"
-                )
-            if multihost is not None:
-                raise ValueError(
-                    "guided decoding is not in the multihost replay table yet"
                 )
             if guided_vocab is None:
                 raise ValueError(
@@ -542,6 +533,21 @@ class TpuEngine:
             self._g_active_version = 0
             self._g_dirty_slots: set = set()
             self._g_cache: Dict[Any, Any] = {}  # grammar key -> TokenTables
+            if multihost is not None:
+                # multihost: the device tables are REPLAY STATE (followers
+                # hold their own handles, updated by the guided_active /
+                # guided_row ops) — seed identical collective arrays on
+                # every process, like output_counts above
+                grepl = NamedSharding(self.mesh, P())
+                self._g_dev_active = jax.device_put(
+                    self._g_active.copy(), grepl
+                )
+                self._g_dev_class = jax.device_put(
+                    self._g_class.copy(), grepl
+                )
+                self._g_dev_trans = jax.device_put(
+                    self._g_trans.copy(), grepl
+                )
 
         self._waiting: List[_Seq] = []
         self._prefill_rr = 0  # round-robin cursor over prefilling sequences
@@ -1002,7 +1008,7 @@ class TpuEngine:
                     steps, temp, top_k, top_p, min_p, pres, freq, rep,
                     prompt_masks, slot, lp_need, is_final, lora_tables,
                     lora_id, proc_masks, mm_embeds, mm_mask,
-                    g_active=None, g_class=None, g_trans=None):
+                    g_active=None, g_state=None, g_class=None, g_trans=None):
             # tokens/positions: [S_pad] — ONE chunk of the prompt (the whole
             # prompt when it fits a bucket); block_table: [max_blocks_per_seq]
             def attend(q, k_new, v_new, layer_idx, **extra):
@@ -1062,10 +1068,16 @@ class TpuEngine:
                     counts[slot][None], steps, total_len[None],
                 )
                 if g_active is not None:
-                    # first generated token: FSM is at the start state (0)
+                    # first generated token: FSM at g_state (0, or past the
+                    # prior tokens on a disagg/migration resume). Full
+                    # [B, ...] tables indexed by slot (not pre-sliced rows):
+                    # the same device-resident unit the decode ops use, so
+                    # multihost replays it as shared state instead of
+                    # broadcasting megabyte rows per chunk.
                     pen = gmask(
-                        pen, g_active[None], jnp.zeros((1,), jnp.int32),
-                        g_class[None], g_trans[None],
+                        pen, g_active[slot][None],
+                        jnp.full((1,), g_state, jnp.int32),
+                        g_class[slot][None], g_trans[slot][None],
                     )
                 tok = sample_tokens(pen, seeds, steps, temp, top_k, top_p, min_p)
                 # the first generated token must enter the output counts, or
@@ -1489,50 +1501,147 @@ class TpuEngine:
         def _set_pmasks(v):
             self.prompt_masks = v
 
+        def _set_dk(v):
+            self.draft_k_caches = v
+
+        def _set_dv(v):
+            self.draft_v_caches = v
+
+        state_get = {
+            "params": lambda: self.params,
+            "k": lambda: self.k_caches,
+            "v": lambda: self.v_caches,
+            "counts": lambda: self.output_counts,
+            "pmasks": lambda: self.prompt_masks,
+            "lora": self._lora_tables,
+        }
+        state_set = {
+            "k": _set_k, "v": _set_v,
+            "counts": _set_counts, "pmasks": _set_pmasks,
+        }
+        if self.cfg.spec_draft is not None:
+            state_get.update({
+                "draft_params": lambda: self.draft_params,
+                "dk": lambda: self.draft_k_caches,
+                "dv": lambda: self.draft_v_caches,
+            })
+            state_set.update({"dk": _set_dk, "dv": _set_dv})
+
+        def _set_g_active(v):
+            self._g_dev_active = v
+
+        def _set_g_class(v):
+            self._g_dev_class = v
+
+        def _set_g_trans(v):
+            self._g_dev_trans = v
+
+        if self.guided_enabled:
+            state_get.update({
+                "g_active_dev": lambda: self._g_dev_active,
+                "g_class_dev": lambda: self._g_dev_class,
+                "g_trans_dev": lambda: self._g_dev_trans,
+            })
+            state_set.update({
+                "g_active_dev": _set_g_active,
+                "g_class_dev": _set_g_class,
+                "g_trans_dev": _set_g_trans,
+            })
         ops = self._mh.router.table(
-            ns=self._mh_ns,
-            state_get={
-                "params": lambda: self.params,
-                "k": lambda: self.k_caches,
-                "v": lambda: self.v_caches,
-                "counts": lambda: self.output_counts,
-                "pmasks": lambda: self.prompt_masks,
-                "lora": self._lora_tables,
-            },
-            state_set={
-                "k": _set_k, "v": _set_v,
-                "counts": _set_counts, "pmasks": _set_pmasks,
-            },
+            ns=self._mh_ns, state_get=state_get, state_set=state_set,
+        )
+        # guided-arg positions appended to the sampler signatures when the
+        # feature is compiled in (engine _build_programs); g_state travels
+        # by value (resync) or as the carry sentinel
+        g_prefill = (
+            # 29 (g_state) travels by value — a scalar resume state
+            {28: "g_active_dev", 30: "g_class_dev", 31: "g_trans_dev"}
+            if self.guided_enabled else {}
+        )
+        g_decode = (
+            {24: "g_active_dev", 26: "g_class_dev", 27: "g_trans_dev"}
+            if self.guided_enabled else {}
+        )
+        g_multi = (
+            {22: "g_active_dev", 24: "g_class_dev", 25: "g_trans_dev"}
+            if self.guided_enabled else {}
         )
         ops.register(
             "prefill", self._prefill_fn,
             state_in={0: "params", 1: "k", 2: "v", 3: "counts",
-                      19: "pmasks", 23: "lora"},
+                      19: "pmasks", 23: "lora", **g_prefill},
             state_out={0: "k", 1: "v", 2: "counts"},
         )
         ops.register(
             "decode", self._decode_fn,
             state_in={0: "params", 1: "k", 2: "v", 3: "counts",
-                      19: "pmasks", 21: "lora"},
+                      19: "pmasks", 21: "lora", **g_decode},
             state_out={0: "k", 1: "v", 2: "counts"},
         )
         ops.register(
             "decode_multi", self._decode_multi_fn,
             state_in={0: "params", 1: "k", 2: "v", 3: "counts",
-                      17: "pmasks", 19: "lora"},
+                      17: "pmasks", 19: "lora", **g_multi},
             state_out={0: "k", 1: "v", 2: "counts", 4: "carry_tokens",
-                       5: "carry_seq_lens", 6: "carry_steps"},
+                       5: "carry_seq_lens", 6: "carry_steps",
+                       **({7: "carry_g"} if self.guided_enabled else {})},
             # tokens/seq_lens/steps arrive either as a host resync (numpy →
             # by value) or as the previous horizon's device carry (jax.Array
             # → sentinel; the follower substitutes its stored carry)
-            carry_in={4: "carry_tokens", 5: "carry_seq_lens", 9: "carry_steps"},
+            carry_in={4: "carry_tokens", 5: "carry_seq_lens", 9: "carry_steps",
+                      **({23: "carry_g"} if self.guided_enabled else {})},
         )
+        if self.guided_enabled:
+            # guided-table sync: by-value incremental updates (the [B] mask
+            # on admission/release, one slot's rows on a guided admission)
+            # that BOTH sides store back — decode dispatches then reference
+            # the tables as state, never re-broadcasting them
+            grepl = NamedSharding(self.mesh, P())
+
+            def guided_active(a):
+                return jnp.asarray(a)
+
+            def guided_row(gc, gt, crow, trow, slot):
+                return gc.at[slot].set(crow), gt.at[slot].set(trow)
+
+            self._mh_guided_active = jax.jit(
+                guided_active, out_shardings=grepl
+            )
+            self._mh_guided_row = jax.jit(guided_row, donate_argnums=(0, 1))
+            ops.register(
+                "guided_active", self._mh_guided_active,
+                state_in={}, state_out={0: "g_active_dev"},
+            )
+            ops.register(
+                "guided_row", self._mh_guided_row,
+                state_in={0: "g_class_dev", 1: "g_trans_dev"},
+                state_out={0: "g_class_dev", 1: "g_trans_dev"},
+            )
         ops.register(
             "reset_slot", self._reset_slot_fn,
             state_in={0: "pmasks", 1: "counts"},
             state_out={0: "pmasks", 1: "counts"},
         )
         ops.register("embed", self._embed_fn, state_in={0: "params"}, state_out={})
+        if self.cfg.spec_draft is not None:
+            # speculative decoding: the spec horizon's carry shares names
+            # with decode_multi's, so spec and normal horizons chain on each
+            # other across the replay table exactly as in-process
+            ops.register(
+                "spec_multi", self._spec_multi_fn,
+                state_in={0: "params", 1: "draft_params", 2: "k", 3: "v",
+                          4: "dk", 5: "dv", 11: "lora"},
+                state_out={0: "k", 1: "v", 2: "dk", 3: "dv",
+                           5: "carry_tokens", 6: "carry_seq_lens",
+                           7: "carry_steps"},
+                carry_in={6: "carry_tokens", 7: "carry_seq_lens",
+                          10: "carry_steps"},
+            )
+            ops.register(
+                "draft_prefill", self._draft_prefill_fn,
+                state_in={0: "draft_params", 1: "dk", 2: "dv"},
+                state_out={0: "dk", 1: "dv"},
+            )
         if getattr(self, "_embed_chunk_fn", None) is not None:
             ops.register(
                 "embed_chunk", self._embed_chunk_fn,
@@ -1579,6 +1688,12 @@ class TpuEngine:
             self._decode_multi_fn = ops.leader_fn("decode_multi")
             self._reset_slot_fn = ops.leader_fn("reset_slot")
             self._embed_fn = ops.leader_fn("embed")
+            if self.cfg.spec_draft is not None:
+                self._spec_multi_fn = ops.leader_fn("spec_multi")
+                self._draft_prefill_fn = ops.leader_fn("draft_prefill")
+            if self.guided_enabled:
+                self._mh_guided_active = ops.leader_fn("guided_active")
+                self._mh_guided_row = ops.leader_fn("guided_row")
             if getattr(self, "_embed_chunk_fn", None) is not None:
                 self._embed_chunk_fn = ops.leader_fn("embed_chunk")
             self._mh_kv_gather = ops.leader_fn("kv_gather")
@@ -1706,6 +1821,20 @@ class TpuEngine:
             last_token=all_tokens[-1] if all_tokens else 0,
             guided_tables=guided_tables,
         )
+        if guided_tables is not None and req.prior_token_ids:
+            # disagg decode hop / migration resume: tokens generated so far
+            # (on the prefill worker / the dead worker) already consumed
+            # grammar transitions — seed the FSM past them instead of
+            # restarting at 0 (which would let the grammar accept a fresh
+            # full match appended to the prior output)
+            try:
+                st.guided_state = guided_tables.walk(
+                    0, [int(t) for t in req.prior_token_ids]
+                )
+            except ValueError as e:
+                raise ValueError(
+                    f"prior tokens violate the guided grammar: {e}"
+                ) from e
         if self.cfg.spec_draft is not None:
             s = req.sampling
             st.spec_ok = (
@@ -2138,8 +2267,9 @@ class TpuEngine:
                     tt = st.guided_tables
                     S_g, C_g = tt.trans.shape
                     self._g_active[slot] = True
-                    self._g_state[slot] = 0
-                    st.guided_state = 0
+                    # guided_state was seeded at generate() (0, or walked
+                    # over prior_token_ids for disagg/migration resumes)
+                    self._g_state[slot] = st.guided_state
                     V_model = self._g_class.shape[1]
                     n = min(len(tt.class_of), V_model)
                     self._g_class[slot, :n] = tt.class_of[:n]
@@ -2261,11 +2391,11 @@ class TpuEngine:
         _j = self._j
         g_args = ()
         if self.guided_enabled:
-            # per-slot rows of the versioned device tables (lazy device
-            # slices; the FSM starts at state 0 for the first token, so no
-            # state arg — the program pins it)
+            # full versioned device tables, indexed by slot in the program;
+            # the FSM state travels by value (0, or walked over prior
+            # tokens for disagg/migration resumes)
             ga, gc, gt = self._guided_dev()
-            g_args = (ga[st.slot], gc[st.slot], gt[st.slot])
+            g_args = (ga, _j(np.int32(st.guided_state)), gc, gt)
         (self.k_caches, self.v_caches, self.output_counts, tok, lp, tlp_vals,
          tlp_ids) = self._prefill_fn(
             self.params, self.k_caches, self.v_caches, self.output_counts,
@@ -2558,9 +2688,6 @@ class TpuEngine:
 
         kind = spec.get("kind")
         key = _json.dumps(spec, sort_keys=True, default=str)
-        hit = self._g_cache.get(key)
-        if hit is not None:
-            return hit
 
         def compile_():
             pattern = guided_regex_pattern(kind, spec.get("value"))
@@ -2579,22 +2706,38 @@ class TpuEngine:
                 )
             return build_token_tables(dfa, self._g_vocab, self._g_eos)
 
+        def checked_compile():
+            tt = compile_()
+            if tt.num_classes >= self.cfg.guided_max_classes:
+                # strict: column C_g of the padded table is the always-
+                # reject class for model-vocab ids beyond the tokenizer
+                # vocab
+                raise ValueError(
+                    f"guided grammar needs {tt.num_classes} token classes "
+                    f">= engine cap {self.cfg.guided_max_classes}"
+                )
+            return tt
+
+        # cache the in-flight FUTURE, not just the result: a burst of
+        # requests sharing one schema (the common case) must not each run
+        # the O(S x V) token-table product concurrently
         loop = asyncio.get_event_loop()
-        try:
-            tt = await loop.run_in_executor(self._fetch_executor, compile_)
-        except (RegexError, SchemaError, ValueError) as e:
-            raise ValueError(f"guided grammar rejected: {e}") from e
-        if tt.num_classes >= self.cfg.guided_max_classes:
-            # strict: column C_g of the padded table is the always-reject
-            # class for model-vocab ids beyond the tokenizer vocab
-            raise ValueError(
-                f"guided grammar needs {tt.num_classes} token classes >= "
-                f"engine cap {self.cfg.guided_max_classes}"
+        task = self._g_cache.get(key)
+        if task is None:
+            task = asyncio.ensure_future(
+                loop.run_in_executor(self._fetch_executor, checked_compile)
             )
-        if len(self._g_cache) > 32:
-            self._g_cache.pop(next(iter(self._g_cache)))
-        self._g_cache[key] = tt
-        return tt
+            if len(self._g_cache) > 32:
+                self._g_cache.pop(next(iter(self._g_cache)))
+            self._g_cache[key] = task
+        try:
+            return await asyncio.shield(task)
+        except (RegexError, SchemaError, ValueError) as e:
+            # failures don't poison the cache (a later identical request
+            # re-validates — caps may be config-reloaded across restarts)
+            if self._g_cache.get(key) is task:
+                del self._g_cache[key]
+            raise ValueError(f"guided grammar rejected: {e}") from e
 
     def _guided_dev(self):
         """Device copies of the guided tables. The [B] active mask
@@ -2602,7 +2745,30 @@ class TpuEngine:
         the big tables upload once, then changed SLOTS scatter in as row
         updates (.at[slot].set — only the row crosses host->device, the
         rest is an on-device copy). [B, S, C] is far too big for _dev's
-        per-dispatch content compare or per-admission full re-upload."""
+        per-dispatch content compare or per-admission full re-upload.
+
+        Multihost: the tables are replay STATE — the leader pushes the same
+        incremental updates through the guided_active/guided_row ops, so
+        followers' handles stay in step and the decode dispatches reference
+        them as state_in instead of broadcasting megabytes per horizon."""
+        if self._mh is not None:
+            if self._dev_cache.get("g/aver") != self._g_active_version:
+                self._g_dev_active = self._mh_guided_active(
+                    self._g_active.copy()
+                )
+                self._dev_cache["g/aver"] = self._g_active_version
+            if self._g_dirty_slots:
+                for slot in sorted(self._g_dirty_slots):
+                    self._g_dev_class, self._g_dev_trans = (
+                        self._mh_guided_row(
+                            self._g_dev_class, self._g_dev_trans,
+                            self._g_class[slot].copy(),
+                            self._g_trans[slot].copy(),
+                            np.int32(slot),
+                        )
+                    )
+                self._g_dirty_slots.clear()
+            return self._g_dev_active, self._g_dev_class, self._g_dev_trans
         if self._dev_cache.get("g/aver") != self._g_active_version:
             self._dev_cache["g/active"] = jnp.asarray(self._g_active)
             self._dev_cache["g/aver"] = self._g_active_version

@@ -335,8 +335,7 @@ def compile_regex(pattern: str, max_states: int = 32768) -> Dfa:
     for es in nfa.edges:
         for byteset, _dst in es:
             if byteset not in seen:
-                seen[byteset] = len(seen) + 1
-                bid = seen[byteset]
+                seen[byteset] = len(seen)
                 arr = np.zeros(256, bool)
                 arr[list(byteset)] = True
                 # fold this set's membership into the per-byte signature

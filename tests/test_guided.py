@@ -389,6 +389,30 @@ async def test_soft_guided_degrades_on_disabled_engine():
         e.stop()
 
 
+async def test_guided_resumes_past_prior_tokens():
+    """Disagg decode hop / migration resume carries already-generated
+    tokens in prior_token_ids: the FSM must be seeded PAST them, not
+    restarted (a restart would accept a fresh full match appended to the
+    prior output)."""
+    e = engine()
+    try:
+        req = preq("resume", guided={"kind": "choice",
+                                     "value": ["left", "right"]})
+        req.prior_token_ids = [ord("l"), ord("e")]  # mid-"left"
+        toks, finish = await collect(e, req)
+        # the only legal continuation from "le" is "ft" then EOS
+        assert text(toks) == "ft", text(toks)
+        assert finish == "stop"
+
+        bad = preq("badresume", guided={"kind": "choice",
+                                        "value": ["left", "right"]})
+        bad.prior_token_ids = [ord("x")]
+        with pytest.raises(ValueError, match="prior tokens violate"):
+            await collect(e, bad)
+    finally:
+        e.stop()
+
+
 async def test_guided_with_spec_engine_falls_back():
     """On an engine with BOTH speculative decoding and guidance, a guided
     row makes the dispatch spec-ineligible; output still honors the

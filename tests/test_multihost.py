@@ -34,7 +34,7 @@ def _free_port() -> int:
 
 
 def _engine_cmd(store_path: str, mh_spec: str, preset: str = "tiny",
-                model: str = "mh-model") -> list:
+                model: str = "mh-model", extra_args: tuple = ()) -> list:
     return [
         sys.executable, "-m", "dynamo_tpu.engine",
         "--platform", "cpu",
@@ -48,6 +48,7 @@ def _engine_cmd(store_path: str, mh_spec: str, preset: str = "tiny",
         "--store-path", store_path,
         "--event-plane", "inproc",
         "--multihost", mh_spec,
+        *extra_args,
     ]
 
 
@@ -61,11 +62,13 @@ def _env() -> dict:
 
 
 def _spawn(store_path: str, mh_spec: str, log_path: str,
-           preset: str = "tiny", model: str = "mh-model") -> subprocess.Popen:
+           preset: str = "tiny", model: str = "mh-model",
+           extra_args: tuple = ()) -> subprocess.Popen:
     # log to a FILE: an undrained 64KB pipe would wedge a chatty child
     # mid-collective and hang the whole mesh
     return subprocess.Popen(
-        _engine_cmd(store_path, mh_spec, preset=preset, model=model),
+        _engine_cmd(store_path, mh_spec, preset=preset, model=model,
+                    extra_args=extra_args),
         stdout=open(log_path, "wb"), stderr=subprocess.STDOUT,
         env=_env(), cwd=REPO,
     )
@@ -99,16 +102,17 @@ def test_two_process_mesh_serves_through_frontend(tmp_path):
 
 
 async def _run_e2e(tmp_path, preset="tiny", model="mh-model",
-                   prompt="hi there", max_tokens=8):
+                   prompt="hi there", max_tokens=8, extra_args=(),
+                   n_requests=1, req_extra=None, check_body=None):
     store_path = str(tmp_path / "store")
     coord, control = _free_port(), _free_port()
     mh = f"127.0.0.1:{coord},2,{{pid}},127.0.0.1:{control}"
     flog, llog = str(tmp_path / "follower.log"), str(tmp_path / "leader.log")
 
     follower = _spawn(store_path, mh.format(pid=1), flog,
-                      preset=preset, model=model)
+                      preset=preset, model=model, extra_args=extra_args)
     leader = _spawn(store_path, mh.format(pid=0), llog,
-                    preset=preset, model=model)
+                    preset=preset, model=model, extra_args=extra_args)
     frontend_rt = watcher = service = None
     try:
         await _wait_marker(leader, llog, b"TPU_ENGINE_READY", 300)
@@ -145,20 +149,26 @@ async def _run_e2e(tmp_path, preset="tiny", model="mh-model",
             raise AssertionError(f"{model} never appeared in discovery")
 
         async with aiohttp.ClientSession() as s:
-            r = await s.post(
-                f"http://127.0.0.1:{service.port}/v1/chat/completions",
-                json={
-                    "model": model,
-                    "messages": [{"role": "user", "content": prompt}],
-                    "max_tokens": max_tokens,
-                    "temperature": 0.0,
-                },
-                timeout=aiohttp.ClientTimeout(total=240),
-            )
-            assert r.status == 200, await r.text()
-            body = await r.json()
-        assert body["usage"]["completion_tokens"] > 0
-        assert isinstance(body["choices"][0]["message"]["content"], str)
+            for _ in range(n_requests):
+                r = await s.post(
+                    f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                    json={
+                        "model": model,
+                        "messages": [{"role": "user", "content": prompt}],
+                        "max_tokens": max_tokens,
+                        "temperature": 0.0,
+                        **(req_extra or {}),
+                    },
+                    timeout=aiohttp.ClientTimeout(total=240),
+                )
+                assert r.status == 200, await r.text()
+                body = await r.json()
+                assert body["usage"]["completion_tokens"] > 0
+                assert isinstance(
+                    body["choices"][0]["message"]["content"], str
+                )
+                if check_body is not None:
+                    check_body(body)
 
         # graceful stop: leader broadcasts __stop__; both processes exit 0
         leader.send_signal(signal.SIGTERM)
@@ -179,6 +189,47 @@ async def _run_e2e(tmp_path, preset="tiny", model="mh-model",
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
+
+
+def test_two_process_mesh_serves_spec_decode(tmp_path):
+    """Multihost x speculative decoding: the draft model's shadow cache and
+    the spec_multi/draft_prefill programs ride the leader/follower dispatch
+    replay (state entries for draft params + caches, shared carry names so
+    spec and normal horizons chain across the table). Two requests: the
+    second exercises prefix-cache reuse + the draft catch-up under replay."""
+    asyncio.run(asyncio.wait_for(
+        _run_e2e(
+            tmp_path, model="mh-spec", prompt="speculate this",
+            max_tokens=10, n_requests=2,
+            extra_args=("--spec-draft", "tiny", "--spec-k", "3",
+                        "--decode-steps", "6", "--decode-pipeline", "2"),
+        ),
+        timeout=560,
+    ))
+
+
+def test_two_process_mesh_serves_guided(tmp_path):
+    """Multihost x guided decoding: the grammar token tables live on both
+    processes as replay state (guided_active/guided_row sync ops), the FSM
+    state rides the replayed horizon carry, and the constrained output must
+    be exactly one of the choices. Two requests exercise table updates on
+    slot turnover under replay."""
+
+    def check(body):
+        assert body["choices"][0]["message"]["content"] in (
+            "tensor", "processing", "unit"
+        ), body
+
+    asyncio.run(asyncio.wait_for(
+        _run_e2e(
+            tmp_path, model="mh-guided", prompt="pick a word",
+            max_tokens=16, n_requests=2,
+            extra_args=("--decode-steps", "6", "--decode-pipeline", "2"),
+            req_extra={"guided_choice": ["tensor", "processing", "unit"]},
+            check_body=check,
+        ),
+        timeout=560,
+    ))
 
 
 def test_two_process_mesh_serves_mla(tmp_path):

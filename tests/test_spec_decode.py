@@ -176,8 +176,9 @@ def test_spec_config_gates():
             dtype=jnp.float32,
         )
         engine(spec=bad)
-    with pytest.raises(ValueError, match="multihost"):
+    with pytest.raises(ValueError, match="non-pp"):
         cfg = TpuEngineConfig(
             model=MODEL, spec_draft=DRAFT, decode_steps=4, decode_pipeline=1,
+            sp=2,
         )
-        TpuEngine(cfg, multihost=object())
+        TpuEngine(cfg)
