@@ -157,6 +157,12 @@ def parse_args():
                         "entirely (no mask ops in the decode programs)")
     p.add_argument("--guided-max-classes", type=int, default=320,
                    help="guided decoding token-class cap (see above)")
+    p.add_argument("--eplb-redundant-experts", type=int, default=0,
+                   help="EPLB (models/eplb.py): add N redundant physical "
+                        "expert slots to a MoE model and spread hot "
+                        "experts' tokens across replicas; rebalance at "
+                        "runtime from measured loads. MoE presets/"
+                        "checkpoints only; (E+N) must divide over tp")
     p.add_argument(
         "--disagg",
         choices=["none", "prefill", "decode"],
@@ -339,6 +345,17 @@ def _load_model(args):
     else:
         mcfg = PRESETS[args.preset]()
         tokenizer_ref = args.tokenizer or "byte"
+    n_red = getattr(args, "eplb_redundant_experts", 0)
+    if n_red > 0:
+        import dataclasses as _dc
+
+        if getattr(mcfg, "num_experts", 0) <= 0 or not hasattr(
+            mcfg, "redundant_experts"
+        ):
+            raise SystemExit(
+                "--eplb-redundant-experts needs a MoeConfig-family model"
+            )
+        mcfg = _dc.replace(mcfg, redundant_experts=n_red)
     return mcfg, params, tokenizer_ref
 
 
@@ -670,6 +687,13 @@ async def main() -> None:
     clear_served = await serve_clear_endpoint(
         runtime, args.namespace, component, engines, served.instance_id
     )
+    eplb_served = None
+    if getattr(mcfg, "redundant_experts", 0) > 0:
+        from dynamo_tpu.llm.serve import serve_eplb_endpoint
+
+        eplb_served = await serve_eplb_endpoint(
+            runtime, args.namespace, component, engines, served.instance_id
+        )
 
     # health: engine watchdog + endpoint canary + status side-port
     # (reference: engine_monitor.py, health_check.rs, system_status_server.rs)
@@ -734,6 +758,8 @@ async def main() -> None:
     if not watchdog.fired:
         await served.stop(graceful_timeout_s=args.graceful_timeout)
     await clear_served.stop()
+    if eplb_served is not None:
+        await eplb_served.stop()
     for s in lora_served:
         await s.stop()
     engine.stop()

@@ -449,6 +449,23 @@ class TpuEngine:
                 # wiring and the decode_multi scan carry are unchanged
                 self.k_caches, self.v_caches = [k], [v]
             else:
+                if (registry.is_moe(self.mcfg)
+                        and getattr(self.mcfg, "redundant_experts", 0) > 0):
+                    # EPLB: checkpoint/warm-loaded params carry LOGICAL
+                    # expert stacks; expand to physical slots + seed the
+                    # remap tables before sharding (models/moe.py). The
+                    # physical count must divide over the EP shards.
+                    from ..models import moe as moe_mod
+
+                    tp_n = meshlib.tp_size(self.mesh)
+                    if self.mcfg.num_physical_experts % tp_n:
+                        raise ValueError(
+                            f"num_experts + redundant_experts = "
+                            f"{self.mcfg.num_physical_experts} must divide "
+                            f"over tp={tp_n} for EP sharding"
+                        )
+                    for lp in params["layers"]:
+                        moe_mod.ensure_eplb_layer(lp, self.mcfg)
                 self.params = self._shard_params(params)
                 self.k_caches, self.v_caches = self._init_caches()
 
@@ -620,6 +637,7 @@ class TpuEngine:
         self.transfer_address: Optional[str] = None
         self._transfer_server = None
         self._transfer_client = None
+        self._probe_load_fn = None  # EPLB load probe, jitted on first use
         self._build_programs()
 
     # ------------------------------------------------------ kv transfer wiring
@@ -1939,6 +1957,84 @@ class TpuEngine:
             # dispatch can't slip a collective past the followers' exit
             self._mh_ops.close()
 
+    # ---------------------------------------------------------------- EPLB
+    def measure_expert_load(self, token_ids: List[int]) -> np.ndarray:
+        """[num_layers, E] tokens-per-logical-expert for a probe batch
+        (models/eplb.py probe — dense forward, OFF the serving hot path;
+        the reference collects the same statistic from its engines
+        periodically). Call from the profiler / an ops endpoint with
+        representative prompts, feed the summed counts to
+        eplb_rebalance."""
+        from ..models import eplb as eplb_mod
+
+        if not (registry.is_moe(self.mcfg)
+                and getattr(self.mcfg, "redundant_experts", 0) > 0):
+            raise ValueError("engine model has no EPLB (redundant_experts=0)")
+        if self._probe_load_fn is None:
+            self._probe_load_fn = jax.jit(
+                partial(eplb_mod.probe_expert_load, cfg=self.mcfg)
+            )
+        toks = jnp.asarray(np.asarray(token_ids, np.int32))
+        pos = jnp.arange(len(token_ids), dtype=jnp.int32)
+        return np.asarray(
+            self._probe_load_fn(self.params, token_ids=toks, positions=pos)
+        )
+
+    def eplb_rebalance(self, counts: np.ndarray) -> Dict[str, Any]:
+        """Re-plan the redundant-expert replicas from measured counts and
+        swap the plan into the live params — table updates + a weight
+        gather along the (sharded) expert dim, zero recompiles (the slot
+        count is static). ``counts``: [E] aggregated, or [L, E] per layer.
+        Output tokens are unchanged by construction (replicas carry the
+        logical weights; only the load placement moves)."""
+        from ..models import eplb as eplb_mod
+
+        if not (registry.is_moe(self.mcfg)
+                and getattr(self.mcfg, "redundant_experts", 0) > 0):
+            raise ValueError("engine model has no EPLB (redundant_experts=0)")
+        if self._mh is not None:
+            raise ValueError(
+                "EPLB rebalance is not in the multihost replay table yet"
+            )
+        counts = np.asarray(counts, np.float64)
+        per_layer = counts.ndim == 2
+        ep = meshlib.tp_size(self.mesh)
+        E, R = self.mcfg.num_experts, self.mcfg.redundant_experts
+        moe_layers = [
+            i for i, lp in enumerate(self.params["layers"])
+            if "eplb_slots" in lp
+        ]
+        # validate BEFORE mutating anything: a wrong-length counts vector
+        # must not silently broadcast into a do-nothing plan or fail after
+        # some layers were already swapped
+        if per_layer:
+            if counts.shape != (len(moe_layers), E):
+                raise ValueError(
+                    f"counts shape {counts.shape} != "
+                    f"({len(moe_layers)} moe layers, {E} experts)"
+                )
+        elif counts.shape != (E,):
+            raise ValueError(
+                f"counts shape {counts.shape} != ({E} experts,)"
+            )
+        plans = []
+        for n, i in enumerate(moe_layers):
+            c = counts[n] if per_layer else counts
+            p = eplb_mod.plan(c, E, R, ep=ep)
+            self.params["layers"][i] = eplb_mod.apply_plan(
+                self.params["layers"][i], p
+            )
+            plans.append(p)
+        return {
+            "layers": len(plans),
+            "redundant_experts": R,
+            "max_shard_load": (
+                plans[0].max_shard_load(
+                    counts[0] if per_layer else counts, ep
+                ) if plans else None
+            ),
+        }
+
     # ------------------------------------------------------- kvbm offload/onboard
     def _enqueue_offload_gather(self, pending: List[Tuple[int, int]]):
         """Event-loop thread: ENQUEUE the device-side page gathers for sealed
@@ -3238,6 +3334,12 @@ class TpuEngine:
         }
         if self.cfg.spec_draft is not None:
             snap["spec"] = dict(self.spec_stats)
+        if (registry.is_moe(self.mcfg)
+                and getattr(self.mcfg, "redundant_experts", 0) > 0):
+            snap["eplb"] = {
+                "redundant_experts": self.mcfg.redundant_experts,
+                "physical_experts": self.mcfg.num_physical_experts,
+            }
         if self.kvbm is not None:
             snap["kvbm"] = {
                 "g2_blocks": len(self.kvbm.host),

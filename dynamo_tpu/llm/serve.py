@@ -82,3 +82,55 @@ async def serve_clear_endpoint(
         .endpoint("clear_kv_blocks")
         .serve(handle_clear_kv, instance_id=instance_id)
     )
+
+
+async def serve_eplb_endpoint(
+    runtime: DistributedRuntime,
+    namespace: str,
+    component: str,
+    engines,
+    instance_id: int,
+) -> ServedEndpoint:
+    """Serve an ``eplb_rebalance`` admin endpoint beside generate (reference:
+    SGLang's EPLB rebalances from periodically collected expert counts; here
+    an operator/cron drives it). Request: {"counts": [E] or [L, E]} to
+    rebalance from external stats, or {"probe_tokens": [...]} to measure on
+    a representative batch first and rebalance from the result."""
+
+    async def handle_eplb(request, context):
+        import asyncio as _aio
+
+        import numpy as _np
+
+        req = request or {}
+        loop = _aio.get_event_loop()
+        counts = req.get("counts")
+        if counts is None:
+            probe = req.get("probe_tokens")
+            if not probe:
+                raise ValueError(
+                    "eplb_rebalance wants counts=[E]|[L,E] or "
+                    "probe_tokens=[...]"
+                )
+            # dp replicas hold identical weights: measure ONCE, feed the
+            # same counts to every rank's rebalance
+            measured = await loop.run_in_executor(
+                None, engines[0].measure_expert_load,
+                [int(t) for t in probe],
+            )
+            counts = measured.sum(axis=0)
+        counts = _np.asarray(counts, float)
+        results = []
+        for e in engines:
+            results.append(
+                await loop.run_in_executor(None, e.eplb_rebalance, counts)
+            )
+        out = dict(results[0])
+        out["engines"] = len(results)
+        yield out
+
+    return await (
+        runtime.namespace(namespace).component(component)
+        .endpoint("eplb_rebalance")
+        .serve(handle_eplb, instance_id=instance_id)
+    )

@@ -1,0 +1,164 @@
+"""EPLB — expert-parallelism load balancing via redundant experts.
+
+Reference: SGLang's EPLB (docs/backends/sglang/expert-distribution-eplb.md
+— redundant experts, hierarchical/global rebalancing from periodically
+collected token counts); TRT-LLM's moe_cluster/expert parallel knobs
+(components/src/dynamo/trtllm/engine.py:120-122). The reference deploys
+engines that own this; here the engine is native, so EPLB is built in.
+
+TPU-native shape (models/moe.py holds the hot-path pieces):
+
+- the expert stacks carry R extra PHYSICAL slots ([E+R, ...], STATIC — no
+  recompiles, the expert dim keeps sharding over the tp/ep axis);
+- per-layer remap tables (``eplb_slots`` [E, R+1], ``eplb_nrep`` [E]) live
+  in the params pytree, so a rebalance is an in-place table + weight-slot
+  update, exactly like LoRA hot-load;
+- tokens spread round-robin across a logical expert's replicas inside the
+  EP kernels (``moe.eplb_remap``), so a hot expert's load divides across
+  the shards that hold its replicas.
+
+This module is the COLD path: measuring loads, planning the replica set,
+and applying a plan to live params. ``TpuEngine.eplb_rebalance`` drives
+it at runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import moe
+
+
+@dataclasses.dataclass
+class EplbPlan:
+    """A replica layout: phys_src[s] = logical expert served by physical
+    slot s (identity for the E primaries); slots/nrep are the routing
+    tables (moe.eplb_remap)."""
+
+    phys_src: np.ndarray   # [E+R] int32
+    slots: np.ndarray      # [E, R+1] int32
+    nrep: np.ndarray       # [E] int32
+
+    def max_shard_load(self, counts: np.ndarray, ep: int) -> float:
+        """Expected max per-shard token load under this plan (the quantity
+        EPLB minimizes): each expert's count divides evenly across its
+        replicas; a slot's load lands on the shard that owns it."""
+        E_phys = len(self.phys_src)
+        per = E_phys // ep
+        shard = np.zeros(ep)
+        for s, e in enumerate(self.phys_src):
+            shard[s // per] += counts[e] / self.nrep[e]
+        return float(shard.max())
+
+
+def plan(counts: np.ndarray, E: int, R: int, ep: int = 1) -> EplbPlan:
+    """Greedy water-filling: repeatedly grant a replica to the expert with
+    the highest per-replica load, then place each replica in a redundant
+    slot preferring shards that (a) don't already serve that expert and
+    (b) carry the least planned load — the same objective as the
+    reference's rebalancing (minimize the hottest rank)."""
+    counts = np.asarray(counts, np.float64).clip(min=0)
+    E_phys = E + R
+    if ep > 0 and E_phys % ep:
+        raise ValueError(f"E+R={E_phys} must divide over ep={ep} shards")
+    reps = np.ones(E, np.int64)
+    for _ in range(R):
+        e = int(np.argmax(counts / reps))
+        reps[e] += 1
+
+    per = E_phys // max(ep, 1)
+    shard_load = np.zeros(max(ep, 1))
+    shard_of = lambda s: s // per  # noqa: E731
+    # primaries' share lands first
+    for e in range(E):
+        shard_load[shard_of(e)] += counts[e] / reps[e]
+
+    phys_src = np.concatenate(
+        [np.arange(E, dtype=np.int32), np.zeros(R, np.int32)]
+    )
+    slots, nrep = _identity_tables(E, R)
+    free = list(range(E, E_phys))
+    # place the hottest experts' replicas first
+    order = sorted(range(E), key=lambda e: -counts[e])
+    for e in order:
+        for _ in range(int(reps[e]) - 1):
+            taken = {shard_of(s) for s in slots[e][: nrep[e]]}
+            # prefer a fresh shard with the least planned load
+            best = min(
+                free,
+                key=lambda s: (shard_of(s) in taken,
+                               shard_load[shard_of(s)]),
+            )
+            free.remove(best)
+            phys_src[best] = e
+            slots[e][nrep[e]] = best
+            nrep[e] += 1
+            shard_load[shard_of(best)] += counts[e] / reps[e]
+    # pad unused table columns with the primary (any pick stays valid)
+    for e in range(E):
+        slots[e][nrep[e]:] = slots[e][0]
+    return EplbPlan(
+        phys_src=phys_src.astype(np.int32),
+        slots=slots.astype(np.int32),
+        nrep=nrep.astype(np.int32),
+    )
+
+
+def _identity_tables(E: int, R: int) -> Tuple[np.ndarray, np.ndarray]:
+    slots = np.tile(np.arange(E, dtype=np.int64)[:, None], (1, R + 1))
+    return slots, np.ones(E, np.int64)
+
+
+def apply_plan(layer: Dict, p: EplbPlan) -> Dict:
+    """New layer params under ``p``: replica slots gather their logical
+    expert's weights FROM THE PRIMARIES (slots 0..E-1 always hold the
+    logical weights, so plans compose without drift), tables swap in. Pure
+    function of the old layer — callers assign the result; shardings are
+    preserved (gather along the sharded expert dim keeps the spec;
+    replicated tables stay replicated)."""
+    from jax.sharding import NamedSharding
+
+    out = dict(layer)
+    src = jnp.asarray(p.phys_src)
+    for k in ("w_gate", "w_up", "w_down"):
+        gathered = layer[k][src]
+        shd = getattr(layer[k], "sharding", None)
+        if isinstance(shd, NamedSharding):
+            # an indexed gather drops the expert-dim sharding (the output
+            # comes back replicated): re-place on the ORIGINAL spec, or one
+            # rebalance silently multiplies expert HBM use by the EP degree.
+            # Uncommitted (mesh-less) arrays stay uncommitted — an explicit
+            # put would pin them to one device and break later mesh use.
+            gathered = jax.device_put(gathered, shd)
+        out[k] = gathered
+    out["eplb_slots"] = jnp.asarray(p.slots)
+    out["eplb_nrep"] = jnp.asarray(p.nrep)
+    return out
+
+
+def probe_expert_load(params, cfg: moe.MoeConfig, token_ids, positions):
+    """[num_layers, E] tokens-per-logical-expert for one batch: a dense
+    causal forward with the router observed at every MoE layer. The
+    reference collects the same statistic from its engines periodically;
+    this is the jittable probe the engine's measure path uses (offline —
+    never on the serving hot path)."""
+    from ..ops import attention as att
+
+    counts: List[jax.Array] = []
+
+    def probing_ffn(p, _cfg, x):
+        topw, topi = moe.route(p, _cfg, x)
+        counts.append(moe.expert_load(_cfg, topi))
+        return moe.moe_ffn(p, _cfg, x)
+
+    def attend(q, k_new, v_new, layer_idx, **extra):
+        return att.causal_attention(q, k_new, v_new, **extra)
+
+    moe.forward(params, cfg, token_ids, positions, attend,
+                ffn_fn=probing_ffn)
+    return jnp.stack(counts)

@@ -49,6 +49,19 @@ class MoeConfig(llama.LlamaConfig):
     norm_topk_prob: bool = True
     # a2a dispatch capacity per (source shard, expert) = ceil(T*K/E * factor)
     capacity_factor: float = 2.0
+    # EPLB (expert parallelism load balancing; reference: SGLang EPLB,
+    # docs/backends/sglang/expert-distribution-eplb.md — redundant experts
+    # rebalanced from observed load). R extra PHYSICAL expert slots hold
+    # replicas of hot experts: the expert stacks are [E+R, ...] (static, so
+    # zero recompiles), per-layer remap tables (eplb_slots/eplb_nrep, part
+    # of the params pytree like LoRA tables) spread each logical expert's
+    # tokens across its replicas, and TpuEngine.eplb_rebalance() re-plans
+    # the replica set from measured counts at runtime. 0 disables.
+    redundant_experts: int = 0
+
+    @property
+    def num_physical_experts(self) -> int:
+        return self.num_experts + self.redundant_experts
 
     @classmethod
     def tiny_moe(cls, **kw) -> "MoeConfig":
@@ -100,6 +113,8 @@ def init_layer_params(rng: jax.Array, cfg: MoeConfig) -> Params:
     if cfg.qk_norm:
         p["q_norm"] = jnp.ones((cfg.head_dim,), cfg.dtype)
         p["k_norm"] = jnp.ones((cfg.head_dim,), cfg.dtype)
+    if cfg.redundant_experts > 0:
+        ensure_eplb_layer(p, cfg)
     return p
 
 
@@ -142,6 +157,74 @@ def expert_load(cfg: MoeConfig, topi: jax.Array) -> jax.Array:
     return oh.sum(0)
 
 
+# ---------------------------------------------------------------------------
+# EPLB: redundant physical experts + replica remap tables
+# ---------------------------------------------------------------------------
+
+
+def default_eplb_tables(cfg: MoeConfig):
+    """Identity-ish plan: redundant slot E+i replicates logical expert
+    i % E (round-robin, so R > E just stacks more replicas per expert)
+    until a measured rebalance replaces it. Returns numpy
+    (slots [E, R+1], nrep [E], src [R]) — slots padded by repeating the
+    primary so any index mod nrep lands on a valid replica; src[i] is the
+    logical expert slot E+i serves (the weight-expansion gather)."""
+    import numpy as np
+
+    E, R = cfg.num_experts, cfg.redundant_experts
+    slots = np.tile(np.arange(E, dtype=np.int32)[:, None], (1, R + 1))
+    nrep = np.ones(E, np.int32)
+    src = np.arange(R, dtype=np.int32) % E
+    for i in range(R):
+        e = src[i]
+        slots[e, nrep[e]] = E + i
+        nrep[e] += 1
+    return slots, nrep, src
+
+
+def ensure_eplb_layer(p: Params, cfg: MoeConfig) -> Params:
+    """Expand a layer's logical [E, ...] expert stacks to physical
+    [E+R, ...] and seed the remap tables. Idempotent — checkpoint loaders
+    produce logical stacks; init and engine admission call this."""
+    R = cfg.redundant_experts
+    if R <= 0 or "w_gate" not in p:
+        return p
+    if p["w_gate"].shape[0] == cfg.num_physical_experts:
+        return p
+    slots, nrep, src = default_eplb_tables(cfg)
+    for k in ("w_gate", "w_up", "w_down"):
+        # default replicas mirror experts src[i] = i % E (the tables above)
+        p[k] = jnp.concatenate([p[k], p[k][src]], axis=0)
+    p["eplb_slots"] = jnp.asarray(slots)
+    p["eplb_nrep"] = jnp.asarray(nrep)
+    return p
+
+
+def eplb_remap(p: Params, topi: jax.Array) -> jax.Array:
+    """Map logical expert ids [T, K] to physical slots, spreading each
+    expert's tokens round-robin across its replicas (the token index is the
+    salt — deterministic, batch-independent per position)."""
+    if "eplb_slots" not in p:
+        return topi
+    T, K = topi.shape
+    nrep = p["eplb_nrep"][topi]                          # [T, K]
+    pick = (jnp.arange(T, dtype=jnp.int32)[:, None] + jnp.arange(K)) % nrep
+    return jnp.take_along_axis(
+        p["eplb_slots"][topi], pick[..., None], axis=-1
+    )[..., 0]
+
+
+def primary_experts(p: Params, cfg: MoeConfig) -> Params:
+    """View of the layer with only the logical expert slots (the dense and
+    gather paths index logically and must not touch replicas)."""
+    if "eplb_slots" not in p:
+        return p
+    out = dict(p)
+    for k in ("w_gate", "w_up", "w_down"):
+        out[k] = p[k][: cfg.num_experts]
+    return out
+
+
 def _expert_mlp(w_gate, w_up, w_down, x, out_dtype):
     """x [E, B, H] through per-expert SwiGLU -> [E, B, H]."""
     gate = jnp.einsum("ebh,ehi->ebi", x, w_gate)
@@ -160,6 +243,7 @@ def moe_ffn(p: Params, cfg: MoeConfig, x: jax.Array) -> jax.Array:
     combine. Exact (no capacity drops); O(T*E) compute — fine for tests and
     single-chip small-E serving."""
     T, H = x.shape
+    p = primary_experts(p, cfg)  # EPLB replicas are an EP-path concern
     topw, topi = route(p, cfg, x)                        # [T, K]
     out_all = _expert_mlp(
         p["w_gate"], p["w_up"], p["w_down"],
@@ -212,6 +296,9 @@ def moe_ffn_ep_psum(
     E_loc = p["w_gate"].shape[0]
     me = jax.lax.axis_index(axis_name)
     topw, topi = routed if routed is not None else route(p, cfg, x)
+    # EPLB: logical -> physical replica slots (tables replicated across
+    # shards, so every shard computes the same assignment)
+    topi = eplb_remap(p, topi)
     out_all = _expert_mlp(
         p["w_gate"], p["w_up"], p["w_down"],
         jnp.broadcast_to(x, (E_loc, T, H)), x.dtype,
@@ -231,12 +318,16 @@ def moe_ffn_ep_a2a(
     sharded [E_loc, ...]. GShard-style capacity dispatch with two
     all-to-alls over ICI."""
     T, H = x.shape
-    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    K = cfg.num_experts_per_tok
     ep = jax.lax.psum(1, axis_name)
-    E_loc = E // ep
-    C = max(1, int(math.ceil(T * K / E * cfg.capacity_factor)))
+    # E here is PHYSICAL (== logical when EPLB is off): the dispatch works
+    # in physical slots; capacity stays a per-logical-expert budget
+    E_loc = p["w_gate"].shape[0]
+    E = E_loc * ep
+    C = max(1, int(math.ceil(T * K / cfg.num_experts * cfg.capacity_factor)))
 
     topw, topi = route(p, cfg, x)                        # [T, K]
+    topi = eplb_remap(p, topi)
     flat_i = topi.reshape(T * K)                         # expert per slot
     flat_w = topw.reshape(T * K)
     oh = jax.nn.one_hot(flat_i, E, dtype=jnp.float32)    # [T*K, E]

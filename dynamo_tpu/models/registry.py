@@ -138,7 +138,13 @@ def forward_fn(cfg, mesh=None):
     # places the params with (below)
     layer_specs = param_specs(cfg)["layer"]
     ep_keys = ("w_router", "w_gate", "w_up", "w_down")
-    ep_specs = ({k: layer_specs[k] for k in ep_keys}, P())
+    if getattr(cfg, "redundant_experts", 0) > 0:
+        # EPLB remap tables ride into the shard_map replicated (every shard
+        # must compute the same logical->physical assignment)
+        ep_keys = ep_keys + ("eplb_slots", "eplb_nrep")
+    ep_specs = (
+        {k: layer_specs.get(k, P()) for k in ep_keys}, P()
+    )
 
     def ffn(p, _cfg, x):
         sub = {k: p[k] for k in ep_keys}

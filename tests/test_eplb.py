@@ -1,0 +1,229 @@
+"""EPLB — expert-parallelism load balancing with redundant experts.
+
+Reference: SGLang EPLB (redundant experts rebalanced from observed token
+counts, docs/backends/sglang/expert-distribution-eplb.md). Here the engine
+owns it (models/eplb.py + the remap tables in models/moe.py): R extra
+physical expert slots, per-layer routing tables in the params pytree,
+runtime rebalance with zero recompiles.
+
+The load-bearing invariant mirrors speculative decoding's: a rebalance
+moves WHERE expert compute runs, never WHAT it computes — outputs are
+token-identical before and after.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import eplb, moe, registry
+from dynamo_tpu.models.moe import MoeConfig
+from dynamo_tpu.parallel.mesh import AXIS_TP, make_mesh
+from dynamo_tpu.runtime import Context
+
+# ----------------------------------------------------------------- planner
+
+
+def test_plan_waterfills_and_spreads_shards():
+    E, R, ep = 8, 4, 4
+    counts = np.array([100, 80, 60, 40, 5, 5, 5, 5], float)
+    p = eplb.plan(counts, E, R, ep=ep)
+    # replicas go to the hottest experts
+    assert p.nrep[0] >= 2 and p.nrep[1] >= 2
+    assert p.nrep[4:].max() == 1
+    # every replica slot serves the expert its table claims
+    for e in range(E):
+        for j in range(p.nrep[e]):
+            assert p.phys_src[p.slots[e, j]] == e
+    # padded columns stay valid
+    assert (p.slots >= 0).all() and (p.slots < E + R).all()
+    # the plan must beat the no-replica layout on the EPLB objective
+    base = eplb.plan(counts, E, 0, ep=ep)
+    assert p.max_shard_load(counts, ep) < base.max_shard_load(counts, ep)
+
+
+def test_plan_replicates_one_ultra_hot_expert_many_times():
+    E, R = 4, 4
+    counts = np.array([1000, 1, 1, 1], float)
+    p = eplb.plan(counts, E, R, ep=4)
+    assert p.nrep[0] == R + 1  # water-filling pours every replica on it
+
+
+def test_plan_rejects_unshardable_layout():
+    with pytest.raises(ValueError, match="divide"):
+        eplb.plan(np.ones(8), 8, 3, ep=4)  # 11 slots over 4 shards
+
+
+def test_more_replicas_than_experts():
+    """R > E: default seeding round-robins replicas over all experts, and
+    the expanded stacks/tables stay consistent."""
+    cfg = MoeConfig.tiny_moe(redundant_experts=8)  # E=4, R=8 -> 12 slots
+    slots, nrep, src = moe.default_eplb_tables(cfg)
+    assert (nrep == 3).all()               # every expert gets 2 replicas
+    assert list(src) == [0, 1, 2, 3, 0, 1, 2, 3]
+    params = registry.init_params(jax.random.PRNGKey(3), cfg)
+    lp = params["layers"][0]
+    assert lp["w_gate"].shape[0] == 12
+    # replica slot E+i carries expert (i % E)'s weights
+    np.testing.assert_array_equal(
+        np.asarray(lp["w_gate"][4]), np.asarray(lp["w_gate"][0])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lp["w_gate"][11]), np.asarray(lp["w_gate"][3])
+    )
+
+
+# ------------------------------------------------- remap + forward equality
+
+CFG0 = MoeConfig.tiny_moe()
+CFG2 = MoeConfig.tiny_moe(redundant_experts=4)
+
+
+def _tokens(n=24):
+    return jnp.asarray([(i * 37 + 11) % 500 for i in range(n)], jnp.int32)
+
+
+def _dense_logits(cfg, params, toks):
+    from dynamo_tpu.ops import attention as att
+
+    def attend(q, k_new, v_new, layer_idx, **extra):
+        return att.causal_attention(q, k_new, v_new, **extra)
+
+    h = moe.forward(params, cfg, toks, jnp.arange(len(toks)), attend)
+    return moe.lm_logits(params, cfg, h)
+
+
+def test_expanded_params_match_logical_model():
+    """Same logical weights, R=4 physical slots, EP over 4 shards: the
+    remapped shard_map forward equals the replicated-logical forward."""
+    params0 = registry.init_params(jax.random.PRNGKey(0), CFG0)
+    params2 = registry.init_params(jax.random.PRNGKey(0), CFG2)
+    toks = _tokens()
+
+    mesh = make_mesh(tp=4, devices=jax.devices()[:4])
+    fwd0 = registry.forward_fn(CFG0, mesh)
+    fwd2 = registry.forward_fn(CFG2, mesh)
+
+    from dynamo_tpu.ops import attention as att
+
+    def attend(q, k_new, v_new, layer_idx, **extra):
+        return att.causal_attention(q, k_new, v_new, **extra)
+
+    with mesh:
+        h0 = fwd0(params0, CFG0, toks, jnp.arange(len(toks)), attend)
+        h2 = fwd2(params2, CFG2, toks, jnp.arange(len(toks)), attend)
+    np.testing.assert_allclose(
+        np.asarray(h0), np.asarray(h2), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_rebalance_is_output_invariant():
+    """apply_plan moves replicas around; the forward is unchanged."""
+    params = registry.init_params(jax.random.PRNGKey(1), CFG2)
+    toks = _tokens()
+    before = _dense_logits(CFG2, params, toks)
+
+    counts = np.array([50, 1, 40, 1], float)
+    p = eplb.plan(counts, CFG2.num_experts, CFG2.redundant_experts, ep=4)
+    params["layers"] = [
+        eplb.apply_plan(lp, p) if "eplb_slots" in lp else lp
+        for lp in params["layers"]
+    ]
+    after = _dense_logits(CFG2, params, toks)
+    np.testing.assert_allclose(
+        np.asarray(before), np.asarray(after), rtol=1e-5, atol=1e-5
+    )
+
+    # and through the EP shard_map path too
+    mesh = make_mesh(tp=4, devices=jax.devices()[:4])
+    fwd = registry.forward_fn(CFG2, mesh)
+    from dynamo_tpu.ops import attention as att
+
+    def attend(q, k_new, v_new, layer_idx, **extra):
+        return att.causal_attention(q, k_new, v_new, **extra)
+
+    with mesh:
+        h = fwd(params, CFG2, toks, jnp.arange(len(toks)), attend)
+    assert bool(jnp.isfinite(h).all())
+
+
+def test_probe_counts_sum_to_tokens_times_k():
+    params = registry.init_params(jax.random.PRNGKey(2), CFG2)
+    toks = _tokens(16)
+    counts = np.asarray(
+        eplb.probe_expert_load(params, CFG2, toks, jnp.arange(16))
+    )
+    assert counts.shape == (CFG2.num_layers, CFG2.num_experts)
+    expect = 16 * CFG2.num_experts_per_tok
+    assert (counts.sum(axis=1) == expect).all()
+
+
+# ------------------------------------------------------------- engine e2e
+
+
+def preq(rid, n=16):
+    return PreprocessedRequest(
+        request_id=rid, model="m",
+        token_ids=[(i * 13 + 5) % 500 for i in range(12)],
+        stop=StopConditions(max_tokens=n, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+async def collect(eng, req):
+    toks = []
+    async for out in eng.generate(req, Context()):
+        toks.extend(out.token_ids)
+    return toks
+
+
+async def test_engine_serves_and_rebalances_identically():
+    """tiny-moe with EPLB over tp=4: serve greedily, measure the load,
+    rebalance mid-serving, serve the same prompt again — token-identical
+    (the rebalance is invisible to outputs by construction)."""
+    cfg = TpuEngineConfig(
+        model=CFG2, num_blocks=64, block_size=4, max_batch_size=2,
+        max_context=256, prefill_buckets=(16, 32), decode_steps=4,
+        decode_pipeline=1, tp=4,
+    )
+    e = TpuEngine(cfg, mesh=make_mesh(tp=4, devices=jax.devices()[:4]))
+    try:
+        first = await collect(e, preq("a"))
+        counts = e.measure_expert_load([(i * 7) % 500 for i in range(32)])
+        assert counts.shape == (CFG2.num_layers, CFG2.num_experts)
+        summary = e.eplb_rebalance(counts.sum(axis=0))
+        assert summary["layers"] == CFG2.num_layers
+        assert summary["redundant_experts"] == CFG2.redundant_experts
+        again = await collect(e, preq("b"))
+        assert again == first
+        # rebalance must preserve the expert-dim sharding (an indexed
+        # gather alone would come back replicated)
+        lp = e.params["layers"][0]
+        spec = lp["w_gate"].sharding.spec
+        assert spec and spec[0] == AXIS_TP, spec
+        # wrong-length counts fail loudly BEFORE any mutation
+        with pytest.raises(ValueError, match="counts shape"):
+            e.eplb_rebalance(np.ones(3))
+        with pytest.raises(ValueError, match="counts shape"):
+            e.eplb_rebalance(np.ones((1, CFG2.num_experts)))
+    finally:
+        e.stop()
+
+
+def test_engine_rejects_unshardable_eplb():
+    bad = MoeConfig.tiny_moe(redundant_experts=3)  # 7 slots over tp=4
+    cfg = TpuEngineConfig(
+        model=bad, num_blocks=64, block_size=4, max_batch_size=2,
+        max_context=256, prefill_buckets=(16, 32), decode_steps=4,
+        decode_pipeline=1, tp=4,
+    )
+    with pytest.raises(ValueError, match="divide"):
+        TpuEngine(cfg, mesh=make_mesh(tp=4, devices=jax.devices()[:4]))
