@@ -449,8 +449,7 @@ class TpuEngine:
                 # wiring and the decode_multi scan carry are unchanged
                 self.k_caches, self.v_caches = [k], [v]
             else:
-                if (registry.is_moe(self.mcfg)
-                        and getattr(self.mcfg, "redundant_experts", 0) > 0):
+                if self._eplb_enabled:
                     # EPLB: checkpoint/warm-loaded params carry LOGICAL
                     # expert stacks; expand to physical slots + seed the
                     # remap tables before sharding (models/moe.py). The
@@ -1554,6 +1553,13 @@ class TpuEngine:
         def _set_g_trans(v):
             self._g_dev_trans = v
 
+        if self._eplb_enabled:
+
+            def _set_params(v):
+                self.params = v
+
+            # EPLB rebalance swaps the whole params pytree (one replayed op)
+            state_set["params"] = _set_params
         if self.guided_enabled:
             state_get.update({
                 "g_active_dev": lambda: self._g_dev_active,
@@ -1609,6 +1615,43 @@ class TpuEngine:
             carry_in={4: "carry_tokens", 5: "carry_seq_lens", 9: "carry_steps",
                       **({23: "carry_g"} if self.guided_enabled else {})},
         )
+        if self._eplb_enabled:
+            # EPLB rebalance as ONE replayed op: every MoE layer's stacked
+            # plan (gather sources + routing tables) applies in a single
+            # jitted params update, sharding pinned so the expert dim stays
+            # on the EP axis on every process
+            especs = registry.param_specs(self.mcfg)["layer"]
+            esh = {
+                k: NamedSharding(self.mesh, especs[k])
+                for k in ("w_gate", "w_up", "w_down")
+            }
+
+            def eplb_apply_all(params, srcs, slots, nreps):
+                # srcs [n_moe, E+R], slots [n_moe, E, R+1], nreps [n_moe, E]
+                layers = []
+                j = 0
+                for lp in params["layers"]:
+                    if "eplb_slots" not in lp:
+                        layers.append(lp)
+                        continue
+                    new = dict(lp)
+                    for k in ("w_gate", "w_up", "w_down"):
+                        new[k] = jax.lax.with_sharding_constraint(
+                            lp[k][srcs[j]], esh[k]
+                        )
+                    new["eplb_slots"] = slots[j]
+                    new["eplb_nrep"] = nreps[j]
+                    layers.append(new)
+                    j += 1
+                return {**params, "layers": layers}
+
+            self._mh_eplb_apply = jax.jit(
+                eplb_apply_all, donate_argnums=(0,)
+            )
+            ops.register(
+                "eplb_apply", self._mh_eplb_apply,
+                state_in={0: "params"}, state_out={0: "params"},
+            )
         if self.guided_enabled:
             # guided-table sync: by-value incremental updates (the [B] mask
             # on admission/release, one slot's rows on a guided admission)
@@ -1712,6 +1755,8 @@ class TpuEngine:
             if self.guided_enabled:
                 self._mh_guided_active = ops.leader_fn("guided_active")
                 self._mh_guided_row = ops.leader_fn("guided_row")
+            if self._eplb_enabled:
+                self._mh_eplb_apply = ops.leader_fn("eplb_apply")
             if getattr(self, "_embed_chunk_fn", None) is not None:
                 self._embed_chunk_fn = ops.leader_fn("embed_chunk")
             self._mh_kv_gather = ops.leader_fn("kv_gather")
@@ -1958,6 +2003,13 @@ class TpuEngine:
             self._mh_ops.close()
 
     # ---------------------------------------------------------------- EPLB
+    @property
+    def _eplb_enabled(self) -> bool:
+        return (
+            registry.is_moe(self.mcfg)
+            and getattr(self.mcfg, "redundant_experts", 0) > 0
+        )
+
     def measure_expert_load(self, token_ids: List[int]) -> np.ndarray:
         """[num_layers, E] tokens-per-logical-expert for a probe batch
         (models/eplb.py probe — dense forward, OFF the serving hot path;
@@ -1967,9 +2019,13 @@ class TpuEngine:
         eplb_rebalance."""
         from ..models import eplb as eplb_mod
 
-        if not (registry.is_moe(self.mcfg)
-                and getattr(self.mcfg, "redundant_experts", 0) > 0):
+        if not self._eplb_enabled:
             raise ValueError("engine model has no EPLB (redundant_experts=0)")
+        if self._mh is not None:
+            raise ValueError(
+                "the load probe is not in the multihost replay table; feed "
+                "externally collected counts to eplb_rebalance instead"
+            )
         if self._probe_load_fn is None:
             self._probe_load_fn = jax.jit(
                 partial(eplb_mod.probe_expert_load, cfg=self.mcfg)
@@ -1989,13 +2045,8 @@ class TpuEngine:
         logical weights; only the load placement moves)."""
         from ..models import eplb as eplb_mod
 
-        if not (registry.is_moe(self.mcfg)
-                and getattr(self.mcfg, "redundant_experts", 0) > 0):
+        if not self._eplb_enabled:
             raise ValueError("engine model has no EPLB (redundant_experts=0)")
-        if self._mh is not None:
-            raise ValueError(
-                "EPLB rebalance is not in the multihost replay table yet"
-            )
         counts = np.asarray(counts, np.float64)
         per_layer = counts.ndim == 2
         ep = meshlib.tp_size(self.mesh)
@@ -2017,14 +2068,34 @@ class TpuEngine:
             raise ValueError(
                 f"counts shape {counts.shape} != ({E} experts,)"
             )
-        plans = []
-        for n, i in enumerate(moe_layers):
-            c = counts[n] if per_layer else counts
-            p = eplb_mod.plan(c, E, R, ep=ep)
-            self.params["layers"][i] = eplb_mod.apply_plan(
-                self.params["layers"][i], p
-            )
-            plans.append(p)
+        plans = [
+            eplb_mod.plan(counts[n] if per_layer else counts, E, R, ep=ep)
+            for n in range(len(moe_layers))
+        ]
+
+        def _apply() -> None:
+            if self._mh is not None:
+                # one replayed op applies every layer's plan: followers swap
+                # their params handle in lockstep (state_out), shardings
+                # pinned inside the jitted update
+                self.params = self._mh_eplb_apply(
+                    self.params,
+                    np.stack([p.phys_src for p in plans]),
+                    np.stack([p.slots for p in plans]),
+                    np.stack([p.nrep for p in plans]),
+                )
+            else:
+                for n, i in enumerate(moe_layers):
+                    self.params["layers"][i] = eplb_mod.apply_plan(
+                        self.params["layers"][i], plans[n]
+                    )
+
+        # the swap MUST run on the step executor: decode/prefill dispatches
+        # read self.params on that (single) thread, and the multihost op
+        # DONATES the old buffers — a swap racing an in-flight dispatch
+        # would hand it deleted arrays (or, multihost, a stale handle the
+        # followers no longer hold)
+        self._executor.submit(_apply).result()
         return {
             "layers": len(plans),
             "redundant_experts": R,
@@ -3334,8 +3405,7 @@ class TpuEngine:
         }
         if self.cfg.spec_draft is not None:
             snap["spec"] = dict(self.spec_stats)
-        if (registry.is_moe(self.mcfg)
-                and getattr(self.mcfg, "redundant_experts", 0) > 0):
+        if self._eplb_enabled:
             snap["eplb"] = {
                 "redundant_experts": self.mcfg.redundant_experts,
                 "physical_experts": self.mcfg.num_physical_experts,

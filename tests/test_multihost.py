@@ -103,7 +103,8 @@ def test_two_process_mesh_serves_through_frontend(tmp_path):
 
 async def _run_e2e(tmp_path, preset="tiny", model="mh-model",
                    prompt="hi there", max_tokens=8, extra_args=(),
-                   n_requests=1, req_extra=None, check_body=None):
+                   n_requests=1, req_extra=None, check_body=None,
+                   between_requests=None):
     store_path = str(tmp_path / "store")
     coord, control = _free_port(), _free_port()
     mh = f"127.0.0.1:{coord},2,{{pid}},127.0.0.1:{control}"
@@ -148,8 +149,11 @@ async def _run_e2e(tmp_path, preset="tiny", model="mh-model",
         else:
             raise AssertionError(f"{model} never appeared in discovery")
 
+        bodies = []
         async with aiohttp.ClientSession() as s:
-            for _ in range(n_requests):
+            for req_i in range(n_requests):
+                if req_i == 1 and between_requests is not None:
+                    await between_requests(frontend_rt)
                 r = await s.post(
                     f"http://127.0.0.1:{service.port}/v1/chat/completions",
                     json={
@@ -163,12 +167,19 @@ async def _run_e2e(tmp_path, preset="tiny", model="mh-model",
                 )
                 assert r.status == 200, await r.text()
                 body = await r.json()
+                bodies.append(body)
                 assert body["usage"]["completion_tokens"] > 0
                 assert isinstance(
                     body["choices"][0]["message"]["content"], str
                 )
                 if check_body is not None:
                     check_body(body)
+
+        if n_requests > 1 and between_requests is not None:
+            # whatever ran between the two identical greedy requests must
+            # be OUTPUT-INVARIANT (e.g. an EPLB rebalance)
+            assert (bodies[0]["choices"][0]["message"]["content"]
+                    == bodies[1]["choices"][0]["message"]["content"])
 
         # graceful stop: leader broadcasts __stop__; both processes exit 0
         leader.send_signal(signal.SIGTERM)
@@ -227,6 +238,35 @@ def test_two_process_mesh_serves_guided(tmp_path):
             extra_args=("--decode-steps", "6", "--decode-pipeline", "2"),
             req_extra={"guided_choice": ["tensor", "processing", "unit"]},
             check_body=check,
+        ),
+        timeout=560,
+    ))
+
+
+def test_two_process_mesh_eplb_rebalance(tmp_path):
+    """Multihost x EPLB: a rebalance driven through the admin endpoint
+    rides the replay table as ONE eplb_apply op (both processes swap their
+    params handle in lockstep), and the identical greedy request before and
+    after returns identical tokens."""
+
+    async def rebalance(frontend_rt):
+        client = await (
+            frontend_rt.namespace("dynamo").component("backend")
+            .endpoint("eplb_rebalance").client()
+        )
+        await client.wait_for_instances(1)
+        stream = await client.generate({"counts": [40.0, 1.0, 30.0, 1.0]})
+        async for out in stream:
+            assert out["layers"] == 2, out
+            assert out["redundant_experts"] == 2, out
+
+    asyncio.run(asyncio.wait_for(
+        _run_e2e(
+            tmp_path, preset="tiny-moe", model="mh-eplb",
+            prompt="balance me", max_tokens=8, n_requests=2,
+            extra_args=("--eplb-redundant-experts", "2",
+                        "--decode-steps", "6", "--decode-pipeline", "2"),
+            between_requests=rebalance,
         ),
         timeout=560,
     ))
