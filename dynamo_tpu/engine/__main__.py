@@ -20,6 +20,7 @@ from dynamo_tpu.engine.weights import config_from_hf, load_params
 from dynamo_tpu.kv_router import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm import ModelDeploymentCard, ModelRuntimeConfig, register_llm
 from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.models.gemma import GemmaConfig
 from dynamo_tpu.models.gptoss import GptOssConfig
 from dynamo_tpu.models.mla import MlaConfig
 from dynamo_tpu.models.moe import MoeConfig
@@ -36,6 +37,10 @@ PRESETS = {
     "tiny-gptoss": GptOssConfig.tiny_gptoss,
     "gpt-oss-20b": GptOssConfig.gpt_oss_20b,
     "gpt-oss-120b": GptOssConfig.gpt_oss_120b,
+    "tiny-gemma2": GemmaConfig.tiny_gemma2,
+    "tiny-gemma3": GemmaConfig.tiny_gemma3,
+    "gemma2-2b": GemmaConfig.gemma2_2b,
+    "gemma3-4b": GemmaConfig.gemma3_4b,
     "tiny-mla": MlaConfig.tiny_mla,
     "tiny-mla-moe": MlaConfig.tiny_mla_moe,
     "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,

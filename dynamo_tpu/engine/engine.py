@@ -344,6 +344,7 @@ class TpuEngine:
                     or registry.is_moe(self.mcfg)
                     or registry.is_mla(self.mcfg)
                     or registry.is_gptoss(self.mcfg)
+                    or registry.is_gemma(self.mcfg)
                     or config.use_pallas):
                 raise ValueError(
                     "pp serving covers the core dense text path (no LoRA/"
@@ -402,16 +403,16 @@ class TpuEngine:
                     "guided decoding needs guided_vocab=(vocab byte forms, "
                     "eos_id) — see guided.vocab_bytes_from_tokenizer"
                 )
-        if registry.is_gptoss(self.mcfg):
+        if registry.is_gptoss(self.mcfg) or registry.is_gemma(self.mcfg):
             if config.sp > 1:
                 raise ValueError(
-                    "gpt-oss sliding-window/sink attention does not ride the"
-                    " ring (sp) path yet; use chunked prefill on sp=1"
+                    "sliding-window attention (gpt-oss/gemma) does not ride"
+                    " the ring (sp) path yet; use chunked prefill on sp=1"
                 )
             if config.use_pallas:
                 raise ValueError(
-                    "gpt-oss attention (window + sinks) runs the pure-JAX"
-                    " paths; the Pallas kernels do not support it"
+                    "windowed/softcapped attention (gpt-oss/gemma) runs the"
+                    " pure-JAX paths; the Pallas kernels do not support it"
                 )
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
@@ -623,8 +624,11 @@ class TpuEngine:
         self.lora = None
         if config.lora_max_adapters > 0:
             if (registry.is_moe(self.mcfg) or registry.is_mla(self.mcfg)
-                    or registry.is_gptoss(self.mcfg)):
-                raise ValueError("LoRA serving covers the dense family only")
+                    or registry.is_gptoss(self.mcfg)
+                    or registry.is_gemma(self.mcfg)):
+                raise ValueError(
+                    "LoRA serving covers the llama/qwen dense family only"
+                )
             from ..lora import LoraAdapterTable
 
             with self.mesh:
@@ -930,6 +934,9 @@ class TpuEngine:
                 and mcfg.num_kv_heads % meshlib.tp_size(self.mesh) == 0
                 # windowed/sink attention (gpt-oss) rides the pure-JAX ops
                 and not registry.is_gptoss(mcfg)
+                # gemma's per-layer window/softcap extras likewise ride the
+                # pure-JAX ops
+                and not registry.is_gemma(mcfg)
             )
         if use_pallas:
             from ..ops import pallas_attention as pa

@@ -28,6 +28,8 @@ def config_from_hf(path: str) -> LlamaConfig:
         return _mla_config_from_hf(hf)
     if hf.get("model_type", "") == "gpt_oss":
         return _gptoss_config_from_hf(hf)
+    if hf.get("model_type", "") in ("gemma2", "gemma3", "gemma3_text"):
+        return _gemma_config_from_hf(hf)
     head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
     return LlamaConfig(
         vocab_size=hf["vocab_size"],
@@ -77,6 +79,46 @@ def _mla_config_from_hf(hf: dict):
         rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
         max_position=hf.get("max_position_embeddings", 8192),
         tie_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+
+def _gemma_config_from_hf(hf: dict):
+    """Gemma 2 / Gemma 3 config.json -> GemmaConfig (models/gemma.py).
+    Multimodal gemma3 nests the language model under text_config."""
+    from ..models.gemma import GemmaConfig
+
+    mt = hf.get("model_type", "")
+    if mt == "gemma3" and "text_config" in hf:
+        hf = hf["text_config"]
+        mt = hf.get("model_type", "gemma3_text")
+    is3 = mt in ("gemma3", "gemma3_text")
+    lt = hf.get("layer_types") or ()
+    layer_types = tuple(
+        "sliding" if t == "sliding_attention" else "full" for t in lt
+    )
+    rope_scaling = hf.get("rope_scaling") or {}
+    return GemmaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim")
+        or hf["hidden_size"] // hf["num_attention_heads"],
+        intermediate_size=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        max_position=hf.get("max_position_embeddings", 8192),
+        tie_embeddings=hf.get("tie_word_embeddings", True),
+        qk_norm=is3,
+        query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar", 256)),
+        sliding_window=hf.get("sliding_window") or 4096,
+        layer_types=layer_types,
+        sliding_pattern=hf.get("sliding_window_pattern", 6 if is3 else 2),
+        attn_logit_softcap=hf.get("attn_logit_softcapping"),
+        final_logit_softcap=hf.get("final_logit_softcapping"),
+        rope_local_theta=hf.get("rope_local_base_freq") if is3 else None,
+        rope_scaling_factor=float(rope_scaling.get("factor", 1.0)),
     )
 
 
@@ -139,6 +181,10 @@ def load_params(path: str, cfg: Optional[LlamaConfig] = None) -> Dict[str, Any]:
         return _load_params_mla(path, cfg)
     if isinstance(cfg, GptOssConfig):
         return _load_params_gptoss(path, cfg)
+    from ..models.gemma import GemmaConfig
+
+    if isinstance(cfg, GemmaConfig):
+        return _load_params_gemma(path, cfg)
     layers: list = [dict() for _ in range(cfg.num_layers)]
     params: Dict[str, Any] = {"layers": layers}
     dt = cfg.dtype
@@ -203,6 +249,68 @@ def _deinterleave_rope_rows(w: np.ndarray, nope: int, rope: int, heads: int) -> 
     perm = np.concatenate([np.arange(0, rope, 2), np.arange(1, rope, 2)])
     w = np.concatenate([w[:, :nope, :], rot[:, perm, :]], axis=1)
     return w.reshape(out, inner)
+
+
+def _load_params_gemma(path: str, cfg) -> Dict[str, Any]:
+    """Map HF Gemma 2/3 tensors onto the models/gemma.py pytree (sandwich
+    norms get their own names; multimodal gemma3 checkpoints prefix the
+    text stack with language_model., stripped here — the vision tower is
+    not loaded)."""
+    layers: list = [dict() for _ in range(cfg.num_layers)]
+    params: Dict[str, Any] = {"layers": layers}
+    dt = cfg.dtype
+
+    def put(arr: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(arr, dt)
+
+    mapping = {
+        "input_layernorm.weight": ("attn_norm", False),
+        "post_attention_layernorm.weight": ("post_attn_norm", False),
+        "pre_feedforward_layernorm.weight": ("pre_mlp_norm", False),
+        "post_feedforward_layernorm.weight": ("post_mlp_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "self_attn.q_norm.weight": ("q_norm", False),
+        "self_attn.k_norm.weight": ("k_norm", False),
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+    }
+    for name, w in _open_safetensors(path):
+        if name.startswith("language_model."):
+            name = name[len("language_model."):]
+        if name == "model.embed_tokens.weight":
+            params["embed"] = put(w)
+        elif name == "model.norm.weight":
+            params["final_norm"] = put(w)
+        elif name == "lm_head.weight":
+            # untied finetunes: released gemma checkpoints tie, but a
+            # finetune with tie_word_embeddings=false must not silently
+            # fall back to embed.T (gemma.lm_logits prefers lm_head)
+            params["lm_head"] = put(w.T)
+        elif name.startswith("model.layers."):
+            parts = name.split(".")
+            li = int(parts[2])
+            rest = ".".join(parts[3:])
+            if rest in mapping:
+                ours, transpose = mapping[rest]
+                layers[li][ours] = put(w.T if transpose else w)
+            else:
+                log.debug("ignoring unmapped tensor %s", name)
+        else:
+            log.debug("ignoring unmapped tensor %s", name)
+    if not cfg.tie_embeddings and "lm_head" not in params:
+        raise ValueError(
+            f"checkpoint at {path} has tie_word_embeddings=false but no "
+            "lm_head.weight"
+        )
+    missing = [i for i, lp in enumerate(layers) if "wq" not in lp]
+    if missing:
+        raise ValueError(f"checkpoint at {path} missing layers {missing[:4]}...")
+    log.info("loaded %d gemma layers from %s", cfg.num_layers, path)
+    return params
 
 
 def _load_params_mla(path: str, cfg) -> Dict[str, Any]:

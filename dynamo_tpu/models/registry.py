@@ -12,7 +12,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import AXIS_TP
-from . import gptoss, llama, mla, moe
+from . import gemma, gptoss, llama, mla, moe
 
 
 def is_moe(cfg) -> bool:
@@ -27,11 +27,17 @@ def is_gptoss(cfg) -> bool:
     return isinstance(cfg, gptoss.GptOssConfig)
 
 
+def is_gemma(cfg) -> bool:
+    return isinstance(cfg, gemma.GemmaConfig)
+
+
 def family(cfg):
     if is_mla(cfg):
         return mla
     if is_gptoss(cfg):
         return gptoss
+    if is_gemma(cfg):
+        return gemma
     return moe if is_moe(cfg) else llama
 
 
@@ -120,6 +126,10 @@ def forward_fn(cfg, mesh=None):
             return fn(ep, x, routed)
 
         return partial(mla.forward, expert_fn=mla_expert_fn)
+    if is_gemma(cfg):
+        # dense family: megatron TP rides GSPMD like llama; sliding-window
+        # layers use the same paged ``window`` path as gpt-oss
+        return gemma.forward
     if not is_moe(cfg):
         return llama.forward
     # the gather path materializes [T, H, I] per-token weight copies: a win

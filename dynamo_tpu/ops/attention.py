@@ -24,6 +24,15 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _softcap(scores: jax.Array, cap: Optional[float]) -> jax.Array:
+    """Gemma-2 style attention-logit softcapping: cap * tanh(scores/cap),
+    applied post-scale and pre-mask (matches the HF reference ordering).
+    None = untouched."""
+    if cap is None:
+        return scores
+    return jnp.tanh(scores / cap) * cap
+
+
 def _gqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
     """q [S,h,d] x k [T,kvh,d] -> scores [S,h,T] with GQA head grouping."""
     S, h, d = q.shape
@@ -59,6 +68,7 @@ def causal_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     window: Optional[int] = None,
     sinks: Optional[jax.Array] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Plain causal self-attention for a single contiguous sequence.
 
@@ -68,7 +78,7 @@ def causal_attention(
     (gpt-oss) folded into the softmax denominator."""
     S = q.shape[0]
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    scores = _gqa_scores(q, k) * scale
+    scores = _softcap(_gqa_scores(q, k) * scale, softcap)
     qi, kj = jnp.arange(S)[:, None], jnp.arange(S)[None, :]
     causal = kj <= qi
     if window is not None:
@@ -89,6 +99,7 @@ def extend_attention(
     total_len: jax.Array,    # scalar: valid length of the context
     window: Optional[int] = None,
     sinks: Optional[jax.Array] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Prefix-extend attention: new tokens attend causally over (cached prefix
     + themselves). Used for prefill with device-side prefix-cache reuse and
@@ -98,7 +109,7 @@ def extend_attention(
     based)."""
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     T = k_ctx.shape[0]
-    scores = _gqa_scores(q, k_ctx) * scale  # [S,h,T]
+    scores = _softcap(_gqa_scores(q, k_ctx) * scale, softcap)  # [S,h,T]
     key_pos = jnp.arange(T)
     valid = key_pos[None, :] < jnp.minimum(q_positions[:, None] + 1, total_len)
     if window is not None:
@@ -135,6 +146,7 @@ def paged_decode_attention(
     seq_lens: jax.Array,      # [B] int32 context length incl. current token
     window: Optional[int] = None,
     sinks: Optional[jax.Array] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Paged decode attention, batched: each query attends over its own pages.
 
@@ -169,9 +181,9 @@ def paged_decode_attention(
         kvh = k.shape[1]
         g = h // kvh
         qg = qb.reshape(kvh, g, d)
-        scores = jnp.einsum(
+        scores = _softcap(jnp.einsum(
             "kgd,tkd->kgt", qg.astype(jnp.float32), k.astype(jnp.float32)
-        ) * scale                                       # [kvh, g, T]
+        ) * scale, softcap)                             # [kvh, g, T]
         scores = jnp.where(valid[None, None, :], scores, NEG_INF)
         if sinks is None:
             weights = jax.nn.softmax(scores, axis=-1)
@@ -228,6 +240,7 @@ def paged_extend_attention(
     total_lens: jax.Array,    # [B] context length incl. the S_new candidates
     window: Optional[int] = None,
     sinks: Optional[jax.Array] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Batched paged prefix-extend: every row attends its S_new new tokens
     causally over its OWN pages (which must already contain the new tokens'
@@ -254,7 +267,8 @@ def paged_extend_attention(
         if window is None:
             k_ctx, v_ctx = gather_kv(k_cache, v_cache, table)
             return extend_attention(
-                qb, k_ctx, v_ctx, positions, tlen, sinks=sinks
+                qb, k_ctx, v_ctx, positions, tlen, sinks=sinks,
+                softcap=softcap,
             )
         nblocks = jnp.maximum((tlen + bs - 1) // bs, 1)
         first = jnp.maximum(nblocks - wb, 0)
@@ -266,7 +280,7 @@ def paged_extend_attention(
         off = first * bs
         return extend_attention(
             qb, k_ctx, v_ctx, positions - off, tlen - off,
-            window=window, sinks=sinks,
+            window=window, sinks=sinks, softcap=softcap,
         )
 
     return jax.vmap(one)(q, block_tables, start_pos, total_lens)

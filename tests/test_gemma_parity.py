@@ -1,0 +1,120 @@
+"""Gold-standard Gemma 2 / Gemma 3 parity: our loader + forward vs HF.
+
+Tiny random transformers Gemma2ForCausalLM / Gemma3ForCausalLM models
+saved as real HF checkpoints, loaded through engine/weights.py, logits
+compared token-for-token. Pins: the (1+weight) RMSNorm convention, the
+sqrt(hidden)-in-model-dtype embed normalizer, sandwich norms, the
+query_pre_attn_scalar attention scale, interleaved sliding/full layers,
+gemma2's attention+final logit softcapping, gemma3's per-head q/k norms
+and dual-rope (local theta on sliding layers, scaled global theta on full
+layers), and the GeGLU MLP.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from dynamo_tpu.engine import weights as W  # noqa: E402
+from dynamo_tpu.models import gemma  # noqa: E402
+from dynamo_tpu.ops import attention as att  # noqa: E402
+
+TOKENS = np.array([5, 99, 23, 77, 1, 42, 17, 63, 8, 120, 3, 60], np.int64)
+
+
+def _ours_logits(ckpt):
+    cfg = W.config_from_hf(ckpt)
+    assert isinstance(cfg, gemma.GemmaConfig)
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = W.load_params(ckpt, cfg)
+    toks = jnp.asarray(TOKENS, jnp.int32)
+    pos = jnp.arange(len(TOKENS), dtype=jnp.int32)
+    hidden = gemma.forward(
+        params, cfg, toks, pos,
+        lambda q, k, v, i, **kw: att.causal_attention(q, k, v, **kw),
+    )
+    return np.asarray(gemma.lm_logits(params, cfg, hidden)), cfg
+
+
+def test_logits_match_hf_gemma2(tmp_path):
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    hf_cfg = Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=24.0, sliding_window=8,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=True, attn_implementation="eager",
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(0)
+    model = Gemma2ForCausalLM(hf_cfg).eval().to(torch.float32)
+    ckpt = str(tmp_path / "g2")
+    model.save_pretrained(ckpt, safe_serialization=True)
+
+    ours, cfg = _ours_logits(ckpt)
+    # gemma2 alternates sliding/full (layer_types from the config)
+    assert cfg.window_for_layer(0) == 8 and cfg.window_for_layer(1) is None
+    assert cfg.attn_logit_softcap == 50.0 and cfg.final_logit_softcap == 30.0
+    with torch.no_grad():
+        hf = model(torch.tensor(TOKENS)[None]).logits[0].numpy()
+    np.testing.assert_allclose(ours, hf, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_match_hf_gemma2_untied(tmp_path):
+    """tie_word_embeddings=false finetunes carry a real lm_head; dropping
+    it and silently falling back to embed.T would corrupt every logit."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    hf_cfg = Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=24.0, sliding_window=8,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        attn_implementation="eager", hidden_activation="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(2)
+    model = Gemma2ForCausalLM(hf_cfg).eval().to(torch.float32)
+    ckpt = str(tmp_path / "g2u")
+    model.save_pretrained(ckpt, safe_serialization=True)
+
+    ours, cfg = _ours_logits(ckpt)
+    assert not cfg.tie_embeddings
+    with torch.no_grad():
+        hf = model(torch.tensor(TOKENS)[None]).logits[0].numpy()
+    np.testing.assert_allclose(ours, hf, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_match_hf_gemma3(tmp_path):
+    from transformers import Gemma3ForCausalLM, Gemma3TextConfig
+
+    hf_cfg = Gemma3TextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=24.0, sliding_window=8,
+        sliding_window_pattern=3, max_position_embeddings=256,
+        rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        tie_word_embeddings=True, attn_implementation="eager",
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(1)
+    model = Gemma3ForCausalLM(hf_cfg).eval().to(torch.float32)
+    ckpt = str(tmp_path / "g3")
+    model.save_pretrained(ckpt, safe_serialization=True)
+
+    ours, cfg = _ours_logits(ckpt)
+    assert cfg.qk_norm and cfg.rope_local_theta == 10_000.0
+    assert cfg.rope_scaling_factor == 8.0
+    # 2 sliding then 1 full, repeating
+    assert cfg.window_for_layer(0) == 8 and cfg.window_for_layer(2) is None
+    with torch.no_grad():
+        hf = model(torch.tensor(TOKENS)[None]).logits[0].numpy()
+    np.testing.assert_allclose(ours, hf, rtol=2e-4, atol=2e-4)
