@@ -319,6 +319,95 @@ async def test_guided_rejections():
         e2.stop()
 
 
+def test_hf_bytelevel_bpe_vocab_and_guided_generation(tmp_path):
+    """Real serving uses HF tokenizers, not the byte tokenizer: pin the
+    GPT-2 byte-level alphabet decoding in vocab_bytes_from_tokenizer (a
+    wrong byte form would silently corrupt every grammar product) and run
+    a guided generation over the BPE vocab end-to-end."""
+    import json as _json
+
+    pytest.importorskip("tokenizers")
+    pytest.importorskip("transformers")
+    from tokenizers import Tokenizer, decoders, models as tmodels
+    from tokenizers import pre_tokenizers, trainers
+
+    tok = Tokenizer(tmodels.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400, special_tokens=["<eos>", "<pad>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(
+        ['{"name": "bob", "age": 3}', "hello world", "cat car cats",
+         "0123456789 true false null"],
+        trainer,
+    )
+    d = str(tmp_path / "bpe")
+    import os
+
+    os.makedirs(d)
+    tok.save(os.path.join(d, "tokenizer.json"))
+    with open(os.path.join(d, "tokenizer_config.json"), "w") as f:
+        _json.dump(
+            {"tokenizer_class": "PreTrainedTokenizerFast",
+             "eos_token": "<eos>", "pad_token": "<pad>"},
+            f,
+        )
+
+    from dynamo_tpu.guided import vocab_bytes_from_tokenizer
+    from dynamo_tpu.llm.tokenizer import HFTokenizer
+
+    hft = HFTokenizer(d)
+    vocab, eos = vocab_bytes_from_tokenizer(hft)
+    assert eos == hft.eos_token_id
+    assert vocab[eos] is None  # special: rejected except EOS-at-accept
+    # INVARIANT: concatenating token byte forms reproduces the input bytes
+    for text in ['{"a": 12}', "cat cars", "true,false"]:
+        ids = hft.encode(text)
+        got = b"".join(vocab[i] for i in ids)
+        assert got == text.encode("utf-8"), (text, got)
+
+    # guided generation over the BPE vocab: pad the class map to the
+    # engine's model vocab (bigger than the tokenizer's)
+    V_model = 512
+    assert len(vocab) <= V_model
+    import dataclasses as _dc
+
+    cfg = _dc.replace(MODEL, vocab_size=V_model)
+    e = TpuEngine(
+        TpuEngineConfig(
+            model=cfg, num_blocks=128, block_size=4, max_batch_size=2,
+            max_context=256, prefill_buckets=(16, 32), decode_steps=6,
+            decode_pipeline=2, guided_max_states=256, guided_max_classes=128,
+        ),
+        guided_vocab=(vocab, eos),
+        mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+    )
+
+    async def go():
+        req = PreprocessedRequest(
+            request_id="bpe", model="m", token_ids=hft.encode("pick: "),
+            stop=StopConditions(max_tokens=24, stop_token_ids=[eos]),
+            sampling=SamplingOptions(
+                temperature=0.0,
+                guided={"kind": "choice", "value": ["cat", "cats", "car"]},
+            ),
+        )
+        toks = []
+        async for out in e.generate(req, Context()):
+            toks.extend(out.token_ids)
+        return toks
+
+    try:
+        toks = asyncio.run(go())
+    finally:
+        e.stop()
+    text = hft.decode(toks)
+    assert text in {"cat", "cats", "car"}, (toks, text)
+
+
+
 def test_preprocessor_guided_mapping():
     """Request-surface mapping (reference precedence, common_ext.rs:175):
     guided_json > tool_choice-derived (soft) > guided_regex/choice >
