@@ -34,7 +34,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..planner.connectors import target_key
 from ..runtime.discovery.store import KVStore
+from ..runtime.faults import FAULTS
 from ..runtime.logging import get_logger
+from ..runtime.resilience import RetryPolicy
 from .render import GraphSpec, ServiceSpec
 
 log = get_logger("deploy.controller")
@@ -86,6 +88,17 @@ class _Proc:
     restarts: int = 0
 
 
+@dataclasses.dataclass
+class _CrashState:
+    """Per-service crash-loop bookkeeping, kept in ONE record so the
+    quiet-horizon reset cannot desynchronize the backoff chain."""
+
+    streak: int = 0
+    last_delay: Optional[float] = None   # jitter chain carry
+    restart_after: float = 0.0
+    last_crash_at: float = 0.0
+
+
 class GraphController:
     def __init__(
         self,
@@ -111,7 +124,17 @@ class GraphController:
         # past their grace deadline and reaped (wait) so nothing zombies
         self._stopping: List[tuple] = []  # (_Proc, kill_deadline)
         self._stop_grace_s = 10.0
-        self._last_crash: Dict[str, float] = {}
+        # crash-looping services back off through the shared policy (scope
+        # controller.restart; DTPU_RETRY_CONTROLLER_RESTART): consecutive
+        # crashes stretch the delay exponentially (decorrelated jitter, the
+        # k8s CrashLoopBackOff analog) instead of the old fixed interval.
+        # restart_backoff_s stays the base so existing configs keep meaning.
+        self._restart_policy = RetryPolicy.from_env(
+            "controller.restart",
+            base_delay_s=restart_backoff_s,
+            max_delay_s=max(30.0, restart_backoff_s),
+        )
+        self._crash: Dict[str, _CrashState] = {}
         self._spec_mtime = (
             os.path.getmtime(spec_path) if spec_path else 0.0
         )
@@ -184,17 +207,35 @@ class GraphController:
                 else:
                     rc = p.popen.returncode
                     if rc != 0:
-                        log.warning(
-                            "%s worker pid %d crashed rc=%s",
-                            svc.name, p.popen.pid, rc,
+                        cs = self._crash.setdefault(svc.name, _CrashState())
+                        cs.streak += 1
+                        cs.last_delay = self._restart_policy.next_delay(
+                            cs.last_delay
                         )
-                        self._last_crash[svc.name] = time.time()
+                        log.warning(
+                            "%s worker pid %d crashed rc=%s (streak %d, "
+                            "restart in %.1fs)",
+                            svc.name, p.popen.pid, rc, cs.streak,
+                            cs.last_delay,
+                        )
+                        cs.restart_after = time.time() + cs.last_delay
+                        cs.last_crash_at = time.time()
                         self.restarts_total += 1
             procs[:] = alive
-            backoff_until = (
-                self._last_crash.get(svc.name, 0.0) + self.restart_backoff_s
-            )
+            # the crash loop resets only after a genuinely quiet stretch —
+            # long enough that a crash-after-warmup cycle (crash period >
+            # the backoff itself) cannot re-zero the streak every lap and
+            # defeat the exponential escalation
+            quiet_horizon = max(30.0, 4.0 * self.restart_backoff_s)
+            cs = self._crash.get(svc.name)
+            if alive and cs is not None and (
+                time.time() - cs.last_crash_at > quiet_horizon
+            ):
+                del self._crash[svc.name]
+                cs = None
+            backoff_until = cs.restart_after if cs is not None else 0.0
             while len(procs) < desired and time.time() >= backoff_until:
+                FAULTS.inject("controller.spawn")
                 cmd = self.runner(svc, len(procs))
                 log.info("spawn %s[%d]: %s", svc.name, len(procs), " ".join(cmd))
                 procs.append(_Proc(

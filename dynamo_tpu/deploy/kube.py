@@ -330,8 +330,16 @@ class KubeGraphController:
         async def watch(plural: str) -> None:
             """Event-triggered reconcile: any change to our workloads pokes
             the loop immediately (kube watch streams end periodically; just
-            re-watch — the reconcile itself is level-triggered)."""
+            re-watch — the reconcile itself is level-triggered). API hiccups
+            back off through the shared policy (scope kube.watch): jittered,
+            growing with consecutive failures, reset on a delivering stream."""
+            from ..runtime.resilience import retry_policy
+
+            policy = retry_policy(
+                "kube.watch", max_attempts=2, base_delay_s=0.5, max_delay_s=10.0,
+            )
             selector = f"app.kubernetes.io/part-of={self.graph.name}"
+            prev_delay = None
             try:
                 while True:
                     try:
@@ -339,10 +347,12 @@ class KubeGraphController:
                             "apps/v1", self.graph.namespace, plural, selector
                         ):
                             self._poke.set()
+                            prev_delay = None
                     except asyncio.CancelledError:
                         raise
                     except Exception:
-                        await asyncio.sleep(1.0)  # API hiccup: back off, retry
+                        prev_delay = policy.next_delay(prev_delay)
+                        await asyncio.sleep(prev_delay)
             except asyncio.CancelledError:
                 pass
 

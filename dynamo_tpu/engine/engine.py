@@ -49,6 +49,12 @@ from ..models.vision import IMAGE_TOKEN_ID
 from ..ops import attention as att
 from ..parallel import mesh as meshlib
 from ..runtime.engine import Context
+from ..runtime.errors import (
+    ContextLengthError,
+    GuidedRejectedError,
+    InvalidRequestError,
+)
+from ..runtime.faults import FAULTS
 from ..runtime.tasks import spawn_bg
 from ..runtime.logging import get_logger
 from ..tokens import TokenBlockSequence
@@ -338,18 +344,17 @@ class TpuEngine:
         if config.pp > 1:
             from ..parallel import pp_serving
 
+            # family gate before any param placement, shared with
+            # pp_serving._check_cfg so the operator-facing message lives
+            # in one place
+            registry.check_pp_supported(self.mcfg)
             if (config.lora_max_adapters or config.vision is not None
                     or config.sp > 1 or kvbm is not None
                     or config.logits_processors
-                    or registry.is_moe(self.mcfg)
-                    or registry.is_mla(self.mcfg)
-                    or registry.is_gptoss(self.mcfg)
-                    or registry.is_gemma(self.mcfg)
                     or config.use_pallas):
                 raise ValueError(
                     "pp serving covers the core dense text path (no LoRA/"
-                    "vision/sp/kvbm/logits-processors/MoE/MLA/gpt-oss/"
-                    "pallas yet)"
+                    "vision/sp/kvbm/logits-processors/pallas yet)"
                 )
             if mesh is None:
                 mesh = pp_serving.make_pp_mesh(pp=config.pp, tp=config.tp)
@@ -1315,7 +1320,11 @@ class TpuEngine:
                 use_pallas
                 and dcfg.head_dim % 128 == 0
                 and dcfg.num_kv_heads % meshlib.tp_size(self.mesh) == 0
+                # windowed/softcapped families (gpt-oss AND gemma) need the
+                # pure-JAX attention extras the Pallas decode kernel lacks —
+                # same gating the main model gets at construction time
                 and not registry.is_gptoss(dcfg)
+                and not registry.is_gemma(dcfg)
             )
             if draft_use_pallas:
                 from ..ops import pallas_attention as dpa
@@ -1807,7 +1816,7 @@ class TpuEngine:
         if n_prompt // self.cfg.block_size + 2 > self.cfg.num_blocks:
             # would wait forever in admission — no amount of eviction frees
             # enough pages for this prompt
-            raise ValueError(
+            raise ContextLengthError(
                 f"prompt {n_prompt} tokens cannot fit the KV pool "
                 f"({self.cfg.num_blocks} blocks x {self.cfg.block_size})"
             )
@@ -1816,13 +1825,13 @@ class TpuEngine:
             known = {n for n, _ in self.cfg.logits_processors}
             bad = [n for n in wanted_procs if n not in known]
             if bad:
-                raise ValueError(f"unknown logits processors {bad!r}")
+                raise InvalidRequestError(f"unknown logits processors {bad!r}")
         lora_name = req.annotations.get("lora")
         if lora_name:
             if self.lora is None:
-                raise ValueError("engine built without LoRA support")
+                raise InvalidRequestError("engine built without LoRA support")
             if self.lora.slot_of(lora_name) == 0:
-                raise ValueError(f"unknown LoRA adapter {lora_name!r}")
+                raise InvalidRequestError(f"unknown LoRA adapter {lora_name!r}")
         guided_tables = None
         if req.sampling.guided is not None:
             if not self.guided_enabled:
@@ -1830,7 +1839,7 @@ class TpuEngine:
                 # llm/preprocessor.py) degrade to unconstrained sampling;
                 # explicit guided_* options fail loudly
                 if not req.sampling.guided.get("soft"):
-                    raise ValueError(
+                    raise GuidedRejectedError(
                         "engine built without guided decoding "
                         "(guided_max_states=0)"
                     )
@@ -1881,7 +1890,7 @@ class TpuEngine:
         self._ensure_loop()
         if req.annotations.get("images"):
             if self.cfg.vision is None:
-                raise ValueError("engine built without a vision tower")
+                raise InvalidRequestError("engine built without a vision tower")
         all_tokens = list(req.token_ids) + list(req.prior_token_ids)
         st = _Seq(
             req=req,
@@ -1902,7 +1911,7 @@ class TpuEngine:
                     0, [int(t) for t in req.prior_token_ids]
                 )
             except ValueError as e:
-                raise ValueError(
+                raise GuidedRejectedError(
                     f"prior tokens violate the guided grammar: {e}"
                 ) from e
         if self.cfg.spec_draft is not None:
@@ -2236,6 +2245,10 @@ class TpuEngine:
                     self._wake.clear()
                     await self._wake.wait()
                 mark("idle")
+                # chaos drill hook: an armed engine.step fault crashes the
+                # loop through the real crash path below (error finishes,
+                # watchdog dereg, migration replay) — no-op unarmed
+                await FAULTS.ainject("engine.step")
                 self._admit_cancelled()
                 self._try_admit()
                 mark("admit")
@@ -2911,7 +2924,7 @@ class TpuEngine:
             # re-validates — caps may be config-reloaded across restarts)
             if self._g_cache.get(key) is task:
                 del self._g_cache[key]
-            raise ValueError(f"guided grammar rejected: {e}") from e
+            raise GuidedRejectedError(f"guided grammar rejected: {e}") from e
 
     def _guided_dev(self):
         """Device copies of the guided tables. The [B] active mask

@@ -51,8 +51,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..runtime.engine import Context
+from ..runtime.faults import FAULTS
 from ..runtime.logging import get_logger
-from ..runtime.request_plane.tcp import TcpClient
+from ..runtime.request_plane.tcp import NoResponders, TcpClient
+from ..runtime.resilience import RETRYABLE_DEFAULT, retry_policy
 from ..tokens import SequenceHash
 
 log = get_logger("engine.transfer")
@@ -556,6 +558,31 @@ class KvTransferClient:
         self.engine = engine
         self._tcp = tcp_client or TcpClient()
 
+    async def _fetch_item(self, address: str, req: Dict[str, Any]) -> Dict[str, Any]:
+        """One wire fetch (request + drained single-item stream), replayed
+        through the shared policy (scope transfer.pull): the protocol is
+        content-addressed and idempotent, so a dropped connection retries
+        safely; exhausted retries surface to the caller, which recomputes
+        the prefill locally instead of failing the request."""
+        async def once() -> Dict[str, Any]:
+            await FAULTS.ainject("transfer.pull")
+            stream = await self._tcp.call(address, req)
+            item: Dict[str, Any] = {}
+            async for it in stream:
+                item = it
+            return item
+
+        # NoResponders is how the tcp client reports EVERY transport loss
+        # (refused connect, reset mid-stream) — it is not a ConnectionError
+        # subclass, so it must be named retryable explicitly. The attempt
+        # timeout bounds a HUNG (not dropped) server so the decode side
+        # falls back to recompute instead of stalling the request.
+        return await retry_policy(
+            "transfer.pull", max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+            attempt_timeout_s=30.0,
+            retryable=RETRYABLE_DEFAULT + (NoResponders,),
+        ).acall(once)
+
     async def fetch_and_import(
         self, address: str, hashes: List[SequenceHash]
     ) -> int:
@@ -606,10 +633,7 @@ class KvTransferClient:
         if device_ok:
             req["device_ok"] = True
             req["device_shards"] = len(jax.local_devices())
-        stream = await self._tcp.call(address, req)
-        item: Dict[str, Any] = {}
-        async for it in stream:
-            item = it
+        item = await self._fetch_item(address, req)
         matched = item.get("matched", 0)
         if matched == 0:
             return have * alloc.block_size
@@ -619,9 +643,7 @@ class KvTransferClient:
                 return (have + got) * alloc.block_size
             # cross-process device pull failed: one retry over the wire
             req.pop("device_ok", None)
-            stream = await self._tcp.call(address, req)
-            async for it in stream:
-                item = it
+            item = await self._fetch_item(address, req)
             matched = item.get("matched", 0)
             if matched == 0 or "device" in item:
                 return have * alloc.block_size

@@ -17,12 +17,14 @@ from typing import Any, AsyncIterator, Dict, List, Optional
 import msgpack
 
 from ..kv_router import KvRouter, KvRouterConfig, WorkerWithDpRank
+from ..runtime import metrics as M
 from ..runtime.component import Client, RouterMode
 from ..runtime.discovery.store import EventType
 from ..runtime.distributed import DistributedRuntime
 from ..runtime.engine import Context
 from ..runtime.logging import get_logger
 from ..runtime.request_plane.tcp import NoResponders
+from ..runtime.resilience import OPEN, CircuitBreaker
 from .migration import Migration
 from .model_card import MDC_PREFIX, ModelDeploymentCard
 from .preprocessor import (
@@ -33,6 +35,49 @@ from .preprocessor import (
 from .protocols.common import BackendOutput, PreprocessedRequest
 
 log = get_logger("llm.discovery")
+
+
+class _RecordedStream:
+    """Wraps a worker response stream and reports the worker's outcome to
+    its circuit breaker: clean finish -> success; transport loss, an
+    ``error`` finish frame, or EOF-without-finish (the signals Migration
+    treats as worker death) -> failure. Preserves ``instance_id`` so the
+    migration operator can still attribute failures."""
+
+    def __init__(self, stream, record):
+        self._stream = stream
+        self._record = record
+        self._done = False
+        self.instance_id = getattr(stream, "instance_id", None)
+
+    def _close(self, ok: bool) -> None:
+        if not self._done:
+            self._done = True
+            self._record(ok)
+
+    def __aiter__(self) -> "_RecordedStream":
+        return self
+
+    async def __anext__(self):
+        try:
+            item = await self._stream.__anext__()
+        except StopAsyncIteration:
+            # EOF without a finish frame = worker died mid-request
+            self._close(False)
+            raise
+        except (NoResponders, ConnectionError):
+            self._close(False)
+            raise
+        fr = (
+            item.get("finish_reason") if isinstance(item, dict)
+            else getattr(item, "finish_reason", None)
+        )
+        if fr is not None:
+            # record AT the finish frame: consumers (Migration) return from
+            # their async-for right here, so the iterator is never exhausted
+            # on the success path
+            self._close(fr != "error")
+        return item
 
 
 class ModelPipeline:
@@ -55,8 +100,51 @@ class ModelPipeline:
         self.migration = Migration(self._send, card.migration_limit)
         self.instance_count = 0
         self._known_worker_ids: set = set()
+        # per-worker circuit breakers (scope DTPU_CB_WORKER): a flapping
+        # worker that keeps dropping streams trips its circuit and routing
+        # steers around it (retry-then-migrate) until the reset probe passes.
+        # Their metrics go to a detached scope, NOT the runtime registry:
+        # worker ids are ephemeral and one series per id ever seen would
+        # grow /metrics without bound under autoscaling churn (the per-model
+        # frontend breaker stays on /metrics).
+        self._worker_breakers: Dict[int, CircuitBreaker] = {}
+        self._worker_cb_metrics = M.MetricsScope()
+        self._rr = 0  # non-KV fallback round-robin over non-shunned workers
         # disaggregation: set when a prefill pool is registered for this model
         self.prefill_router = None
+
+    def _worker_cb(self, iid: int) -> CircuitBreaker:
+        cb = self._worker_breakers.get(iid)
+        if cb is None:
+            cb = self._worker_breakers[iid] = CircuitBreaker.from_env(
+                "worker", name=f"worker.{iid:016x}",
+                failure_threshold=3, failure_rate=0.5, window_s=10.0,
+                reset_timeout_s=2.0, metrics=self._worker_cb_metrics,
+            )
+        return cb
+
+    def _tripped(self, excluded: List[int]) -> List[int]:
+        """Workers to steer around: open circuits, unless that would leave
+        no candidate at all (then trying a tripped worker beats failing)."""
+        assert self.client is not None
+        # drop breakers for departed workers here (not only on the KV path)
+        # so long-lived non-KV frontends under churn don't accumulate them
+        for iid in list(self._worker_breakers):
+            if iid not in self.client.instances:
+                self._worker_breakers.pop(iid, None)
+        # a worker with no breaker yet has never recorded an outcome —
+        # treat as closed without constructing one (healthy hot path)
+        avoid = [
+            iid for iid in self.client.instances
+            if iid not in excluded
+            and (cb := self._worker_breakers.get(iid)) is not None
+            and cb.state == OPEN
+        ]
+        eligible = [
+            iid for iid in self.client.instances
+            if iid not in excluded and iid not in avoid
+        ]
+        return avoid if eligible else []
 
     async def start(self) -> "ModelPipeline":
         endpoint = (
@@ -104,6 +192,7 @@ class ModelPipeline:
         gone = self._known_worker_ids - live
         for iid in gone:
             self.kv_router.remove_worker_id(iid)
+            self._worker_breakers.pop(iid, None)
         self._known_worker_ids = set(live)
 
     async def _send(
@@ -111,13 +200,16 @@ class ModelPipeline:
     ) -> AsyncIterator[Any]:
         assert self.client is not None
         instance_id: Optional[int] = None
+        # per-request exclusions (migration) plus cross-request tripped
+        # circuits: both are steered around the same way
+        shun = list(excluded) + self._tripped(excluded)
         # pooled forwards don't touch KV pages: routing them through the KV
         # scheduler would charge phantom blocks to a worker (and pollute the
         # approx prefix view) that complete() on the embed path never frees
         use_kv = self.kv_router is not None and req.annotations.get("op") != "embed"
         if use_kv:
             self._prune_dead_workers()
-            cands = self._candidates(excluded)
+            cands = self._candidates(shun)
             if not cands:
                 # every instance is excluded (dead mid-request): fail this
                 # attempt rather than round-robin back onto a dead worker
@@ -131,18 +223,36 @@ class ModelPipeline:
             )
             req.annotations[ANNOTATION_WORKER_ID] = instance_id
             req.annotations["dp_rank"] = decision.worker.dp_rank
-        elif excluded:
-            # non-KV mode: steer away from excluded (dead) instances
-            alive = [i for i in self.client.instance_ids() if i not in excluded]
+        elif shun:
+            # non-KV mode: steer away from excluded (dead) + tripped
+            # instances, round-robining over the survivors — pinning to
+            # alive[0] would dump the tripped worker's whole share onto one
+            # neighbor for the open window
+            alive = [i for i in self.client.instance_ids() if i not in shun]
             if not alive:
                 raise NoResponders(f"no non-excluded instances for {self.card.name}")
-            instance_id = alive[0]
+            instance_id = alive[self._rr % len(alive)]
+            self._rr += 1
         try:
-            return await self.client.generate(req.to_obj(), context, instance_id)
+            stream = await self.client.generate(req.to_obj(), context, instance_id)
         except (NoResponders, ConnectionError) as e:
             if instance_id is not None and getattr(e, "instance_id", None) is None:
                 e.instance_id = instance_id  # type: ignore[attr-defined]
+            iid = getattr(e, "instance_id", None)
+            if iid is not None:
+                cb = self._worker_cb(iid)
+                # reserve the half-open probe slot (no-op when closed) so
+                # this outcome counts as the probe result; the breaker
+                # ignores unreserved results in half-open as stale
+                cb.allow()
+                cb.record(False)
             raise
+        iid = getattr(stream, "instance_id", None)
+        if iid is None:
+            return stream
+        cb = self._worker_cb(iid)
+        cb.allow()  # see above: this stream IS the half-open probe
+        return _RecordedStream(stream, cb.record)
 
     async def generate_tokens(
         self, req: PreprocessedRequest, context: Context

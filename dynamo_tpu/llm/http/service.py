@@ -21,8 +21,10 @@ from aiohttp.client_exceptions import ClientConnectionResetError
 
 from ...runtime import metrics as M
 from ...runtime.engine import Context
+from ...runtime.errors import InvalidRequestError, http_status_for
 from ...runtime.logging import get_logger
 from ...runtime.request_plane.tcp import NoResponders
+from ...runtime.resilience import CircuitBreaker
 from ...runtime.tracing import Tracer, get_tracer
 from ..audit import AuditBus
 from ...parsers import get_reasoning_parser, get_tool_parser
@@ -75,28 +77,30 @@ def _safe_parser(factory, name):
 
 def _stream_fail_status(e: Exception) -> tuple:
     """(status, err_type) for a request that died before/while streaming.
-    Engine-side guided rejections — grammar caps/vocab checks only the
-    worker can make, or an engine built without guidance — are client
-    errors, not 500s."""
-    msg = str(e)
-    if "guided grammar" in msg or "without guided decoding" in msg:
-        return 400, "invalid_request_error"
-    return 500, "internal_error"
+    Classification is by TYPE (runtime/errors.py taxonomy) locally and by
+    the typed ``code`` the request plane propagates for worker-side errors
+    — never by substring-matching exception messages."""
+    return http_status_for(e)
 
 
 def _preprocess_err_type(e: Exception) -> str:
-    """OpenAI-style error type for a preprocess-stage ValueError: length
-    errors keep the code clients switch on; everything else (bad guided
-    grammar, unsupported modality, ...) is a generic invalid request."""
-    msg = str(e)
-    if "context" in msg or "prompt length" in msg:
-        return "context_length_exceeded"
+    """OpenAI-style error type for a preprocess-stage failure: typed errors
+    (ContextLengthError, GuidedRejectedError, ...) carry their own wire
+    type; a plain ValueError is a generic invalid request."""
+    if isinstance(e, InvalidRequestError):
+        return e.err_type
     return "invalid_request_error"
 
 
-def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
+def _error(
+    status: int,
+    message: str,
+    err_type: str = "invalid_request_error",
+    headers: Optional[dict] = None,
+) -> web.Response:
     return web.json_response(
-        {"error": {"message": message, "type": err_type, "code": status}}, status=status
+        {"error": {"message": message, "type": err_type, "code": status}},
+        status=status, headers=headers,
     )
 
 
@@ -199,8 +203,37 @@ class HttpService:
         # optional llm.request_template.RequestTemplate: fills model /
         # temperature / max_completion_tokens on requests that omit them
         self.request_template = request_template
+        # per-model circuit breaker over worker availability: repeated
+        # no-responders (migration exhausted) trip it, and while open the
+        # frontend sheds load with busy-503 + Retry-After instead of
+        # burning a full migration cycle per doomed request. Tunable via
+        # DTPU_CB_FRONTEND (runtime/resilience.py); state/transition
+        # metrics ride this service's /metrics registry.
+        self._model_breakers: dict = {}
         self._runner: Optional[web.AppRunner] = None
         self.app = self._build_app()
+
+    def _breaker(self, model: str) -> CircuitBreaker:
+        cb = self._model_breakers.get(model)
+        if cb is None:
+            cb = self._model_breakers[model] = CircuitBreaker.from_env(
+                "frontend", name=f"frontend.{model}",
+                failure_threshold=5, failure_rate=0.5, window_s=10.0,
+                reset_timeout_s=2.0, metrics=self.metrics,
+            )
+        return cb
+
+    def _check_circuit(self, model: str) -> Optional[web.Response]:
+        """Busy-503 with Retry-After while the model's circuit is open."""
+        cb = self._breaker(model)
+        if cb.allow():
+            return None
+        retry_after = max(1, int(cb.retry_after_s() + 0.999))
+        self._requests.inc(model=model, status="503")
+        return _error(
+            503, f"no workers responding for {model!r} (circuit open)",
+            "service_unavailable", headers={"Retry-After": str(retry_after)},
+        )
 
     def _build_app(self) -> web.Application:
         app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -376,18 +409,25 @@ class HttpService:
                 "op": "image", "prompt": prompt, "n": n, "size": size,
             },
         )
+        circuit = self._check_circuit(model)
+        if circuit is not None:
+            return circuit
+        cb = self._breaker(model)
         ctx = Context(preq.request_id)
         self.inflight += 1
         self._inflight_g.set(self.inflight)
         data = []
+        ok = True
         try:
             async for out in pipe.generate_tokens(preq, ctx):
                 for img in (out.annotations or {}).get("images", []):
                     data.append({"b64_json": img})
         except NoResponders:
+            ok = False
             return await self._fail(None, 503, "no workers available",
                                     "service_unavailable")
         finally:
+            cb.record(ok)
             ctx.stop_generating()
             self.inflight -= 1
             self._inflight_g.set(self.inflight)
@@ -501,6 +541,10 @@ class HttpService:
         hold per-choice state). ``aggregator`` receives the list of streams.
         ``usage_chunk_factory`` builds the single trailing usage chunk for
         multi-choice streaming (single-choice generators emit their own)."""
+        circuit = self._check_circuit(model)
+        if circuit is not None:
+            return circuit
+        cb = self._breaker(model)
         ctxs = [Context(p.request_id) for p in preqs]
         self.inflight += 1
         self._inflight_g.set(self.inflight)
@@ -590,6 +634,9 @@ class HttpService:
         finally:
             self.inflight -= 1
             self._inflight_g.set(self.inflight)
+            # only worker loss (503) counts against the circuit; application
+            # errors mean the workers ARE responding
+            cb.record(status != "503")
             self._requests.inc(model=model, status=status)
             self._input_tokens.inc(prompt_tokens, model=model)
             self._output_tokens.inc(completion_tokens, model=model)
@@ -733,6 +780,10 @@ class HttpService:
                 preqs.append(preq)
         except ValueError as e:
             return _error(400, str(e), _preprocess_err_type(e))
+        circuit = self._check_circuit(model)
+        if circuit is not None:
+            return circuit
+        cb = self._breaker(model)
         self.inflight += 1
         self._inflight_g.set(self.inflight)
         status = "200"
@@ -799,6 +850,7 @@ class HttpService:
         finally:
             self.inflight -= 1
             self._inflight_g.set(self.inflight)
+            cb.record(status != "503")
             self._requests.inc(model=model, status=status)
             self._input_tokens.inc(prompt_tokens, model=model)
 
@@ -823,6 +875,10 @@ class HttpService:
             preq = pipeline.preprocessor.preprocess_chat(chat)
         except ValueError as e:
             return _error(400, str(e), _preprocess_err_type(e))
+        circuit = self._check_circuit(rreq.model)
+        if circuit is not None:
+            return circuit
+        cb = self._breaker(rreq.model)
         rid = preq.request_id.replace("chatcmpl-", "resp_")
         ctx = Context(preq.request_id)
         created = int(time.time())
@@ -918,6 +974,7 @@ class HttpService:
         finally:
             self.inflight -= 1
             self._inflight_g.set(self.inflight)
+            cb.record(status != "503")
             self._requests.inc(model=rreq.model, status=status)
             self._input_tokens.inc(prompt_tokens, model=rreq.model)
             self._output_tokens.inc(completion_tokens, model=rreq.model)

@@ -10,6 +10,11 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Union
 
+from ..runtime.errors import (
+    ContextLengthError,
+    GuidedRejectedError,
+    InvalidRequestError,
+)
 from ..runtime.logging import get_logger
 from .model_card import ModelDeploymentCard
 from .protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
@@ -48,7 +53,7 @@ class OpenAIPreprocessor:
         if has and self.card.image_tokens <= 0:
             # silently dropping the image would produce a confident answer
             # about content the model never saw
-            raise ValueError(
+            raise InvalidRequestError(
                 f"model {self.card.name!r} does not accept image input"
             )
         return has
@@ -65,7 +70,7 @@ class OpenAIPreprocessor:
             for m in request.messages
         )
         if wants_audio or has_audio_part:
-            raise ValueError(
+            raise InvalidRequestError(
                 f"model {self.card.name!r} does not support audio input/output"
             )
 
@@ -134,7 +139,7 @@ class OpenAIPreprocessor:
                         guided_regex_pattern(spec["kind"], spec["value"])
                     )
                 except Exception as e:
-                    raise ValueError(f"invalid guided grammar: {e}") from e
+                    raise GuidedRejectedError(f"invalid guided grammar: {e}") from e
             return spec
 
         if getattr(request, "guided_json", None) is not None:
@@ -179,7 +184,7 @@ class OpenAIPreprocessor:
         request_id: str,
     ) -> PreprocessedRequest:
         if len(token_ids) >= self.card.context_length:
-            raise ValueError(
+            raise ContextLengthError(
                 f"prompt length {len(token_ids)} exceeds model context "
                 f"{self.card.context_length}"
             )

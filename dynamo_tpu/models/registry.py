@@ -8,10 +8,9 @@ from __future__ import annotations
 
 from functools import partial
 
-import jax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import AXIS_TP
+from ..parallel.mesh import AXIS_TP, shard_map
 from . import gemma, gptoss, llama, mla, moe
 
 
@@ -29,6 +28,28 @@ def is_gptoss(cfg) -> bool:
 
 def is_gemma(cfg) -> bool:
     return isinstance(cfg, gemma.GemmaConfig)
+
+
+def supports_pp(cfg) -> bool:
+    """Pipeline-parallel serving covers the dense llama family only: the
+    stage placement stacks per-layer params homogeneously, which MoE expert
+    stacks, MLA latent projections, and gpt-oss/gemma windowed-attention
+    extras do not fit (parallel/pp_serving.py)."""
+    return not (is_moe(cfg) or is_mla(cfg) or is_gptoss(cfg) or is_gemma(cfg))
+
+
+def check_pp_supported(cfg) -> None:
+    """One gate, one message: raised both at TpuEngine construction and at
+    the pp_serving program builders, so a MoE/MLA/gpt-oss/gemma preset
+    configured with pp>1 fails at the door with the fix spelled out instead
+    of a KeyError deep in stacked-param placement."""
+    if not supports_pp(cfg):
+        raise ValueError(
+            f"pp serving supports dense llama-family models only; "
+            f"{type(cfg).__name__} (MoE/MLA/gpt-oss/gemma) is not stacked "
+            f"for pipeline stages — configure this preset with pp=1 "
+            f"(use tp/sp/dp instead)"
+        )
 
 
 def family(cfg):
@@ -53,7 +74,7 @@ def _ep_psum_shard_map(mesh, weight_specs, kernel, n_extra_args):
     the MoeConfig, MLA, and gpt-oss families. ``n_extra_args``: 0 for
     kernel(shard_params, x), 1 for kernel(shard_params, x, routed)."""
     extra = ((P(), P()),) * n_extra_args
-    return jax.shard_map(
+    return shard_map(
         kernel,
         mesh=mesh,
         in_specs=(weight_specs, P(), *extra),

@@ -27,6 +27,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel.mesh import shard_map
+
 NEG_INF = -1e30
 
 
@@ -216,7 +218,7 @@ def sharded_paged_decode_attention(
         return paged_decode_attention(
             q, k_cache, v_cache, block_tables, seq_lens, **kw
         )
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(paged_decode_attention, **kw),
         mesh=mesh,
         in_specs=(

@@ -31,6 +31,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel.mesh import shard_map
+
 NEG_INF = -1e30
 
 # default tile sizes; the engine's eligibility guard imports these so the
@@ -193,7 +195,7 @@ def sharded_flash_extend_attention(
         return flash_extend_attention(
             q, k_ctx, v_ctx, q_positions, total_len, **kw
         )
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(flash_extend_attention, **kw),
         mesh=mesh,
         in_specs=(

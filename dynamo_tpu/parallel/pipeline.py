@@ -31,7 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama
-from .mesh import AXIS_DP, AXIS_TP
+from .mesh import AXIS_DP, AXIS_TP, shard_map
 
 AXIS_PP = "pp"
 
@@ -276,7 +276,7 @@ def pipeline_loss_fn(
         nonlocal specs
         if specs is None:
             specs = stacked_param_specs(stacked)
-        fn = jax.shard_map(
+        fn = shard_map(
             local_fn,
             mesh=mesh,
             in_specs=(

@@ -48,6 +48,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama
 from ..ops import attention as att
+from . import mesh as meshlib
 from .mesh import AXIS_TP
 from .pipeline import (
     AXIS_PP,
@@ -88,6 +89,10 @@ def init_pp_caches(
 
 
 def _check_cfg(mcfg: llama.LlamaConfig, pp: int, tp: int) -> None:
+    # registry-level family gate (VERDICT r5 directive)
+    from ..models import registry
+
+    registry.check_pp_supported(mcfg)
     if mcfg.num_layers % pp:
         raise ValueError(f"num_layers {mcfg.num_layers} not divisible by pp={pp}")
     if mcfg.num_kv_heads % tp or mcfg.num_heads % tp:
@@ -181,7 +186,7 @@ def make_pp_prefill_forward(mesh: Mesh, mcfg: llama.LlamaConfig, pp: int, tp: in
         cache = pp_cache_spec()
 
         @partial(
-            jax.shard_map, mesh=mesh,
+            meshlib.shard_map, mesh=mesh,
             in_specs=(specs, cache, cache, P(), P(), P(), P(), P()),
             out_specs=(P(), cache, cache),
             check_vma=False,
@@ -238,7 +243,7 @@ def make_pp_embed_forward(mesh: Mesh, mcfg: llama.LlamaConfig, pp: int, tp: int)
         specs = stacked_param_specs(params)
 
         @partial(
-            jax.shard_map, mesh=mesh,
+            meshlib.shard_map, mesh=mesh,
             in_specs=(specs, P(), P()),
             out_specs=P(),
             check_vma=False,
@@ -316,7 +321,7 @@ def make_pp_decode_forward(mesh: Mesh, mcfg: llama.LlamaConfig, pp: int, tp: int
         cond_skip = os.environ.get("DTPU_PP_COND_SKIP", "1") != "0"
 
         @partial(
-            jax.shard_map, mesh=mesh,
+            meshlib.shard_map, mesh=mesh,
             in_specs=(specs, cache, cache, P(), P(), P(), P(), P(), P()),
             out_specs=(P(), cache, cache),
             check_vma=False,

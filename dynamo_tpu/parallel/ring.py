@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import AXIS_SP
+from .mesh import AXIS_SP, shard_map
 
 NEG_INF = -1e30
 
@@ -113,7 +113,7 @@ def ring_prefill_attention(
     sp = mesh.shape[sp_axis]
     if q.shape[0] % sp:
         raise ValueError(f"sequence {q.shape[0]} not divisible by sp={sp}")
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_attention_shard, axis_name=sp_axis),
         mesh=mesh,
         in_specs=(P(sp_axis, None, None),) * 3,
@@ -202,7 +202,7 @@ def ring_extend_attention(
     sp = mesh.shape[sp_axis]
     if q.shape[0] % sp:
         raise ValueError(f"chunk {q.shape[0]} not divisible by sp={sp}")
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_extend_shard, axis_name=sp_axis),
         mesh=mesh,
         in_specs=(

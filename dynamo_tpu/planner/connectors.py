@@ -24,8 +24,18 @@ from typing import Dict, List, Optional, Protocol
 
 from ..runtime.discovery.store import KVStore
 from ..runtime.logging import get_logger
+from ..runtime.resilience import retry_policy
 
 log = get_logger("planner.connectors")
+
+
+def _planner_policy():
+    """Shared retry for connector side effects (scope planner.connector):
+    scale decisions are level-triggered and idempotent, so a dropped store
+    write / kube patch replays instead of losing the scaling step."""
+    return retry_policy(
+        "planner.connector", max_attempts=3, base_delay_s=0.1, max_delay_s=2.0,
+    )
 
 PLANNER_PREFIX = "v1/planner"
 
@@ -48,12 +58,15 @@ class VirtualConnector:
         self.namespace = namespace
 
     async def get_replicas(self, component: str) -> int:
-        obj = await self.store.get_obj(target_key(self.namespace, component))
+        obj = await _planner_policy().acall(
+            self.store.get_obj, target_key(self.namespace, component)
+        )
         return int(obj["target"]) if obj else 0
 
     async def set_replicas(self, component: str, n: int) -> None:
-        await self.store.put_obj(
-            target_key(self.namespace, component), {"target": int(n)}
+        await _planner_policy().acall(
+            self.store.put_obj,
+            target_key(self.namespace, component), {"target": int(n)},
         )
 
 
@@ -148,15 +161,17 @@ class KubernetesConnector:
         return f"{self.prefix}{component}"
 
     async def get_replicas(self, component: str) -> int:
-        dep = await self.kube.get(
-            "apps/v1", self.kube_namespace, "deployments", self._name(component)
+        dep = await _planner_policy().acall(
+            self.kube.get,
+            "apps/v1", self.kube_namespace, "deployments", self._name(component),
         )
         if dep is None:
             return 0
         return int((dep.get("spec") or {}).get("replicas") or 0)
 
     async def set_replicas(self, component: str, n: int) -> None:
-        await self.kube.patch(
+        await _planner_policy().acall(
+            self.kube.patch,
             "apps/v1", self.kube_namespace, "deployments", self._name(component),
             {"spec": {"replicas": int(n)}},
         )

@@ -20,27 +20,39 @@ from .discovery.store import (
 )
 from .distributed import DistributedRuntime, make_runtime
 from .engine import AsyncEngine, Context, FnEngine, Operator, collect
+from .errors import ContextLengthError, GuidedRejectedError, InvalidRequestError
 from .event_plane.base import EventPlane, InProcEventPlane, Subscription
+from .faults import FAULTS, FaultInjected, FaultRegistry, InjectedDrop
 from .health import EndpointCanary, HealthState, StatusServer
 from .logging import get_logger, init_logging
 from .metrics import MetricsScope
 from .request_plane.tcp import NoResponders, RequestPlaneError, TcpClient, TcpRequestServer
+from .resilience import CircuitBreaker, CircuitOpenError, RetryPolicy
 
 __all__ = [
     "AsyncEngine",
+    "CircuitBreaker",
+    "CircuitOpenError",
     "Client",
     "Component",
     "Context",
+    "ContextLengthError",
     "DistributedRuntime",
     "Endpoint",
     "EndpointCanary",
     "EventPlane",
     "EventType",
+    "FAULTS",
+    "FaultInjected",
+    "FaultRegistry",
+    "GuidedRejectedError",
     "HealthState",
     "StatusServer",
     "FileKVStore",
     "FnEngine",
     "InProcEventPlane",
+    "InjectedDrop",
+    "InvalidRequestError",
     "Instance",
     "KVStore",
     "MemKVStore",
@@ -49,6 +61,7 @@ __all__ = [
     "NoResponders",
     "Operator",
     "RequestPlaneError",
+    "RetryPolicy",
     "RouterMode",
     "RuntimeConfig",
     "ServedEndpoint",

@@ -48,6 +48,13 @@ ENV_LORA_CACHE = "DTPU_LORA_CACHE"                    # adapter cache dir
 # kvbm remote tier (kvbm/remote.py)
 ENV_KVBM_REMOTE = "DTPU_KVBM_REMOTE"                  # G4 block store host:port
 ENV_CONFIG_FILE = "DTPU_CONFIG"                       # layered config file (json/toml)
+# resilience + chaos (runtime/resilience.py, runtime/faults.py).
+# Retry/breaker scopes are layered specs: DTPU_RETRY_DEFAULT applies to every
+# policy, DTPU_RETRY_<SCOPE> (scope upper-cased, dots -> underscores, e.g.
+# DTPU_RETRY_TRANSFER_PULL) overrides per scope; same shape for DTPU_CB_*.
+ENV_RETRY_DEFAULT = "DTPU_RETRY_DEFAULT"              # "attempts=3,base=0.05,max=2,timeout=10,deadline=30"
+ENV_CB_DEFAULT = "DTPU_CB_DEFAULT"                    # "threshold=5,rate=0.5,window=30,reset=5,half_open=1"
+ENV_FAULTS = "DTPU_FAULTS"                            # fault-injection spec, e.g. "transfer.pull:drop@2"
 
 _TRUTHY = {"1", "true", "yes", "on", "enabled"}
 _FALSEY = {"0", "false", "no", "off", "disabled", ""}
@@ -162,8 +169,15 @@ def load_config_file(path: str) -> Dict[str, Any]:
     with open(path, "rb") as f:
         raw = f.read()
     if path.endswith(".toml"):
-        import tomllib
+        try:
+            import tomllib  # py3.11+
+        except ImportError:
+            try:
+                import tomli as tomllib  # type: ignore[no-redef]
+            except ImportError:
+                import toml
 
+                return toml.loads(raw.decode())
         return tomllib.loads(raw.decode())
     import json
 

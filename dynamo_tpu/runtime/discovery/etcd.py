@@ -32,7 +32,9 @@ from typing import Dict, Optional
 
 import aiohttp
 
+from ..faults import FAULTS
 from ..logging import get_logger
+from ..resilience import retry_policy
 from .store import (
     DEFAULT_LEASE_TTL_S,
     EventType,
@@ -85,13 +87,31 @@ class EtcdKVStore(KVStore):
         return self._session
 
     async def _call(self, path: str, body: dict) -> dict:
-        s = await self._http()
-        async with s.post(self.endpoint + path, json=body) as r:
-            if r.status != 200:
-                raise ConnectionError(
-                    f"etcd {path} -> {r.status}: {(await r.text())[:200]}"
-                )
-            return await r.json()
+        async def once() -> dict:
+            await FAULTS.ainject("discovery.call")
+            s = await self._http()
+            try:
+                async with s.post(self.endpoint + path, json=body) as r:
+                    if r.status != 200:
+                        err = ConnectionError(
+                            f"etcd {path} -> {r.status}: {(await r.text())[:200]}"
+                        )
+                        if 400 <= r.status < 500 and r.status not in (408, 429):
+                            # a deterministic gateway rejection (bad op,
+                            # auth): still a ConnectionError for existing
+                            # catchers, but marked terminal so the policy
+                            # doesn't replay it
+                            err.code = "invalid_request"  # type: ignore[attr-defined]
+                        raise err
+                    return await r.json()
+            except aiohttp.ClientError as e:
+                raise ConnectionError(f"etcd {path}: {e}") from e
+
+        # every gateway op here is idempotent (put/range/deleterange/lease
+        # grant+revoke), so the shared policy may replay a dropped call
+        return await retry_policy(
+            "discovery.call", max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+        ).acall(once)
 
     # ------------------------------------------------------------------- kv
     async def put(self, key: str, value: bytes, lease_id: Optional[str] = None) -> None:
@@ -138,7 +158,7 @@ class EtcdKVStore(KVStore):
                 if r.status != 200:
                     return False
                 line = await r.content.readline()
-        except (aiohttp.ClientError, asyncio.TimeoutError):
+        except (aiohttp.ClientError, asyncio.TimeoutError, ConnectionError):
             return False
         if not line.strip():
             return False
@@ -183,19 +203,29 @@ class EtcdKVStore(KVStore):
         """Long-lived watch with reconnect: a dropped connection (etcd
         restart, idle proxy) resumes from the last delivered revision —
         terminating the watcher on a transient error would freeze the
-        client's view of discovery forever."""
+        client's view of discovery forever. Reconnect pacing comes from the
+        shared policy (scope discovery.watch): exponential backoff with
+        jitter on consecutive failures, reset once a stream delivers."""
         next_rev = start_rev
+        policy = retry_policy(
+            "discovery.watch", max_attempts=2, base_delay_s=0.25, max_delay_s=5.0,
+        )
+        prev_delay = None
         try:
             while not watcher._closed:
                 try:
+                    await FAULTS.ainject("discovery.watch")
                     next_rev = await self._watch_once(prefix, next_rev, watcher)
+                    prev_delay = None  # the stream delivered: backoff resets
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
+                    prev_delay = policy.next_delay(prev_delay)
                     log.warning(
-                        "etcd watch for %r dropped (%s); reconnecting", prefix, e
+                        "etcd watch for %r dropped (%s); reconnecting in %.2fs",
+                        prefix, e, prev_delay,
                     )
-                    await asyncio.sleep(1.0)
+                    await asyncio.sleep(prev_delay)
         except asyncio.CancelledError:
             pass
         finally:

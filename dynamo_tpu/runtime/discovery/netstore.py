@@ -25,7 +25,9 @@ from typing import Dict, Optional
 
 import msgpack
 
+from ..faults import FAULTS
 from ..logging import get_logger
+from ..resilience import retry_policy
 from .store import (
     DEFAULT_LEASE_TTL_S,
     EventType,
@@ -222,6 +224,7 @@ class TcpKVStore(KVStore):
             self._rx_task = None
 
     async def _call(self, obj: dict) -> dict:
+        await FAULTS.ainject("discovery.call")
         async with self._lock:
             await self._ensure()
             self._rid += 1
@@ -233,18 +236,26 @@ class TcpKVStore(KVStore):
             await self._writer.drain()
         return await fut
 
+    async def _call_retry(self, obj: dict) -> dict:
+        """Idempotent ops replay through the shared policy: a severed
+        connection reconnects in ``_ensure`` on the next attempt instead of
+        surfacing every blip to discovery consumers."""
+        return await retry_policy(
+            "discovery.call", max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+        ).acall(self._call, dict(obj))
+
     # -- KVStore interface ---------------------------------------------------
     async def put(self, key: str, value: bytes, lease_id: Optional[str] = None) -> None:
-        await self._call({"op": "put", "key": key, "value": value, "lease_id": lease_id})
+        await self._call_retry({"op": "put", "key": key, "value": value, "lease_id": lease_id})
 
     async def get(self, key: str) -> Optional[bytes]:
-        return (await self._call({"op": "get", "key": key}))["value"]
+        return (await self._call_retry({"op": "get", "key": key}))["value"]
 
     async def delete(self, key: str) -> None:
-        await self._call({"op": "delete", "key": key})
+        await self._call_retry({"op": "delete", "key": key})
 
     async def list_prefix(self, prefix: str) -> Dict[str, bytes]:
-        return (await self._call({"op": "list", "prefix": prefix}))["items"]
+        return (await self._call_retry({"op": "list", "prefix": prefix}))["items"]
 
     async def watch(self, prefix: str) -> Watcher:
         async with self._lock:

@@ -15,9 +15,11 @@ from .component import DistributedRuntimeBase
 from .config import RuntimeConfig
 from .discovery.store import KVStore, make_store
 from .event_plane.base import EventPlane, InProcEventPlane
+from .faults import FAULTS
 from .logging import get_logger, init_logging
 from .metrics import MetricsScope
 from .request_plane.tcp import TcpClient
+from .resilience import retry_policy
 from .tasks import TaskTracker
 
 log = get_logger("runtime.distributed")
@@ -39,6 +41,11 @@ class DistributedRuntime(DistributedRuntimeBase):
         self.tcp_client = TcpClient()
         self._http_client = None  # lazy: most deployments never use it
         self.metrics = MetricsScope()
+        # shared retry policies/breakers created after this point export
+        # their counters through this runtime's registry (-> /metrics)
+        from .resilience import adopt_metrics_scope
+
+        adopt_metrics_scope(self.metrics)
         # supervised background work (runtime/tasks.py; reference
         # utils/tasks/tracker.rs): components spawn under runtime.tasks so
         # shutdown() drains the whole tree
@@ -85,23 +92,43 @@ class DistributedRuntime(DistributedRuntimeBase):
         try:
             while True:
                 await asyncio.sleep(interval)
-                if self.lease_id is not None:
+                if self.lease_id is None:
+                    continue
+                try:
+                    await FAULTS.ainject("discovery.lease_keepalive")
                     ok = await self.store.keep_alive(self.lease_id)
-                    if not ok:
-                        log.warning("lease %s lost; re-acquiring", self.lease_id[:8])
-                        lease = await self.store.create_lease(ttl_s)
-                        self.lease_id = lease.id
-                        # lease expiry deleted our instance keys: re-register
-                        # every endpoint this runtime still serves
-                        for served in list(self.served):
-                            try:
-                                await self.store.put_obj(
-                                    served._key, served.instance.to_obj(), self.lease_id
-                                )
-                                for k, obj in served.extra_objs.items():
-                                    await self.store.put_obj(k, obj, self.lease_id)
-                            except Exception:
-                                log.exception("re-register %s failed", served._key)
+                except Exception as e:
+                    # a raising heartbeat must not kill the loop — treat it
+                    # as a missed beat and let the lease path recover
+                    log.warning("lease keepalive error: %s", e)
+                    ok = False
+                if not ok:
+                    log.warning("lease %s lost; re-acquiring", self.lease_id[:8])
+                    try:
+                        # shared policy (scope discovery.lease): the store
+                        # may be mid-restart; back off instead of hot-looping
+                        lease = await retry_policy(
+                            "discovery.lease",
+                            max_attempts=4, base_delay_s=0.1, max_delay_s=2.0,
+                            retryable=(Exception,),
+                        ).acall(self.store.create_lease, ttl_s)
+                    except Exception:
+                        log.exception(
+                            "lease re-acquire failed; retrying next beat"
+                        )
+                        continue
+                    self.lease_id = lease.id
+                    # lease expiry deleted our instance keys: re-register
+                    # every endpoint this runtime still serves
+                    for served in list(self.served):
+                        try:
+                            await self.store.put_obj(
+                                served._key, served.instance.to_obj(), self.lease_id
+                            )
+                            for k, obj in served.extra_objs.items():
+                                await self.store.put_obj(k, obj, self.lease_id)
+                        except Exception:
+                            log.exception("re-register %s failed", served._key)
         except asyncio.CancelledError:
             pass
 

@@ -10,6 +10,11 @@ from __future__ import annotations
 import asyncio
 from typing import AsyncIterator, Optional, Tuple
 
+from ..faults import FAULTS
+from ..logging import get_logger
+
+log = get_logger("runtime.event_plane")
+
 
 class Subscription:
     def __init__(self):
@@ -59,6 +64,15 @@ class InProcEventPlane(EventPlane):
         self._subs: list = []  # (prefix, Subscription)
 
     async def publish(self, topic: str, payload: bytes) -> None:
+        try:
+            await FAULTS.ainject("event_plane.publish")
+        except ConnectionError as e:
+            # events are fire-and-forget: a dropped publish degrades
+            # (consumers resync from snapshots), it must not crash the
+            # publisher's loop
+            log.warning("event publish dropped (%s): %s", topic, e)
+            return
+        payload = FAULTS.mangle("event_plane.publish", payload)
         for prefix, sub in list(self._subs):
             if topic.startswith(prefix):
                 sub._emit(topic, payload)

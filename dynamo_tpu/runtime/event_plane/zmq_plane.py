@@ -18,7 +18,9 @@ import zmq
 import zmq.asyncio
 
 from ..discovery.store import KVStore
+from ..faults import FAULTS
 from ..logging import get_logger
+from ..resilience import retry_policy
 from .base import EventPlane, Subscription
 
 log = get_logger("runtime.event_plane.zmq")
@@ -91,7 +93,24 @@ class ZmqEventPlane(EventPlane):
             # are dropped on the floor (zmq slow-joiner).
             await asyncio.sleep(0.15)
             self._warmed = True
-        await self._pub.send_multipart([topic.encode(), payload])
+
+        async def send():
+            await FAULTS.ainject("event_plane.publish")
+            body = FAULTS.mangle("event_plane.publish", payload)
+            await self._pub.send_multipart([topic.encode(), body])
+
+        try:
+            # shared policy (scope event_plane.publish): transient socket
+            # errors retry; an exhausted retry DROPS the event (pub/sub is
+            # best-effort; consumers resync from snapshots) instead of
+            # crashing the publisher's loop
+            await retry_policy(
+                "event_plane.publish",
+                max_attempts=3, base_delay_s=0.02, max_delay_s=0.5,
+                retryable=(ConnectionError, OSError, zmq.ZMQError),
+            ).acall(send)
+        except (ConnectionError, OSError, zmq.ZMQError) as e:
+            log.warning("event publish dropped (%s): %s", topic, e)
 
     async def subscribe(self, topic_prefix: str) -> Subscription:
         sock = self._ctx.socket(zmq.SUB)

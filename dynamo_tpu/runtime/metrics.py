@@ -38,6 +38,11 @@ KV_ACTIVE_BLOCKS = f"{PREFIX}_kv_active_blocks"
 KV_TOTAL_BLOCKS = f"{PREFIX}_kv_total_blocks"
 KV_HIT_TOKENS = f"{PREFIX}_kv_cached_tokens_total"
 WORKER_ACTIVE_DECODE_BLOCKS = f"{PREFIX}_worker_active_decode_blocks"
+# resilience (runtime/resilience.py): per-policy retry/breaker observability
+RETRY_ATTEMPTS_TOTAL = f"{PREFIX}_retry_attempts_total"
+RETRY_GIVEUPS_TOTAL = f"{PREFIX}_retry_giveups_total"
+CIRCUIT_STATE = f"{PREFIX}_circuit_state"
+CIRCUIT_TRANSITIONS_TOTAL = f"{PREFIX}_circuit_transitions_total"
 
 LABEL_NAMESPACE = "dtpu_namespace"
 LABEL_COMPONENT = "dtpu_component"

@@ -27,6 +27,7 @@ from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
 from aiohttp.client_exceptions import ClientConnectorError, ClientError
 
 from ..engine import Context
+from ..faults import FAULTS
 from ..logging import get_logger
 from ..tasks import spawn_bg
 from .tcp import Handler, NoResponders, RequestPlaneError
@@ -145,6 +146,10 @@ class HttpClient:
         ctx = context or Context()
         rid = uuid.uuid4().hex
         sess = self._sess()
+        try:
+            await FAULTS.ainject("request_plane.send")
+        except ConnectionError as e:
+            raise NoResponders(f"send {address}: {e}") from e
         try:
             resp = await sess.post(
                 address.rstrip("/") + "/rpc",

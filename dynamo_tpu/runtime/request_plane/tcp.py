@@ -30,7 +30,9 @@ from typing import Any, AsyncIterator, Callable, Dict, Optional
 import msgpack
 
 from ..engine import Context
+from ..faults import FAULTS
 from ..logging import get_logger
+from ..resilience import retry_policy
 from ..tasks import spawn_bg
 
 log = get_logger("runtime.tcp")
@@ -257,8 +259,19 @@ class TcpClient:
             if conn is not None and not conn.closed:
                 return conn
             host, port_s = address.rsplit(":", 1)
+
+            async def connect():
+                await FAULTS.ainject("request_plane.connect")
+                return await asyncio.open_connection(host, int(port_s))
+
             try:
-                reader, writer = await asyncio.open_connection(host, int(port_s))
+                # shared policy (scope request_plane.connect): one quick
+                # retry absorbs a worker restarting its listener; a truly
+                # dead target still surfaces as NoResponders in ~base delay
+                reader, writer = await retry_policy(
+                    "request_plane.connect",
+                    max_attempts=2, base_delay_s=0.02, max_delay_s=0.2,
+                ).acall(connect)
             except (ConnectionRefusedError, OSError) as e:
                 raise NoResponders(f"connect {address}: {e}") from e
             conn = _Conn(reader, writer)
@@ -292,8 +305,9 @@ class TcpClient:
 
         ctx.on_cancel(on_cancel)
         try:
+            await FAULTS.ainject("request_plane.send")
             await conn.send({"t": "req", "id": rid, "body": request})
-        except (ConnectionResetError, BrokenPipeError) as e:
+        except ConnectionError as e:  # covers reset/broken-pipe/injected drop
             conn.streams.pop(rid, None)
             raise NoResponders(f"send {address}: {e}") from e
 

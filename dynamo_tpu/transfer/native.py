@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..runtime.faults import FAULTS
 from ..runtime.logging import get_logger
 
 log = get_logger("transfer.native")
@@ -179,7 +180,9 @@ def native_fetch(
     block_bytes: int,
 ) -> np.ndarray:
     """Client side: gather remote blocks into one contiguous buffer.
-    Returns a uint8 array of shape [n, block_bytes]. Raises on failure."""
+    Returns a uint8 array of shape [n, block_bytes]. Raises on failure.
+    Runs on executor threads — the sync fault point is safe here."""
+    FAULTS.inject("transfer.native_fetch")
     lib = _load()
     if lib is None:
         raise RuntimeError("native transfer library unavailable")

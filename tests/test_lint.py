@@ -110,3 +110,59 @@ async def good():
     found = dropped_tasks("x.py", ast.parse(src))
     assert len(found) == 2
     assert {f[1] for f in found} == {5, 6}
+
+
+def test_linter_catches_broad_retry_continue(tmp_path):
+    bad = tmp_path / "bad_retry.py"
+    bad.write_text(
+        "def pump(items):\n"
+        "    for it in items:\n"
+        "        try:\n"
+        "            it.run()\n"
+        "        except Exception:\n"
+        "            continue\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "BROAD-RETRY" in r.stdout
+
+
+def test_linter_catches_fixed_sleep_retry_loop(tmp_path):
+    bad = tmp_path / "bad_sleep.py"
+    bad.write_text(
+        "import time\n"
+        "def poll(fn):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except Exception:\n"
+        "            pass\n"
+        "        time.sleep(1.0)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "SLEEP-RETRY" in r.stdout
+
+
+def test_linter_allows_policy_driven_delay(tmp_path):
+    ok = tmp_path / "ok_retry.py"
+    ok.write_text(
+        "import time\n"
+        "def poll(fn, policy):\n"
+        "    prev = None\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except Exception:\n"
+        "            prev = policy.next_delay(prev)\n"
+        "        time.sleep(prev)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(ok)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stdout

@@ -209,3 +209,32 @@ async def test_pp_microbatched_decode_matches_default(monkeypatch):
     masked = await run_with({"DTPU_PP_COND_SKIP": "0"})
     assert mb == base
     assert masked == base
+
+
+def test_pp_rejects_non_dense_families_with_actionable_error():
+    """VERDICT r5 directive: a MoE/MLA/gemma preset configured with pp>1
+    must fail at the door with the fix spelled out, not as a KeyError deep
+    in stacked-param placement. Gated at the registry (supports_pp), checked
+    both at TpuEngine construction and at the pp_serving program builders."""
+    import pytest
+
+    from dynamo_tpu.models.gemma import GemmaConfig
+    from dynamo_tpu.models.mla import MlaConfig
+    from dynamo_tpu.models.moe import MoeConfig
+    from dynamo_tpu.parallel import pp_serving
+
+    for mcfg in (
+        MoeConfig.tiny_moe(),
+        MlaConfig.tiny_mla(),
+        GemmaConfig.tiny_gemma3(),
+    ):
+        assert not registry.supports_pp(mcfg)
+        with pytest.raises(ValueError, match="dense llama-family.*pp=1"):
+            TpuEngine(_cfg(model=mcfg, tp=2, pp=2))
+        # direct pp_serving use (bypassing TpuEngine) hits the same gate
+        with pytest.raises(ValueError, match="dense llama-family"):
+            pp_serving.make_pp_prefill_forward(
+                make_pp_mesh(pp=2, tp=2, devices=jax.devices()[:4]),
+                mcfg, pp=2, tp=2,
+            )
+    assert registry.supports_pp(_mcfg())  # the dense family still serves

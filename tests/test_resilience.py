@@ -1,0 +1,283 @@
+"""Unit tests for the unified resilience policy (runtime/resilience.py):
+retry backoff/jitter/predicates sync+async, circuit breaker state machine,
+env-spec configuration, and Prometheus metric export.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import metrics as M
+from dynamo_tpu.runtime.errors import InvalidRequestError, is_terminal
+from dynamo_tpu.runtime.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+    circuit_breaker,
+    reset_registries,
+    retry_policy,
+)
+
+
+def _policy(**kw):
+    kw.setdefault("name", "test")
+    kw.setdefault("base_delay_s", 0.001)
+    kw.setdefault("max_delay_s", 0.01)
+    return RetryPolicy(**kw)
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+def test_retry_sync_succeeds_after_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("blip")
+        return "ok"
+
+    assert _policy(max_attempts=5).call(flaky) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_sync_exhausts_and_reraises():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        _policy(max_attempts=3).call(always)
+    assert calls["n"] == 3
+
+
+def test_terminal_errors_never_retry():
+    calls = {"n": 0}
+
+    def invalid():
+        calls["n"] += 1
+        raise InvalidRequestError("bad grammar")
+
+    with pytest.raises(InvalidRequestError):
+        _policy(max_attempts=5).call(invalid)
+    assert calls["n"] == 1  # not retryable: one attempt only
+    assert is_terminal(InvalidRequestError("x"))
+    assert not is_terminal(ConnectionError("x"))
+
+
+def test_custom_predicate_wins():
+    calls = {"n": 0}
+
+    def fail():
+        calls["n"] += 1
+        raise ValueError("retry me anyway")
+
+    p = _policy(max_attempts=3, predicate=lambda e: isinstance(e, ValueError))
+    with pytest.raises(ValueError):
+        p.call(fail)
+    assert calls["n"] == 3
+
+
+def test_backoff_is_decorrelated_jitter_within_bounds():
+    p = _policy(max_attempts=10, base_delay_s=0.05, max_delay_s=0.4, seed=3)
+    prev = None
+    for d in p.delays():
+        lo = p.base_delay_s
+        hi = min(p.max_delay_s, 3.0 * (prev if prev is not None else lo))
+        assert lo <= d <= max(lo, hi)
+        prev = d
+
+
+def test_backoff_deterministic_with_seed():
+    a = list(_policy(max_attempts=8, seed=42).delays())
+    b = list(_policy(max_attempts=8, seed=42).delays())
+    c = list(_policy(max_attempts=8, seed=43).delays())
+    assert a == b
+    assert a != c
+
+
+async def test_retry_async_with_attempt_timeout():
+    calls = {"n": 0}
+
+    async def slow_then_fast():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            await asyncio.sleep(5.0)  # would blow the attempt timeout
+        return "ok"
+
+    p = _policy(max_attempts=3, attempt_timeout_s=0.05)
+    assert await p.acall(slow_then_fast) == "ok"
+    assert calls["n"] == 2
+
+
+async def test_retry_async_deadline_caps_total():
+    calls = {"n": 0}
+
+    async def always():
+        calls["n"] += 1
+        await asyncio.sleep(0.03)
+        raise ConnectionError("down")
+
+    p = _policy(max_attempts=50, deadline_s=0.05)
+    with pytest.raises(ConnectionError):
+        await p.acall(always)
+    assert calls["n"] < 10  # the deadline, not max_attempts, stopped it
+
+
+def test_retry_env_spec_overrides(monkeypatch):
+    monkeypatch.setenv("DTPU_RETRY_DEFAULT", "attempts=7,base=0.5")
+    monkeypatch.setenv("DTPU_RETRY_TRANSFER_PULL", "attempts=2")
+    p = RetryPolicy.from_env("transfer.pull", max_attempts=3, max_delay_s=9.0)
+    assert p.max_attempts == 2          # scope overrides default
+    assert p.base_delay_s == 0.5        # default layer applies
+    assert p.max_delay_s == 9.0         # code default survives
+    reset_registries()
+
+
+def test_registry_caches_per_scope():
+    reset_registries()
+    a = retry_policy("scope.a", max_attempts=4)
+    assert retry_policy("scope.a") is a
+    assert retry_policy("scope.b") is not a
+    reset_registries()
+
+
+# -- CircuitBreaker ----------------------------------------------------------
+
+def _breaker(**kw):
+    kw.setdefault("name", "cb-test")
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("window_s", 10.0)
+    kw.setdefault("reset_timeout_s", 60.0)
+    return CircuitBreaker(**kw)
+
+
+def test_breaker_trips_after_threshold_failures():
+    t = [0.0]
+    cb = _breaker(clock=lambda: t[0])
+    assert cb.state == CLOSED
+    for _ in range(2):
+        cb.record(False)
+    assert cb.state == CLOSED  # below threshold
+    cb.record(False)
+    assert cb.state == OPEN
+    assert not cb.allow()
+    assert cb.retry_after_s() > 0
+
+
+def test_breaker_failure_rate_guard():
+    # 3 failures among 17 successes: volume hit but rate too low to trip
+    cb = _breaker(failure_rate=0.5)
+    for _ in range(17):
+        cb.record(True)
+    for _ in range(3):
+        cb.record(False)
+    assert cb.state == CLOSED
+
+
+def test_breaker_window_expires_old_failures():
+    t = [0.0]
+    cb = _breaker(clock=lambda: t[0], window_s=5.0)
+    cb.record(False)
+    cb.record(False)
+    t[0] = 6.0  # the old failures age out of the window
+    cb.record(False)
+    assert cb.state == CLOSED
+
+
+def test_breaker_half_open_probe_closes_on_success():
+    t = [0.0]
+    cb = _breaker(clock=lambda: t[0], reset_timeout_s=5.0)
+    for _ in range(3):
+        cb.record(False)
+    assert cb.state == OPEN
+    t[0] = 5.1
+    assert cb.state == HALF_OPEN
+    assert cb.allow()          # the single probe slot
+    assert not cb.allow()      # concurrent second call rejected
+    cb.record(True)
+    assert cb.state == CLOSED
+    assert cb.allow()
+
+
+def test_breaker_half_open_probe_reopens_on_failure():
+    t = [0.0]
+    cb = _breaker(clock=lambda: t[0], reset_timeout_s=5.0)
+    for _ in range(3):
+        cb.record(False)
+    t[0] = 5.1
+    assert cb.allow()
+    cb.record(False)
+    assert cb.state == OPEN
+    t[0] = 5.2
+    assert not cb.allow()  # a fresh reset window started
+
+
+def test_breaker_guard_raises_typed_error():
+    cb = _breaker(failure_threshold=1)
+    cb.record(False)
+    with pytest.raises(CircuitOpenError) as ei:
+        cb.guard()
+    assert ei.value.retry_after_s > 0
+    assert ei.value.code == "circuit_open"
+
+
+async def test_breaker_acall_wraps_outcomes():
+    cb = _breaker(failure_threshold=2)
+
+    async def boom():
+        raise ConnectionError("x")
+
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            await cb.acall(boom)
+    assert cb.state == OPEN
+    with pytest.raises(CircuitOpenError):
+        await cb.acall(boom)
+
+
+def test_breaker_env_spec(monkeypatch):
+    monkeypatch.setenv("DTPU_CB_FRONTEND", "threshold=2,reset=0.25,window=3")
+    cb = CircuitBreaker.from_env("frontend", failure_threshold=9)
+    assert cb.failure_threshold == 2
+    assert cb.reset_timeout_s == 0.25
+    assert cb.window_s == 3.0
+    reset_registries()
+
+
+def test_breaker_metrics_exported():
+    scope = M.MetricsScope()
+    cb = CircuitBreaker(
+        "metrics-cb", failure_threshold=1, reset_timeout_s=60.0, metrics=scope
+    )
+    cb.record(False)
+    text = scope.expose().decode()
+    assert M.CIRCUIT_TRANSITIONS_TOTAL in text
+    assert 'policy="metrics-cb"' in text
+    assert 'state="open"' in text
+    assert M.CIRCUIT_STATE in text
+
+
+def test_retry_metrics_exported():
+    scope = M.MetricsScope()
+    p = RetryPolicy(
+        name="metrics-retry", max_attempts=2, base_delay_s=0.001,
+        max_delay_s=0.002, metrics=scope,
+    )
+    with pytest.raises(ConnectionError):
+        p.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+    text = scope.expose().decode()
+    assert M.RETRY_ATTEMPTS_TOTAL in text
+    assert M.RETRY_GIVEUPS_TOTAL in text
+    assert 'policy="metrics-retry"' in text
+
+
+def test_breaker_registry_caches():
+    reset_registries()
+    assert circuit_breaker("x") is circuit_breaker("x")
+    reset_registries()

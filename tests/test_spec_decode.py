@@ -168,47 +168,57 @@ async def test_mixed_batch_falls_back_to_normal_horizons():
     assert greedy == ref[0]
 
 
-async def test_spec_with_mla_and_gemma_mains():
+async def _spec_matches_family_main(main_cfg):
     """The verify pass (paged_extend_attention) covers every cache layout
     the families use — MLA's latent-MQA cache and gemma's windowed,
     softcap-free layers included. Greedy equality pins it per family; the
     draft stays a plain dense model (drafts are family-agnostic as long as
     the vocab matches)."""
-    from dynamo_tpu.models.gemma import GemmaConfig
+    e_ref = TpuEngine(
+        TpuEngineConfig(
+            model=main_cfg, num_blocks=256, block_size=4,
+            max_batch_size=2, max_context=512,
+            prefill_buckets=(16, 32, 64), decode_steps=6,
+            decode_pipeline=2,
+        ),
+        mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+    )
+    try:
+        ref = await collect(e_ref, preq("ref", PROMPTS[0], n=12))
+    finally:
+        e_ref.stop()
+    e_spec = TpuEngine(
+        TpuEngineConfig(
+            model=main_cfg, num_blocks=256, block_size=4,
+            max_batch_size=2, max_context=512,
+            prefill_buckets=(16, 32, 64), decode_steps=6,
+            decode_pipeline=2, spec_k=3, spec_draft=DRAFT,
+        ),
+        mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+    )
+    try:
+        got = await collect(e_spec, preq("spec", PROMPTS[0], n=12))
+        assert got == ref, type(main_cfg).__name__
+        assert e_spec.spec_stats["rounds"] > 0
+    finally:
+        e_spec.stop()
+
+
+# Split per family (VERDICT r5 directive 3): the combined test compiled
+# four engines' programs in one 120s conftest budget and timed out under
+# parallel CI (-n 4) while passing serially. Each half owns its own budget.
+
+
+async def test_spec_with_mla_main():
     from dynamo_tpu.models.mla import MlaConfig
 
-    for main_cfg in (
-        MlaConfig.tiny_mla(vocab_size=512),
-        GemmaConfig.tiny_gemma3(vocab_size=512),
-    ):
-        e_ref = TpuEngine(
-            TpuEngineConfig(
-                model=main_cfg, num_blocks=256, block_size=4,
-                max_batch_size=2, max_context=512,
-                prefill_buckets=(16, 32, 64), decode_steps=6,
-                decode_pipeline=2,
-            ),
-            mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
-        )
-        try:
-            ref = await collect(e_ref, preq("ref", PROMPTS[0], n=12))
-        finally:
-            e_ref.stop()
-        e_spec = TpuEngine(
-            TpuEngineConfig(
-                model=main_cfg, num_blocks=256, block_size=4,
-                max_batch_size=2, max_context=512,
-                prefill_buckets=(16, 32, 64), decode_steps=6,
-                decode_pipeline=2, spec_k=3, spec_draft=DRAFT,
-            ),
-            mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
-        )
-        try:
-            got = await collect(e_spec, preq("spec", PROMPTS[0], n=12))
-            assert got == ref, type(main_cfg).__name__
-            assert e_spec.spec_stats["rounds"] > 0
-        finally:
-            e_spec.stop()
+    await _spec_matches_family_main(MlaConfig.tiny_mla(vocab_size=512))
+
+
+async def test_spec_with_gemma_main():
+    from dynamo_tpu.models.gemma import GemmaConfig
+
+    await _spec_matches_family_main(GemmaConfig.tiny_gemma3(vocab_size=512))
 
 
 def test_spec_config_gates():

@@ -240,6 +240,82 @@ def dropped_tasks(path: str, tree: ast.AST):
     return out
 
 
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    """bare ``except:`` or ``except (Base)Exception``."""
+    if h.type is None:
+        return True
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    return any(
+        isinstance(t, ast.Name) and t.id in ("Exception", "BaseException")
+        for t in types
+    )
+
+
+def _sleep_calls(node: ast.AST):
+    """time.sleep / asyncio.sleep calls (awaited or not) under ``node``."""
+    for n in ast.walk(node):
+        call = n.value if isinstance(n, ast.Await) else n
+        if not isinstance(call, ast.Call):
+            continue
+        fn = call.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "sleep"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in ("time", "asyncio")
+        ):
+            yield call
+
+
+def adhoc_retry(path: str, tree: ast.AST):
+    """Hand-rolled retry loops that belong on runtime/resilience.py's shared
+    policy (fixed pacing, no jitter, no give-up bound, invisible to the
+    retry metrics). Two shapes:
+
+      - BROAD-RETRY: a broad handler (bare / ``except Exception``) whose
+        body is nothing but ``continue`` (or pass+continue) — swallow the
+        error, go around again, forever.
+      - SLEEP-RETRY: a loop that both swallows broad exceptions (handler
+        with no ``raise``) and paces itself with a CONSTANT-argument
+        ``time.sleep``/``asyncio.sleep`` — a fixed-backoff retry loop.
+        Policy-driven delays (variables) pass.
+
+    runtime/resilience.py and runtime/faults.py are the funnel and are
+    exempt (main() skips them)."""
+    out = []
+    for loop_node in ast.walk(tree):
+        if not isinstance(loop_node, (ast.While, ast.For, ast.AsyncFor)):
+            continue
+        swallows = None
+        for n in ast.walk(loop_node):
+            if not isinstance(n, ast.Try):
+                continue
+            for h in n.handlers:
+                if not _is_broad_handler(h):
+                    continue
+                body = [s for s in h.body if not isinstance(s, ast.Pass)]
+                if len(body) == 1 and isinstance(body[0], ast.Continue):
+                    out.append((
+                        path, h.lineno,
+                        "BROAD-RETRY: broad except swallowed into `continue` "
+                        "— route retries through runtime/resilience.py",
+                    ))
+                elif not any(isinstance(x, ast.Raise) for x in ast.walk(h)):
+                    swallows = h
+        if swallows is None:
+            continue
+        for call in _sleep_calls(loop_node):
+            if call.args and isinstance(call.args[0], ast.Constant):
+                out.append((
+                    path, call.lineno,
+                    "SLEEP-RETRY: fixed-interval sleep in a loop that "
+                    "swallows broad exceptions — use a RetryPolicy "
+                    "(runtime/resilience.py) for backoff",
+                ))
+                break  # one finding per loop is enough
+    return out
+
+
 def _ident_tokens(text: str):
     tok = ""
     for ch in text:
@@ -280,6 +356,11 @@ def main(argv) -> int:
         for p, lineno, msg in dropped_tasks(path, tree):
             print(f"{p}:{lineno}: DROPPED-TASK: {msg}")
             bad += 1
+        norm = path.replace(os.sep, "/")
+        if not norm.endswith(("runtime/resilience.py", "runtime/faults.py")):
+            for p, lineno, msg in adhoc_retry(path, tree):
+                print(f"{p}:{lineno}: {msg}")
+                bad += 1
     if bad:
         print(f"{bad} finding(s)")
     return 1 if bad else 0
