@@ -47,6 +47,11 @@ PIPELINE = int(os.environ.get("BENCH_PIPELINE", "2"))
 WARMUP_TOKENS = 16
 # batch sweep runs BY DEFAULT; set BENCH_SWEEP=8 (single config) to disable
 SWEEP = os.environ.get("BENCH_SWEEP", "8,16,32")
+# KV precision sweep: "model" (cache dtype, the default) and/or "int8"
+# (quantized paged cache, ops/quant.py) — e.g. BENCH_KV_DTYPE=model,int8
+# benches both so the int8 bandwidth win is measurable against BENCH_r05.
+# Every result carries kv_dtype + kv_bytes_per_token in its detail.
+KV_SWEEP = os.environ.get("BENCH_KV_DTYPE", "model")
 # fleet benches (mocker, no TPU): router prefix-ratio + disagg-vs-agg
 FLEET = os.environ.get("BENCH_FLEET", "1") not in ("0", "")
 
@@ -73,7 +78,7 @@ def roofline_tokens_per_s(cfg: LlamaConfig, batch: int, ctx: int) -> float:
     return steps_per_s * batch
 
 
-async def run_bench(batch: int = BATCH) -> dict:
+async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
     mcfg = model_config()
     # headroom so deep horizon pipelines never fall back to single-step near
     # the end of generation (prepare_horizon needs L + depth*steps < ctx)
@@ -95,6 +100,7 @@ async def run_bench(batch: int = BATCH) -> dict:
         ) + (ctx,),
         decode_steps=DECODE_STEPS,
         decode_pipeline=PIPELINE,
+        kv_dtype=kv_dtype,
     )
     engine = TpuEngine(cfg)
 
@@ -131,6 +137,12 @@ async def run_bench(batch: int = BATCH) -> dict:
     ttft = (min(t_firsts) - t0) if t_firsts else 0.0
     tok_s = total_tokens / elapsed
     roof = roofline_tokens_per_s(mcfg, batch, PROMPT_LEN + DECODE_TOKENS)
+    # KV bytes one token occupies — identical across the paged cache, the
+    # disagg transfer wire and a KVBM tier block (kvbm/layout is the one
+    # byte-accounting source); this is the field the int8 acceptance gate
+    # reads (int8/bf16 <= 0.55x)
+    from dynamo_tpu.kvbm.layout import kv_bytes_per_token
+
     return {
         "metric": "decode_throughput_qwen3_0.6b_bs%d" % batch,
         "value": round(tok_s, 1),
@@ -146,6 +158,10 @@ async def run_bench(batch: int = BATCH) -> dict:
             "prompt_len": PROMPT_LEN,
             "decode_steps": DECODE_STEPS,
             "pipeline": PIPELINE,
+            "kv_dtype": kv_dtype,
+            "kv_bytes_per_token": kv_bytes_per_token(
+                mcfg, cfg.block_size, kv_dtype
+            ),
         },
     }
 
@@ -200,6 +216,8 @@ def _emit(results, errors) -> None:
                 "tok_s": r["value"],
                 "vs_roofline": r["vs_baseline"],
                 "ttft_s": r["detail"]["first_ttft_s"],
+                "kv_dtype": r["detail"]["kv_dtype"],
+                "kv_bytes_per_token": r["detail"]["kv_bytes_per_token"],
             }
             for r in results
         ]
@@ -235,17 +253,19 @@ def _watchdog(results, errors) -> None:
 
 def main() -> None:
     batches = [int(b) for b in SWEEP.split(",") if b.strip()] or [BATCH]
+    kv_dtypes = [k.strip() for k in KV_SWEEP.split(",") if k.strip()] or ["model"]
     results = []
     errors = []
     _watchdog(results, errors)
-    for b in batches:
-        # a tunnel flake on one config must not sink the whole run: keep
-        # whatever measured and report the failures in detail
-        try:
-            results.append(asyncio.run(run_bench(b)))
-        except Exception as e:
-            errors.append({"batch": b, "error": repr(e)[:300]})
-            print(f"bench batch={b} failed: {e!r}", file=sys.stderr)
+    for kvd in kv_dtypes:
+        for b in batches:
+            # a tunnel flake on one config must not sink the whole run: keep
+            # whatever measured and report the failures in detail
+            try:
+                results.append(asyncio.run(run_bench(b, kv_dtype=kvd)))
+            except Exception as e:
+                errors.append({"batch": b, "kv_dtype": kvd, "error": repr(e)[:300]})
+                print(f"bench batch={b} kv={kvd} failed: {e!r}", file=sys.stderr)
     if not _claim_emit():
         return  # watchdog emitted and is exiting
     _emit(results, errors)

@@ -95,6 +95,12 @@ def parse_args():
                    "group; the KV router targets (worker, dp_rank)")
     p.add_argument("--num-blocks", type=int, default=2048)
     p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--kv-dtype", default="auto",
+                   choices=("auto", "model", "int8"),
+                   help="paged-KV storage precision (docs/operations.md "
+                        "'KV precision'): int8 = quantized cache w/ "
+                        "per-block scales, ~0.51x bf16 KV bytes; auto "
+                        "defers to DTPU_KV_DTYPE (default: model dtype)")
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-context", type=int, default=2048,
                    help="may exceed the largest prefill bucket: long prompts "
@@ -261,6 +267,7 @@ def make_engine_config(args, mcfg, vcfg=None, logits_procs=(), spec_draft=None):
         model=mcfg,
         num_blocks=args.num_blocks,
         block_size=args.block_size,
+        kv_dtype=getattr(args, "kv_dtype", "auto"),
         max_batch_size=args.max_batch_size,
         max_context=ctx,
         tp=args.tp,
@@ -479,10 +486,17 @@ async def main() -> None:
     instance_id = new_instance_id()
     kvbm = None
     if args.kvbm_host_gb > 0 or args.kvbm_disk_gb > 0 or args.kvbm_remote:
+        from dynamo_tpu.kvbm.layout import kv_bytes_per_token
         from dynamo_tpu.kvbm.pool import KvbmTiers
+        from dynamo_tpu.ops.quant import resolve_kv_dtype
 
-        block_nbytes = (
-            4 * mcfg.num_layers * 2 * args.block_size * mcfg.num_kv_heads * mcfg.head_dim
+        # size tiers in STORED bytes per block (model dtype, or the int8
+        # codec buffer) — a hardcoded 4 bytes/element would under-use the
+        # configured budget 2-4x for bf16/int8 caches. kv_bytes_per_token
+        # is the one byte-accounting source (kvbm/layout).
+        kvd = resolve_kv_dtype(getattr(args, "kv_dtype", "auto"))
+        block_nbytes = int(
+            kv_bytes_per_token(mcfg, args.block_size, kvd) * args.block_size
         )
         remote = None
         if args.kvbm_remote:

@@ -154,6 +154,15 @@ class TpuEngineConfig:
     # constructed with guided_vocab=(vocab byte forms, eos_id).
     guided_max_states: int = 0
     guided_max_classes: int = 320
+    # paged-KV storage precision (ops/quant.py; docs/operations.md "KV
+    # precision"). "auto" defers to DTPU_KV_DTYPE (default "model" — exactly
+    # today's behavior); "int8" stores the cache as int8 with per-block-per-
+    # kv-head f32 scales, halving KV bytes in HBM, on the transfer wire and
+    # in the KVBM tiers vs bf16 (quartering vs f32) and doubling effective
+    # KV capacity per block budget, at a bounded quantization error
+    # (amax/254 per element). The draft model's shadow cache stays in model
+    # dtype — it is small and its values only steer acceptance, never output.
+    kv_dtype: str = "auto"
 
     def __post_init__(self):
         bad = [b for b in self.prefill_buckets if b % self.block_size]
@@ -161,6 +170,13 @@ class TpuEngineConfig:
             raise ValueError(
                 f"prefill_buckets {bad} not multiples of block_size {self.block_size}"
             )
+        from ..ops.quant import resolve_kv_dtype
+
+        self.kv_dtype = resolve_kv_dtype(self.kv_dtype)
+
+    @property
+    def kv_quantized(self) -> bool:
+        return self.kv_dtype == "int8"
 
     @property
     def prefill_chunk(self) -> int:
@@ -325,6 +341,22 @@ class TpuEngine:
     ):
         self.cfg = config
         self.mcfg = config.model
+        # int8 paged KV (config.kv_dtype / DTPU_KV_DTYPE; ops/quant.py):
+        # every cache-touching path below branches on this ONE flag
+        self.kv_quantized = config.kv_quantized
+        if self.kv_quantized:
+            if config.pp > 1:
+                raise ValueError(
+                    "kv_dtype=int8 does not cover pp serving yet (the pp "
+                    "wavefront stacks per-layer caches without the "
+                    "quantize-on-write ops); use tp/sp or kv_dtype=model"
+                )
+            if multihost is not None:
+                raise ValueError(
+                    "kv_dtype=int8 does not cover multihost groups yet (the "
+                    "replay table's kv gather/scatter state wiring moves "
+                    "raw arrays); use kv_dtype=model"
+                )
         # namespace on the multihost dispatch channel: dp ranks / disagg
         # roles sharing one group each get their own replay table
         self._mh_ns = mh_ns
@@ -494,7 +526,12 @@ class TpuEngine:
                         jax.random.PRNGKey(config.seed + 2), dcfg
                     )
                 self.draft_params = self._shard_params(draft_params, dcfg)
-                self.draft_k_caches, self.draft_v_caches = self._init_caches(dcfg)
+                # the draft's shadow cache stays in model dtype even under
+                # kv_dtype=int8: it is spec_k-steps small, and its values
+                # only move the acceptance rate, never the emitted tokens
+                self.draft_k_caches, self.draft_v_caches = self._init_caches(
+                    dcfg, quantized=False
+                )
         # acceptance telemetry (reference reports spec acceptance through
         # its engine metrics). rounds = per-ROW rounds applied (a horizon
         # with A active rows and R rounds adds A*R); emitted = tokens
@@ -702,20 +739,41 @@ class TpuEngine:
             out["layers"].append(slp)
         return out
 
-    def _init_caches(self, mcfg=None) -> Tuple[List[jax.Array], List[jax.Array]]:
+    def _init_caches(
+        self, mcfg=None, quantized: Optional[bool] = None
+    ) -> Tuple[List[jax.Array], List[jax.Array]]:
         mcfg = mcfg if mcfg is not None else self.mcfg
+        if quantized is None:
+            quantized = self.kv_quantized
         shape = (
             self.cfg.num_blocks,
             self.cfg.block_size,
             mcfg.num_kv_heads,
             mcfg.head_dim,
         )
+        tp_n = meshlib.tp_size(self.mesh)
         sharding = NamedSharding(
-            self.mesh,
-            registry.kv_cache_spec(mcfg, meshlib.tp_size(self.mesh)),
+            self.mesh, registry.kv_cache_spec(mcfg, tp_n)
         )
         # host-side zeros: device_put shards them per-process (jnp.zeros would
         # commit to the local default device — invalid for a multi-host mesh)
+        if quantized:
+            from ..ops.quant import SCALE_DTYPE, QuantizedKV
+
+            s_sharding = NamedSharding(
+                self.mesh, registry.kv_scale_spec(mcfg, tp_n)
+            )
+            s_shape = (self.cfg.num_blocks, mcfg.num_kv_heads)
+
+            def qzeros():
+                return QuantizedKV(
+                    jax.device_put(np.zeros(shape, np.int8), sharding),
+                    jax.device_put(np.zeros(s_shape, SCALE_DTYPE), s_sharding),
+                )
+
+            k = [qzeros() for _ in range(mcfg.num_layers)]
+            v = [qzeros() for _ in range(mcfg.num_layers)]
+            return k, v
         zeros = partial(np.zeros, shape, mcfg.dtype)
         k = [jax.device_put(zeros(), sharding) for _ in range(mcfg.num_layers)]
         v = [jax.device_put(zeros(), sharding) for _ in range(mcfg.num_layers)]
@@ -903,6 +961,7 @@ class TpuEngine:
         cfg, mcfg = self.cfg, self.mcfg
         fwd, logits_fn = self._forward, self._lm_logits
         lora_enabled = self.lora is not None
+        quantized = self.kv_quantized
 
         vision_enabled = cfg.vision is not None
 
@@ -1044,27 +1103,53 @@ class TpuEngine:
                 # extra: per-layer attention variants the model opts into
                 # (sliding ``window``, per-head ``sinks`` — models/gptoss.py);
                 # plain families pass nothing and nothing changes
+                k_w, v_w = k_new, v_new
+                if quantized:
+                    # zero the chunk's PADDING rows before quantize-on-write:
+                    # a bucket-padded chunk shares its last real block with
+                    # pad rows (token 0 at position max_context-1) whose
+                    # activations would otherwise enter the per-block amax
+                    # and coarsen the real tokens' quantization. Pad rows
+                    # are never attended (every mask keys off total_len),
+                    # so zeros are safe — and exact for the amax.
+                    valid = (positions < total_len)[:, None, None]
+                    k_w = jnp.where(valid, k_new, 0.0)
+                    v_w = jnp.where(valid, v_new, 0.0)
                 kc, vc = att.write_prefill_kv(
-                    k_caches[layer_idx], v_caches[layer_idx], k_new, v_new, new_block_ids
+                    k_caches[layer_idx], v_caches[layer_idx], k_w, v_w, new_block_ids
                 )
                 k_caches[layer_idx], v_caches[layer_idx] = kc, vc
-                k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
                 if cfg.sp > 1:
                     # context-parallel chunk attention: queries + chunk KV
                     # shard over the sp axis and rotate around the ring; the
-                    # cached prefix is attended locally (parallel/ring.py)
+                    # cached prefix is attended locally (parallel/ring.py).
+                    # gather_kv dequantizes int8 caches, so the ring path
+                    # rides quantization transparently.
+                    k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
                     return ringlib.ring_extend_attention(
                         self.mesh, q, k_new, v_new, k_ctx, v_ctx,
                         positions, chunk_start, chunk_start,
                     )
                 from ..ops import pallas_prefill as pf
 
-                if (
+                flash_ok = (
                     use_pallas
                     and not extra
                     and q.shape[0] % pf.Q_TILE == 0
-                    and k_ctx.shape[0] % pf.KV_TILE == 0
-                ):
+                    and block_table.shape[0] * cfg.block_size % pf.KV_TILE == 0
+                )
+                if flash_ok and quantized:
+                    # raw-int8 gather: the flash kernel streams int8 context
+                    # tiles + per-position scale columns and dequantizes
+                    # in-register (half the context bytes vs bf16)
+                    kq, vq, ks, vs = att.gather_kv_quant(kc, vc, block_table)
+                    return pf.sharded_flash_extend_attention(
+                        self.mesh, meshlib.AXIS_TP,
+                        q, kq, vq, positions, total_len,
+                        k_scales=ks, v_scales=vs, interpret=interp,
+                    )
+                k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
+                if flash_ok:
                     # flash extend kernel (ops/pallas_prefill): O(tile) VMEM
                     # vs the dense [S, h, T] score tensor; TP rides a
                     # shard_map over heads (GSPMD cannot partition a custom
@@ -1279,9 +1364,16 @@ class TpuEngine:
             final chunk returns the normalized last-token hidden state."""
 
             def attend(q, k_new, v_new, layer_idx, **extra):
+                k_w, v_w = k_new, v_new
+                if quantized:
+                    # same pad-row zeroing as the prefill attend: keep
+                    # padding out of the per-block quantization amax
+                    valid = (positions < total_len)[:, None, None]
+                    k_w = jnp.where(valid, k_new, 0.0)
+                    v_w = jnp.where(valid, v_new, 0.0)
                 kc, vc = att.write_prefill_kv(
                     k_caches[layer_idx], v_caches[layer_idx],
-                    k_new, v_new, new_block_ids,
+                    k_w, v_w, new_block_ids,
                 )
                 k_caches[layer_idx], v_caches[layer_idx] = kc, vc
                 k_ctx, v_ctx = att.gather_kv(kc, vc, block_table)
@@ -2129,22 +2221,52 @@ class TpuEngine:
         guarantees the gather reads the pages before any later-dispatched
         decode/prefill can rewrite them after LRU eviction — the host fetch
         itself can then run lazily on the offload thread."""
+        from ..ops import block_copy as bc
+
         ids = jnp.asarray(np.asarray([bid for bid, _, _ in pending], np.int32))
         gathered = []
         for kc, vc in zip(self.k_caches, self.v_caches):
-            gathered.append((kc[ids], vc[ids]))  # [n, bs, kvh, d] each
+            if self.kv_quantized:
+                # payload + scale pages move as one unit (ops/block_copy)
+                gathered.append((
+                    bc.gather_blocks_quant(kc, ids),
+                    bc.gather_blocks_quant(vc, ids),
+                ))
+            else:
+                gathered.append((kc[ids], vc[ids]))  # [n, bs, kvh, d] each
         return gathered
 
     def _offload_fetch(self, pending: List[Tuple[int, int, int]], gathered) -> None:
         """Offload thread: fetch the already-gathered pages and hand them to
         the kvbm priority queue (prefix blocks outrank decode blocks; the
         kvbm worker does the tier writes). Best-effort: failures are logged,
-        never fatal."""
+        never fatal.
+
+        Tier bytes are the STORAGE format (kvbm/layout.block_shape_for):
+        model dtype for float caches — a bf16 model stores bf16 blocks, not
+        2x-inflated float32 — and the flat int8+scales codec buffer for
+        kv_dtype=int8 (bit-exact round trip, no float detour)."""
         try:
+            if self.kv_quantized:
+                codec = self._kv_codec()
+                n = len(pending)
+                pay = np.empty((n,) + codec.payload_shape, np.int8)
+                scl = np.empty((n,) + codec.scales_shape, np.float32)
+                for li, (kq, vq) in enumerate(gathered):
+                    pay[:, li, 0] = np.asarray(kq.data)
+                    pay[:, li, 1] = np.asarray(vq.data)
+                    scl[:, li, 0] = np.asarray(kq.scale)
+                    scl[:, li, 1] = np.asarray(vq.scale)
+                for i, (_, h, prio) in enumerate(pending):
+                    self.kvbm.offload(
+                        h, codec.encode(pay[i], scl[i]), priority=prio
+                    )
+                return
+            store_dtype = np.dtype(self.mcfg.dtype)
             layers = []
             for k_dev, v_dev in gathered:
-                k = np.asarray(k_dev, np.float32)
-                v = np.asarray(v_dev, np.float32)
+                k = np.asarray(k_dev, store_dtype)
+                v = np.asarray(v_dev, store_dtype)
                 layers.append(np.stack([k, v], axis=1))  # [n, 2, bs, kvh, d]
             arr = np.stack(layers, axis=1)               # [n, L, 2, bs, kvh, d]
             for i, (_, h, prio) in enumerate(pending):
@@ -2154,9 +2276,35 @@ class TpuEngine:
         except Exception:
             log.exception("kv offload failed (continuing without write-through)")
 
-    def _scatter_blocks(self, local_ids: List[int], arr: np.ndarray) -> None:
+    def _kv_codec(self):
+        """The int8 block codec shared by the KVBM tiers and the native
+        transfer arena (kvbm/layout.QuantizedBlockCodec)."""
+        from ..kvbm.layout import QuantizedBlockCodec, block_shape_for
+
+        codec = getattr(self, "_kv_codec_cached", None)
+        if codec is None:
+            codec = self._kv_codec_cached = QuantizedBlockCodec(
+                block_shape_for(self.mcfg, self.cfg.block_size, "int8")
+            )
+        return codec
+
+    def _scatter_blocks(self, local_ids: List[int], arr) -> None:
         """Executor thread: device scatter only — no allocator access here
-        (the allocator is single-threaded on the event loop)."""
+        (the allocator is single-threaded on the event loop).
+
+        ``arr`` is either float pages [n, L, 2, bs, kvh, d] or, for int8
+        caches, a (payload int8 [n, L, 2, bs, kvh, d], scales f32
+        [n, L, 2, kvh]) pair that scatters straight into the quantized cache
+        — no float detour, bit-exact. Float pages arriving at a quantized
+        cache (a float-cache transfer peer) quantize on the way in."""
+        if isinstance(arr, tuple) and not self.kv_quantized:
+            # quantized pages arriving at a float cache: dequantize
+            # host-side BEFORE any branch — the multihost scatter below
+            # (multihost engines are always float; int8+mh is gated at
+            # construction) must see plain pages too
+            from ..ops.quant import dequantize_blocks_np
+
+            arr = dequantize_blocks_np(arr[0], arr[1])
         if self._mh is not None:
             # arr [n, L, 2, ...] -> kp/vp [L, n, ...] by value: the scatter
             # is a replayed collective (eager .at[].set on a mesh spanning
@@ -2169,6 +2317,30 @@ class TpuEngine:
             )
             return
         ids = jnp.asarray(np.asarray(local_ids, np.int32))
+        if self.kv_quantized:
+            from ..ops import block_copy as bc
+            from ..ops.quant import QuantizedKV, quantize_blocks_np
+
+            if isinstance(arr, tuple):
+                payload, scales = arr
+            else:
+                payload, scales = quantize_blocks_np(np.asarray(arr))
+            for li in range(payload.shape[1]):
+                self.k_caches[li] = bc.scatter_blocks_quant(
+                    self.k_caches[li], ids,
+                    QuantizedKV(
+                        jnp.asarray(payload[:, li, 0]),
+                        jnp.asarray(np.ascontiguousarray(scales[:, li, 0])),
+                    ),
+                )
+                self.v_caches[li] = bc.scatter_blocks_quant(
+                    self.v_caches[li], ids,
+                    QuantizedKV(
+                        jnp.asarray(payload[:, li, 1]),
+                        jnp.asarray(np.ascontiguousarray(scales[:, li, 1])),
+                    ),
+                )
+            return
         dtype = self.mcfg.dtype
         for li in range(arr.shape[1]):
             k = jnp.asarray(arr[:, li, 0], dtype)
@@ -2176,12 +2348,13 @@ class TpuEngine:
             self.k_caches[li] = self.k_caches[li].at[ids].set(k)
             self.v_caches[li] = self.v_caches[li].at[ids].set(v)
 
-    async def import_blocks(self, hashes: List[int], arr: np.ndarray) -> int:
-        """Import [n, L, 2, bs, kvh, d] as content-addressed cached pages.
+    async def import_blocks(self, hashes: List[int], arr) -> int:
+        """Import [n, L, 2, bs, kvh, d] pages (or an int8 (payload, scales)
+        pair — see _scatter_blocks) as content-addressed cached pages.
         Shared by the kv transfer plane and kvbm onboarding. Allocator
         mutations stay on the event-loop thread; only the scatter runs in
         the executor."""
-        n = arr.shape[0]
+        n = (arr[0] if isinstance(arr, tuple) else arr).shape[0]
         try:
             local_ids = self.allocator.allocate(n)
         except OutOfBlocks:
@@ -2216,6 +2389,38 @@ class TpuEngine:
         arr = await loop.run_in_executor(None, self.kvbm.load_prefix, hashes[have : have + n])
         if arr is None:
             return
+        # format guard: disk/remote tiers survive restarts and are shared
+        # fleet-wide, so blobs written under a DIFFERENT kv_dtype (or model
+        # shape) can come back under the same content hashes — treat them as
+        # a miss and recompute rather than crash the loop or import garbage
+        if self.kv_quantized:
+            codec = self._kv_codec()
+            if (
+                arr.dtype != np.uint8 or arr.ndim != 2
+                or arr.shape[1] != codec.nbytes
+            ):
+                log.warning(
+                    "kvbm blocks are not this engine's int8 codec format "
+                    "(%s %s); skipping onboard — clear stale tiers via "
+                    "/clear_kv_blocks", arr.dtype, arr.shape,
+                )
+                return
+            # decode the flat int8+scales buffers to the (payload, scales)
+            # pair the quantized scatter takes — the round trip never
+            # touches floats, so onboarded blocks are bit-equal to what
+            # was offloaded
+            arr = codec.decode_many(arr)
+        else:
+            expect = (
+                self.mcfg.num_layers, 2, self.cfg.block_size,
+                self.mcfg.num_kv_heads, self.mcfg.head_dim,
+            )
+            if arr.ndim != 6 or arr.shape[1:] != expect:
+                log.warning(
+                    "kvbm blocks do not match this engine's KV layout "
+                    "(%s vs %s); skipping onboard", arr.shape[1:], expect,
+                )
+                return
         got = await self.import_blocks(list(hashes[have : have + n]), arr)
         if got:
             log.debug("onboarded %d blocks from kvbm for %s", got, st.req.request_id[:8])

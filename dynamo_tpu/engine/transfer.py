@@ -50,6 +50,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# name -> dtype for wire fields lives with the block-storage layout:
+# ONE spot (kvbm/layout) resolves ml_dtypes names like "bfloat16"
+from ..kvbm.layout import dtype_from_name as _dtype_from_name
+from ..ops.quant import SCALE_DTYPE
 from ..runtime.engine import Context
 from ..runtime.faults import FAULTS
 from ..runtime.logging import get_logger
@@ -183,14 +187,6 @@ async def import_pages_device(dst, hashes: List[SequenceHash], kp, vp) -> Option
     return n
 
 
-def _dtype_from_name(name: str) -> np.dtype:
-    """np.dtype('bfloat16') is only resolvable through ml_dtypes."""
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes
-
-        return np.dtype(getattr(ml_dtypes, name))
 
 
 class KvTransferServer:
@@ -210,7 +206,21 @@ class KvTransferServer:
         m = self.engine.mcfg
         bs = self.engine.cfg.block_size
         self._block_shape = [m.num_layers, 2, bs, m.num_kv_heads, m.head_dim]
-        self._arena_dtype = np.dtype(m.dtype)  # cache dtype (bf16 halves bytes)
+        # wire bytes are the CACHE storage format: model dtype (bf16 halves
+        # bytes vs f32), or for kv_dtype=int8 the flat payload+scales codec
+        # buffer (halves them again) — blocks then round-trip bit-exactly
+        # with no dequantize/requantize detour on either end
+        self._quantized = bool(getattr(engine, "kv_quantized", False))
+        if self._quantized:
+            self._codec = engine._kv_codec()
+            self._arena_dtype = np.dtype(np.uint8)
+            self._block_nbytes = self._codec.nbytes
+        else:
+            self._codec = None
+            self._arena_dtype = np.dtype(m.dtype)
+            self._block_nbytes = (
+                int(np.prod(self._block_shape)) * self._arena_dtype.itemsize
+            )
         # cross-process device plane: uuid -> (expiry, (k, v) device arrays)
         self._xfer = None
         self._pull_pending: Dict[int, Tuple[float, tuple]] = {}
@@ -226,14 +236,13 @@ class KvTransferServer:
 
             if not native_available():
                 return False
-            block_elems = int(np.prod(self._block_shape))
+            block_elems = self._block_nbytes // self._arena_dtype.itemsize
             self._arena = np.zeros(
                 (self._arena_slots, block_elems), self._arena_dtype
             )
             self._agent = NativeAgent(host=self.host)
             self._agent.register(
-                NATIVE_REGION, self._arena,
-                self._arena_dtype.itemsize * block_elems,
+                NATIVE_REGION, self._arena, self._block_nbytes,
             )
             log.info(
                 "native transfer agent serving on %s:%d (%.0f MiB arena)",
@@ -355,7 +364,14 @@ class KvTransferServer:
             return
         hashes: List[SequenceHash] = list(request.get("hashes", []))
         native_ok = bool(request.get("native_ok")) and self._ensure_native()
-        device_ok = bool(request.get("device_ok")) and self._ensure_device()
+        # int8 caches serve the wire + native planes only: the device-pull /
+        # ICI fast paths move raw cache arrays and do not carry the
+        # payload+scales pair yet
+        device_ok = (
+            bool(request.get("device_ok"))
+            and not self._quantized
+            and self._ensure_device()
+        )
         alloc = self.engine.allocator
         # pin the matched prefix so eviction can't race the device gather
         block_ids = alloc.acquire_prefix(hashes)
@@ -379,6 +395,8 @@ class KvTransferServer:
                     "matched": n,
                     "block_shape": self._block_shape,
                     "dtype": self._arena_dtype.name,
+                    "kv_dtype": "int8" if self._quantized else "model",
+                    "block_bytes": self._block_nbytes,
                     "native": {
                         "host": self.host,
                         "port": self._agent.port,
@@ -395,14 +413,21 @@ class KvTransferServer:
                     },
                 }
             else:
-                data, shape = await self._gather(block_ids)
-                yield {"matched": n, "data": data, "shape": shape}
+                data, shape, dtype_name, scales = await self._gather(block_ids)
+                item = {
+                    "matched": n, "data": data, "shape": shape,
+                    "dtype": dtype_name,
+                }
+                if scales is not None:
+                    item["scales"] = scales  # f32 [L, 2, n, kvh] raw bytes
+                yield item
         finally:
             alloc.release(block_ids)
 
-    def _gather_np(self, block_ids: List[int], dtype=np.float32) -> np.ndarray:
-        """Executor thread: device gather -> [L, 2, n, bs, kvh, d]; dtype=None
-        keeps the cache dtype (native path; bf16 halves the wire bytes)."""
+    def _gather_np(self, block_ids: List[int], dtype=None) -> np.ndarray:
+        """Executor thread: device gather -> [L, 2, n, bs, kvh, d]; dtype
+        None keeps the CACHE dtype (the wire default — bf16 models ship bf16
+        bytes, not a 2x float32 inflation). Float caches only."""
         eng = self.engine
         if eng._mh is not None:
             # multihost group: the gather is a replayed collective whose
@@ -422,14 +447,38 @@ class KvTransferServer:
         arr = np.stack(layers)               # [L, 2, n, bs, kvh, d]
         return arr if dtype is None else arr.astype(dtype)
 
-    async def _gather(self, block_ids: List[int]) -> Tuple[bytes, List[int]]:
+    def _gather_quant_np(self, block_ids: List[int]):
+        """Executor thread, int8 cache: -> (payload int8 [L, 2, n, bs, kvh,
+        d], scales f32 [L, 2, n, kvh]) — the pair IS the wire format; no
+        float materialization anywhere on the serving side."""
+        eng = self.engine
+        ids = jnp.asarray(np.asarray(block_ids, np.int32))
+        pay, scl = [], []
+        for kc, vc in zip(eng.k_caches, eng.v_caches):
+            pay.append(np.stack([
+                np.asarray(kc.data[ids]), np.asarray(vc.data[ids])
+            ]))
+            scl.append(np.stack([
+                np.asarray(kc.scale[ids]), np.asarray(vc.scale[ids])
+            ]))
+        return np.stack(pay), np.stack(scl)
+
+    async def _gather(self, block_ids: List[int]):
+        """Inline wire payload: (data bytes, shape, dtype name, scales bytes
+        or None). Scales present <=> the payload is int8."""
         import asyncio
 
         loop = asyncio.get_event_loop()
 
         def gather():
+            if self._quantized:
+                payload, scales = self._gather_quant_np(block_ids)
+                return (
+                    payload.tobytes(), list(payload.shape), "int8",
+                    scales.tobytes(),
+                )
             arr = self._gather_np(block_ids)
-            return arr.tobytes(), list(arr.shape)
+            return arr.tobytes(), list(arr.shape), arr.dtype.name, None
 
         return await loop.run_in_executor(self.engine._executor, gather)
 
@@ -442,10 +491,21 @@ class KvTransferServer:
         loop = asyncio.get_event_loop()
 
         def gather() -> List[int]:
-            arr = self._gather_np(block_ids, dtype=None)  # [L, 2, n, ...]
-            block_major = np.moveaxis(arr, 2, 0)          # [n, L, 2, ...]
             n = len(block_ids)
-            flat = block_major.reshape(n, -1)
+            if self._quantized:
+                payload, scales = self._gather_quant_np(block_ids)
+                pb = np.moveaxis(payload, 2, 0)  # [n, L, 2, bs, kvh, d]
+                sb = np.moveaxis(scales, 2, 0)   # [n, L, 2, kvh]
+                # bulk pack, one concatenate: byte-identical to per-block
+                # codec.encode (payload bytes then scale bytes, C-order)
+                flat = np.concatenate([
+                    np.ascontiguousarray(pb).reshape(n, -1).view(np.uint8),
+                    np.ascontiguousarray(sb).reshape(n, -1).view(np.uint8),
+                ], axis=1)
+            else:
+                arr = self._gather_np(block_ids)      # [L, 2, n, ...]
+                block_major = np.moveaxis(arr, 2, 0)  # [n, L, 2, ...]
+                flat = block_major.reshape(n, -1)
             sums = []
             for i, s in enumerate(slots):
                 self._arena[s] = flat[i]
@@ -611,6 +671,14 @@ class KvTransferClient:
             # in-process mover would dispatch them leader-only and hang the
             # group — take the wire protocol instead
             local = None
+        if local is not None and (
+            getattr(local.engine, "kv_quantized", False)
+            or getattr(self.engine, "kv_quantized", False)
+        ):
+            # int8 caches: the ICI mover's gather/scatter move raw cache
+            # arrays, not the payload+scales pair — wire protocol instead
+            # (which ships the half-width int8 blocks anyway)
+            local = None
         if local is not None and local.engine is not self.engine:
             moved = await IciKvMover(local.engine, self.engine).move(list(want))
             if moved is not None:
@@ -624,6 +692,7 @@ class KvTransferClient:
         device_ok = (
             device_transfer_available()
             and mesh_is_addressable(self.engine.mesh)
+            and not getattr(self.engine, "kv_quantized", False)
             and alloc.can_allocate(len(want))
         )
         req = {
@@ -652,10 +721,25 @@ class KvTransferClient:
             if block_major is None:
                 return have * alloc.block_size
         else:
-            arr = np.frombuffer(item.get("data", b""), np.float32).reshape(
+            dtype = _dtype_from_name(item.get("dtype", "float32"))
+            arr = np.frombuffer(item.get("data", b""), dtype).reshape(
                 item.get("shape", [])
             )
-            block_major = np.ascontiguousarray(np.moveaxis(arr, 2, 0))
+            if "scales" in item:
+                # int8 wire: payload [L, 2, n, bs, kvh, d] + scales
+                # [L, 2, n, kvh] — import the pair as-is (the engine
+                # scatter quantize/dequantizes only on a cache-mode
+                # mismatch; matched int8 ends round-trip bit-exactly)
+                L, _, n = arr.shape[:3]
+                scales = np.frombuffer(
+                    item["scales"], SCALE_DTYPE
+                ).reshape(L, 2, n, arr.shape[4])
+                block_major = (
+                    np.ascontiguousarray(np.moveaxis(arr, 2, 0)),
+                    np.ascontiguousarray(np.moveaxis(scales, 2, 0)),
+                )
+            else:
+                block_major = np.ascontiguousarray(np.moveaxis(arr, 2, 0))
         imported = await self.engine.import_blocks(
             list(want[:matched]), block_major
         )
@@ -717,9 +801,11 @@ class KvTransferClient:
 
     async def _native_fetch(
         self, address: str, item: Dict[str, Any], matched: int
-    ) -> Optional[np.ndarray]:
+    ):
         """Bulk-fetch leased slots over the C++ agent; returns block-major
-        [n, L, 2, bs, kvh, d] float32 or None on failure (caller recomputes)."""
+        pages [n, L, 2, bs, kvh, d] in the server's wire dtype — or, for an
+        int8 server, the decoded (payload, scales) pair — or None on failure
+        (caller recomputes)."""
         import asyncio
 
         from ..transfer import native_fetch
@@ -727,7 +813,10 @@ class KvTransferClient:
         nat = item["native"]
         block_shape = item["block_shape"]
         dtype = _dtype_from_name(item.get("dtype", "float32"))
-        block_bytes = int(np.prod(block_shape)) * dtype.itemsize
+        quantized = item.get("kv_dtype") == "int8"
+        block_bytes = int(
+            item.get("block_bytes", int(np.prod(block_shape)) * dtype.itemsize)
+        )
         loop = asyncio.get_event_loop()
         try:
             raw = await loop.run_in_executor(
@@ -761,6 +850,15 @@ class KvTransferClient:
                         nat["slots"][i],
                     )
                     return None
+        if quantized:
+            from ..kvbm.layout import BlockShape, QuantizedBlockCodec
+
+            L, _, bs, kvh, d = block_shape
+            codec = QuantizedBlockCodec(BlockShape(
+                num_layers=L, block_size=bs, num_kv_heads=kvh, head_dim=d,
+                dtype=np.dtype(np.int8),
+            ))
+            return codec.decode_many(raw[:matched])
         return raw.view(dtype).reshape([matched] + list(block_shape))
 
     async def close(self) -> None:

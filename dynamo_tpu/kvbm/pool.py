@@ -13,9 +13,13 @@ priority; lower values transfer first, FIFO within a priority, and the
 bounded queue sheds the lowest-priority work under backpressure instead of
 stalling the engine.
 
-Storage layout per block: float32 array [L, 2, bs, kvh, d] (same shape the
-transfer plane uses) — one contiguous buffer per block keeps the host copy
-a single memcpy and the disk tier a single file write.
+Storage layout per block: one contiguous buffer per block (a single memcpy
+for the host copy, a single file write for disk). The BYTES are whatever the
+engine's KV storage format is (kvbm/layout.block_shape_for): model-dtype
+[L, 2, bs, kvh, d] for float caches — bf16 models store bf16, not a 2x
+float32 blow-up — or the flat int8+scales codec buffer for kv_dtype="int8",
+which halves host-RAM and wire bytes per block again. The pools themselves
+are format-agnostic.
 """
 
 from __future__ import annotations
@@ -89,6 +93,45 @@ class HostBlockPool:
         return gone
 
 
+# G3 file format: 4-byte little-endian header length, json {"dtype","shape"},
+# raw C-order bytes. np.save cannot round-trip ml_dtypes (a saved bfloat16
+# block loads back as void '|V2' and poisons onboarding), so the dtype rides
+# an explicit header resolved via layout.dtype_from_name. Legacy .npy files
+# (pre-header spill dirs survive restarts) are still readable.
+_NPY_MAGIC = b"\x93NUMPY"
+
+
+def _write_block_file(path: str, block: np.ndarray) -> None:
+    import json as _json
+
+    header = _json.dumps(
+        {"dtype": block.dtype.name, "shape": list(block.shape)}
+    ).encode()
+    with open(path, "wb") as f:
+        f.write(len(header).to_bytes(4, "little"))
+        f.write(header)
+        f.write(np.ascontiguousarray(block).tobytes())
+
+
+def _read_block_file(path: str) -> np.ndarray:
+    import json as _json
+
+    from .layout import dtype_from_name
+
+    with open(path, "rb") as f:
+        head = f.read(4)
+        if head[:4].startswith(_NPY_MAGIC[:4]):
+            # legacy np.save file from an older spill dir
+            f.seek(0)
+            return np.load(f, allow_pickle=False)
+        n = int.from_bytes(head, "little")
+        meta = _json.loads(f.read(n))
+        data = f.read()
+    return np.frombuffer(data, dtype_from_name(meta["dtype"])).reshape(
+        meta["shape"]
+    )
+
+
 class DiskBlockPool:
     """G3: one file per block under a spill directory, LRU by access order."""
 
@@ -133,8 +176,7 @@ class DiskBlockPool:
                 except FileNotFoundError:
                     pass
         tmp = self._file(h) + f".tmp{os.getpid()}"
-        with open(tmp, "wb") as f:
-            np.save(f, block, allow_pickle=False)
+        _write_block_file(tmp, block)
         os.replace(tmp, self._file(h))
         with self._lock:
             self._lru[h] = None
@@ -146,9 +188,8 @@ class DiskBlockPool:
                 return None
             self._lru.move_to_end(h)
         try:
-            with open(self._file(h), "rb") as f:
-                return np.load(f, allow_pickle=False)
-        except (FileNotFoundError, ValueError):
+            return _read_block_file(self._file(h))
+        except (FileNotFoundError, ValueError, KeyError):
             with self._lock:
                 self._lru.pop(h, None)
             return None
@@ -380,7 +421,13 @@ class KvbmTiers:
         return n
 
     def load_prefix(self, hashes: List[SequenceHash]) -> Optional[np.ndarray]:
-        """Contiguous blocks [n, L, 2, bs, kvh, d] for a matched prefix."""
+        """Contiguous blocks [n, L, 2, bs, kvh, d] (or [n, nbytes] codec
+        buffers) for a matched prefix. The run stops at the first block whose
+        shape/dtype differs from the first: a restart-surviving disk tier or
+        shared remote store can hold blocks written under a different
+        kv_dtype for the same content hashes, and stacking mixed formats
+        would raise instead of degrading to a shorter onboard (the engine's
+        format guard then vets what remains)."""
         blocks = []
         for h in hashes:
             b = self.host.get(h)
@@ -389,6 +436,15 @@ class KvbmTiers:
             if b is None and self.remote is not None:
                 b = self.remote.get(h)
             if b is None:
+                break
+            if blocks and (
+                b.shape != blocks[0].shape or b.dtype != blocks[0].dtype
+            ):
+                log.warning(
+                    "kvbm block %x format %s%s != prefix %s%s; truncating "
+                    "onboard run", h, b.dtype, b.shape,
+                    blocks[0].dtype, blocks[0].shape,
+                )
                 break
             if h not in self.host:
                 self._insert_host(h, b)  # promote G3/G4 -> G2 (with spill)

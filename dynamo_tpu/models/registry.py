@@ -283,3 +283,16 @@ def kv_cache_spec(cfg, tp: int = 1) -> P:
     if kvh == 1 or (tp > 1 and kvh % tp != 0):
         return P(None, None, None, None)
     return meshlib.kv_cache_spec()
+
+
+def kv_scale_spec(cfg, tp: int = 1) -> P:
+    """Sharding for the int8 cache's per-block-per-kv-head scale rows
+    ([num_blocks, kv_heads] f32): the kv-head dim follows the cache payload
+    — sharded over TP exactly when kv_cache_spec shards kv_heads, replicated
+    otherwise (MQA / MLA-latent / non-dividing GQA). One condition, two
+    specs, so payload and scales can never shard apart."""
+    if kv_cache_spec(cfg, tp) == P(None, None, None, None):
+        return P(None, None)
+    from ..parallel.mesh import AXIS_TP as _tp_axis
+
+    return P(None, _tp_axis)

@@ -12,6 +12,12 @@ Layout: paged KV cache per layer is ``[num_blocks, block_size, kv_heads,
 head_dim]`` — block-major so a block is contiguous in HBM (transfer-friendly,
 like the reference KVBM's fully-contiguous layout, lib/llm/src/block_manager/
 layout.rs) with heads minor to keep per-head slices dense for TP sharding.
+
+Every op that touches the cache also accepts the int8 form (ops/quant.py
+``QuantizedKV``: int8 payload + per-block-per-kv-head f32 scales). Writes
+quantize on the way in; gathers dequantize on the way out — so this file is
+the numerics reference the Pallas kernels and the CPU tier-1 tests pin
+against, float and int8 alike.
 """
 
 from __future__ import annotations
@@ -20,6 +26,14 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from .quant import (
+    QuantizedKV,
+    dequantize_blocks,
+    is_quantized,
+    quantize_blocks,
+    requantize_token,
+)
 
 NEG_INF = -1e30
 
@@ -123,19 +137,54 @@ def extend_attention(
 
 
 def gather_kv(
-    k_cache: jax.Array,      # [num_blocks, block_size, kvh, d]
+    k_cache: jax.Array,      # [num_blocks, block_size, kvh, d] (or QuantizedKV)
     v_cache: jax.Array,
     block_table: jax.Array,  # [max_blocks] int32 (padded with 0)
 ) -> Tuple[jax.Array, jax.Array]:
-    """Gather one sequence's KV pages into contiguous [max_blocks*bs, kvh, d]."""
+    """Gather one sequence's KV pages into contiguous [max_blocks*bs, kvh, d].
+
+    Quantized caches dequantize during the gather (f32 out): the HBM read is
+    still the int8 payload + tiny scale rows, which is where the bandwidth
+    win lives; every consumer casts to f32 for the matmuls anyway."""
     bs = k_cache.shape[1]
-    k = k_cache[block_table]  # [max_blocks, bs, kvh, d]
-    v = v_cache[block_table]
     mb = block_table.shape[0]
+    if is_quantized(k_cache):
+        k = dequantize_blocks(
+            k_cache.data[block_table], k_cache.scale[block_table]
+        )
+        v = dequantize_blocks(
+            v_cache.data[block_table], v_cache.scale[block_table]
+        )
+    else:
+        k = k_cache[block_table]  # [max_blocks, bs, kvh, d]
+        v = v_cache[block_table]
     return (
         k.reshape(mb * bs, *k.shape[2:]),
         v.reshape(mb * bs, *v.shape[2:]),
     )
+
+
+def gather_kv_quant(
+    k_cache: QuantizedKV,
+    v_cache: QuantizedKV,
+    block_table: jax.Array,  # [max_blocks] int32
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Raw-int8 gather for kernels that dequantize in-register
+    (ops/pallas_prefill): (k int8 [T, kvh, d], v int8, k_scales f32 [T, kvh],
+    v_scales f32 [T, kvh]) with the per-block scales broadcast to positions."""
+    bs = k_cache.shape[1]
+    mb = block_table.shape[0]
+
+    def pick(c):
+        q = c.data[block_table].reshape(mb * bs, *c.shape[2:])
+        s = jnp.broadcast_to(
+            c.scale[block_table][:, None, :], (mb, bs, c.shape[2])
+        ).reshape(mb * bs, c.shape[2])
+        return q, s
+
+    kq, ks = pick(k_cache)
+    vq, vs = pick(v_cache)
+    return kq, vq, ks, vs
 
 
 def paged_decode_attention(
@@ -208,11 +257,30 @@ def write_prefill_kv(
 
     The caller pads S to a block multiple and supplies one destination block
     per chunk; padding rows land in a scratch block (block 0 by convention is
-    reserved as scratch so garbage writes are harmless)."""
+    reserved as scratch so garbage writes are harmless).
+
+    Quantized caches quantize-on-write: prefill writes whole blocks, so the
+    per-block amax (and thus the scale) is computed in one shot — no rescale
+    ever needed on this path. The amax covers EVERY row passed, so callers
+    must zero bucket-padding rows first (the engine's prefill attend does)
+    or pad activations inflate the real tokens' scale."""
     bs = k_cache.shape[1]
     S = k_new.shape[0]
     k_blocks = k_new.reshape(S // bs, bs, *k_new.shape[1:])
     v_blocks = v_new.reshape(S // bs, bs, *v_new.shape[1:])
+    if is_quantized(k_cache):
+        kq, ks = quantize_blocks(k_blocks)
+        vq, vs = quantize_blocks(v_blocks)
+        return (
+            QuantizedKV(
+                k_cache.data.at[block_ids].set(kq),
+                k_cache.scale.at[block_ids].set(ks),
+            ),
+            QuantizedKV(
+                v_cache.data.at[block_ids].set(vq),
+                v_cache.scale.at[block_ids].set(vs),
+            ),
+        )
     return k_cache.at[block_ids].set(k_blocks), v_cache.at[block_ids].set(v_blocks)
 
 
@@ -224,7 +292,34 @@ def write_decode_kv(
     block_ids: jax.Array,     # [B] destination block of each seq's current pos
     offsets: jax.Array,       # [B] offset within the block
 ) -> Tuple[jax.Array, jax.Array]:
-    """Scatter one token per sequence into its page slot (decode path)."""
+    """Scatter one token per sequence into its page slot (decode path).
+
+    Quantized caches do a read-modify-write of the ONE destination block per
+    row: the block scale grows to cover the new token and the existing ints
+    rescale once (ops/quant.requantize_token — a bit-exact no-op whenever the
+    scale is unchanged, the common case). A write at offset 0 is the FIRST
+    row of a freshly-(re)allocated block, so the inherited scale is a stale
+    leftover from the block's previous occupant and is reset — otherwise a
+    recycled block that once held large activations would quantize a small
+    new token to zero. Inactive rows all target scratch block 0;
+    duplicate-index write order there is undefined and harmless."""
+    if is_quantized(k_cache):
+        B = k_new.shape[0]
+        rows = jnp.arange(B)
+        fresh = (offsets == 0)[:, None]  # [B, 1] broadcast over kvh
+
+        def wr(cache, x_new):
+            s_base = jnp.where(fresh, 0.0, cache.scale[block_ids])
+            blk, s_new, q_new = requantize_token(
+                cache.data[block_ids], s_base, x_new
+            )
+            blk = blk.at[rows, offsets].set(q_new)
+            return QuantizedKV(
+                cache.data.at[block_ids].set(blk),
+                cache.scale.at[block_ids].set(s_new),
+            )
+
+        return wr(k_cache, k_new), wr(v_cache, v_new)
     return (
         k_cache.at[block_ids, offsets].set(k_new),
         v_cache.at[block_ids, offsets].set(v_new),

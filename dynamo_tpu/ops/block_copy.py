@@ -161,5 +161,49 @@ def copy_blocks_ref(
     return cache.at[dst_ids].set(cache[src_ids])
 
 
+# -- quantized caches (ops/quant.QuantizedKV) --------------------------------
+# A quantized page move is two moves — the int8 payload and its f32 scale
+# row — that MUST travel together (a payload under the wrong scale is silent
+# corruption, not an error). These wrappers keep the pair atomic for the
+# KVBM offload/onboard and transfer staging paths; per-array they reuse the
+# same DMA kernels/refs above, so the TPU path stays all-async.
+# NOTE (hardware): the scale array's DMA slice is a [kvh] f32 row (minor dim
+# not 128-aligned) — the SAME Mosaic caveat flagged on the in-kernel scale
+# DMA in pallas_attention._decode_kernel; the first real-TPU int8 run must
+# confirm both sites (fallback: the _ref paths below, or kv_dtype=model).
+def gather_blocks_quant(cache, block_ids: jax.Array, *, interpret: bool = False):
+    """QuantizedKV pages -> (payload [M, bs, kvh, d] int8, scales [M, kvh])."""
+    from .quant import QuantizedKV
+
+    if on_tpu() or interpret:
+        return QuantizedKV(
+            gather_blocks(cache.data, block_ids, interpret=interpret),
+            gather_blocks(cache.scale, block_ids, interpret=interpret),
+        )
+    return QuantizedKV(
+        gather_blocks_ref(cache.data, block_ids),
+        gather_blocks_ref(cache.scale, block_ids),
+    )
+
+
+def scatter_blocks_quant(
+    cache, block_ids: jax.Array, blocks, *, interpret: bool = False
+):
+    """Scatter (payload, scales) pages into a QuantizedKV cache."""
+    from .quant import QuantizedKV
+
+    if on_tpu() or interpret:
+        return QuantizedKV(
+            scatter_blocks(cache.data, block_ids, blocks.data,
+                           interpret=interpret),
+            scatter_blocks(cache.scale, block_ids, blocks.scale,
+                           interpret=interpret),
+        )
+    return QuantizedKV(
+        scatter_blocks_ref(cache.data, block_ids, blocks.data),
+        scatter_blocks_ref(cache.scale, block_ids, blocks.scale),
+    )
+
+
 def on_tpu() -> bool:
     return jax.default_backend() == "tpu"

@@ -28,6 +28,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import shard_map
+from .quant import QuantizedKV, is_quantized
 
 NEG_INF = -1e30
 
@@ -38,18 +39,27 @@ def _decode_kernel(
     lens_ref,       # [B] int32 context lengths (incl. current token)
     # inputs
     q_ref,          # VMEM [1, h, d] this sequence's query
-    k_hbm,          # ANY/HBM [num_blocks, bs, kvh, d]
+    k_hbm,          # ANY/HBM [num_blocks, bs, kvh, d] (model dtype or int8)
     v_hbm,          # ANY/HBM [num_blocks, bs, kvh, d]
+    # quantized=True only: ks_hbm/vs_hbm ANY/HBM [num_blocks, kvh] f32 scales
     # outputs
-    o_ref,          # VMEM [1, h, d]
+    # o_ref         VMEM [1, h, d]
     # scratch
-    k_buf,          # VMEM [2, CP, bs, kvh, d] double-buffered page chunks
-    v_buf,          # VMEM [2, CP, bs, kvh, d]
-    sem,            # DMA sems [2, 2, CP] (k/v, slot, page)
-    *,
+    # k_buf/v_buf   VMEM [2, CP, bs, kvh, d] double-buffered page chunks
+    # quantized=True only: ks_buf/vs_buf VMEM [2, CP, kvh] f32 scale rows
+    # sem           DMA sems [2, 2, CP] (k/v, slot, page)
+    # quantized=True only: ssem DMA sems [2, 2, CP] for the scale rows
+    *rest,
     max_blocks: int,
     chunk_pages: int,
+    quantized: bool,
 ):
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf, sem,
+         ssem) = rest
+    else:
+        o_ref, k_buf, v_buf, sem = rest
+        ks_hbm = vs_hbm = ks_buf = vs_buf = ssem = None
     b = pl.program_id(0)
     bs, kvh, d = k_hbm.shape[1], k_hbm.shape[2], k_hbm.shape[3]
     h = q_ref.shape[1]
@@ -70,12 +80,30 @@ def _decode_kernel(
             src.at[idx], dst.at[slot, j], sem.at[kind, slot, j]
         )
 
+    def scale_dma(kind, c, j, slot):
+        """Scale-row DMA for page j: rides the same prefetched table index
+        the page DMA uses — [kvh] f32 per page, ~1000x smaller than the
+        payload it describes. NOTE (hardware): this slice's minor dim is
+        kvh, not 128-aligned; CPU tier-1 only exercises interpret mode, so
+        the first real-TPU int8 run must confirm Mosaic accepts the copy
+        (fallback if not: use_pallas=False or pad scales to [nb, kvh, 128]
+        sublane-major)."""
+        idx = tables_ref[b * max_blocks + c * CP + j]
+        src = ks_hbm if kind == 0 else vs_hbm
+        dst = ks_buf if kind == 0 else vs_buf
+        return pltpu.make_async_copy(
+            src.at[idx], dst.at[slot, j], ssem.at[kind, slot, j]
+        )
+
     def start_chunk(c, slot):
         for j in range(CP):  # static unroll; guard ragged tail
             @pl.when(c * CP + j < num_pages)
             def _():
                 page_dma(0, c, j, slot).start()
                 page_dma(1, c, j, slot).start()
+                if quantized:
+                    scale_dma(0, c, j, slot).start()
+                    scale_dma(1, c, j, slot).start()
 
     def wait_chunk(c, slot):
         for j in range(CP):
@@ -83,6 +111,9 @@ def _decode_kernel(
             def _():
                 page_dma(0, c, j, slot).wait()
                 page_dma(1, c, j, slot).wait()
+                if quantized:
+                    scale_dma(0, c, j, slot).wait()
+                    scale_dma(1, c, j, slot).wait()
 
     start_chunk(0, 0)
 
@@ -99,8 +130,21 @@ def _decode_kernel(
 
         wait_chunk(c, slot)
 
-        k = k_buf[slot].reshape(T, kvh, d).astype(jnp.float32)
-        v = v_buf[slot].reshape(T, kvh, d).astype(jnp.float32)
+        if quantized:
+            # dequantize in-register: int8 page chunks -> f32 scaled by the
+            # per-(page, kv-head) rows that just DMA'd in alongside them.
+            # HBM traffic for the K/V bytes themselves is halved vs bf16.
+            k = (
+                k_buf[slot].astype(jnp.float32)
+                * ks_buf[slot][:, None, :, None]
+            ).reshape(T, kvh, d)
+            v = (
+                v_buf[slot].astype(jnp.float32)
+                * vs_buf[slot][:, None, :, None]
+            ).reshape(T, kvh, d)
+        else:
+            k = k_buf[slot].reshape(T, kvh, d).astype(jnp.float32)
+            v = v_buf[slot].reshape(T, kvh, d).astype(jnp.float32)
         # rows past seq_len were never DMA'd (garbage / NaN): scores are
         # masked below, but V must be zeroed too — 0-weight * NaN = NaN in
         # the PV matmul otherwise
@@ -162,29 +206,51 @@ def paged_decode_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """Ragged paged decode attention (Pallas). Same semantics as
-    ``ops.attention.paged_decode_attention``."""
+    ``ops.attention.paged_decode_attention``. ``k_cache``/``v_cache`` may be
+    ``QuantizedKV`` (int8 payload + per-block scales): the kernel DMAs the
+    int8 pages plus their scale rows and dequantizes in-register, so the
+    per-page HBM bytes halve vs bf16."""
     B, h, d = q.shape
     _, bs, kvh, _ = k_cache.shape
     max_blocks = block_tables.shape[1]
     chunk_pages = max(1, chunk_tokens // bs)
+    quantized = is_quantized(k_cache)
 
     kernel = functools.partial(
-        _decode_kernel, max_blocks=max_blocks, chunk_pages=chunk_pages
+        _decode_kernel, max_blocks=max_blocks, chunk_pages=chunk_pages,
+        quantized=quantized,
     )
+    cache_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((2, chunk_pages, bs, kvh, d), k_cache.dtype),
+        pltpu.VMEM((2, chunk_pages, bs, kvh, d), v_cache.dtype),
+    ]
+    if quantized:
+        cache_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # k scales [num_blocks, kvh]
+            pl.BlockSpec(memory_space=pl.ANY),  # v scales
+        ]
+        scratch += [
+            pltpu.VMEM((2, chunk_pages, kvh), jnp.float32),
+            pltpu.VMEM((2, chunk_pages, kvh), jnp.float32),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2, chunk_pages)))
+    if quantized:
+        scratch.append(pltpu.SemaphoreType.DMA((2, 2, chunk_pages)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=[pl.BlockSpec((1, h, d), lambda b, *_: (b, 0, 0))]
+        + cache_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda b, *_: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, chunk_pages, bs, kvh, d), k_cache.dtype),
-            pltpu.VMEM((2, chunk_pages, bs, kvh, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, chunk_pages)),
-        ],
+        scratch_shapes=scratch,
+    )
+    cache_args = (
+        (k_cache.data, v_cache.data, k_cache.scale, v_cache.scale)
+        if quantized else (k_cache, v_cache)
     )
     return pl.pallas_call(
         kernel,
@@ -195,8 +261,7 @@ def paged_decode_attention(
         block_tables.reshape(-1).astype(jnp.int32),
         seq_lens.astype(jnp.int32),
         q,
-        k_cache,
-        v_cache,
+        *cache_args,
     )
 
 
@@ -218,13 +283,18 @@ def sharded_paged_decode_attention(
         return paged_decode_attention(
             q, k_cache, v_cache, block_tables, seq_lens, **kw
         )
+    cache_spec = P(None, None, tp_axis, None)
+    if is_quantized(k_cache):
+        # spec tree mirrors the QuantizedKV pytree: payload shards on
+        # kv_heads like the float cache, scale rows on their kv-head dim
+        cache_spec = QuantizedKV(cache_spec, P(None, tp_axis))
     fn = shard_map(
         functools.partial(paged_decode_attention, **kw),
         mesh=mesh,
         in_specs=(
             P(None, tp_axis, None),
-            P(None, None, tp_axis, None),
-            P(None, None, tp_axis, None),
+            cache_spec,
+            cache_spec,
             P(None, None),
             P(None),
         ),

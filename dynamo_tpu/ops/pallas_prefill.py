@@ -47,11 +47,16 @@ def _prefill_kernel(
     q_ref,       # VMEM [1, TQ, g, d] this (kv_head, q_tile)'s queries
     k_ref,       # VMEM [1, KT, d] one KV tile of this kv_head's context
     v_ref,       # VMEM [1, KT, d]
-    o_ref,       # VMEM [1, TQ, g, d]
-    m_scr,       # VMEM [TQ*g, 1] f32 online-softmax running max
-    l_scr,       # VMEM [TQ*g, 1] f32 running denominator
-    acc_scr,     # VMEM [TQ*g, d] f32 running numerator
+    # quantized=True only: ks_ref/vs_ref VMEM [1, KT, 1] f32 per-position
+    # scales (per-block scales broadcast at gather time)
+    *rest,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     # Mosaic only loads SCALARS from SMEM, so q positions can't arrive as a
     # prefetched vector; they're derived from start_ref + the row iota
     # instead (engine chunks are contiguous — _chunk_arrays). Both the
@@ -87,6 +92,12 @@ def _prefill_kernel(
         lim2 = jnp.minimum(pos + 1, tlen)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # dequantize in-register: [KT, d] int8 tile * [KT, 1] scale
+            # column (lane-dim broadcast) — the HBM->VMEM tile stream stays
+            # int8, so prefill context reads halve vs bf16 too
+            k = k * ks_ref[0]
+            v = v * vs_ref[0]
         s = jax.lax.dot_general(
             q2, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -122,6 +133,8 @@ def flash_extend_attention(
     q_positions: jax.Array,  # [S] absolute positions
     total_len: jax.Array,    # scalar valid context length
     *,
+    k_scales: jax.Array = None,  # [T, kvh] f32: k_ctx/v_ctx are int8 pages
+    v_scales: jax.Array = None,  # (ops.attention.gather_kv_quant output)
     q_tile: int = Q_TILE,
     kv_tile: int = KV_TILE,
     interpret: bool = False,
@@ -130,10 +143,14 @@ def flash_extend_attention(
     q_positions (the engine's chunks are: row i sits at q_positions[0]+i;
     padded tail rows may carry arbitrary positions — their output is
     discarded by the caller). S and T must be multiples of the tile sizes
-    (the engine's bucketed chunks are)."""
+    (the engine's bucketed chunks are).
+
+    With ``k_scales``/``v_scales`` the context is int8 (quantized paged
+    cache) and the kernel dequantizes each tile in-register."""
     S, h, d = q.shape
     T, kvh, _ = k_ctx.shape
     g = h // kvh
+    quantized = k_scales is not None
     if S % q_tile or T % kv_tile:
         raise ValueError(
             f"S={S} / T={T} not multiples of tiles ({q_tile}, {kv_tile})"
@@ -146,14 +163,26 @@ def flash_extend_attention(
     kg = k_ctx.transpose(1, 0, 2)  # [kvh, T, d]
     vg = v_ctx.transpose(1, 0, 2)
 
+    in_specs = [
+        pl.BlockSpec((1, q_tile, g, d), lambda kh, qt, c, *_: (kh, qt, 0, 0)),
+        pl.BlockSpec((1, kv_tile, d), lambda kh, qt, c, *_: (kh, c, 0)),
+        pl.BlockSpec((1, kv_tile, d), lambda kh, qt, c, *_: (kh, c, 0)),
+    ]
+    args = [qg, kg, vg]
+    if quantized:
+        # [T, kvh] -> [kvh, T, 1]: tiles broadcast over the lane (d) dim
+        in_specs += [
+            pl.BlockSpec((1, kv_tile, 1), lambda kh, qt, c, *_: (kh, c, 0)),
+            pl.BlockSpec((1, kv_tile, 1), lambda kh, qt, c, *_: (kh, c, 0)),
+        ]
+        args += [
+            k_scales.astype(jnp.float32).T[:, :, None],
+            v_scales.astype(jnp.float32).T[:, :, None],
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(kvh, nq, nkv),
-        in_specs=[
-            pl.BlockSpec((1, q_tile, g, d), lambda kh, qt, c, *_: (kh, qt, 0, 0)),
-            pl.BlockSpec((1, kv_tile, d), lambda kh, qt, c, *_: (kh, c, 0)),
-            pl.BlockSpec((1, kv_tile, d), lambda kh, qt, c, *_: (kh, c, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, q_tile, g, d), lambda kh, qt, c, *_: (kh, qt, 0, 0)
         ),
@@ -164,14 +193,14 @@ def flash_extend_attention(
         ],
     )
     out = pl.pallas_call(
-        _prefill_kernel,
+        functools.partial(_prefill_kernel, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((kvh, S, g, d), q.dtype),
         interpret=interpret,
     )(
         q_positions[:1].astype(jnp.int32),  # chunk start (row 0's position)
         jnp.asarray(total_len, jnp.int32).reshape(1),
-        qg, kg, vg,
+        *args,
     )
     # [kvh, S, g, d] -> [S, h, d]
     return out.transpose(1, 0, 2, 3).reshape(S, h, d)
@@ -185,6 +214,8 @@ def sharded_flash_extend_attention(
     v_ctx: jax.Array,
     q_positions: jax.Array,
     total_len: jax.Array,
+    k_scales: jax.Array = None,
+    v_scales: jax.Array = None,
     **kw,
 ) -> jax.Array:
     """TP-sharded wrapper: extend attention is head-wise independent, so each
@@ -193,19 +224,33 @@ def sharded_flash_extend_attention(
     treatment as pallas_attention.sharded_paged_decode_attention."""
     if mesh.shape[tp_axis] == 1:
         return flash_extend_attention(
-            q, k_ctx, v_ctx, q_positions, total_len, **kw
+            q, k_ctx, v_ctx, q_positions, total_len,
+            k_scales=k_scales, v_scales=v_scales, **kw
         )
+    in_specs = [
+        P(None, tp_axis, None),
+        P(None, tp_axis, None),
+        P(None, tp_axis, None),
+        P(None),
+        P(),
+    ]
+    args = [q, k_ctx, v_ctx, q_positions, total_len]
+    if k_scales is not None:
+        # int8 context: scale rows shard on their kv-head dim with the pages
+        in_specs += [P(None, tp_axis), P(None, tp_axis)]
+        args += [k_scales, v_scales]
+
+    def body(q_, k_, v_, pos_, tlen_, *scales_):
+        ks_, vs_ = scales_ if scales_ else (None, None)
+        return flash_extend_attention(
+            q_, k_, v_, pos_, tlen_, k_scales=ks_, v_scales=vs_, **kw
+        )
+
     fn = shard_map(
-        functools.partial(flash_extend_attention, **kw),
+        body,
         mesh=mesh,
-        in_specs=(
-            P(None, tp_axis, None),
-            P(None, tp_axis, None),
-            P(None, tp_axis, None),
-            P(None),
-            P(),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, tp_axis, None),
         check_vma=False,
     )
-    return fn(q, k_ctx, v_ctx, q_positions, total_len)
+    return fn(*args)

@@ -38,6 +38,15 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy tests (multi-engine spec-decode builds) excluded "
+        "from the tier-1 run (-m 'not slow'); run them serially via "
+        "-m slow — they time out under parallel/xdist runs on this image",
+    )
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests on a fresh event loop (no pytest-asyncio here)."""

@@ -56,6 +56,22 @@ class TestDiskPool:
         assert len(p) == 2
         assert p.get(3) is not None
 
+    def test_bf16_roundtrip_keeps_dtype(self, tmp_path):
+        """Model-dtype blocks (bf16) survive the disk tier: np.save/np.load
+        silently degrade ml_dtypes arrays to void ('|V2'), which is why the
+        tier writes an explicit dtype header instead."""
+        b = np.zeros((2, 2, 4, 2, 8), np.dtype(jnp.bfloat16))
+        b += np.asarray(1.5, b.dtype)
+        p = DiskBlockPool(str(tmp_path), 10 * b.nbytes, b.nbytes)
+        p.store(0xB16, b)
+        got = p.get(0xB16)
+        assert got.dtype == b.dtype, got.dtype
+        np.testing.assert_array_equal(got, b)
+        # uint8 codec buffers (int8 KV mode) round-trip too
+        buf = np.arange(64, dtype=np.uint8)
+        p.store(0xC0DE, buf)
+        np.testing.assert_array_equal(p.get(0xC0DE), buf)
+
 
 class TestTiers:
     def test_spillover_and_promotion(self, tmp_path):
@@ -73,6 +89,20 @@ class TestTiers:
         arr = tiers.load_prefix([0, 1])
         np.testing.assert_array_equal(arr[0], blk(0))
         assert 0 in tiers.host  # promoted G3 -> G2
+
+    def test_mixed_format_prefix_truncates(self):
+        """A tier holding blocks written under a different kv format (e.g.
+        int8 codec buffers next to float blocks after a restart with a new
+        DTPU_KV_DTYPE) yields the longest same-format run instead of a
+        np.stack crash that would kill every onboard of that prefix."""
+        nbytes = blk(0).nbytes
+        tiers = KvbmTiers(nbytes, host_capacity_bytes=10 * nbytes)
+        tiers.store(0, blk(0))
+        tiers.store(1, np.arange(16, dtype=np.uint8))  # foreign format
+        tiers.store(2, blk(2))
+        arr = tiers.load_prefix([0, 1, 2])
+        assert arr.shape[0] == 1
+        np.testing.assert_array_equal(arr[0], blk(0))
 
 
 # ------------------------------------------------------------------- engine

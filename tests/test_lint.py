@@ -45,6 +45,23 @@ def test_linter_catches_unused_import(tmp_path):
     assert r.returncode == 1 and "UNUSED-IMPORT: json" in r.stdout
 
 
+def test_linter_catches_kv_float32(tmp_path):
+    """Raw float32 KV buffers in KV-plane files (kvbm/, transfer) are
+    flagged; the central layout helper is exempt."""
+    kvbm = tmp_path / "kvbm"
+    kvbm.mkdir()
+    bad = kvbm / "pool2.py"
+    bad.write_text("import numpy as np\nBLK = np.zeros((4,), np.float32)\n")
+    ok = kvbm / "layout.py"
+    ok.write_text("import numpy as np\nD = np.dtype(np.float32)\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(kvbm)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "KV-DTYPE" in r.stdout
+    assert "layout.py" not in r.stdout
+
+
 def test_linter_catches_wrong_arity(tmp_path):
     bad = tmp_path / "bad3.py"
     bad.write_text(

@@ -29,6 +29,12 @@ from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.parallel.mesh import make_mesh
 from dynamo_tpu.runtime import Context
 
+# every test here builds 2+ engines (main + draft programs compile
+# separately) — with the persistent XLA cache disabled on this image that is
+# minutes of compile per test, which times out under parallel runs; tier-1
+# skips the file (-m 'not slow'), run it serially with -m slow
+pytestmark = pytest.mark.slow
+
 MODEL = LlamaConfig(
     vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
     num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,

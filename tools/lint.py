@@ -316,6 +316,46 @@ def adhoc_retry(path: str, tree: ast.AST):
     return out
 
 
+# KV-plane files where a raw float32 KV buffer is a latent 2-4x byte bug:
+# bf16 models must store/ship model-dtype bytes and int8 caches the
+# payload+scales codec buffer — both via the central helper
+# (kvbm/layout.block_shape_for / QuantizedBlockCodec), which is the ONE
+# exempt file. engine/engine.py is out of scope (float32 there is sampling
+# state, not KV bytes).
+def _is_kv_plane_file(norm_path: str) -> bool:
+    if norm_path.endswith("kvbm/layout.py"):
+        return False  # the central layout helper owns the dtype decision
+    return (
+        "/kvbm/" in norm_path
+        or norm_path.endswith("engine/transfer.py")
+        or "dynamo_tpu/transfer/" in norm_path
+        or norm_path.endswith("ops/block_copy.py")
+    )
+
+
+def kv_float32_allocations(path: str, tree: ast.AST):
+    """np.float32 / jnp.float32 anywhere in a KV-plane file (allocation
+    dtypes, astype targets, frombuffer dtypes): KV buffers take their dtype
+    from kvbm/layout.block_shape_for (model dtype or the int8 codec), never
+    a float32 literal — the exact hardcoding that made bf16 models pay 2x
+    host-RAM and wire bytes per block."""
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "float32"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("np", "jnp", "numpy")
+        ):
+            out.append((
+                path, node.lineno,
+                "KV-DTYPE: raw float32 in a KV-plane file — derive the "
+                "dtype from kvbm/layout.block_shape_for (model dtype / "
+                "int8 codec) instead",
+            ))
+    return out
+
+
 def _ident_tokens(text: str):
     tok = ""
     for ch in text:
@@ -359,6 +399,10 @@ def main(argv) -> int:
         norm = path.replace(os.sep, "/")
         if not norm.endswith(("runtime/resilience.py", "runtime/faults.py")):
             for p, lineno, msg in adhoc_retry(path, tree):
+                print(f"{p}:{lineno}: {msg}")
+                bad += 1
+        if _is_kv_plane_file(norm):
+            for p, lineno, msg in kv_float32_allocations(path, tree):
                 print(f"{p}:{lineno}: {msg}")
                 bad += 1
     if bad:
