@@ -60,6 +60,22 @@ def model_config() -> LlamaConfig:
     return LlamaConfig.qwen3_0_6b(vocab_size=151936)
 
 
+def _phase_summary(samples: list) -> dict:
+    """mean/p99 step duration + occupancy for one phase's StepStats — the
+    baseline future perf PRs diff against (engine/telemetry.py)."""
+    durs = sorted(s.duration_s for s in samples)
+    n = len(durs)
+    return {
+        "steps": n,
+        "mean_ms": round(sum(durs) / n * 1e3, 3),
+        "p99_ms": round(durs[min(n - 1, int(n * 0.99))] * 1e3, 3),
+        "mean_occupancy": round(
+            sum(s.batch_occupancy for s in samples) / n, 2
+        ),
+        "mean_tokens_per_step": round(sum(s.tokens for s in samples) / n, 2),
+    }
+
+
 def roofline_tokens_per_s(cfg: LlamaConfig, batch: int, ctx: int) -> float:
     """Bandwidth-bound decode estimate for one v5e chip (~816 GB/s HBM)."""
     bw = 816e9
@@ -103,6 +119,10 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
         kv_dtype=kv_dtype,
     )
     engine = TpuEngine(cfg)
+    # per-phase step telemetry rides the engine's StepStats hook; warmup
+    # samples (compile-dominated) are discarded before the timed run
+    step_log: dict = {}
+    engine.stats_hook = lambda s: step_log.setdefault(s.phase, []).append(s)
 
     async def one(i: int, n_tokens: int, t_first: list):
         req = PreprocessedRequest(
@@ -122,6 +142,7 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
     try:
         # warmup: compile prefill + decode
         await asyncio.gather(*[one(i, WARMUP_TOKENS, []) for i in range(batch)])
+        step_log.clear()
         # timed run
         t_firsts: list = []
         t0 = time.monotonic()
@@ -162,6 +183,11 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
             "kv_bytes_per_token": kv_bytes_per_token(
                 mcfg, cfg.block_size, kv_dtype
             ),
+            "step_telemetry": {
+                phase: _phase_summary(samples)
+                for phase, samples in sorted(step_log.items())
+                if samples
+            },
         },
     }
 

@@ -597,6 +597,17 @@ async def main() -> None:
         engine = DpEngineGroup(engines)
     else:
         engine = engines[0]
+    # step telemetry (engine/telemetry.py): every rank's loop feeds StepStats
+    # into the runtime registry under the component hierarchy labels, so
+    # /metrics exposes step-duration/occupancy/queue-depth per (worker, rank)
+    from dynamo_tpu.engine.telemetry import EngineTelemetry
+
+    tele_scope = runtime.metrics.child(
+        dtpu_namespace=args.namespace, dtpu_component=component,
+        dtpu_endpoint=args.endpoint,
+    )
+    for r, e in enumerate(engines):
+        e.stats_hook = EngineTelemetry(tele_scope.child(dp_rank=str(r))).on_step
     if mh is not None:
         # follower death is unrecoverable for the group (its mesh shards are
         # gone): mark every engine unhealthy — the watchdog deregisters and

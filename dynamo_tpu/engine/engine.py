@@ -55,10 +55,13 @@ from ..runtime.errors import (
     InvalidRequestError,
 )
 from ..runtime.faults import FAULTS
+from ..runtime.flight_recorder import get_flight_recorder
 from ..runtime.tasks import spawn_bg
 from ..runtime.logging import get_logger
+from ..runtime.tracing import get_tracer
 from ..tokens import TokenBlockSequence
 from .allocator import BlockAllocator, OutOfBlocks
+from .telemetry import StepStats
 from .sampling import (
     TOP_LOGPROBS_K,
     apply_penalties,
@@ -296,6 +299,13 @@ class _Seq:
     # draft prefill: their draft KV would never be read.
     spec_ok: bool = True
     done: bool = False
+    # lifecycle milestones (unix ns, 0 = not reached): stamped host-side by
+    # the loop / accept path, turned into engine.queue / engine.prefill /
+    # engine.decode spans + flight-recorder events when the request finishes
+    t_queued: int = 0
+    t_admitted: int = 0
+    t_prefill_start: int = 0
+    t_first_token: int = 0
 
 
 @dataclasses.dataclass
@@ -626,6 +636,10 @@ class TpuEngine:
         # the worker; reference components/src/dynamo/vllm/engine_monitor.py)
         self.healthy = True
         self.on_crash: Optional[Any] = None  # callback(exc) scheduled on loop crash
+        # step telemetry (engine/telemetry.py): callable(StepStats) invoked
+        # after every prefill chunk / consumed decode horizon; None = off.
+        # Workers wire EngineTelemetry.on_step; bench.py wires a collector.
+        self.stats_hook: Optional[Any] = None
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpu-step")
         # result readback pool: each in-flight horizon's packed fetch runs on
         # its own thread; on tunneled devices the ~100ms RTT is latency, not
@@ -2042,15 +2056,26 @@ class TpuEngine:
             st.no_cache = True
         # disaggregated decode: pull the prefill worker's KV pages first so
         # admission sees them as a cached prefix (no recompute)
+        flight = get_flight_recorder()
         if req.kv_transfer and req.kv_transfer.get("address"):
             try:
                 got = await self._get_transfer_client().fetch_and_import(
                     req.kv_transfer["address"],
                     [int(h) for h in req.kv_transfer.get("hashes", [])],
+                    traceparent=req.annotations.get("traceparent"),
                 )
                 log.debug("imported %d transferred kv tokens for %s", got, req.request_id[:8])
-            except Exception:
+                flight.record(
+                    req.request_id, "transfer",
+                    tokens=got, address=req.kv_transfer["address"],
+                )
+            except Exception as e:
                 log.exception("kv transfer failed; recomputing prefill locally")
+                flight.record(
+                    req.request_id, "transfer",
+                    tokens=0, error=str(e)[:200],
+                    address=req.kv_transfer["address"],
+                )
         if self.kvbm is not None:
             try:
                 await self._onboard_from_kvbm(st)
@@ -2058,6 +2083,11 @@ class TpuEngine:
                 log.exception("kvbm onboard failed; prefilling from scratch")
         # disaggregated prefill: announce our pages on the way out
         is_prefill_side = req.annotations.get("disagg") == "prefill"
+        st.t_queued = time.time_ns()
+        flight.record(
+            req.request_id, "queued",
+            prompt_tokens=n_prompt, waiting=len(self._waiting),
+        )
         self._waiting.append(st)
         self._wake.set()
         while True:
@@ -2076,6 +2106,11 @@ class TpuEngine:
                     "hashes": [int(h) for h in st.seq.sequence_hashes()[:prompt_blocks]],
                     "num_tokens": prompt_blocks * self.cfg.block_size,
                 }
+            if item.finish_reason is not None:
+                # observability BEFORE the final yield: consumers typically
+                # return at the finish frame, which closes this generator at
+                # the yield (code after it would never run)
+                self._request_finished(st, item.finish_reason)
             yield item
             if item.finish_reason is not None:
                 return
@@ -2246,6 +2281,8 @@ class TpuEngine:
         model dtype for float caches — a bf16 model stores bf16 blocks, not
         2x-inflated float32 — and the flat int8+scales codec buffer for
         kv_dtype=int8 (bit-exact round trip, no float detour)."""
+        t_offload = time.time_ns()
+        offloaded_bytes = 0
         try:
             if self.kv_quantized:
                 codec = self._kv_codec()
@@ -2261,6 +2298,7 @@ class TpuEngine:
                     self.kvbm.offload(
                         h, codec.encode(pay[i], scl[i]), priority=prio
                     )
+                offloaded_bytes = len(pending) * codec.nbytes
                 return
             store_dtype = np.dtype(self.mcfg.dtype)
             layers = []
@@ -2273,8 +2311,18 @@ class TpuEngine:
                 # copy: a view of arr would pin the whole n-block gather
                 # buffer in the host tier for as long as one block lives
                 self.kvbm.offload(h, arr[i].copy(), priority=prio)
+            offloaded_bytes = int(arr.nbytes)
         except Exception:
             log.exception("kv offload failed (continuing without write-through)")
+        finally:
+            tracer = get_tracer()
+            if tracer.enabled and offloaded_bytes:
+                # background batch spanning many requests: its own trace,
+                # not parented to any one request
+                tracer.emit(
+                    "kvbm.offload", t_offload, time.time_ns(),
+                    blocks=len(pending), bytes=offloaded_bytes,
+                )
 
     def _kv_codec(self):
         """The int8 block codec shared by the KVBM tiers and the native
@@ -2375,6 +2423,7 @@ class TpuEngine:
         """Pull a host/disk-cached prefix into device pages before admission."""
         if self.kvbm is None:
             return
+        t_onboard = time.time_ns()
         bs = self.cfg.block_size
         hashes = st.seq.sequence_hashes()[: (len(st.seq) - 1) // bs]
         have = len(self.allocator.match_prefix(hashes))
@@ -2424,6 +2473,23 @@ class TpuEngine:
         got = await self.import_blocks(list(hashes[have : have + n]), arr)
         if got:
             log.debug("onboarded %d blocks from kvbm for %s", got, st.req.request_id[:8])
+            get_flight_recorder().record(
+                st.req.request_id, "onboard",
+                blocks=got, tokens=got * bs,
+            )
+            tracer = get_tracer()
+            if tracer.enabled:
+                # int8 tiers decode to a (payload, scales) pair above
+                nbytes = (
+                    sum(int(a[:got].nbytes) for a in arr)
+                    if isinstance(arr, tuple) else int(arr[:got].nbytes)
+                )
+                tracer.emit(
+                    "kvbm.onboard", t_onboard, time.time_ns(),
+                    traceparent=st.req.annotations.get("traceparent"),
+                    request_id=st.req.request_id,
+                    blocks=got, bytes=nbytes,
+                )
 
     # ------------------------------------------------------------- step loop
     async def _loop(self) -> None:
@@ -2478,6 +2544,10 @@ class TpuEngine:
                             cumulative_tokens=pick.produced,
                         ))
                     else:
+                        if pick.t_prefill_start == 0:
+                            pick.t_prefill_start = time.time_ns()
+                        chunk_from = pick.prefill_pos
+                        t_step = time.perf_counter()
                         res = await loop.run_in_executor(
                             self._executor, self._run_prefill_chunk, pick
                         )
@@ -2491,6 +2561,10 @@ class TpuEngine:
                             )
                             self._prefill_tasks.add(task)
                             task.add_done_callback(self._prefill_tasks.discard)
+                        self._step_stats(
+                            "prefill", time.perf_counter() - t_step,
+                            pick.prefill_pos - chunk_from,
+                        )
                         mark("prefill")
                 has_active = any(
                     s is not None and not s.done and s.prefilled
@@ -2518,16 +2592,29 @@ class TpuEngine:
                     mark("dispatch")
                 if self._chains:
                     chain = self._chains.popleft()
+                    t_step = time.perf_counter()
                     packed = await asyncio.wrap_future(chain.fetch)
                     mark("fetch")
+                    emitted_before = sum(
+                        s.produced for s in chain.seqs if s is not None
+                    )
                     self._apply_packed(chain, packed)
+                    self._step_stats(
+                        "decode", time.perf_counter() - t_step,
+                        sum(s.produced for s in chain.seqs if s is not None)
+                        - emitted_before,
+                    )
                     mark("apply")
                 elif has_active:
+                    t_step = time.perf_counter()
                     results = await loop.run_in_executor(
                         self._executor, self._run_decode, self._decode_snapshot()
                     )
                     for rst, tok, lp, tids, tvals in results:
                         self._accept_token(rst, tok, lp, tids, tvals)
+                    self._step_stats(
+                        "decode", time.perf_counter() - t_step, len(results)
+                    )
                 elif self._prefill_tasks and not prefilling:
                     # nothing to compute until a first-token readback lands:
                     # park instead of busy-spinning through the loop
@@ -2713,6 +2800,12 @@ class TpuEngine:
                     if other is not None and other is not st:
                         self._slot_dirty[j] = True
             admitted.append(st)
+            st.t_admitted = time.time_ns()
+            get_flight_recorder().record(
+                st.req.request_id, "admitted",
+                slot=slot, cached_tokens=st.cached_tokens,
+                prompt_tokens=prompt_len,
+            )
             log.debug(
                 "admit %s: %d tokens (%d cached), slot %d",
                 st.req.request_id[:8], prompt_len, st.cached_tokens, slot,
@@ -3538,6 +3631,11 @@ class TpuEngine:
                 "cached_tokens": st.cached_tokens,
                 "input_tokens": len(st.req.token_ids),
             }
+        if first_ann and (emit_ids or finish is not None) and st.t_first_token == 0:
+            st.t_first_token = time.time_ns()
+            get_flight_recorder().record(
+                st.req.request_id, "first_token", slot=st.slot,
+            )
         out = BackendOutput(
             token_ids=emit_ids,
             finish_reason=finish,
@@ -3568,6 +3666,73 @@ class TpuEngine:
                     st.out_queue.put_nowait(
                         BackendOutput(finish_reason="cancelled", cumulative_tokens=st.produced)
                     )
+
+    def _request_finished(self, st: "_Seq", finish_reason: str) -> None:
+        """Emit the request's engine-phase spans (queue / prefill / decode,
+        parented on the cross-plane traceparent annotation) and close its
+        flight-recorder timeline. Host-side bookkeeping only."""
+        flight = get_flight_recorder()
+        rid = st.req.request_id
+        flight.finish(
+            rid,
+            error=("engine error finish" if finish_reason == FINISH_ERROR else None),
+            error_class="engine_error" if finish_reason == FINISH_ERROR else None,
+            finish_reason=finish_reason,
+            tokens=st.produced,
+        )
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        tp = st.req.annotations.get("traceparent")
+        status = "ERROR" if finish_reason == FINISH_ERROR else "OK"
+        if st.t_queued and st.t_admitted:
+            tracer.emit(
+                "engine.queue", st.t_queued, st.t_admitted,
+                traceparent=tp, request_id=rid,
+            )
+        prefill_start = st.t_prefill_start or st.t_admitted
+        if prefill_start and st.t_first_token:
+            tracer.emit(
+                "engine.prefill", prefill_start, st.t_first_token,
+                traceparent=tp, request_id=rid,
+                prompt_tokens=len(st.req.token_ids),
+                cached_tokens=st.cached_tokens,
+            )
+        if st.t_first_token:
+            tracer.emit(
+                "engine.decode", st.t_first_token, time.time_ns(),
+                traceparent=tp, request_id=rid, status=status,
+                tokens=st.produced, finish=finish_reason,
+            )
+
+    def _step_stats(self, phase: str, duration_s: float, tokens: int) -> None:
+        """Feed one StepStats to the hook — scalars the loop already holds;
+        never forces a device sync (engine/telemetry.py)."""
+        hook = self.stats_hook
+        if hook is None:
+            return
+        spec_acc = None
+        if self.cfg.spec_draft is not None and self.spec_stats["rounds"]:
+            spec_acc = self.spec_stats["emitted"] / (
+                self.spec_stats["rounds"] * self.spec_stats["k"]
+            )
+        try:
+            hook(StepStats(
+                phase=phase,
+                duration_s=duration_s,
+                batch_occupancy=sum(
+                    1 for s in self._slots if s is not None and not s.done
+                ),
+                batch_size=self.cfg.max_batch_size,
+                tokens=int(tokens),
+                queue_depth=len(self._waiting),
+                kv_active_blocks=self.allocator.active_blocks,
+                kv_free_blocks=self.allocator.free_blocks,
+                kv_total_blocks=self.cfg.num_blocks,
+                spec_acceptance=spec_acc,
+            ))
+        except Exception:
+            log.exception("stats hook failed")
 
     async def _publish_events(self) -> None:
         stored, removed = self.allocator.drain_events()

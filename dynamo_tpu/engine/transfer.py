@@ -59,6 +59,7 @@ from ..runtime.faults import FAULTS
 from ..runtime.logging import get_logger
 from ..runtime.request_plane.tcp import NoResponders, TcpClient
 from ..runtime.resilience import RETRYABLE_DEFAULT, retry_policy
+from ..runtime.tracing import get_tracer
 from ..tokens import SequenceHash
 
 log = get_logger("engine.transfer")
@@ -349,6 +350,21 @@ class KvTransferServer:
             self._slot_lease[s] = (now + SLOT_LEASE_S, token)
         return slots, token
 
+    def _trace_serve(self, request: Any, start_ns: int, wire: str,
+                     matched: int, nbytes: int) -> None:
+        """Span for one served fetch, parented on the traceparent the client
+        shipped in the handshake — the decode-side pull and this prefill-side
+        serve land in the same trace. Emitted just before the result yields
+        (wrapping an async generator in a span context would hold the
+        ambient contextvar across the yield)."""
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.emit(
+                "kv.transfer.serve", start_ns, time.time_ns(),
+                traceparent=request.get("traceparent"),
+                wire=wire, blocks=matched, bytes=nbytes,
+            )
+
     async def handle(self, request: Any, context: Context) -> AsyncIterator[Dict]:
         if "free_slots" in request:
             token = request.get("token")
@@ -362,6 +378,7 @@ class KvTransferServer:
             self._pull_pending.pop(int(request["free_pull"]), None)
             yield {"ok": True}
             return
+        t_serve = time.time_ns()
         hashes: List[SequenceHash] = list(request.get("hashes", []))
         native_ok = bool(request.get("native_ok")) and self._ensure_native()
         # int8 caches serve the wire + native planes only: the device-pull /
@@ -378,6 +395,7 @@ class KvTransferServer:
         try:
             n = len(block_ids)
             if n == 0:
+                self._trace_serve(request, t_serve, "none", 0, 0)
                 yield {"matched": 0, "data": b"", "shape": []}
                 return
             if device_ok:
@@ -385,12 +403,16 @@ class KvTransferServer:
                     block_ids, int(request.get("device_shards", 1))
                 )
                 if offer is not None:
+                    self._trace_serve(request, t_serve, "device", n, 0)
                     yield {"matched": n, "device": offer}
                     return
             leased = self._lease_slots(n) if native_ok else None
             if leased is not None:
                 slots, token = leased
                 checksums = await self._gather_into_arena(block_ids, slots)
+                self._trace_serve(
+                    request, t_serve, "native", n, n * self._block_nbytes
+                )
                 yield {
                     "matched": n,
                     "block_shape": self._block_shape,
@@ -420,6 +442,10 @@ class KvTransferServer:
                 }
                 if scales is not None:
                     item["scales"] = scales  # f32 [L, 2, n, kvh] raw bytes
+                self._trace_serve(
+                    request, t_serve, "inline", n,
+                    len(data) + (len(scales) if scales is not None else 0),
+                )
                 yield item
         finally:
             alloc.release(block_ids)
@@ -644,13 +670,43 @@ class KvTransferClient:
         ).acall(once)
 
     async def fetch_and_import(
-        self, address: str, hashes: List[SequenceHash]
+        self, address: str, hashes: List[SequenceHash],
+        traceparent: Optional[str] = None,
     ) -> int:
         """Pull blocks for ``hashes`` from ``address``; returns tokens imported.
 
         Already-cached local blocks are skipped (only the missing suffix is
         fetched). Imported blocks are committed content-addressed, so the
-        engine's normal admission path picks them up as a cached prefix."""
+        engine's normal admission path picks them up as a cached prefix.
+
+        ``traceparent`` continues the request's trace: a ``kv.transfer.pull``
+        span (wire path + bytes + blocks) is emitted here and shipped in the
+        handshake so the serving side's span joins the same trace."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return await self._pull(address, hashes, traceparent, {})
+        info: Dict[str, Any] = {"wire": "none", "bytes": 0, "blocks": 0}
+        t0 = time.time_ns()
+        status = "OK"
+        tokens = 0
+        try:
+            tokens = await self._pull(address, hashes, traceparent, info)
+            return tokens
+        except Exception:
+            status = "ERROR"
+            raise
+        finally:
+            tracer.emit(
+                "kv.transfer.pull", t0, time.time_ns(),
+                traceparent=traceparent, status=status, address=address,
+                wire=info["wire"], bytes=info["bytes"],
+                blocks=info["blocks"], tokens=tokens,
+            )
+
+    async def _pull(
+        self, address: str, hashes: List[SequenceHash],
+        traceparent: Optional[str], info: Dict[str, Any],
+    ) -> int:
         alloc = self.engine.allocator
         have = len(alloc.match_prefix(hashes))
         want = hashes[have:]
@@ -682,6 +738,7 @@ class KvTransferClient:
         if local is not None and local.engine is not self.engine:
             moved = await IciKvMover(local.engine, self.engine).move(list(want))
             if moved is not None:
+                info.update(wire="ici", blocks=moved)
                 return (have + moved) * alloc.block_size
             # device path failed: fall through to the DCN protocol
         from ..transfer import native_available
@@ -699,6 +756,9 @@ class KvTransferClient:
             "hashes": [int(h) for h in want],
             "native_ok": native_available(),
         }
+        if traceparent:
+            # the serving side parents its kv.transfer.serve span on this
+            req["traceparent"] = traceparent
         if device_ok:
             req["device_ok"] = True
             req["device_shards"] = len(jax.local_devices())
@@ -709,6 +769,12 @@ class KvTransferClient:
         if "device" in item:
             got = await self._device_pull(address, item, list(want[:matched]))
             if got is not None:
+                dev = item["device"]
+                info.update(
+                    wire="device", blocks=got,
+                    bytes=2 * int(np.prod(dev["shape"]))
+                    * _dtype_from_name(dev["dtype"]).itemsize,
+                )
                 return (have + got) * alloc.block_size
             # cross-process device pull failed: one retry over the wire
             req.pop("device_ok", None)
@@ -720,10 +786,19 @@ class KvTransferClient:
             block_major = await self._native_fetch(address, item, matched)
             if block_major is None:
                 return have * alloc.block_size
+            info.update(
+                wire="native",
+                bytes=matched * int(item.get("block_bytes", 0)),
+            )
         else:
             dtype = _dtype_from_name(item.get("dtype", "float32"))
             arr = np.frombuffer(item.get("data", b""), dtype).reshape(
                 item.get("shape", [])
+            )
+            info.update(
+                wire="inline",
+                bytes=len(item.get("data", b""))
+                + len(item.get("scales", b"")),
             )
             if "scales" in item:
                 # int8 wire: payload [L, 2, n, bs, kvh, d] + scales
@@ -743,6 +818,7 @@ class KvTransferClient:
         imported = await self.engine.import_blocks(
             list(want[:matched]), block_major
         )
+        info["blocks"] = imported
         return (have + imported) * alloc.block_size
 
     async def _device_pull(

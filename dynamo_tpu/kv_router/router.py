@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 
 import msgpack
 
+from ..runtime import metrics as M
 from ..runtime.event_plane.base import EventPlane, Subscription
 from ..runtime.logging import get_logger
 from ..tokens import compute_sequence_hashes
@@ -48,12 +49,23 @@ class KvRouter:
         config: Optional[KvRouterConfig] = None,
         seed: Optional[int] = None,
         recorder=None,
+        metrics: Optional[M.MetricsScope] = None,
     ):
         self.config = config or KvRouterConfig()
         # optional runtime.recorder.Recorder: captures the ingested KV-event
         # stream as JSONL for offline replay (reference lib/llm/src/recorder.rs
         # feeding benchmarks/router playback)
         self.recorder = recorder
+        # prefix-cache effectiveness on /metrics: tokens the chosen worker
+        # already holds per routing decision (the reference's kv-hit-rate
+        # signal); None = no registry attached (standalone/unit use)
+        self._hit_tokens = (
+            metrics.counter(
+                M.KV_HIT_TOKENS,
+                "prompt tokens matched in the chosen worker's prefix cache",
+            )
+            if metrics is not None else None
+        )
         self.block_size = block_size
         self.namespace = namespace
         self.component = component
@@ -253,6 +265,8 @@ class KvRouter:
             candidates, overlaps, query_blocks=len(hashes), tree_sizes=tree_sizes
         )
         new_blocks = decision.query_blocks - decision.overlap_blocks
+        if self._hit_tokens is not None and decision.overlap_blocks > 0:
+            self._hit_tokens.inc(decision.overlap_blocks * self.block_size)
         self.scheduler.add_local_load(decision.worker, new_blocks)
         if request_id is not None:
             self._active[request_id] = (decision.worker, new_blocks)

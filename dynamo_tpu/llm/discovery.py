@@ -22,9 +22,11 @@ from ..runtime.component import Client, RouterMode
 from ..runtime.discovery.store import EventType
 from ..runtime.distributed import DistributedRuntime
 from ..runtime.engine import Context
+from ..runtime.flight_recorder import get_flight_recorder
 from ..runtime.logging import get_logger
 from ..runtime.request_plane.tcp import NoResponders
 from ..runtime.resilience import OPEN, CircuitBreaker
+from ..runtime.tracing import get_tracer
 from .migration import Migration
 from .model_card import MDC_PREFIX, ModelDeploymentCard
 from .preprocessor import (
@@ -162,6 +164,7 @@ class ModelPipeline:
                 self.card.component,
                 block_size=self.card.kv_block_size,
                 config=self.kv_router_config,
+                metrics=self.runtime.metrics,
             ).start()
         return self
 
@@ -200,53 +203,97 @@ class ModelPipeline:
     ) -> AsyncIterator[Any]:
         assert self.client is not None
         instance_id: Optional[int] = None
-        # per-request exclusions (migration) plus cross-request tripped
-        # circuits: both are steered around the same way
-        shun = list(excluded) + self._tripped(excluded)
-        # pooled forwards don't touch KV pages: routing them through the KV
-        # scheduler would charge phantom blocks to a worker (and pollute the
-        # approx prefix view) that complete() on the embed path never frees
-        use_kv = self.kv_router is not None and req.annotations.get("op") != "embed"
-        if use_kv:
-            self._prune_dead_workers()
-            cands = self._candidates(shun)
-            if not cands:
-                # every instance is excluded (dead mid-request): fail this
-                # attempt rather than round-robin back onto a dead worker
-                raise NoResponders(f"no non-excluded instances for {self.card.name}")
-            decision = self.kv_router.schedule_tokens(
-                req.token_ids, cands, request_id=req.request_id
+        # trace hop: the routing decision gets its own span, and its id
+        # REPLACES the traceparent annotation the worker will parent on —
+        # one trace then reads frontend -> router -> worker in order
+        tracer = get_tracer()
+        span = None
+        if tracer.enabled:
+            span = tracer.span(
+                "router.schedule",
+                traceparent=req.annotations.get("traceparent"),
+                request_id=req.request_id, model=self.card.name,
             )
-            instance_id = decision.worker.worker_id
-            req.annotations[ANNOTATION_CACHED_TOKENS] = (
-                decision.overlap_blocks * self.card.kv_block_size
-            )
-            req.annotations[ANNOTATION_WORKER_ID] = instance_id
-            req.annotations["dp_rank"] = decision.worker.dp_rank
-        elif shun:
-            # non-KV mode: steer away from excluded (dead) + tripped
-            # instances, round-robining over the survivors — pinning to
-            # alive[0] would dump the tripped worker's whole share onto one
-            # neighbor for the open window
-            alive = [i for i in self.client.instance_ids() if i not in shun]
-            if not alive:
-                raise NoResponders(f"no non-excluded instances for {self.card.name}")
-            instance_id = alive[self._rr % len(alive)]
-            self._rr += 1
+            span.__enter__()
+            req.annotations["traceparent"] = span.traceparent()
         try:
-            stream = await self.client.generate(req.to_obj(), context, instance_id)
-        except (NoResponders, ConnectionError) as e:
-            if instance_id is not None and getattr(e, "instance_id", None) is None:
-                e.instance_id = instance_id  # type: ignore[attr-defined]
-            iid = getattr(e, "instance_id", None)
-            if iid is not None:
-                cb = self._worker_cb(iid)
-                # reserve the half-open probe slot (no-op when closed) so
-                # this outcome counts as the probe result; the breaker
-                # ignores unreserved results in half-open as stale
-                cb.allow()
-                cb.record(False)
+            # per-request exclusions (migration) plus cross-request tripped
+            # circuits: both are steered around the same way
+            shun = list(excluded) + self._tripped(excluded)
+            # pooled forwards don't touch KV pages: routing them through the KV
+            # scheduler would charge phantom blocks to a worker (and pollute the
+            # approx prefix view) that complete() on the embed path never frees
+            use_kv = self.kv_router is not None and req.annotations.get("op") != "embed"
+            overlap_tokens = 0
+            if use_kv:
+                self._prune_dead_workers()
+                cands = self._candidates(shun)
+                if not cands:
+                    # every instance is excluded (dead mid-request): fail this
+                    # attempt rather than round-robin back onto a dead worker
+                    raise NoResponders(f"no non-excluded instances for {self.card.name}")
+                decision = self.kv_router.schedule_tokens(
+                    req.token_ids, cands, request_id=req.request_id
+                )
+                instance_id = decision.worker.worker_id
+                overlap_tokens = decision.overlap_blocks * self.card.kv_block_size
+                req.annotations[ANNOTATION_CACHED_TOKENS] = overlap_tokens
+                req.annotations[ANNOTATION_WORKER_ID] = instance_id
+                req.annotations["dp_rank"] = decision.worker.dp_rank
+                if span is not None:
+                    span.set(
+                        mode="kv", worker=f"{instance_id:016x}",
+                        dp_rank=decision.worker.dp_rank,
+                        overlap_blocks=decision.overlap_blocks,
+                        query_blocks=decision.query_blocks,
+                        excluded=len(shun),
+                    )
+            elif shun:
+                # non-KV mode: steer away from excluded (dead) + tripped
+                # instances, round-robining over the survivors — pinning to
+                # alive[0] would dump the tripped worker's whole share onto one
+                # neighbor for the open window
+                alive = [i for i in self.client.instance_ids() if i not in shun]
+                if not alive:
+                    raise NoResponders(f"no non-excluded instances for {self.card.name}")
+                instance_id = alive[self._rr % len(alive)]
+                self._rr += 1
+            if span is not None and not use_kv:
+                span.set(
+                    mode=str(self.router_mode.value)
+                    if hasattr(self.router_mode, "value") else str(self.router_mode),
+                    worker=(f"{instance_id:016x}" if instance_id is not None
+                            else "client-routed"),
+                    excluded=len(shun),
+                )
+            get_flight_recorder().record(
+                req.request_id, "routed",
+                worker=(f"{instance_id:016x}" if instance_id is not None
+                        else "client-routed"),
+                overlap_tokens=overlap_tokens, excluded=len(shun),
+            )
+            try:
+                stream = await self.client.generate(req.to_obj(), context, instance_id)
+            except (NoResponders, ConnectionError) as e:
+                if instance_id is not None and getattr(e, "instance_id", None) is None:
+                    e.instance_id = instance_id  # type: ignore[attr-defined]
+                iid = getattr(e, "instance_id", None)
+                if iid is not None:
+                    cb = self._worker_cb(iid)
+                    # reserve the half-open probe slot (no-op when closed) so
+                    # this outcome counts as the probe result; the breaker
+                    # ignores unreserved results in half-open as stale
+                    cb.allow()
+                    cb.record(False)
+                raise
+        except Exception as e:
+            if span is not None:
+                span.status = "ERROR"
+                span.set(error=repr(e))
             raise
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
         iid = getattr(stream, "instance_id", None)
         if iid is None:
             return stream

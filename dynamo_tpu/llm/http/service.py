@@ -22,6 +22,7 @@ from aiohttp.client_exceptions import ClientConnectionResetError
 from ...runtime import metrics as M
 from ...runtime.engine import Context
 from ...runtime.errors import InvalidRequestError, http_status_for
+from ...runtime.flight_recorder import get_flight_recorder
 from ...runtime.logging import get_logger
 from ...runtime.request_plane.tcp import NoResponders
 from ...runtime.resilience import CircuitBreaker
@@ -142,6 +143,9 @@ def _openapi_spec() -> dict:
             "/health": {"get": op("Service + model health", tag="system")},
             "/live": {"get": op("Liveness", tag="system")},
             "/metrics": {"get": op("Prometheus metrics", tag="system")},
+            "/debug/requests": {"get": op(
+                "Flight-recorder request timelines", tag="system"
+            )},
             "/openapi.json": {"get": op("This document", tag="system")},
         },
     }
@@ -180,6 +184,12 @@ class HttpService:
             M.REQUESTS_TOTAL, "requests", extra_labels=(M.LABEL_MODEL, "status")
         )
         self._inflight_g = self.metrics.gauge(M.INFLIGHT_REQUESTS, "in-flight requests")
+        self._duration = self.metrics.histogram(
+            M.REQUEST_DURATION_SECONDS, "end-to-end request duration",
+            extra_labels=(M.LABEL_MODEL,),
+            buckets=(0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     120.0),
+        )
         self._ttft = self.metrics.histogram(
             M.TTFT_SECONDS, "time to first token", extra_labels=(M.LABEL_MODEL,),
             buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
@@ -249,6 +259,7 @@ class HttpService:
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/openapi.json", self.openapi)
         app.router.add_get("/docs", self.docs)
+        app.router.add_get("/debug/requests", self.debug_requests)
         return app
 
     async def start(self) -> str:
@@ -273,8 +284,9 @@ class HttpService:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
-        # short-lived processes would otherwise drop a partial span batch
-        self.tracer.flush()
+        # short-lived processes would otherwise drop a partial span batch;
+        # shutdown also drains the OTLP exporter's background queue
+        self.tracer.shutdown()
 
     # -- aux handlers --------------------------------------------------------
     async def health(self, request: web.Request) -> web.Response:
@@ -285,6 +297,18 @@ class HttpService:
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.expose(), content_type="text/plain")
+
+    async def debug_requests(self, request: web.Request) -> web.Response:
+        """Flight-recorder timelines (runtime/flight_recorder.py):
+        ``/debug/requests`` lists recent requests most-recent-first,
+        ``?id=<request_id>`` returns one timeline (404 once evicted)."""
+        from ...runtime.flight_recorder import debug_requests_payload
+
+        status, payload = debug_requests_payload(
+            get_flight_recorder(),
+            request.query.get("id"), request.query.get("limit"),
+        )
+        return web.json_response(payload, status=status)
 
     async def models(self, request: web.Request) -> web.Response:
         data = ModelList(
@@ -436,7 +460,7 @@ class HttpService:
     # -- shared request path -------------------------------------------------
     def _observed(
         self, stream: AsyncIterator[BackendOutput], model: str, t_start: float,
-        prompt_tokens: int = 0,
+        prompt_tokens: int = 0, request_id: str = "",
     ) -> AsyncIterator[BackendOutput]:
         """Wrap the token stream with TTFT/ITL observation."""
 
@@ -452,6 +476,10 @@ class HttpService:
                         if first_at is None:
                             first_at = now
                             self._ttft.observe(now - t_start, model=model)
+                            get_flight_recorder().record(
+                                request_id, "first_token",
+                                ttft_ms=round((now - t_start) * 1e3, 3),
+                            )
                         elif last_at is not None:
                             self._itl.observe(now - last_at, model=model)
                         last_at = now
@@ -563,12 +591,20 @@ class HttpService:
         for p in preqs:
             p.annotations["traceparent"] = span.traceparent()
         span.__enter__()
+        flight = get_flight_recorder()
+        flight.record(
+            rid, "received",
+            model=model, streaming=stream_mode, choices=len(preqs),
+        )
+        flight.record(rid, "tokenized", prompt_tokens=len(preqs[0].token_ids))
+        fail_msg: Optional[str] = None
+        fail_type = "internal_error"
         try:
             t0 = time.monotonic()
             streams = [
                 self._observed(
                     pipeline.generate_tokens(p, c), model, t0,
-                    prompt_tokens=len(p.token_ids),
+                    prompt_tokens=len(p.token_ids), request_id=rid,
                 )
                 for p, c in zip(preqs, ctxs)
             ]
@@ -620,6 +656,7 @@ class HttpService:
             return web.json_response(result.model_dump(exclude_none=True))
         except NoResponders:
             status = "503"
+            fail_msg, fail_type = "no workers available", "service_unavailable"
             return await self._fail(resp, 503, "no workers available", "service_unavailable")
         except asyncio.CancelledError:
             status = "499"
@@ -630,6 +667,7 @@ class HttpService:
             log.exception("request %s failed", rid[:16])
             code, etype = _stream_fail_status(e)
             status = str(code)
+            fail_msg, fail_type = str(e), etype
             return await self._fail(resp, code, str(e), etype)
         finally:
             self.inflight -= 1
@@ -638,6 +676,7 @@ class HttpService:
             # errors mean the workers ARE responding
             cb.record(status != "503")
             self._requests.inc(model=model, status=status)
+            self._duration.observe(time.monotonic() - t0, model=model)
             self._input_tokens.inc(prompt_tokens, model=model)
             self._output_tokens.inc(completion_tokens, model=model)
             for c in ctxs:
@@ -648,6 +687,14 @@ class HttpService:
                 # closes, so mark failure explicitly or OTLP status reads OK
                 span.status = "ERROR"
             span.__exit__(None, None, None)
+            # a failed request auto-dumps its timeline (flight_recorder.py);
+            # 499 is the client hanging up, not a failure
+            flight.finish(
+                rid,
+                error=(fail_msg if status not in ("200", "499") else None),
+                error_class=fail_type,
+                status=status, completion_tokens=completion_tokens,
+            )
             if audit_handle is not None:
                 audit_handle.emit()
                 await self.audit.drain_async_sinks()
@@ -909,10 +956,21 @@ class HttpService:
         )
         preq.annotations["traceparent"] = span.traceparent()
         span.__enter__()
+        flight = get_flight_recorder()
+        flight.record(
+            preq.request_id, "received",
+            model=rreq.model, streaming=rreq.stream, choices=1,
+        )
+        flight.record(
+            preq.request_id, "tokenized", prompt_tokens=len(preq.token_ids)
+        )
+        fail_msg: Optional[str] = None
+        fail_type = "internal_error"
+        t0 = time.monotonic()
         try:
             stream = self._observed(
-                pipeline.generate_tokens(preq, ctx), rreq.model, time.monotonic(),
-                prompt_tokens=len(preq.token_ids),
+                pipeline.generate_tokens(preq, ctx), rreq.model, t0,
+                prompt_tokens=len(preq.token_ids), request_id=preq.request_id,
             )
             if not rreq.stream:
                 text = []
@@ -961,6 +1019,7 @@ class HttpService:
             return resp
         except NoResponders:
             status = "503"
+            fail_msg, fail_type = "no workers available", "service_unavailable"
             return await self._fail(resp, 503, "no workers available", "service_unavailable")
         except asyncio.CancelledError:
             status = "499"
@@ -970,12 +1029,14 @@ class HttpService:
             log.exception("responses request %s failed", preq.request_id[:16])
             code, etype = _stream_fail_status(e)
             status = str(code)
+            fail_msg, fail_type = str(e), etype
             return await self._fail(resp, code, str(e), etype)
         finally:
             self.inflight -= 1
             self._inflight_g.set(self.inflight)
             cb.record(status != "503")
             self._requests.inc(model=rreq.model, status=status)
+            self._duration.observe(time.monotonic() - t0, model=rreq.model)
             self._input_tokens.inc(prompt_tokens, model=rreq.model)
             self._output_tokens.inc(completion_tokens, model=rreq.model)
             ctx.stop_generating()
@@ -983,6 +1044,12 @@ class HttpService:
             if status not in ("200", "499"):
                 span.status = "ERROR"
             span.__exit__(None, None, None)
+            flight.finish(
+                preq.request_id,
+                error=(fail_msg if status not in ("200", "499") else None),
+                error_class=fail_type,
+                status=status, completion_tokens=completion_tokens,
+            )
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         busy = self._check_capacity()

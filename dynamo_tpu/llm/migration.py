@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Any, AsyncIterator, Awaitable, Callable, List, Optional
 
 from ..runtime.engine import Context
+from ..runtime.flight_recorder import get_flight_recorder
 from ..runtime.logging import get_logger
 from ..runtime.request_plane.tcp import NoResponders
 from .protocols.common import BackendOutput, PreprocessedRequest
@@ -93,6 +94,14 @@ class Migration:
                 worker_id: Optional[int] = getattr(e, "instance_id", None)
                 if worker_id is not None and worker_id not in excluded:
                     excluded.append(worker_id)
+                get_flight_recorder().record(
+                    request.request_id, "migration",
+                    tokens_so_far=len(accumulated),
+                    attempts_left=attempts_left,
+                    from_worker=(f"{worker_id:016x}" if worker_id is not None
+                                 else "unknown"),
+                    error=str(e)[:200],
+                )
                 log.info(
                     "migrating request %s (%d tokens so far, %d attempts left): %s",
                     req.request_id, len(accumulated), attempts_left, e,

@@ -18,8 +18,10 @@ from typing import Optional
 from ..kv_router import KvRouter, KvRouterConfig, WorkerWithDpRank
 from ..runtime.component import Client, RouterMode
 from ..runtime.engine import Context
+from ..runtime.flight_recorder import get_flight_recorder
 from ..runtime.logging import get_logger
 from ..runtime.request_plane.tcp import NoResponders
+from ..runtime.tracing import get_tracer
 from .model_card import ModelDeploymentCard
 from .preprocessor import ANNOTATION_PREFILL_WORKER_ID
 from .protocols.common import BackendOutput, PreprocessedRequest
@@ -54,6 +56,7 @@ class PrefillRouter:
                 self.card.component,
                 block_size=self.card.kv_block_size,
                 config=self.kv_router_config,
+                metrics=getattr(self.runtime, "metrics", None),
             ).start()
         return self
 
@@ -76,36 +79,69 @@ class PrefillRouter:
         preq.stop.stop_strings = []
         preq.annotations["disagg"] = "prefill"
 
+        # trace hop: the prefill dispatch is its own span, and the prefill
+        # worker's spans parent on IT (frontend -> router.prefill -> worker)
+        tracer = get_tracer()
+        span = None
+        if tracer.enabled:
+            span = tracer.span(
+                "router.prefill",
+                traceparent=preq.annotations.get("traceparent"),
+                request_id=preq.request_id,
+            )
+            span.__enter__()
+            preq.annotations["traceparent"] = span.traceparent()
         instance_id: Optional[int] = None
-        if self.kv_router is not None and self.client.instances:
-            # dp-aware like the decode path (scheduler.rs:543-560): every
-            # (instance, dp_rank) is a candidate, and the chosen rank rides
-            # the annotation so the worker's DpEngineGroup dispatches to it
-            cands = []
-            for iid, inst in self.client.instances.items():
-                dp = int(inst.metadata.get("data_parallel_size", 1) or 1)
-                for r in range(dp):
-                    cands.append(WorkerWithDpRank(iid, r))
-            decision = self.kv_router.schedule_tokens(preq.token_ids, cands)
-            instance_id = decision.worker.worker_id
-            preq.annotations["dp_rank"] = decision.worker.dp_rank
         try:
-            stream = await self.client.generate(preq.to_obj(), context.child(), instance_id)
-            last: Optional[BackendOutput] = None
-            async for item in stream:
-                out = item if isinstance(item, BackendOutput) else BackendOutput.from_obj(item)
-                last = out
-                if out.finish_reason is not None:
-                    break
-            if last is not None and instance_id is not None:
-                last.annotations[ANNOTATION_PREFILL_WORKER_ID] = instance_id
-            return last
-        except NoResponders:
-            log.info("prefill pool unavailable; falling back to aggregated")
-            return None
-        except Exception:
-            log.exception("prefill failed; falling back to aggregated")
-            return None
+            if self.kv_router is not None and self.client.instances:
+                # dp-aware like the decode path (scheduler.rs:543-560): every
+                # (instance, dp_rank) is a candidate, and the chosen rank rides
+                # the annotation so the worker's DpEngineGroup dispatches to it
+                cands = []
+                for iid, inst in self.client.instances.items():
+                    dp = int(inst.metadata.get("data_parallel_size", 1) or 1)
+                    for r in range(dp):
+                        cands.append(WorkerWithDpRank(iid, r))
+                decision = self.kv_router.schedule_tokens(preq.token_ids, cands)
+                instance_id = decision.worker.worker_id
+                preq.annotations["dp_rank"] = decision.worker.dp_rank
+                if span is not None:
+                    span.set(
+                        worker=f"{instance_id:016x}",
+                        dp_rank=decision.worker.dp_rank,
+                        overlap_blocks=decision.overlap_blocks,
+                    )
+            get_flight_recorder().record(
+                preq.request_id, "prefill_routed",
+                worker=(f"{instance_id:016x}" if instance_id is not None
+                        else "round-robin"),
+            )
+            try:
+                stream = await self.client.generate(preq.to_obj(), context.child(), instance_id)
+                last: Optional[BackendOutput] = None
+                async for item in stream:
+                    out = item if isinstance(item, BackendOutput) else BackendOutput.from_obj(item)
+                    last = out
+                    if out.finish_reason is not None:
+                        break
+                if last is not None and instance_id is not None:
+                    last.annotations[ANNOTATION_PREFILL_WORKER_ID] = instance_id
+                return last
+            except NoResponders:
+                log.info("prefill pool unavailable; falling back to aggregated")
+                if span is not None:
+                    span.status = "ERROR"
+                    span.set(error="no responders")
+                return None
+            except Exception as e:
+                log.exception("prefill failed; falling back to aggregated")
+                if span is not None:
+                    span.status = "ERROR"
+                    span.set(error=repr(e))
+                return None
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
 
     async def stop(self) -> None:
         if self.kv_router is not None:

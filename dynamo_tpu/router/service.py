@@ -63,6 +63,8 @@ class RouterService:
             block_size=self.block_size,
             config=self.config,
             recorder=self.recorder,
+            # standalone router: its overlap hits land on ITS /metrics
+            metrics=getattr(self.runtime, "metrics", None),
         ).start()
         ep = (
             self.runtime.namespace(self.namespace)

@@ -43,6 +43,10 @@ ENV_AUDIT_FORCE_LOGGING = "DTPU_AUDIT_FORCE_LOGGING"  # audit every request
 ENV_AUDIT_SUBJECT = "DTPU_AUDIT_SUBJECT"              # event-plane audit topic
 ENV_OTLP_ENDPOINT = "DTPU_OTLP_ENDPOINT"              # OTLP/HTTP collector
 ENV_TRACE_JSONL = "DTPU_TRACE_JSONL"                  # span JSONL file
+# request flight recorder (runtime/flight_recorder.py) + step telemetry
+ENV_FLIGHT_CAPACITY = "DTPU_FLIGHT_CAPACITY"          # retained request timelines
+ENV_FLIGHT_DUMP = "DTPU_FLIGHT_DUMP"                  # JSONL path for failure dumps
+ENV_SLOW_STEP_MS = "DTPU_SLOW_STEP_MS"                # slow-step log threshold
 # lora (lora/cache.py)
 ENV_LORA_CACHE = "DTPU_LORA_CACHE"                    # adapter cache dir
 # kvbm remote tier (kvbm/remote.py)

@@ -147,6 +147,8 @@ class StatusServer:
       /metrics   Prometheus exposition from the runtime registry
       /metadata  caller-provided component metadata (model, config, snapshot)
       /v1/loras  loaded LoRA adapters (system_status_server.rs:196-215)
+      /debug/requests  flight-recorder timelines (runtime/flight_recorder.py);
+                 ``?id=<request_id>`` returns one timeline, 404 if evicted
     """
 
     def __init__(
@@ -158,6 +160,7 @@ class StatusServer:
         host: str = "0.0.0.0",
         port: int = 0,
         loras_fn: Optional[Callable[[], list]] = None,
+        flight_recorder=None,
     ):
         self.state = state
         self.metrics = metrics_scope
@@ -166,6 +169,9 @@ class StatusServer:
         self.pre_expose = pre_expose  # refresh gauges right before scraping
         self.host = host
         self.port = port
+        # None = the process-global recorder (workers get /debug/requests
+        # without wiring); tests pass their own
+        self._flight_recorder = flight_recorder
         self.started_at = time.time()
         self._runner: Optional[web.AppRunner] = None
         app = web.Application()
@@ -174,6 +180,7 @@ class StatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/metadata", self._metadata)
         app.router.add_get("/v1/loras", self._loras)
+        app.router.add_get("/debug/requests", self._debug_requests)
         self.app = app
 
     async def _health(self, request: web.Request) -> web.Response:
@@ -200,6 +207,15 @@ class StatusServer:
     async def _loras(self, request: web.Request) -> web.Response:
         names = self.loras_fn() if self.loras_fn is not None else []
         return web.json_response({"data": [{"id": n} for n in names]})
+
+    async def _debug_requests(self, request: web.Request) -> web.Response:
+        from .flight_recorder import debug_requests_payload, get_flight_recorder
+
+        rec = self._flight_recorder or get_flight_recorder()
+        status, payload = debug_requests_payload(
+            rec, request.query.get("id"), request.query.get("limit")
+        )
+        return web.json_response(payload, status=status)
 
     async def start(self) -> str:
         self._runner = web.AppRunner(self.app, access_log=None)

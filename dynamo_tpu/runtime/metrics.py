@@ -38,6 +38,13 @@ KV_ACTIVE_BLOCKS = f"{PREFIX}_kv_active_blocks"
 KV_TOTAL_BLOCKS = f"{PREFIX}_kv_total_blocks"
 KV_HIT_TOKENS = f"{PREFIX}_kv_cached_tokens_total"
 WORKER_ACTIVE_DECODE_BLOCKS = f"{PREFIX}_worker_active_decode_blocks"
+# engine step telemetry (engine/telemetry.py): per-step loop observability
+KV_FREE_BLOCKS = f"{PREFIX}_kv_free_blocks"
+STEP_DURATION_SECONDS = f"{PREFIX}_engine_step_duration_seconds"
+STEP_TOKENS = f"{PREFIX}_engine_tokens_per_step"
+BATCH_OCCUPANCY = f"{PREFIX}_engine_batch_occupancy"
+SPEC_ACCEPTANCE = f"{PREFIX}_engine_spec_acceptance_rate"
+SLOW_STEPS_TOTAL = f"{PREFIX}_engine_slow_steps_total"
 # resilience (runtime/resilience.py): per-policy retry/breaker observability
 RETRY_ATTEMPTS_TOTAL = f"{PREFIX}_retry_attempts_total"
 RETRY_GIVEUPS_TOTAL = f"{PREFIX}_retry_giveups_total"

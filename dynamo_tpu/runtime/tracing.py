@@ -22,10 +22,12 @@ engine's nested spans parent correctly without explicit plumbing.
 
 from __future__ import annotations
 
+import atexit
 import contextvars
 import dataclasses
 import json
 import os
+import queue
 import secrets
 import threading
 import time
@@ -177,15 +179,68 @@ class JsonlExporter:
 
 class OtlpHttpExporter:
     """OTLP/HTTP JSON POST to ``<endpoint>/v1/traces``; best-effort, never
-    raises into the request path."""
+    raises into the request path.
+
+    The POST runs on a dedicated daemon thread behind a bounded queue:
+    ``export()`` only enqueues, so a slow/unreachable collector costs the
+    caller nothing (it used to block the finishing span's thread for up to
+    ``timeout_s``). When the queue is full the batch is dropped, counted in
+    ``dropped_spans``. ``flush()`` waits for queued batches to drain —
+    registered via ``atexit`` so a short-lived process's tail batch still
+    ships without any further span triggering a time-based flush."""
 
     def __init__(self, endpoint: str, service_name: str = "dynamo_tpu",
-                 timeout_s: float = 2.0):
+                 timeout_s: float = 2.0, queue_max: int = 64):
         self.endpoint = endpoint.rstrip("/")
         self.service_name = service_name
         self.timeout_s = timeout_s
+        self.dropped_spans = 0
+        self._q: "queue.Queue[Optional[List[Span]]]" = queue.Queue(maxsize=queue_max)
+        self._worker: Optional[threading.Thread] = None
+        self._worker_lock = threading.Lock()
+        atexit.register(self.flush)
+
+    def _ensure_worker(self) -> None:
+        with self._worker_lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._run, name="dtpu-otlp-export", daemon=True
+                )
+                self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            batch = self._q.get()
+            try:
+                if batch:
+                    self._post(batch)
+            finally:
+                self._q.task_done()
 
     def export(self, spans: List[Span]) -> None:
+        self._ensure_worker()
+        try:
+            self._q.put_nowait(list(spans))
+        except queue.Full:
+            self.dropped_spans += len(spans)
+            log.debug(
+                "otlp export queue full (dropping %d spans, %d lifetime)",
+                len(spans), self.dropped_spans,
+            )
+
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Block until queued batches are posted (bounded by ``timeout_s``)."""
+        deadline = time.monotonic() + (
+            self.timeout_s if timeout_s is None else timeout_s
+        )
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._q.all_tasks_done.wait(remaining)
+
+    def _post(self, spans: List[Span]) -> None:
         body = json.dumps({
             "resourceSpans": [{
                 "resource": {"attributes": [{
@@ -278,6 +333,39 @@ class Tracer:
             _tracer=self,
         )
 
+    def emit(
+        self,
+        name: str,
+        start_ns: int,
+        end_ns: int,
+        traceparent: Optional[str] = None,
+        status: str = "OK",
+        **attrs: Any,
+    ) -> Span:
+        """A finished span with explicit timestamps. Engine-loop milestones
+        (queue/prefill/decode phases) are observed after the fact from
+        per-request timestamps, not wrapped in a context manager — this is
+        the export path for those. Does not touch the ambient contextvar."""
+        trace_id = parent_id = None
+        if traceparent:
+            trace_id, parent_id = parse_traceparent(traceparent)
+        if trace_id is None:
+            amb = _current_span.get()
+            if amb is not None:
+                trace_id, parent_id = amb.trace_id, amb.span_id
+        span = Span(
+            name=name,
+            trace_id=trace_id or new_trace_id(),
+            span_id=new_span_id(),
+            parent_id=parent_id,
+            start_ns=start_ns,
+            end_ns=end_ns,
+            attributes=dict(attrs),
+            status=status,
+        )
+        self._finish(span)
+        return span
+
     def _finish(self, span: Span) -> None:
         if self.exporter is None:
             return
@@ -293,11 +381,22 @@ class Tracer:
             self.flush()
 
     def flush(self) -> None:
+        """Hand the buffered batch to the exporter. Non-blocking (the OTLP
+        exporter enqueues to its worker thread) — safe on the request path."""
         with self._lock:
             batch, self._buf = self._buf, []
             self._last_flush = time.monotonic()
         if batch and self.exporter is not None:
             self.exporter.export(batch)
+
+    def shutdown(self) -> None:
+        """flush() plus a bounded wait for the exporter's queue to drain —
+        the process-exit path (a plain flush would enqueue the tail batch
+        and then let the daemon thread die with it unsent)."""
+        self.flush()
+        drain = getattr(self.exporter, "flush", None)
+        if drain is not None:
+            drain()
 
 
 _global_tracer: Optional[Tracer] = None
@@ -307,6 +406,11 @@ def get_tracer() -> Tracer:
     global _global_tracer
     if _global_tracer is None:
         _global_tracer = Tracer.from_env()
+        if _global_tracer.enabled:
+            # the tail batch of a short-lived process (worker smoke run,
+            # bench) must not die in the buffer; atexit LIFO runs this
+            # before the exporter's own queue-drain hook
+            atexit.register(_global_tracer.shutdown)
     return _global_tracer
 
 

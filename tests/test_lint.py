@@ -183,3 +183,62 @@ def test_linter_allows_policy_driven_delay(tmp_path):
         capture_output=True, text=True, timeout=60,
     )
     assert r.returncode == 0, r.stdout
+
+
+def test_linter_catches_unused_metric_name(tmp_path):
+    """Canonical dtpu_* names declared in runtime/metrics.py with no call
+    site anywhere else are flagged; used names and LABEL_* pass."""
+    runtime = tmp_path / "runtime"
+    runtime.mkdir()
+    (runtime / "metrics.py").write_text(
+        'PREFIX = "dtpu"\n'
+        'REQUESTS_TOTAL = f"{PREFIX}_requests_total"\n'
+        'GHOST_METRIC = f"{PREFIX}_ghost_total"\n'
+        'LABEL_MODEL = "model"\n'
+    )
+    (tmp_path / "user.py").write_text(
+        "from .runtime import metrics as M\n"
+        "NAME = M.REQUESTS_TOTAL\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "UNUSED-METRIC: GHOST_METRIC" in r.stdout
+    assert "REQUESTS_TOTAL" not in r.stdout and "LABEL_MODEL" not in r.stdout
+
+
+def test_linter_catches_prometheus_import_outside_metrics(tmp_path):
+    bad = tmp_path / "svc.py"
+    bad.write_text("from prometheus_client import Counter\nC = Counter\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "PROMETHEUS-IMPORT" in r.stdout
+
+
+def test_linter_catches_wallclock_latency_in_request_path(tmp_path):
+    http_dir = tmp_path / "llm" / "http"
+    http_dir.mkdir(parents=True)
+    bad = http_dir / "svc.py"
+    bad.write_text(
+        "import time\n"
+        "def handler(t0):\n"
+        "    created = int(time.time())\n"      # creation stamp: fine
+        "    return time.time() - t0\n"         # latency on the wall clock
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "WALLCLOCK-LATENCY" in r.stdout
+    assert r.stdout.count("WALLCLOCK-LATENCY") == 1
+    # the same code outside a request-path module passes
+    ok = tmp_path / "scheduler.py"
+    ok.write_text("import time\nAGE = time.time() - 5\n")
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(ok)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "WALLCLOCK-LATENCY" not in r2.stdout

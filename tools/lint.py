@@ -356,6 +356,125 @@ def kv_float32_allocations(path: str, tree: ast.AST):
     return out
 
 
+# -- observability pass ------------------------------------------------------
+# Request-path modules where latency must flow through MetricsScope on a
+# monotonic clock, not hand-rolled wall-clock subtraction. kv_router/scheduler
+# is deliberately out: its staleness check compares a CROSS-PROCESS wall-clock
+# stamp, where monotonic would be wrong.
+def _is_request_path_file(norm_path: str) -> bool:
+    return (
+        "/llm/http/" in norm_path
+        or "/runtime/request_plane/" in norm_path
+        or norm_path.endswith((
+            "llm/backend.py", "llm/discovery.py", "llm/migration.py",
+            "llm/prefill_router.py",
+        ))
+    )
+
+
+def prometheus_imports(path: str, tree: ast.AST):
+    """Direct prometheus_client imports outside runtime/metrics.py: every
+    metric must ride a MetricsScope so it lands in the shared registry with
+    the dtpu_namespace/component hierarchy labels — a directly-constructed
+    collector is invisible to /metrics or collides on re-registration."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            names = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            names = [node.module or ""]
+        else:
+            continue
+        if any(n.split(".")[0] == "prometheus_client" for n in names):
+            out.append((
+                path, node.lineno,
+                "PROMETHEUS-IMPORT: import prometheus_client outside "
+                "runtime/metrics.py — go through MetricsScope",
+            ))
+    return out
+
+
+def _is_wallclock_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "time"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "time"
+    )
+
+
+def wallclock_latency(path: str, tree: ast.AST):
+    """``time.time() - x`` / ``x - time.time()`` in a request-path module:
+    an ad-hoc latency measurement on the WALL clock (steps under NTP slew)
+    that bypasses MetricsScope. Use time.monotonic() and observe() into the
+    catalog histograms (runtime/metrics.py). ``int(time.time())`` creation
+    timestamps pass — only subtraction is flagged."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            if _is_wallclock_call(node.left) or _is_wallclock_call(node.right):
+                out.append((
+                    path, node.lineno,
+                    "WALLCLOCK-LATENCY: time.time() subtraction in a "
+                    "request-path module — use time.monotonic() and a "
+                    "MetricsScope histogram (runtime/metrics.py)",
+                ))
+    return out
+
+
+def unused_metric_names(parsed):
+    """Canonical ``dtpu_*`` names declared in runtime/metrics.py with zero
+    call sites anywhere else: a name in the catalog that nothing observes is
+    a dashboard lying in wait (the QUEUED_REQUESTS/KV_HIT_TOKENS bug class).
+    ``parsed`` is the [(path, tree)] list for the whole lint run; the pass
+    is skipped unless runtime/metrics.py is in it."""
+    metrics_entry = next(
+        (
+            (p, t) for p, t in parsed
+            if p.replace(os.sep, "/").endswith("runtime/metrics.py")
+        ),
+        None,
+    )
+    if metrics_entry is None:
+        return []
+    mpath, mtree = metrics_entry
+    declared = {}  # constant name -> lineno
+    for node in mtree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Name) and tgt.id.isupper()):
+            continue
+        # metric names are f"{PREFIX}_..." JoinedStrs (or plain strings);
+        # PREFIX itself and the LABEL_* constants are not metric names
+        if tgt.id == "PREFIX" or tgt.id.startswith("LABEL_"):
+            continue
+        if isinstance(node.value, ast.JoinedStr) or (
+            isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            declared[tgt.id] = node.lineno
+    if not declared:
+        return []
+    used = set()
+    for p, tree in parsed:
+        if p == mpath:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr in declared:
+                used.add(node.attr)
+            elif isinstance(node, ast.Name) and node.id in declared:
+                used.add(node.id)
+    return [
+        (mpath, lineno,
+         f"UNUSED-METRIC: {name} is in the canonical catalog but nothing "
+         "observes it — wire it or drop it")
+        for name, lineno in sorted(declared.items(), key=lambda kv: kv[1])
+        if name not in used
+    ]
+
+
 def _ident_tokens(text: str):
     tok = ""
     for ch in text:
@@ -374,6 +493,7 @@ def main(argv) -> int:
         os.path.join(os.path.dirname(os.path.dirname(__file__)), "dynamo_tpu")
     ]
     bad = 0
+    parsed = []  # (path, tree) for the cross-file passes
     for path in module_files(paths):
         with open(path, encoding="utf-8") as f:
             src = f.read()
@@ -383,6 +503,7 @@ def main(argv) -> int:
             print(f"{path}: SYNTAX: {e}")
             bad += 1
             continue
+        parsed.append((path, tree))
         for p, name in undefined_globals(path, src):
             print(f"{p}: UNDEFINED: {name}")
             bad += 1
@@ -405,6 +526,17 @@ def main(argv) -> int:
             for p, lineno, msg in kv_float32_allocations(path, tree):
                 print(f"{p}:{lineno}: {msg}")
                 bad += 1
+        if not norm.endswith("runtime/metrics.py"):
+            for p, lineno, msg in prometheus_imports(path, tree):
+                print(f"{p}:{lineno}: {msg}")
+                bad += 1
+        if _is_request_path_file(norm):
+            for p, lineno, msg in wallclock_latency(path, tree):
+                print(f"{p}:{lineno}: {msg}")
+                bad += 1
+    for p, lineno, msg in unused_metric_names(parsed):
+        print(f"{p}:{lineno}: {msg}")
+        bad += 1
     if bad:
         print(f"{bad} finding(s)")
     return 1 if bad else 0
