@@ -19,6 +19,29 @@ import os
 import sys
 import time
 
+# --sim: the deterministic CPU perf gate (dynamo_tpu/sim) — no TPU, no
+# device ops, runs even when the tunnel is down. Must branch BEFORE the
+# jax import below so a TPU-pinned jax can never stall the gate; the sim
+# itself never touches a device (JAX_PLATFORMS forced to cpu for the
+# transitive jax import via llm.protocols).
+if "--sim" in sys.argv:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    def _sim_main() -> None:
+        from dynamo_tpu.sim.report import bench_record
+        from dynamo_tpu.sim.scenarios import run_suite
+
+        reports = run_suite(
+            seed=int(os.environ.get("BENCH_SIM_SEED", "0")),
+            workers=int(os.environ.get("BENCH_SIM_WORKERS", "24")),
+            duration_s=float(os.environ.get("BENCH_SIM_DURATION", "360")),
+        )
+        print(json.dumps(bench_record(reports)), flush=True)
+
+    _sim_main()
+    sys.exit(0)
+
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dtpu_jax_cache")
 
 import jax  # noqa: E402

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 import msgpack
 
@@ -83,10 +83,15 @@ class WorkerMetricsPublisher:
         component: str,
         worker_id: int,
         dp_rank: int = 0,
+        clock: Callable[[], float] = time.time,
     ):
         self._plane = event_plane
         self._topic = metrics_topic(namespace, component)
         self.worker = WorkerWithDpRank(worker_id, dp_rank)
+        # metric freshness is judged against the consumer's clock
+        # (planner metrics_source, router scheduler): a simulated fleet
+        # injects its virtual clock so both sides share one timeline
+        self._clock = clock
         self._task: Optional[asyncio.Task] = None
 
     async def publish(
@@ -104,7 +109,7 @@ class WorkerMetricsPublisher:
             num_requests_waiting=num_requests_waiting,
             num_requests_active=num_requests_active,
             total_blocks=total_blocks,
-            ts=time.time(),
+            ts=self._clock(),
         )
         await self._plane.publish(self._topic, msgpack.packb(m.to_obj(), use_bin_type=True))
 

@@ -70,6 +70,9 @@ class KvRouter:
         self.namespace = namespace
         self.component = component
         self._plane = event_plane
+        # seeded rng for the snapshot-answer jitter below: the fleet
+        # simulator pins ``seed`` so replica-sync timing is reproducible
+        self._rng = random.Random(seed)
         self.scheduler = KvScheduler(self.config, seed=seed)
         self.indexer: KvIndexer | ApproxKvIndexer
         if self.config.use_kv_events:
@@ -213,7 +216,7 @@ class KvRouter:
         self._snapshots_seen.discard(requester)
 
         async def answer() -> None:
-            await asyncio.sleep(0.05 + 0.2 * random.random())
+            await asyncio.sleep(0.05 + 0.2 * self._rng.random())
             if requester in self._snapshots_seen:
                 return
             await self._publish_sync(

@@ -16,13 +16,13 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import hashlib
-import time
 from collections import OrderedDict
 from typing import Any, AsyncIterator, Dict, List, Optional, Set
 
 from ..kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from ..runtime.engine import Context
 from ..runtime.logging import get_logger
+from ..runtime.clock import WALL, Clock
 from ..tokens import SequenceHash, TokenBlockSequence
 from ..llm.protocols.common import (
     FINISH_ERROR,
@@ -190,6 +190,7 @@ class MockerEngine:
         args: Optional[MockEngineArgs] = None,
         kv_publisher: Optional[KvEventPublisher] = None,
         metrics_publisher: Optional[WorkerMetricsPublisher] = None,
+        clock: Optional[Clock] = None,
     ):
         self.args = args or MockEngineArgs()
         from .perf_model import load_perf_model
@@ -198,13 +199,17 @@ class MockerEngine:
         self.kv = KvBlockState(self.args)
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
+        # pacing clock: WALL for live use; the fleet simulator injects a
+        # VirtualClock so step sleeps and startup delays become discrete
+        # virtual-time events (sim/clock.py)
+        self.clock = clock or WALL
         self._waiting: List[_Running] = []
         self._running: List[_Running] = []
         self._outbox: List = []  # (queue, BackendOutput) deferred past the step sleep
         self.sim_time = 0.0      # simulated seconds of engine compute elapsed
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
-        self._started_at = time.monotonic()
+        self._started_at = self.clock.time()
         self._stopped = False
 
     # -- engine interface ----------------------------------------------------
@@ -213,9 +218,9 @@ class MockerEngine:
     ) -> AsyncIterator[BackendOutput]:
         req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_obj(request)
         self._ensure_loop()
-        startup_left = self.args.startup_time_s - (time.monotonic() - self._started_at)
+        startup_left = self.args.startup_time_s - (self.clock.time() - self._started_at)
         if startup_left > 0:
-            await asyncio.sleep(startup_left / self.args.speedup_ratio)
+            await self.clock.sleep(startup_left / self.args.speedup_ratio)
         if self._stopped:
             # stop() ran during the startup sleep: the loop's stranded-
             # consumer flush already happened, so erroring here is the only
@@ -264,7 +269,7 @@ class MockerEngine:
                 # the simulated step duration has elapsed — a real engine's
                 # first token arrives AFTER prefill compute, so TTFT
                 # measurements (profiler, benchmarks) see the model's cost
-                await asyncio.sleep(step_time / self.args.speedup_ratio)
+                await self.clock.sleep(step_time / self.args.speedup_ratio)
                 self.sim_time += step_time
                 if self.args.emit_sim_ts:
                     for _, item in self._outbox:

@@ -118,6 +118,12 @@ class PlannerConfig:
     # planner_core.py:132-256); 0 = unbounded
     total_budget: int = 0
     scale_down_headroom: float = 0.8   # only shrink when utilization < this
+    # bound on how much of the pool one tick may remove (1.0 = unbounded,
+    # the historical behavior). Without it a demand trough collapses the
+    # whole fleet in one decision and the next ramp pays full boot latency
+    # for every worker — the 100->1->rebuild oscillation the fleet
+    # simulator's diurnal no-oscillation invariant caught (sim/scenarios.py)
+    max_scale_down_frac: float = 1.0
     # EMA weight kept on the previous correction factor each window (0 =
     # jump straight to the latest measurement)
     correction_smoothing: float = 0.5
@@ -206,6 +212,13 @@ class PoolPlanner:
             capacity = self._capacity(snapshot)
             if predicted > capacity * desired * self.config.scale_down_headroom:
                 desired = current
+            elif self.config.max_scale_down_frac < 1.0:
+                # bounded descent: never drop more than the configured
+                # fraction of the current pool in one tick
+                floor = math.ceil(
+                    current * (1.0 - self.config.max_scale_down_frac)
+                )
+                desired = max(desired, int(floor))
         if desired != current:
             log.info(
                 "%s pool: scaling %s %d -> %d (predicted load %.1f)",

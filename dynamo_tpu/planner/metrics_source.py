@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Dict
+from typing import Callable, Dict
 
 import msgpack
 
@@ -36,9 +36,13 @@ class FrontendStatsPublisher:
     the HTTP layer already measures TTFT/ITL per stream for its Prometheus
     histograms; this fans the same numbers out to the planner."""
 
-    def __init__(self, plane: EventPlane, namespace: str = "dynamo"):
+    def __init__(self, plane: EventPlane, namespace: str = "dynamo",
+                 clock: Callable[[], float] = time.time):
         self.plane = plane
         self.topic = frontend_stats_topic(namespace)
+        # injectable clock so simulated frontends stamp stats on the sim
+        # timeline (sim/clock.py); live frontends keep wall time
+        self._clock = clock
         # strong refs: the loop only weak-refs tasks, and a GC'd publish
         # task silently drops the stats event
         self._inflight: set = set()
@@ -47,7 +51,7 @@ class FrontendStatsPublisher:
                    ttft_s: float, itl_s: float) -> None:
         payload = msgpack.packb({
             "pt": int(prompt_tokens), "ct": int(completion_tokens),
-            "ttft": float(ttft_s), "itl": float(itl_s), "ts": time.time(),
+            "ttft": float(ttft_s), "itl": float(itl_s), "ts": self._clock(),
         }, use_bin_type=True)
 
         async def _send() -> None:
@@ -67,15 +71,21 @@ class FrontendStatsPublisher:
 class EventPlaneMetricsSource:
     """Aggregates worker metrics + frontend stats into LoadSnapshots."""
 
-    def __init__(self, plane: EventPlane, namespace: str, components: list):
+    def __init__(self, plane: EventPlane, namespace: str, components: list,
+                 clock: Callable[[], float] = time.time):
         self.plane = plane
         self.namespace = namespace
         self.components = components
+        # rate windows divide by elapsed *clock* seconds: under the fleet
+        # simulator this must be the virtual clock or the planner would see
+        # simulated arrivals over wall windows and misread rates by the
+        # wall/virtual ratio (ISSUE 6 satellite)
+        self._clock = clock
         self._latest: Dict[WorkerWithDpRank, WorkerMetrics] = {}
         self._tasks = []
         self._subs = []
         # per-window accumulators for rate/latency estimation
-        self._last_rate_calc = time.time()
+        self._last_rate_calc = self._clock()
         self._decode_tokens_window = 0
         self._prefill_tokens_window = 0
         self._requests_window = 0
@@ -129,7 +139,7 @@ class EventPlaneMetricsSource:
             self._itl_window.append(itl_s)
 
     def snapshot(self) -> LoadSnapshot:
-        now = time.time()
+        now = self._clock()
         dt = max(now - self._last_rate_calc, 1e-6)
         fresh = [m for m in self._latest.values() if now - m.ts < 30.0]
         n_req = self._requests_window

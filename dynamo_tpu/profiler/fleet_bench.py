@@ -35,6 +35,7 @@ from ..llm.protocols.common import (
 from ..mocker.engine import MockEngineArgs, MockerEngine
 from ..runtime.engine import Context
 from ..runtime.event_plane.base import InProcEventPlane
+from ..runtime.clock import WALL, Clock
 
 
 def _prompt(group: int, i: int, prompt_len: int, shared_len: int) -> List[int]:
@@ -212,6 +213,7 @@ async def disagg_vs_agg_bench(
     osl: int = 256,
     block_size: int = 16,
     speedup: float = 100.0,
+    clock: Optional[Clock] = None,
 ) -> Dict[str, object]:
     """Decode ITL under a prefill-heavy load: aggregated vs disaggregated.
 
@@ -222,6 +224,8 @@ async def disagg_vs_agg_bench(
     dedicated prefill worker (decode side sees the KV as transferred —
     the mocker analog of the NIXL pull), decode steps stay pure."""
     from ..tokens import TokenBlockSequence
+
+    clock = clock or WALL
 
     args = MockEngineArgs(
         block_size=block_size, num_blocks=32768, speedup_ratio=speedup,
@@ -268,7 +272,7 @@ async def disagg_vs_agg_bench(
             # the bench
             tasks = []
             for rid, toks in prefill_reqs:
-                await asyncio.sleep(0.002)
+                await clock.sleep(0.002)
                 tasks.append(asyncio.ensure_future(one_prefill(rid, toks)))
             await asyncio.gather(*tasks, return_exceptions=True)
 

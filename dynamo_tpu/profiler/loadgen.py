@@ -11,9 +11,12 @@ real PoolPlanner, whose decisions resize the fleet — so planner heuristics
 (correction factors, the queue bump) are validated against load shapes
 instead of being constants taken on faith.
 
-All latencies are SIMULATED-clock quantities (mocker sim_ts); arrivals are
-paced in wall time and scaled by speedup_ratio, so a minutes-long diurnal
-trace replays in CI seconds.
+All latencies are SIMULATED-clock quantities (mocker sim_ts). Arrival pacing
+and poll loops run on an injectable ``Clock`` (sim/clock.py): the default
+WALL clock paces in wall time scaled by speedup_ratio (live use), while the
+fleet simulator injects a VirtualClock so the same replay runs jitter-free
+on virtual time (host asyncio jitter is amplified by speedup_ratio and was
+measurably flaking the overload assertions on slow CI hosts).
 """
 
 from __future__ import annotations
@@ -34,6 +37,7 @@ from ..mocker.engine import MockEngineArgs, MockerEngine
 from ..planner.core import LoadSnapshot, PoolPlanner
 from ..runtime.engine import Context
 from ..runtime.logging import get_logger
+from ..runtime.clock import WALL, Clock
 
 log = get_logger("profiler.loadgen")
 
@@ -166,12 +170,15 @@ async def replay(
     speedup: float = 1.0,
     route_fn: Optional[Callable[[int, List[int]], int]] = None,
     on_arrival: Optional[Callable[[TraceItem], None]] = None,
+    clock: Optional[Clock] = None,
 ) -> SlaReport:
     """Replay ``trace`` against a mocker fleet at arrival-time pacing
-    (wall-clock, divided by ``speedup``), reporting SLA attainment measured
-    on the engines' simulated clocks. ``route_fn(idx, tokens)`` picks the
-    worker (default round-robin over the CURRENT fleet, so a resize mid-
-    replay shifts traffic — what planner_sim exercises)."""
+    (``clock`` seconds — wall by default — divided by ``speedup``),
+    reporting SLA attainment measured on the engines' simulated clocks.
+    ``route_fn(idx, tokens)`` picks the worker (default round-robin over
+    the CURRENT fleet, so a resize mid-replay shifts traffic — what
+    planner_sim exercises)."""
+    clock = clock or WALL
     ttfts: List[float] = []
     itls: List[float] = []
     cached = [0]
@@ -208,7 +215,7 @@ async def replay(
         dt = (item.t - t_prev_arrival) / speedup
         t_prev_arrival = item.t
         if dt > 0:
-            await asyncio.sleep(dt)
+            await clock.sleep(dt)
         if on_arrival is not None:
             on_arrival(item)
         tasks.append(asyncio.create_task(one(idx, item)))
@@ -236,9 +243,15 @@ async def replay(
 class FleetConnector:
     """Planner connector that resizes an in-process mocker fleet."""
 
-    def __init__(self, engines: List[MockerEngine], make_engine: Callable[[], MockerEngine]):
+    def __init__(
+        self,
+        engines: List[MockerEngine],
+        make_engine: Callable[[], MockerEngine],
+        clock: Optional[Clock] = None,
+    ):
         self.engines = engines
         self.make_engine = make_engine
+        self.clock = clock or WALL
         self.drain_tasks: List[asyncio.Task] = []
 
     async def get_replicas(self, component: str) -> int:
@@ -254,13 +267,12 @@ class FleetConnector:
                 asyncio.create_task(self._drain_stop(self.engines.pop()))
             )
 
-    @staticmethod
-    async def _drain_stop(engine: MockerEngine) -> None:
+    async def _drain_stop(self, engine: MockerEngine) -> None:
         while True:
             s = engine.snapshot()
             if not s["waiting"] and not s["running"]:
                 break
-            await asyncio.sleep(0.05)
+            await self.clock.sleep(0.05)
         engine.stop()
 
 
@@ -281,30 +293,31 @@ async def planner_sim(
     ttft_target_s: float = 0.5,
     itl_target_s: float = 0.05,
     prefix_share: float = 0.3,
+    clock: Optional[Clock] = None,
 ) -> PlannerSimResult:
     """Closed loop: replay ``trace`` while a real PoolPlanner observes fleet
-    snapshots every ``tick_s`` wall-seconds and resizes the fleet through a
+    snapshots every ``tick_s`` clock-seconds and resizes the fleet through a
     FleetConnector. Returns the SLA report plus the replica/correction
     timelines for convergence assertions."""
+    clock = clock or WALL
     args = engine_args or MockEngineArgs(
         emit_sim_ts=True, speedup_ratio=speedup, num_blocks=512,
     )
 
     def make_engine() -> MockerEngine:
-        return MockerEngine(dataclasses.replace(args))
+        return MockerEngine(dataclasses.replace(args), clock=clock)
 
     engines = [make_engine() for _ in range(initial_replicas)]
-    conn = FleetConnector(engines, make_engine)
+    conn = FleetConnector(engines, make_engine, clock=clock)
     planner = planner_factory(conn)
 
-    arrivals: List[float] = []   # wall-clock arrival stamps (for rate calc)
+    arrivals: List[float] = []   # clock arrival stamps (for rate calc)
     isls: List[int] = []
     replica_timeline: List[int] = []
     correction_timeline: List[float] = []
-    loop = asyncio.get_event_loop()
 
     def on_arrival(item: TraceItem) -> None:
-        arrivals.append(loop.time())
+        arrivals.append(clock.time())
         isls.append(item.isl)
 
     rr = [0]
@@ -316,14 +329,14 @@ async def planner_sim(
     stop = asyncio.Event()
 
     async def planner_loop() -> None:
-        window_start = loop.time()
+        window_start = clock.time()
         seen = 0
         while not stop.is_set():
             try:
                 await asyncio.wait_for(stop.wait(), tick_s)
             except asyncio.TimeoutError:
                 pass
-            now = loop.time()
+            now = clock.time()
             new = arrivals[seen:]
             seen = len(arrivals)
             window = max(now - window_start, 1e-6)
@@ -350,7 +363,7 @@ async def planner_sim(
         report = await replay(
             trace, engines, ttft_target_s, itl_target_s,
             prefix_share=prefix_share, speedup=speedup,
-            route_fn=route, on_arrival=on_arrival,
+            route_fn=route, on_arrival=on_arrival, clock=clock,
         )
     finally:
         stop.set()

@@ -1,0 +1,35 @@
+"""The injectable time source for pace-and-stamp code.
+
+``Clock`` is the funnel every sim-path component takes (mocker engine
+steps, loadgen arrival pacing, planner rate windows, worker-metrics
+timestamps). The default ``WALL`` instance preserves live behavior
+(``time.monotonic`` + ``asyncio.sleep``); the fleet simulator injects
+``sim.clock.VirtualClock`` instead. It lives in ``runtime`` — not ``sim``
+— so core modules (mocker, profiler, planner) never import from the sim
+package and no import cycle can form; tools/lint.py's SIM-WALLCLOCK pass
+enforces that sim-path modules route pacing through a Clock rather than
+calling ``time.time()`` / ``time.sleep()`` / ``asyncio.sleep()`` directly,
+and this module is the one exempt wall-clock funnel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class Clock:
+    """Wall-clock time source: ``time()`` seconds + async ``sleep``.
+
+    Intervals only — ``time()`` is monotonic, not epoch-anchored, so callers
+    must treat values as differences (exactly how the sim path uses them).
+    """
+
+    def time(self) -> float:
+        return time.monotonic()
+
+    async def sleep(self, dt: float) -> None:
+        await asyncio.sleep(max(dt, 0.0))
+
+
+WALL = Clock()

@@ -1,0 +1,27 @@
+"""dynamo_tpu.sim: deterministic virtual-time fleet simulation.
+
+Hundreds of mocker workers behind the *real* control plane (kv_router,
+planner, pool selection, breakers, fault injection) in one process, driven
+on a virtual clock so minutes-long traces replay in CI seconds with
+same-seed -> bit-identical reports. See docs/operations.md
+"Fleet simulation & perf gate".
+
+The injectable ``Clock`` base lives in ``runtime/clock.py`` (so core
+modules like the mocker and loadgen never import from this package);
+``sim.clock`` adds the virtual driver and re-exports the base. Heavier
+submodules are imported lazily to keep ``import dynamo_tpu.sim`` cheap.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from .clock import WALL, Clock, VirtualClock, VirtualTimeStall, run  # noqa: F401
+
+_LAZY = ("traces", "fleet", "scenarios", "report")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,85 @@
+"""CLI: ``python -m dynamo_tpu.sim <scenario> [--workers N] [--seed S] ...``
+
+Runs one named scenario (or ``suite`` for the perf-gate four) on the
+virtual clock and prints its report JSON. Exit code 1 if any invariant
+failed — usable directly as a CI gate.
+
+Examples::
+
+    python -m dynamo_tpu.sim diurnal --workers 100
+    python -m dynamo_tpu.sim bursty-breaker-chaos --seed 7 --duration 600
+    python -m dynamo_tpu.sim suite --workers 24 --out report.json
+    python -m dynamo_tpu.sim list
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .scenarios import ALIASES, SCENARIOS, run_scenario, run_suite
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.sim",
+        description="deterministic virtual-time fleet simulator",
+    )
+    ap.add_argument(
+        "scenario",
+        help="scenario name or alias (see 'list'), or 'suite' for the "
+             "perf-gate four",
+    )
+    ap.add_argument("--workers", type=int, default=100,
+                    help="fleet size / autoscale cap (default 100)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=720.0,
+                    help="simulated seconds to replay (default 720 = 12 min)")
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON to this path")
+    ap.add_argument("--wall", action="store_true",
+                    help="include the non-deterministic wall section in "
+                         "stdout (always present in --out)")
+    args = ap.parse_args(argv)
+
+    if args.scenario == "list":
+        for name in sorted(SCENARIOS):
+            short = [a for a, full in ALIASES.items() if full == name]
+            print(f"{name}" + (f"  (alias: {short[0]})" if short else ""))
+        return 0
+
+    if args.scenario == "suite":
+        reports = run_suite(seed=args.seed, workers=args.workers,
+                            duration_s=args.duration)
+    else:
+        reports = [run_scenario(args.scenario, seed=args.seed,
+                                workers=args.workers,
+                                duration_s=args.duration)]
+
+    if args.out:
+        # always a list, so the file's shape doesn't depend on how many
+        # scenarios ran (single run vs suite)
+        with open(args.out, "w") as f:
+            json.dump(reports, f, indent=2, sort_keys=True)
+    ok = True
+    for rep in reports:
+        shown = dict(rep) if args.wall else {"sim": rep["sim"]}
+        print(json.dumps(shown, indent=2, sort_keys=True))
+        sim = rep["sim"]
+        ok = ok and sim["passed"]
+        status = "PASS" if sim["passed"] else "FAIL"
+        bad = [iv["name"] for iv in sim["invariants"] if not iv["ok"]]
+        print(
+            f'# {sim["scenario"]}: {status} '
+            f'({len(sim["invariants"])} invariants'
+            + (f", failing: {bad}" if bad else "")
+            + f'; {rep["wall"]["elapsed_s"]}s wall for '
+            f'{sim["sim_advanced_s"]}s simulated)',
+            file=sys.stderr,
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Deterministic virtual time: the sim's loop driver over the Clock funnel.
+
+The injectable ``Clock`` base (and its live ``WALL`` instance) lives in
+``runtime/clock.py`` so core modules never import from the sim package;
+both are re-exported here for convenience. This module adds the virtual
+half:
+
+``VirtualClock`` + ``run()`` — a discrete-event driver over a *stock*
+asyncio event loop. Rather than reimplementing timers, ``run()`` points
+``loop.time`` at the virtual clock and wraps the loop's selector: whenever
+the loop is about to block waiting for its earliest timer (i.e. no task is
+runnable — the loop itself computed the idle gap), the wrapper advances
+virtual time by exactly that gap instead of sleeping. Every
+``asyncio.sleep`` / ``wait_for`` timeout on the loop thereby becomes a
+virtual-time event with zero wall cost and zero host-scheduling jitter, so
+a minutes-long diurnal trace replays in CI seconds and two same-seed runs
+interleave identically (asyncio's ready queue and timer heap are FIFO /
+(when, tiebreak-counter) ordered — deterministic given deterministic
+inputs).
+
+A sim that deadlocks (no runnable task, no pending timer) raises
+``VirtualTimeStall`` instead of hanging CI: with wall I/O off the sim path,
+a loop with nothing to run and nothing to wait for can never make progress.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Union
+
+from ..runtime.clock import WALL, Clock  # noqa: F401  (re-export)
+
+
+class VirtualClock(Clock):
+    """Virtual seconds; advanced only by the ``run()`` loop driver (or
+    explicitly via ``advance`` in unit tests). ``sleep`` delegates to
+    ``asyncio.sleep``, which is virtual under ``run()``."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self.advanced = 0.0  # total virtual seconds driven so far
+
+    def time(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> None:
+        if dt < 0:
+            raise ValueError(f"cannot advance virtual time by {dt}")
+        self._now += dt
+        self.advanced += dt
+
+
+class VirtualTimeStall(RuntimeError):
+    """The virtualized loop has no runnable task and no pending timer."""
+
+
+class _VirtualSelector:
+    """Selector wrapper that converts idle blocking into time advancement.
+
+    ``BaseEventLoop._run_once`` computes ``timeout`` as: 0 when callbacks
+    are ready, ``earliest_timer - loop.time()`` when only timers pend, and
+    None when nothing at all pends. We poll real FDs without blocking
+    (call_soon_threadsafe self-pipe wakeups still work), and when the loop
+    would have idled until a timer we jump the virtual clock there instead.
+    """
+
+    # consecutive no-timer no-event polls tolerated before declaring a stall
+    # (a thread may be about to wake the loop via call_soon_threadsafe)
+    _MAX_IDLE_POLLS = 3
+    _IDLE_POLL_S = 0.05
+
+    def __init__(self, inner, clock: VirtualClock):
+        self._inner = inner
+        self._clock = clock
+        self._idle_polls = 0
+
+    def select(self, timeout=None):
+        events = self._inner.select(0)
+        if events:
+            self._idle_polls = 0
+            return events
+        if timeout is None:
+            # no ready callbacks, no timers: either a thread is about to
+            # wake us through the self-pipe (grace-poll for it) or the sim
+            # is deadlocked
+            self._idle_polls += 1
+            if self._idle_polls > self._MAX_IDLE_POLLS:
+                raise VirtualTimeStall(
+                    "virtual-time deadlock: no runnable tasks and no timers "
+                    "(a sim task is awaiting an event nothing will set)"
+                )
+            return self._inner.select(self._IDLE_POLL_S)
+        self._idle_polls = 0
+        if timeout > 0:
+            self._clock.advance(timeout)
+        return []
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+def run(
+    main: Union[Awaitable, Callable[[VirtualClock], Awaitable]],
+    *,
+    start: float = 0.0,
+) -> Any:
+    """Drive ``main`` to completion on a fresh virtual-time event loop.
+
+    ``main`` is a coroutine, or a callable taking the ``VirtualClock`` and
+    returning one (for code that wants the clock injected). Returns main's
+    result; the loop (and any tasks it leaked) is torn down afterwards.
+    """
+    clock = VirtualClock(start)
+    loop = asyncio.new_event_loop()
+    inner = getattr(loop, "_selector", None)
+    if inner is None:  # pragma: no cover - proactor/uvloop hosts
+        loop.close()
+        raise RuntimeError(
+            "virtual time needs a selector event loop (loop._selector)"
+        )
+    loop._selector = _VirtualSelector(inner, clock)
+    loop.time = clock.time  # type: ignore[method-assign]
+    coro = main(clock) if callable(main) else main
+    try:
+        asyncio.set_event_loop(loop)
+        return loop.run_until_complete(coro)
+    finally:
+        try:
+            leftovers = asyncio.all_tasks(loop)
+            for t in leftovers:
+                t.cancel()
+            if leftovers:
+                loop.run_until_complete(
+                    asyncio.gather(*leftovers, return_exceptions=True)
+                )
+            loop.run_until_complete(loop.shutdown_asyncgens())
+        finally:
+            asyncio.set_event_loop(None)
+            loop.close()

@@ -1,0 +1,534 @@
+"""Scenario builder: N mocker workers behind the real control plane.
+
+One process, one virtual clock: each ``SimPool`` stands up mocker engines
+(``mocker/engine.py``) publishing real KV events + worker metrics onto an
+in-proc event plane, routed by a real ``KvRouter``, observed by a real
+``EventPlaneMetricsSource`` feeding a real ``PoolPlanner`` whose decisions
+resize the fleet (extending ``profiler/loadgen.planner_sim`` from a one-off
+validation into the subsystem's closed loop). Per-worker ``CircuitBreaker``s
+steer traffic around flapping workers exactly like the frontend does
+(``llm/discovery.py _tripped``), and flaps themselves come from the PR 1
+fault registry (points ``sim.worker.<id>``, seeded schedules) so chaos is
+reproducible.
+
+Everything that paces — arrivals, engine steps, planner windows, breaker
+reset timers, worker boot — rides the injected ``Clock``; under
+``sim.clock.run`` a minutes-long trace replays in seconds and two same-seed
+runs produce identical request records. The only wall-clock quantity kept is
+the router *decision latency* (``time.perf_counter_ns`` around
+``schedule_tokens``): that is a real control-plane CPU cost this harness
+exists to measure (ROADMAP item 3), and it is reported in the separate
+non-deterministic ``wall`` section of the scenario report.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..kv_router import (
+    KvEventPublisher,
+    KvRouter,
+    KvRouterConfig,
+    WorkerMetricsPublisher,
+    WorkerWithDpRank,
+)
+from ..llm.protocols.common import (
+    FINISH_ERROR,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from ..mocker.engine import MockEngineArgs, MockerEngine
+from ..planner.core import LoadSnapshot, PlannerConfig, PoolPlanner
+from ..planner.metrics_source import (
+    EventPlaneMetricsSource,
+    FrontendStatsPublisher,
+)
+from ..profiler.loadgen import prefix_prompt
+from ..runtime import metrics as M
+from ..runtime.engine import Context
+from ..runtime.event_plane.base import InProcEventPlane
+from ..runtime.faults import FAULTS, FaultInjected, parse_faults
+from ..runtime.logging import get_logger
+from ..runtime.resilience import CLOSED, OPEN, CircuitBreaker
+from .clock import Clock
+from .traces import SimRequest
+
+log = get_logger("sim.fleet")
+
+
+def worker_fault_point(worker_id: int) -> str:
+    """Fault-registry point name for one simulated worker's serving path."""
+    return f"sim.worker.{worker_id}"
+
+
+@dataclasses.dataclass
+class PoolConfig:
+    """One worker pool (a namespace with its own router and planner)."""
+
+    name: str = "pool0"
+    namespace: str = "sim"
+    component: str = "backend"
+    initial_workers: int = 4
+    min_workers: int = 1
+    max_workers: int = 64
+    # mocker sizing
+    block_size: int = 16
+    num_blocks: int = 4096
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int = 8192
+    startup_time_s: float = 5.0        # simulated boot time of a new worker
+    # mocker timing model: deliberately slow per-worker speeds so hundreds
+    # of workers are *needed* at realistic request rates while the step
+    # count (= python cost) stays low
+    prefill_base_s: float = 0.05
+    prefill_per_token_s: float = 5e-4
+    decode_base_s: float = 0.05
+    decode_per_kv_block_s: float = 1e-5
+    # planner (autoscale=False -> fixed fleet of initial_workers)
+    autoscale: bool = False
+    adjustment_interval_s: float = 10.0
+    capacity_req_s: float = 1.0        # per-worker sustainable req/s profile
+    expected_ttft_s: float = 0.0       # >0 -> measured/expected correction
+    queue_bump_divisor: float = 4.0
+    scale_down_headroom: float = 0.8
+    max_scale_down_frac: float = 0.5   # bounded descent (planner/core.py)
+    predictor: str = "holt"
+    # router
+    overlap_weight: float = 1.0
+    router_temperature: float = 0.0
+    # per-worker breakers (llm/discovery.py analog)
+    breaker_threshold: int = 3
+    breaker_window_s: float = 60.0
+    breaker_reset_s: float = 30.0
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    seed: int = 0
+    prefix_share: float = 0.5          # shared fraction of each group prompt
+    max_attempts: int = 3              # retry-then-migrate bound per request
+    faults: str = ""                   # DTPU_FAULTS-style spec armed for the run
+    pools: List[PoolConfig] = dataclasses.field(
+        default_factory=lambda: [PoolConfig()]
+    )
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    idx: int
+    group: int
+    region: str
+    pool: str
+    t_arrive: float
+    isl: int
+    osl: int
+    ttft_target_s: float
+    itl_target_s: float
+    worker: int = -1
+    ttft_s: float = -1.0
+    itl_sum_s: float = 0.0
+    itl_count: int = 0
+    cached_tokens: int = 0
+    input_tokens: int = 0
+    produced: int = 0
+    attempts: int = 0
+    ok: bool = False
+
+    @property
+    def itl_mean_s(self) -> float:
+        return self.itl_sum_s / self.itl_count if self.itl_count else 0.0
+
+
+@dataclasses.dataclass
+class SimWorker:
+    wid: int
+    engine: MockerEngine
+    breaker: CircuitBreaker
+    spawned_at: float
+    requests: int = 0
+    last_state: str = CLOSED
+
+
+class _PoolConnector:
+    """Planner connector resizing a SimPool (the closed loop's actuator)."""
+
+    def __init__(self, pool: "SimPool"):
+        self.pool = pool
+
+    async def get_replicas(self, component: str) -> int:
+        return len(self.pool.workers)
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        self.pool.resize(n)
+
+
+class SimPool:
+    def __init__(self, fleet: "SimFleet", cfg: PoolConfig, seed: int):
+        self.fleet = fleet
+        self.cfg = cfg
+        self.clock: Clock = fleet.clock
+        self.plane = fleet.plane
+        self.workers: Dict[int, SimWorker] = {}
+        self._next_wid = 1
+        # wid -> WorkerWithDpRank, cached: _candidates builds a ~fleet-sized
+        # list per routing decision and dataclass construction dominates it
+        self._cands: Dict[int, WorkerWithDpRank] = {}
+        self.router = KvRouter(
+            self.plane, cfg.namespace, cfg.component,
+            block_size=cfg.block_size,
+            config=KvRouterConfig(
+                overlap_score_weight=cfg.overlap_weight,
+                router_temperature=cfg.router_temperature,
+            ),
+            seed=seed,
+        )
+        self.stats_pub = FrontendStatsPublisher(
+            self.plane, cfg.namespace, clock=self.clock.time
+        )
+        self.metrics_source: Optional[EventPlaneMetricsSource] = None
+        self.planner: Optional[PoolPlanner] = None
+        # -- deterministic outputs -------------------------------------------
+        self.records: List[RequestRecord] = []
+        self.itls: List[float] = []
+        self.replica_timeline: List[List[float]] = []   # [t, replicas]
+        self.correction_timeline: List[float] = []
+        self.breaker_events: List[List] = []            # [t, wid, state]
+        self.fanout: List[int] = []                     # candidates/decision
+        # -- wall-clock outputs (real control-plane CPU cost) ----------------
+        self.decision_wall_ns: List[int] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self) -> "SimPool":
+        await self.router.start()
+        for _ in range(self.cfg.initial_workers):
+            self._spawn(startup_s=0.0)  # the initial fleet is already booted
+        if self.cfg.autoscale:
+            self.metrics_source = await EventPlaneMetricsSource(
+                self.plane, self.cfg.namespace, [self.cfg.component],
+                clock=self.clock.time,
+            ).start()
+            self.planner = PoolPlanner(
+                self.cfg.name, self.cfg.component, _PoolConnector(self),
+                PlannerConfig(
+                    adjustment_interval_s=self.cfg.adjustment_interval_s,
+                    predictor=self.cfg.predictor,
+                    min_replicas=self.cfg.min_workers,
+                    max_replicas=self.cfg.max_workers,
+                    queue_bump_divisor=self.cfg.queue_bump_divisor,
+                    scale_down_headroom=self.cfg.scale_down_headroom,
+                    max_scale_down_frac=self.cfg.max_scale_down_frac,
+                ),
+                capacity_fn=lambda snap: self.cfg.capacity_req_s,
+            )
+            self.fleet.spawn_task(self._planner_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self.metrics_source is not None:
+            self.metrics_source.stop()
+        for w in self.workers.values():
+            w.engine.stop()
+        await self.router.stop()
+
+    def _spawn(self, startup_s: Optional[float] = None) -> int:
+        wid = self._next_wid
+        self._next_wid += 1
+        cfg = self.cfg
+        args = MockEngineArgs(
+            num_blocks=cfg.num_blocks, block_size=cfg.block_size,
+            max_num_seqs=cfg.max_num_seqs,
+            max_num_batched_tokens=cfg.max_num_batched_tokens,
+            emit_sim_ts=True, speedup_ratio=1.0,
+            startup_time_s=(
+                cfg.startup_time_s if startup_s is None else startup_s
+            ),
+            prefill_base_s=cfg.prefill_base_s,
+            prefill_per_token_s=cfg.prefill_per_token_s,
+            decode_base_s=cfg.decode_base_s,
+            decode_per_kv_block_s=cfg.decode_per_kv_block_s,
+        )
+        engine = MockerEngine(
+            args,
+            kv_publisher=KvEventPublisher(
+                self.plane, cfg.namespace, cfg.component,
+                worker_id=wid, block_size=cfg.block_size,
+            ),
+            metrics_publisher=WorkerMetricsPublisher(
+                self.plane, cfg.namespace, cfg.component,
+                worker_id=wid, clock=self.clock.time,
+            ),
+            clock=self.fleet.clock,
+        )
+        # per-worker breaker on the virtual clock (discovery.py analog);
+        # detached metrics scope — worker ids churn under autoscaling
+        breaker = CircuitBreaker(
+            name=f"sim.{cfg.name}.worker.{wid}",
+            failure_threshold=cfg.breaker_threshold,
+            failure_rate=0.5,
+            window_s=cfg.breaker_window_s,
+            reset_timeout_s=cfg.breaker_reset_s,
+            metrics=self.fleet.breaker_metrics,
+            clock=self.clock.time,
+        )
+        self.workers[wid] = SimWorker(
+            wid, engine, breaker, spawned_at=self.clock.time()
+        )
+        self._cands[wid] = WorkerWithDpRank(wid, 0)
+        return wid
+
+    def resize(self, n: int) -> None:
+        n = max(self.cfg.min_workers, min(self.cfg.max_workers, n))
+        while len(self.workers) < n:
+            self._spawn()
+        while len(self.workers) > n:
+            # retire newest-first (LIFO, mirrors FleetConnector.pop):
+            # the oldest workers hold the warmest radix caches
+            self._retire(max(self.workers))
+
+    def _retire(self, wid: int) -> None:
+        w = self.workers.pop(wid)
+        self._cands.pop(wid, None)
+        self.router.remove_worker_id(wid)
+        self.fleet.spawn_task(self._drain_stop(w))
+
+    async def _drain_stop(self, w: SimWorker) -> None:
+        try:
+            while True:
+                s = w.engine.snapshot()
+                if not s["waiting"] and not s["running"]:
+                    break
+                await self.clock.sleep(0.25)
+        finally:
+            # retired workers are no longer in self.workers, so pool.stop()
+            # can't reach them — stop the engine even if the drain is
+            # cancelled at fleet shutdown
+            w.engine.stop()
+
+    # -- the closed loop -----------------------------------------------------
+    async def _planner_loop(self) -> None:
+        assert self.planner is not None and self.metrics_source is not None
+        while True:
+            await self.clock.sleep(self.cfg.adjustment_interval_s)
+            snap: LoadSnapshot = self.metrics_source.snapshot()
+            self.planner.observe(snap.request_rate)
+            if self.cfg.expected_ttft_s > 0 and snap.measured_ttft > 0:
+                self.planner.update_correction(
+                    snap.measured_ttft, self.cfg.expected_ttft_s
+                )
+            try:
+                await self.planner.plan_and_apply(snap)
+            except Exception:
+                log.exception("sim planner tick failed (pool %s)", self.cfg.name)
+            self.replica_timeline.append(
+                [round(self.clock.time(), 3), len(self.workers)]
+            )
+            self.correction_timeline.append(round(self.planner.correction, 4))
+
+    # -- request path --------------------------------------------------------
+    def _candidates(self, excluded=()) -> List[WorkerWithDpRank]:
+        """Live workers minus open breakers minus this request's already-
+        failed workers — unless that empties the pool (then a tripped
+        worker beats no worker; llm/discovery.py _tripped + Migration's
+        excluded-instance list)."""
+        avoid = [
+            wid for wid, w in self.workers.items()
+            if wid in excluded or w.breaker.state == OPEN
+        ]
+        eligible = [wid for wid in self.workers if wid not in avoid]
+        if not eligible:
+            eligible = list(self.workers)
+        return [self._cands[wid] for wid in eligible]
+
+    def _note_breaker(self, w: SimWorker) -> None:
+        state = w.breaker.state
+        if state != w.last_state:
+            self.breaker_events.append(
+                [round(self.clock.time(), 3), w.wid, state]
+            )
+            w.last_state = state
+
+    async def submit(self, idx: int, sreq: SimRequest) -> RequestRecord:
+        item = sreq.item
+        tokens = prefix_prompt(item, idx, self.fleet.cfg.prefix_share)
+        t_arrive = self.clock.time()
+        rec = RequestRecord(
+            idx=idx, group=item.group, region=sreq.region, pool=self.cfg.name,
+            t_arrive=round(t_arrive, 6), isl=item.isl, osl=item.osl,
+            ttft_target_s=sreq.ttft_target_s, itl_target_s=sreq.itl_target_s,
+        )
+        tried: set = set()
+        while rec.attempts < self.fleet.cfg.max_attempts:
+            rec.attempts += 1
+            cands = self._candidates(excluded=tried)
+            if not cands:
+                break
+            rid = f"sim-{self.cfg.name}-{idx}.a{rec.attempts}"
+            t0 = time.perf_counter_ns()
+            decision = self.router.schedule_tokens(tokens, cands, request_id=rid)
+            self.decision_wall_ns.append(time.perf_counter_ns() - t0)
+            self.fanout.append(len(cands))
+            wid = decision.worker.worker_id
+            w = self.workers.get(wid)
+            ok = False
+            try:
+                # seeded flap injection on this worker's serving path
+                await FAULTS.ainject(worker_fault_point(wid))
+                if w is None:  # retired between decision and dispatch
+                    raise ConnectionError(f"sim worker {wid} gone")
+                ok = await self._consume(w.engine, rid, tokens, item, rec, t_arrive)
+            except (ConnectionError, FaultInjected):
+                ok = False
+            finally:
+                self.router.complete(rid)
+            if not ok:
+                # exclude on ANY failure, raised or not (FINISH_ERROR frame,
+                # stream ending without a finish) — otherwise radix affinity
+                # re-picks the same dead worker every attempt
+                tried.add(wid)
+            if w is not None:
+                w.breaker.record(ok)
+                self._note_breaker(w)
+            if ok:
+                rec.ok = True
+                rec.worker = wid
+                w.requests += 1
+                # the real stack's frontend stats fan-out: planner
+                # correction factors read these measured latencies
+                self.stats_pub.on_request(
+                    prompt_tokens=rec.input_tokens or len(tokens),
+                    completion_tokens=rec.produced,
+                    ttft_s=rec.ttft_s,
+                    itl_s=rec.itl_mean_s,
+                )
+                break
+        self.records.append(rec)
+        return rec
+
+    async def _consume(
+        self,
+        engine: MockerEngine,
+        rid: str,
+        tokens: List[int],
+        item,
+        rec: RequestRecord,
+        t_arrive: float,
+    ) -> bool:
+        req = PreprocessedRequest(
+            request_id=rid, model="sim", token_ids=tokens,
+            stop=StopConditions(
+                max_tokens=item.osl, min_tokens=item.osl, ignore_eos=True
+            ),
+            sampling=SamplingOptions(temperature=0.0),
+        )
+        t_prev: Optional[float] = None
+        produced = 0
+        async for out in engine.generate(req, Context(rid)):
+            if out.finish_reason == FINISH_ERROR:
+                return False
+            if not out.token_ids:
+                continue
+            now = self.clock.time()
+            if t_prev is None:
+                # serving TTFT on the one shared timeline: includes queueing,
+                # worker boot and routing retries, not just engine compute
+                rec.ttft_s = now - t_arrive
+                rec.cached_tokens = out.annotations.get("cached_tokens", 0)
+                rec.input_tokens = out.annotations.get("input_tokens", 0)
+            else:
+                gap = now - t_prev
+                self.itls.append(gap)
+                rec.itl_sum_s += gap
+                rec.itl_count += 1
+            t_prev = now
+            produced += len(out.token_ids)
+            if out.finish_reason is not None:
+                rec.produced = produced
+                return True
+        return False  # stream ended without a finish frame: worker died
+
+
+class SimFleet:
+    """All pools + the shared event plane + run-wide fault arming."""
+
+    def __init__(self, cfg: FleetConfig, clock: Clock):
+        self.cfg = cfg
+        self.clock = clock
+        self.plane = InProcEventPlane()
+        self.breaker_metrics = M.MetricsScope()  # detached from /metrics
+        self.pools: Dict[str, SimPool] = {
+            p.name: SimPool(self, p, seed=cfg.seed + i)
+            for i, p in enumerate(cfg.pools)
+        }
+        self._tasks: List[asyncio.Task] = []
+        self._armed_points: List[str] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self) -> "SimFleet":
+        if self.cfg.faults:
+            self.arm_faults(self.cfg.faults)
+        for pool in self.pools.values():
+            await pool.start()
+        return self
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        for pool in self.pools.values():
+            await pool.stop()
+        await self.plane.close()
+        for point in self._armed_points:
+            FAULTS.disarm(point)
+        self._armed_points = []
+
+    def spawn_task(self, coro) -> asyncio.Task:
+        t = asyncio.create_task(coro)
+        self._tasks.append(t)
+        return t
+
+    # -- chaos ---------------------------------------------------------------
+    def arm_faults(self, spec: str) -> None:
+        """Arm a DTPU_FAULTS-grammar spec for this run (points are disarmed
+        and their call counters reset on stop, so back-to-back same-seed
+        runs see identical schedules)."""
+        for rule in parse_faults(spec):
+            FAULTS.arm_rule(rule)
+            if rule.point not in self._armed_points:
+                self._armed_points.append(rule.point)
+
+    def disarm_fault(self, point: str) -> None:
+        FAULTS.disarm(point)
+        if point in self._armed_points:
+            self._armed_points.remove(point)
+
+    # -- driving -------------------------------------------------------------
+    @property
+    def default_pool(self) -> SimPool:
+        return next(iter(self.pools.values()))
+
+    async def run_trace(
+        self,
+        trace: List[SimRequest],
+        pool_for: Optional[Callable[[SimRequest], str]] = None,
+    ) -> None:
+        """Replay ``trace`` at virtual arrival pacing, fanning each request
+        into its pool (``pool_for`` defaults to the first pool)."""
+        tasks: List[asyncio.Task] = []
+        t_prev = 0.0
+        for idx, sreq in enumerate(trace):
+            dt = sreq.t - t_prev
+            t_prev = sreq.t
+            if dt > 0:
+                await self.clock.sleep(dt)
+            pool = (
+                self.pools[pool_for(sreq)] if pool_for is not None
+                else self.default_pool
+            )
+            tasks.append(asyncio.create_task(pool.submit(idx, sreq)))
+        if tasks:
+            await asyncio.gather(*tasks)

@@ -1,0 +1,256 @@
+"""Scenario reports: deterministic sim metrics + wall control-plane cost.
+
+A scenario report has two sections with a hard contract:
+
+- ``sim`` — every value is a pure function of (scenario, seed, knobs):
+  simulated TTFT/ITL percentiles, SLA attainment, cache hit ratio, replica
+  and breaker timelines, routing fan-out, and the machine-checked
+  invariants. Two same-seed runs must serialize this section byte-for-byte
+  identically (``canonical_json``; tests/test_sim.py pins it).
+
+- ``wall`` — real CPU cost of the control plane measured during the run:
+  router decision latency percentiles, elapsed wall seconds, virtual
+  seconds driven. Host-dependent by nature; excluded from the determinism
+  comparison exactly like run timestamps.
+
+``bench_record`` folds a scenario-suite run into the one-line BENCH JSON
+schema (metric/value/unit/vs_baseline/detail) bench.py prints, so the sim
+gate gives every PR a perf verdict even with the device bench down.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from ..profiler.loadgen import pct
+from .fleet import SimFleet, SimPool
+
+
+@dataclasses.dataclass
+class Invariant:
+    """One machine-checked closed-loop property of a scenario."""
+
+    name: str
+    ok: bool
+    detail: str
+
+    def to_obj(self) -> dict:
+        return {"name": self.name, "ok": self.ok, "detail": self.detail}
+
+
+def _dist_ms(xs: List[float]) -> Dict[str, float]:
+    xs = sorted(xs)
+    n = len(xs)
+    return {
+        "n": n,
+        "mean_ms": round(sum(xs) / n * 1e3, 3) if n else 0.0,
+        "p50_ms": round(pct(xs, 0.50) * 1e3, 3),
+        "p95_ms": round(pct(xs, 0.95) * 1e3, 3),
+        "p99_ms": round(pct(xs, 0.99) * 1e3, 3),
+    }
+
+
+def direction_flips(
+    timeline: List[int], min_step: int = 2, min_frac: float = 0.1
+) -> int:
+    """Significant resize-direction changes in a replica timeline — the
+    oscillation measure the no-flapping invariant bounds (a clean diurnal
+    cycle is one up-run + one down-run = 1 flip per period). Moves smaller
+    than ``min_step`` workers or ``min_frac`` of the pool are operating
+    noise (a 1-worker wobble on a 12-worker fleet) and neither count as a
+    flip nor establish a direction."""
+    flips = 0
+    prev_dir = 0
+    for a, b in zip(timeline, timeline[1:]):
+        delta = b - a
+        if abs(delta) <= max(min_step, min_frac * max(a, 1)):
+            continue
+        d = 1 if delta > 0 else -1
+        if prev_dir != 0 and d != prev_dir:
+            flips += 1
+        prev_dir = d
+    return flips
+
+
+def pool_report(pool: SimPool) -> dict:
+    """Deterministic per-pool metrics from the run's request records.
+
+    Memoized: scenarios call this for their invariants and scenario_report
+    calls it again to serialize — the cache makes both reads the same
+    O(records) aggregation (and the same dict) instead of two. The key
+    covers every input stream (records, replica/breaker/itl/fanout
+    timelines) so a mid-run sampler never sees a stale report."""
+    key = (
+        len(pool.records), len(pool.replica_timeline),
+        len(pool.breaker_events), len(pool.itls), len(pool.fanout),
+        len(pool.correction_timeline),
+    )
+    cached_rep = getattr(pool, "_report_cache", None)
+    if cached_rep is not None and cached_rep[0] == key:
+        return cached_rep[1]
+    recs = pool.records
+    done = [r for r in recs if r.ok]
+    ttfts = [r.ttft_s for r in done if r.ttft_s >= 0]
+    replicas = [n for _, n in pool.replica_timeline]
+    per_worker: Dict[str, int] = {}
+    per_group_ttft: Dict[int, List[float]] = {}
+    for r in done:
+        per_worker[str(r.worker)] = per_worker.get(str(r.worker), 0) + 1
+        per_group_ttft.setdefault(r.group, []).append(r.ttft_s)
+    cached = sum(r.cached_tokens for r in done)
+    inputs = sum(r.input_tokens for r in done)
+    itl_target = _itl_target(pool)
+    rep = {
+        "workers_final": len(pool.workers),
+        "requests": len(recs),
+        "completed": len(done),
+        "failed": len(recs) - len(done),
+        "retries": sum(r.attempts - 1 for r in recs),
+        "ttft": _dist_ms(ttfts),
+        "itl": _dist_ms(pool.itls),
+        "ttft_attainment": round(
+            sum(1 for r in done if r.ttft_s <= r.ttft_target_s)
+            / max(len(done), 1), 4,
+        ),
+        "itl_attainment": round(
+            sum(1 for g in pool.itls if g <= itl_target) /
+            max(len(pool.itls), 1), 4,
+        ),
+        "cache_hit_ratio": round(cached / max(inputs, 1), 4),
+        "per_worker_requests": dict(sorted(per_worker.items())),
+        "group_ttft_p95_ms": {
+            str(g): round(pct(sorted(v), 0.95) * 1e3, 3)
+            for g, v in sorted(per_group_ttft.items())
+        },
+        "fanout_mean": round(
+            sum(pool.fanout) / max(len(pool.fanout), 1), 2
+        ),
+        "replicas": {
+            "timeline": pool.replica_timeline,
+            "min": min(replicas) if replicas else len(pool.workers),
+            "max": max(replicas) if replicas else len(pool.workers),
+            "final": replicas[-1] if replicas else len(pool.workers),
+            "direction_flips": direction_flips(replicas),
+        },
+        "correction_final": (
+            pool.correction_timeline[-1] if pool.correction_timeline else 1.0
+        ),
+        "breaker_events": pool.breaker_events,
+    }
+    pool._report_cache = (key, rep)
+    return rep
+
+
+def _itl_target(pool: SimPool) -> float:
+    done = [r for r in pool.records if r.ok]
+    return done[0].itl_target_s if done else 0.05
+
+
+def pool_wall_report(pool: SimPool) -> dict:
+    ns = sorted(pool.decision_wall_ns)
+    return {
+        "router_decisions": len(ns),
+        "router_decision_us": {
+            "p50": round(pct(ns, 0.50) / 1e3, 1),
+            "p99": round(pct(ns, 0.99) / 1e3, 1),
+        },
+    }
+
+
+def scenario_report(
+    name: str,
+    seed: int,
+    fleet: SimFleet,
+    invariants: List[Invariant],
+    sim_duration_s: float,
+    wall_elapsed_s: float,
+    extra_sim: Optional[dict] = None,
+    sim_advanced_s: Optional[float] = None,
+) -> dict:
+    # sim_duration_s is the configured trace span; sim_advanced_s is the
+    # virtual time the loop actually drove (clock.advanced), which exceeds it
+    # whenever the request tail outlives the last arrival (slow boots, deep
+    # queues). Speedup is computed from the driven time — the configured span
+    # would understate it for long tails. Both are deterministic.
+    driven = sim_advanced_s if sim_advanced_s is not None else sim_duration_s
+    sim = {
+        "scenario": name,
+        "seed": seed,
+        "sim_duration_s": round(sim_duration_s, 3),
+        "sim_advanced_s": round(driven, 3),
+        "pools": {p.cfg.name: pool_report(p) for p in fleet.pools.values()},
+        "invariants": [iv.to_obj() for iv in invariants],
+        "passed": all(iv.ok for iv in invariants),
+    }
+    if extra_sim:
+        sim.update(extra_sim)
+    return {
+        "sim": sim,
+        "wall": {
+            "elapsed_s": round(wall_elapsed_s, 3),
+            "sim_speedup": round(driven / max(wall_elapsed_s, 1e-9), 1),
+            "pools": {
+                p.cfg.name: pool_wall_report(p) for p in fleet.pools.values()
+            },
+        },
+    }
+
+
+def canonical_json(report: dict, include_wall: bool = False) -> str:
+    """Byte-stable serialization of a report's deterministic section.
+
+    Same seed + same scenario => identical string; the ``wall`` section
+    (host-dependent latencies, elapsed time) is dropped unless asked for.
+    """
+    obj = report if include_wall else {
+        k: v for k, v in report.items() if k != "wall"
+    }
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def bench_record(reports: List[dict]) -> dict:
+    """Fold scenario reports into the BENCH JSON schema (bench.py contract:
+    one line, metric/value/unit/vs_baseline/detail). ``value`` is the
+    fraction of closed-loop invariants holding across the suite;
+    ``vs_baseline`` compares against all-pass (1.0), so any invariant
+    regression reads as a perf verdict < 1 even with the TPU bench down."""
+    invs = [iv for r in reports for iv in r["sim"]["invariants"]]
+    ok = sum(1 for iv in invs if iv["ok"])
+    frac = ok / max(len(invs), 1)
+    decisions_us: List[float] = []
+    ttft_p95 = {}
+    itl_p95 = {}
+    for r in reports:
+        for w in r["wall"]["pools"].values():
+            decisions_us.append(w["router_decision_us"]["p99"])
+        for pname, p in r["sim"]["pools"].items():
+            key = f'{r["sim"]["scenario"]}/{pname}'
+            ttft_p95[key] = p["ttft"]["p95_ms"]
+            itl_p95[key] = p["itl"]["p95_ms"]
+    return {
+        "metric": "sim_fleet_control_plane_gate",
+        "value": round(frac, 4),
+        "unit": "invariants_passed_fraction",
+        "vs_baseline": round(frac, 4),
+        "detail": {
+            "scenarios": {
+                r["sim"]["scenario"]: {
+                    "passed": r["sim"]["passed"],
+                    "seed": r["sim"]["seed"],
+                    "sim_duration_s": r["sim"]["sim_duration_s"],
+                    "wall_elapsed_s": r["wall"]["elapsed_s"],
+                    "invariants": r["sim"]["invariants"],
+                    "router_decision_us": {
+                        pname: w["router_decision_us"]
+                        for pname, w in r["wall"]["pools"].items()
+                    },
+                }
+                for r in reports
+            },
+            "router_decision_p99_us_max": max(decisions_us) if decisions_us else 0.0,
+            "sim_ttft_p95_ms": ttft_p95,
+            "sim_itl_p95_ms": itl_p95,
+        },
+    }

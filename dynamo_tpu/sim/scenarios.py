@@ -1,0 +1,528 @@
+"""Named fleet scenarios with machine-checked closed-loop invariants.
+
+Each scenario wires a workload (sim/traces.py) through a SimFleet
+(sim/fleet.py) on a virtual clock and asserts *control-plane properties* —
+not point metrics but the loop behaviors ROADMAP item 3 needs proven:
+
+- ``diurnal-autoscale``   planner tracks a diurnal load without oscillating
+- ``bursty-breaker-chaos``  per-worker breakers trip on injected flaps,
+                            steer traffic around them with bounded goodput
+                            loss, and re-admit the worker after recovery
+- ``prefix-heavy-radix``  KV routing keeps radix reuse high and queue
+                            fairness intact under a hot shared-prefix group
+- ``multi-pool-balance``  grid pool selection (global_router) splits SLA
+                            classes onto the right pools and keeps the
+                            interactive pool isolated from batch load
+- ``multi-region-follow-sun``  phase-shifted regional diurnals keep the
+                            combined fleet busy while each region holds SLA
+
+Scenarios scale with ``workers`` and ``duration_s`` so the same invariants
+run as a tier-1 smoke (small fleet, ~4 simulated minutes, seconds of wall
+time) and as the full CLI gate (hundreds of workers, 10+ simulated
+minutes). Every knob derives from (seed, workers, duration_s) only: same
+inputs => byte-identical deterministic report section (sim/report.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..global_router.pool_selection import PrefillPoolSelectionStrategy
+from ..runtime.resilience import OPEN
+from . import clock as simclock
+from . import traces
+from .fleet import FleetConfig, PoolConfig, SimFleet, worker_fault_point
+from .report import Invariant, scenario_report
+
+# per-worker mocker speed used by every scenario: slow enough that tens to
+# hundreds of workers are *needed* at single-digit req/s rates (keeping the
+# python step count — the wall cost — low), fast enough that a pool keeps
+# its SLA with headroom. One worker sustains ~0.5 req/s of the default
+# isl=256/osl=12 shape (measured; capacity_req_s below is the planner's
+# profile of the same number).
+_SPEED = dict(
+    prefill_base_s=0.8,
+    prefill_per_token_s=6.5e-3,
+    decode_base_s=0.4,
+    decode_per_kv_block_s=1e-5,
+)
+_CAPACITY_REQ_S = 0.3
+
+
+def _invariant(name: str, ok: bool, detail: str) -> Invariant:
+    return Invariant(name, bool(ok), detail)
+
+
+# ---------------------------------------------------------------------------
+# diurnal-autoscale
+# ---------------------------------------------------------------------------
+
+
+async def _diurnal_autoscale(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    periods = 2
+    amplitude = 0.8
+    peak_rate = 0.55 * workers * _CAPACITY_REQ_S
+    mean_rate = peak_rate / (1 + amplitude)
+    trace = traces.diurnal(
+        duration_s=duration_s, mean_rate=mean_rate, amplitude=amplitude,
+        period_s=duration_s / periods, isl=256, osl=12, seed=seed,
+        # targets sized to the slow worker model: ~1.5s prefill + queueing
+        # + up to 5s boot when a request lands on a just-spawned worker
+        ttft_target_s=18.0, itl_target_s=3.0,
+    )
+    cfg = FleetConfig(
+        seed=seed, prefix_share=0.5,
+        pools=[PoolConfig(
+            name="decode", initial_workers=max(2, workers // 8),
+            min_workers=1, max_workers=workers,
+            autoscale=True, adjustment_interval_s=10.0,
+            capacity_req_s=_CAPACITY_REQ_S, startup_time_s=5.0,
+            scale_down_headroom=0.7,
+            **_SPEED,
+        )],
+    )
+    fleet = SimFleet(cfg, clock)
+    await fleet.start()
+    try:
+        await fleet.run_trace(trace)
+    finally:
+        await fleet.stop()
+
+    pool = fleet.default_pool
+    from .report import direction_flips, pool_report
+
+    rep = pool_report(pool)
+    replicas = [n for _, n in pool.replica_timeline]
+    flips = direction_flips(replicas)
+    peak = max(replicas) if replicas else 0
+    final = replicas[-1] if replicas else 0
+    invs = [
+        _invariant(
+            "scaled_up", peak >= max(3, int(0.35 * workers)),
+            f"peak replicas {peak} (cap {workers})",
+        ),
+        _invariant(
+            "scaled_back_down", final <= max(2, int(0.7 * peak)),
+            f"final {final} vs peak {peak}",
+        ),
+        _invariant(
+            "no_oscillation", flips <= 3 * periods,
+            f"{flips} resize-direction flips over {periods} periods "
+            f"(bound {3 * periods})",
+        ),
+        _invariant(
+            "all_completed", rep["failed"] == 0,
+            f'{rep["completed"]}/{rep["requests"]} completed',
+        ),
+        _invariant(
+            "ttft_sla_held", rep["ttft_attainment"] >= 0.75,
+            f'ttft attainment {rep["ttft_attainment"]} (>= 0.75)',
+        ),
+    ]
+    return {"fleet": fleet, "invariants": invs, "requests": len(trace)}
+
+
+# ---------------------------------------------------------------------------
+# bursty-breaker-chaos
+# ---------------------------------------------------------------------------
+
+
+async def _bursty_breaker_chaos(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    flap_wid = 1  # first-spawned worker flaps
+    flap_until = 0.6 * duration_s
+    trace = traces.bursty(
+        duration_s=duration_s,
+        base_rate=0.15 * workers * _CAPACITY_REQ_S,
+        burst_rate=0.9 * workers * _CAPACITY_REQ_S,
+        burst_len_s=duration_s / 8, cycle_s=duration_s / 4,
+        isl=256, osl=12, seed=seed, ttft_target_s=15.0, itl_target_s=3.0,
+    )
+    cfg = FleetConfig(
+        seed=seed, prefix_share=0.5, max_attempts=4,
+        # the flapping worker drops 95% of its dispatches on a seeded
+        # schedule; a thin event-plane drop keeps the router view noisy too
+        faults=(
+            f"{worker_fault_point(flap_wid)}:drop@p=0.95@seed={seed + 17};"
+            f"event_plane.publish:drop@p=0.02@seed={seed + 23}"
+        ),
+        pools=[PoolConfig(
+            name="decode", initial_workers=workers,
+            min_workers=workers, max_workers=workers,
+            breaker_threshold=3, breaker_window_s=60.0,
+            breaker_reset_s=duration_s / 6,
+            **_SPEED,
+        )],
+    )
+    fleet = SimFleet(cfg, clock)
+    await fleet.start()
+
+    async def _recover() -> None:
+        await clock.sleep(flap_until)
+        fleet.disarm_fault(worker_fault_point(flap_wid))
+
+    fleet.spawn_task(_recover())
+    try:
+        await fleet.run_trace(trace)
+    finally:
+        await fleet.stop()
+
+    pool = fleet.default_pool
+    from .report import pool_report
+
+    rep = pool_report(pool)
+    opens = [t for t, wid, st in pool.breaker_events
+             if wid == flap_wid and st == OPEN]
+    first_open = opens[0] if opens else float("inf")
+    done = [r for r in pool.records if r.ok]
+    during = [r for r in done if first_open <= r.t_arrive <= flap_until]
+    on_flapped = sum(1 for r in during if r.worker == flap_wid)
+    share_during = on_flapped / max(len(during), 1)
+    fair = 1.0 / workers
+    after = [r for r in done
+             if r.t_arrive > flap_until + pool.cfg.breaker_reset_s]
+    recovered = sum(1 for r in after if r.worker == flap_wid)
+    goodput = rep["completed"] / max(rep["requests"], 1)
+    invs = [
+        _invariant(
+            "breaker_tripped", bool(opens),
+            f"worker {flap_wid} breaker opened at t={opens[:3]}",
+        ),
+        _invariant(
+            "goodput_held", goodput >= 0.99,
+            f"goodput {goodput:.4f} with {rep['retries']} retries "
+            "(retry-then-migrate absorbs the flap)",
+        ),
+        _invariant(
+            "steered_around", share_during <= 0.5 * fair,
+            f"flapping worker served {share_during:.4f} of traffic while "
+            f"tripped (fair share {fair:.4f})",
+        ),
+        _invariant(
+            "recovered_after_flap", recovered >= 1,
+            f"worker {flap_wid} served {recovered} requests after recovery",
+        ),
+    ]
+    return {"fleet": fleet, "invariants": invs, "requests": len(trace)}
+
+
+# ---------------------------------------------------------------------------
+# prefix-heavy-radix
+# ---------------------------------------------------------------------------
+
+
+async def _prefix_heavy_radix(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    num_groups = max(4, workers)
+    # run the fleet at ~60% utilization: saturated workers would make the
+    # scheduler's load term rightly override radix affinity, which is the
+    # steady-state this scenario is NOT about
+    trace = traces.prefix_heavy(
+        duration_s=duration_s, rate=0.35 * workers * _CAPACITY_REQ_S,
+        isl=256, osl=12, num_groups=num_groups, hot_group_share=0.4,
+        seed=seed, ttft_target_s=10.0, itl_target_s=3.0,
+    )
+    cfg = FleetConfig(
+        seed=seed, prefix_share=0.75,
+        pools=[PoolConfig(
+            name="decode", initial_workers=workers,
+            min_workers=workers, max_workers=workers,
+            **_SPEED,
+        )],
+    )
+    fleet = SimFleet(cfg, clock)
+    await fleet.start()
+    try:
+        await fleet.run_trace(trace)
+    finally:
+        await fleet.stop()
+
+    pool = fleet.default_pool
+    from .report import pool_report
+
+    rep = pool_report(pool)
+    done = [r for r in pool.records if r.ok]
+    by_group: Dict[int, List] = {}
+    for r in done:
+        by_group.setdefault(r.group, []).append(r)
+    # radix routing's per-request effect: the engine confirmed (via
+    # cached_tokens on the first output) that the chosen worker already
+    # held most of the shared prefix. Group members may legitimately span
+    # several workers — the scheduler *replicates* a hot prefix when its
+    # holders are loaded — so the property is reuse-on-arrival, not
+    # single-worker affinity.
+    shared_len = 0.75 * 256
+    prefix_routed = sum(
+        1 for r in done if r.cached_tokens >= 0.75 * shared_len
+    ) / max(len(done), 1)
+    # fairness: cold groups must not starve behind the hot group
+    cold_attain = [
+        sum(1 for r in rs if r.ttft_s <= r.ttft_target_s) / len(rs)
+        for g, rs in sorted(by_group.items()) if g != 0 and len(rs) >= 10
+    ]
+    worst_cold = min(cold_attain) if cold_attain else 1.0
+    used_workers = {r.worker for r in done}
+    invs = [
+        _invariant(
+            "radix_reuse", rep["cache_hit_ratio"] >= 0.4,
+            f'cache hit ratio {rep["cache_hit_ratio"]} '
+            "(0.75 of each group prompt is shared)",
+        ),
+        _invariant(
+            "prefix_routed", prefix_routed >= 0.7,
+            f"{prefix_routed:.3f} of requests landed on a worker already "
+            "holding >=75% of their shared prefix",
+        ),
+        _invariant(
+            "queue_fairness", worst_cold >= 0.6,
+            f"worst cold-group TTFT attainment {worst_cold:.3f} "
+            "(hot group must not starve the rest)",
+        ),
+        _invariant(
+            "fleet_spread", len(used_workers) >= max(2, int(0.75 * workers)),
+            f"{len(used_workers)}/{workers} workers served traffic",
+        ),
+        _invariant(
+            "all_completed", rep["failed"] == 0,
+            f'{rep["completed"]}/{rep["requests"]} completed',
+        ),
+    ]
+    return {"fleet": fleet, "invariants": invs, "requests": len(trace)}
+
+
+# ---------------------------------------------------------------------------
+# multi-pool-balance
+# ---------------------------------------------------------------------------
+
+
+async def _multi_pool_balance(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    w_inter = max(2, workers // 2)
+    w_batch = max(2, workers - w_inter)
+    classes = [
+        {"weight": 0.65, "isl": 128, "osl": 8,
+         "ttft_target_s": 8.0, "itl_target_s": 3.0},
+        {"weight": 0.35, "isl": 1024, "osl": 24,
+         "ttft_target_s": 60.0, "itl_target_s": 3.0},
+    ]
+    # interactive pool is sized for short prompts; batch pool absorbs the
+    # heavy ISL class (its per-request cost is ~8x the interactive one)
+    rate = 0.55 * w_inter * _CAPACITY_REQ_S / classes[0]["weight"] * 0.5
+    trace = traces.sla_classes(
+        duration_s=duration_s, rate=rate, classes=classes, seed=seed,
+    )
+    # the real global_router grid: (ISL, TTFT target) -> pool index
+    strategy = PrefillPoolSelectionStrategy(
+        ttft_min=0.0, ttft_max=60.0, ttft_resolution=2,
+        isl_min=0, isl_max=2048, isl_resolution=2,
+        prefill_pool_mapping=[[0, 0], [1, 1]],
+    )
+    pool_names = ["interactive", "batch"]
+    cfg = FleetConfig(
+        seed=seed, prefix_share=0.5,
+        pools=[
+            PoolConfig(
+                name="interactive", namespace="sim-inter",
+                initial_workers=w_inter, min_workers=w_inter,
+                max_workers=w_inter, **_SPEED,
+            ),
+            PoolConfig(
+                name="batch", namespace="sim-batch",
+                initial_workers=w_batch, min_workers=w_batch,
+                max_workers=w_batch, **_SPEED,
+            ),
+        ],
+    )
+    fleet = SimFleet(cfg, clock)
+    await fleet.start()
+
+    def pool_for(sreq: traces.SimRequest) -> str:
+        idx = strategy.select_pool(sreq.item.isl, sreq.ttft_target_s)
+        return pool_names[idx]
+
+    try:
+        await fleet.run_trace(trace, pool_for=pool_for)
+    finally:
+        await fleet.stop()
+
+    from .report import pool_report
+
+    inter, batch = fleet.pools["interactive"], fleet.pools["batch"]
+    rep_i, rep_b = pool_report(inter), pool_report(batch)
+    misrouted = (
+        sum(1 for r in inter.records if r.isl >= 1024)
+        + sum(1 for r in batch.records if r.isl < 1024)
+    )
+    # in-pool balance: no worker hoards traffic
+    def max_share(rep: dict) -> float:
+        counts = list(rep["per_worker_requests"].values())
+        return max(counts) / max(sum(counts), 1) if counts else 0.0
+
+    fair_i = 1.0 / w_inter
+    invs = [
+        _invariant(
+            "selection_correct", misrouted == 0,
+            f"{misrouted} requests landed in the wrong pool "
+            "(grid: isl<1024 -> interactive)",
+        ),
+        _invariant(
+            "all_completed", rep_i["failed"] == 0 and rep_b["failed"] == 0,
+            f'interactive {rep_i["completed"]}/{rep_i["requests"]}, '
+            f'batch {rep_b["completed"]}/{rep_b["requests"]}',
+        ),
+        _invariant(
+            "interactive_isolated", rep_i["ttft_attainment"] >= 0.9,
+            f'interactive TTFT attainment {rep_i["ttft_attainment"]} '
+            "despite batch-class load on the fleet",
+        ),
+        _invariant(
+            "in_pool_balance", max_share(rep_i) <= 3.0 * fair_i,
+            f"hottest interactive worker share {max_share(rep_i):.3f} "
+            f"(fair {fair_i:.3f})",
+        ),
+    ]
+    return {"fleet": fleet, "invariants": invs, "requests": len(trace)}
+
+
+# ---------------------------------------------------------------------------
+# multi-region-follow-sun
+# ---------------------------------------------------------------------------
+
+
+async def _multi_region_follow_sun(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    regions = 2
+    per_region = max(2, workers // regions)
+    amplitude = 0.8
+    peak_rate = 0.5 * per_region * _CAPACITY_REQ_S
+    region_traces = traces.multi_region(
+        regions=regions, duration_s=duration_s,
+        mean_rate=peak_rate / (1 + amplitude), amplitude=amplitude,
+        isl=256, osl=12, seed=seed, ttft_target_s=12.0, itl_target_s=3.0,
+    )
+    trace = traces.merge(*region_traces.values())
+    cfg = FleetConfig(
+        seed=seed, prefix_share=0.5,
+        pools=[
+            PoolConfig(
+                name=f"r{i}", namespace=f"sim-r{i}",
+                initial_workers=per_region, min_workers=per_region,
+                max_workers=per_region, **_SPEED,
+            )
+            for i in range(regions)
+        ],
+    )
+    fleet = SimFleet(cfg, clock)
+    await fleet.start()
+    try:
+        await fleet.run_trace(trace, pool_for=lambda sr: sr.region)
+    finally:
+        await fleet.stop()
+
+    from .report import pool_report
+
+    reps = {name: pool_report(p) for name, p in fleet.pools.items()}
+    attains = {name: r["ttft_attainment"] for name, r in reps.items()}
+    counts = {name: r["requests"] for name, r in reps.items()}
+    total = sum(counts.values())
+    shares = {n: c / max(total, 1) for n, c in counts.items()}
+    invs = [
+        _invariant(
+            "regions_balanced",
+            max(shares.values()) - min(shares.values()) <= 0.15,
+            f"request shares {shares} (phase-shifted peaks, near-even total)",
+        ),
+        _invariant(
+            "all_regions_hold_sla", min(attains.values()) >= 0.75,
+            f"per-region TTFT attainment {attains}",
+        ),
+        _invariant(
+            "all_completed",
+            all(r["failed"] == 0 for r in reps.values()),
+            f"completed per region {dict((n, r['completed']) for n, r in reps.items())}",
+        ),
+    ]
+    return {"fleet": fleet, "invariants": invs, "requests": len(trace)}
+
+
+# ---------------------------------------------------------------------------
+# registry + runner
+# ---------------------------------------------------------------------------
+
+SCENARIOS: Dict[str, Callable] = {
+    "diurnal-autoscale": _diurnal_autoscale,
+    "bursty-breaker-chaos": _bursty_breaker_chaos,
+    "prefix-heavy-radix": _prefix_heavy_radix,
+    "multi-pool-balance": _multi_pool_balance,
+    "multi-region-follow-sun": _multi_region_follow_sun,
+}
+
+# aliases accepted by the CLI (`python -m dynamo_tpu.sim diurnal`)
+ALIASES = {
+    "diurnal": "diurnal-autoscale",
+    "bursty": "bursty-breaker-chaos",
+    "prefix": "prefix-heavy-radix",
+    "multipool": "multi-pool-balance",
+    "regions": "multi-region-follow-sun",
+}
+
+
+def resolve(name: str) -> str:
+    full = ALIASES.get(name, name)
+    if full not in SCENARIOS:
+        raise KeyError(
+            f"unknown scenario {name!r}; known: {sorted(SCENARIOS)} "
+            f"(aliases {sorted(ALIASES)})"
+        )
+    return full
+
+
+def run_scenario(
+    name: str,
+    seed: int = 0,
+    workers: int = 8,
+    duration_s: Optional[float] = None,
+) -> dict:
+    """Run one scenario to completion on a fresh virtual-time loop and
+    return its report (sim/report.py schema). Blocking; call from sync
+    code (CLI, bench.py, tests)."""
+    full = resolve(name)
+    duration = float(duration_s) if duration_s is not None else 240.0
+    t0 = time.perf_counter()
+
+    async def main(clock: simclock.VirtualClock):
+        return await SCENARIOS[full](clock, seed, workers, duration), clock
+
+    out, clock = simclock.run(main)
+    return scenario_report(
+        name=full, seed=seed, fleet=out["fleet"],
+        invariants=out["invariants"], sim_duration_s=duration,
+        wall_elapsed_s=time.perf_counter() - t0,
+        extra_sim={"workers": workers, "trace_requests": out["requests"]},
+        sim_advanced_s=clock.advanced,
+    )
+
+
+def run_suite(
+    names: Optional[List[str]] = None,
+    seed: int = 0,
+    workers: int = 8,
+    duration_s: Optional[float] = None,
+) -> List[dict]:
+    """The perf-gate suite: the four gate scenarios (plus any extras asked
+    for) at the given scale."""
+    gate = names or [
+        "diurnal-autoscale", "bursty-breaker-chaos",
+        "prefix-heavy-radix", "multi-pool-balance",
+    ]
+    return [
+        run_scenario(n, seed=seed, workers=workers, duration_s=duration_s)
+        for n in gate
+    ]

@@ -1,0 +1,227 @@
+"""Seeded workload library for the fleet simulator.
+
+Builds on the ``profiler/loadgen.py`` TraceItem model (arrival t, isl, osl,
+prefix group) and its arrival processes; adds the shapes the scenario suite
+needs: heavy-tail ISL/OSL, hot-group prefix skew, SLA classes, and
+phase-shifted multi-region diurnals. Every builder is a pure function of its
+seed — same seed, same trace, byte for byte.
+
+Reference analogs: benchmarks/sin_load_generator (diurnal),
+benchmarks/burstgpt_loadgen (bursty replay), prefix_data_generator
+(controlled shared-prefix share).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, List, Optional
+
+from ..profiler.loadgen import TraceItem, bursty_trace, sinusoidal_trace
+
+
+@dataclasses.dataclass
+class SimRequest:
+    """One sim arrival: a TraceItem plus routing metadata the control plane
+    reads (SLA targets feed pool selection; ``region`` tags multi-region
+    traffic for the balance invariants)."""
+
+    item: TraceItem
+    ttft_target_s: float = 0.5
+    itl_target_s: float = 0.05
+    region: str = "r0"
+
+    @property
+    def t(self) -> float:
+        return self.item.t
+
+
+def _wrap(
+    items: List[TraceItem],
+    ttft_target_s: float = 0.5,
+    itl_target_s: float = 0.05,
+    region: str = "r0",
+) -> List[SimRequest]:
+    return [
+        SimRequest(it, ttft_target_s=ttft_target_s,
+                   itl_target_s=itl_target_s, region=region)
+        for it in items
+    ]
+
+
+def diurnal(
+    duration_s: float,
+    mean_rate: float,
+    amplitude: float = 0.8,
+    period_s: Optional[float] = None,
+    isl: int = 256,
+    osl: int = 24,
+    num_groups: int = 16,
+    seed: int = 0,
+    ttft_target_s: float = 0.5,
+    itl_target_s: float = 0.05,
+) -> List[SimRequest]:
+    """Diurnal sinusoid: two full periods by default so the autoscale
+    invariants see a ramp-up, a peak, a ramp-down and a second cycle."""
+    period = period_s if period_s is not None else duration_s / 2.0
+    return _wrap(sinusoidal_trace(
+        duration_s=duration_s, mean_rate=mean_rate, amplitude=amplitude,
+        period_s=period, isl=isl, osl=osl, num_groups=num_groups, seed=seed,
+    ), ttft_target_s=ttft_target_s, itl_target_s=itl_target_s)
+
+
+def bursty(
+    duration_s: float,
+    base_rate: float,
+    burst_rate: float,
+    burst_len_s: float,
+    cycle_s: float,
+    isl: int = 256,
+    osl: int = 24,
+    num_groups: int = 16,
+    seed: int = 0,
+    ttft_target_s: float = 0.5,
+    itl_target_s: float = 0.05,
+) -> List[SimRequest]:
+    """BurstGPT-style on/off bursts."""
+    return _wrap(bursty_trace(
+        duration_s=duration_s, base_rate=base_rate, burst_rate=burst_rate,
+        burst_len_s=burst_len_s, cycle_s=cycle_s, isl=isl, osl=osl,
+        num_groups=num_groups, seed=seed,
+    ), ttft_target_s=ttft_target_s, itl_target_s=itl_target_s)
+
+
+def heavy_tail(
+    duration_s: float,
+    rate: float,
+    isl_median: int = 256,
+    isl_sigma: float = 0.8,
+    osl_median: int = 24,
+    osl_sigma: float = 0.6,
+    max_isl: int = 4096,
+    max_osl: int = 256,
+    num_groups: int = 16,
+    seed: int = 0,
+    ttft_target_s: float = 0.5,
+    itl_target_s: float = 0.05,
+) -> List[SimRequest]:
+    """Poisson arrivals with log-normal ISL/OSL (the production shape: most
+    prompts short, a fat tail of very long ones)."""
+    rng = random.Random(seed)
+    out: List[TraceItem] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        isl = min(max_isl, max(16, int(rng.lognormvariate(
+            math.log(isl_median), isl_sigma))))
+        osl = min(max_osl, max(4, int(rng.lognormvariate(
+            math.log(osl_median), osl_sigma))))
+        out.append(TraceItem(t, isl, osl, rng.randrange(num_groups)))
+    return _wrap(out, ttft_target_s=ttft_target_s, itl_target_s=itl_target_s)
+
+
+def prefix_heavy(
+    duration_s: float,
+    rate: float,
+    isl: int = 512,
+    osl: int = 16,
+    num_groups: int = 8,
+    hot_group_share: float = 0.5,
+    seed: int = 0,
+    ttft_target_s: float = 0.5,
+    itl_target_s: float = 0.05,
+) -> List[SimRequest]:
+    """Shared-prefix-ratio workload with a hot group: ``hot_group_share`` of
+    requests hit group 0 (the agent-loop / system-prompt pattern radix
+    routing exists for), the rest spread uniformly over the other groups."""
+    rng = random.Random(seed)
+    out: List[TraceItem] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        if rng.random() < hot_group_share:
+            g = 0
+        else:
+            g = 1 + rng.randrange(max(num_groups - 1, 1))
+        out.append(TraceItem(t, isl, osl, g))
+    return _wrap(out, ttft_target_s=ttft_target_s, itl_target_s=itl_target_s)
+
+
+def sla_classes(
+    duration_s: float,
+    rate: float,
+    classes: Optional[List[dict]] = None,
+    num_groups: int = 16,
+    seed: int = 0,
+) -> List[SimRequest]:
+    """Mixed SLA-class traffic for pool selection: each arrival draws a
+    class (weight, isl, osl, ttft/itl targets). Defaults model 'interactive'
+    (short prompt, tight TTFT) vs 'batch' (long prompt, loose TTFT) —
+    the two-pool grid in the multi-pool scenario keys off exactly this."""
+    cls = classes or [
+        {"weight": 0.6, "isl": 128, "osl": 16,
+         "ttft_target_s": 0.3, "itl_target_s": 0.05},
+        {"weight": 0.4, "isl": 1024, "osl": 48,
+         "ttft_target_s": 2.0, "itl_target_s": 0.2},
+    ]
+    weights = [c["weight"] for c in cls]
+    rng = random.Random(seed)
+    out: List[SimRequest] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        c = rng.choices(cls, weights=weights)[0]
+        out.append(SimRequest(
+            TraceItem(t, int(c["isl"]), int(c["osl"]),
+                      rng.randrange(num_groups)),
+            ttft_target_s=float(c["ttft_target_s"]),
+            itl_target_s=float(c["itl_target_s"]),
+        ))
+    return out
+
+
+def multi_region(
+    regions: int,
+    duration_s: float,
+    mean_rate: float,
+    amplitude: float = 0.8,
+    isl: int = 256,
+    osl: int = 24,
+    num_groups: int = 16,
+    seed: int = 0,
+    ttft_target_s: float = 0.5,
+    itl_target_s: float = 0.05,
+) -> Dict[str, List[SimRequest]]:
+    """Per-region diurnal traces with evenly phase-shifted peaks (follow-the-
+    sun): when region 0 peaks, region k is 1/k of a period away. The merged
+    fleet load is near-flat, which is what multi-pool balancing must hold."""
+    period = duration_s / 2.0
+    out: Dict[str, List[SimRequest]] = {}
+    for r in range(regions):
+        shift = period * r / max(regions, 1)
+        items = sinusoidal_trace(
+            duration_s=duration_s + shift, mean_rate=mean_rate,
+            amplitude=amplitude, period_s=period, isl=isl, osl=osl,
+            num_groups=num_groups, seed=seed + 1000 * r,
+        )
+        shifted = [
+            TraceItem(it.t - shift, it.isl, it.osl, it.group)
+            for it in items if it.t >= shift
+        ]
+        out[f"r{r}"] = _wrap(shifted, ttft_target_s=ttft_target_s,
+                             itl_target_s=itl_target_s, region=f"r{r}")
+    return out
+
+
+def merge(*traces: List[SimRequest]) -> List[SimRequest]:
+    """Interleave traces by arrival time (stable for equal stamps)."""
+    flat = [req for tr in traces for req in tr]
+    flat.sort(key=lambda r: r.t)
+    return flat

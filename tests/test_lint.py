@@ -242,3 +242,52 @@ def test_linter_catches_wallclock_latency_in_request_path(tmp_path):
         capture_output=True, text=True, timeout=60,
     )
     assert "WALLCLOCK-LATENCY" not in r2.stdout
+
+
+def test_linter_catches_sim_wallclock(tmp_path):
+    """time.time()/time.monotonic()/asyncio.sleep() in sim-path modules
+    (mocker/, sim/, loadgen) are flagged; the Clock funnel (sim/clock.py)
+    is exempt and time.perf_counter stays allowed (wall cost measurement
+    is the sim's job)."""
+    mocker = tmp_path / "mocker"
+    mocker.mkdir()
+    bad = mocker / "engine2.py"
+    bad.write_text(
+        "import asyncio\n"
+        "import time\n"
+        "async def step():\n"
+        "    t0 = time.time()\n"
+        "    await asyncio.sleep(0.01)\n"
+        "    time.sleep(0.01)\n"
+        "    return time.monotonic() - t0\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(mocker)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert r.stdout.count("SIM-WALLCLOCK") == 4, r.stdout
+    assert "time.sleep() in a sim-path module" in r.stdout, r.stdout
+
+    sim = tmp_path / "dynamo_tpu" / "sim"
+    sim.mkdir(parents=True)
+    funnel = sim / "clock.py"
+    funnel.write_text(
+        "import asyncio\nimport time\n"
+        "class Clock:\n"
+        "    def time(self):\n"
+        "        return time.monotonic()\n"
+        "    async def sleep(self, dt):\n"
+        "        await asyncio.sleep(dt)\n"
+    )
+    ok = sim / "fleet2.py"
+    ok.write_text(
+        "import time\n"
+        "def measure():\n"
+        "    return time.perf_counter()\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), str(sim)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "SIM-WALLCLOCK" not in r.stdout, r.stdout

@@ -65,41 +65,52 @@ def test_prefix_prompt_shares_group_prefix():
     assert a[50:] != b[50:]       # unique tails
 
 
-async def test_replay_sla_attainment_light_vs_overload():
+def test_replay_sla_attainment_light_vs_overload():
     """A fleet that comfortably fits the load attains ~1.0; a single engine
-    under the same burst misses TTFT targets."""
+    under the same burst misses targets.
+
+    Runs on the virtual clock (sim/clock.py): the wall-paced version of this
+    test was flaky on slow CI hosts — asyncio jitter amplified by
+    speedup_ratio smeared the burst enough that the single engine sometimes
+    kept up. Virtual pacing makes the arrival process exact and the verdict
+    deterministic."""
+    from dynamo_tpu.sim import clock as simclock
+
     tr = bursty_trace(
         duration_s=6.0, base_rate=2.0, burst_rate=60.0,
         burst_len_s=1.5, cycle_s=3.0, isl=128, osl=16, seed=7,
     )
 
-    def fleet(n):
-        return [
-            MockerEngine(MockEngineArgs(
-                emit_sim_ts=True, speedup_ratio=30.0, num_blocks=512,
-            ))
-            for _ in range(n)
-        ]
+    def run_fleet(n):
+        async def main(ck):
+            engines = [
+                MockerEngine(
+                    MockEngineArgs(emit_sim_ts=True, num_blocks=512),
+                    clock=ck,
+                )
+                for _ in range(n)
+            ]
+            try:
+                return await replay(
+                    tr, engines, ttft_target_s=0.5, itl_target_s=0.05,
+                    clock=ck,
+                )
+            finally:
+                for e in engines:
+                    e.stop()
 
-    big = fleet(8)
-    try:
-        rep_big = await replay(tr, big, ttft_target_s=0.5, itl_target_s=0.05,
-                               speedup=30.0)
-    finally:
-        for e in big:
-            e.stop()
-    small = fleet(1)
-    try:
-        rep_small = await replay(tr, small, ttft_target_s=0.5, itl_target_s=0.05,
-                                 speedup=30.0)
-    finally:
-        for e in small:
-            e.stop()
+        return simclock.run(main)
+
+    rep_big = run_fleet(8)
+    rep_small = run_fleet(1)
     assert rep_big.completed == len(tr)
-    # overload shows in ITL first: the single engine serves the burst as one
-    # big decode batch (every step slower), while admission keeps TTFT low
+    # with exact pacing the overload shows where the queueing model puts
+    # it: admission backlog on the single engine craters TTFT attainment,
+    # while ITL stays step-time-bound on both (the wall-clock version of
+    # this test was asserting on host-jitter-inflated ITL instead)
     assert rep_big.itl_attainment > 0.9, rep_big
-    assert rep_small.itl_attainment < 0.6, rep_small
+    assert rep_big.ttft_attainment > 0.9, rep_big
+    assert rep_small.ttft_attainment < 0.6, rep_small
     assert rep_big.ttft_p95_s < rep_small.ttft_p95_s
 
 

@@ -1,0 +1,247 @@
+"""Fleet simulator (dynamo_tpu/sim): virtual clock, determinism, and the
+tier-1 closed-loop scenario gate.
+
+ISSUE 6 acceptance: same seed + same scenario => byte-identical report JSON
+(modulo the wall section); a changed seed changes arrivals but the reference
+scenarios still pass their invariants; the four gate scenarios run in tier-1
+as the CPU perf-gate smoke (fast, not marked slow).
+"""
+
+import asyncio
+import json
+import time
+
+from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.sim import clock as simclock
+from dynamo_tpu.sim import traces
+from dynamo_tpu.sim.report import bench_record, canonical_json, direction_flips
+from dynamo_tpu.sim.scenarios import run_scenario, run_suite
+
+SMOKE = dict(workers=8, duration_s=240.0)
+
+
+# ---------------------------------------------------------------------------
+# virtual clock
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_clock_exact_timers_zero_wall():
+    """Timers fire in exact virtual order with (essentially) no wall cost."""
+
+    async def main(ck):
+        order = []
+
+        async def a():
+            await asyncio.sleep(100)
+            order.append(("a", ck.time()))
+
+        async def b():
+            await asyncio.sleep(50)
+            order.append(("b", ck.time()))
+            await asyncio.sleep(200)
+            order.append(("b2", ck.time()))
+
+        await asyncio.gather(a(), b())
+        return order
+
+    t0 = time.monotonic()
+    order = simclock.run(main)
+    wall = time.monotonic() - t0
+    assert order == [("b", 50.0), ("a", 100.0), ("b2", 250.0)]
+    assert wall < 1.0  # 250 virtual seconds, milliseconds of wall
+
+
+def test_virtual_clock_wait_for_timeout_is_virtual():
+    async def main(ck):
+        try:
+            await asyncio.wait_for(asyncio.Event().wait(), 500)
+        except asyncio.TimeoutError:
+            return ck.time()
+
+    assert simclock.run(main) == 500.0
+
+
+def test_virtual_clock_stall_detection():
+    """A sim awaiting an event nothing will set raises instead of hanging."""
+
+    async def main(ck):
+        await asyncio.Event().wait()
+
+    try:
+        simclock.run(main)
+    except simclock.VirtualTimeStall:
+        pass
+    else:
+        raise AssertionError("expected VirtualTimeStall")
+
+
+def test_mocker_on_virtual_clock_is_deterministic():
+    """Engine startup + step pacing ride the injected clock: TTFT equals
+    boot + prefill exactly, twice."""
+
+    def once():
+        async def main(ck):
+            eng = MockerEngine(
+                MockEngineArgs(emit_sim_ts=True, startup_time_s=3.0),
+                clock=ck,
+            )
+            req = PreprocessedRequest(
+                request_id="r1", model="m", token_ids=list(range(64)),
+                stop=StopConditions(max_tokens=4, min_tokens=4,
+                                    ignore_eos=True),
+                sampling=SamplingOptions(temperature=0.0),
+            )
+            stamps = []
+            async for out in eng.generate(req, Context("r1")):
+                if out.token_ids:
+                    stamps.append(ck.time())
+            eng.stop()
+            return stamps
+
+        return simclock.run(main)
+
+    a, b = once(), once()
+    assert a == b
+    assert a[0] >= 3.0  # first token waits out the simulated boot
+
+
+# ---------------------------------------------------------------------------
+# traces
+# ---------------------------------------------------------------------------
+
+
+def test_traces_seeded():
+    a = traces.heavy_tail(duration_s=60, rate=5.0, seed=1)
+    b = traces.heavy_tail(duration_s=60, rate=5.0, seed=1)
+    c = traces.heavy_tail(duration_s=60, rate=5.0, seed=2)
+    key = lambda tr: [(r.t, r.item.isl, r.item.osl, r.item.group) for r in tr]
+    assert key(a) == key(b)
+    assert key(a) != key(c)
+    # heavy tail actually has a tail
+    isls = sorted(r.item.isl for r in a)
+    assert isls[-1] > 4 * isls[len(isls) // 2]
+
+
+def test_multi_region_phase_shift():
+    regs = traces.multi_region(regions=2, duration_s=400, mean_rate=5.0,
+                               amplitude=0.9, seed=3)
+    assert set(regs) == {"r0", "r1"}
+    # r1's peak lags r0's by half a period: their busiest quarters differ
+    def busiest_quarter(tr):
+        counts = [0, 0, 0, 0]
+        for r in tr:
+            counts[min(3, int(r.t / 100))] += 1
+        return counts.index(max(counts))
+
+    assert busiest_quarter(regs["r0"]) != busiest_quarter(regs["r1"])
+    merged = traces.merge(regs["r0"], regs["r1"])
+    assert [r.t for r in merged] == sorted(r.t for r in merged)
+
+
+def test_direction_flips_ignores_noise():
+    assert direction_flips([1, 8, 8, 1]) == 1          # up then down
+    assert direction_flips([10, 11, 10, 11, 10]) == 0  # +-1 wobble is noise
+    assert direction_flips([100, 1, 100, 1]) == 2      # real oscillation
+
+
+# ---------------------------------------------------------------------------
+# determinism (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_identical_report():
+    a = run_scenario("multi-pool-balance", seed=11, **SMOKE)
+    b = run_scenario("multi-pool-balance", seed=11, **SMOKE)
+    ja, jb = canonical_json(a), canonical_json(b)
+    assert ja == jb
+    # and the full report still carries a wall section (excluded above)
+    assert "wall" in a and a["wall"]["elapsed_s"] > 0
+
+
+def test_changed_seed_changes_arrivals_invariants_hold():
+    base = run_scenario("prefix-heavy-radix", seed=0, **SMOKE)
+    other = run_scenario("prefix-heavy-radix", seed=1, **SMOKE)
+    assert canonical_json(base) != canonical_json(other)
+    assert base["sim"]["trace_requests"] != other["sim"]["trace_requests"]
+    assert base["sim"]["passed"] and other["sim"]["passed"]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 closed-loop gate: all four scenarios pass at smoke scale
+# ---------------------------------------------------------------------------
+
+
+def test_diurnal_autoscale_smoke():
+    rep = run_scenario("diurnal-autoscale", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    p = rep["sim"]["pools"]["decode"]
+    assert p["replicas"]["max"] > p["replicas"]["min"]  # it actually scaled
+
+
+def test_bursty_breaker_chaos_smoke():
+    rep = run_scenario("bursty-breaker-chaos", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    p = rep["sim"]["pools"]["decode"]
+    assert p["breaker_events"], "flap must trip a breaker"
+    assert p["retries"] > 0  # migration happened
+
+
+def test_prefix_heavy_radix_smoke():
+    rep = run_scenario("prefix-heavy-radix", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    assert rep["sim"]["pools"]["decode"]["cache_hit_ratio"] >= 0.4
+
+
+def test_multi_pool_balance_smoke():
+    rep = run_scenario("multi-pool-balance", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    assert set(rep["sim"]["pools"]) == {"interactive", "batch"}
+
+
+def test_multi_region_follow_sun_smoke():
+    rep = run_scenario("multi-region-follow-sun", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+
+
+# ---------------------------------------------------------------------------
+# BENCH schema + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_bench_record_schema():
+    reports = run_suite(names=["multi-pool-balance"], seed=0, **SMOKE)
+    rec = bench_record(reports)
+    # bench.py contract: one JSON-able record with these exact keys
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline", "detail"}
+    assert rec["value"] == 1.0 and rec["vs_baseline"] == 1.0
+    det = rec["detail"]
+    assert "multi-pool-balance" in det["scenarios"]
+    scn = det["scenarios"]["multi-pool-balance"]
+    assert "router_decision_us" in scn and "invariants" in scn
+    assert det["router_decision_p99_us_max"] > 0
+    assert det["sim_ttft_p95_ms"] and det["sim_itl_p95_ms"]
+    json.dumps(rec)  # serializable
+
+
+def test_cli_runs_and_gates(tmp_path, capsys):
+    from dynamo_tpu.sim.__main__ import main
+
+    out = tmp_path / "rep.json"
+    rc = main(["diurnal", "--workers", "6", "--duration", "180",
+               "--seed", "0", "--out", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+    reports = json.loads(out.read_text())
+    assert isinstance(reports, list)  # --out shape is a list regardless of count
+    (rep,) = reports
+    assert rep["sim"]["scenario"] == "diurnal-autoscale"
+    assert rep["sim"]["passed"]
+    assert rep["sim"]["sim_advanced_s"] >= rep["sim"]["sim_duration_s"]
+    assert main(["list"]) == 0
+    capsys.readouterr()

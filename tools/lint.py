@@ -356,6 +356,61 @@ def kv_float32_allocations(path: str, tree: ast.AST):
     return out
 
 
+# -- sim-path virtual-time pass ----------------------------------------------
+# Modules on the fleet simulator's path (dynamo_tpu/sim, the mocker, loadgen,
+# fleet_bench, planner metrics windows) must pace and stamp time through an
+# injected Clock (runtime/clock.py — the wall-clock funnel; sim/clock.py is
+# the exempt virtual driver): a direct time.time()
+# / time.monotonic() / asyncio.sleep() call silently mixes wall seconds into
+# virtual timelines, which breaks same-seed determinism and re-introduces the
+# asyncio jitter the virtual clock exists to remove; a blocking time.sleep()
+# is worse still — it stalls the single-threaded virtualized loop for real
+# wall seconds. time.perf_counter[_ns]
+# stays allowed — measuring real control-plane CPU cost is the sim's job.
+def _is_sim_path_file(norm_path: str) -> bool:
+    if norm_path.endswith("sim/clock.py"):
+        return False  # the Clock funnel owns the wall-clock calls
+    return (
+        "dynamo_tpu/sim/" in norm_path
+        or "/mocker/" in norm_path
+        or norm_path.endswith((
+            "profiler/loadgen.py", "profiler/fleet_bench.py",
+            "planner/metrics_source.py",
+        ))
+    )
+
+
+def sim_wallclock(path: str, tree: ast.AST):
+    out = []
+    for call in ast.walk(tree):
+        if not (isinstance(call, ast.Call) and isinstance(call.func, ast.Attribute)):
+            continue
+        fn = call.func
+        if not isinstance(fn.value, ast.Name):
+            continue
+        if fn.value.id == "time" and fn.attr in ("time", "monotonic"):
+            out.append((
+                path, call.lineno,
+                f"SIM-WALLCLOCK: time.{fn.attr}() in a sim-path module — "
+                "read the injected Clock (runtime/clock.py) so virtual time "
+                "stays deterministic",
+            ))
+        elif fn.value.id == "time" and fn.attr == "sleep":
+            out.append((
+                path, call.lineno,
+                "SIM-WALLCLOCK: time.sleep() in a sim-path module — it "
+                "blocks the virtualized loop in real wall seconds; await "
+                "the injected Clock.sleep (runtime/clock.py)",
+            ))
+        elif fn.value.id == "asyncio" and fn.attr == "sleep":
+            out.append((
+                path, call.lineno,
+                "SIM-WALLCLOCK: asyncio.sleep() in a sim-path module — "
+                "pace through the injected Clock.sleep (runtime/clock.py)",
+            ))
+    return out
+
+
 # -- observability pass ------------------------------------------------------
 # Request-path modules where latency must flow through MetricsScope on a
 # monotonic clock, not hand-rolled wall-clock subtraction. kv_router/scheduler
@@ -524,6 +579,10 @@ def main(argv) -> int:
                 bad += 1
         if _is_kv_plane_file(norm):
             for p, lineno, msg in kv_float32_allocations(path, tree):
+                print(f"{p}:{lineno}: {msg}")
+                bad += 1
+        if _is_sim_path_file(norm):
+            for p, lineno, msg in sim_wallclock(path, tree):
                 print(f"{p}:{lineno}: {msg}")
                 bad += 1
         if not norm.endswith("runtime/metrics.py"):
