@@ -213,11 +213,11 @@ def measure_device_rtt(device, tries: int = 3) -> float:
     np.asarray (a real fetch), not block_until_ready — on tunneled TPUs the
     latter returns early and under-reports by the full tunnel latency."""
     x = jax.device_put(jnp.zeros((8,), jnp.float32), device)
-    np.asarray(x + 1)  # warm the op cache
+    np.asarray(x + 1)  # warm the op cache  # dtpu: ignore[HOST-SYNC] — deliberate: this IS the RTT probe
     samples = []
     for _ in range(tries):
         t0 = time.perf_counter()
-        np.asarray(x + 1)
+        np.asarray(x + 1)  # dtpu: ignore[HOST-SYNC] — deliberate fetch: measuring the round-trip is the point
         samples.append(time.perf_counter() - t0)
     samples.sort()
     return samples[len(samples) // 2]

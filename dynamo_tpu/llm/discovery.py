@@ -26,6 +26,7 @@ from ..runtime.flight_recorder import get_flight_recorder
 from ..runtime.logging import get_logger
 from ..runtime.request_plane.tcp import NoResponders
 from ..runtime.resilience import OPEN, CircuitBreaker
+from ..runtime.tasks import spawn_bg
 from ..runtime.tracing import get_tracer
 from .migration import Migration
 from .model_card import MDC_PREFIX, ModelDeploymentCard
@@ -397,7 +398,9 @@ class ModelWatcher:
 
     async def start(self) -> "ModelWatcher":
         self._watcher = await self.runtime.store.watch(MDC_PREFIX + "/")
-        self._task = asyncio.create_task(self._loop())
+        # spawn_bg: a dead model watcher means new models never register
+        # and removed ones keep serving — log the failure, don't drop it
+        self._task = spawn_bg(self._loop())
         return self
 
     async def _loop(self) -> None:

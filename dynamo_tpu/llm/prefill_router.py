@@ -18,6 +18,7 @@ from typing import Optional
 from ..kv_router import KvRouter, KvRouterConfig, WorkerWithDpRank
 from ..runtime.component import Client, RouterMode
 from ..runtime.engine import Context
+from ..runtime.errors import is_terminal
 from ..runtime.flight_recorder import get_flight_recorder
 from ..runtime.logging import get_logger
 from ..runtime.request_plane.tcp import NoResponders
@@ -134,6 +135,15 @@ class PrefillRouter:
                     span.set(error="no responders")
                 return None
             except Exception as e:
+                if is_terminal(e):
+                    # typed 4xx-class failure (context length, guided
+                    # grammar, ...): the request itself is wrong, so the
+                    # aggregated path would only re-run the same doomed
+                    # prefill and fail again — surface it to the client now
+                    if span is not None:
+                        span.status = "ERROR"
+                        span.set(error=repr(e))
+                    raise
                 log.exception("prefill failed; falling back to aggregated")
                 if span is not None:
                     span.status = "ERROR"

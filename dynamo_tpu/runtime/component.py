@@ -23,6 +23,7 @@ from .discovery.store import EventType, KVStore, Watcher
 from .engine import Context
 from .logging import get_logger
 from .request_plane.tcp import Handler, NoResponders, TcpClient, TcpRequestServer
+from .tasks import spawn_bg
 
 log = get_logger("runtime.component")
 
@@ -239,25 +240,39 @@ class Client:
     async def start(self) -> None:
         store = self.endpoint.runtime.store
         self._watcher = await store.watch(self.endpoint.subject_prefix)
-        self._watch_task = asyncio.create_task(self._watch_loop())
+        # spawn_bg: a watch loop that dies must log — a silently-dead loop
+        # leaves this client routing to a stale instance table forever
+        self._watch_task = spawn_bg(self._watch_loop())
 
     async def _watch_loop(self) -> None:
         assert self._watcher is not None
+        async for ev in self._watcher:
+            try:
+                self._apply_event(ev)
+            except Exception:
+                # per-event isolation: one corrupt instance record must not
+                # kill the loop and freeze the instance table (every later
+                # PUT/DELETE would be lost while requests keep routing on
+                # stale entries)
+                log.exception(
+                    "%s: bad instance event (%s)", self.endpoint.path, ev.key
+                )
+
+    def _apply_event(self, ev) -> None:
         import msgpack
 
-        async for ev in self._watcher:
-            if ev.type == EventType.PUT and ev.value is not None:
-                inst = Instance.from_obj(msgpack.unpackb(ev.value, raw=False))
-                self.instances[inst.instance_id] = inst
-                self._instances_event.set()
-            elif ev.type == EventType.DELETE:
-                iid_hex = ev.key.rsplit("/", 1)[-1]
-                try:
-                    self.instances.pop(int(iid_hex, 16), None)
-                except ValueError:
-                    pass
-                if not self.instances:
-                    self._instances_event.clear()
+        if ev.type == EventType.PUT and ev.value is not None:
+            inst = Instance.from_obj(msgpack.unpackb(ev.value, raw=False))
+            self.instances[inst.instance_id] = inst
+            self._instances_event.set()
+        elif ev.type == EventType.DELETE:
+            iid_hex = ev.key.rsplit("/", 1)[-1]
+            try:
+                self.instances.pop(int(iid_hex, 16), None)
+            except ValueError:
+                pass
+            if not self.instances:
+                self._instances_event.clear()
 
     async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> List[Instance]:
         deadline = asyncio.get_event_loop().time() + timeout

@@ -21,6 +21,7 @@ from ..discovery.store import KVStore
 from ..faults import FAULTS
 from ..logging import get_logger
 from ..resilience import retry_policy
+from ..tasks import spawn_bg
 from .base import EventPlane, Subscription
 
 log = get_logger("runtime.event_plane.zmq")
@@ -48,7 +49,9 @@ class ZmqBroker:
         xpub_port = self._xpub.bind_to_random_port(f"tcp://{self._host}")
         self.pub_addr = f"tcp://{self._host}:{xsub_port}"
         self.sub_addr = f"tcp://{self._host}:{xpub_port}"
-        self._task = asyncio.create_task(self._forward())
+        # spawn_bg: a forwarder that dies on a ZMQ error must log, not
+        # vanish silently with its exception unretrieved until GC
+        self._task = spawn_bg(self._forward())
         log.debug("zmq broker up: pub=%s sub=%s", self.pub_addr, self.sub_addr)
 
     async def _forward(self) -> None:
@@ -85,14 +88,44 @@ class ZmqEventPlane(EventPlane):
         self._broker = broker  # set if this plane founded the broker
         self._sub_tasks: List[asyncio.Task] = []
         self._sub_sockets: List[zmq.asyncio.Socket] = []
-        self._warmed = False
+        self._warm_evt: Optional[asyncio.Event] = None
+
+    async def _warm(self) -> None:
+        """One slow-joiner beat, shared by every concurrent first publish.
+
+        The old ``if not self._warmed: await sleep(); self._warmed = True``
+        was a check-then-act across an await (ASYNC-RMW): every publish that
+        arrived during the warm window re-checked the stale flag and served
+        its own full sleep. The event is created synchronously (no await
+        between check and act), so exactly one caller sleeps and the rest
+        ride the same beat. If the elected sleeper is cancelled mid-beat it
+        wakes the waiters and clears the slot so the next caller re-elects —
+        otherwise one cancelled wait_for would deadlock every later publish."""
+        while True:
+            if self._warm_evt is None:
+                self._warm_evt = evt = asyncio.Event()
+                try:
+                    # PUB->broker connect is async; without a beat the first
+                    # publishes are dropped on the floor (zmq slow-joiner).
+                    await asyncio.sleep(0.15)
+                except BaseException:
+                    # deliberate rollback: the election itself is synchronous
+                    # (check->assign with no await between); this write only
+                    # undoes OUR election so a waiter can re-elect
+                    self._warm_evt = None  # dtpu: ignore[ASYNC-RMW]
+                    evt.set()  # wake waiters so one of them re-elects
+                    raise
+                evt.set()
+                return
+            evt = self._warm_evt
+            if evt.is_set():
+                return
+            await evt.wait()
+            if self._warm_evt is evt:
+                return  # the sleeper finished the beat
 
     async def publish(self, topic: str, payload: bytes) -> None:
-        if not self._warmed:
-            # PUB->broker connect is async; without a beat the first publishes
-            # are dropped on the floor (zmq slow-joiner).
-            await asyncio.sleep(0.15)
-            self._warmed = True
+        await self._warm()
 
         async def send():
             await FAULTS.ainject("event_plane.publish")

@@ -24,6 +24,7 @@ from aiohttp import web
 from . import metrics as M
 from .logging import get_logger
 from .request_plane.tcp import TcpClient
+from .tasks import spawn_bg
 
 log = get_logger("runtime.health")
 
@@ -116,7 +117,17 @@ class EndpointCanary:
                         self._down.add(name)
                         log.warning("canary: endpoint %s unhealthy (%s)", name, e)
                         if self.on_unhealthy is not None:
-                            await self.on_unhealthy(name)
+                            try:
+                                await self.on_unhealthy(name)
+                            except Exception:
+                                # the callback (deregister, shed, restart)
+                                # tends to hit the same dead infrastructure
+                                # the canary just detected; its failure must
+                                # not kill the probe loop — the canary is
+                                # most needed exactly then
+                                log.exception(
+                                    "canary: on_unhealthy(%s) failed", name
+                                )
 
     def start(self) -> "EndpointCanary":
         async def loop() -> None:
@@ -127,7 +138,9 @@ class EndpointCanary:
             except asyncio.CancelledError:
                 pass
 
-        self._task = asyncio.create_task(loop())
+        # spawn_bg: a canary that dies from an unexpected error must log,
+        # not silently stop probing while /health keeps reporting stale state
+        self._task = spawn_bg(loop())
         return self
 
     async def stop(self) -> None:

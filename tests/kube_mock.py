@@ -16,6 +16,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from aiohttp import web
 
+from dynamo_tpu.runtime.tasks import spawn_bg
+
 
 def _merge(base: Any, patch: Any) -> Any:
     """RFC 7386 JSON merge patch."""
@@ -124,7 +126,7 @@ class MockKubeApi:
         self.objects[(plural, ns, name)] = obj
         self._emit("ADDED", plural, ns, obj)
         if plural in ("deployments", "statefulsets"):
-            asyncio.ensure_future(self._make_ready(plural, ns, name))
+            spawn_bg(self._make_ready(plural, ns, name))
         return web.json_response(obj, status=201)
 
     async def _get(self, request: web.Request) -> web.Response:
@@ -152,7 +154,7 @@ class MockKubeApi:
         self.objects[(plural, ns, name)] = merged
         self._emit("MODIFIED", plural, ns, merged)
         if plural in ("deployments", "statefulsets"):
-            asyncio.ensure_future(self._make_ready(plural, ns, name))
+            spawn_bg(self._make_ready(plural, ns, name))
         return web.json_response(merged)
 
     async def _delete(self, request: web.Request) -> web.Response:

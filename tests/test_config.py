@@ -3,7 +3,6 @@ kwargs — the figment analog (reference lib/runtime/src/config.rs)."""
 
 import json
 
-import pytest
 
 from dynamo_tpu.runtime.config import (
     ENV_CONFIG_FILE,

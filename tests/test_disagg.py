@@ -10,7 +10,6 @@ import asyncio
 import jax.numpy as jnp
 
 from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
-from dynamo_tpu.kv_router import KvRouterConfig
 from dynamo_tpu.llm import ModelDeploymentCard, ModelManager, ModelWatcher, register_llm
 from dynamo_tpu.llm.model_card import MODEL_TYPE_PREFILL
 from dynamo_tpu.llm.protocols.common import (
@@ -178,6 +177,50 @@ async def test_disagg_falls_back_without_prefill_pool():
         engine.stop()
         await decode_rt.shutdown()
         await frontend_rt.shutdown()
+
+
+async def test_prefill_terminal_error_surfaces_instead_of_fallback():
+    """A typed 4xx-class failure from the prefill pool (context length,
+    guided grammar, ...) must propagate to the client: the request itself is
+    wrong, so the aggregated fallback would only re-run the same doomed
+    prefill. Transport-class failures still fall back (return None).
+
+    Regression test for the broad ``except Exception -> return None`` in
+    PrefillRouter.run_prefill that swallowed runtime/errors.py typed errors
+    (flagged while building tools/analysis)."""
+    import pytest
+
+    from dynamo_tpu.llm.prefill_router import PrefillRouter
+    from dynamo_tpu.runtime.request_plane.tcp import RequestPlaneError
+
+    class StubClient:
+        def __init__(self, exc):
+            self.exc = exc
+            self.instances = {1: object()}
+
+        async def generate(self, obj, context, instance_id=None):
+            raise self.exc
+
+        async def stop(self):
+            pass
+
+    card = ModelDeploymentCard(
+        name="disagg-model", component="prefill", tokenizer="byte",
+        kv_block_size=4, context_length=128,
+    )
+    router = PrefillRouter(runtime=None, card=card)
+
+    # worker-side typed error rides the wire as RequestPlaneError(code=...)
+    router.client = StubClient(
+        RequestPlaneError("prompt exceeds model context", code="context_length")
+    )
+    with pytest.raises(RequestPlaneError, match="context"):
+        await router.run_prefill(preq("terminal", list(range(8))), Context())
+
+    # transport-ish failure: fall back to aggregated (None), don't raise
+    router.client = StubClient(RuntimeError("socket exploded"))
+    out = await router.run_prefill(preq("transient", list(range(8))), Context())
+    assert out is None
 
 
 async def test_disagg_uses_native_transfer(monkeypatch):

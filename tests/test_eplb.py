@@ -11,7 +11,6 @@ moves WHERE expert compute runs, never WHAT it computes — outputs are
 token-identical before and after.
 """
 
-import asyncio
 
 import jax
 import jax.numpy as jnp

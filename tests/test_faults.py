@@ -3,7 +3,6 @@ spec parsing, call-indexed schedules, seeded reproducibility, and the
 sync/async/corrupt injection surfaces.
 """
 
-import asyncio
 import time
 
 import pytest

@@ -7,7 +7,6 @@ disagg TTFT/ITL comparisons in docs/design_docs/architecture.md:87-91.
 
 import asyncio
 
-import pytest
 
 from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
 from dynamo_tpu.profiler.fleet_bench import (

@@ -494,19 +494,20 @@ async def test_https_serving(tmp_path):
     --tls-cert-path/--tls-key-path): self-signed cert, HTTPS round-trip."""
     import shutil
     import ssl
-    import subprocess
 
     import pytest
 
     if shutil.which("openssl") is None:
         pytest.skip("openssl binary not available")
     cert, key = tmp_path / "crt.pem", tmp_path / "key.pem"
-    subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-         "-keyout", str(key), "-out", str(cert), "-days", "1",
-         "-subj", "/CN=localhost"],
-        check=True, capture_output=True,
+    proc = await asyncio.create_subprocess_exec(
+        "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(key), "-out", str(cert), "-days", "1",
+        "-subj", "/CN=localhost",
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
     )
+    _, errs = await proc.communicate()
+    assert proc.returncode == 0, errs.decode()
     store = MemKVStore()
     worker_rt, frontend_rt, served, watcher, plain, _ = await start_stack(store)
     service = HttpService(

@@ -13,7 +13,6 @@ import asyncio
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig

@@ -7,7 +7,6 @@ handler}.py.
 
 import asyncio
 
-import pytest
 
 from dynamo_tpu.global_router import (
     DecodePoolSelectionStrategy,

@@ -99,6 +99,51 @@ async def test_canary_detects_dead_endpoint_and_status_server_reports():
         await rt.shutdown()
 
 
+async def test_canary_survives_raising_unhealthy_callback():
+    """The on_unhealthy callback (deregister, shed, restart) tends to hit
+    the same dead infrastructure the canary just detected; if its exception
+    kills the probe loop, health reporting silently freezes exactly when it
+    is needed most. Regression test for the unguarded ``await
+    self.on_unhealthy(name)`` (flagged while building tools/analysis)."""
+    store = MemKVStore()
+    rt = await make_rt(store).start()
+    served = await (
+        rt.namespace("ns").component("c").endpoint("gen").serve(EchoEngine().generate)
+    )
+    state = HealthState()
+    calls = []
+
+    async def exploding_callback(name):
+        calls.append(name)
+        raise RuntimeError("deregister hit the same dead store")
+
+    canary = EndpointCanary(
+        {"live": served.address, "dead": "127.0.0.1:1"},
+        state=state, interval_s=0.05, timeout_s=0.5, fail_threshold=2,
+        on_unhealthy=exploding_callback,
+    )
+    try:
+        # two probes trip the dead target and fire the raising callback;
+        # probe_once must swallow it (pre-fix: RuntimeError propagates here
+        # and, from the started loop, kills the canary task)
+        await canary.probe_once()
+        await canary.probe_once()
+        assert calls == ["dead"]
+        assert not state.snapshot()["subsystems"]["dead"]["healthy"]
+
+        # the loop keeps probing after the callback failure: the live
+        # target's RTT still refreshes
+        canary.start()
+        canary.last_rtt.pop("live", None)
+        await poll(lambda: "live" in canary.last_rtt)
+        assert canary._task is not None and not canary._task.done()
+        assert state.snapshot()["subsystems"]["live"]["healthy"]
+    finally:
+        await canary.stop()
+        await served.stop()
+        await rt.shutdown()
+
+
 async def test_stale_pong_not_credited_to_next_ping():
     """A pong owed to a timed-out ping is discarded, not credited to the
     next ping — otherwise a consistently-slow endpoint pings 'healthy'

@@ -7,7 +7,6 @@ Reference analog: NIXL GPU<->GPU RDMA (lib/memory/src/nixl.rs:13,
 docs/design_docs/disagg_serving.md:20,54).
 """
 
-import asyncio
 
 import jax
 import jax.numpy as jnp

@@ -13,6 +13,20 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_analyzer_repo_gate_zero_new_findings():
+    """The full tools/analysis run (every pass, all three top-level source
+    trees) must report zero non-baselined findings: a new violation anywhere
+    fails THIS test in the PR that introduces it. Fix the code, add an
+    inline ``# dtpu: ignore[RULE]`` with a rationale, or (for a pre-existing
+    pattern newly covered by a rule) regenerate the baseline — in that
+    order of preference."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "dynamo_tpu", "tools", "tests"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, "\n" + r.stdout + r.stderr
+
+
 def test_package_lints_clean():
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "lint.py"),

@@ -7,7 +7,6 @@ load/unload/list endpoints (components/src/dynamo/vllm/main.py:712).
 
 import asyncio
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -23,7 +22,6 @@ from dynamo_tpu.lora import (
     LoRACache,
     LoraAdapterTable,
     LoraReplicaConfig,
-    LoraRoutingTable,
     RendezvousHasher,
     allocate,
     load_adapter,

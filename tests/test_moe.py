@@ -3,7 +3,6 @@
 EP strategies run under shard_map on the virtual 8-device CPU mesh; the same
 programs compile for a real ICI ep axis."""
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -66,7 +65,7 @@ class TestEpEquivalence:
         in_specs = (
             {k: expert_spec.get(k, P()) for k in self.p}, P(),
         )
-        fn = jax.shard_map(
+        fn = meshlib.shard_map(
             lambda p, x: moe.moe_ffn_ep_psum(p, self.cfg, x, meshlib.AXIS_TP),
             mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False,
         )
@@ -88,7 +87,7 @@ class TestEpEquivalence:
             {k: expert_spec.get(k, P()) for k in self.p},
             P(meshlib.AXIS_TP),          # tokens sharded
         )
-        fn = jax.shard_map(
+        fn = meshlib.shard_map(
             lambda p, x: moe.moe_ffn_ep_a2a(p, cfg, x, meshlib.AXIS_TP),
             mesh=mesh, in_specs=in_specs, out_specs=P(meshlib.AXIS_TP),
             check_vma=False,
@@ -108,7 +107,7 @@ class TestEpEquivalence:
             "w_down": P(meshlib.AXIS_TP),
         }
         in_specs = ({k: expert_spec.get(k, P()) for k in self.p}, P(meshlib.AXIS_TP))
-        fn = jax.shard_map(
+        fn = meshlib.shard_map(
             lambda p, x: moe.moe_ffn_ep_a2a(p, cfg, x, meshlib.AXIS_TP),
             mesh=mesh, in_specs=in_specs, out_specs=P(meshlib.AXIS_TP), check_vma=False,
         )

@@ -13,7 +13,6 @@ stream. Reference analog: engine_monitor + migration
 import asyncio
 import json
 import os
-import signal
 import socket
 import subprocess
 import sys

@@ -5,7 +5,6 @@ and delegated attention kernels behaviorally; here the same kernels that run
 compiled on TPU execute under the Pallas interpreter so CI needs no chips.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

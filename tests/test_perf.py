@@ -4,7 +4,6 @@ Reference analog: lib/llm/src/perf.rs + perf/logprobs.rs.
 """
 
 import asyncio
-import math
 
 from dynamo_tpu.llm.perf import (
     RecordedStream,

@@ -17,7 +17,7 @@ from dynamo_tpu.planner.core import (
     PerfInterpolator,
     PlannerConfig,
 )
-from dynamo_tpu.profiler import ProfileResult, calibrate_mocker_args, profile_engine
+from dynamo_tpu.profiler import calibrate_mocker_args, profile_engine
 
 # step durations well above asyncio timer jitter (~1-2ms), so single-rep
 # measurements are stable in CI

@@ -117,6 +117,100 @@ async def test_inproc_event_plane():
     await plane.close()
 
 
+async def test_zmq_publish_warm_is_single_shared_beat(monkeypatch):
+    """Concurrent first publishes share ONE slow-joiner warm beat.
+
+    The old ``if not self._warmed: await sleep(); self._warmed = True`` was
+    a check-then-act across an await (ASYNC-RMW): every publish arriving
+    during the warm window re-read the stale flag and served its own full
+    sleep. Regression test for the Event-based fix."""
+    from dynamo_tpu.runtime.event_plane import zmq_plane
+
+    broker = ZmqBroker()
+    await broker.start()
+    plane = ZmqEventPlane(broker.pub_addr, broker.sub_addr)
+    sleeps = []
+    real_sleep = asyncio.sleep
+
+    async def counting_sleep(dt):
+        sleeps.append(dt)
+        await real_sleep(0)
+
+    monkeypatch.setattr(zmq_plane.asyncio, "sleep", counting_sleep)
+    try:
+        await asyncio.gather(*[plane._warm() for _ in range(5)])
+        assert len(sleeps) == 1, f"warm beat must be shared, got {sleeps}"
+        assert plane._warm_evt is not None and plane._warm_evt.is_set()
+        await plane._warm()  # warmed: no further sleeps
+        assert len(sleeps) == 1
+    finally:
+        monkeypatch.undo()
+        await plane.close()
+        await broker.stop()
+
+
+async def test_zmq_warm_cancelled_sleeper_does_not_deadlock_waiters(monkeypatch):
+    """Cancelling the elected warm sleeper (e.g. a publish under
+    asyncio.wait_for timing out mid-beat) must not leave _warm_evt unset
+    forever — waiters re-elect a sleeper and later publishes still warm."""
+    from dynamo_tpu.runtime.event_plane import zmq_plane
+
+    broker = ZmqBroker()
+    await broker.start()
+    plane = ZmqEventPlane(broker.pub_addr, broker.sub_addr)
+    real_sleep = asyncio.sleep
+    gate = asyncio.Event()
+
+    async def hanging_sleep(dt):
+        gate.set()
+        await real_sleep(3600)
+
+    monkeypatch.setattr(zmq_plane.asyncio, "sleep", hanging_sleep)
+    try:
+        sleeper = asyncio.create_task(plane._warm())
+        await gate.wait()
+        waiter = asyncio.create_task(plane._warm())
+        await real_sleep(0.05)
+        sleeper.cancel()
+        monkeypatch.undo()  # the re-elected sleeper uses the real beat
+        await asyncio.wait_for(waiter, 5)  # must NOT hang forever
+        assert plane._warm_evt is not None and plane._warm_evt.is_set()
+        await asyncio.wait_for(plane._warm(), 5)
+    finally:
+        monkeypatch.undo()
+        await plane.close()
+        await broker.stop()
+
+
+async def test_client_watch_loop_survives_corrupt_instance_record():
+    """One corrupt instance record must not kill the Client's watch loop —
+    a silently-dead loop freezes the instance table while requests keep
+    routing on stale entries. Regression test for the unguarded event
+    handling in Client._watch_loop (flagged while building tools/analysis)."""
+    from dynamo_tpu.runtime import DistributedRuntime, MemKVStore, RuntimeConfig
+
+    store = MemKVStore()
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    rt = await DistributedRuntime(cfg, store=store, event_plane=InProcEventPlane()).start()
+    endpoint = rt.namespace("ns").component("c").endpoint("gen")
+    client = await endpoint.client()
+    try:
+        # a record that unpacks but is not an Instance: from_obj explodes
+        await store.put_obj(endpoint.subject_prefix + "deadbeef", {"garbage": True})
+        await asyncio.sleep(0.1)
+        assert client._watch_task is not None and not client._watch_task.done()
+
+        # the loop is still alive: a valid registration after the corrupt
+        # one still lands in the instance table
+        served = await endpoint.serve(echo_handler)
+        insts = await client.wait_for_instances(1, timeout=5.0)
+        assert [i.instance_id for i in insts] == [served.instance_id]
+        await served.stop()
+    finally:
+        await client.stop()
+        await rt.shutdown()
+
+
 async def test_zmq_event_plane_broker():
     broker = ZmqBroker()
     await broker.start()

@@ -37,7 +37,6 @@ from dynamo_tpu.runtime.tracing import (
     InMemoryExporter,
     OtlpHttpExporter,
     Tracer,
-    get_tracer,
     set_tracer,
 )
 

@@ -5,12 +5,9 @@ lib/gpu_memory_service crash-surviving weights; SURVEY §2.4 prescribes the
 host-cache + fast re-device_put design implemented here.
 """
 
-import json
-import os
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dynamo_tpu.engine.warm import WarmWeightCache, _flatten, _unflatten
 from dynamo_tpu.models.llama import LlamaConfig, init_params

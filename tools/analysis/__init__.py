@@ -1,0 +1,26 @@
+"""Single-parse multi-pass AST static analysis for the repo.
+
+See core.py for the framework (pass registry, Finding model, baseline,
+inline ignores, CLI); asyncpass.py / purity.py for the semantic passes;
+legacy.py for the rules ported from tools/lint.py.
+
+Run: ``python -m tools.analysis [paths...]`` (default: dynamo_tpu/).
+"""
+
+from .core import (  # noqa: F401
+    AnalysisError,
+    Context,
+    Finding,
+    Module,
+    RunResult,
+    apply_baseline,
+    collect_findings,
+    load_baseline,
+    load_modules,
+    main,
+    register,
+    registered_passes,
+    rule_ids,
+    run,
+    write_baseline,
+)

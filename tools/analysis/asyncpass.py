@@ -1,0 +1,510 @@
+"""Asyncio semantic passes: RMW races across awaits, blocking calls inside
+coroutines, and leaked task handles.
+
+ASYNC-RMW is the headline: the control plane is ~20 asyncio-heavy packages
+where shared state (router load tables, planner pools, transfer maps) is
+read, an ``await`` yields the loop, and the state is written back — the
+interleaving the fleet simulator caught in the planner trough-collapse bug.
+The detector is linear-stream based (no path explosion): it walks each
+``async def`` in execution-ish order producing READ/WRITE/AWAIT/LOCK events
+for shared targets (``self.attr`` and ``global`` names) and flags three
+concrete shapes:
+
+  A. check-then-act: an ``if`` whose test reads T, with an await in the body
+     before a write to T (``if k not in self.d: v = await f(); self.d[k]=v``)
+  B. read-await-write: T read into a local, an await, then T written, all in
+     one statement block
+  C. aug-await: ``self.n += await f()`` (the read of ``self.n`` happens
+     BEFORE the await in CPython's evaluation order)
+
+plus D: re-acquiring the same asyncio lock inside its own ``async with``
+body — a guaranteed self-deadlock. Reads and writes both covered by the
+same ``async with <lock>`` block are safe and skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from .core import MUTATING_METHODS, Context, Finding, register, spawn_call_name
+
+
+# -- scope: request-path / control-plane modules -----------------------------
+
+def _is_control_plane_file(norm_path: str) -> bool:
+    return (
+        "dynamo_tpu/kv_router/" in norm_path
+        or "dynamo_tpu/router/" in norm_path
+        or "dynamo_tpu/planner/" in norm_path
+        or "dynamo_tpu/llm/" in norm_path
+        or "dynamo_tpu/transfer/" in norm_path
+        or "dynamo_tpu/sim/" in norm_path
+        or "dynamo_tpu/global_router/" in norm_path
+        or "dynamo_tpu/frontend/" in norm_path
+        or "runtime/discovery/" in norm_path
+        or "runtime/event_plane/" in norm_path
+        or "runtime/request_plane/" in norm_path
+        or norm_path.endswith((
+            "engine/transfer.py", "runtime/component.py", "runtime/health.py",
+            "runtime/distributed.py", "runtime/multihost.py",
+        ))
+    )
+
+
+# -- shared-target extraction ------------------------------------------------
+
+def _shared_target(node: ast.AST, global_names: set) -> Optional[str]:
+    """Canonical key for shared mutable state: ``self.attr`` (one level,
+    subscripts collapse onto the base attribute) or a declared-global name.
+    Locals return None."""
+    base = node
+    while isinstance(base, ast.Subscript):
+        base = base.value
+    if isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name):
+        if base.value.id == "self":
+            return f"self.{base.attr}"
+        if base.value.id in global_names:
+            return f"{base.value.id}.{base.attr}"
+        return None
+    if isinstance(base, ast.Name) and base.id in global_names:
+        return base.id
+    return None
+
+
+_LOCK_HINTS = ("lock", "mutex", "sem", "cond")
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    """Heuristic: the context manager of ``async with`` guards state when its
+    name smells like a lock (self._lock, LOCK, router_sem, ...)."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    return name is not None and any(h in name.lower() for h in _LOCK_HINTS)
+
+
+# -- event stream ------------------------------------------------------------
+
+# event kinds
+READ, WRITE, AWAIT, IF_OPEN, IF_CLOSE = "read", "write", "await", "if_open", "if_close"
+
+
+class _Event:
+    __slots__ = ("kind", "target", "line", "locked", "depth")
+
+    def __init__(self, kind, target, line, locked, depth):
+        self.kind = kind
+        self.target = target
+        self.line = line
+        self.locked = locked
+        self.depth = depth  # statement-block nesting depth
+
+
+class _AsyncFnScanner:
+    """Produces the linear event stream for one async function body."""
+
+    def __init__(self, global_names: set):
+        self.globals = global_names
+        self.events: List[_Event] = []
+        self.lock_depth = 0
+        self.block_depth = 0
+        self.lock_stack: List[str] = []
+        self.findings: List[Tuple[int, str]] = []  # (line, message) for shape D
+
+    # -- emission helpers
+    def _emit(self, kind, target, line):
+        self.events.append(
+            _Event(kind, target, line, self.lock_depth > 0, self.block_depth)
+        )
+
+    def _reads_in(self, node: ast.AST, line: int) -> None:
+        """READ events for every shared target loaded under ``node``; AWAIT
+        events for awaits, in source order (good enough inside one expr)."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Await):
+                self._emit(AWAIT, None, getattr(n, "lineno", line))
+            t = None
+            if isinstance(n, (ast.Attribute, ast.Subscript)) and isinstance(
+                getattr(n, "ctx", None), ast.Load
+            ):
+                t = _shared_target(n, self.globals)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                t = _shared_target(n, self.globals)
+            if t is not None:
+                self._emit(READ, t, getattr(n, "lineno", line))
+            # mutating method call on shared state is a WRITE
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in MUTATING_METHODS
+            ):
+                t2 = _shared_target(n.func.value, self.globals)
+                if t2 is not None:
+                    self._emit(WRITE, t2, getattr(n, "lineno", line))
+
+    def _writes_in(self, target_node: ast.AST, line: int) -> None:
+        t = _shared_target(target_node, self.globals)
+        if t is not None:
+            self._emit(WRITE, t, line)
+
+    # -- statement walk (execution-ish order: values before targets)
+    def visit_body(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self.visit_stmt(stmt)
+
+    def visit_stmt(self, stmt: ast.stmt) -> None:
+        line = getattr(stmt, "lineno", 0)
+        if isinstance(stmt, ast.Assign):
+            self._reads_in(stmt.value, line)
+            for tgt in stmt.targets:
+                # subscript/attribute stores read their base first
+                self._writes_in(tgt, line)
+        elif isinstance(stmt, ast.AugAssign):
+            t = _shared_target(stmt.target, self.globals)
+            if t is not None:
+                self._emit(READ, t, line)
+            self._reads_in(stmt.value, line)
+            if t is not None:
+                self._emit(WRITE, t, line)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._reads_in(stmt.value, line)
+            self._writes_in(stmt.target, line)
+        elif isinstance(stmt, ast.If):
+            self._reads_in(stmt.test, line)
+            self.events.append(_Event(IF_OPEN, _test_targets(stmt.test, self.globals),
+                                      line, self.lock_depth > 0, self.block_depth))
+            self.block_depth += 1
+            self.visit_body(stmt.body)
+            self.block_depth -= 1
+            self.events.append(_Event(IF_CLOSE, None, line, self.lock_depth > 0,
+                                      self.block_depth))
+            if stmt.orelse:
+                self.block_depth += 1
+                self.visit_body(stmt.orelse)
+                self.block_depth -= 1
+        elif isinstance(stmt, (ast.While,)):
+            self._reads_in(stmt.test, line)
+            self.block_depth += 1
+            self.visit_body(stmt.body)
+            self.block_depth -= 1
+            self.visit_body(stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._reads_in(stmt.iter, line)
+            if isinstance(stmt, ast.AsyncFor):
+                self._emit(AWAIT, None, line)
+            self._writes_in(stmt.target, line)
+            self.block_depth += 1
+            self.visit_body(stmt.body)
+            self.block_depth -= 1
+            self.visit_body(stmt.orelse)
+        elif isinstance(stmt, ast.AsyncWith):
+            is_lock = any(_is_lock_expr(item.context_expr) for item in stmt.items)
+            for item in stmt.items:
+                self._reads_in(item.context_expr, line)
+            self._emit(AWAIT, None, line)  # __aenter__ awaits
+            if is_lock:
+                for item in stmt.items:
+                    key = _expr_key(item.context_expr)
+                    if key is not None and key in self.lock_stack:
+                        self.findings.append((
+                            line,
+                            f"async with {key} re-acquired inside its own "
+                            f"guarded body — asyncio.Lock is not reentrant; "
+                            f"this deadlocks",
+                        ))
+                    self.lock_stack.append(key or "<lock>")
+                self.lock_depth += 1
+                self.visit_body(stmt.body)
+                self.lock_depth -= 1
+                for item in stmt.items:
+                    self.lock_stack.pop()
+            else:
+                self.visit_body(stmt.body)
+            self._emit(AWAIT, None, line)  # __aexit__ awaits
+        elif isinstance(stmt, ast.With):
+            is_lock = any(_is_lock_expr(item.context_expr) for item in stmt.items)
+            for item in stmt.items:
+                self._reads_in(item.context_expr, line)
+            if is_lock:
+                self.lock_depth += 1
+                self.visit_body(stmt.body)
+                self.lock_depth -= 1
+            else:
+                self.visit_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.visit_body(stmt.body)
+            for h in stmt.handlers:
+                self.visit_body(h.body)
+            self.visit_body(stmt.orelse)
+            self.visit_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            pass  # nested scopes analyzed separately
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._reads_in(stmt.value, line)
+        elif isinstance(stmt, ast.Expr):
+            self._reads_in(stmt.value, line)
+        elif isinstance(stmt, (ast.Delete,)):
+            for tgt in stmt.targets:
+                self._writes_in(tgt, line)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._reads_in(stmt.exc, line)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._reads_in(child, line)
+
+
+def _test_targets(test: ast.AST, global_names: set) -> Optional[frozenset]:
+    out = set()
+    for n in ast.walk(test):
+        if isinstance(n, (ast.Attribute, ast.Subscript, ast.Name)):
+            t = _shared_target(n, global_names)
+            if t is not None:
+                out.add(t)
+    return frozenset(out) if out else frozenset()
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Stable text key for a lock expression (self._lock -> 'self._lock')."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover — unparse exists on py3.9+
+        return None
+
+
+def _module_global_names(tree: ast.AST) -> set:
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            names.update(node.names)
+    return names
+
+
+def _scan_rmw(fn: ast.AsyncFunctionDef, global_names: set) -> List[Tuple[int, str]]:
+    scanner = _AsyncFnScanner(global_names)
+    scanner.visit_body(fn.body)
+    out: List[Tuple[int, str]] = list(scanner.findings)
+    ev = scanner.events
+
+    reported = set()
+
+    def report(line, target, kind):
+        if (target, kind) in reported:
+            return
+        reported.add((target, kind))
+        out.append((
+            line,
+            f"{kind} of {target} spans an await with no asyncio.Lock held — "
+            f"another coroutine can interleave and clobber it; guard both "
+            f"sides with one `async with lock` (or restructure to a single "
+            f"synchronous mutation)",
+        ))
+
+    # shape A: check-then-act — if-test reads T, await + write(T) in body
+    depth_stack: List[Tuple[frozenset, int, bool]] = []  # (targets, idx, locked)
+    for i, e in enumerate(ev):
+        if e.kind == IF_OPEN:
+            depth_stack.append((e.target, i, e.locked))
+        elif e.kind == IF_CLOSE:
+            if depth_stack:
+                targets, start, locked = depth_stack.pop()
+                await_at = None
+                for j in range(start + 1, i):
+                    if ev[j].kind == AWAIT and not ev[j].locked:
+                        await_at = j
+                    if (
+                        await_at is not None
+                        and ev[j].kind == WRITE
+                        and ev[j].target in targets
+                        and not (locked and ev[j].locked)
+                    ):
+                        report(ev[j].line, ev[j].target, "check-then-act")
+                        break
+
+    # shapes B/C: read(T) ... await ... write(T) at the same block depth
+    for i, e in enumerate(ev):
+        if e.kind != WRITE or e.target is None:
+            continue
+        await_seen = None
+        for j in range(i - 1, -1, -1):
+            p = ev[j]
+            if p.kind == AWAIT and not p.locked:
+                await_seen = p
+            elif p.kind == WRITE and p.target == e.target:
+                break  # a closer write owns this window
+            elif p.kind == READ and p.target == e.target:
+                if p.locked and e.locked:
+                    # double-checked locking: a guarded re-read before a
+                    # guarded write owns the window — earlier unlocked
+                    # reads are just the lock-free fast path
+                    break
+                if await_seen is not None and p.depth == e.depth:
+                    report(e.line, e.target, "read-modify-write")
+                    break
+    return out
+
+
+@register("async-rmw", "shared-state read-modify-write spanning an await")
+def _async_rmw_pass(ctx: Context) -> Iterator[Finding]:
+    for m in ctx.modules:
+        if not _is_control_plane_file(m.path):
+            continue
+        global_names = _module_global_names(m.tree)
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                for line, msg in _scan_rmw(node, global_names):
+                    yield Finding("ASYNC-RMW", m.path, line, msg)
+
+
+_async_rmw_pass.RULES = ("ASYNC-RMW",)
+
+
+# -- ASYNC-BLOCKING ----------------------------------------------------------
+
+_BLOCKING_ATTR_CALLS = {
+    ("time", "sleep"): "time.sleep() blocks the event loop — await "
+                       "asyncio.sleep() (or the injected Clock.sleep)",
+    ("subprocess", "run"): "subprocess.run() blocks the event loop — use "
+                           "asyncio.create_subprocess_exec",
+    ("subprocess", "call"): "subprocess.call() blocks the event loop — use "
+                            "asyncio.create_subprocess_exec",
+    ("subprocess", "check_call"): "subprocess.check_call() blocks the event "
+                                  "loop — use asyncio.create_subprocess_exec",
+    ("subprocess", "check_output"): "subprocess.check_output() blocks the "
+                                    "event loop — use asyncio.create_subprocess_exec",
+    ("socket", "create_connection"): "sync socket connect blocks the event "
+                                     "loop — use asyncio.open_connection",
+    ("socket", "getaddrinfo"): "sync DNS resolution blocks the event loop — "
+                               "use loop.getaddrinfo",
+    ("os", "system"): "os.system() blocks the event loop — use "
+                      "asyncio.create_subprocess_shell",
+    ("urllib", "urlopen"): "sync HTTP blocks the event loop — use aiohttp",
+    ("request", "urlopen"): "sync HTTP blocks the event loop — use aiohttp",
+}
+
+_REQUESTS_METHODS = {"get", "post", "put", "delete", "head", "patch", "request"}
+
+
+def _blocking_calls(fn_body: List[ast.stmt]) -> Iterator[Tuple[int, str]]:
+    """Blocking calls lexically inside an async def, skipping nested sync
+    defs/lambdas (those typically run on an executor)."""
+    # line ranges of nested defs: sync defs/lambdas typically run on an
+    # executor; nested async defs get their own scan from the module walk
+    nested: List[Tuple[int, int]] = []
+    for stmt in fn_body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                nested.append((node.lineno, node.end_lineno or node.lineno))
+    for stmt in fn_body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(a <= node.lineno <= b for a, b in nested):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+                key = (f.value.id, f.attr)
+                if key in _BLOCKING_ATTR_CALLS:
+                    yield node.lineno, _BLOCKING_ATTR_CALLS[key]
+                elif f.value.id == "requests" and f.attr in _REQUESTS_METHODS:
+                    yield (
+                        node.lineno,
+                        f"requests.{f.attr}() is sync I/O inside async "
+                        f"def — use aiohttp (or run_in_executor)",
+                    )
+
+
+@register("async-blocking", "blocking sync I/O inside async def")
+def _async_blocking_pass(ctx: Context) -> Iterator[Finding]:
+    for m in ctx.modules:
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                for line, msg in _blocking_calls(node.body):
+                    yield Finding("ASYNC-BLOCKING", m.path, line, msg)
+
+
+_async_blocking_pass.RULES = ("ASYNC-BLOCKING",)
+
+
+# -- TASK-LIFECYCLE ----------------------------------------------------------
+
+def _is_task_spawn(call: ast.Call) -> bool:
+    return spawn_call_name(call) is not None
+
+
+def leaked_task_handles(path: str, tree: ast.AST):
+    """``t = asyncio.create_task(...)`` where ``t`` is a local that is never
+    read again in the function: the reference dies with the frame, so the
+    loop's weak ref is the only thing keeping the task alive — same GC'd-
+    mid-flight failure mode as a discarded call, one hop removed
+    (DROPPED-TASK catches the zero-hop case). Retention through an
+    attribute/subscript store (self._task = ...) passes. Fix: keep the
+    handle, add a done callback, or spawn through runtime/tasks.py
+    (spawn_bg / TaskTracker.spawn), which pins and logs."""
+    out = []
+    functions = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+    def innermost_owner(lineno: int):
+        best = None
+        for f in functions:
+            if f.lineno <= lineno <= (f.end_lineno or f.lineno):
+                if best is None or f.lineno > best.lineno:
+                    best = f
+        return best
+
+    for fn in functions:
+        spawns = []  # (name, lineno)
+        for stmt in ast.walk(fn):
+            if (
+                isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)
+                and _is_task_spawn(stmt.value)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and innermost_owner(stmt.lineno) is fn
+            ):
+                spawns.append((stmt.targets[0].id, stmt.lineno))
+        for name, lineno in spawns:
+            if name == "_":
+                out.append((path, lineno,
+                            "task handle assigned to _ and dropped — the loop "
+                            "only weak-refs tasks; keep it or use "
+                            "runtime/tasks.spawn_bg"))
+                continue
+            used = False
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and node.id == name
+                    and isinstance(node.ctx, ast.Load)
+                ):
+                    used = True
+                    break
+            if not used:
+                out.append((path, lineno,
+                            f"task handle '{name}' is never used after spawn — "
+                            f"the frame's reference dies and the task can be "
+                            f"GC'd mid-flight; retain it or use "
+                            f"runtime/tasks.spawn_bg"))
+    return out
+
+
+@register("task-lifecycle", "task handles assigned but never retained/observed")
+def _task_lifecycle_pass(ctx: Context) -> Iterator[Finding]:
+    for m in ctx.modules:
+        for p, lineno, msg in leaked_task_handles(m.path, m.tree):
+            yield Finding("TASK-LIFECYCLE", m.path, lineno, msg)
+
+
+_task_lifecycle_pass.RULES = ("TASK-LIFECYCLE",)

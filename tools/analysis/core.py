@@ -1,0 +1,437 @@
+"""Single-parse multi-pass AST analysis framework.
+
+tools/lint.py grew one ad-hoc walker per rule across three PRs; every pass
+re-parsed and re-walked on its own and there was no way to suppress a known
+finding short of editing the pass. This package is the same stdlib-only
+model (``ast`` + ``symtable``, no installs) grown up:
+
+- every file is parsed ONCE into a :class:`Module`; passes share the
+  :class:`Context`;
+- passes register themselves with :func:`register` and yield
+  :class:`Finding` objects carrying a stable rule id;
+- known pre-existing findings live in a checked-in baseline file
+  (``tools/analysis/baseline.txt``) so the gate is zero-NEW-findings;
+- a deliberate violation is silenced in place with an inline
+  ``# dtpu: ignore[RULE]`` comment on the flagged line;
+- ``python -m tools.analysis`` is the CLI (text or ``--json``), exit 0 clean
+  / 1 findings / 2 usage or guard error.
+
+tools/lint.py remains as a thin compatibility shim over this package.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from collections import Counter
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
+
+SEVERITIES = ("error", "warn")
+
+# shared AST vocabulary — single source so sibling passes can't drift:
+# container methods that mutate their receiver in place (ASYNC-RMW write
+# detection and JIT-PURITY trace-time-side-effect detection use the same set)
+MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popleft", "appendleft",
+    "clear", "update", "setdefault", "add", "discard", "popitem",
+})
+
+# receivers whose .create_task keeps only the loop's weak ref; TaskGroup-
+# style and tracker receivers HOLD their tasks and are fine
+SPAWN_RECEIVERS = ("asyncio", "loop", "_loop", "event_loop")
+
+
+def spawn_call_name(call: ast.Call) -> Optional[str]:
+    """``"create_task"``/``"ensure_future"`` if this call spawns a
+    free-flying asyncio task, else None. Shared by DROPPED-TASK (discarded
+    expression) and TASK-LIFECYCLE (dead local) so the two rules always
+    agree on what counts as a spawn."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value
+        recv_name = recv.id if isinstance(recv, ast.Name) else (
+            recv.attr if isinstance(recv, ast.Attribute) else None
+        )
+        if fn.attr == "create_task":
+            return "create_task" if recv_name in SPAWN_RECEIVERS else None
+        if fn.attr == "ensure_future":
+            return "ensure_future"
+        return None
+    if isinstance(fn, ast.Name) and fn.id in ("create_task", "ensure_future"):
+        return fn.id
+    return None
+
+
+class AnalysisError(Exception):
+    """Unusable invocation (bad path, pycache-only package, bad flag) —
+    distinct from findings: the CLI exits 2, never 1, on these."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative when the file is under the repo root
+    line: int          # 1-based; 0 = whole-file finding
+    message: str
+    severity: str = "error"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.rule}: {self.message}"
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        # line numbers deliberately excluded: an unrelated edit above a
+        # baselined finding must not churn the baseline file
+        return (self.rule, self.path, self.message)
+
+    def to_obj(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Module:
+    path: str                  # normalized (repo-relative, "/" separators)
+    src: str
+    tree: ast.AST
+    lines: List[str]
+
+    @property
+    def norm(self) -> str:
+        return self.path
+
+
+class Context:
+    """Everything a pass may look at: the parsed module set."""
+
+    def __init__(self, modules: List[Module]):
+        self.modules = modules
+
+    def module(self, suffix: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.path.endswith(suffix):
+                return m
+        return None
+
+
+# -- pass registry -----------------------------------------------------------
+
+# name -> (fn, description); fn(Context) -> Iterable[Finding]
+_REGISTRY: Dict[str, Tuple[Callable[[Context], Iterable[Finding]], str]] = {}
+
+
+def register(name: str, doc: str = ""):
+    def deco(fn):
+        first_doc_line = ((fn.__doc__ or "").strip().splitlines() or [""])[0]
+        _REGISTRY[name] = (fn, doc or first_doc_line)
+        return fn
+    return deco
+
+
+def registered_passes() -> Dict[str, Tuple[Callable, str]]:
+    _load_builtin_passes()
+    return dict(_REGISTRY)
+
+
+def rule_ids() -> List[str]:
+    """All rule ids any registered pass can emit (passes declare theirs
+    via a ``RULES`` attribute; the pass name is the fallback)."""
+    _load_builtin_passes()
+    out: List[str] = []
+    for name, (fn, _doc) in sorted(_REGISTRY.items()):
+        out.extend(getattr(fn, "RULES", (name,)))
+    return sorted(set(out))
+
+
+def _load_builtin_passes() -> None:
+    # deferred so core is importable without the pass modules (and so the
+    # shim can import pieces without triggering registration twice)
+    from . import asyncpass, legacy, purity  # noqa: F401  # dtpu: ignore[UNUSED-IMPORT] — imported for @register side effects
+
+
+# -- module loading ----------------------------------------------------------
+
+def normalize_path(path: str) -> str:
+    ap = os.path.abspath(path)
+    root = REPO_ROOT + os.sep
+    if ap.startswith(root):
+        ap = ap[len(root):]
+    return ap.replace(os.sep, "/")
+
+
+def iter_source_files(paths: Iterable[str]) -> Iterator[str]:
+    for root in paths:
+        if os.path.isfile(root):
+            yield root
+            continue
+        if not os.path.isdir(root):
+            raise AnalysisError(f"no such file or directory: {root}")
+        py_seen = 0
+        pycache_seen = False
+        files: List[str] = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            if "__pycache__" in dirnames or os.path.basename(dirpath) == "__pycache__":
+                pycache_seen = True
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in sorted(filenames):
+                if f.endswith(".pyc"):
+                    pycache_seen = True
+                # *_pb2.py is protoc output: it builds names via descriptor
+                # metaprogramming that static analysis can't see
+                if f.endswith(".py") and not f.endswith("_pb2.py"):
+                    py_seen += 1
+                    files.append(os.path.join(dirpath, f))
+        if py_seen == 0:
+            if pycache_seen:
+                raise AnalysisError(
+                    f"refusing to analyze {root}: it contains only __pycache__/"
+                    f"*.pyc artifacts (stale orphan of a deleted package?) — "
+                    f"remove the directory or point at real sources"
+                )
+            raise AnalysisError(f"no Python sources under {root}")
+        yield from files
+
+
+def load_modules(paths: Iterable[str]) -> Tuple[List[Module], List[Finding]]:
+    """Parse every file once. Syntax errors become SYNTAX findings rather
+    than aborting the run (one broken file must not hide the rest)."""
+    modules: List[Module] = []
+    findings: List[Finding] = []
+    for path in iter_source_files(paths):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        norm = normalize_path(path)
+        try:
+            tree = ast.parse(src, path)
+        except SyntaxError as e:
+            findings.append(Finding("SYNTAX", norm, e.lineno or 0, str(e.msg)))
+            continue
+        modules.append(Module(norm, src, tree, src.splitlines()))
+    return modules, findings
+
+
+# -- inline suppression ------------------------------------------------------
+
+_IGNORE_RE = re.compile(r"#\s*dtpu:\s*ignore\[([A-Za-z0-9_\-, *]+)\]")
+
+
+def inline_ignored(module: Module, finding: Finding) -> bool:
+    """True when the finding's line carries ``# dtpu: ignore[RULE]`` (or
+    ``[*]``) naming its rule. The comment sits on the flagged line itself."""
+    if not finding.line or finding.line > len(module.lines):
+        return False
+    m = _IGNORE_RE.search(module.lines[finding.line - 1])
+    if m is None:
+        return False
+    rules = {r.strip() for r in m.group(1).split(",")}
+    return "*" in rules or finding.rule in rules
+
+
+# -- baseline ----------------------------------------------------------------
+
+BASELINE_HEADER = (
+    "# tools/analysis baseline — known pre-existing findings, suppressed so the\n"
+    "# gate is zero-NEW-findings. One finding per line: rule<TAB>path<TAB>message.\n"
+    "# Regenerate with: python -m tools.analysis <paths> --write-baseline\n"
+    "# Shrink it whenever you fix one of these for real.\n"
+)
+
+
+def load_baseline(path: str) -> Counter:
+    entries: Counter = Counter()
+    if not os.path.isfile(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split("\t", 2)
+            if len(parts) != 3:
+                raise AnalysisError(f"{path}: malformed baseline line: {line!r}")
+            entries[(parts[0], parts[1], parts[2])] += 1
+    return entries
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    keys = sorted(f.baseline_key() for f in findings)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(BASELINE_HEADER)
+        for rule, p, msg in keys:
+            f.write(f"{rule}\t{p}\t{msg}\n")
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: Counter
+) -> Tuple[List[Finding], List[Finding], Counter]:
+    """(new, suppressed, stale) — multiset semantics: N baselined copies of
+    an identical finding suppress at most N occurrences."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        k = f.baseline_key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            suppressed.append(f)
+        else:
+            new.append(f)
+    stale = Counter({k: n for k, n in budget.items() if n > 0})
+    return new, suppressed, stale
+
+
+# -- driver ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RunResult:
+    new: List[Finding]
+    suppressed: List[Finding]
+    stale: Counter
+    total_raw: int
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+
+def collect_findings(
+    modules: List[Module],
+    parse_findings: List[Finding],
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run every registered pass once over the shared Context; honor inline
+    ignores. ``select`` filters by RULE id (not pass name)."""
+    ctx = Context(modules)
+    by_path = {m.path: m for m in modules}
+    findings: List[Finding] = list(parse_findings)
+    for name, (fn, _doc) in sorted(registered_passes().items()):
+        findings.extend(fn(ctx))
+    if select is not None:
+        wanted = set(select)
+        unknown = wanted - set(rule_ids()) - {"SYNTAX"}
+        if unknown:
+            raise AnalysisError(f"unknown rule id(s): {sorted(unknown)}")
+        findings = [f for f in findings if f.rule in wanted]
+    kept = []
+    for f in findings:
+        m = by_path.get(f.path)
+        if m is not None and inline_ignored(m, f):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+def run(
+    paths: List[str],
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+    select: Optional[Iterable[str]] = None,
+) -> RunResult:
+    modules, parse_findings = load_modules(paths)
+    findings = collect_findings(modules, parse_findings, select)
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+        new, suppressed, stale = apply_baseline(findings, baseline)
+        # an entry is only provably stale if this run could have produced it:
+        # its file was scanned and its rule ran (wasn't filtered by --select)
+        scanned = {m.path for m in modules}
+        wanted = set(select) if select is not None else None
+        stale = Counter(
+            {
+                (r, p, m): n
+                for (r, p, m), n in stale.items()
+                if p in scanned and (wanted is None or r in wanted)
+            }
+        )
+    else:
+        new, suppressed, stale = findings, [], Counter()
+    return RunResult(new=new, suppressed=suppressed, stale=stale, total_raw=len(findings))
+
+
+def render_text(result: RunResult, verbose: bool = False) -> str:
+    out = [f.render() for f in result.new]
+    if result.new:
+        out.append(f"{len(result.new)} finding(s)")
+    if result.suppressed and verbose:
+        out.append(f"{len(result.suppressed)} baselined finding(s) suppressed")
+    for (rule, path, msg), n in sorted(result.stale.items()):
+        out.append(
+            f"note: stale baseline entry ({n}x): {rule}\t{path}\t{msg[:60]} "
+            f"— fixed for real? prune it"
+        )
+    return "\n".join(out)
+
+
+def render_json(result: RunResult) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_obj() for f in result.new],
+            "suppressed": len(result.suppressed),
+            "stale_baseline": [
+                {"rule": r, "path": p, "message": m, "count": n}
+                for (r, p, m), n in sorted(result.stale.items())
+            ],
+        },
+        indent=2,
+    )
+
+
+def main(argv: List[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="Multi-pass AST static analysis (races, blocking calls, "
+        "purity, task lifecycle + the legacy lint rules).",
+    )
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="FILE")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, including baselined ones")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline file from this run's findings")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ns = ap.parse_args(argv)
+
+    try:
+        if ns.list_rules:
+            for r in rule_ids():
+                print(r)
+            return 0
+        paths = ns.paths or [os.path.join(REPO_ROOT, "dynamo_tpu")]
+        select = [s.strip() for s in ns.select.split(",")] if ns.select else None
+        baseline = None if ns.no_baseline else ns.baseline
+        if ns.write_baseline:
+            if select is not None:
+                # write_baseline REPLACES the file; under --select that would
+                # silently drop every other rule's baselined entries
+                print(
+                    "error: --write-baseline with --select would discard "
+                    "baseline entries for the unselected rules — rewrite "
+                    "the full baseline without --select",
+                    file=sys.stderr,
+                )
+                return 2
+            modules, parse_findings = load_modules(paths)
+            findings = collect_findings(modules, parse_findings, select)
+            write_baseline(ns.baseline, findings)
+            print(f"wrote {len(findings)} finding(s) to {ns.baseline}")
+            return 0
+        result = run(paths, baseline_path=baseline, select=select)
+    except AnalysisError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    text = render_json(result) if ns.json else render_text(result, ns.verbose)
+    if text:
+        print(text)
+    return result.exit_code

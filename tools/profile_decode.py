@@ -30,7 +30,6 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dynamo_tpu.models.llama import LlamaConfig, init_params, forward, lm_logits
-from dynamo_tpu.ops import attention as att
 from dynamo_tpu.ops import pallas_attention as pa
 from dynamo_tpu.engine.sampling import sample_tokens
 
