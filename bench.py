@@ -186,6 +186,29 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
     # byte-accounting source); this is the field the int8 acceptance gate
     # reads (int8/bf16 <= 0.55x)
     from dynamo_tpu.kvbm.layout import kv_bytes_per_token
+    # kernel-side deterministic perf gate (ops/costs.py): modeled HBM bytes
+    # of ONE mixed continuous-batching step vs the equivalent split
+    # prefill-chunk + decode-step pair at this bench's shapes. Analytic (no
+    # device), so the number lands in BENCH JSON even when the TPU tunnel
+    # is down; tier-1 asserts the ratio stays <= 1.0.
+    from dynamo_tpu.ops.costs import mixed_vs_split
+
+    kv_itemsize = 1 if kv_dtype == "int8" else 2
+    chunk = min(PROMPT_LEN, cfg.prefill_chunk)
+    kernel_bytes = mixed_vs_split(
+        chunk_len=chunk,
+        chunk_total_len=chunk,
+        decode_seq_lens=[PROMPT_LEN + DECODE_TOKENS // 2] * batch,
+        block_size=cfg.block_size,
+        kv_heads=mcfg.num_kv_heads,
+        num_heads=mcfg.num_heads,
+        head_dim=mcfg.head_dim,
+        max_blocks_per_seq=cfg.max_blocks_per_seq,
+        kv_itemsize=kv_itemsize,
+        quantized=kv_dtype == "int8",
+        bucket=next((b for b in cfg.prefill_buckets if b >= chunk),
+                    cfg.prefill_chunk),
+    )
 
     return {
         "metric": "decode_throughput_qwen3_0.6b_bs%d" % batch,
@@ -206,6 +229,7 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
             "kv_bytes_per_token": kv_bytes_per_token(
                 mcfg, cfg.block_size, kv_dtype
             ),
+            "kernel_bytes": kernel_bytes,
             "step_telemetry": {
                 phase: _phase_summary(samples)
                 for phase, samples in sorted(step_log.items())

@@ -101,6 +101,12 @@ def parse_args():
                         "'KV precision'): int8 = quantized cache w/ "
                         "per-block scales, ~0.51x bf16 KV bytes; auto "
                         "defers to DTPU_KV_DTYPE (default: model dtype)")
+    p.add_argument("--mixed", default="auto", choices=("auto", "on", "off"),
+                   help="mixed continuous batching (docs/operations.md 5c): "
+                        "a prefill chunk fuses with the resident decode "
+                        "batch through the unified ragged kernel; auto "
+                        "defers to DTPU_MIXED (default on, auto-gated off "
+                        "for pp/sp/spec/vision/LoRA/multihost)")
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-context", type=int, default=2048,
                    help="may exceed the largest prefill bucket: long prompts "
@@ -268,6 +274,10 @@ def make_engine_config(args, mcfg, vcfg=None, logits_procs=(), spec_draft=None):
         num_blocks=args.num_blocks,
         block_size=args.block_size,
         kv_dtype=getattr(args, "kv_dtype", "auto"),
+        mixed_admission=(
+            None if getattr(args, "mixed", "auto") == "auto"
+            else getattr(args, "mixed") == "on"
+        ),
         max_batch_size=args.max_batch_size,
         max_context=ctx,
         tp=args.tp,

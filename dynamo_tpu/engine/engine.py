@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import os
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -157,6 +158,16 @@ class TpuEngineConfig:
     # constructed with guided_vocab=(vocab byte forms, eos_id).
     guided_max_states: int = 0
     guided_max_classes: int = 320
+    # mixed continuous batching (ops/pallas_unified + the mixed engine
+    # step): when a prefill chunk and resident decode rows coexist, ONE
+    # fused dispatch serves both — the chunk rides along with the decode
+    # batch through the unified ragged paged-attention kernel instead of
+    # stalling it behind a separate prefill program. None = defer to the
+    # DTPU_MIXED env (default on). Auto-gated off for the paths the fused
+    # program does not cover yet (pp/sp, spec decode, vision, LoRA,
+    # multihost, windowed/softcapped families) — those fall back to the
+    # split prefill/decode dispatches unchanged.
+    mixed_admission: Optional[bool] = None
     # paged-KV storage precision (ops/quant.py; docs/operations.md "KV
     # precision"). "auto" defers to DTPU_KV_DTYPE (default "model" — exactly
     # today's behavior); "int8" stores the cache as int8 with per-block-per-
@@ -461,6 +472,36 @@ class TpuEngine:
                     "windowed/softcapped attention (gpt-oss/gemma) runs the"
                     " pure-JAX paths; the Pallas kernels do not support it"
                 )
+        # whether the Pallas kernels are active for this engine (one
+        # resolution shared by _build_programs and the mixed gate below)
+        self.use_pallas = self._resolve_use_pallas()
+        # mixed continuous batching: a prefill chunk fuses into the decode
+        # batch through ONE program (unified ragged paged attention). The
+        # knob gates intent; the feature additionally requires the plain
+        # text path (the fused program covers neither the pp/sp forwards,
+        # the draft-cache coupling of spec decode, per-token LoRA/vision
+        # splicing, the multihost replay table, nor windowed/sink families)
+        # AND the Pallas kernels by default — on a pure-JAX engine the
+        # fused step would run the O(R*Tq*T) reference attention, slower
+        # than the split dispatches it replaces, so only an EXPLICIT
+        # mixed_admission=True (--mixed on; CPU/interpret tests) forces it.
+        mixed = config.mixed_admission
+        if mixed is None:
+            mixed = os.environ.get("DTPU_MIXED", "1").lower() not in (
+                "0", "", "false", "off"
+            )
+        self.mixed_enabled = bool(
+            mixed
+            and (config.mixed_admission is True or self.use_pallas)
+            and config.pp == 1
+            and config.sp == 1
+            and config.spec_draft is None
+            and config.vision is None
+            and config.lora_max_adapters == 0
+            and multihost is None
+            and not registry.is_gptoss(self.mcfg)
+            and not registry.is_gemma(self.mcfg)
+        )
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         self.allocator = BlockAllocator(config.num_blocks, config.block_size)
@@ -793,6 +834,28 @@ class TpuEngine:
         v = [jax.device_put(zeros(), sharding) for _ in range(mcfg.num_layers)]
         return k, v
 
+    def _resolve_use_pallas(self) -> bool:
+        """cfg.use_pallas, with None resolved to the auto rule: Mosaic DMA
+        slices need the minor dim 128-aligned (head_dim is the page's minor
+        dim, so odd head sizes fall back to pure JAX); the shard_map'd
+        kernel shards the cache on kv_heads, so fewer kv heads than TP
+        shards (MQA / MLA latent) falls back to the GSPMD pure-JAX path;
+        windowed/sink attention families (gpt-oss, gemma) ride the pure-JAX
+        ops. pp serving never uses Pallas (construction rejects the
+        combination)."""
+        if self.cfg.pp > 1:
+            return False
+        if self.cfg.use_pallas is not None:
+            return bool(self.cfg.use_pallas)
+        mcfg = self.mcfg
+        return (
+            jax.default_backend() == "tpu"
+            and mcfg.head_dim % 128 == 0
+            and mcfg.num_kv_heads % meshlib.tp_size(self.mesh) == 0
+            and not registry.is_gptoss(mcfg)
+            and not registry.is_gemma(mcfg)
+        )
+
     def _build_programs_pp(self) -> None:
         """pp>1 programs: same signatures/state layout as _build_programs so
         every call site (and the multihost replay table) is oblivious; the
@@ -961,11 +1024,35 @@ class TpuEngine:
             h = hidden[last_idx].astype(jnp.float32)
             return _fetchable(h / jnp.maximum(jnp.linalg.norm(h), 1e-9))
 
+        def embed_chunk(params, k_caches, v_caches, tokens, positions,
+                        block_table, new_block_ids, total_len, last_idx,
+                        is_final):
+            """Chunked pooled forward through the pipeline: inputs past the
+            largest prefill bucket run like pp chunked prefill — each chunk
+            writes its KV into TEMPORARY pages via the wavefront prefill
+            forward (allocated by the caller, never committed, released
+            after) and attends over the gathered prefix; the final chunk
+            yields the normalized last-token hidden state. Same host-side
+            protocol as the non-pp embed_chunk, so _run_embed is oblivious."""
+            hidden, k2, v2 = pf_fwd(
+                params, k_caches[0], v_caches[0], tokens, positions,
+                block_table, new_block_ids, total_len,
+            )
+            vec = jax.lax.cond(
+                is_final,
+                lambda: (
+                    lambda h: h / jnp.maximum(jnp.linalg.norm(h), 1e-9)
+                )(hidden[last_idx].astype(jnp.float32)),
+                lambda: jnp.zeros((mcfg.hidden_size,), jnp.float32),
+            )
+            return [k2], [v2], _fetchable(vec)
+
         self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2, 3))
         self._decode_fn = jax.jit(decode, donate_argnums=(1, 2, 3))
         self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2, 3))
         self._reset_slot_fn = jax.jit(reset_slot, donate_argnums=(0, 1))
         self._embed_fn = jax.jit(embed)
+        self._embed_chunk_fn = jax.jit(embed_chunk, donate_argnums=(1, 2))
         if self._mh is not None:
             self._wire_multihost()
 
@@ -999,23 +1086,7 @@ class TpuEngine:
                 return fwd(params, mcfg, tokens, positions, attend)
             return fwd(params, mcfg, tokens, positions, attend, **kw)
 
-        use_pallas = cfg.use_pallas
-        if use_pallas is None:
-            # Mosaic DMA slices need the minor dim 128-aligned; head_dim is
-            # the page's minor dim, so odd head sizes fall back to pure JAX.
-            # The shard_map'd kernel also shards the cache on kv_heads, so a
-            # cache with fewer kv heads than TP shards (MQA / MLA latent)
-            # falls back to the GSPMD pure-JAX path.
-            use_pallas = (
-                jax.default_backend() == "tpu"
-                and mcfg.head_dim % 128 == 0
-                and mcfg.num_kv_heads % meshlib.tp_size(self.mesh) == 0
-                # windowed/sink attention (gpt-oss) rides the pure-JAX ops
-                and not registry.is_gptoss(mcfg)
-                # gemma's per-layer window/softcap extras likewise ride the
-                # pure-JAX ops
-                and not registry.is_gemma(mcfg)
-            )
+        use_pallas = self.use_pallas
         if use_pallas:
             from ..ops import pallas_attention as pa
 
@@ -1351,6 +1422,160 @@ class TpuEngine:
             )
             return out + (g_out,) if g_active is not None else out
 
+        if use_pallas:
+            from ..ops import pallas_unified as pun
+
+            def ragged_attention(q, kc, vc, tables, q_starts, q_lens, lens):
+                return pun.sharded_ragged_paged_attention(
+                    self.mesh, meshlib.AXIS_TP, q, kc, vc, tables,
+                    q_starts, q_lens, lens, interpret=interp,
+                )
+        else:
+            ragged_attention = att.ragged_paged_attention
+
+        def mixed_step(params, k_caches, v_caches, counts,
+                       c_tokens, c_positions, c_block_table, c_new_block_ids,
+                       c_total_len, c_chunk_start, c_slot, c_is_final,
+                       c_lp_need,
+                       d_tokens, d_positions, block_tables, d_seq_lens,
+                       d_write_blocks, d_write_offsets,
+                       seeds, steps, temps, top_ks, top_ps, min_ps, pres,
+                       freqs, reps, prompt_masks, lp_need, lora_tables,
+                       lora_ids, proc_masks,
+                       g_active=None, g_state=None, c_g_state=None,
+                       g_class=None, g_trans=None):
+            """ONE fused continuous-batching step: a prefill chunk of one
+            sequence (c_* args — the prefill() conventions) rides along with
+            the resident decode batch (d_* args — the decode() conventions)
+            through a single forward. The packed token buffer is
+            [S_pad + B]: the chunk's bucketed tokens first, then one decode
+            token per slot; attention is ONE unified ragged launch where row
+            0 is the chunk (query_len = chunk_len) and rows 1..B are the
+            decode slots (query_len = 1, or 0 when inactive). Sampling
+            epilogues are copied verbatim from prefill()/decode() so mixed
+            steps are token-identical to the split dispatches."""
+            S_pad = c_tokens.shape[0]
+            B = d_tokens.shape[0]
+            chunk_len = c_total_len - c_chunk_start
+            tokens = jnp.concatenate([c_tokens, d_tokens])
+            positions = jnp.concatenate([c_positions, d_positions])
+            active = d_seq_lens > 0
+
+            def attend(q, k_new, v_new, layer_idx, **extra):
+                # extra stays empty: mixed is gated off for windowed/sink
+                # families at engine construction
+                kc, vc = k_caches[layer_idx], v_caches[layer_idx]
+                k_c, v_c = k_new[:S_pad], v_new[:S_pad]
+                if quantized:
+                    # same pad-row zeroing as the prefill attend: bucket
+                    # padding must not enter the per-block quantize amax
+                    validc = (c_positions < c_total_len)[:, None, None]
+                    k_c = jnp.where(validc, k_c, 0.0)
+                    v_c = jnp.where(validc, v_c, 0.0)
+                kc, vc = att.write_prefill_kv(
+                    kc, vc, k_c, v_c, c_new_block_ids
+                )
+                kc, vc = att.write_decode_kv(
+                    kc, vc, k_new[S_pad:], v_new[S_pad:],
+                    d_write_blocks, d_write_offsets,
+                )
+                k_caches[layer_idx], v_caches[layer_idx] = kc, vc
+                tables = jnp.concatenate(
+                    [c_block_table[None], block_tables], axis=0
+                )
+                q_starts = jnp.concatenate([
+                    jnp.zeros((1,), jnp.int32),
+                    S_pad + jnp.arange(B, dtype=jnp.int32),
+                ])
+                q_lens = jnp.concatenate([
+                    chunk_len[None].astype(jnp.int32),
+                    active.astype(jnp.int32),
+                ])
+                row_lens = jnp.concatenate([
+                    c_total_len[None].astype(jnp.int32),
+                    d_seq_lens.astype(jnp.int32),
+                ])
+                return ragged_attention(
+                    q, kc, vc, tables, q_starts, q_lens, row_lens
+                )
+
+            hidden = call_fwd(
+                params, tokens, positions, attend, lora_tables, lora_ids
+            )  # [S_pad + B, H]
+
+            # -- decode epilogue: verbatim decode() ---------------------------
+            logits = logits_fn(params, mcfg, hidden[S_pad:])  # [B, V]
+            pen = apply_penalties(
+                logits, counts, prompt_masks, pres, freqs, reps
+            )
+            pen = run_procs(pen, proc_masks, counts, steps, d_seq_lens)
+            if g_active is not None:
+                pen = gmask(pen, g_active, g_state, g_class, g_trans)
+            toks = sample_tokens(
+                pen, seeds, steps, temps, top_ks, top_ps, min_ps
+            )
+            counts = update_counts(
+                counts, toks, active,
+                counts_need(pres, freqs, reps, proc_masks),
+            )
+            lps = logprobs_of(logits, toks)
+            tlp_vals, tlp_ids = top_logprobs(logits, lp_need)
+
+            # -- chunk epilogue: verbatim prefill() (slot-sliced args) --------
+            def sample_branch(counts):
+                last_idx = jnp.argmax(c_positions == c_total_len - 1)
+                logits1 = logits_fn(params, mcfg, hidden[last_idx][None])
+                pen1 = apply_penalties(
+                    logits1, jnp.zeros_like(logits1, jnp.int32),
+                    prompt_masks[c_slot][None], pres[c_slot][None],
+                    freqs[c_slot][None], reps[c_slot][None],
+                )
+                pen1 = run_procs(
+                    pen1, proc_masks[c_slot][None], counts[c_slot][None],
+                    jnp.zeros((1,), jnp.int32), c_total_len[None],
+                )
+                if g_active is not None:
+                    pen1 = gmask(
+                        pen1, g_active[c_slot][None],
+                        jnp.full((1,), c_g_state, jnp.int32),
+                        g_class[c_slot][None], g_trans[c_slot][None],
+                    )
+                tok1 = sample_tokens(
+                    pen1, seeds[c_slot][None], jnp.zeros((1,), jnp.int32),
+                    temps[c_slot][None], top_ks[c_slot][None],
+                    top_ps[c_slot][None], min_ps[c_slot][None],
+                )
+                counts = jax.lax.cond(
+                    counts_need(
+                        pres[c_slot][None], freqs[c_slot][None],
+                        reps[c_slot][None], proc_masks[c_slot][None],
+                    ),
+                    lambda c: c.at[c_slot, tok1[0]].add(1),
+                    lambda c: c,
+                    counts,
+                )
+                lp1 = logprobs_of(logits1, tok1)
+                tlp_vals1, tlp_ids1 = top_logprobs(logits1, c_lp_need)
+                return counts, tok1[0], lp1[0], tlp_vals1[0], tlp_ids1[0]
+
+            def no_sample(counts):
+                K = TOP_LOGPROBS_K
+                return (
+                    counts, jnp.int32(0), jnp.float32(0.0),
+                    jnp.zeros((K,), jnp.float32), jnp.zeros((K,), jnp.int32),
+                )
+
+            counts, c_tok, c_lp, c_tlp_vals, c_tlp_ids = jax.lax.cond(
+                c_is_final, sample_branch, no_sample, counts
+            )
+            toks, lps, tlp_vals, tlp_ids, c_tok, c_lp, c_tlp_vals, c_tlp_ids = map(
+                _fetchable,
+                (toks, lps, tlp_vals, tlp_ids, c_tok, c_lp, c_tlp_vals,
+                 c_tlp_ids),
+            )
+            return (k_caches, v_caches, counts, toks, lps, tlp_vals, tlp_ids,
+                    c_tok, c_lp, c_tlp_vals, c_tlp_ids)
+
         def reset_slot(prompt_masks, counts, slot, row):
             return prompt_masks.at[slot].set(row), counts.at[slot].set(0)
 
@@ -1607,6 +1832,7 @@ class TpuEngine:
             )
 
         self._embed_chunk_fn = jax.jit(embed_chunk, donate_argnums=(1, 2))
+        self._mixed_fn = jax.jit(mixed_step, donate_argnums=(1, 2, 3))
         self._prefill_fn = jax.jit(prefill, donate_argnums=(1, 2, 3))
         self._decode_fn = jax.jit(decode, donate_argnums=(1, 2, 3))
         self._decode_multi_fn = jax.jit(decode_multi, donate_argnums=(1, 2, 3))
@@ -1907,17 +2133,6 @@ class TpuEngine:
             raise ValueError(
                 f"prompt {n_prompt} tokens exceeds engine max_context "
                 f"{self.cfg.max_context}"
-            )
-        if (
-            req.annotations.get("op") == "embed"
-            and len(req.token_ids) > self.cfg.prefill_chunk
-            and self.cfg.pp > 1
-        ):
-            # the pp pooled forward is a single dense dispatch (no paged
-            # chunk variant yet); non-pp chunks below
-            raise ValueError(
-                f"embedding input {len(req.token_ids)} tokens exceeds the "
-                f"largest prefill bucket {self.cfg.prefill_chunk} (pp engine)"
             )
         if n_prompt // self.cfg.block_size + 2 > self.cfg.num_blocks:
             # would wait forever in admission — no amount of eviction frees
@@ -2532,6 +2747,8 @@ class TpuEngine:
                     if s is not None and not s.done and not s.prefilled
                     and not s.prefill_inflight
                 ]
+                did_mixed = False
+                mixed_blocked = False
                 if prefilling:
                     pick = prefilling[self._prefill_rr % len(prefilling)]
                     self._prefill_rr += 1
@@ -2547,11 +2764,40 @@ class TpuEngine:
                         if pick.t_prefill_start == 0:
                             pick.t_prefill_start = time.time_ns()
                         chunk_from = pick.prefill_pos
+                        # mixed continuous batching: when decode rows are
+                        # resident (and no horizon is in flight to carry
+                        # stale device state past the fused step), the chunk
+                        # rides along with ONE decode step in a single
+                        # program — decode never stalls behind the prefill
+                        mixed_seqs = None
+                        if self.mixed_enabled and not self._chains:
+                            snap = self._decode_snapshot()
+                            if any(s is not None for s in snap):
+                                if self._prepare_mixed(snap):
+                                    mixed_seqs = snap
+                                else:
+                                    # booking failed (block pressure /
+                                    # context headroom): this prefill runs
+                                    # split, and horizons must keep
+                                    # pipelining rather than wait for a
+                                    # fused step that cannot book
+                                    mixed_blocked = True
                         t_step = time.perf_counter()
-                        res = await loop.run_in_executor(
-                            self._executor, self._run_prefill_chunk, pick
-                        )
-                        self._commit_prefilled_blocks(pick)
+                        if mixed_seqs is not None:
+                            results, res = await loop.run_in_executor(
+                                self._executor, self._run_mixed_step, pick,
+                                mixed_seqs,
+                            )
+                            did_mixed = True
+                            self._commit_prefilled_blocks(pick)
+                            for rst, tok, lp, tids, tvals in results:
+                                self._accept_token(rst, tok, lp, tids, tvals)
+                        else:
+                            results = []
+                            res = await loop.run_in_executor(
+                                self._executor, self._run_prefill_chunk, pick
+                            )
+                            self._commit_prefilled_blocks(pick)
                         if res is not None:
                             fut = self._fetch_executor.submit(
                                 self._fetch_prefill_result, *res
@@ -2562,10 +2808,11 @@ class TpuEngine:
                             self._prefill_tasks.add(task)
                             task.add_done_callback(self._prefill_tasks.discard)
                         self._step_stats(
-                            "prefill", time.perf_counter() - t_step,
-                            pick.prefill_pos - chunk_from,
+                            "mixed" if mixed_seqs is not None else "prefill",
+                            time.perf_counter() - t_step,
+                            (pick.prefill_pos - chunk_from) + len(results),
                         )
-                        mark("prefill")
+                        mark("mixed" if mixed_seqs is not None else "prefill")
                 has_active = any(
                     s is not None and not s.done and s.prefilled
                     for s in self._slots
@@ -2575,9 +2822,21 @@ class TpuEngine:
                 # the in-flight horizons' device compute. Dispatch runs on
                 # the executor: the first call jit-compiles (30-90s cold)
                 # and must not stall the event loop's lease heartbeats.
+                # while a mixed-eligible prefill is in progress, the pipeline
+                # is NOT topped up: in-flight chains drain (their carry
+                # predates the fused step's cache writes), and once empty
+                # every tick runs one fused chunk+decode step until the
+                # prefill completes — decode keeps advancing, prefill keeps
+                # chunking, nothing stalls
+                mixed_wait = (
+                    self.mixed_enabled and bool(prefilling) and has_active
+                    and not mixed_blocked
+                )
                 while (
                     has_active
                     and not self._waiting
+                    and not did_mixed
+                    and not mixed_wait
                     and len(self._chains) < self.cfg.decode_pipeline
                     and (not self._chains or self._can_chain(self._chains[-1]))
                     and self._prepare_horizon(depth=len(self._chains) + 1)
@@ -2605,7 +2864,7 @@ class TpuEngine:
                         - emitted_before,
                     )
                     mark("apply")
-                elif has_active:
+                elif has_active and not did_mixed:
                     t_step = time.perf_counter()
                     results = await loop.run_in_executor(
                         self._executor, self._run_decode, self._decode_snapshot()
@@ -3058,25 +3317,96 @@ class TpuEngine:
             )
         return np.asarray(vec)
 
-    def _prepare_horizon(self, depth: int = 1) -> bool:
-        """Pre-allocate pages so every active sequence can absorb ``depth``
-        more decode horizons (depth=2 when dispatching on top of an in-flight
-        chain). False => fall back to the single-step program (block pressure
-        or a sequence within a horizon of max_context)."""
-        n = self.cfg.decode_steps
-        if n <= 1:
-            return False
+    def _run_mixed_step(self, st: _Seq, seqs: List[Optional["_Seq"]]):
+        """Executor thread: ONE fused dispatch serving st's next prefill
+        chunk AND a single decode step for the ``seqs`` snapshot (the mixed
+        continuous-batching step; engine _build_programs mixed_step).
+        Returns (decode acceptance tuples like _run_decode's, prefill
+        result tuple like _run_prefill_chunk's or None for intermediate
+        chunks)."""
+        prompt = st.seq.tokens()
+        start = st.prefill_pos
+        remaining = len(prompt) - start
+        cap = self.cfg.prefill_chunk
+        is_final = remaining <= cap
+        chunk_len = remaining if is_final else cap
+        tokens, positions, new_block_ids = self._chunk_arrays(
+            prompt, start, chunk_len, st.block_ids
+        )
+        (d_positions, d_seq_lens, write_blocks, write_offsets, steps) = (
+            self._decode_dispatch_arrays(seqs)
+        )
+        lp_need = bool(np.any((self._lp_ns > 0) & (d_seq_lens > 0)))
+        c_lp_need = self._lp_ns[st.slot] > 0
+        _j = self._j
+        g_args = ()
+        if self.guided_enabled:
+            # decode rows resync the host FSM states (mixed steps are never
+            # chained); the chunk row's state travels by value like prefill
+            g_active, g_class, g_trans = self._guided_dev()
+            g_args = (
+                g_active, _j(self._g_state.copy()),
+                _j(np.int32(st.guided_state)), g_class, g_trans,
+            )
+        (self.k_caches, self.v_caches, self.output_counts, toks, lps,
+         tlp_vals, tlp_ids, c_tok, c_lp, c_tlp_vals, c_tlp_ids) = (
+            self._mixed_fn(
+                self.params, self.k_caches, self.v_caches, self.output_counts,
+                _j(tokens), _j(positions),
+                _j(self._block_tables[st.slot]), _j(new_block_ids),
+                _j(np.int32(start + chunk_len)), _j(np.int32(start)),
+                _j(np.int32(st.slot)), _j(np.bool_(is_final)),
+                _j(np.bool_(c_lp_need)),
+                _j(self._tokens), _j(d_positions),
+                _j(self._block_tables), _j(d_seq_lens),
+                _j(write_blocks), _j(write_offsets),
+                _j(self._seeds), _j(steps),
+                _j(self._temps), _j(self._top_ks), _j(self._top_ps),
+                _j(self._min_ps), _j(self._pres), _j(self._freqs),
+                _j(self._reps),
+                self.prompt_masks, _j(np.bool_(lp_need)),
+                self._lora_tables(), _j(self._lora_slots),
+                self._dev("proc_masks", self._lp_masks),
+                *g_args,
+            )
+        )
+        st.prefill_pos = start + chunk_len
+        results = self._decode_results(seqs, toks, lps, tlp_ids, tlp_vals,
+                                       lp_need)
+        prefill_res = None
+        if is_final:
+            # same async-readback protocol as _run_prefill_chunk: the loop
+            # hands these to the fetch pool so the D2H RTT overlaps
+            st.prefill_inflight = True
+            c_tok.copy_to_host_async()
+            c_lp.copy_to_host_async()
+            prefill_res = (st, c_tok, c_lp,
+                           c_tlp_ids if c_lp_need else None,
+                           c_tlp_vals if c_lp_need else None)
+        return results, prefill_res
+
+    def _book_decode_blocks(
+        self, seqs: List[Optional["_Seq"]], extra_tokens: int
+    ) -> bool:
+        """Pre-allocate pages so every active (prefilled, unfinished)
+        sequence in ``seqs`` can absorb ``extra_tokens`` more decode tokens.
+        All-or-nothing: on any failure (context headroom, block pressure)
+        every block this call took is given back — otherwise the fallback
+        path itself starves (the blocks would sit idle until finish). The
+        one booking routine behind both the horizon dispatch
+        (_prepare_horizon) and the fused mixed step (_prepare_mixed), so
+        the split and fused paths can never drift."""
         bs = self.cfg.block_size
         granted: List[Tuple[_Seq, int]] = []  # rollback on partial failure
         ok = True
-        for st in self._slots:
+        for st in seqs:
             if st is None or st.done or not st.prefilled:
                 continue
             L = len(st.seq)
-            if L + depth * n >= self.cfg.max_context:
+            if L + extra_tokens >= self.cfg.max_context:
                 ok = False
                 break
-            needed = (L + depth * n) // bs + 1
+            needed = (L + extra_tokens) // bs + 1
             extra = needed - len(st.block_ids)
             if extra > 0:
                 if not self.allocator.can_allocate(extra):
@@ -3092,14 +3422,29 @@ class TpuEngine:
                     self._block_tables[st.slot, len(st.block_ids) - 1] = bid
                 granted.append((st, len(new_ids)))
         if not ok:
-            # under pressure: give back what this call took, or the fallback
-            # path itself starves (the blocks would sit idle until finish)
             for st, count in granted:
                 taken = st.block_ids[-count:]
                 del st.block_ids[-count:]
                 self.allocator.release(taken)
             return False
         return True
+
+    def _prepare_mixed(self, seqs: List[Optional["_Seq"]]) -> bool:
+        """Book a mixed step: the chunk's pages were booked at admission
+        (_try_admit allocates the whole prompt), so this books the DECODE
+        half — every active row gets headroom for the one token the fused
+        step advances. False => fall back to the split prefill dispatch."""
+        return self._book_decode_blocks(seqs, 1)
+
+    def _prepare_horizon(self, depth: int = 1) -> bool:
+        """Pre-allocate pages so every active sequence can absorb ``depth``
+        more decode horizons (depth=2 when dispatching on top of an in-flight
+        chain). False => fall back to the single-step program (block pressure
+        or a sequence within a horizon of max_context)."""
+        n = self.cfg.decode_steps
+        if n <= 1:
+            return False
+        return self._book_decode_blocks(self._slots, depth * n)
 
     def _lora_tables(self):
         return self.lora.tables() if self.lora is not None else {}
@@ -3462,13 +3807,20 @@ class TpuEngine:
             self.spec_stats["emitted"] += len(toks)
             self._accept_tokens(st, toks, lps, None, None)
 
-    def _run_decode(self, seqs: List[Optional["_Seq"]]) -> List[Tuple[_Seq, int, float]]:
+    def _decode_dispatch_arrays(self, seqs: List[Optional["_Seq"]]):
+        """Per-slot host arrays for ONE decode step over the ``seqs``
+        snapshot — shared by _run_decode and _run_mixed_step so the
+        write-block math and carry conventions can never drift between the
+        split and fused paths. Also refreshes self._tokens with each row's
+        fed token. Returns (positions, seq_lens, write_blocks,
+        write_offsets, steps), all [B]."""
         bs = self.cfg.block_size
         B = self.cfg.max_batch_size
-        write_blocks = np.zeros(B, np.int32)
-        write_offsets = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         seq_lens = np.zeros(B, np.int32)
+        write_blocks = np.zeros(B, np.int32)
+        write_offsets = np.zeros(B, np.int32)
+        steps = np.zeros(B, np.int32)
         for i, st in enumerate(seqs):
             if st is None:
                 continue
@@ -3476,15 +3828,36 @@ class TpuEngine:
             positions[i] = L - 1
             seq_lens[i] = L
             self._tokens[i] = st.last_token
-            blk = (L - 1) // bs
-            write_blocks[i] = st.block_ids[blk]
+            write_blocks[i] = st.block_ids[(L - 1) // bs]
             write_offsets[i] = (L - 1) % bs
+            steps[i] = st.produced
+        return positions, seq_lens, write_blocks, write_offsets, steps
 
-        steps = np.zeros(B, np.int32)
+    def _decode_results(self, seqs: List[Optional["_Seq"]], toks, lps,
+                        tlp_ids, tlp_vals, lp_need: bool):
+        """Device outputs of one decode step -> per-sequence acceptance
+        tuples (shared by _run_decode and _run_mixed_step)."""
+        toks_np = np.asarray(toks)
+        lps_np = np.asarray(lps)
+        tlp_ids_np = np.asarray(tlp_ids) if lp_need else None
+        tlp_vals_np = np.asarray(tlp_vals) if lp_need else None
+        results = []
         for i, st in enumerate(seqs):
-            if st is not None:
-                steps[i] = st.produced
+            if st is None:
+                continue
+            if self._lp_ns[i] > 0 and tlp_ids_np is not None:
+                results.append((st, int(toks_np[i]), float(lps_np[i]),
+                                tlp_ids_np[i], tlp_vals_np[i]))
+            else:
+                results.append(
+                    (st, int(toks_np[i]), float(lps_np[i]), None, None)
+                )
+        return results
 
+    def _run_decode(self, seqs: List[Optional["_Seq"]]) -> List[Tuple[_Seq, int, float]]:
+        (positions, seq_lens, write_blocks, write_offsets, steps) = (
+            self._decode_dispatch_arrays(seqs)
+        )
         lp_need = bool(np.any((self._lp_ns > 0) & (seq_lens > 0)))
         _j = self._j
         g_args = ()
@@ -3509,20 +3882,8 @@ class TpuEngine:
             self._dev("proc_masks", self._lp_masks),
             *g_args,
         )
-        toks_np = np.asarray(toks)
-        lps_np = np.asarray(lps)
-        tlp_ids_np = np.asarray(tlp_ids) if lp_need else None
-        tlp_vals_np = np.asarray(tlp_vals) if lp_need else None
-        results = []
-        for i, st in enumerate(seqs):
-            if st is None:
-                continue
-            if self._lp_ns[i] > 0 and tlp_ids_np is not None:
-                results.append((st, int(toks_np[i]), float(lps_np[i]),
-                                tlp_ids_np[i], tlp_vals_np[i]))
-            else:
-                results.append((st, int(toks_np[i]), float(lps_np[i]), None, None))
-        return results
+        return self._decode_results(seqs, toks, lps, tlp_ids, tlp_vals,
+                                    lp_need)
 
     # -- host-side token bookkeeping -----------------------------------------
     def _accept_token(

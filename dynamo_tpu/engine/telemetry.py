@@ -1,7 +1,10 @@
 """Engine step telemetry: a cheap per-step stats hook + Prometheus projection.
 
 The engine loop hands a ``StepStats`` to ``engine.stats_hook`` after every
-prefill chunk and every consumed decode horizon. The stats are host-side
+prefill chunk, every consumed decode horizon, and every fused ``mixed``
+continuous-batching step (one prefill chunk riding along with a decode step
+through the unified ragged kernel — its batch_occupancy shows how full the
+fused launch ran). The stats are host-side
 scalars read off bookkeeping the loop already maintains — the hook NEVER
 touches jit-traced code or forces a device sync (durations are host wall
 time around executor calls; token counts come from ``_accept_tokens``'s own
@@ -39,11 +42,12 @@ _TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096)
 class StepStats:
     """One engine-loop step, observed host-side."""
 
-    phase: str                 # "prefill" | "decode"
+    phase: str                 # "prefill" | "decode" | "mixed"
     duration_s: float          # host wall time of the step's dispatch/consume
     batch_occupancy: int       # active (admitted, unfinished) slots
     batch_size: int            # configured max batch width
     tokens: int                # tokens processed: prefill chunk len / emitted
+                               # ("mixed" fused steps count chunk + decode)
     queue_depth: int           # admission queue length (waiting requests)
     kv_active_blocks: int
     kv_free_blocks: int

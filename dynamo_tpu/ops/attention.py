@@ -326,6 +326,64 @@ def write_decode_kv(
     )
 
 
+def ragged_paged_attention(
+    q: jax.Array,             # [Tq, h, d] densely packed ragged queries
+    k_cache: jax.Array,       # [num_blocks, bs, kvh, d] (or QuantizedKV)
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [R, max_blocks] int32
+    q_starts: jax.Array,      # [R] int32 offset of row r's segment in q
+    q_lens: jax.Array,        # [R] int32 segment length (0 = empty row)
+    seq_lens: jax.Array,      # [R] int32 context length incl. the row's
+                              #     q_lens new tokens
+    window: Optional[int] = None,
+    sinks: Optional[jax.Array] = None,
+    softcap: Optional[float] = None,
+) -> jax.Array:
+    """Unified ragged paged attention, pure-JAX reference twin of
+    ``ops.pallas_unified.ragged_paged_attention``.
+
+    One call serves an arbitrary mix of prefill chunks and decode tokens:
+    each ROW r owns the query tokens ``q[q_starts[r] : q_starts[r]+q_lens[r]]``
+    (its new tokens, sitting at the TAIL of its context — token i of the
+    segment is at absolute position ``seq_lens[r] - q_lens[r] + i``) and
+    attends causally over its own pages. A decode row is ``q_len == 1``; a
+    prefill chunk is ``q_len == chunk_len``. Segments must be disjoint (gaps
+    are fine — padding rows between segments belong to no row); ``q_len <=
+    seq_len`` per row. Tokens outside every segment, and rows with
+    ``q_len == 0`` or ``seq_len == 0`` (inactive slots), return ZEROS.
+
+    This is the numerics reference the Pallas unified kernel pins against in
+    interpret mode; the engine's mixed prefill+decode step uses it directly
+    when ``use_pallas`` is off. O(R * Tq * T) — every row scores the whole
+    packed buffer and masks — so it is a reference, not a fast path."""
+    Tq = q.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    idx = jnp.arange(Tq)
+
+    def one(table, q_start, q_len, seq_len):
+        k, v = gather_kv(k_cache, v_cache, table)   # [T, kvh, d]
+        local = idx - q_start
+        member = (local >= 0) & (local < q_len) & (seq_len > 0)
+        q_pos = seq_len - q_len + local
+        scores = _softcap(_gqa_scores(q, k) * scale, softcap)  # [Tq, h, T]
+        key_pos = jnp.arange(k.shape[0])
+        lim = jnp.minimum(q_pos + 1, seq_len)
+        valid = key_pos[None, :] < lim[:, None]
+        if window is not None:
+            valid &= key_pos[None, :] > q_pos[:, None] - window
+        scores = jnp.where(valid[:, None, :], scores, NEG_INF)
+        if sinks is None:
+            weights = jax.nn.softmax(scores, axis=-1)
+        else:
+            weights = _sink_softmax(scores, sinks.astype(jnp.float32))
+        out = _gqa_values(weights, v)               # [Tq, h, d] f32
+        return jnp.where(member[:, None, None], out, 0.0)
+
+    outs = jax.vmap(one)(block_tables, q_starts, q_lens, seq_lens)
+    # segments are disjoint, so summing the per-row masked outputs packs them
+    return jnp.sum(outs, axis=0).astype(q.dtype)
+
+
 def paged_extend_attention(
     q: jax.Array,             # [B, S_new, h, d] candidate-token queries
     k_cache: jax.Array,       # [num_blocks, bs, kvh, d]

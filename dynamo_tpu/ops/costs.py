@@ -1,0 +1,249 @@
+"""Deterministic kernel-side perf accounting: FLOP/HBM-byte counts.
+
+The device bench is unreliable on this image (BENCH_NOTES: one clean
+datapoint in five runs), so kernel work iterates against MODELED bytes
+instead of measured seconds — the kernel-side half of the ROADMAP item 5
+perf gate. Two complementary sources:
+
+- :func:`jaxpr_counts` traces a jitted fn and walks the jaxpr, tallying
+  MXU FLOPs (``dot_general``) and memory-moving op bytes (gather / scatter /
+  dynamic slices / concatenate) op by op. ``pallas_call`` eqns are opaque to
+  XLA's view of bytes (the kernel drives its own DMAs), so they are
+  surfaced as entries for the caller to price with the analytic models;
+- the analytic models below price the paged-attention DMA traffic of the
+  three Pallas kernels exactly — pages touched, scale rows, q/o streams,
+  and the gather copies the split path pays that the unified kernel does
+  not — parameterized by the concrete per-row (query_len, seq_len) mix.
+
+``bench.py`` folds :func:`mixed_vs_split` into BENCH JSON as
+``detail.kernel_bytes`` and ``tests/test_unified_attention.py`` gates
+mixed <= split on every PR, so a byte regression in the unified path fails
+tier-1 without any hardware in the loop.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, List, Sequence, Tuple
+
+SCALE_BYTES = 4  # f32 per-block-per-kv-head scale rows (ops/quant.py)
+
+# primitives whose cost is dominated by the bytes they move; priced as
+# sum of operand + result nbytes
+_MEMORY_PRIMS = {
+    "gather", "scatter", "scatter-add", "dynamic_slice",
+    "dynamic_update_slice", "concatenate", "take", "take_along_axis",
+}
+
+
+# --------------------------------------------------------------- jaxpr walk
+def _aval_bytes(aval) -> int:
+    try:
+        return int(math.prod(aval.shape)) * aval.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _dot_flops(eqn) -> int:
+    """2*M*N*K (times batch) for one dot_general."""
+    (lhs, rhs) = eqn.invars[:2]
+    dims = eqn.params["dimension_numbers"]
+    (lc, rc), (lb, rb) = dims
+    lshape = lhs.aval.shape
+    rshape = rhs.aval.shape
+    contract = math.prod(lshape[i] for i in lc) if lc else 1
+    batch = math.prod(lshape[i] for i in lb) if lb else 1
+    m = math.prod(
+        s for i, s in enumerate(lshape) if i not in lc and i not in lb
+    )
+    n = math.prod(
+        s for i, s in enumerate(rshape) if i not in rc and i not in rb
+    )
+    return 2 * batch * m * n * contract
+
+
+def _walk(jaxpr, acc: Dict[str, Any]) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            f = _dot_flops(eqn)
+            acc["flops"] += f
+            acc["by_op"][name] = acc["by_op"].get(name, 0) + f
+        elif name == "pallas_call":
+            info = eqn.params.get(
+                "name_and_src_info", eqn.params.get("name", "")
+            )
+            acc["pallas_calls"].append({
+                "name": str(info).split(" at ")[0] or "pallas_call",
+                "in_shapes": [tuple(v.aval.shape) for v in eqn.invars],
+                "out_shapes": [tuple(v.aval.shape) for v in eqn.outvars],
+            })
+        elif name in _MEMORY_PRIMS:
+            b = sum(_aval_bytes(v.aval) for v in eqn.invars)
+            b += sum(_aval_bytes(v.aval) for v in eqn.outvars)
+            acc["hbm_bytes"] += b
+            acc["by_op"][name] = acc["by_op"].get(name, 0) + b
+        # recurse into sub-jaxprs (jit/scan/cond/while/shard_map bodies)
+        for sub in eqn.params.values():
+            if hasattr(sub, "jaxpr"):
+                inner = sub.jaxpr if hasattr(sub.jaxpr, "eqns") else sub
+                _walk(inner, acc)
+            elif isinstance(sub, (list, tuple)):
+                for s in sub:
+                    if hasattr(s, "jaxpr"):
+                        _walk(s.jaxpr, acc)
+
+
+def jaxpr_counts(fn, *args, **kwargs) -> Dict[str, Any]:
+    """Trace ``fn(*args, **kwargs)`` and return op-level cost tallies:
+    ``{"flops", "hbm_bytes", "by_op", "pallas_calls"}``. FLOPs come from
+    dot_general shapes; hbm_bytes from memory-moving primitives;
+    ``pallas_calls`` lists the opaque kernel launches for the caller to
+    price with the analytic models (their DMA traffic is invisible to the
+    jaxpr)."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    acc: Dict[str, Any] = {
+        "flops": 0, "hbm_bytes": 0, "by_op": {}, "pallas_calls": [],
+    }
+    _walk(closed.jaxpr, acc)
+    return acc
+
+
+# ------------------------------------------------------- analytic DMA models
+def _pages(seq_len: int, bs: int) -> int:
+    return -(-max(int(seq_len), 0) // bs)
+
+
+def unified_attention_bytes(
+    rows: Sequence[Tuple[int, int]],   # (query_len, seq_len) per row
+    *,
+    block_size: int,
+    kv_heads: int,
+    num_heads: int,
+    head_dim: int,
+    kv_itemsize: int = 2,              # bf16 pages; 1 for int8
+    q_itemsize: int = 2,
+    quantized: bool = False,
+) -> int:
+    """HBM bytes one unified ragged launch moves (ops/pallas_unified):
+    each active row's REAL pages stream once per kv head as per-head slices
+    (total = the full page bytes), plus int8 scale rows, plus the packed
+    q read and o write. No gather, no per-q-tile context re-read."""
+    total_q = sum(max(q, 0) for q, _ in rows)
+    kv = 0
+    for q_len, seq_len in rows:
+        if q_len <= 0 or seq_len <= 0:
+            continue
+        p = _pages(seq_len, block_size)
+        kv += 2 * p * block_size * kv_heads * head_dim * kv_itemsize
+        if quantized:
+            # the kernel DMAs the full [kvh] scale row per page per kv head
+            kv += 2 * p * kv_heads * kv_heads * SCALE_BYTES
+    qo = 2 * total_q * num_heads * head_dim * q_itemsize
+    return kv + qo
+
+
+def split_prefill_bytes(
+    chunk_len: int,
+    total_len: int,
+    table_blocks: int,                 # gather width: max_blocks_per_seq
+    *,
+    block_size: int,
+    kv_heads: int,
+    num_heads: int,
+    head_dim: int,
+    kv_itemsize: int = 2,
+    q_itemsize: int = 2,
+    quantized: bool = False,
+    q_tile: int = 128,
+    bucket: int = None,
+) -> int:
+    """HBM bytes the SPLIT prefill path moves for one chunk: gather_kv
+    materializes the FULL padded table (read + write, both K and V), then
+    the flash-extend kernel streams the gathered context once per q tile
+    (its grid re-reads every kv tile for each of the chunk's q tiles),
+    plus the q read / o write at the bucketed width."""
+    del total_len  # the split gather width is the PADDED table, not the
+    # real context — that is exactly the waste being priced
+    S_pad = bucket if bucket is not None else chunk_len
+    T = table_blocks * block_size
+    ctx_elems = T * kv_heads * head_dim
+    gather = 2 * 2 * ctx_elems * kv_itemsize      # K+V, read+write
+    if quantized:
+        gather += 2 * 2 * table_blocks * kv_heads * SCALE_BYTES
+    nq = -(-S_pad // q_tile)
+    kernel_kv = 2 * nq * ctx_elems * kv_itemsize
+    if quantized:
+        # per-position scale columns stream with the tiles
+        kernel_kv += 2 * nq * T * kv_heads * SCALE_BYTES
+    qo = 2 * S_pad * num_heads * head_dim * q_itemsize
+    return gather + kernel_kv + qo
+
+
+def split_decode_bytes(
+    seq_lens: Iterable[int],
+    *,
+    block_size: int,
+    kv_heads: int,
+    num_heads: int,
+    head_dim: int,
+    kv_itemsize: int = 2,
+    q_itemsize: int = 2,
+    quantized: bool = False,
+) -> int:
+    """HBM bytes one ragged decode launch moves (ops/pallas_attention):
+    each row's real pages once (+ scale rows), one query token per row."""
+    kv = 0
+    n = 0
+    for L in seq_lens:
+        if L <= 0:
+            continue
+        n += 1
+        p = _pages(L, block_size)
+        kv += 2 * p * block_size * kv_heads * head_dim * kv_itemsize
+        if quantized:
+            kv += 2 * p * kv_heads * SCALE_BYTES
+    qo = 2 * n * num_heads * head_dim * q_itemsize
+    return kv + qo
+
+
+def mixed_vs_split(
+    chunk_len: int,
+    chunk_total_len: int,
+    decode_seq_lens: Sequence[int],
+    *,
+    block_size: int,
+    kv_heads: int,
+    num_heads: int,
+    head_dim: int,
+    max_blocks_per_seq: int,
+    kv_itemsize: int = 2,
+    q_itemsize: int = 2,
+    quantized: bool = False,
+    bucket: int = None,
+) -> Dict[str, Any]:
+    """Price ONE mixed continuous-batching step against the equivalent
+    split pair (one prefill-chunk dispatch + one decode dispatch over the
+    same rows). Returns the byte counts and their ratio — the deterministic
+    gate `bench.py` emits as ``detail.kernel_bytes`` and tier-1 asserts
+    stays <= 1.0."""
+    rows: List[Tuple[int, int]] = [(chunk_len, chunk_total_len)]
+    rows += [(1, int(L)) for L in decode_seq_lens]
+    kw = dict(
+        block_size=block_size, kv_heads=kv_heads, num_heads=num_heads,
+        head_dim=head_dim, kv_itemsize=kv_itemsize, q_itemsize=q_itemsize,
+        quantized=quantized,
+    )
+    mixed = unified_attention_bytes(rows, **kw)
+    split = split_prefill_bytes(
+        chunk_len, chunk_total_len, max_blocks_per_seq, bucket=bucket, **kw
+    ) + split_decode_bytes(decode_seq_lens, **kw)
+    return {
+        "mixed_step_bytes": int(mixed),
+        "split_pair_bytes": int(split),
+        "ratio": round(mixed / split, 4) if split else 0.0,
+        "rows": len(rows),
+        "quantized": bool(quantized),
+    }

@@ -1,0 +1,425 @@
+"""Pallas TPU kernel: unified ragged paged attention (prefill + decode fused).
+
+ONE launch serves an arbitrary mix of prefill chunks and decode tokens — the
+"Ragged Paged Attention" formulation (PAPERS.md) that lets the engine step
+loop run true continuous batches instead of alternating a prefill-only
+kernel (ops/pallas_prefill.py flash extend) with a decode-only kernel
+(ops/pallas_attention.py ragged decode). Rows carry ``(query_len, seq_len)``
+pairs: query tokens pack densely into one ragged buffer, each row's segment
+sits at the TAIL of its own paged context, and causal masking is per row.
+
+Versus the two split kernels this also removes two whole classes of HBM
+traffic:
+
+- no gather: the prefill side of the split path materializes the FULL
+  padded context (``gather_kv`` over ``max_blocks_per_seq`` pages, an
+  HBM->HBM copy) before the flash kernel even starts; here KV pages stream
+  straight from the paged cache, and only the ``ceil(seq_len / bs)`` real
+  pages of each row are ever touched;
+- single pass over KV: the flash-extend grid re-reads the gathered context
+  once per q tile; here the chunk loop is OUTER and the q-tile loop INNER,
+  so each row's pages are DMA'd exactly once per kv head regardless of how
+  many query tokens ride on them.
+
+``ops/costs.py`` turns both layouts into byte counts; the tier-1 gate pins
+mixed <= split.
+
+Layout/machinery shared with the PR 2 kernels: paged cache
+``[num_blocks, block_size, kv_heads, head_dim]``; int8 caches
+(ops/quant.QuantizedKV) DMA the int8 pages PLUS their per-block
+``[kvh]`` f32 scale rows on the same scalar-prefetched table indices and
+dequantize in-register (the scale-row DMA machinery introduced by the
+decode kernel — and carrying the same hardware caveat: the scale row's
+minor dim is kvh, not 128-aligned; CPU tier-1 exercises interpret mode
+only, and tests/test_unified_attention.py pins the grow-scale rescale RMW
+path there).
+
+Grid: ``(kvh, R)`` — kv head OUTER so the packed q/o blocks for one head
+stay VMEM-resident across all R rows; rows iterate on the minor dim. Per
+(head, row): double-buffered page-slice DMAs (``[bs, d]`` per page for this
+head) chunked ``chunk_pages`` at a time, a DYNAMIC inner loop over the
+row's ``ceil(q_len / q_seg)`` query tiles with online-softmax state per
+tile in VMEM scratch, and a masked read-modify-write emit so neighbouring
+segments' outputs survive clamped tile writes. A decode row costs one
+``q_seg``-row tile per chunk (bandwidth-bound, unchanged page bytes); a
+prefill chunk amortizes the same page stream over all its tiles.
+
+NOTE (hardware): the dynamic scratch slices step in ``q_seg * g`` sublanes
+and the per-head page DMA strides over kv heads; both run interpret-clean
+and need the first real-TPU run to confirm Mosaic lowering (same protocol
+as the PR 2 scale-row caveat — fallback: use_pallas=False).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import shard_map
+from .quant import QuantizedKV, is_quantized
+
+NEG_INF = -1e30
+
+# default query-tile rows per inner iteration: small enough that a decode
+# row (q_len=1) stays bandwidth-bound, large enough that q_seg * g fills
+# MXU sublanes for common GQA group sizes
+Q_SEG = 8
+
+
+def _unified_kernel(
+    # scalar prefetch (SMEM)
+    starts_ref,   # [R] int32 packed-q segment starts
+    qlens_ref,    # [R] int32 segment lengths (0 = empty row)
+    lens_ref,     # [R] int32 context lengths (incl. the segment)
+    tables_ref,   # [R * max_blocks] int32 flattened block tables
+    # inputs
+    q_ref,        # VMEM [1, Tq, g, d] this kv head's packed queries
+    k_hbm,        # ANY/HBM [num_blocks, bs, kvh, d] (model dtype or int8)
+    v_hbm,
+    # quantized=True only: ks_hbm/vs_hbm ANY/HBM [num_blocks, kvh] f32
+    # outputs
+    # o_ref       VMEM [1, Tq, g, d]
+    # scratch
+    # k_buf/v_buf VMEM [2, CP, bs, d] double-buffered page slices (this head)
+    # quantized=True only: ks_buf/vs_buf VMEM [2, CP, kvh] f32 scale rows
+    # m/l/acc     VMEM [Tq_pad*g, 1/1/d] f32 online-softmax state per q tile
+    # sem         DMA sems [2, 2, CP]; quantized: ssem [2, 2, CP]
+    *rest,
+    max_blocks: int,
+    chunk_pages: int,
+    q_seg: int,
+    quantized: bool,
+):
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         m_scr, l_scr, acc_scr, sem, ssem) = rest
+    else:
+        o_ref, k_buf, v_buf, m_scr, l_scr, acc_scr, sem = rest
+        ks_hbm = vs_hbm = ks_buf = vs_buf = ssem = None
+    kh = pl.program_id(0)
+    r = pl.program_id(1)
+    bs, kvh, d = k_hbm.shape[1], k_hbm.shape[2], k_hbm.shape[3]
+    Tq, g = q_ref.shape[1], q_ref.shape[2]
+    CP = chunk_pages
+    T = CP * bs
+    QG = q_seg * g
+
+    q_start = starts_ref[r]
+    q_len = qlens_ref[r]
+    seq_len = lens_ref[r]
+
+    @pl.when(r == 0)
+    def _zero_out():
+        # fresh block per kv head: padding tokens (gaps between segments)
+        # must read back deterministic zeros, matching the reference twin
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    num_pages = pl.cdiv(seq_len, bs)
+    num_chunks = pl.cdiv(num_pages, CP)
+    nq = pl.cdiv(q_len, q_seg)
+    active = jnp.logical_and(q_len > 0, seq_len > 0)
+    chunks = jnp.where(active, num_chunks, 0)
+    ctx_start = seq_len - q_len  # absolute position of the segment's row 0
+
+    def page_dma(kind, c, j, slot):
+        """DMA this kv head's slice of page j of chunk c: [bs, d]."""
+        idx = tables_ref[r * max_blocks + c * CP + j]
+        src = k_hbm if kind == 0 else v_hbm
+        dst = k_buf if kind == 0 else v_buf
+        return pltpu.make_async_copy(
+            src.at[idx, :, kh], dst.at[slot, j], sem.at[kind, slot, j]
+        )
+
+    def scale_dma(kind, c, j, slot):
+        """Full [kvh] scale row for page j — the PR 2 scale-row machinery
+        (one tiny f32 row riding the same prefetched table index)."""
+        idx = tables_ref[r * max_blocks + c * CP + j]
+        src = ks_hbm if kind == 0 else vs_hbm
+        dst = ks_buf if kind == 0 else vs_buf
+        return pltpu.make_async_copy(
+            src.at[idx], dst.at[slot, j], ssem.at[kind, slot, j]
+        )
+
+    def start_chunk(c, slot):
+        for j in range(CP):  # static unroll; guard ragged tail
+            @pl.when(c * CP + j < num_pages)
+            def _():
+                page_dma(0, c, j, slot).start()
+                page_dma(1, c, j, slot).start()
+                if quantized:
+                    scale_dma(0, c, j, slot).start()
+                    scale_dma(1, c, j, slot).start()
+
+    def wait_chunk(c, slot):
+        for j in range(CP):
+            @pl.when(c * CP + j < num_pages)
+            def _():
+                page_dma(0, c, j, slot).wait()
+                page_dma(1, c, j, slot).wait()
+                if quantized:
+                    scale_dma(0, c, j, slot).wait()
+                    scale_dma(1, c, j, slot).wait()
+
+    # per-row online-softmax state: one (m, l, acc) strip per q tile,
+    # reset every row (only the first nq tiles are ever touched)
+    m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(active)
+    def _prime():
+        start_chunk(0, 0)
+
+    scale = 1.0 / (d ** 0.5)
+
+    def tile_start(qt):
+        # clamped so the static-size q slice stays in bounds; overlapping
+        # tiles recompute identical rows (each tile's masks derive from its
+        # ACTUAL packed offset, not qt * q_seg)
+        return jnp.minimum(q_start + qt * q_seg, Tq - q_seg)
+
+    def chunk_body(c, carry):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < chunks)
+        def _():
+            start_chunk(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait_chunk(c, slot)
+
+        if quantized:
+            # dequantize in-register: this head's scale is one lane of the
+            # [CP, kvh] rows that just DMA'd in (lane-select via one-hot —
+            # kh is a grid index, so a dynamic lane slice is avoided)
+            sel = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, kvh), 1) == kh
+            ).astype(jnp.float32)                                  # [1, kvh]
+            ksc = jnp.sum(ks_buf[slot] * sel, axis=1)              # [CP]
+            vsc = jnp.sum(vs_buf[slot] * sel, axis=1)
+            k = k_buf[slot].astype(jnp.float32) * ksc[:, None, None]
+            v = v_buf[slot].astype(jnp.float32) * vsc[:, None, None]
+        else:
+            k = k_buf[slot].astype(jnp.float32)
+            v = v_buf[slot].astype(jnp.float32)
+        k = k.reshape(T, d)
+        v = v.reshape(T, d)
+        # rows past seq_len were never DMA'd (garbage / NaN): scores are
+        # masked below, but V must be zeroed too — 0-weight * NaN = NaN
+        row_pos = c * T + jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+        v = jnp.where(row_pos < seq_len, v, 0.0)
+        key_pos = c * T + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+
+        def tile_body(qt, carry2):
+            seg = tile_start(qt)
+            # row index per flattened (q, g) pair in the [QG, 1] layout
+            # (iota // g keeps the lane dim fixed — see pallas_prefill)
+            row = jax.lax.broadcasted_iota(jnp.int32, (QG, 1), 0) // g
+            local = (seg - q_start) + row
+            member = jnp.logical_and(local >= 0, local < q_len)
+            q_pos = ctx_start + local
+            lim = jnp.where(member, jnp.minimum(q_pos + 1, seq_len), 0)
+            # causal tile-skip: this chunk's keys start at c*T; the tile's
+            # highest attention limit is its last member row's
+            hi = jnp.minimum(ctx_start + (seg - q_start) + q_seg, seq_len)
+
+            @pl.when(c * T < hi)
+            def _():
+                qf = (
+                    q_ref[0, pl.ds(seg, q_seg)].astype(jnp.float32) * scale
+                ).reshape(QG, d)
+                s = jax.lax.dot_general(
+                    qf, k,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )                                                  # [QG, T]
+                s = jnp.where(key_pos < lim, s, NEG_INF)
+                sl = pl.ds(qt * QG, QG)
+                m_prev = m_scr[sl]
+                l_prev = l_scr[sl]
+                acc_prev = acc_scr[sl]
+                m_cur = jnp.max(s, axis=-1, keepdims=True)
+                m_new = jnp.maximum(m_prev, m_cur)
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m_prev - m_new)
+                m_scr[sl] = m_new
+                l_scr[sl] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+                acc_scr[sl] = alpha * acc_prev + jax.lax.dot_general(
+                    p, v,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            return carry2
+
+        jax.lax.fori_loop(0, nq, tile_body, 0)
+        return carry
+
+    jax.lax.fori_loop(0, chunks, chunk_body, 0)
+
+    def emit_tile(qt, carry):
+        seg = tile_start(qt)
+        sl = pl.ds(qt * QG, QG)
+        out = acc_scr[sl] / jnp.maximum(l_scr[sl], 1e-30)          # [QG, d]
+        row = jax.lax.broadcasted_iota(jnp.int32, (QG, 1), 0) // g
+        local = (seg - q_start) + row
+        member = jnp.logical_and(local >= 0, local < q_len)
+        # masked read-modify-write: a clamped tile spans neighbouring
+        # segments' tokens — their already-written outputs must survive
+        cur = o_ref[0, pl.ds(seg, q_seg)].astype(jnp.float32).reshape(QG, d)
+        merged = jnp.where(member, out, cur)
+        o_ref[0, pl.ds(seg, q_seg)] = merged.reshape(
+            q_seg, g, d
+        ).astype(o_ref.dtype)
+        return carry
+
+    @pl.when(active)
+    def _emit():
+        jax.lax.fori_loop(0, nq, emit_tile, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("q_seg", "chunk_tokens", "interpret")
+)
+def ragged_paged_attention(
+    q: jax.Array,             # [Tq, h, d] densely packed ragged queries
+    k_cache: jax.Array,       # [num_blocks, bs, kvh, d] (or QuantizedKV)
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [R, max_blocks] int32
+    q_starts: jax.Array,      # [R] int32
+    q_lens: jax.Array,        # [R] int32 (0 = empty row)
+    seq_lens: jax.Array,      # [R] int32
+    *,
+    q_seg: int = Q_SEG,
+    chunk_tokens: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Unified ragged paged attention (Pallas). Same semantics as
+    ``ops.attention.ragged_paged_attention`` (the pure-JAX reference twin):
+    row r's segment ``q[q_starts[r] : q_starts[r]+q_lens[r]]`` attends
+    causally over that row's pages with the segment at the context tail;
+    tokens outside every segment return zeros. ``k_cache``/``v_cache`` may
+    be ``QuantizedKV`` — int8 pages + per-block scale rows DMA together and
+    dequantize in-register, halving per-page HBM bytes vs bf16."""
+    Tq, h, d = q.shape
+    _, bs, kvh, _ = k_cache.shape
+    R, max_blocks = block_tables.shape
+    g = h // kvh
+    chunk_pages = max(1, chunk_tokens // bs)
+    quantized = is_quantized(k_cache)
+
+    # pad the packed buffer so every clamped q tile is in bounds
+    Tq_pad = max(q_seg, -(-Tq // q_seg) * q_seg)
+    if Tq_pad != Tq:
+        q = jnp.pad(q, ((0, Tq_pad - Tq), (0, 0), (0, 0)))
+
+    kernel = functools.partial(
+        _unified_kernel, max_blocks=max_blocks, chunk_pages=chunk_pages,
+        q_seg=q_seg, quantized=quantized,
+    )
+    cache_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((2, chunk_pages, bs, d), k_cache.dtype),
+        pltpu.VMEM((2, chunk_pages, bs, d), v_cache.dtype),
+    ]
+    if quantized:
+        cache_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # k scales [num_blocks, kvh]
+            pl.BlockSpec(memory_space=pl.ANY),  # v scales
+        ]
+        scratch += [
+            pltpu.VMEM((2, chunk_pages, kvh), jnp.float32),
+            pltpu.VMEM((2, chunk_pages, kvh), jnp.float32),
+        ]
+    scratch += [
+        pltpu.VMEM((Tq_pad * g, 1), jnp.float32),   # m
+        pltpu.VMEM((Tq_pad * g, 1), jnp.float32),   # l
+        pltpu.VMEM((Tq_pad * g, d), jnp.float32),   # acc
+    ]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2, chunk_pages)))
+    if quantized:
+        scratch.append(pltpu.SemaphoreType.DMA((2, 2, chunk_pages)))
+
+    # [Tq, h, d] -> [kvh, Tq, g, d]: each kv head's q group contiguous; the
+    # kv head is the OUTER grid dim so the block stays resident across rows
+    qg = q.reshape(Tq_pad, kvh, g, d).transpose(1, 0, 2, 3)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(kvh, R),
+        in_specs=[
+            pl.BlockSpec((1, Tq_pad, g, d), lambda kh, r, *_: (kh, 0, 0, 0))
+        ] + cache_specs,
+        out_specs=pl.BlockSpec(
+            (1, Tq_pad, g, d), lambda kh, r, *_: (kh, 0, 0, 0)
+        ),
+        scratch_shapes=scratch,
+    )
+    cache_args = (
+        (k_cache.data, v_cache.data, k_cache.scale, v_cache.scale)
+        if quantized else (k_cache, v_cache)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((kvh, Tq_pad, g, d), q.dtype),
+        interpret=interpret,
+    )(
+        q_starts.astype(jnp.int32),
+        q_lens.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        block_tables.reshape(-1).astype(jnp.int32),
+        qg,
+        *cache_args,
+    )
+    # [kvh, Tq_pad, g, d] -> [Tq, h, d]
+    return out.transpose(1, 0, 2, 3).reshape(Tq_pad, h, d)[:Tq]
+
+
+def sharded_ragged_paged_attention(
+    mesh: Mesh,
+    tp_axis: str,
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    q_starts: jax.Array,
+    q_lens: jax.Array,
+    seq_lens: jax.Array,
+    **kw,
+) -> jax.Array:
+    """TP-sharded wrapper: attention is head-wise independent, so each TP
+    shard runs the kernel on its own heads (q sharded on h, caches on kvh).
+    shard_map because GSPMD cannot partition a custom call — the same
+    treatment as the split kernels' sharded wrappers."""
+    if mesh.shape[tp_axis] == 1:
+        return ragged_paged_attention(
+            q, k_cache, v_cache, block_tables, q_starts, q_lens, seq_lens,
+            **kw,
+        )
+    cache_spec = P(None, None, tp_axis, None)
+    if is_quantized(k_cache):
+        # spec tree mirrors the QuantizedKV pytree (payload on kv_heads,
+        # scale rows on their kv-head dim) — same as the decode kernel
+        cache_spec = QuantizedKV(cache_spec, P(None, tp_axis))
+    fn = shard_map(
+        functools.partial(ragged_paged_attention, **kw),
+        mesh=mesh,
+        in_specs=(
+            P(None, tp_axis, None),
+            cache_spec,
+            cache_spec,
+            P(None, None),
+            P(None),
+            P(None),
+            P(None),
+        ),
+        out_specs=P(None, tp_axis, None),
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, block_tables, q_starts, q_lens, seq_lens)

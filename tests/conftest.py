@@ -25,6 +25,13 @@ os.environ["XLA_FLAGS"] = flags
 # chunked-prefill engine tests). Recompiling costs ~30-60s per engine-heavy
 # file; a single segfault costs every test after it in the session.
 os.environ["JAX_COMPILATION_CACHE_DIR"] = ""
+# Mixed continuous batching (engine mixed_step) compiles ONE extra fused
+# program the first time a prefill overlaps resident decodes; across the
+# suite's dozens of tiny engines that is minutes of serial XLA compile for a
+# path tests/test_mixed_batching.py pins explicitly (engines there opt in
+# via TpuEngineConfig(mixed_admission=True)). Default off for the suite;
+# setdefault so DTPU_MIXED=1 can still force it everywhere.
+os.environ.setdefault("DTPU_MIXED", "0")
 
 import jax  # noqa: E402
 

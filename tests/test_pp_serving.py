@@ -168,6 +168,51 @@ async def test_pp_embeddings():
         ref_engine.stop()
 
 
+async def test_pp_embeddings_multi_chunk():
+    """An embedding input longer than the largest prefill bucket used to be
+    a hard ValueError on pp engines ("no paged chunk variant yet"); it now
+    runs the chunked pooled forward (pp embed_chunk over the wavefront
+    prefill) and matches the non-pp single-shot embedding."""
+    import numpy as np
+
+    params = _params()
+    toks = [(i * 29 + 5) % 500 for i in range(100)]
+    engine = TpuEngine(
+        _cfg(tp=1, pp=2, prefill_buckets=(16, 32, 64), max_context=256,
+             num_blocks=128),
+        params=params,
+        mesh=make_pp_mesh(pp=2, tp=1, devices=jax.devices()[:2]),
+    )
+    ref_engine = TpuEngine(
+        _cfg(prefill_buckets=(128,), max_context=256, num_blocks=128),
+        params=params,
+    )
+    try:
+        req = PreprocessedRequest(
+            request_id="em", model="m", token_ids=toks,
+            annotations={"op": "embed"},
+        )
+        outs = []
+        async for out in engine.generate(req, Context()):
+            outs.append(out)
+        vec = outs[-1].annotations["embedding"]
+        req2 = PreprocessedRequest(
+            request_id="em2", model="m", token_ids=toks,
+            annotations={"op": "embed"},
+        )
+        outs2 = []
+        async for out in ref_engine.generate(req2, Context()):
+            outs2.append(out)
+        ref_vec = outs2[-1].annotations["embedding"]
+        assert len(vec) == 64
+        np.testing.assert_allclose(vec, ref_vec, atol=2e-3)
+        # temporary chunk pages were released, not leaked
+        assert engine.allocator.active_blocks == 0
+    finally:
+        engine.stop()
+        ref_engine.stop()
+
+
 def test_pp_gates_unsupported_features():
     import pytest
 

@@ -1,0 +1,234 @@
+"""Unified ragged paged-attention kernel (ops/pallas_unified) vs its
+pure-JAX reference twin (ops/attention.ragged_paged_attention), plus the
+kernel-side deterministic byte gate (ops/costs).
+
+The kernel runs under the Pallas interpreter on CPU (same strategy as
+tests/test_pallas_ops.py): every mixed-row shape — decode-only,
+prefill-only, mixed, empty rows, single-token prefill, block-boundary
+sequence lengths — in both KV dtypes (float and int8+per-block scales),
+including the grow-scale rescale RMW path the PR 2 in-kernel caveat
+flagged as interpret-only-verified (pinned here by a test instead of a
+comment). The cost model's mixed <= split assertion is the tier-1 stand-in
+for the dead device bench (ROADMAP item 5's kernel-side half).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.ops import attention as att
+from dynamo_tpu.ops import costs
+from dynamo_tpu.ops import pallas_unified as pu
+from dynamo_tpu.ops.quant import QuantizedKV, quantize_blocks
+
+ATOL = 2e-5  # same pallas-vs-reference bounds as the split kernels' tests
+
+
+def _make_case(rng, rows, h, kvh, d, bs, num_blocks, max_blocks,
+               dtype=jnp.float32, quant=False, gap_after=0):
+    """rows: [(q_len, seq_len)]; packs segments densely with an optional
+    padding gap after the first segment (tokens belonging to no row)."""
+    R = len(rows)
+    Tq = sum(max(q, 0) for q, _ in rows) + gap_after
+    Tq = max(Tq, 1)
+    q = jnp.asarray(rng.standard_normal((Tq, h, d)), dtype)
+    k_cache = jnp.asarray(rng.standard_normal((num_blocks, bs, kvh, d)), dtype)
+    v_cache = jnp.asarray(rng.standard_normal((num_blocks, bs, kvh, d)), dtype)
+    tables = np.zeros((R, max_blocks), np.int32)
+    q_starts = np.zeros(R, np.int32)
+    q_lens = np.zeros(R, np.int32)
+    seq_lens = np.zeros(R, np.int32)
+    free = list(range(1, num_blocks))
+    off = 0
+    for r, (ql, sl) in enumerate(rows):
+        q_starts[r] = off
+        q_lens[r] = ql
+        seq_lens[r] = sl
+        off += max(ql, 0)
+        if r == 0:
+            off += gap_after
+        for j in range(-(-sl // bs)):
+            tables[r, j] = free.pop()
+    if quant:
+        kq, ks = quantize_blocks(k_cache)
+        vq, vs = quantize_blocks(v_cache)
+        k_cache, v_cache = QuantizedKV(kq, ks), QuantizedKV(vq, vs)
+    return (q, k_cache, v_cache, jnp.asarray(tables), jnp.asarray(q_starts),
+            jnp.asarray(q_lens), jnp.asarray(seq_lens))
+
+
+ROW_MIXES = {
+    # chunk + decode rows + an idle slot — the engine's mixed step shape
+    "mixed": [(12, 20), (1, 9), (0, 0), (1, 33)],
+    "decode_only": [(1, 5), (1, 31), (1, 1), (1, 16)],
+    "prefill_only": [(24, 24)],
+    # chunked continuation: 8 new tokens against a 32-token cached prefix
+    "chunk_continue": [(8, 40), (1, 7)],
+    "single_token_prefill": [(1, 1), (1, 12)],
+    # every context exactly on a block boundary
+    "block_boundary": [(16, 16), (1, 32), (1, 16)],
+    "empty_rows": [(0, 0), (1, 10), (0, 0)],
+}
+
+
+@pytest.mark.parametrize("name", sorted(ROW_MIXES))
+@pytest.mark.parametrize("quant", [False, True], ids=["float", "int8"])
+def test_unified_matches_reference(name, quant):
+    rng = np.random.default_rng(hash(name) % (2**32))
+    args = _make_case(
+        rng, ROW_MIXES[name], h=8, kvh=4, d=32, bs=16, num_blocks=64,
+        max_blocks=6, quant=quant, gap_after=3,
+    )
+    ref = att.ragged_paged_attention(*args)
+    got = pu.ragged_paged_attention(
+        *args, q_seg=4, chunk_tokens=32, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=ATOL, rtol=ATOL
+    )
+
+
+def test_unified_bf16_and_head_layouts():
+    """bf16 queries/pages and MQA-ish head grouping (kvh=1)."""
+    rng = np.random.default_rng(7)
+    for h, kvh in [(8, 1), (4, 4)]:
+        args = _make_case(
+            rng, [(8, 24), (1, 15)], h=h, kvh=kvh, d=32, bs=8,
+            num_blocks=32, max_blocks=5, dtype=jnp.bfloat16,
+        )
+        ref = att.ragged_paged_attention(*args)
+        got = pu.ragged_paged_attention(
+            *args, q_seg=4, chunk_tokens=16, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2,
+        )
+
+
+def test_unified_int8_grow_scale_rmw():
+    """PR 2 caveat pinned by a test: a decode write that GROWS a block's
+    scale (requantize_token's rescale RMW) feeds the unified kernel's
+    scale-row DMA path — the kernel must read the grown scales, not stale
+    ones, and match the reference twin within quantization tolerance."""
+    rng = np.random.default_rng(11)
+    bs, kvh, d, h = 8, 2, 32, 4
+    num_blocks = 16
+    k_cache = QuantizedKV(
+        jnp.zeros((num_blocks, bs, kvh, d), jnp.int8),
+        jnp.zeros((num_blocks, kvh), jnp.float32),
+    )
+    v_cache = QuantizedKV(
+        jnp.zeros((num_blocks, bs, kvh, d), jnp.int8),
+        jnp.zeros((num_blocks, kvh), jnp.float32),
+    )
+    # prefill 8 small-amplitude tokens into block 1 (scale saturates small)
+    k_new = jnp.asarray(rng.standard_normal((bs, kvh, d)) * 0.1, jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((bs, kvh, d)) * 0.1, jnp.float32)
+    blocks = jnp.asarray([1], jnp.int32)
+    k_cache, v_cache = att.write_prefill_kv(k_cache, v_cache, k_new, v_new, blocks)
+    # decode-write a LARGE token into block 2 offset 1 after a small one:
+    # the second write's amax exceeds the inherited scale -> rescale RMW
+    for off, amp in [(0, 0.05), (1, 5.0)]:
+        kd = jnp.asarray(rng.standard_normal((1, kvh, d)) * amp, jnp.float32)
+        vd = jnp.asarray(rng.standard_normal((1, kvh, d)) * amp, jnp.float32)
+        k_cache, v_cache = att.write_decode_kv(
+            k_cache, v_cache, kd, vd,
+            jnp.asarray([2], jnp.int32), jnp.asarray([off], jnp.int32),
+        )
+    assert float(k_cache.scale[2].max()) > 0.01  # the grow actually happened
+    # row 0: extend over block 1's 8 tokens; row 1: decode over block 2's 2
+    q = jnp.asarray(rng.standard_normal((5, h, d)), jnp.float32)
+    tables = jnp.asarray([[1, 0, 0], [2, 0, 0]], jnp.int32)
+    q_starts = jnp.asarray([0, 4], jnp.int32)
+    q_lens = jnp.asarray([4, 1], jnp.int32)
+    seq_lens = jnp.asarray([8, 2], jnp.int32)
+    args = (q, k_cache, v_cache, tables, q_starts, q_lens, seq_lens)
+    ref = att.ragged_paged_attention(*args)
+    got = pu.ragged_paged_attention(
+        *args, q_seg=4, chunk_tokens=16, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=ATOL, rtol=ATOL
+    )
+
+
+def test_unified_sharded_wrapper_tp():
+    """TP shard_map wrapper: per-head-shard kernel equals the full kernel."""
+    from dynamo_tpu.parallel.mesh import AXIS_TP, make_mesh
+
+    rng = np.random.default_rng(3)
+    args = _make_case(
+        rng, [(8, 16), (1, 9)], h=8, kvh=4, d=32, bs=8, num_blocks=32,
+        max_blocks=4,
+    )
+    ref = att.ragged_paged_attention(*args)
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    with mesh:
+        got = pu.sharded_ragged_paged_attention(
+            mesh, AXIS_TP, *args, q_seg=4, chunk_tokens=16, interpret=True
+        )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=ATOL, rtol=ATOL
+    )
+
+
+# ---------------------------------------------------------------- byte gate
+def test_mixed_step_moves_fewer_bytes_than_split():
+    """Tier-1 kernel perf gate: across representative serving shapes (and
+    the bench config's), one mixed step's modeled HBM bytes stay <= the
+    split prefill-dispatch + decode-dispatch pair it replaces."""
+    shapes = [
+        # (chunk_len, total_len, decode_lens, bs, kvh, h, d, mbs, bucket)
+        (256, 256, [320] * 8, 16, 8, 16, 128, 64, 256),     # bench-like
+        (512, 512, [384] * 32, 16, 8, 16, 128, 64, 512),    # bigger batch
+        (32, 160, [40] * 4, 4, 2, 4, 16, 40, 32),           # tiny chunk cont.
+        (64, 64, [2000], 16, 1, 8, 128, 256, 64),           # long-context MQA
+    ]
+    for (cl, tl, dec, bs, kvh, h, d, mbs, bucket) in shapes:
+        for quant, esize in [(False, 2), (True, 1)]:
+            r = costs.mixed_vs_split(
+                chunk_len=cl, chunk_total_len=tl, decode_seq_lens=dec,
+                block_size=bs, kv_heads=kvh, num_heads=h, head_dim=d,
+                max_blocks_per_seq=mbs, kv_itemsize=esize, quantized=quant,
+                bucket=bucket,
+            )
+            assert r["mixed_step_bytes"] <= r["split_pair_bytes"], r
+            assert 0 < r["ratio"] <= 1.0, r
+
+
+def test_jaxpr_counts_traces_kernel_and_reference():
+    """The jaxpr walker surfaces the unified kernel's pallas_call (for the
+    analytic models to price) and counts MXU FLOPs in the reference twin."""
+    q = jnp.zeros((12, 4, 16), jnp.float32)
+    kc = jnp.zeros((8, 4, 2, 16), jnp.float32)
+    vc = jnp.zeros_like(kc)
+    tables = jnp.zeros((2, 2), jnp.int32)
+    qs = jnp.asarray([0, 10], jnp.int32)
+    ql = jnp.asarray([10, 1], jnp.int32)
+    sl = jnp.asarray([10, 6], jnp.int32)
+    c = costs.jaxpr_counts(
+        lambda *a: pu.ragged_paged_attention(*a, interpret=True),
+        q, kc, vc, tables, qs, ql, sl,
+    )
+    assert any("_unified_kernel" in p["name"] for p in c["pallas_calls"])
+    c2 = costs.jaxpr_counts(
+        att.ragged_paged_attention, q, kc, vc, tables, qs, ql, sl
+    )
+    assert c2["flops"] > 0
+    assert c2["hbm_bytes"] > 0
+    assert "dot_general" in c2["by_op"]
+
+
+def test_bench_kernel_bytes_schema():
+    """The record bench.py emits as detail.kernel_bytes carries the gate
+    fields and passes at <= 1.0 for the bench defaults."""
+    r = costs.mixed_vs_split(
+        chunk_len=256, chunk_total_len=256, decode_seq_lens=[320] * 8,
+        block_size=16, kv_heads=8, num_heads=16, head_dim=128,
+        max_blocks_per_seq=64, bucket=256,
+    )
+    for key in ("mixed_step_bytes", "split_pair_bytes", "ratio", "rows"):
+        assert key in r
+    assert r["ratio"] <= 1.0

@@ -465,6 +465,66 @@ def _sim_wallclock_pass(ctx: Context) -> Iterator[Finding]:
 _sim_wallclock_pass.RULES = ("SIM-WALLCLOCK",)
 
 
+# -- KERNEL-SPLIT ------------------------------------------------------------
+
+# The unified ragged paged-attention kernel (ops/pallas_unified +
+# ops/attention.ragged_paged_attention) serves arbitrary prefill/decode
+# mixes in one launch; the split-era entry points below remain ONLY for the
+# engine's fallback dispatches. A NEW reference outside ops/ (and tests,
+# which pin parity on all of them) should target the unified kernel instead
+# — existing engine fallback sites are baselined.
+SPLIT_ATTENTION_ENTRY_POINTS = frozenset({
+    "flash_extend_attention", "sharded_flash_extend_attention",
+    "paged_decode_attention", "sharded_paged_decode_attention",
+})
+
+
+def _is_kernel_split_exempt(norm_path: str) -> bool:
+    return norm_path.startswith(("dynamo_tpu/ops/", "tests/", "tools/"))
+
+
+def kernel_split_refs(path: str, tree: ast.AST):
+    out = []
+
+    def msg(name):
+        return (
+            f"legacy split-attention entry point {name} referenced outside "
+            "ops/ — new call sites should target the unified ragged kernel "
+            "(ops/pallas_unified.ragged_paged_attention or its pure-JAX "
+            "twin); the split kernels remain for fallback dispatches only"
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in SPLIT_ATTENTION_ENTRY_POINTS:
+                    out.append((path, node.lineno, msg(a.name)))
+        elif (
+            isinstance(node, ast.Attribute)
+            and node.attr in SPLIT_ATTENTION_ENTRY_POINTS
+        ):
+            out.append((path, node.lineno, msg(node.attr)))
+        elif (
+            isinstance(node, ast.Name)
+            and node.id in SPLIT_ATTENTION_ENTRY_POINTS
+            and isinstance(node.ctx, ast.Load)
+        ):
+            out.append((path, node.lineno, msg(node.id)))
+    return out
+
+
+@register("kernel-split", "legacy split-attention entry points outside ops/")
+def _kernel_split_pass(ctx: Context) -> Iterator[Finding]:
+    for m in ctx.modules:
+        if _is_kernel_split_exempt(m.path):
+            continue
+        for _p, lineno, msg in kernel_split_refs(m.path, m.tree):
+            yield Finding("KERNEL-SPLIT", m.path, lineno, msg)
+
+
+_kernel_split_pass.RULES = ("KERNEL-SPLIT",)
+
+
 # -- PROMETHEUS-IMPORT -------------------------------------------------------
 
 def prometheus_imports(path: str, tree: ast.AST):
