@@ -193,8 +193,38 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
     # is down; tier-1 asserts the ratio stays <= 1.0.
     from dynamo_tpu.ops.costs import mixed_vs_split
 
+    # disagg transfer gate (ops/costs.py): modeled streamed-vs-blocking
+    # disagg TTFT at this bench's shapes over the wire-class priors — the
+    # deterministic number behind the PR 10 overlap win (device bench is
+    # dead on this image); tier-1 asserts streamed <= blocking.
+    from dynamo_tpu.ops.costs import streamed_transfer_model
+    from dynamo_tpu.runtime.bandwidth import WIRE_PRIORS
+
     kv_itemsize = 1 if kv_dtype == "int8" else 2
     chunk = min(PROMPT_LEN, cfg.prefill_chunk)
+    bytes_per_block = int(
+        kv_bytes_per_token(mcfg, cfg.block_size, kv_dtype) * cfg.block_size
+    )
+    # two shapes: the bench prompt (single chunk — the overlap floor) and a
+    # long-prompt disagg shape (8 chunks — where streaming hides the wire)
+    transfer_detail = {
+        shape_name: {
+            wire: streamed_transfer_model(
+                n_tokens,
+                block_size=cfg.block_size,
+                prefill_chunk=chunk,
+                kv_bytes_per_block=bytes_per_block,
+                bandwidth_bytes_s=WIRE_PRIORS[wire],
+                prefill_chunk_s=0.02,
+                window_blocks=8,
+            )
+            for wire in ("native", "inline")
+        }
+        for shape_name, n_tokens in (
+            ("bench_prompt", PROMPT_LEN),
+            ("long_prompt", 8 * PROMPT_LEN),
+        )
+    }
     kernel_bytes = mixed_vs_split(
         chunk_len=chunk,
         chunk_total_len=chunk,
@@ -230,6 +260,7 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
                 mcfg, cfg.block_size, kv_dtype
             ),
             "kernel_bytes": kernel_bytes,
+            "transfer": transfer_detail,
             "step_telemetry": {
                 phase: _phase_summary(samples)
                 for phase, samples in sorted(step_log.items())

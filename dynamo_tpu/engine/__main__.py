@@ -618,6 +618,11 @@ async def main() -> None:
     )
     for r, e in enumerate(engines):
         e.stats_hook = EngineTelemetry(tele_scope.child(dp_rank=str(r))).on_step
+    # per-wire KV transfer bandwidth EWMA onto /metrics (the decode side of
+    # a disagg pair observes pulls here; routing elsewhere reads the gauge)
+    from dynamo_tpu.runtime.bandwidth import get_bandwidth_estimator
+
+    get_bandwidth_estimator().attach_metrics(tele_scope)
     if mh is not None:
         # follower death is unrecoverable for the group (its mesh shards are
         # gone): mark every engine unhealthy — the watchdog deregisters and
@@ -632,10 +637,20 @@ async def main() -> None:
             mh.router.close(timeout_s=2.0)
 
         mh.watch_followers(_on_follower_death)
+    transfer_md = {}
     if args.disagg in ("prefill", "decode"):
         transfer_engine = engines[0]
         addr = await transfer_engine.serve_transfer(host=cfg.host_ip)
         print(f"KV_TRANSFER at {addr}", flush=True)
+        # advertise the fetch address at registration: streamed disagg
+        # dispatches the decode hop before prefill finishes, so the
+        # frontend needs it at routing time (register_llm also picks it up
+        # from the engine; setting it here covers dp groups whose facade
+        # object is not engines[0])
+        transfer_md = {
+            "transfer_address": addr,
+            "kv_wire": os.environ.get("DTPU_KV_WIRE", "inline"),
+        }
 
     # parser names fail FAST at worker startup (the frontend's _safe_parser
     # degrades unknown names to pass-through with only a warning); gpt-oss
@@ -677,7 +692,10 @@ async def main() -> None:
             max_context_len=args.max_context,
         ),
     )
-    served = await register_llm(runtime, engine, card, instance_id=instance_id)
+    served = await register_llm(
+        runtime, engine, card, instance_id=instance_id,
+        metadata=transfer_md or None,
+    )
 
     # LoRA management endpoints (load/unload/list), served beside generate
     lora_served = []

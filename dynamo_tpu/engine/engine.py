@@ -737,6 +737,10 @@ class TpuEngine:
         self.transfer_address: Optional[str] = None
         self._transfer_server = None
         self._transfer_client = None
+        # per-chunk commit broadcast for streamed transfer (created with the
+        # transfer server; _commit_prefilled_blocks fires it so streaming
+        # fetches wake as each prefill chunk's blocks become addressable)
+        self.kv_commits = None
         self._probe_load_fn = None  # EPLB load probe, jitted on first use
         self._build_programs()
 
@@ -747,8 +751,10 @@ class TpuEngine:
             # transfer gathers iterate per-layer cache lists; pp stacks them
             raise ValueError("pp serving does not cover KV transfer yet")
         from ..runtime.request_plane.tcp import TcpRequestServer
-        from .transfer import KvTransferServer
+        from .transfer import KvCommitSignal, KvTransferServer
 
+        if self.kv_commits is None:
+            self.kv_commits = KvCommitSignal()
         srv = KvTransferServer(self, host=host)
         self._kv_transfer_srv = srv
         self._transfer_server = TcpRequestServer(srv.handle, host=host)
@@ -766,6 +772,17 @@ class TpuEngine:
 
             self._transfer_client = KvTransferClient(self)
         return self._transfer_client
+
+    @property
+    def kv_bytes_per_block(self) -> int:
+        """Wire/storage bytes of one KV block (the transfer-cost signal
+        register_llm advertises for transfer-aware disagg routing)."""
+        from ..kvbm.layout import kv_bytes_per_token
+
+        return int(
+            kv_bytes_per_token(self.mcfg, self.cfg.block_size, self.cfg.kv_dtype)
+            * self.cfg.block_size
+        )
 
     # ------------------------------------------------------------------ setup
     def _shard_params(self, params: llama.Params, mcfg=None) -> llama.Params:
@@ -2278,6 +2295,7 @@ class TpuEngine:
                     req.kv_transfer["address"],
                     [int(h) for h in req.kv_transfer.get("hashes", [])],
                     traceparent=req.annotations.get("traceparent"),
+                    stream=bool(req.kv_transfer.get("stream")),
                 )
                 log.debug("imported %d transferred kv tokens for %s", got, req.request_id[:8])
                 flight.record(
@@ -2320,6 +2338,8 @@ class TpuEngine:
                     "address": self.transfer_address,
                     "hashes": [int(h) for h in st.seq.sequence_hashes()[:prompt_blocks]],
                     "num_tokens": prompt_blocks * self.cfg.block_size,
+                    # this server speaks the block-window streaming protocol
+                    "stream": True,
                 }
             if item.finish_reason is not None:
                 # observability BEFORE the final yield: consumers typically
@@ -2632,6 +2652,8 @@ class TpuEngine:
         for bid, h in zip(local_ids, hashes):
             self.allocator.commit(bid, h)
         self.allocator.release(local_ids)
+        if n and self.kv_commits is not None:
+            self.kv_commits.fire()
         return n
 
     async def _onboard_from_kvbm(self, st: "_Seq") -> None:
@@ -3093,6 +3115,11 @@ class TpuEngine:
             self.allocator.commit(st.block_ids[i], hashes[i])
             if self.kvbm is not None:
                 self._offload_pending.append((st.block_ids[i], hashes[i], 0))
+        if upto > st.commit_upto and self.kv_commits is not None:
+            # wake streaming transfer fetches: this chunk's blocks are now
+            # addressable, so a decode-side pull overlapping our remaining
+            # prefill compute can ship them immediately
+            self.kv_commits.fire()
         st.commit_upto = max(st.commit_upto, upto)
 
     # -- device calls (run in executor thread) -------------------------------

@@ -36,6 +36,28 @@ Wire protocol (served as a normal endpoint, "kv_fetch"):
                carries slot indices. Slots are leased from a staging arena
                and freed by a follow-up {"free_slots": [...]} call (or by
                lease expiry, so a crashed client can't pin the arena).
+
+Streamed protocol (FlowKV-style block-wise overlap, same endpoint):
+    request : {"hashes": [u64...], "stream": true, "window": W,
+               "wait_s": S, "native_ok": bool}
+    response: a SEQUENCE of window items, then an eof frame
+      window:  {"offset": k, "matched": m <= W, "wait_s": t, ...}
+               with the same inline/native body as the blocking response,
+               covering hashes[k : k+m]. The server serves whatever prefix
+               the engine has content-addressed SO FAR and then *waits for
+               more commits* (engine.kv_commits fires per prefill chunk —
+               write_prefill_kv finalizes those blocks), so a decode-side
+               client that connects while the prefill is still computing
+               pulls early blocks under later chunks' compute. ``wait_s``
+               is the server-side commit wait for that window; clients
+               subtract it from inter-item latency when estimating wire
+               bandwidth. The stream ends with {"eof": true, "served": n}
+               once all requested hashes shipped or no new block committed
+               within the wait budget.
+    The protocol stays content-addressed and idempotent: a client that
+    loses the stream mid-way re-requests ONLY the un-imported suffix (its
+    imported prefix is already committed locally), so recovery is
+    per-block, never whole-request.
 """
 
 from __future__ import annotations
@@ -66,6 +88,58 @@ log = get_logger("engine.transfer")
 
 NATIVE_REGION = 1
 SLOT_LEASE_S = 30.0
+
+# -- streamed block-window protocol knobs ------------------------------------
+# window width in blocks: small enough that the first window ships while the
+# second prefill chunk still computes, large enough to amortize per-item
+# request-plane overhead (a chunk of 512 tokens at bs=16 commits 32 blocks)
+STREAM_WINDOW_BLOCKS = int(os.environ.get("DTPU_STREAM_WINDOW", "8"))
+# how long a streaming fetch waits for the NEXT block to be committed before
+# concluding the prefill side has nothing more (prefill crashed / request
+# never landed there); the decode side then recomputes the missing suffix
+STREAM_WAIT_S = float(os.environ.get("DTPU_STREAM_WAIT_S", "30.0"))
+# commit-signal re-check tick while waiting (bounds a lost-wakeup stall)
+_STREAM_POLL_S = 1.0
+# consecutive progress-less resume attempts before the client gives up on
+# the remaining suffix (progress resets the counter: recovery is per-block)
+STREAM_MAX_RESUMES = 3
+
+
+class KvCommitSignal:
+    """Broadcast wakeup: "new blocks were content-addressed on this engine".
+
+    The engine fires it from ``_commit_prefilled_blocks`` (event-loop
+    thread, once per landed prefill chunk) and ``import_blocks``; streaming
+    fetch handlers wait on it instead of polling the allocator. One shared
+    future serves every concurrent waiter (``shield`` keeps one waiter's
+    timeout from cancelling the others' wakeup); ``gen`` is a monotonic
+    commit generation so a fire between ``wait`` calls is never lost.
+    """
+
+    def __init__(self) -> None:
+        self.gen = 0
+        self._fut: Optional["asyncio.Future"] = None
+
+    def fire(self) -> None:
+        self.gen += 1
+        fut = self._fut
+        if fut is not None and not fut.done():
+            fut.set_result(None)
+
+    async def wait(self, seen: int, timeout: float) -> int:
+        """Return the current generation, blocking up to ``timeout`` only
+        while it still equals ``seen``."""
+        import asyncio
+
+        if self.gen != seen:
+            return self.gen
+        if self._fut is None or self._fut.done():
+            self._fut = asyncio.get_event_loop().create_future()
+        try:
+            await asyncio.wait_for(asyncio.shield(self._fut), timeout)
+        except asyncio.TimeoutError:
+            pass
+        return self.gen
 
 # process-local registry: transfer address -> KvTransferServer. A client
 # whose target lives here skips the wire entirely (ICI device path).
@@ -378,6 +452,10 @@ class KvTransferServer:
             self._pull_pending.pop(int(request["free_pull"]), None)
             yield {"ok": True}
             return
+        if request.get("stream"):
+            async for item in self._handle_stream(request):
+                yield item
+            return
         t_serve = time.time_ns()
         hashes: List[SequenceHash] = list(request.get("hashes", []))
         native_ok = bool(request.get("native_ok")) and self._ensure_native()
@@ -449,6 +527,112 @@ class KvTransferServer:
                 yield item
         finally:
             alloc.release(block_ids)
+
+    async def _window_item(
+        self, ids: List[int], native_ok: bool, stream_leases: List[Tuple[int, int]]
+    ) -> Tuple[Dict[str, Any], int]:
+        """Gather ONE window of blocks into a response item (native when the
+        arena has room, inline otherwise). Returns (item, nbytes)."""
+        take = len(ids)
+        leased = self._lease_slots(take) if native_ok else None
+        if leased is not None:
+            slots, token = leased
+            stream_leases.extend((s, token) for s in slots)
+            checksums = await self._gather_into_arena(ids, slots)
+            return {
+                "matched": take,
+                "block_shape": self._block_shape,
+                "dtype": self._arena_dtype.name,
+                "kv_dtype": "int8" if self._quantized else "model",
+                "block_bytes": self._block_nbytes,
+                "native": {
+                    "host": self.host,
+                    "port": self._agent.port,
+                    "region": NATIVE_REGION,
+                    "slots": slots,
+                    "token": token,
+                    "crc32": checksums,
+                },
+            }, take * self._block_nbytes
+        data, shape, dtype_name, scales = await self._gather(ids)
+        item: Dict[str, Any] = {
+            "matched": take, "data": data, "shape": shape, "dtype": dtype_name,
+        }
+        nbytes = len(data)
+        if scales is not None:
+            item["scales"] = scales
+            nbytes += len(scales)
+        return item, nbytes
+
+    async def _handle_stream(self, request: Any) -> AsyncIterator[Dict]:
+        """Block-window streaming fetch: serve committed blocks as windows,
+        waiting on the engine's commit signal for blocks whose prefill chunk
+        has not landed yet — the decode side overlaps its pull with the
+        prefill side's remaining compute.
+
+        Lease lifecycle: window leases are tracked per-stream; if the client
+        disappears mid-stream (GeneratorExit / transport error) every lease
+        it never freed is dropped immediately instead of pinning arena
+        capacity for the full SLOT_LEASE_S — a cancelled fetch must not be
+        a slow capacity bleed. On a clean eof the client's own free_slots
+        calls (or normal expiry) reclaim the tail window."""
+        t_serve = time.time_ns()
+        hashes: List[SequenceHash] = list(request.get("hashes", []))
+        n = len(hashes)
+        window = max(1, int(request.get("window") or STREAM_WINDOW_BLOCKS))
+        wait_budget = float(request.get("wait_s") or STREAM_WAIT_S)
+        native_ok = bool(request.get("native_ok")) and self._ensure_native()
+        alloc = self.engine.allocator
+        sig = getattr(self.engine, "kv_commits", None)
+        served = 0
+        nbytes_total = 0
+        wire = "none"
+        stream_leases: List[Tuple[int, int]] = []
+        clean_exit = False
+        try:
+            gen = sig.gen if sig is not None else 0
+            t_window = time.monotonic()  # when we started waiting for the next window
+            while served < n:
+                block_ids = alloc.acquire_prefix(hashes)
+                avail = len(block_ids)
+                if avail <= served:
+                    alloc.release(block_ids)
+                    waited = time.monotonic() - t_window
+                    if waited >= wait_budget or sig is None:
+                        break  # no more commits coming: eof with what shipped
+                    gen = await sig.wait(
+                        gen, min(_STREAM_POLL_S, wait_budget - waited)
+                    )
+                    continue
+                take = min(avail - served, window)
+                waited = time.monotonic() - t_window
+                try:
+                    item, nbytes = await self._window_item(
+                        block_ids[served : served + take], native_ok,
+                        stream_leases,
+                    )
+                finally:
+                    alloc.release(block_ids)
+                item["offset"] = served
+                item["wait_s"] = round(waited, 6)
+                wire = "native" if "native" in item else "inline"
+                yield item
+                served += take
+                nbytes_total += nbytes
+                t_window = time.monotonic()
+            self._trace_serve(
+                request, t_serve, f"stream-{wire}", served, nbytes_total
+            )
+            clean_exit = True
+            yield {"eof": True, "served": served, "of": n}
+        finally:
+            if not clean_exit:
+                # client gone mid-stream: reclaim every lease it never freed
+                # (token match keeps re-leased slots untouched)
+                for slot, token in stream_leases:
+                    lease = self._slot_lease.get(slot)
+                    if lease is not None and lease[1] == token:
+                        self._slot_lease.pop(slot, None)
 
     def _gather_np(self, block_ids: List[int], dtype=None) -> np.ndarray:
         """Executor thread: device gather -> [L, 2, n, bs, kvh, d]; dtype
@@ -669,9 +853,15 @@ class KvTransferClient:
             retryable=RETRYABLE_DEFAULT + (NoResponders,),
         ).acall(once)
 
+    def _block_nbytes(self) -> int:
+        """Wire bytes of one block on THIS engine's cache format (the one
+        byte-accounting source, kvbm/layout via the engine property) — used
+        to price device-fabric moves that never materialize host bytes."""
+        return int(self.engine.kv_bytes_per_block)
+
     async def fetch_and_import(
         self, address: str, hashes: List[SequenceHash],
-        traceparent: Optional[str] = None,
+        traceparent: Optional[str] = None, stream: bool = False,
     ) -> int:
         """Pull blocks for ``hashes`` from ``address``; returns tokens imported.
 
@@ -679,33 +869,52 @@ class KvTransferClient:
         fetched). Imported blocks are committed content-addressed, so the
         engine's normal admission path picks them up as a cached prefix.
 
+        ``stream=True`` takes the block-window streaming protocol: windows
+        import as the serving side commits them, overlapping the wire with
+        the prefill side's remaining compute; a mid-stream loss resumes
+        from the first un-imported block (never a whole-request restart).
+
         ``traceparent`` continues the request's trace: a ``kv.transfer.pull``
         span (wire path + bytes + blocks) is emitted here and shipped in the
-        handshake so the serving side's span joins the same trace."""
+        handshake so the serving side's span joins the same trace. Observed
+        (bytes, seconds) per wire feed the process bandwidth estimator that
+        prices future routing decisions."""
+        from ..runtime.bandwidth import get_bandwidth_estimator
+
         tracer = get_tracer()
-        if not tracer.enabled:
-            return await self._pull(address, hashes, traceparent, {})
-        info: Dict[str, Any] = {"wire": "none", "bytes": 0, "blocks": 0}
+        info: Dict[str, Any] = {
+            "wire": "none", "bytes": 0, "blocks": 0, "xfer_s": 0.0,
+        }
         t0 = time.time_ns()
         status = "OK"
         tokens = 0
         try:
-            tokens = await self._pull(address, hashes, traceparent, info)
+            tokens = await self._pull(address, hashes, traceparent, info, stream)
             return tokens
         except Exception:
             status = "ERROR"
             raise
         finally:
-            tracer.emit(
-                "kv.transfer.pull", t0, time.time_ns(),
-                traceparent=traceparent, status=status, address=address,
-                wire=info["wire"], bytes=info["bytes"],
-                blocks=info["blocks"], tokens=tokens,
+            # streamed pulls accumulate wire-active time per window (server
+            # commit waits subtracted); blocking pulls are wire-active for
+            # the whole call
+            xfer_s = info["xfer_s"] or (time.time_ns() - t0) / 1e9
+            get_bandwidth_estimator().observe(
+                info["wire"], info["bytes"], xfer_s
             )
+            if tracer.enabled:
+                tracer.emit(
+                    "kv.transfer.pull", t0, time.time_ns(),
+                    traceparent=traceparent, status=status, address=address,
+                    wire=info["wire"], bytes=info["bytes"],
+                    blocks=info["blocks"], tokens=tokens,
+                    streamed=bool(stream),
+                )
 
     async def _pull(
         self, address: str, hashes: List[SequenceHash],
         traceparent: Optional[str], info: Dict[str, Any],
+        stream: bool = False,
     ) -> int:
         alloc = self.engine.allocator
         have = len(alloc.match_prefix(hashes))
@@ -736,11 +945,23 @@ class KvTransferClient:
             # (which ships the half-width int8 blocks anyway)
             local = None
         if local is not None and local.engine is not self.engine:
-            moved = await IciKvMover(local.engine, self.engine).move(list(want))
+            if stream:
+                moved = await self._ici_stream(local.engine, want, info)
+            else:
+                t_ici = time.monotonic()
+                moved = await IciKvMover(local.engine, self.engine).move(list(want))
+                if moved:
+                    info.update(
+                        bytes=moved * self._block_nbytes(),
+                        xfer_s=time.monotonic() - t_ici,
+                    )
             if moved is not None:
                 info.update(wire="ici", blocks=moved)
                 return (have + moved) * alloc.block_size
             # device path failed: fall through to the DCN protocol
+        if stream:
+            imported = await self._pull_stream(address, want, traceparent, info)
+            return (have + imported) * alloc.block_size
         from ..transfer import native_available
 
         # device offers are only solicited when the pull could land: room to
@@ -820,6 +1041,164 @@ class KvTransferClient:
         )
         info["blocks"] = imported
         return (have + imported) * alloc.block_size
+
+    async def _ici_stream(
+        self, src_engine, want: List[SequenceHash], info: Dict[str, Any]
+    ) -> Optional[int]:
+        """Streamed same-process transfer: move the committed prefix over
+        the device fabric window by window, waiting on the source engine's
+        commit signal while later prefill chunks are still computing.
+        Returns blocks moved, or None when the first move fails outright
+        (caller falls back to the wire)."""
+        mover = IciKvMover(src_engine, self.engine)
+        sig = getattr(src_engine, "kv_commits", None)
+        moved_total = 0
+        active_s = 0.0
+        failed = False
+        gen = sig.gen if sig is not None else 0
+        t_window = time.monotonic()
+        while moved_total < len(want):
+            t_move = time.monotonic()
+            moved = await mover.move(list(want[moved_total:]))
+            if moved is None:
+                failed = True
+                break
+            if moved:
+                active_s += time.monotonic() - t_move
+                moved_total += moved
+                t_window = time.monotonic()
+                continue
+            waited = time.monotonic() - t_window
+            if waited >= STREAM_WAIT_S or sig is None:
+                break  # source has nothing more coming: recompute the rest
+            gen = await sig.wait(
+                gen, min(_STREAM_POLL_S, STREAM_WAIT_S - waited)
+            )
+        info.update(
+            bytes=moved_total * self._block_nbytes(),
+            xfer_s=active_s,
+        )
+        if failed and not moved_total:
+            return None  # nothing moved: let the caller try the wire
+        return moved_total
+
+    async def _pull_stream(
+        self, address: str, want: List[SequenceHash],
+        traceparent: Optional[str], info: Dict[str, Any],
+    ) -> int:
+        """Consume the block-window streaming protocol: import each window
+        as it arrives, resume from the first un-imported block on any
+        mid-stream loss (idempotent content addressing makes the re-request
+        safe), give up on the remaining suffix after STREAM_MAX_RESUMES
+        consecutive progress-less attempts — the engine then recomputes
+        only the lost blocks."""
+        import asyncio
+
+        from ..transfer import native_available
+
+        n = len(want)
+        imported = 0
+        resumes = 0
+        while imported < n:
+            req: Dict[str, Any] = {
+                "hashes": [int(h) for h in want[imported:]],
+                "stream": True,
+                "window": STREAM_WINDOW_BLOCKS,
+                "wait_s": STREAM_WAIT_S,
+                "native_ok": native_available(),
+            }
+            if traceparent:
+                req["traceparent"] = traceparent
+            eof = False
+            progressed = False
+            try:
+                await FAULTS.ainject("transfer.pull")
+                stream = await self._tcp.call(address, req)
+                t_prev = time.monotonic()
+                async for item in stream:
+                    if item.get("eof"):
+                        eof = True
+                        break
+                    # chaos hook: an armed mid-stream window fault drops the
+                    # stream through the real resume path (no-op unarmed)
+                    await FAULTS.ainject("transfer.stream_window")
+                    m = int(item.get("matched", 0))
+                    if m <= 0:
+                        continue
+                    w_hashes = list(want[imported : imported + m])
+                    if "native" in item:
+                        block_major = await self._native_fetch(address, item, m)
+                        if block_major is None:
+                            raise ConnectionError(
+                                "native window fetch failed mid-stream"
+                            )
+                        wire = "native"
+                        nbytes = m * int(item.get("block_bytes", 0))
+                    else:
+                        dtype = _dtype_from_name(item.get("dtype", "float32"))
+                        arr = np.frombuffer(
+                            item.get("data", b""), dtype
+                        ).reshape(item.get("shape", []))
+                        nbytes = len(item.get("data", b"")) + len(
+                            item.get("scales", b"")
+                        )
+                        if "scales" in item:
+                            L = arr.shape[0]
+                            scales = np.frombuffer(
+                                item["scales"], SCALE_DTYPE
+                            ).reshape(L, 2, m, arr.shape[4])
+                            block_major = (
+                                np.ascontiguousarray(np.moveaxis(arr, 2, 0)),
+                                np.ascontiguousarray(np.moveaxis(scales, 2, 0)),
+                            )
+                        else:
+                            block_major = np.ascontiguousarray(
+                                np.moveaxis(arr, 2, 0)
+                            )
+                        wire = "inline"
+                    # wire-active seconds: inter-item latency minus the
+                    # server-side commit wait it reported for this window
+                    leg = max(
+                        time.monotonic() - t_prev
+                        - float(item.get("wait_s", 0.0)),
+                        1e-9,
+                    )
+                    got = await self.engine.import_blocks(w_hashes, block_major)
+                    info["wire"] = wire
+                    info["bytes"] += nbytes
+                    info["xfer_s"] += leg
+                    imported += got
+                    progressed = progressed or got > 0
+                    if got < m:
+                        # local allocator full: stop pulling, serve with what
+                        # landed (admission recomputes the rest)
+                        info["blocks"] = imported
+                        return imported
+                    t_prev = time.monotonic()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning(
+                    "kv stream from %s lost after %d/%d blocks (%r); "
+                    "resuming from the first missing block",
+                    address, imported, n, e,
+                )
+            if eof:
+                break
+            if progressed:
+                resumes = 0
+            else:
+                resumes += 1
+                if resumes > STREAM_MAX_RESUMES:
+                    log.warning(
+                        "kv stream from %s exhausted %d resume attempts at "
+                        "%d/%d blocks; recomputing the remaining suffix",
+                        address, STREAM_MAX_RESUMES, imported, n,
+                    )
+                    break
+                await asyncio.sleep(min(0.05 * resumes, 0.5))
+        info["blocks"] = imported
+        return imported
 
     async def _device_pull(
         self, address: str, item: Dict[str, Any], hashes: List[SequenceHash]

@@ -250,22 +250,31 @@ class KvRouter:
         candidates: Sequence[WorkerWithDpRank],
         request_id: Optional[str] = None,
         cacheable: Optional[bool] = None,
+        extra_costs: Optional[Dict[WorkerWithDpRank, float]] = None,
+        hashes: Optional[Sequence[int]] = None,
     ) -> SchedulingDecision:
         """Multimodal prompts (image placeholder runs hash identically
         across different images) must not produce overlap estimates or
         enter the approx indexer — the engine never serves their blocks
         from cache. Cacheability is derived from the tokens themselves
         (placeholder sentinel present) unless the caller overrides; the
-        LOAD accounting keeps the true block count either way."""
+        LOAD accounting keeps the true block count either way.
+
+        ``hashes`` lets a caller that already hashed the prompt (the
+        disagg planner hashes once for scoring AND the transfer handshake)
+        skip the re-hash; it must be ``compute_sequence_hashes(token_ids,
+        self.block_size)``."""
         if cacheable is None:
             from ..models.vision import IMAGE_TOKEN_ID
 
             cacheable = IMAGE_TOKEN_ID not in token_ids
-        hashes = compute_sequence_hashes(token_ids, self.block_size)
+        if hashes is None:
+            hashes = compute_sequence_hashes(token_ids, self.block_size)
         overlaps = self.indexer.find_matches(hashes if cacheable else [])
         tree_sizes = {c: self.indexer.tree.worker_block_count(c) for c in candidates}
         decision = self.scheduler.select_worker(
-            candidates, overlaps, query_blocks=len(hashes), tree_sizes=tree_sizes
+            candidates, overlaps, query_blocks=len(hashes),
+            tree_sizes=tree_sizes, extra_costs=extra_costs,
         )
         new_blocks = decision.query_blocks - decision.overlap_blocks
         if self._hit_tokens is not None and decision.overlap_blocks > 0:
@@ -291,6 +300,8 @@ class KvRouter:
         self,
         token_ids: Sequence[int],
         candidates: Sequence[WorkerWithDpRank],
+        extra_costs: Optional[Dict[WorkerWithDpRank, float]] = None,
+        hashes: Optional[Sequence[int]] = None,
     ) -> SchedulingDecision:
         """Stateless pick: same overlap+load scoring as schedule_tokens but
         NO side effects — no optimistic load charge, no in-flight tracking,
@@ -298,15 +309,40 @@ class KvRouter:
         this go?" (the endpoint picker, deploy/epp.py): they have no
         completion signal, so an optimistic charge could never be released
         and would drift the scheduler into anti-affinity noise. Worker load
-        still tracks reality through the published WorkerMetrics."""
-        hashes = compute_sequence_hashes(token_ids, self.block_size)
+        still tracks reality through the published WorkerMetrics. A caller
+        that DOES dispatch on the decision follows up with
+        :meth:`commit_route`. ``hashes`` skips the re-hash (pass [] for
+        uncacheable prompts — overlap is then ignored but the load term
+        keeps the true block count via ``token_ids``)."""
+        if hashes is None:
+            hashes = compute_sequence_hashes(token_ids, self.block_size)
         overlaps = self.indexer.find_matches(hashes)
         tree_sizes = {
             c: self.indexer.tree.worker_block_count(c) for c in candidates
         }
-        return self.scheduler.select_worker(
-            candidates, overlaps, query_blocks=len(hashes), tree_sizes=tree_sizes
+        query_blocks = max(
+            len(hashes), len(token_ids) // self.block_size
         )
+        return self.scheduler.select_worker(
+            candidates, overlaps, query_blocks=query_blocks,
+            tree_sizes=tree_sizes, extra_costs=extra_costs,
+        )
+
+    def commit_route(
+        self, decision: SchedulingDecision, hashes: Sequence[int] = (),
+    ) -> None:
+        """Apply the routing bookkeeping ``schedule_tokens`` would have
+        done for a decision obtained via :meth:`score_tokens`, once the
+        caller has actually dispatched on it: optimistic load charge,
+        prefix-hit metric, approx-index route record. Plan-then-maybe-
+        deflect callers (the disagg planner) score first so an abandoned
+        plan leaves zero phantom state."""
+        new_blocks = decision.query_blocks - decision.overlap_blocks
+        if self._hit_tokens is not None and decision.overlap_blocks > 0:
+            self._hit_tokens.inc(decision.overlap_blocks * self.block_size)
+        self.scheduler.add_local_load(decision.worker, new_blocks)
+        if isinstance(self.indexer, ApproxKvIndexer) and hashes:
+            self.indexer.process_routed_request(list(hashes), decision.worker)
 
     def complete(self, request_id: str) -> None:
         """Request finished: release its optimistic load contribution."""

@@ -103,7 +103,14 @@ class KvScheduler:
         overlaps: OverlapScores,
         query_blocks: int,
         tree_sizes: Optional[Dict[WorkerWithDpRank, int]] = None,
+        extra_costs: Optional[Dict[WorkerWithDpRank, float]] = None,
     ) -> SchedulingDecision:
+        """``extra_costs`` adds a per-candidate cost in BLOCK units to the
+        logit — the transfer-cost-aware term (NetKV-style): disagg routing
+        passes each prefill candidate's estimated wire time for the KV it
+        would have to ship, normalized by the per-block prefill time, so a
+        candidate behind a slow wire loses to one a device hop away even at
+        equal queue depth."""
         if not candidates:
             raise ValueError("no candidate workers")
         w = self.config.overlap_score_weight
@@ -111,7 +118,10 @@ class KvScheduler:
         for cand in candidates:
             overlap = overlaps.scores.get(cand, 0)
             potential_prefill = max(0, query_blocks - overlap)
-            logits[cand] = w * potential_prefill + self.decode_blocks(cand)
+            logits[cand] = (
+                w * potential_prefill + self.decode_blocks(cand)
+                + (extra_costs.get(cand, 0.0) if extra_costs else 0.0)
+            )
 
         chosen = self._sample(logits, tree_sizes or {})
         return SchedulingDecision(

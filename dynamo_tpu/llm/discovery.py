@@ -28,10 +28,12 @@ from ..runtime.request_plane.tcp import NoResponders
 from ..runtime.resilience import OPEN, CircuitBreaker
 from ..runtime.tasks import spawn_bg
 from ..runtime.tracing import get_tracer
+from ..tokens import compute_sequence_hashes
 from .migration import Migration
 from .model_card import MDC_PREFIX, ModelDeploymentCard
 from .preprocessor import (
     ANNOTATION_CACHED_TOKENS,
+    ANNOTATION_PREFILL_WORKER_ID,
     ANNOTATION_WORKER_ID,
     OpenAIPreprocessor,
 )
@@ -302,6 +304,26 @@ class ModelPipeline:
         cb.allow()  # see above: this stream IS the half-open probe
         return _RecordedStream(stream, cb.record)
 
+    def _decode_overlap(self, req: PreprocessedRequest, hashes=None) -> int:
+        """Prompt blocks the DECODE pool's radix tree already holds — the
+        radix-hit deflection signal (shipping KV the decode side has is
+        pure waste). 0 when KV routing is off for this model. ``hashes``
+        shares a caller's hash pass (must match this router's block size)."""
+        if (
+            self.kv_router is None
+            or self.client is None
+            or not self.client.instances
+        ):
+            return 0
+        cands = self._candidates([])
+        try:
+            # stateless peek: no load charge, no index update
+            return self.kv_router.score_tokens(
+                req.token_ids, cands, hashes=hashes
+            ).overlap_blocks
+        except Exception:
+            return 0
+
     async def generate_tokens(
         self, req: PreprocessedRequest, context: Context
     ) -> AsyncIterator[BackendOutput]:
@@ -315,7 +337,52 @@ class ModelPipeline:
                 yield out
             return
         if self.prefill_router is not None and self.prefill_router.has_workers:
-            pre_out = await self.prefill_router.run_prefill(req, context)
+            plan = None
+            try:
+                # ONE hash pass serves the decode-overlap peek, the plan's
+                # scoring and the streamed transfer handshake when both
+                # pools share a block size (the normal deployment)
+                bs_p = self.prefill_router.card.kv_block_size
+                shared_hashes = (
+                    compute_sequence_hashes(req.token_ids, bs_p)
+                    if self.kv_router is None
+                    or self.kv_router.block_size == bs_p
+                    else None
+                )
+                plan = self.prefill_router.plan(
+                    req,
+                    decode_overlap_blocks=self._decode_overlap(
+                        req, shared_hashes
+                    ),
+                    hashes=shared_hashes,
+                )
+            except Exception:
+                log.exception(
+                    "disagg planning failed; taking the sequential prefill path"
+                )
+            if plan is not None and plan.deflected:
+                # prefill deflection: the aggregated path below prefills
+                # locally on the decode worker (mixed batching rides the
+                # deflected chunk along the decode dispatch)
+                pre_out = None
+            elif plan is not None and plan.streamed:
+                # streamed disagg: fire the prefill clone and dispatch the
+                # decode request NOW with a streamed kv_transfer handshake —
+                # its block-window pull overlaps the prefill compute instead
+                # of serializing behind prefill + full transfer
+                self.prefill_router.start_streamed_prefill(req, context, plan)
+                bs = self.prefill_router.card.kv_block_size
+                req = PreprocessedRequest.from_obj(req.to_obj())
+                req.kv_transfer = {
+                    "address": plan.transfer_address,
+                    "hashes": list(plan.hashes),
+                    "num_tokens": plan.query_blocks * bs,
+                    "stream": True,
+                }
+                req.annotations[ANNOTATION_PREFILL_WORKER_ID] = plan.worker_id
+                pre_out = None
+            else:
+                pre_out = await self.prefill_router.run_prefill(req, context, plan)
             if pre_out is not None and pre_out.token_ids:
                 merged = dict(req.annotations)
                 merged.update(pre_out.annotations)
@@ -335,6 +402,14 @@ class ModelPipeline:
                 req = PreprocessedRequest.from_obj(req.to_obj())
                 req.prior_token_ids = [first_tok]
                 req.kv_transfer = pre_out.kv_transfer
+                if req.kv_transfer:
+                    # sequential dispatch: the prefill is COMPLETE, so the
+                    # one-shot blocking pull is strictly better here — it
+                    # can take the device wire (fastest DCN path), which
+                    # the window protocol does not speak. Drop the
+                    # announce's stream capability flag.
+                    req.kv_transfer = dict(req.kv_transfer)
+                    req.kv_transfer.pop("stream", None)
                 if req.stop.max_tokens is not None:
                     req.stop.max_tokens -= 1
         first = offset == 0

@@ -48,6 +48,10 @@ class ModelRuntimeConfig:
     data_parallel_size: int = 1
     tensor_parallel_size: int = 1
     max_context_len: int = 0
+    # wire bytes of one KV block in this worker's cache storage format
+    # (kvbm/layout.kv_bytes_per_token * block_size; int8 is ~half bf16) —
+    # transfer-cost-aware disagg routing prices candidate wires with it
+    kv_bytes_per_block: int = 0
 
     def to_obj(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

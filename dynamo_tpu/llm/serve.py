@@ -9,6 +9,7 @@ lease so frontends discover it (reference: lib/llm/src/model_card.rs:32).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 from ..runtime.component import ServedEndpoint
@@ -42,6 +43,17 @@ async def register_llm(
         "data_parallel_size": card.runtime_config.data_parallel_size,
         "total_kv_blocks": card.runtime_config.total_kv_blocks,
     }
+    # disaggregation: a worker already serving KV transfer advertises its
+    # fetch address (streamed disagg dispatches the decode hop BEFORE the
+    # prefill finishes, so the frontend needs the address at routing time)
+    # and a wire-class hint for the transfer-cost-aware router
+    transfer_address = getattr(engine, "transfer_address", None)
+    if transfer_address:
+        md.setdefault("transfer_address", transfer_address)
+        md.setdefault("kv_wire", os.environ.get("DTPU_KV_WIRE", "inline"))
+    bpb = int(getattr(engine, "kv_bytes_per_block", 0) or 0)
+    if bpb and not card.runtime_config.kv_bytes_per_block:
+        card.runtime_config.kv_bytes_per_block = bpb
     if metadata:
         md.update(metadata)
     served = await endpoint.serve(handler, instance_id=instance_id, metadata=md)

@@ -209,6 +209,81 @@ def split_decode_bytes(
     return kv + qo
 
 
+# ------------------------------------------------- analytic transfer model
+def streamed_transfer_model(
+    prompt_tokens: int,
+    *,
+    block_size: int,
+    prefill_chunk: int,
+    kv_bytes_per_block: int,
+    bandwidth_bytes_s: float,
+    prefill_chunk_s: float,
+    window_blocks: int = 8,
+    handshake_s: float = 0.0,
+    decode_step_s: float = 0.0,
+) -> Dict[str, Any]:
+    """Deterministic TTFT model of blocking vs streamed disagg KV transfer.
+
+    The prefill side computes ``ceil(prompt/chunk)`` chunks, each taking
+    ``prefill_chunk_s``; a chunk's blocks become transferable when it lands
+    (the engine content-addresses them per chunk). The decode side cannot
+    produce its first token until every prompt block arrived (+ one decode
+    step).
+
+    - blocking: the pull starts only after the LAST chunk — TTFT pays
+      prefill then the whole serialized wire transfer back to back.
+    - streamed: windows of ``window_blocks`` ship as soon as their blocks
+      are committed, on one wire (transfers serialize with each other but
+      overlap prefill compute) — TTFT pays prefill plus only the wire TAIL
+      that could not hide under compute.
+
+    Pure function of its arguments (the tier-1 gate asserts streamed <=
+    blocking across a parameter grid; ``bench.py`` folds one call at the
+    bench shapes into BENCH JSON as ``detail.transfer``).
+    """
+    blocks = max(_pages(prompt_tokens, block_size), 0)
+    chunks = max(_pages(prompt_tokens, prefill_chunk), 1)
+    prefill_s = chunks * prefill_chunk_s
+    bw = max(float(bandwidth_bytes_s), 1.0)
+    total_bytes = blocks * kv_bytes_per_block
+    blocking_ttft = prefill_s + handshake_s + total_bytes / bw + decode_step_s
+    # streamed pipeline: walk windows in commit order; a window starts when
+    # both its last block is committed and the wire is free
+    blocks_per_chunk = prefill_chunk // block_size
+    wire_free = handshake_s
+    done_at = handshake_s  # no blocks -> transfer adds nothing
+    sent = 0
+    while sent < blocks:
+        take = min(window_blocks, blocks - sent)
+        last_block = sent + take  # 1-based index of the window's last block
+        commit_chunk = _pages(last_block, blocks_per_chunk) if blocks_per_chunk else 1
+        committed_at = min(commit_chunk, chunks) * prefill_chunk_s
+        start = max(wire_free, committed_at)
+        wire_free = start + take * kv_bytes_per_block / bw
+        done_at = wire_free
+        sent += take
+    streamed_ttft = max(done_at, prefill_s) + decode_step_s
+    transfer_s = total_bytes / bw
+    hidden = max(blocking_ttft - streamed_ttft, 0.0)
+    return {
+        "prompt_tokens": int(prompt_tokens),
+        "blocks": int(blocks),
+        "prefill_chunks": int(chunks),
+        "prefill_s": round(prefill_s, 6),
+        "transfer_s": round(transfer_s, 6),
+        "bytes": int(total_bytes),
+        "bandwidth_bytes_s": round(bw, 1),
+        "window_blocks": int(window_blocks),
+        "blocking_ttft_s": round(blocking_ttft, 6),
+        "streamed_ttft_s": round(streamed_ttft, 6),
+        "speedup": round(blocking_ttft / streamed_ttft, 4)
+        if streamed_ttft > 0 else 1.0,
+        # fraction of the wire time hidden under prefill compute
+        "overlap_fraction": round(hidden / transfer_s, 4)
+        if transfer_s > 0 else 0.0,
+    }
+
+
 def mixed_vs_split(
     chunk_len: int,
     chunk_total_len: int,

@@ -1,0 +1,143 @@
+"""Per-wire KV-transfer bandwidth estimation (the NetKV-style cost signal).
+
+Disagg routing used to price a candidate by KV overlap + queue depth alone;
+the wire between the prefill and decode instance was free in the model even
+though the four transfer paths (``ici`` device fabric, cross-process
+``device`` pulls, the ``native`` C++ agent, msgpack ``inline`` payloads)
+span ~two orders of magnitude of real bandwidth. This module keeps one
+process-wide EWMA of observed bytes/second per wire class, seeded with
+static priors so routing is sane before the first transfer lands, and fed
+by ``KvTransferClient`` from the same measurements the ``kv.transfer.pull``
+spans record.
+
+Estimates are deliberately coarse (per wire class, not per peer): the
+estimator prices *which path* a transfer would take, and routing only needs
+enough resolution to rank "same-slice ICI hop" above "msgpack over DCN".
+``transfer_seconds(wire, nbytes)`` is the scoring primitive PrefillRouter
+and the fleet simulator share.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from . import metrics as M
+
+# static priors (bytes/second), used until a wire class has observations.
+# Order-of-magnitude figures: ICI moves pages HBM->HBM on the pod fabric,
+# the PJRT device plane streams over ICI/DCN with protocol overhead, the
+# native agent is a raw-TCP memcpy loop, and inline rides msgpack on the
+# asyncio request plane.
+WIRE_PRIORS: Dict[str, float] = {
+    "ici": 4.0e10,
+    "device": 1.0e10,
+    "native": 2.0e9,
+    "inline": 5.0e8,
+}
+DEFAULT_WIRE = "inline"  # the pessimistic assumption for an unknown path
+
+
+class WireBandwidthEstimator:
+    """EWMA of observed per-wire bandwidth, seeded with static priors.
+
+    Thread-safe: observations arrive from transfer client coroutines and
+    executor threads; reads come from routing hot paths. ``alpha`` weights
+    the newest observation (0.3 ~ a ~3-transfer memory, responsive to a
+    congested wire without thrashing on one outlier).
+    """
+
+    def __init__(
+        self,
+        alpha: float = 0.3,
+        priors: Optional[Dict[str, float]] = None,
+        metrics: Optional[M.MetricsScope] = None,
+    ):
+        self.alpha = float(alpha)
+        self.priors = dict(WIRE_PRIORS)
+        if priors:
+            self.priors.update(priors)
+        self._ewma: Dict[str, float] = {}
+        self._observations: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._gauge = (
+            metrics.gauge(
+                M.KV_WIRE_BANDWIDTH,
+                "EWMA of observed KV transfer bandwidth per wire class",
+                extra_labels=("wire",),
+            )
+            if metrics is not None else None
+        )
+
+    def attach_metrics(self, metrics: M.MetricsScope) -> None:
+        """Late-bind a metrics scope (the process singleton is created
+        before any registry exists)."""
+        self._gauge = metrics.gauge(
+            M.KV_WIRE_BANDWIDTH,
+            "EWMA of observed KV transfer bandwidth per wire class",
+            extra_labels=("wire",),
+        )
+
+    def observe(self, wire: str, nbytes: int, seconds: float) -> None:
+        """Fold one completed transfer leg into the wire's estimate.
+        Degenerate samples (zero bytes, non-positive duration — e.g. a
+        fully-cached pull) are ignored rather than polluting the EWMA."""
+        if nbytes <= 0 or seconds <= 0:
+            return
+        bw = nbytes / seconds
+        with self._lock:
+            prev = self._ewma.get(wire)
+            cur = bw if prev is None else prev + self.alpha * (bw - prev)
+            self._ewma[wire] = cur
+            self._observations[wire] = self._observations.get(wire, 0) + 1
+        if self._gauge is not None:
+            self._gauge.set(cur, wire=wire)
+
+    def bandwidth(self, wire: str) -> float:
+        """Bytes/second for a wire class: the EWMA when observed, else the
+        static prior (unknown classes price as DEFAULT_WIRE)."""
+        with self._lock:
+            est = self._ewma.get(wire)
+        if est is not None:
+            return est
+        return self.priors.get(wire, self.priors[DEFAULT_WIRE])
+
+    def transfer_seconds(self, wire: str, nbytes: int) -> float:
+        """The scoring primitive: estimated seconds to move ``nbytes`` over
+        ``wire``. 0 bytes is free regardless of the wire."""
+        if nbytes <= 0:
+            return 0.0
+        return nbytes / self.bandwidth(wire)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Point-in-time view for /debug surfaces and reports:
+        {wire: {bandwidth, observations, prior}}."""
+        with self._lock:
+            wires = set(self.priors) | set(self._ewma)
+            return {
+                w: {
+                    "bandwidth_bytes_s": self._ewma.get(
+                        w, self.priors.get(w, self.priors[DEFAULT_WIRE])
+                    ),
+                    "observations": self._observations.get(w, 0),
+                    "prior_bytes_s": self.priors.get(
+                        w, self.priors[DEFAULT_WIRE]
+                    ),
+                }
+                for w in sorted(wires)
+            }
+
+
+_estimator: Optional[WireBandwidthEstimator] = None
+_estimator_lock = threading.Lock()
+
+
+def get_bandwidth_estimator() -> WireBandwidthEstimator:
+    """The process-wide estimator every transfer client feeds and every
+    router reads (one process observes one network position)."""
+    global _estimator
+    if _estimator is None:
+        with _estimator_lock:
+            if _estimator is None:
+                _estimator = WireBandwidthEstimator()
+    return _estimator

@@ -58,6 +58,7 @@ FAULT_POINTS = (
     "discovery.lease_keepalive", # runtime keepalive heartbeat
     "discovery.watch",           # etcd watch stream (per reconnect attempt)
     "transfer.pull",             # KV transfer client fetch
+    "transfer.stream_window",    # streamed fetch, per block window (client)
     "transfer.native_fetch",     # native (C++ agent) bulk fetch
     "engine.step",               # engine step loop (crash/watchdog drills)
     "controller.spawn",          # deploy controller process spawn

@@ -46,6 +46,9 @@ BATCH_OCCUPANCY = f"{PREFIX}_engine_batch_occupancy"
 SPEC_ACCEPTANCE = f"{PREFIX}_engine_spec_acceptance_rate"
 SLOW_STEPS_TOTAL = f"{PREFIX}_engine_slow_steps_total"
 # resilience (runtime/resilience.py): per-policy retry/breaker observability
+KV_WIRE_BANDWIDTH = f"{PREFIX}_kv_wire_bandwidth_bytes_per_s"
+PREFILL_DEFLECTED_TOTAL = f"{PREFIX}_prefill_deflected_total"
+
 RETRY_ATTEMPTS_TOTAL = f"{PREFIX}_retry_attempts_total"
 RETRY_GIVEUPS_TOTAL = f"{PREFIX}_retry_giveups_total"
 CIRCUIT_STATE = f"{PREFIX}_circuit_state"

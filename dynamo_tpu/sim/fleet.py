@@ -351,9 +351,15 @@ class SimPool:
             )
             w.last_state = state
 
-    async def submit(self, idx: int, sreq: SimRequest) -> RequestRecord:
+    async def submit(
+        self, idx: int, sreq: SimRequest,
+        tokens: Optional[List[int]] = None,
+    ) -> RequestRecord:
+        """``tokens`` overrides the trace-derived prompt (the disagg
+        scenario submits only the un-transferred tail to the decode pool)."""
         item = sreq.item
-        tokens = prefix_prompt(item, idx, self.fleet.cfg.prefix_share)
+        if tokens is None:
+            tokens = prefix_prompt(item, idx, self.fleet.cfg.prefix_share)
         t_arrive = self.clock.time()
         rec = RequestRecord(
             idx=idx, group=item.group, region=sreq.region, pool=self.cfg.name,

@@ -453,6 +453,285 @@ async def _multi_region_follow_sun(
 
 
 # ---------------------------------------------------------------------------
+# disagg-streamed-prefill
+# ---------------------------------------------------------------------------
+
+
+async def _disagg_streamed_prefill(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    """Disaggregated prefill/decode with the REAL PrefillRouter in the loop
+    (ROADMAP item 3 remainder): every arrival is planned by
+    ``PrefillRouter.plan`` — transfer-cost-aware candidate scoring over the
+    prefill pool's real KvRouter, short-prompt/radix/load deflection — then
+    the prefill leg runs on a mocker prefill pool and the decode leg on the
+    decode pool, with the wire modeled per request by the deterministic
+    ``ops.costs.streamed_transfer_model`` at the scenario's per-worker wire
+    classes. Invariants gate the PR 10 acceptance criteria: streamed TTFT
+    <= the blocking counterfactual, deflection active under the load mix,
+    cost-aware steering toward fast-wire workers, and disagg TTFT within
+    1.15x of an equal-capacity colocated twin fleet on the same trace."""
+    import asyncio
+
+    from ..llm.model_card import ModelDeploymentCard
+    from ..llm.prefill_router import DisaggConfig, PrefillRouter
+    from ..llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from ..ops.costs import streamed_transfer_model
+    from ..profiler.loadgen import prefix_prompt
+    from ..runtime.bandwidth import WireBandwidthEstimator
+    from ..runtime.engine import Context
+    from .traces import SimRequest, TraceItem
+
+    block_size = 16
+    prefill_chunk = 512
+    kv_bytes_per_block = 2 << 20            # a ~70B-class bf16 block
+    speed = dict(_SPEED, prefill_base_s=0.2)
+    # wire classes per prefill worker: even ids sit a native hop away, odd
+    # ids only reach the decode pool over a congested inline path — the
+    # skew the cost-aware router must price
+    wire_priors = {"native": 2.0e9, "inline": 1.0e8}
+
+    p_workers = max(2, workers // 2)
+    d_workers = max(2, workers - p_workers)
+    long_isl, short_isl, osl = 2048, 48, 12
+    long_w = 0.65
+    prefill_cost_long = speed["prefill_base_s"] + speed["prefill_per_token_s"] * long_isl
+    rate = 0.35 * p_workers / (long_w * prefill_cost_long)
+    classes = [
+        {"weight": 1 - long_w, "isl": short_isl, "osl": osl,
+         "ttft_target_s": 10.0, "itl_target_s": 3.0},
+        {"weight": long_w, "isl": long_isl, "osl": osl,
+         "ttft_target_s": 30.0, "itl_target_s": 3.0},
+    ]
+    trace = traces.sla_classes(
+        duration_s=duration_s, rate=rate, classes=classes, seed=seed,
+    )
+
+    dcfg = DisaggConfig(
+        streamed=True, deflect=True,
+        deflect_max_tokens=64, deflect_overlap_frac=0.5, deflect_margin=2.0,
+        prefill_block_time_s=speed["prefill_per_token_s"] * block_size,
+        kv_bytes_per_block=kv_bytes_per_block,
+    )
+
+    # ---- phase 1: disagg fleet (decode pool + prefill pool) ----------------
+    cfg = FleetConfig(
+        seed=seed, prefix_share=0.0,
+        pools=[
+            PoolConfig(
+                name="decode", namespace="sim-dec",
+                initial_workers=d_workers, min_workers=d_workers,
+                max_workers=d_workers, block_size=block_size, **speed,
+            ),
+            PoolConfig(
+                name="prefill", namespace="sim-pre",
+                initial_workers=p_workers, min_workers=p_workers,
+                max_workers=p_workers, block_size=block_size, **speed,
+            ),
+        ],
+    )
+    fleet = SimFleet(cfg, clock)
+    await fleet.start()
+    decode_pool = fleet.pools["decode"]
+    prefill_pool = fleet.pools["prefill"]
+
+    p_wids = sorted(prefill_pool.workers)
+    wires = {
+        wid: ("native" if i < (len(p_wids) + 1) // 2 else "inline")
+        for i, wid in enumerate(p_wids)
+    }
+
+    class _Inst:
+        def __init__(self, wid: int):
+            self.metadata = {
+                "data_parallel_size": 1,
+                "transfer_address": f"sim://prefill/{wid}",
+                "kv_wire": wires[wid],
+            }
+
+    class _StubClient:
+        """The real Client surface PrefillRouter.plan reads."""
+
+        @property
+        def instances(self):
+            return {wid: _Inst(wid) for wid in sorted(prefill_pool.workers)}
+
+    prefill_card = ModelDeploymentCard(
+        name="sim", component="prefill", kv_block_size=block_size,
+    )
+    router = PrefillRouter(runtime=None, card=prefill_card, disagg=dcfg)
+    router.client = _StubClient()
+    router.kv_router = prefill_pool.router        # the REAL prefill KvRouter
+    router.bandwidth = WireBandwidthEstimator(priors=wire_priors)
+
+    streamed_ttfts: List[float] = []
+    blocking_ttfts: List[float] = []
+    deflect_reasons: Dict[str, int] = {}
+    disagg_wires: List[str] = []
+    failures = [0]
+
+    async def _prefill_leg(wid: int, rid: str, tokens: List[int]) -> float:
+        w = prefill_pool.workers.get(wid)
+        if w is None:  # retired between plan and dispatch: any worker
+            w = next(iter(prefill_pool.workers.values()))
+        req = PreprocessedRequest(
+            request_id=rid, model="sim", token_ids=tokens,
+            stop=StopConditions(max_tokens=1, min_tokens=1, ignore_eos=True),
+            sampling=SamplingOptions(temperature=0.0),
+        )
+        t0 = clock.time()
+        async for out in w.engine.generate(req, Context(rid)):
+            if out.finish_reason is not None:
+                break
+        return clock.time() - t0
+
+    async def _one(idx: int, sreq: SimRequest) -> None:
+        item = sreq.item
+        t_arr = clock.time()
+        tokens = prefix_prompt(item, idx, fleet.cfg.prefix_share)
+        preq = PreprocessedRequest(
+            request_id=f"sim-disagg-{idx}", model="sim", token_ids=tokens,
+            stop=StopConditions(max_tokens=item.osl),
+            sampling=SamplingOptions(temperature=0.0),
+        )
+        overlap = decode_pool.router.score_tokens(
+            tokens, decode_pool._candidates()
+        ).overlap_blocks if decode_pool.workers else 0
+        plan = router.plan(preq, decode_overlap_blocks=overlap)
+        if plan is None or plan.deflected:
+            reason = plan.deflect_reason if plan is not None else "no_candidates"
+            deflect_reasons[reason] = deflect_reasons.get(reason, 0) + 1
+            rec = await decode_pool.submit(idx, sreq)
+            if not rec.ok:
+                failures[0] += 1
+                return
+            streamed_ttfts.append(rec.ttft_s)
+            blocking_ttfts.append(rec.ttft_s)  # no wire either way
+            return
+        disagg_wires.append(plan.wire)
+        prefill_s = await _prefill_leg(
+            plan.worker_id, f"{preq.request_id}.p", tokens
+        )
+        chunks = max(-(-item.isl // prefill_chunk), 1)
+        model = streamed_transfer_model(
+            item.isl,
+            block_size=block_size,
+            prefill_chunk=prefill_chunk,
+            kv_bytes_per_block=kv_bytes_per_block,
+            bandwidth_bytes_s=router.bandwidth.bandwidth(plan.wire),
+            prefill_chunk_s=prefill_s / chunks,
+            window_blocks=8,
+        )
+        streamed_extra = max(model["streamed_ttft_s"] - model["prefill_s"], 0.0)
+        blocking_extra = max(model["blocking_ttft_s"] - model["prefill_s"], 0.0)
+        router.bandwidth.observe(plan.wire, model["bytes"], model["transfer_s"])
+        if streamed_extra > 0:
+            await clock.sleep(streamed_extra)  # the un-hidden wire tail
+        # decode leg: the transferred prefix is resident; only the final
+        # partial block's tokens are recomputed on the decode worker
+        tail = item.isl % block_size or block_size
+        tail_req = SimRequest(
+            TraceItem(item.t, tail, item.osl, item.group),
+            ttft_target_s=sreq.ttft_target_s, itl_target_s=sreq.itl_target_s,
+            region=sreq.region,
+        )
+        t_submit = clock.time()
+        rec = await decode_pool.submit(idx, tail_req, tokens=tokens[-tail:])
+        if not rec.ok:
+            failures[0] += 1
+            return
+        ttft = (t_submit - t_arr) + rec.ttft_s
+        streamed_ttfts.append(ttft)
+        blocking_ttfts.append(ttft + (blocking_extra - streamed_extra))
+
+    try:
+        tasks: List[asyncio.Task] = []
+        t_prev = 0.0
+        for idx, sreq in enumerate(trace):
+            dt = sreq.t - t_prev
+            t_prev = sreq.t
+            if dt > 0:
+                await clock.sleep(dt)
+            tasks.append(asyncio.create_task(_one(idx, sreq)))
+        if tasks:
+            await asyncio.gather(*tasks)
+    finally:
+        await fleet.stop()
+
+    # ---- phase 2: colocated twin (equal capacity, same trace) --------------
+    colo_cfg = FleetConfig(
+        seed=seed, prefix_share=0.0,
+        pools=[PoolConfig(
+            name="colocated", namespace="sim-colo",
+            initial_workers=d_workers + p_workers,
+            min_workers=d_workers + p_workers,
+            max_workers=d_workers + p_workers,
+            block_size=block_size, **speed,
+        )],
+    )
+    colo = SimFleet(colo_cfg, clock)
+    await colo.start()
+    try:
+        await colo.run_trace(trace)
+    finally:
+        await colo.stop()
+
+    from ..profiler.loadgen import pct
+
+    colo_ttfts = sorted(
+        r.ttft_s for r in colo.pools["colocated"].records if r.ok
+    )
+    s_sorted = sorted(streamed_ttfts)
+    b_sorted = sorted(blocking_ttfts)
+    p50_s, p50_b = pct(s_sorted, 0.5), pct(b_sorted, 0.5)
+    mean_s = sum(s_sorted) / max(len(s_sorted), 1)
+    mean_b = sum(b_sorted) / max(len(b_sorted), 1)
+    p50_colo = pct(colo_ttfts, 0.5)
+    n_total = len(trace)
+    n_deflected = sum(deflect_reasons.values())
+    share = n_deflected / max(n_total, 1)
+    fast_share = (
+        sum(1 for w in disagg_wires if w == "native") / len(disagg_wires)
+        if disagg_wires else 0.0
+    )
+    colo_failed = sum(1 for r in colo.pools["colocated"].records if not r.ok)
+    invs = [
+        _invariant(
+            "streamed_le_blocking",
+            p50_s <= p50_b and (not disagg_wires or mean_s < mean_b),
+            f"streamed TTFT p50 {p50_s:.3f}s mean {mean_s:.3f}s vs blocking "
+            f"counterfactual p50 {p50_b:.3f}s mean {mean_b:.3f}s "
+            f"({len(disagg_wires)} disagg requests)",
+        ),
+        _invariant(
+            "deflection_active",
+            0.15 <= share <= 0.85 and deflect_reasons.get("short_prompt", 0) > 0,
+            f"deflected {n_deflected}/{n_total} ({share:.3f}) by reason "
+            f"{dict(sorted(deflect_reasons.items()))}",
+        ),
+        _invariant(
+            "wire_cost_steering", fast_share >= 0.55,
+            f"{fast_share:.3f} of disagg prefills landed on native-wire "
+            "workers (half the pool; cost-blind routing would give ~0.5)",
+        ),
+        _invariant(
+            "near_colocated_ttft", p50_s <= 1.15 * p50_colo,
+            f"disagg TTFT p50 {p50_s:.3f}s vs colocated {p50_colo:.3f}s "
+            f"(bound 1.15x = {1.15 * p50_colo:.3f}s)",
+        ),
+        _invariant(
+            "all_completed", failures[0] == 0 and colo_failed == 0,
+            f"disagg failures {failures[0]}, colocated failures {colo_failed}",
+        ),
+    ]
+    return {"fleet": fleet, "invariants": invs, "requests": len(trace)}
+
+
+# ---------------------------------------------------------------------------
 # registry + runner
 # ---------------------------------------------------------------------------
 
@@ -462,6 +741,7 @@ SCENARIOS: Dict[str, Callable] = {
     "prefix-heavy-radix": _prefix_heavy_radix,
     "multi-pool-balance": _multi_pool_balance,
     "multi-region-follow-sun": _multi_region_follow_sun,
+    "disagg-streamed-prefill": _disagg_streamed_prefill,
 }
 
 # aliases accepted by the CLI (`python -m dynamo_tpu.sim diurnal`)
@@ -471,6 +751,7 @@ ALIASES = {
     "prefix": "prefix-heavy-radix",
     "multipool": "multi-pool-balance",
     "regions": "multi-region-follow-sun",
+    "disagg": "disagg-streamed-prefill",
 }
 
 
@@ -521,6 +802,7 @@ def run_suite(
     gate = names or [
         "diurnal-autoscale", "bursty-breaker-chaos",
         "prefix-heavy-radix", "multi-pool-balance",
+        "disagg-streamed-prefill",
     ]
     return [
         run_scenario(n, seed=seed, workers=workers, duration_s=duration_s)

@@ -465,6 +465,68 @@ def test_cli_json_output(tmp_path):
     assert f["line"] == 3 and f["severity"] == "error"
 
 
+# -- WIRE-BLOCKING -----------------------------------------------------------
+
+_WIRE_POS = (
+    "class Mover:\n"
+    "    async def pull_all(self, ids):\n"
+    "        return await self._gather_np(ids)\n"
+)
+
+
+def test_wire_blocking_flags_request_path_whole_gather(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/mover.py", _WIRE_POS,
+        rule="WIRE-BLOCKING",
+    )
+    assert len(found) == 1 and found[0].line == 3
+    assert "_gather_np" in found[0].message
+    assert "streaming protocol" in found[0].message
+
+
+def test_wire_blocking_exempts_streaming_protocol_and_helpers(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/mover.py",
+        "class Srv:\n"
+        "    async def _handle_stream(self, req):\n"
+        "        return await self._gather(ids)\n"       # window-bounded
+        "    async def _window_item(self, ids):\n"
+        "        def gather():\n"
+        "            return self._gather_np(ids)\n"      # nested closure
+        "        return gather\n"
+        "    async def _gather(self, ids):\n"
+        "        return self._gather_quant_np(ids)\n"    # helper composing
+        "    def other_gathers(self, ids):\n"
+        "        return kv_gather(ids)\n",               # different name
+        rule="WIRE-BLOCKING",
+    )
+    assert found == []
+
+
+def test_wire_blocking_scoped_to_request_path_modules(tmp_path):
+    # the same call outside engine//llm/ (tools, kvbm background tiers) is
+    # not request-path and stays unflagged
+    found = analyze(
+        tmp_path, "dynamo_tpu/kvbm/pool.py", _WIRE_POS, rule="WIRE-BLOCKING",
+    )
+    assert found == []
+
+
+def test_wire_blocking_current_tree_only_baselined_sites():
+    """The live tree carries exactly the deliberate blocking-wire sites in
+    handle()'s legacy branch — both baselined; anything new fails the gate."""
+    modules, parse = core.load_modules([os.path.join(REPO, "dynamo_tpu")])
+    found = [
+        f for f in core.collect_findings(modules, parse)
+        if f.rule == "WIRE-BLOCKING"
+    ]
+    assert len(found) == 2
+    assert all(f.path == "dynamo_tpu/engine/transfer.py" for f in found)
+    baseline = core.load_baseline(core.DEFAULT_BASELINE)
+    for f in found:
+        assert f.baseline_key() in baseline
+
+
 # -- parity with the pre-framework lint.py -----------------------------------
 
 def test_ported_passes_match_preport_lint_on_current_tree():

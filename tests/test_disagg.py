@@ -55,17 +55,103 @@ def preq(rid, tokens, max_tokens=8):
     )
 
 
-async def test_disagg_matches_aggregated():
+async def test_disagg_matches_aggregated(monkeypatch):
+    # the 30-token prompt is below the deflection threshold; pin deflection
+    # off — this test is about the transfer path itself
+    monkeypatch.setenv("DTPU_DEFLECT", "0")
     await _disagg_matches_aggregated()
 
 
-async def test_disagg_matches_aggregated_gptoss():
+async def test_disagg_matches_aggregated_sequential(monkeypatch):
+    """Legacy sequential pipeline (DTPU_STREAM_KV=0): prefill completes,
+    first token streams from the prefill worker, the decode hop pulls the
+    whole KV blocking-style. Must stay byte-identical to aggregated."""
+    monkeypatch.setenv("DTPU_DEFLECT", "0")
+    monkeypatch.setenv("DTPU_STREAM_KV", "0")
+    await _disagg_matches_aggregated()
+
+
+async def test_disagg_matches_aggregated_gptoss(monkeypatch):
     """Disaggregated prefill/decode with gpt-oss: the transferred KV pages
     carry windowed+sink attention context; the decode engine's import must
     reproduce the aggregated greedy output exactly."""
     from dynamo_tpu.models.gptoss import GptOssConfig
 
+    monkeypatch.setenv("DTPU_DEFLECT", "0")
     await _disagg_matches_aggregated(mcfg=GptOssConfig.tiny_gptoss())
+
+
+async def test_disagg_short_prompt_deflects(monkeypatch):
+    """Prefill deflection: a short prompt skips the disagg hop entirely —
+    the decode worker prefills locally (no transferred blocks), output
+    still correct, and the flight recorder shows the deflection."""
+    monkeypatch.setenv("DTPU_DEFLECT", "1")
+    monkeypatch.setenv("DTPU_DEFLECT_MAX_TOKENS", "64")
+    from dynamo_tpu.runtime.flight_recorder import get_flight_recorder
+
+    prompt = list(range(100, 130))  # 30 tokens <= 64: deflects
+
+    agg = TpuEngine(tiny_cfg())
+    golden = []
+    try:
+        async for out in agg.generate(preq("golden-defl", prompt), Context()):
+            golden.extend(out.token_ids)
+    finally:
+        agg.stop()
+
+    store = MemKVStore()
+    plane = InProcEventPlane()
+    prefill_rt = await make_rt(store, plane).start()
+    decode_rt = await make_rt(store, plane).start()
+    frontend_rt = await make_rt(store, plane).start()
+    prefill_engine = TpuEngine(tiny_cfg())
+    await prefill_engine.serve_transfer()
+    decode_engine = TpuEngine(tiny_cfg())
+    prefill_card = ModelDeploymentCard(
+        name="disagg-model", component="backend_prefill",
+        model_type=[MODEL_TYPE_PREFILL], tokenizer="byte",
+        kv_block_size=4, context_length=128,
+    )
+    decode_card = ModelDeploymentCard(
+        name="disagg-model", component="backend", tokenizer="byte",
+        kv_block_size=4, context_length=128,
+    )
+    s_prefill = await register_llm(prefill_rt, prefill_engine, prefill_card)
+    s_decode = await register_llm(decode_rt, decode_engine, decode_card)
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager, RouterMode.ROUND_ROBIN).start()
+    try:
+        for _ in range(100):
+            pipe = manager.get("disagg-model")
+            if (
+                pipe is not None and pipe.client.instances
+                and pipe.prefill_router is not None
+                and pipe.prefill_router.has_workers
+            ):
+                break
+            await asyncio.sleep(0.05)
+        pipe = manager.get("disagg-model")
+        got = []
+        async for out in pipe.generate_tokens(preq("defl", prompt), Context()):
+            got.extend(out.token_ids)
+        assert got == golden
+        # deflected: nothing was transferred into the decode allocator from
+        # the prefill engine, and the prefill engine never saw the request
+        flight = get_flight_recorder().timeline("defl") or {"events": []}
+        kinds = [e["event"]["kind"] for e in flight["events"]]
+        assert "prefill_deflected" in kinds, kinds
+        # the prefill pool never prefilled this prompt
+        hashes = compute_sequence_hashes(prompt, 4)
+        assert prefill_engine.allocator.match_prefix(hashes[:7]) == []
+    finally:
+        await watcher.stop()
+        await s_prefill.stop()
+        await s_decode.stop()
+        prefill_engine.stop()
+        decode_engine.stop()
+        await prefill_rt.shutdown()
+        await decode_rt.shutdown()
+        await frontend_rt.shutdown()
 
 
 async def _disagg_matches_aggregated(mcfg=None):

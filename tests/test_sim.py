@@ -209,6 +209,25 @@ def test_multi_region_follow_sun_smoke():
     assert rep["sim"]["passed"], rep["sim"]["invariants"]
 
 
+def test_disagg_streamed_prefill_smoke():
+    """ISSUE 10 acceptance in the sim: streamed disagg TTFT <= the blocking
+    counterfactual, deflection active under the load mix, transfer-cost
+    steering visible, and disagg TTFT within 1.15x of an equal-capacity
+    colocated fleet — all through the REAL PrefillRouter + KvRouter."""
+    rep = run_scenario("disagg-streamed-prefill", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    by_name = {iv["name"]: iv for iv in rep["sim"]["invariants"]}
+    assert by_name["streamed_le_blocking"]["ok"]
+    assert by_name["near_colocated_ttft"]["ok"]
+    assert by_name["deflection_active"]["ok"]
+
+
+def test_disagg_streamed_prefill_same_seed_identical():
+    a = run_scenario("disagg-streamed-prefill", seed=3, **SMOKE)
+    b = run_scenario("disagg-streamed-prefill", seed=3, **SMOKE)
+    assert canonical_json(a["sim"]) == canonical_json(b["sim"])
+
+
 # ---------------------------------------------------------------------------
 # BENCH schema + CLI
 # ---------------------------------------------------------------------------

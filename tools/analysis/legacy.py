@@ -525,6 +525,84 @@ def _kernel_split_pass(ctx: Context) -> Iterator[Finding]:
 _kernel_split_pass.RULES = ("KERNEL-SPLIT",)
 
 
+# -- WIRE-BLOCKING -----------------------------------------------------------
+
+# The disagg transfer plane streams KV in block windows
+# (KvTransferServer._handle_stream / _window_item): the serving side ships
+# each prefill chunk's blocks as they commit, hiding the wire under compute.
+# A request-path call that gathers the FULL multi-block payload in one shot
+# re-serializes the transfer behind the whole prefill — the exact TTFT
+# regression PR 10 removed. The blocking branch of handle() keeps two such
+# calls deliberately (legacy clients, device/native one-shot wires); those
+# sites are baselined.
+WHOLE_PAYLOAD_GATHERS = frozenset({
+    "_gather", "_gather_np", "_gather_quant_np", "_gather_into_arena",
+})
+# functions ALLOWED to call the gather helpers: the streaming window
+# implementation (window-bounded by construction) and the helpers' own
+# bodies (they compose each other)
+_WIRE_STREAMING_FUNCS = frozenset(
+    {"_window_item", "_handle_stream"}
+) | WHOLE_PAYLOAD_GATHERS
+_WIRE_REQUEST_PATH = ("dynamo_tpu/engine/", "dynamo_tpu/llm/")
+
+
+def _is_wire_request_path(norm_path: str) -> bool:
+    # containment (not startswith): fixture trees live outside the repo root
+    return any(seg in norm_path for seg in _WIRE_REQUEST_PATH)
+
+
+def wire_blocking_refs(path: str, tree: ast.AST):
+    out = []
+
+    def msg(name):
+        return (
+            f"request-path code gathers a full multi-block KV payload in one "
+            f"{name} call outside the streaming protocol — serve block "
+            "windows instead (KvTransferServer._handle_stream) so transfer "
+            "overlaps prefill; deliberate blocking-wire sites are baselined"
+        )
+
+    stack: list = []
+
+    def walk(node):
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if is_fn:
+            stack.append(node.name)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if (
+                name in WHOLE_PAYLOAD_GATHERS
+                # any enclosing scope counts: the helpers run their device
+                # work in nested executor closures (def gather(): ...)
+                and not any(f in _WIRE_STREAMING_FUNCS for f in stack)
+            ):
+                out.append((path, node.lineno, msg(name)))
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+        if is_fn:
+            stack.pop()
+
+    walk(tree)
+    return out
+
+
+@register("wire-blocking", "whole-payload KV gathers outside the streaming protocol")
+def _wire_blocking_pass(ctx: Context) -> Iterator[Finding]:
+    for m in ctx.modules:
+        if not _is_wire_request_path(m.path):
+            continue
+        for _p, lineno, msg in wire_blocking_refs(m.path, m.tree):
+            yield Finding("WIRE-BLOCKING", m.path, lineno, msg)
+
+
+_wire_blocking_pass.RULES = ("WIRE-BLOCKING",)
+
+
 # -- PROMETHEUS-IMPORT -------------------------------------------------------
 
 def prometheus_imports(path: str, tree: ast.AST):
