@@ -147,7 +147,12 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
     step_log: dict = {}
     engine.stats_hook = lambda s: step_log.setdefault(s.phase, []).append(s)
 
-    async def one(i: int, n_tokens: int, t_first: list):
+    # per-request (ttft_s, itl_mean_s, tokens) samples for detail.slo —
+    # what the measured latencies score against each named SLA class
+    # (runtime/slo.py bench_slo_detail)
+    slo_samples: list = []
+
+    async def one(i: int, n_tokens: int, t_first: list, t_start=None):
         req = PreprocessedRequest(
             request_id=f"bench-{i}-{n_tokens}",
             model="bench",
@@ -156,10 +161,18 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
             sampling=SamplingOptions(temperature=0.0),
         )
         count = 0
+        first_at = None
         async for out in engine.generate(req, Context()):
             if count == 0 and out.token_ids:
-                t_first.append(time.monotonic())
+                first_at = time.monotonic()
+                t_first.append(first_at)
             count += len(out.token_ids)
+        if t_start is not None and first_at is not None:
+            itl = (
+                (time.monotonic() - first_at) / (count - 1)
+                if count > 1 else None
+            )
+            slo_samples.append((first_at - t_start, itl, count))
         return count
 
     try:
@@ -170,7 +183,8 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
         t_firsts: list = []
         t0 = time.monotonic()
         counts = await asyncio.gather(
-            *[one(100 + i, DECODE_TOKENS, t_firsts) for i in range(batch)]
+            *[one(100 + i, DECODE_TOKENS, t_firsts, t_start=t0)
+              for i in range(batch)]
         )
         t1 = time.monotonic()
     finally:
@@ -199,6 +213,7 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
     # dead on this image); tier-1 asserts streamed <= blocking.
     from dynamo_tpu.ops.costs import streamed_transfer_model
     from dynamo_tpu.runtime.bandwidth import WIRE_PRIORS
+    from dynamo_tpu.runtime.slo import bench_slo_detail
 
     kv_itemsize = 1 if kv_dtype == "int8" else 2
     chunk = min(PROMPT_LEN, cfg.prefill_chunk)
@@ -261,6 +276,10 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
             ),
             "kernel_bytes": kernel_bytes,
             "transfer": transfer_detail,
+            # per-class attainment + burn rate of the measured latencies
+            # against the named SLA classes (runtime/slo.py; tier-1 pins
+            # the schema in tests/test_slo.py)
+            "slo": bench_slo_detail(slo_samples),
             "step_telemetry": {
                 phase: _phase_summary(samples)
                 for phase, samples in sorted(step_log.items())

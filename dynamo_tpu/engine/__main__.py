@@ -623,6 +623,13 @@ async def main() -> None:
     from dynamo_tpu.runtime.bandwidth import get_bandwidth_estimator
 
     get_bandwidth_estimator().attach_metrics(tele_scope)
+    # worker-side SLO ledger (runtime/slo.py): the engine feeds the global
+    # accountant from milestone timestamps; binding it here puts goodput +
+    # attainment/burn gauges on this worker's /metrics (and /debug/slo on
+    # the status server reads the same ledger)
+    from dynamo_tpu.runtime.slo import get_slo_accountant
+
+    get_slo_accountant().bind_metrics(tele_scope)
     if mh is not None:
         # follower death is unrecoverable for the group (its mesh shards are
         # gone): mark every engine unhealthy — the watchdog deregisters and
@@ -782,6 +789,8 @@ async def main() -> None:
             g_waiting.set(sum(r["waiting"] for r in ranks))
             g_free.set(sum(r["free_blocks"] for r in ranks))
             g_cached.set(sum(r["cached_blocks"] for r in ranks))
+            # rolling attainment/burn gauges follow the scrape clock
+            get_slo_accountant().export_metrics()
 
         status_server = StatusServer(
             health,

@@ -57,6 +57,7 @@ from ..runtime.errors import (
 )
 from ..runtime.faults import FAULTS
 from ..runtime.flight_recorder import get_flight_recorder
+from ..runtime.slo import get_slo_accountant, sla_t0_ns, spec_from_annotations
 from ..runtime.tasks import spawn_bg
 from ..runtime.logging import get_logger
 from ..runtime.tracing import get_tracer
@@ -317,6 +318,9 @@ class _Seq:
     t_admitted: int = 0
     t_prefill_start: int = 0
     t_first_token: int = 0
+    # SLO accounting (runtime/slo.py): the request's promise parsed from the
+    # sla annotation at accept time; None = unclassified (no accounting)
+    sla: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -2316,11 +2320,21 @@ class TpuEngine:
                 log.exception("kvbm onboard failed; prefilling from scratch")
         # disaggregated prefill: announce our pages on the way out
         is_prefill_side = req.annotations.get("disagg") == "prefill"
+        st.sla = spec_from_annotations(req.annotations)
         st.t_queued = time.time_ns()
-        flight.record(
-            req.request_id, "queued",
+        queued_fields: Dict[str, Any] = dict(
             prompt_tokens=n_prompt, waiting=len(self._waiting),
         )
+        if st.sla is not None:
+            # the queued event carries the promise so /debug/requests?id=
+            # can compute the budget breakdown (runtime/slo.py) at read time
+            queued_fields.update(
+                sla_class=st.sla.sla_class,
+                ttft_target_s=st.sla.ttft_target_s,
+                itl_target_s=st.sla.itl_target_s,
+                deadline_s=st.sla.deadline_s,
+            )
+        flight.record(req.request_id, "queued", **queued_fields)
         self._waiting.append(st)
         self._wake.set()
         while True:
@@ -4061,12 +4075,15 @@ class TpuEngine:
         flight-recorder timeline. Host-side bookkeeping only."""
         flight = get_flight_recorder()
         rid = st.req.request_id
+        if st.sla is not None:
+            self._slo_finished(st, finish_reason)
         flight.finish(
             rid,
             error=("engine error finish" if finish_reason == FINISH_ERROR else None),
             error_class="engine_error" if finish_reason == FINISH_ERROR else None,
             finish_reason=finish_reason,
             tokens=st.produced,
+            **({"sla_class": st.sla.sla_class} if st.sla is not None else {}),
         )
         tracer = get_tracer()
         if not tracer.enabled:
@@ -4091,6 +4108,42 @@ class TpuEngine:
                 "engine.decode", st.t_first_token, time.time_ns(),
                 traceparent=tp, request_id=rid, status=status,
                 tokens=st.produced, finish=finish_reason,
+            )
+
+    def _slo_finished(self, st: "_Seq", finish_reason: str) -> None:
+        """Feed the worker-side SLO ledger from the milestone timestamps the
+        loop already stamped (host-side scalars — no device sync). TTFT is
+        anchored on the frontend receipt stamp riding the sla annotation
+        when present (same-host wall clock), else on engine queue entry;
+        ITL is the request's mean decode gap."""
+        spec = st.sla
+        now_ns = time.time_ns()
+        t0 = sla_t0_ns(st.req.annotations) or st.t_queued
+        ttft_s = (
+            (st.t_first_token - t0) / 1e9 if st.t_first_token else None
+        )
+        itl_s = None
+        if st.t_first_token and st.produced > 1:
+            itl_s = (now_ns - st.t_first_token) / 1e9 / (st.produced - 1)
+        e2e_s = (now_ns - t0) / 1e9
+        met = get_slo_accountant().record(
+            st.req.model, spec,
+            ttft_s=ttft_s, itl_s=itl_s,
+            output_tokens=st.produced, e2e_s=e2e_s,
+        )
+        fields: Dict[str, Any] = dict(
+            sla_class=spec.sla_class,
+            met=met,
+            ttft_ms=(None if ttft_s is None else round(ttft_s * 1e3, 3)),
+            ttft_target_ms=round(spec.ttft_target_s * 1e3, 3),
+            itl_ms=(None if itl_s is None else round(itl_s * 1e3, 3)),
+            itl_target_ms=round(spec.itl_target_s * 1e3, 3),
+        )
+        if spec.deadline_s > 0:
+            fields["deadline_remaining_s"] = round(spec.deadline_s - e2e_s, 3)
+        if not met or finish_reason == FINISH_ERROR:
+            get_flight_recorder().record(
+                st.req.request_id, "slo_violation", **fields
             )
 
     def _step_stats(self, phase: str, duration_s: float, tokens: int) -> None:

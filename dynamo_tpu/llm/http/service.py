@@ -26,6 +26,14 @@ from ...runtime.flight_recorder import get_flight_recorder
 from ...runtime.logging import get_logger
 from ...runtime.request_plane.tcp import NoResponders
 from ...runtime.resilience import CircuitBreaker
+from ...runtime.slo import (
+    SLA_HEADER,
+    ANNOTATION_SLA,
+    SlaSpec,
+    SloAccountant,
+    debug_slo_payload,
+    resolve_sla,
+)
 from ...runtime.tracing import Tracer, get_tracer
 from ..audit import AuditBus
 from ...parsers import get_reasoning_parser, get_tool_parser
@@ -146,6 +154,9 @@ def _openapi_spec() -> dict:
             "/debug/requests": {"get": op(
                 "Flight-recorder request timelines", tag="system"
             )},
+            "/debug/slo": {"get": op(
+                "Per-class SLO attainment / burn-rate ledger", tag="system"
+            )},
             "/openapi.json": {"get": op("This document", tag="system")},
         },
     }
@@ -186,16 +197,24 @@ class HttpService:
         self._inflight_g = self.metrics.gauge(M.INFLIGHT_REQUESTS, "in-flight requests")
         self._duration = self.metrics.histogram(
             M.REQUEST_DURATION_SECONDS, "end-to-end request duration",
-            extra_labels=(M.LABEL_MODEL,),
+            extra_labels=(M.LABEL_MODEL, M.LABEL_SLA_CLASS),
             buckets=(0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
                      120.0),
         )
+        # SLO accounting (runtime/slo.py): this frontend's client-observed
+        # ledger — attainment/burn-rate/goodput per (model, sla_class), fed
+        # from the same stream observation that drives the histograms above
+        # and served on /debug/slo. Worker-side engines keep their own
+        # ledger from milestone timestamps (StatusServer /debug/slo).
+        self.slo = SloAccountant(metrics=self.metrics)
         self._ttft = self.metrics.histogram(
-            M.TTFT_SECONDS, "time to first token", extra_labels=(M.LABEL_MODEL,),
+            M.TTFT_SECONDS, "time to first token",
+            extra_labels=(M.LABEL_MODEL, M.LABEL_SLA_CLASS),
             buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
         )
         self._itl = self.metrics.histogram(
-            M.ITL_SECONDS, "inter-token latency", extra_labels=(M.LABEL_MODEL,),
+            M.ITL_SECONDS, "inter-token latency",
+            extra_labels=(M.LABEL_MODEL, M.LABEL_SLA_CLASS),
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0),
         )
         self._input_tokens = self.metrics.counter(
@@ -260,6 +279,7 @@ class HttpService:
         app.router.add_get("/openapi.json", self.openapi)
         app.router.add_get("/docs", self.docs)
         app.router.add_get("/debug/requests", self.debug_requests)
+        app.router.add_get("/debug/slo", self.debug_slo)
         return app
 
     async def start(self) -> str:
@@ -296,6 +316,9 @@ class HttpService:
         return web.json_response({"status": "live"})
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
+        # attainment/burn gauges are derived from rolling windows: refresh
+        # them at scrape time so they track the scrape clock, not traffic
+        self.slo.export_metrics()
         return web.Response(body=self.metrics.expose(), content_type="text/plain")
 
     async def debug_requests(self, request: web.Request) -> web.Response:
@@ -309,6 +332,26 @@ class HttpService:
             request.query.get("id"), request.query.get("limit"),
         )
         return web.json_response(payload, status=status)
+
+    async def debug_slo(self, request: web.Request) -> web.Response:
+        """Per-(model, sla_class) attainment/burn-rate ledger
+        (runtime/slo.py) — the client-observed view this frontend keeps."""
+        return web.json_response(debug_slo_payload(self.slo))
+
+    def _resolve_sla(self, request: web.Request, body_class: Optional[str],
+                     pipeline: ModelPipeline):
+        """(spec, error_response): the request's SLA class from the body
+        ``sla`` field, the x-dtpu-sla header, or the default class — with
+        the model card's per-class target overrides applied. An unknown
+        class is a 400 (silently serving untracked would defeat the
+        accounting plane)."""
+        name = body_class or request.headers.get(SLA_HEADER)
+        spec = resolve_sla(name, pipeline.card.runtime_config.sla_classes)
+        if spec is None:
+            return None, _error(
+                400, f"unknown SLA class {name!r}", "invalid_request_error"
+            )
+        return spec, None
 
     async def models(self, request: web.Request) -> web.Response:
         data = ModelList(
@@ -461,8 +504,12 @@ class HttpService:
     def _observed(
         self, stream: AsyncIterator[BackendOutput], model: str, t_start: float,
         prompt_tokens: int = 0, request_id: str = "",
+        sla: Optional[SlaSpec] = None,
     ) -> AsyncIterator[BackendOutput]:
-        """Wrap the token stream with TTFT/ITL observation."""
+        """Wrap the token stream with TTFT/ITL observation. With an
+        ``sla`` spec the samples land class-labeled and the stream's
+        outcome feeds the frontend SLO ledger + the planner stats topic."""
+        cls = sla.sla_class if sla is not None else ""
 
         async def gen():
             first_at = None
@@ -475,24 +522,50 @@ class HttpService:
                         n_tokens += len(out.token_ids)
                         if first_at is None:
                             first_at = now
-                            self._ttft.observe(now - t_start, model=model)
+                            self._ttft.observe(
+                                now - t_start, model=model, sla_class=cls
+                            )
                             get_flight_recorder().record(
                                 request_id, "first_token",
                                 ttft_ms=round((now - t_start) * 1e3, 3),
                             )
                         elif last_at is not None:
-                            self._itl.observe(now - last_at, model=model)
+                            self._itl.observe(
+                                now - last_at, model=model, sla_class=cls
+                            )
                         last_at = now
                     yield out
             finally:
-                if self.stats_hook is not None and first_at is not None:
-                    itl = (
-                        (last_at - first_at) / (n_tokens - 1)
-                        if last_at and n_tokens > 1 else 0.0
+                itl = (
+                    (last_at - first_at) / (n_tokens - 1)
+                    if first_at is not None and last_at and n_tokens > 1
+                    else 0.0
+                )
+                met = None
+                if sla is not None and first_at is not None:
+                    met = self.slo.record(
+                        model, sla,
+                        ttft_s=first_at - t_start,
+                        itl_s=(itl if n_tokens > 1 else None),
+                        output_tokens=n_tokens,
+                        e2e_s=time.monotonic() - t_start,
                     )
+                if self.stats_hook is not None and first_at is not None:
                     try:
                         self.stats_hook(
-                            prompt_tokens, n_tokens, first_at - t_start, itl
+                            prompt_tokens, n_tokens, first_at - t_start, itl,
+                            **(
+                                dict(
+                                    sla_class=sla.sla_class,
+                                    ttft_target_s=sla.ttft_target_s,
+                                    itl_target_s=sla.itl_target_s,
+                                    # the accountant's verdict rides along
+                                    # so the planner's per-class attainment
+                                    # can't drift from /debug/slo semantics
+                                    sla_met=met,
+                                )
+                                if sla is not None else {}
+                            ),
                         )
                     except Exception:
                         log.exception("stats hook failed")
@@ -561,6 +634,7 @@ class HttpService:
         aggregator,
         audit_handle=None,
         usage_chunk_factory=None,
+        sla: Optional[SlaSpec] = None,
     ) -> web.StreamResponse:
         """Execute one generation request: routing, streaming, metrics, errors.
 
@@ -588,13 +662,20 @@ class HttpService:
             request_id=rid, model=model, streaming=stream_mode,
             n=len(preqs),
         )
+        sla_ann = sla.to_annotation() if sla is not None else None
         for p in preqs:
             p.annotations["traceparent"] = span.traceparent()
+            if sla_ann is not None:
+                # the promise rides the request plane like the traceparent:
+                # router, prefill router, engine and flight recorder all see
+                # (sla_class, ttft/itl targets, deadline, receipt stamp)
+                p.annotations[ANNOTATION_SLA] = dict(sla_ann)
         span.__enter__()
         flight = get_flight_recorder()
         flight.record(
             rid, "received",
             model=model, streaming=stream_mode, choices=len(preqs),
+            **({"sla_class": sla.sla_class} if sla is not None else {}),
         )
         flight.record(rid, "tokenized", prompt_tokens=len(preqs[0].token_ids))
         fail_msg: Optional[str] = None
@@ -605,6 +686,7 @@ class HttpService:
                 self._observed(
                     pipeline.generate_tokens(p, c), model, t0,
                     prompt_tokens=len(p.token_ids), request_id=rid,
+                    sla=sla,
                 )
                 for p, c in zip(preqs, ctxs)
             ]
@@ -675,8 +757,23 @@ class HttpService:
             # only worker loss (503) counts against the circuit; application
             # errors mean the workers ARE responding
             cb.record(status != "503")
+            if (
+                sla is not None and status not in ("200", "499")
+                and completion_tokens == 0
+            ):
+                # died before a first token: _observed never accounted it,
+                # but a broken promise during an outage is exactly what the
+                # client-observed ledger exists to surface (ttft unobserved
+                # counts as a combined miss, not a ttft sample)
+                self.slo.record(
+                    model, sla, ttft_s=None, output_tokens=0,
+                    e2e_s=time.monotonic() - t0,
+                )
             self._requests.inc(model=model, status=status)
-            self._duration.observe(time.monotonic() - t0, model=model)
+            self._duration.observe(
+                time.monotonic() - t0, model=model,
+                sla_class=(sla.sla_class if sla is not None else ""),
+            )
             self._input_tokens.inc(prompt_tokens, model=model)
             self._output_tokens.inc(completion_tokens, model=model)
             for c in ctxs:
@@ -734,6 +831,9 @@ class HttpService:
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return _error(404, f"model '{req.model}' not found", "model_not_found")
+        sla, sla_err = self._resolve_sla(request, req.sla, pipeline)
+        if sla_err is not None:
+            return sla_err
         try:
             preq = pipeline.preprocessor.preprocess_chat(req)
         except ValueError as e:
@@ -790,6 +890,7 @@ class HttpService:
             aggregator,
             audit_handle=audit_handle,
             usage_chunk_factory=usage_chunk_factory,
+            sla=sla,
         )
 
     async def embeddings(self, request: web.Request) -> web.Response:
@@ -918,6 +1019,9 @@ class HttpService:
         pipeline = self.manager.get(rreq.model)
         if pipeline is None:
             return _error(404, f"model '{rreq.model}' not found", "model_not_found")
+        sla, sla_err = self._resolve_sla(request, rreq.sla, pipeline)
+        if sla_err is not None:
+            return sla_err
         try:
             preq = pipeline.preprocessor.preprocess_chat(chat)
         except ValueError as e:
@@ -955,11 +1059,14 @@ class HttpService:
             request_id=preq.request_id, model=rreq.model, streaming=rreq.stream,
         )
         preq.annotations["traceparent"] = span.traceparent()
+        if sla is not None:
+            preq.annotations[ANNOTATION_SLA] = sla.to_annotation()
         span.__enter__()
         flight = get_flight_recorder()
         flight.record(
             preq.request_id, "received",
             model=rreq.model, streaming=rreq.stream, choices=1,
+            **({"sla_class": sla.sla_class} if sla is not None else {}),
         )
         flight.record(
             preq.request_id, "tokenized", prompt_tokens=len(preq.token_ids)
@@ -971,6 +1078,7 @@ class HttpService:
             stream = self._observed(
                 pipeline.generate_tokens(preq, ctx), rreq.model, t0,
                 prompt_tokens=len(preq.token_ids), request_id=preq.request_id,
+                sla=sla,
             )
             if not rreq.stream:
                 text = []
@@ -1035,8 +1143,19 @@ class HttpService:
             self.inflight -= 1
             self._inflight_g.set(self.inflight)
             cb.record(status != "503")
+            if (
+                sla is not None and status not in ("200", "499")
+                and completion_tokens == 0
+            ):
+                self.slo.record(
+                    rreq.model, sla, ttft_s=None, output_tokens=0,
+                    e2e_s=time.monotonic() - t0,
+                )
             self._requests.inc(model=rreq.model, status=status)
-            self._duration.observe(time.monotonic() - t0, model=rreq.model)
+            self._duration.observe(
+                time.monotonic() - t0, model=rreq.model,
+                sla_class=(sla.sla_class if sla is not None else ""),
+            )
             self._input_tokens.inc(prompt_tokens, model=rreq.model)
             self._output_tokens.inc(completion_tokens, model=rreq.model)
             ctx.stop_generating()
@@ -1065,6 +1184,9 @@ class HttpService:
         pipeline = self.manager.get(req.model)
         if pipeline is None:
             return _error(404, f"model '{req.model}' not found", "model_not_found")
+        sla, sla_err = self._resolve_sla(request, req.sla, pipeline)
+        if sla_err is not None:
+            return sla_err
         prompt = req.prompt
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], (list, str)):
             if len(prompt) > 1 or isinstance(prompt[0], list):
@@ -1112,4 +1234,5 @@ class HttpService:
             audit_handle=self.audit.create_handle(
                 body, rid, req.model, req.stream
             ),
+            sla=sla,
         )

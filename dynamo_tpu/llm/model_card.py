@@ -52,6 +52,10 @@ class ModelRuntimeConfig:
     # (kvbm/layout.kv_bytes_per_token * block_size; int8 is ~half bf16) —
     # transfer-cost-aware disagg routing prices candidate wires with it
     kv_bytes_per_block: int = 0
+    # per-model SLA target overrides keyed by class name, e.g.
+    # {"interactive": {"ttft_target_s": 0.3}} — merged over the named-class
+    # table by runtime/slo.resolve_sla at the frontend
+    sla_classes: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
 
     def to_obj(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

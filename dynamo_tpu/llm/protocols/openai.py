@@ -65,6 +65,10 @@ class SamplingFields(_Lenient):
     logprobs: Optional[Union[bool, int]] = None
     top_logprobs: Optional[int] = Field(default=None, ge=0, le=20)
     ignore_eos: Optional[bool] = None  # extension, matches reference nvext
+    # SLA class extension (runtime/slo.py): named class ("interactive" /
+    # "standard" / "batch" / DTPU_SLA_CLASSES); also accepted as the
+    # x-dtpu-sla header — the body field wins when both are set
+    sla: Optional[str] = None
     # guided decoding extensions (reference nvext guided_* fields,
     # lib/llm/src/protocols/openai/common_ext.rs:175-219): at most one may
     # be set; chat requests can also use response_format json_schema /
@@ -168,6 +172,8 @@ class ResponsesRequest(_Lenient):
     top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
     stream: bool = False
     user: Optional[str] = None
+    # SLA class extension (runtime/slo.py), same semantics as the chat field
+    sla: Optional[str] = None
 
     def to_chat(self) -> "ChatCompletionRequest":
         messages: List[ChatMessage] = []

@@ -149,6 +149,10 @@ class LoadSnapshot:
     # the correction factors (reference planner_core.py:766-820)
     measured_ttft: float = 0.0
     measured_itl: float = 0.0
+    # per-SLA-class attainment over the window (runtime/slo.py classes;
+    # empty when no class-labeled stats arrived): the signal that lets the
+    # planner scale against promises instead of raw load
+    class_attainment: Dict[str, float] = dataclasses.field(default_factory=dict)
     ts: float = dataclasses.field(default_factory=time.time)
 
 

@@ -48,11 +48,26 @@ class FrontendStatsPublisher:
         self._inflight: set = set()
 
     def on_request(self, prompt_tokens: int, completion_tokens: int,
-                   ttft_s: float, itl_s: float) -> None:
-        payload = msgpack.packb({
+                   ttft_s: float, itl_s: float, sla_class: str = "",
+                   ttft_target_s: float = 0.0,
+                   itl_target_s: float = 0.0,
+                   sla_met: "bool | None" = None) -> None:
+        obj = {
             "pt": int(prompt_tokens), "ct": int(completion_tokens),
             "ttft": float(ttft_s), "itl": float(itl_s), "ts": self._clock(),
-        }, use_bin_type=True)
+        }
+        if sla_class:
+            # class-labeled latency record (runtime/slo.py): the planner
+            # derives per-class attainment from these — targets ride along
+            # so the aggregator needs no SLA-class table of its own, and
+            # the publisher's accountant verdict (when it has one) wins so
+            # deadline-bound classes can't drift from /debug/slo
+            obj["sla"] = str(sla_class)
+            obj["tt"] = float(ttft_target_s)
+            obj["it"] = float(itl_target_s)
+            if sla_met is not None:
+                obj["ok"] = bool(sla_met)
+        payload = msgpack.packb(obj, use_bin_type=True)
 
         async def _send() -> None:
             try:
@@ -91,6 +106,9 @@ class EventPlaneMetricsSource:
         self._requests_window = 0
         self._ttft_window: list = []
         self._itl_window: list = []
+        # sla_class -> [met_count, total] over the window (met = ttft and,
+        # when observed, itl within the record's own targets)
+        self._class_window: Dict[str, list] = {}
 
     async def start(self) -> "EventPlaneMetricsSource":
         for comp in self.components:
@@ -120,6 +138,15 @@ class EventPlaneMetricsSource:
                     ttft_s=float(st.get("ttft", 0.0)),
                     itl_s=float(st.get("itl", 0.0)),
                 )
+                if st.get("sla"):
+                    self.record_class_outcome(
+                        str(st["sla"]),
+                        ttft_s=float(st.get("ttft", 0.0)),
+                        ttft_target_s=float(st.get("tt", 0.0)),
+                        itl_s=float(st.get("itl", 0.0)),
+                        itl_target_s=float(st.get("it", 0.0)),
+                        met=(bool(st["ok"]) if "ok" in st else None),
+                    )
             except Exception:
                 log.exception("bad frontend stats")
 
@@ -137,6 +164,25 @@ class EventPlaneMetricsSource:
             self._ttft_window.append(ttft_s)
         if itl_s > 0:
             self._itl_window.append(itl_s)
+
+    def record_class_outcome(self, sla_class: str, ttft_s: float,
+                             ttft_target_s: float, itl_s: float,
+                             itl_target_s: float,
+                             met: "bool | None" = None) -> None:
+        """One class-labeled request outcome; targets come from the record
+        itself (per-model overrides make one class mean different numbers
+        on different models). An explicit ``met`` (the publisher-side
+        SloAccountant verdict, which also folds in deadlines) overrides
+        the local derivation."""
+        if met is None:
+            met = (
+                (ttft_target_s <= 0.0 or ttft_s <= ttft_target_s)
+                and (itl_target_s <= 0.0 or itl_s <= 0.0
+                     or itl_s <= itl_target_s)
+            )
+        cell = self._class_window.setdefault(sla_class, [0, 0])
+        cell[0] += 1 if met else 0
+        cell[1] += 1
 
     def snapshot(self) -> LoadSnapshot:
         now = self._clock()
@@ -161,6 +207,10 @@ class EventPlaneMetricsSource:
                 sum(self._itl_window) / len(self._itl_window)
                 if self._itl_window else 0.0
             ),
+            class_attainment={
+                cls: round(met / max(total, 1), 4)
+                for cls, (met, total) in sorted(self._class_window.items())
+            },
         )
         self._last_rate_calc = now
         self._prefill_tokens_window = 0
@@ -168,6 +218,7 @@ class EventPlaneMetricsSource:
         self._requests_window = 0
         self._ttft_window = []
         self._itl_window = []
+        self._class_window = {}
         return snap
 
     def stop(self) -> None:

@@ -77,15 +77,9 @@ async def _replay_main(argv) -> None:
     finally:
         for e in engines:
             e.stop()
-    print(json.dumps({
-        "requests": rep.completed,
-        "workers": args.workers,
-        "ttft_attainment": round(rep.ttft_attainment, 4),
-        "itl_attainment": round(rep.itl_attainment, 4),
-        "ttft_p95_s": round(rep.ttft_p95_s, 4),
-        "itl_p95_s": round(rep.itl_p95_s, 4),
-        "cache_hit_ratio": round(rep.cache_hit_ratio, 4),
-    }))
+    # one source of truth for SLA math + report shape (profiler/loadgen.py
+    # -> runtime/slo.py); byte-identical output pinned by tests/test_slo.py
+    print(json.dumps(loadgen.sla_report_obj(rep, args.workers)))
 
 
 def parse_args():

@@ -47,6 +47,10 @@ ENV_TRACE_JSONL = "DTPU_TRACE_JSONL"                  # span JSONL file
 ENV_FLIGHT_CAPACITY = "DTPU_FLIGHT_CAPACITY"          # retained request timelines
 ENV_FLIGHT_DUMP = "DTPU_FLIGHT_DUMP"                  # JSONL path for failure dumps
 ENV_SLOW_STEP_MS = "DTPU_SLOW_STEP_MS"                # slow-step log threshold
+# SLO accounting (runtime/slo.py)
+ENV_SLA_CLASSES = "DTPU_SLA_CLASSES"                  # "interactive:ttft=0.5,itl=0.05;batch:ttft=30"
+ENV_SLA_DEFAULT = "DTPU_SLA_DEFAULT"                  # class stamped when a request names none
+ENV_SLO_OBJECTIVE = "DTPU_SLO_OBJECTIVE"              # attainment objective for burn rate (0.99)
 # lora (lora/cache.py)
 ENV_LORA_CACHE = "DTPU_LORA_CACHE"                    # adapter cache dir
 # kvbm remote tier (kvbm/remote.py)

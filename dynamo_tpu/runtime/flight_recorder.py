@@ -162,6 +162,14 @@ def debug_requests_payload(
             return 404, {
                 "error": f"request {request_id!r} not in the flight recorder"
             }
+        # single-request view gains the SLO budget breakdown (queue/prefill/
+        # decode share of the TTFT target, remaining deadline) when the
+        # engine stamped the request's sla class onto its queued event
+        from .slo import budget_breakdown
+
+        slo = budget_breakdown(flight)
+        if slo is not None:
+            flight = dict(flight, slo=slo)
         return 200, flight
     try:
         limit = int(limit_raw) if limit_raw is not None else 64

@@ -162,6 +162,9 @@ class StatusServer:
       /v1/loras  loaded LoRA adapters (system_status_server.rs:196-215)
       /debug/requests  flight-recorder timelines (runtime/flight_recorder.py);
                  ``?id=<request_id>`` returns one timeline, 404 if evicted
+      /debug/slo  per-(model, sla_class) attainment/burn-rate/goodput ledger
+                 (runtime/slo.py SloAccountant; the worker-side view fed
+                 from engine milestone timestamps)
     """
 
     def __init__(
@@ -194,6 +197,7 @@ class StatusServer:
         app.router.add_get("/metadata", self._metadata)
         app.router.add_get("/v1/loras", self._loras)
         app.router.add_get("/debug/requests", self._debug_requests)
+        app.router.add_get("/debug/slo", self._debug_slo)
         self.app = app
 
     async def _health(self, request: web.Request) -> web.Response:
@@ -229,6 +233,11 @@ class StatusServer:
             rec, request.query.get("id"), request.query.get("limit")
         )
         return web.json_response(payload, status=status)
+
+    async def _debug_slo(self, request: web.Request) -> web.Response:
+        from .slo import debug_slo_payload, get_slo_accountant
+
+        return web.json_response(debug_slo_payload(get_slo_accountant()))
 
     async def start(self) -> str:
         self._runner = web.AppRunner(self.app, access_log=None)

@@ -48,6 +48,10 @@ SLOW_STEPS_TOTAL = f"{PREFIX}_engine_slow_steps_total"
 # resilience (runtime/resilience.py): per-policy retry/breaker observability
 KV_WIRE_BANDWIDTH = f"{PREFIX}_kv_wire_bandwidth_bytes_per_s"
 PREFILL_DEFLECTED_TOTAL = f"{PREFIX}_prefill_deflected_total"
+# SLO accounting plane (runtime/slo.py): per-(model, sla_class) promises
+SLO_ATTAINMENT = f"{PREFIX}_slo_attainment_ratio"
+SLO_BURN_RATE = f"{PREFIX}_slo_burn_rate"
+GOODPUT_TOKENS = f"{PREFIX}_goodput_tokens_total"
 
 RETRY_ATTEMPTS_TOTAL = f"{PREFIX}_retry_attempts_total"
 RETRY_GIVEUPS_TOTAL = f"{PREFIX}_retry_giveups_total"
@@ -58,6 +62,8 @@ LABEL_NAMESPACE = "dtpu_namespace"
 LABEL_COMPONENT = "dtpu_component"
 LABEL_ENDPOINT = "dtpu_endpoint"
 LABEL_MODEL = "model"
+LABEL_SLA_CLASS = "sla_class"
+LABEL_WINDOW = "window"
 
 
 class MetricsScope:

@@ -54,6 +54,7 @@ from ..runtime.event_plane.base import InProcEventPlane
 from ..runtime.faults import FAULTS, FaultInjected, parse_faults
 from ..runtime.logging import get_logger
 from ..runtime.resilience import CLOSED, OPEN, CircuitBreaker
+from ..runtime.slo import SlaSpec, SloAccountant
 from .clock import Clock
 from .traces import SimRequest
 
@@ -123,6 +124,7 @@ class RequestRecord:
     group: int
     region: str
     pool: str
+    sla_class: str
     t_arrive: float
     isl: int
     osl: int
@@ -189,6 +191,11 @@ class SimPool:
         self.stats_pub = FrontendStatsPublisher(
             self.plane, cfg.namespace, clock=self.clock.time
         )
+        # the REAL SLO accountant (runtime/slo.py) on the virtual clock:
+        # scenario SLA invariants read per-class attainment from here —
+        # the same code path the frontend serves on /debug/slo. Objective
+        # pinned (not env) so reports stay a pure function of the seed.
+        self.slo = SloAccountant(clock=self.clock.time, objective=0.99)
         self.metrics_source: Optional[EventPlaneMetricsSource] = None
         self.planner: Optional[PoolPlanner] = None
         # -- deterministic outputs -------------------------------------------
@@ -363,6 +370,7 @@ class SimPool:
         t_arrive = self.clock.time()
         rec = RequestRecord(
             idx=idx, group=item.group, region=sreq.region, pool=self.cfg.name,
+            sla_class=sreq.sla_class,
             t_arrive=round(t_arrive, 6), isl=item.isl, osl=item.osl,
             ttft_target_s=sreq.ttft_target_s, itl_target_s=sreq.itl_target_s,
         )
@@ -402,13 +410,29 @@ class SimPool:
                 rec.ok = True
                 rec.worker = wid
                 w.requests += 1
+                # feed the production accountant with the record's own
+                # promise — the per-class ledger the invariants assert on
+                met = self.slo.record(
+                    "sim",
+                    SlaSpec(rec.sla_class, rec.ttft_target_s,
+                            rec.itl_target_s),
+                    ttft_s=rec.ttft_s,
+                    itl_s=(rec.itl_mean_s if rec.itl_count else None),
+                    output_tokens=rec.produced,
+                    e2e_s=self.clock.time() - t_arrive,
+                )
                 # the real stack's frontend stats fan-out: planner
-                # correction factors read these measured latencies
+                # correction factors read these measured latencies, and the
+                # accountant verdict rides along like the HTTP frontend's
                 self.stats_pub.on_request(
                     prompt_tokens=rec.input_tokens or len(tokens),
                     completion_tokens=rec.produced,
                     ttft_s=rec.ttft_s,
                     itl_s=rec.itl_mean_s,
+                    sla_class=rec.sla_class,
+                    ttft_target_s=rec.ttft_target_s,
+                    itl_target_s=rec.itl_target_s,
+                    sla_met=met,
                 )
                 break
         self.records.append(rec)

@@ -138,9 +138,21 @@ def pool_report(pool: SimPool) -> dict:
             pool.correction_timeline[-1] if pool.correction_timeline else 1.0
         ),
         "breaker_events": pool.breaker_events,
+        # the production SloAccountant's ledger (fed per completed request
+        # on the virtual clock): per-class windows, burn rates, goodput —
+        # scenario SLA invariants read these instead of re-deriving math
+        "slo": _slo_section(pool),
     }
     pool._report_cache = (key, rep)
     return rep
+
+
+def _slo_section(pool: SimPool) -> dict:
+    snap = pool.slo.snapshot()
+    return {
+        "objective": snap["objective"],
+        "classes": snap["models"].get("sim", {}),
+    }
 
 
 def _itl_target(pool: SimPool) -> float:

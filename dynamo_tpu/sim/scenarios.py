@@ -54,6 +54,23 @@ def _invariant(name: str, ok: bool, detail: str) -> Invariant:
     return Invariant(name, bool(ok), detail)
 
 
+def _slo_attainment(pool, sla_class: str, kind: str = "ttft") -> float:
+    """Cumulative attainment from the pool's production ``SloAccountant``
+    (sim/fleet.py feeds it per completed request on the virtual clock)."""
+    att = pool.slo.attainment("sim", sla_class, window="total", kind=kind)
+    return round(att, 4) if att is not None else 0.0
+
+
+def _trace_ttft_attainment(pool) -> float:
+    """The scenario-local math the accountant replaces — kept only as the
+    agreement counterfactual for the mixed-SLA check."""
+    done = [r for r in pool.records if r.ok]
+    return round(
+        sum(1 for r in done if r.ttft_s <= r.ttft_target_s)
+        / max(len(done), 1), 4,
+    )
+
+
 # ---------------------------------------------------------------------------
 # diurnal-autoscale
 # ---------------------------------------------------------------------------
@@ -117,9 +134,12 @@ async def _diurnal_autoscale(
             "all_completed", rep["failed"] == 0,
             f'{rep["completed"]}/{rep["requests"]} completed',
         ),
+        # re-derived from the production SloAccountant (runtime/slo.py) on
+        # the virtual clock, not scenario-local percentile math
         _invariant(
-            "ttft_sla_held", rep["ttft_attainment"] >= 0.75,
-            f'ttft attainment {rep["ttft_attainment"]} (>= 0.75)',
+            "ttft_sla_held", _slo_attainment(pool, "standard") >= 0.75,
+            f'accountant ttft attainment '
+            f'{_slo_attainment(pool, "standard")} (>= 0.75)',
         ),
     ]
     return {"fleet": fleet, "invariants": invs, "requests": len(trace)}
@@ -306,9 +326,9 @@ async def _multi_pool_balance(
     w_inter = max(2, workers // 2)
     w_batch = max(2, workers - w_inter)
     classes = [
-        {"weight": 0.65, "isl": 128, "osl": 8,
+        {"name": "interactive", "weight": 0.65, "isl": 128, "osl": 8,
          "ttft_target_s": 8.0, "itl_target_s": 3.0},
-        {"weight": 0.35, "isl": 1024, "osl": 24,
+        {"name": "batch", "weight": 0.35, "isl": 1024, "osl": 24,
          "ttft_target_s": 60.0, "itl_target_s": 3.0},
     ]
     # interactive pool is sized for short prompts; batch pool absorbs the
@@ -386,6 +406,22 @@ async def _multi_pool_balance(
             f"hottest interactive worker share {max_share(rep_i):.3f} "
             f"(fair {fair_i:.3f})",
         ),
+        # mixed-SLA-classes accounting: the production SloAccountant's
+        # per-class ledger must (a) hold the interactive promise and (b)
+        # agree exactly with the trace-derived attainment — proving the
+        # accountant code path on deterministic virtual time
+        _invariant(
+            "mixed_sla_classes_accounted",
+            _slo_attainment(inter, "interactive") >= 0.9
+            and _slo_attainment(inter, "interactive")
+            == _trace_ttft_attainment(inter)
+            and _slo_attainment(batch, "batch")
+            == _trace_ttft_attainment(batch),
+            f'accountant interactive {_slo_attainment(inter, "interactive")} '
+            f'(trace {_trace_ttft_attainment(inter)}), '
+            f'batch {_slo_attainment(batch, "batch")} '
+            f'(trace {_trace_ttft_attainment(batch)})',
+        ),
     ]
     return {"fleet": fleet, "invariants": invs, "requests": len(trace)}
 
@@ -429,7 +465,10 @@ async def _multi_region_follow_sun(
     from .report import pool_report
 
     reps = {name: pool_report(p) for name, p in fleet.pools.items()}
-    attains = {name: r["ttft_attainment"] for name, r in reps.items()}
+    # per-region attainment from each pool's production SloAccountant
+    # (was scenario-local percentile math before the slo plane landed)
+    attains = {name: _slo_attainment(p, "standard")
+               for name, p in fleet.pools.items()}
     counts = {name: r["requests"] for name, r in reps.items()}
     total = sum(counts.values())
     shares = {n: c / max(total, 1) for n, c in counts.items()}

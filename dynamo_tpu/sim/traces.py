@@ -31,6 +31,10 @@ class SimRequest:
     ttft_target_s: float = 0.5
     itl_target_s: float = 0.05
     region: str = "r0"
+    # SLA class name (runtime/slo.py): keys the SloAccountant series the
+    # fleet feeds, so scenario invariants read per-class attainment from
+    # the production accountant instead of scenario-local math
+    sla_class: str = "standard"
 
     @property
     def t(self) -> float:
@@ -164,9 +168,9 @@ def sla_classes(
     (short prompt, tight TTFT) vs 'batch' (long prompt, loose TTFT) —
     the two-pool grid in the multi-pool scenario keys off exactly this."""
     cls = classes or [
-        {"weight": 0.6, "isl": 128, "osl": 16,
+        {"name": "interactive", "weight": 0.6, "isl": 128, "osl": 16,
          "ttft_target_s": 0.3, "itl_target_s": 0.05},
-        {"weight": 0.4, "isl": 1024, "osl": 48,
+        {"name": "batch", "weight": 0.4, "isl": 1024, "osl": 48,
          "ttft_target_s": 2.0, "itl_target_s": 0.2},
     ]
     weights = [c["weight"] for c in cls]
@@ -183,6 +187,7 @@ def sla_classes(
                       rng.randrange(num_groups)),
             ttft_target_s=float(c["ttft_target_s"]),
             itl_target_s=float(c["itl_target_s"]),
+            sla_class=str(c.get("name", "standard")),
         ))
     return out
 

@@ -580,3 +580,58 @@ def test_ported_passes_match_preport_lint_on_current_tree():
         name = f.message.split()[0] if f.rule in ("UNDEFINED", "UNUSED-IMPORT") else None
         new.append((f.rule, f.path, f.line, name))
     assert sorted(old) == sorted(new)
+
+
+# -- METRIC-CARDINALITY ------------------------------------------------------
+
+_CARD_POS = (
+    "class Svc:\n"
+    "    def on_finish(self, rid, model, request_id, address):\n"
+    "        self._lat.observe(0.5, model=model, request_id=rid)\n"
+    "        self._reqs.inc(model=model, worker=f'{address}')\n"
+)
+
+
+def test_metric_cardinality_flags_unbounded_labels(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/llm/http/svc.py", _CARD_POS,
+        rule="METRIC-CARDINALITY",
+    )
+    assert len(found) == 2
+    assert found[0].line == 3 and "request_id" in found[0].message
+    # 'worker' label is fine as a name, but its VALUE is an address
+    assert found[1].line == 4 and "'address'" in found[1].message
+
+
+def test_metric_cardinality_allows_bounded_labels_and_non_metrics(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/thing.py",
+        "class Svc:\n"
+        "    def ok(self, model, status, wire, request_id, span, state):\n"
+        "        self._reqs.inc(model=model, status=status)\n"     # bounded
+        "        self._bw_gauge.set(1.0, wire=wire)\n"             # bounded
+        "        span.set(request_id=request_id)\n"                # a span, not a metric
+        "        state.set('x', True, request_id=request_id)\n"    # health state
+        "        self.flight.record(request_id, 'queued')\n",      # positional, not a label
+        rule="METRIC-CARDINALITY",
+    )
+    assert found == []
+
+
+def test_metric_cardinality_scoped_to_serving_packages(tmp_path):
+    # the same call in tools/ or sim/ is not a serving-path registry
+    found = analyze(
+        tmp_path, "tools/report.py", _CARD_POS, rule="METRIC-CARDINALITY",
+    )
+    assert found == []
+
+
+def test_metric_cardinality_current_tree_clean():
+    """The live serving tree keeps every metric label bounded (worker ids
+    ride detached scopes; anything new fails the gate)."""
+    modules, parse = core.load_modules([os.path.join(REPO, "dynamo_tpu")])
+    found = [
+        f for f in core.collect_findings(modules, parse)
+        if f.rule == "METRIC-CARDINALITY"
+    ]
+    assert found == []

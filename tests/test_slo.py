@@ -1,0 +1,643 @@
+"""SLO accounting plane (runtime/slo.py).
+
+Class resolution + annotation propagation frontend -> engine, multi-window
+attainment/burn-rate on a controlled clock, goodput counters, /debug/slo
+payloads (frontend + StatusServer), class-labeled metrics hierarchy, the
+planner's class_attainment feed, the flight-recorder budget breakdown, the
+loadgen/profiler attainment dedupe (byte-pinned JSON), the bench detail.slo
+schema, and the sim mixed-SLA accountant-vs-trace agreement.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+
+from dynamo_tpu.llm import (
+    EchoEngine,
+    ModelDeploymentCard,
+    ModelManager,
+    ModelWatcher,
+    register_llm,
+)
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.model_card import ModelRuntimeConfig
+from dynamo_tpu.planner.metrics_source import (
+    EventPlaneMetricsSource,
+    FrontendStatsPublisher,
+)
+from dynamo_tpu.runtime import (
+    DistributedRuntime,
+    InProcEventPlane,
+    MemKVStore,
+    RouterMode,
+    RuntimeConfig,
+)
+from dynamo_tpu.runtime import metrics as M
+from dynamo_tpu.runtime import slo
+from dynamo_tpu.runtime.flight_recorder import (
+    FlightRecorder,
+    debug_requests_payload,
+    set_flight_recorder,
+)
+from dynamo_tpu.runtime.health import HealthState, StatusServer
+from dynamo_tpu.runtime.slo import (
+    SlaSpec,
+    SloAccountant,
+    attainment,
+    bench_slo_detail,
+    budget_breakdown,
+    resolve_sla,
+    set_slo_accountant,
+    sla_classes,
+    spec_from_annotations,
+)
+
+
+# ------------------------------------------------------ class resolution
+def test_builtin_classes_and_env_overlay(monkeypatch):
+    classes = sla_classes()
+    assert {"interactive", "standard", "batch"} <= set(classes)
+    assert classes["interactive"].ttft_target_s < classes["batch"].ttft_target_s
+    monkeypatch.setenv(
+        "DTPU_SLA_CLASSES", "rt:ttft=0.2,itl=0.02,deadline=5;batch:ttft=60"
+    )
+    classes = sla_classes()
+    assert classes["rt"] == SlaSpec("rt", 0.2, 0.02, 5.0)
+    # partial override inherits the built-in's unset targets
+    assert classes["batch"].ttft_target_s == 60.0
+    assert classes["batch"].itl_target_s == 1.0
+
+
+def test_bad_env_spec_falls_back_to_builtins(monkeypatch):
+    monkeypatch.setenv("DTPU_SLA_CLASSES", "oops:ttft=fast")
+    classes = sla_classes()
+    assert set(classes) == {"interactive", "standard", "batch"}
+
+
+def test_resolve_sla_model_overrides_and_unknown():
+    spec = resolve_sla("interactive", {"interactive": {"ttft_target_s": 0.3}})
+    assert spec is not None and spec.ttft_target_s == 0.3
+    assert spec.itl_target_s == sla_classes()["interactive"].itl_target_s
+    assert resolve_sla(None).sla_class == "standard"  # default class
+    assert resolve_sla("no-such-class") is None
+
+
+def test_annotation_round_trip_and_malformed():
+    spec = SlaSpec("interactive", 0.5, 0.05, 30.0)
+    ann = {slo.ANNOTATION_SLA: spec.to_annotation(t0_ns=123)}
+    back = spec_from_annotations(ann)
+    assert back == spec
+    assert slo.sla_t0_ns(ann) == 123
+    assert spec_from_annotations({}) is None
+    assert spec_from_annotations({slo.ANNOTATION_SLA: "interactive"}) is None
+    assert spec_from_annotations(
+        {slo.ANNOTATION_SLA: {"class": "x", "ttft_target_s": "bogus"}}
+    ) is None
+
+
+# ------------------------------------------------------ accountant windows
+def _clocked(objective=0.9):
+    t = [0.0]
+    acct = SloAccountant(clock=lambda: t[0], objective=objective)
+    return t, acct
+
+
+SPEC = SlaSpec("interactive", ttft_target_s=0.5, itl_target_s=0.05)
+
+
+def test_multi_window_rolling_attainment():
+    t, acct = _clocked()
+    for _ in range(10):  # meets at t=5
+        t[0] = 5.0
+        acct.record("m", SPEC, ttft_s=0.1, itl_s=0.01, output_tokens=4)
+    t[0] = 100.0
+    for _ in range(5):  # misses at t=100
+        acct.record("m", SPEC, ttft_s=2.0, itl_s=0.01, output_tokens=4)
+    # 1m window at t=100 only sees the misses; 5m/1h/total see everything
+    assert acct.attainment("m", "interactive", "1m", "ttft") == 0.0
+    assert acct.attainment("m", "interactive", "5m", "ttft") == 10 / 15
+    assert acct.attainment("m", "interactive", "1h", "ttft") == 10 / 15
+    assert acct.attainment("m", "interactive", "total", "ttft") == 10 / 15
+    # an hour later the rolling windows are empty but the total persists
+    t[0] = 3700.0 + 100.0
+    assert acct.attainment("m", "interactive", "1h", "ttft") is None
+    assert acct.attainment("m", "interactive", "total", "ttft") == 10 / 15
+
+
+def test_burn_rate_semantics():
+    t, acct = _clocked(objective=0.9)
+    for ok in (True,) * 8 + (False,) * 2:  # attainment 0.8, budget 0.1
+        acct.record("m", SPEC, ttft_s=0.1 if ok else 2.0, output_tokens=1)
+    br = acct.burn_rate("m", "interactive", "total")
+    assert abs(br - 2.0) < 1e-9  # burning 2x the allowed error rate
+    assert acct.burn_rate("m", "nope", "total") is None
+    # exactly on objective -> burn rate 1.0
+    assert abs(slo.burn_rate(0.9, 0.9) - 1.0) < 1e-9
+
+
+def test_itl_and_deadline_fold_into_combined():
+    t, acct = _clocked()
+    spec = SlaSpec("x", ttft_target_s=1.0, itl_target_s=0.05, deadline_s=10.0)
+    assert acct.record("m", spec, ttft_s=0.1, itl_s=0.01, e2e_s=5.0)
+    assert not acct.record("m", spec, ttft_s=0.1, itl_s=0.5, e2e_s=5.0)
+    assert not acct.record("m", spec, ttft_s=0.1, itl_s=0.01, e2e_s=50.0)
+    # unobserved ITL cannot violate
+    assert acct.record("m", spec, ttft_s=0.1, itl_s=None, e2e_s=5.0)
+    assert acct.attainment("m", "x", "total", "combined") == 2 / 4
+    assert acct.attainment("m", "x", "total", "itl") == 2 / 3
+
+
+def test_goodput_counts_only_met_requests():
+    t, acct = _clocked()
+    acct.record("m", SPEC, ttft_s=0.1, output_tokens=100)   # met
+    acct.record("m", SPEC, ttft_s=5.0, output_tokens=40)    # violated
+    snap = acct.snapshot()
+    tw = snap["models"]["m"]["interactive"]["windows"]["total"]
+    assert tw["goodput_tokens"] == 100
+    assert tw["total_tokens"] == 140
+    assert tw["goodput_ratio"] == round(100 / 140, 6)
+
+
+def test_goodput_counter_exported_through_scope():
+    scope = M.MetricsScope().child(dtpu_namespace="ns9")
+    t = [0.0]
+    acct = SloAccountant(clock=lambda: t[0], objective=0.99, metrics=scope)
+    acct.record("m1", SPEC, ttft_s=0.1, itl_s=0.01, output_tokens=7)
+    acct.record("m1", SPEC, ttft_s=9.9, output_tokens=3)  # violated: no goodput
+    acct.export_metrics()
+    text = scope.expose().decode()
+    good = next(
+        l for l in text.splitlines()
+        if l.startswith(M.GOODPUT_TOKENS + "{") or (
+            l.startswith(M.GOODPUT_TOKENS) and "_total{" in l
+        )
+    )
+    assert 'model="m1"' in good and 'sla_class="interactive"' in good
+    assert good.rstrip().endswith("7.0")
+    # attainment + burn gauges carry the full label hierarchy
+    att = next(
+        l for l in text.splitlines()
+        if l.startswith(M.SLO_ATTAINMENT + "{") and 'window="total"' in l
+        and 'slo="ttft"' in l
+    )
+    assert 'dtpu_namespace="ns9"' in att and 'sla_class="interactive"' in att
+    assert att.rstrip().endswith("0.5")
+    assert any(l.startswith(M.SLO_BURN_RATE + "{") for l in text.splitlines())
+
+
+def test_debug_slo_payload_schema():
+    t, acct = _clocked()
+    acct.record("m", SPEC, ttft_s=0.1, itl_s=0.01, output_tokens=4)
+    payload = slo.debug_slo_payload(acct)
+    assert payload["windows"] == ["1h", "1m", "5m", "total"]
+    body = payload["models"]["m"]["interactive"]
+    assert body["targets"] == {
+        "ttft_target_s": 0.5, "itl_target_s": 0.05, "deadline_s": 0.0,
+    }
+    for w in ("1m", "5m", "1h", "total"):
+        win = body["windows"][w]
+        assert {
+            "requests", "ttft_attainment", "itl_attainment", "attainment",
+            "burn_rate", "goodput_tokens", "total_tokens", "goodput_ratio",
+        } <= set(win)
+    assert slo.debug_slo_payload(None)["models"] == {}
+
+
+async def test_status_server_serves_debug_slo():
+    acct = SloAccountant()
+    acct.record("worker-model", SPEC, ttft_s=0.2, output_tokens=2)
+    set_slo_accountant(acct)
+    server = StatusServer(HealthState(), host="127.0.0.1")
+    await server.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{server.port}/debug/slo"
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+        assert "worker-model" in body["models"]
+        win = body["models"]["worker-model"]["interactive"]["windows"]["total"]
+        assert win["requests"] == 1 and win["attainment"] == 1.0
+    finally:
+        await server.stop()
+        set_slo_accountant(None)
+
+
+# ------------------------------------------------------ budget breakdown
+def test_flight_budget_breakdown_and_debug_requests_section():
+    rec = FlightRecorder(capacity=8)
+    rec.record("r1", "queued", prompt_tokens=10, sla_class="interactive",
+               ttft_target_s=1.0, itl_target_s=0.05, deadline_s=30.0)
+    rec.record("r1", "admitted")
+    rec.record("r1", "first_token")
+    rec.finish("r1", status="200")
+    flight = rec.timeline("r1")
+    bb = budget_breakdown(flight)
+    assert bb is not None and bb["sla_class"] == "interactive"
+    assert {"queue_ms", "prefill_ms", "ttft_ms", "decode_ms"} <= set(bb)
+    assert set(bb["budget_shares"]) == {"queue", "prefill"}
+    assert bb["ttft_met"] is True
+    assert "deadline_remaining_s" in bb
+    # the ?id= payload carries the section; unclassified flights don't
+    status, payload = debug_requests_payload(rec, "r1", None)
+    assert status == 200 and payload["slo"]["sla_class"] == "interactive"
+    rec.record("r2", "queued", prompt_tokens=1)
+    rec.finish("r2", status="200")
+    status, plain = debug_requests_payload(rec, "r2", None)
+    assert status == 200 and "slo" not in plain
+
+
+# ------------------------------------------------------ e2e: frontend -> engine
+class _CaptureEngine(EchoEngine):
+    """Echo worker that records the annotations it was dispatched with."""
+
+    def __init__(self):
+        super().__init__()
+        self.seen = []
+
+    async def generate(self, request, context):
+        req = request if isinstance(request, dict) else None
+        ann = (request.get("annotations") if isinstance(request, dict)
+               else request.annotations)
+        self.seen.append(ann or {})
+        async for out in super().generate(request, context):
+            yield out
+
+
+async def test_sla_class_propagates_frontend_to_engine_e2e():
+    """The acceptance e2e: a request tagged ``x-dtpu-sla: interactive``
+    produces (a) the sla annotation on the worker side, (b) class-labeled
+    TTFT/ITL histogram samples, (c) a populated /debug/slo payload, and
+    (d) per-class attainment in the planner's LoadSnapshot."""
+    store = MemKVStore()
+    cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+    worker_rt = await DistributedRuntime(
+        cfg, store=store, event_plane=InProcEventPlane()
+    ).start()
+    frontend_rt = await DistributedRuntime(
+        cfg, store=store, event_plane=InProcEventPlane()
+    ).start()
+    engine = _CaptureEngine()
+    card = ModelDeploymentCard(
+        name="echo-model", tokenizer="byte", context_length=4096,
+        # per-model override: interactive TTFT tightened on this card
+        runtime_config=ModelRuntimeConfig(
+            sla_classes={"interactive": {"ttft_target_s": 0.4}}
+        ),
+    )
+    served = await register_llm(worker_rt, engine, card)
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        frontend_rt, manager, RouterMode.ROUND_ROBIN
+    ).start()
+    # planner feed: frontend stats topic -> metrics source -> LoadSnapshot
+    plane = frontend_rt.event_plane
+    stats = FrontendStatsPublisher(plane, "dynamo")
+    source = await EventPlaneMetricsSource(plane, "dynamo", ["backend"]).start()
+    service = HttpService(
+        manager, host="127.0.0.1", port=0, stats_hook=stats.on_request
+    )
+    await service.start()
+    try:
+        for _ in range(100):
+            pipe = manager.get("echo-model")
+            if pipe and pipe.client.instances:
+                break
+            await asyncio.sleep(0.05)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{base}/v1/completions",
+                json={"model": "echo-model", "prompt": "hello world",
+                      "max_tokens": 8},
+                headers={"x-dtpu-sla": "interactive"},
+            )
+            assert r.status == 200, await r.text()
+            # (a) the worker saw the promise (class + overridden target)
+            ann = next(a for a in engine.seen if slo.ANNOTATION_SLA in a)
+            spec = spec_from_annotations(ann)
+            assert spec.sla_class == "interactive"
+            assert spec.ttft_target_s == 0.4  # model-card override applied
+            assert slo.sla_t0_ns(ann) is not None
+            # (b) class-labeled histogram samples on /metrics
+            async with s.get(f"{base}/metrics") as mr:
+                text = await mr.text()
+            ttft_line = next(
+                l for l in text.splitlines()
+                if l.startswith(M.TTFT_SECONDS + "_count{")
+            )
+            assert 'sla_class="interactive"' in ttft_line
+            assert 'model="echo-model"' in ttft_line
+            itl_count = next(
+                l for l in text.splitlines()
+                if l.startswith(M.ITL_SECONDS + "_count{")
+            )
+            assert 'sla_class="interactive"' in itl_count
+            dur = next(
+                l for l in text.splitlines()
+                if l.startswith(M.REQUEST_DURATION_SECONDS + "_count{")
+            )
+            assert 'sla_class="interactive"' in dur
+            # (c) populated /debug/slo
+            async with s.get(f"{base}/debug/slo") as dr:
+                payload = await dr.json()
+            win = payload["models"]["echo-model"]["interactive"]["windows"]
+            assert win["total"]["requests"] == 1
+            assert win["total"]["attainment"] in (0.0, 1.0)
+            # body field beats the header; unknown class is a 400
+            r2 = await s.post(
+                f"{base}/v1/completions",
+                json={"model": "echo-model", "prompt": "x", "max_tokens": 4,
+                      "sla": "batch"},
+                headers={"x-dtpu-sla": "interactive"},
+            )
+            assert r2.status == 200
+            ann2 = spec_from_annotations(engine.seen[-1])
+            assert ann2.sla_class == "batch"
+            r3 = await s.post(
+                f"{base}/v1/completions",
+                json={"model": "echo-model", "prompt": "x", "sla": "nope"},
+            )
+            assert r3.status == 400
+            body = await r3.json()
+            assert "unknown SLA class" in body["error"]["message"]
+        # (d) the planner snapshot carries per-class attainment
+        for _ in range(50):
+            await asyncio.sleep(0.02)
+            snap = source.snapshot()
+            if snap.class_attainment:
+                break
+        assert "interactive" in snap.class_attainment or (
+            "batch" in snap.class_attainment
+        )
+        for v in snap.class_attainment.values():
+            assert 0.0 <= v <= 1.0
+    finally:
+        source.stop()
+        await service.stop()
+        await watcher.stop()
+        await served.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()
+
+
+# ------------------------------------------------------ engine-side ledger
+async def test_engine_feeds_global_accountant_and_violation_event():
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.parallel.mesh import make_mesh
+    from dynamo_tpu.runtime.engine import Context
+
+    rec = FlightRecorder(capacity=16)
+    set_flight_recorder(rec)
+    acct = SloAccountant()
+    set_slo_accountant(acct)
+    mcfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+    engine = TpuEngine(
+        TpuEngineConfig(
+            model=mcfg, num_blocks=64, block_size=4, max_batch_size=4,
+            max_context=256, prefill_buckets=(16, 32, 64),
+        ),
+        mesh=make_mesh(tp=1, devices=jax.devices()[:1]),
+    )
+    try:
+        # impossible target -> violation; generous target -> met
+        for rid, ttft_target in (("slo-viol", 1e-9), ("slo-ok", 60.0)):
+            spec = SlaSpec("interactive", ttft_target, 60.0)
+            req = PreprocessedRequest(
+                request_id=rid, model="tiny", token_ids=list(range(40, 52)),
+                stop=StopConditions(max_tokens=4, ignore_eos=True),
+                sampling=SamplingOptions(temperature=0.0),
+                annotations={slo.ANNOTATION_SLA: spec.to_annotation()},
+            )
+            async for _ in engine.generate(req, Context(rid)):
+                pass
+        assert acct.attainment("tiny", "interactive", "total", "ttft") == 0.5
+        viol = rec.timeline("slo-viol")
+        kinds = [e["event"]["kind"] for e in viol["events"]]
+        assert "slo_violation" in kinds
+        ev = next(
+            e["event"] for e in viol["events"]
+            if e["event"]["kind"] == "slo_violation"
+        )
+        assert ev["sla_class"] == "interactive" and ev["met"] is False
+        # queued event carries the promise -> ?id= budget breakdown works
+        status, payload = debug_requests_payload(rec, "slo-ok", None)
+        assert status == 200 and payload["slo"]["ttft_met"] is True
+        ok_kinds = [
+            e["event"]["kind"] for e in rec.timeline("slo-ok")["events"]
+        ]
+        assert "slo_violation" not in ok_kinds
+        # finish event is class-stamped
+        fin = next(
+            e["event"] for e in rec.timeline("slo-ok")["events"]
+            if e["event"]["kind"] == "finish"
+        )
+        assert fin["sla_class"] == "interactive"
+    finally:
+        engine.stop()
+        set_flight_recorder(None)
+        set_slo_accountant(None)
+
+
+# ------------------------------------------------------ dedupe pins
+def test_loadgen_attainment_json_byte_identical():
+    """sla_report_obj through runtime/slo.attainment must produce byte-
+    identical JSON to the historical inline expressions."""
+    from dynamo_tpu.profiler.loadgen import SlaReport, pct, sla_report_obj
+
+    ttfts = [0.1, 0.2, 0.7, 0.05, 1.3]
+    itls = [0.01, 0.09, 0.02]
+    ttft_t, itl_t = 0.5, 0.05
+    rep = SlaReport(
+        completed=5,
+        ttft_attainment=attainment(ttfts, ttft_t),
+        itl_attainment=attainment(itls, itl_t),
+        ttft_p95_s=pct(ttfts, 0.95),
+        itl_p95_s=pct(itls, 0.95),
+        cache_hit_ratio=0.25,
+        sim_busy_s=1.0,
+    )
+    got = json.dumps(sla_report_obj(rep, workers=4))
+    legacy_obj = {
+        "requests": 5,
+        "workers": 4,
+        "ttft_attainment": round(
+            sum(1 for x in ttfts if x <= ttft_t) / max(len(ttfts), 1), 4
+        ),
+        "itl_attainment": round(
+            sum(1 for x in itls if x <= itl_t) / max(len(itls), 1), 4
+        ),
+        "ttft_p95_s": round(pct(ttfts, 0.95), 4),
+        "itl_p95_s": round(pct(itls, 0.95), 4),
+        "cache_hit_ratio": 0.25,
+    }
+    assert got == json.dumps(legacy_obj)
+    # empty-list convention preserved (0.0, not 1.0)
+    assert attainment([], 1.0) == 0.0
+
+
+async def test_replay_uses_shared_attainment_helper():
+    from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
+    from dynamo_tpu.profiler import loadgen
+
+    trace = loadgen.poisson_trace(6, rate=50.0, isl=32, osl=4)
+    engines = [MockerEngine(MockEngineArgs(
+        emit_sim_ts=True, speedup_ratio=50.0,
+    ))]
+    try:
+        rep = await loadgen.replay(trace, engines, 10.0, 10.0, speedup=50.0)
+    finally:
+        for e in engines:
+            e.stop()
+    assert rep.completed == 6
+    assert rep.ttft_attainment == 1.0 and rep.itl_attainment == 1.0
+
+
+# ------------------------------------------------------ bench detail.slo
+def test_bench_slo_detail_schema():
+    """The record bench.py emits as detail.slo: per-class attainment +
+    burn rate at the measured shapes (tier-1 schema pin alongside the
+    detail.step_telemetry / detail.kernel_bytes checks)."""
+    samples = [(0.1, 0.01, 64), (0.4, 0.02, 64), (3.0, 0.3, 64)]
+    detail = bench_slo_detail(samples)
+    assert detail["requests"] == 3
+    assert {"interactive", "standard", "batch"} <= set(detail["classes"])
+    inter = detail["classes"]["interactive"]
+    assert {
+        "ttft_target_s", "itl_target_s", "ttft_attainment", "itl_attainment",
+        "attainment", "burn_rate", "goodput_tokens", "total_tokens",
+    } <= set(inter)
+    # tighter class -> no better attainment than the loosest class
+    assert inter["attainment"] <= detail["classes"]["batch"]["attainment"]
+    assert inter["total_tokens"] == 192
+    # deterministic given the samples
+    assert bench_slo_detail(samples) == detail
+
+
+# ------------------------------------------------------ sim agreement smoke
+def test_sim_mixed_sla_accountant_agrees_with_trace():
+    """The production accountant on the virtual clock must reproduce the
+    trace-derived attainment exactly (multi-pool mixed-SLA scenario) and
+    its ledger lands in the deterministic report."""
+    from dynamo_tpu.sim.scenarios import run_scenario
+
+    r = run_scenario("multi-pool-balance", seed=3, workers=6, duration_s=120)
+    inv = next(
+        iv for iv in r["sim"]["invariants"]
+        if iv["name"] == "mixed_sla_classes_accounted"
+    )
+    assert inv["ok"], inv["detail"]
+    assert r["sim"]["passed"]
+    slo_sec = r["sim"]["pools"]["interactive"]["slo"]
+    assert slo_sec["objective"] == 0.99
+    inter = slo_sec["classes"]["interactive"]
+    assert inter["windows"]["total"]["requests"] > 0
+    assert (
+        inter["windows"]["total"]["ttft_attainment"]
+        == r["sim"]["pools"]["interactive"]["ttft_attainment"]
+    )
+
+
+# ------------------------------------------------------ review-fix pins
+def test_default_class_typo_falls_back_not_400(monkeypatch):
+    monkeypatch.setenv("DTPU_SLA_DEFAULT", "interctive")  # typo'd default
+    spec = resolve_sla(None)
+    assert spec is not None and spec.sla_class == "standard"
+    # an EXPLICITLY named unknown class still resolves to None (-> 400)
+    assert resolve_sla("interctive") is None
+
+
+def test_export_metrics_neutralizes_drained_windows():
+    scope = M.MetricsScope()
+    t = [0.0]
+    acct = SloAccountant(clock=lambda: t[0], objective=0.99, metrics=scope)
+    for _ in range(5):  # violation burst at t=0: 1m burn rate = 100
+        acct.record("m", SPEC, ttft_s=9.0, output_tokens=1)
+    acct.export_metrics()
+
+    def burn_1m():
+        line = next(
+            l for l in scope.expose().decode().splitlines()
+            if l.startswith(M.SLO_BURN_RATE + "{") and 'window="1m"' in l
+        )
+        return float(line.rsplit(" ", 1)[1])
+
+    assert abs(burn_1m() - 100.0) < 1e-6
+    t[0] = 600.0  # traffic stops; the 1m window drains
+    acct.export_metrics()
+    assert burn_1m() == 0.0  # not frozen at the stale page-now value
+
+
+def test_bench_slo_detail_scores_deadline_classes(monkeypatch):
+    monkeypatch.setenv("DTPU_SLA_CLASSES", "rt:ttft=5,itl=1,deadline=30")
+    detail = bench_slo_detail([(0.1, 0.01, 16), (0.2, 0.02, 16)])
+    rt = detail["classes"]["rt"]
+    # fast samples within the deadline must not auto-miss on e2e
+    assert rt["attainment"] == 1.0 and rt["burn_rate"] == 0.0
+
+
+def test_planner_class_outcome_honors_accountant_verdict():
+    plane = InProcEventPlane()
+    src = EventPlaneMetricsSource(plane, "ns", [])
+    # latencies meet both targets, but the publisher's accountant said the
+    # request missed (e.g. blew its deadline): the verdict wins
+    src.record_class_outcome(
+        "interactive", ttft_s=0.1, ttft_target_s=1.0,
+        itl_s=0.01, itl_target_s=0.1, met=False,
+    )
+    src.record_class_outcome(
+        "interactive", ttft_s=0.1, ttft_target_s=1.0,
+        itl_s=0.01, itl_target_s=0.1,  # no verdict -> local derivation
+    )
+    snap = src.snapshot()
+    assert snap.class_attainment == {"interactive": 0.5}
+
+
+async def test_failure_before_first_token_lands_in_frontend_ledger():
+    from aiohttp.test_utils import make_mocked_request
+
+    from dynamo_tpu.runtime.request_plane.tcp import NoResponders
+
+    class _DeadPipeline:
+        async def generate_tokens(self, preq, ctx):
+            raise NoResponders("nobody home")
+            yield  # pragma: no cover
+
+    service = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    spec = SlaSpec("interactive", 0.5, 0.05)
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+    from dynamo_tpu.llm.protocols.delta import (
+        CompletionDeltaGenerator,
+        aggregate_completion,
+    )
+
+    preq = PreprocessedRequest(
+        request_id="dead-1", model="m", token_ids=[1, 2, 3]
+    )
+    req = make_mocked_request("POST", "/v1/completions")
+    resp = await service._run(
+        req, [preq], _DeadPipeline(), "m", False,
+        [CompletionDeltaGenerator("dead-1", "m", False)],
+        lambda ss: aggregate_completion("dead-1", "m", ss[0], ""),
+        sla=spec,
+    )
+    assert resp.status == 503
+    win = service.slo.snapshot()["models"]["m"]["interactive"]["windows"]
+    # the outage IS accounted: a combined miss with no ttft sample
+    assert win["total"]["requests"] == 1
+    assert win["total"]["attainment"] == 0.0
+    assert win["total"]["ttft_attainment"] is None

@@ -754,3 +754,94 @@ def _unused_metric_pass(ctx: Context) -> Iterator[Finding]:
 
 
 _unused_metric_pass.RULES = ("UNUSED-METRIC",)
+
+
+# -- METRIC-CARDINALITY ------------------------------------------------------
+
+# Prometheus label values must come from bounded sets: a label fed from
+# request ids, raw prompts, traceparents or per-worker transfer addresses
+# grows one time series per distinct value and /metrics without bound.
+# Label *names* that are unbounded by definition:
+_CARDINALITY_SUSPECT_LABELS = {
+    "request_id", "rid", "prompt", "traceparent", "trace_id", "address",
+}
+# identifier fragments that mark a label *value* as drawn from an unbounded
+# set (worker/instance ids churn under autoscaling; addresses are per-host
+# outside the known-instance path; prompts/request ids are per-request)
+_CARDINALITY_UNBOUNDED_NAMES = {
+    "request_id", "rid", "prompt", "traceparent", "trace_id",
+    "address", "transfer_address", "instance_id", "worker_id", "iid", "wid",
+}
+_METRIC_OBSERVE_METHODS = {"inc", "dec", "observe"}
+
+
+def _is_metric_scope_file(norm_path: str) -> bool:
+    return (
+        "dynamo_tpu/runtime/" in norm_path
+        or "dynamo_tpu/llm/" in norm_path
+        or "dynamo_tpu/engine/" in norm_path
+    )
+
+
+def _is_metric_call(node: ast.Call) -> bool:
+    """inc/dec/observe on anything, plus .set on a gauge-named receiver
+    (``.set`` alone is too common: spans, health state, jax ``.at[].set``)."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr in _METRIC_OBSERVE_METHODS:
+        return True
+    if func.attr == "set":
+        recv = func.value
+        name = (
+            recv.attr if isinstance(recv, ast.Attribute)
+            else recv.id if isinstance(recv, ast.Name) else ""
+        )
+        return "gauge" in name.lower() or name.endswith("_g")
+    return False
+
+
+def _unbounded_value_name(expr: ast.AST):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in _CARDINALITY_UNBOUNDED_NAMES:
+            return n.id
+        if isinstance(n, ast.Attribute) and n.attr in _CARDINALITY_UNBOUNDED_NAMES:
+            return n.attr
+    return None
+
+
+def metric_cardinality(path: str, tree: ast.AST):
+    """Metric label values fed from unbounded sets in runtime//llm//engine/:
+    each distinct value is a new time series kept forever by the registry."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_metric_call(node)):
+            continue
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            src = (
+                kw.arg if kw.arg in _CARDINALITY_SUSPECT_LABELS
+                else _unbounded_value_name(kw.value)
+            )
+            if src is not None:
+                out.append((
+                    path, node.lineno,
+                    f"metric label {kw.arg!r} is fed from the unbounded "
+                    f"set {src!r} (one series per distinct value) — label "
+                    "with a bounded class instead, or keep the metric on a "
+                    "detached scope",
+                ))
+    return out
+
+
+@register("metric-cardinality", "metric labels fed from unbounded value sets")
+def _metric_cardinality_pass(ctx: Context) -> Iterator[Finding]:
+    for m in ctx.modules:
+        if not _is_metric_scope_file(m.path):
+            continue
+        for _p, lineno, msg in metric_cardinality(m.path, m.tree):
+            yield Finding("METRIC-CARDINALITY", m.path, lineno, msg)
+
+
+_metric_cardinality_pass.RULES = ("METRIC-CARDINALITY",)
