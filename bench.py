@@ -29,6 +29,7 @@ if "--sim" in sys.argv:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
     def _sim_main() -> None:
+        from dynamo_tpu.kv_router.microbench import router_microbench
         from dynamo_tpu.sim.report import bench_record
         from dynamo_tpu.sim.scenarios import run_suite
 
@@ -37,7 +38,15 @@ if "--sim" in sys.argv:
             workers=int(os.environ.get("BENCH_SIM_WORKERS", "24")),
             duration_s=float(os.environ.get("BENCH_SIM_DURATION", "360")),
         )
-        print(json.dumps(bench_record(reports)), flush=True)
+        rec = bench_record(reports)
+        # the router decision micro-bench (seeded tree + fleet, no device):
+        # the perf trajectory's pruned-vs-exact decisions/s datapoint. It
+        # must never sink the sim gate record itself.
+        try:
+            rec["detail"]["router"] = router_microbench()
+        except Exception as e:
+            rec["detail"]["router"] = {"error": repr(e)}
+        print(json.dumps(rec), flush=True)
 
     _sim_main()
     sys.exit(0)
@@ -351,6 +360,14 @@ def _emit(results, errors) -> None:
             best["detail"]["fleet"] = fleet_metrics()
         except Exception as e:  # fleet benches must never sink the TPU number
             best["detail"]["fleet"] = {"error": repr(e)}
+    try:
+        # CPU-only routing micro-bench (kv_router/microbench.py): lands in
+        # every BENCH record, device reachable or not
+        from dynamo_tpu.kv_router.microbench import router_microbench
+
+        best["detail"]["router"] = router_microbench()
+    except Exception as e:
+        best["detail"]["router"] = {"error": repr(e)}
     print(json.dumps(best), flush=True)
 
 

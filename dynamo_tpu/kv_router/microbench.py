@@ -1,0 +1,107 @@
+"""Deterministic CPU micro-bench of the routing decision (BENCH detail.router).
+
+A seeded synthetic prefix tree + fleet at a few sizes, scored through the
+real ``KvRouter`` decision path twice — pruned (configured top-K) and exact
+(top-K forced to 0, the linear scan) — so every BENCH run carries a router
+decisions/s datapoint and the pruned-vs-exact candidate counts, with no
+device and no event loop. State construction is a pure function of the
+seed; the timings are wall-clock like every other bench number.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, Sequence
+
+from ..tokens import compute_sequence_hashes
+from .protocols import KvCacheEvent, KvEventKind, RouterEvent, WorkerWithDpRank
+from .router import KvRouter
+from .scheduler import KvRouterConfig
+
+
+def _build_router(
+    n_workers: int, seed: int, topk: int, block_size: int = 16,
+    groups: int = 32, blocks_per_group: int = 16, holders_per_group: int = 24,
+) -> tuple:
+    """A router over a synthetic fleet: every worker carries a random load,
+    each prefix group's hash chain is held by a seeded worker subset — fed
+    through the real event-stream ``KvIndexer.apply`` path."""
+    from ..runtime.event_plane.base import InProcEventPlane
+
+    rng = random.Random(seed * 1000003 + n_workers)
+    router = KvRouter(
+        InProcEventPlane(), "bench", "router", block_size=block_size,
+        config=KvRouterConfig(topk_candidates=topk), seed=seed,
+    )
+    workers = [WorkerWithDpRank(i) for i in range(n_workers)]
+    for w in workers:
+        router.register_worker(w)
+        load = rng.randrange(0, 64)
+        if load:
+            router.scheduler.add_local_load(w, load)
+    group_tokens = []
+    eid = 0
+    for g in range(groups):
+        tokens = [(g * 977 + j * 13) % 1021 for j in range(blocks_per_group * block_size)]
+        group_tokens.append(tokens)
+        hashes = compute_sequence_hashes(tokens, block_size)
+        for w in rng.sample(workers, min(holders_per_group, n_workers)):
+            eid += 1
+            router.indexer.apply(RouterEvent(
+                w, KvCacheEvent(KvEventKind.STORED, list(hashes), None, block_size),
+                eid,
+            ))
+    return router, group_tokens, rng
+
+
+def _queries(group_tokens, rng: random.Random, n: int, block_size: int):
+    """Trace-shaped probe prompts: a hot-group prefix plus a unique tail,
+    and a share of fully cold prompts."""
+    out = []
+    for i in range(n):
+        if rng.random() < 0.2:
+            out.append([rng.randrange(1021) for _ in range(12 * block_size)])
+        else:
+            base = group_tokens[rng.randrange(len(group_tokens))]
+            tail = [rng.randrange(1021) for _ in range(4 * block_size)]
+            out.append(list(base[: 8 * block_size]) + tail)
+    return out
+
+
+def router_microbench(
+    sizes: Sequence[int] = (256, 2048, 8192),
+    decisions: int = 200,
+    seed: int = 0,
+    topk: int = 16,
+) -> Dict:
+    """The BENCH ``detail.router`` record: per fleet size, decisions/s and
+    mean scored-candidate count for the pruned path vs the exact scan."""
+    out: Dict = {"topk": topk, "decisions": decisions, "sizes": {}}
+    for n in sizes:
+        router, group_tokens, rng = _build_router(n, seed, topk)
+        prompts = _queries(group_tokens, rng, decisions, router.block_size)
+
+        def run(k: int) -> Dict:
+            saved = router.config.topk_candidates
+            router.config.topk_candidates = k
+            try:
+                for toks in prompts[: min(20, len(prompts))]:
+                    router.score_tokens(toks)  # warm
+                scored = 0
+                t0 = time.perf_counter()
+                for toks in prompts:
+                    scored += len(router.score_tokens(toks).logits)
+                dt = time.perf_counter() - t0
+            finally:
+                router.config.topk_candidates = saved
+            return {
+                "decisions_per_s": round(len(prompts) / max(dt, 1e-9), 1),
+                "mean_candidates_scored": round(scored / max(len(prompts), 1), 1),
+            }
+
+        out["sizes"][str(n)] = {
+            "pruned": run(topk),
+            "exact": run(0),
+        }
+    return out

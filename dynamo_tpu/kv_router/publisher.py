@@ -84,6 +84,7 @@ class WorkerMetricsPublisher:
         worker_id: int,
         dp_rank: int = 0,
         clock: Callable[[], float] = time.time,
+        sleep: Callable[[float], "asyncio.Future"] = asyncio.sleep,
     ):
         self._plane = event_plane
         self._topic = metrics_topic(namespace, component)
@@ -92,6 +93,8 @@ class WorkerMetricsPublisher:
         # (planner metrics_source, router scheduler): a simulated fleet
         # injects its virtual clock so both sides share one timeline
         self._clock = clock
+        # the polling loop paces through this (Clock.sleep under the sim)
+        self._sleep = sleep
         self._task: Optional[asyncio.Task] = None
 
     async def publish(
@@ -123,7 +126,7 @@ class WorkerMetricsPublisher:
                         await self.publish(**snapshot_fn())
                     except Exception:
                         log.exception("metrics publish failed")
-                    await asyncio.sleep(interval_s)
+                    await self._sleep(interval_s)
             except asyncio.CancelledError:
                 pass
 

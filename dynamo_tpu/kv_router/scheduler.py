@@ -10,20 +10,35 @@ worker would still have to do) and ``decode_blocks`` is its current load. The
 *lowest* logit is best; selection samples a softmax over ``-logit / T`` with
 temperature T (T=0 -> argmin), tie-breaking toward the worker with the
 smallest cached-block footprint to spread the tree.
+
+Scale: ``select_worker`` stays the *exact* scorer; at fleet scale the router
+(router.py) calls it on a pruned candidate set instead of every worker. The
+scheduler's half of pruning lives here: a registry of known routing targets
+plus a load-ordered bucket index answering "the K least-loaded workers" in
+O(K log B) without scanning the fleet.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import math
+import os
 import random
 import time
-from typing import Dict, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..runtime.logging import get_logger
 from .protocols import OverlapScores, WorkerMetrics, WorkerWithDpRank
 
 log = get_logger("kv_router.scheduler")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
 
 
 @dataclasses.dataclass
@@ -41,6 +56,24 @@ class KvRouterConfig:
     replica_sync: bool = False
     metrics_stale_after_s: float = 10.0
     approx_ttl_s: float = 120.0
+    # -- two-stage decision knobs (docs/operations.md "Router scale") -------
+    # top-K candidate pruning: union of the K longest-prefix workers
+    # (postings index), the K least-loaded workers (load buckets) and any
+    # extra-cost standouts is scored exactly; 0 disables pruning. Pruning
+    # only engages above 2*K eligible workers, so small fleets are always
+    # exact.
+    topk_candidates: int = dataclasses.field(
+        default_factory=lambda: _env_int("DTPU_ROUTER_TOPK", 16)
+    )
+    # hash-bucket shards of the postings index + the replica-sync snapshot
+    # protocol (one shard = legacy whole-state snapshots)
+    index_shards: int = dataclasses.field(
+        default_factory=lambda: _env_int("DTPU_ROUTER_SHARDS", 1)
+    )
+    # capped per-block postings size (postings.py)
+    postings_bucket: int = dataclasses.field(
+        default_factory=lambda: _env_int("DTPU_ROUTER_POSTINGS_BUCKET", 8)
+    )
 
 
 @dataclasses.dataclass
@@ -55,46 +88,167 @@ class SchedulingDecision:
         return self.overlap_blocks  # caller multiplies by block_size
 
 
+class _LoadIndex:
+    """Load-ordered worker buckets: ``least(k)`` yields the K lowest-load
+    workers in O(K + touched-buckets log B). Buckets are keyed by the
+    integer load value; a lazy min-heap orders non-empty bucket keys
+    (stale/duplicate keys are dropped on pop). Iteration inside a bucket
+    is insertion-ordered — deterministic given a deterministic update
+    stream, which the fleet sim's byte-identical reports rely on."""
+
+    __slots__ = ("_load", "_buckets", "_heap")
+
+    def __init__(self):
+        self._load: Dict[WorkerWithDpRank, int] = {}
+        self._buckets: Dict[int, Dict[WorkerWithDpRank, None]] = {}
+        self._heap: List[int] = []
+
+    def set(self, w: WorkerWithDpRank, load: int) -> None:
+        load = int(load)
+        cur = self._load.get(w)
+        if cur == load:
+            return
+        if cur is not None:
+            b = self._buckets.get(cur)
+            if b is not None:
+                b.pop(w, None)
+        self._load[w] = load
+        b = self._buckets.get(load)
+        if b is None:
+            b = self._buckets[load] = {}
+            heapq.heappush(self._heap, load)
+        b[w] = None
+
+    def remove(self, w: WorkerWithDpRank) -> None:
+        cur = self._load.pop(w, None)
+        if cur is not None:
+            b = self._buckets.get(cur)
+            if b is not None:
+                b.pop(w, None)
+
+    def least(self, k: int, excluded=()) -> List[WorkerWithDpRank]:
+        out: List[WorkerWithDpRank] = []
+        popped: List[int] = []
+        seen_keys: set = set()
+        while self._heap and len(out) < k:
+            key = heapq.heappop(self._heap)
+            if key in seen_keys:
+                continue  # duplicate heap entry (bucket re-created): drop
+            seen_keys.add(key)
+            b = self._buckets.get(key)
+            if not b:
+                # empty bucket: drop the key AND the bucket dict for good
+                self._buckets.pop(key, None)
+                continue
+            popped.append(key)
+            for w in b:
+                if w in excluded:
+                    continue
+                out.append(w)
+                if len(out) >= k:
+                    break
+        for key in popped:
+            heapq.heappush(self._heap, key)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._load)
+
+
 class KvScheduler:
-    def __init__(self, config: Optional[KvRouterConfig] = None, seed: Optional[int] = None):
+    def __init__(
+        self,
+        config: Optional[KvRouterConfig] = None,
+        seed: Optional[int] = None,
+        clock: Callable[[], float] = time.time,
+    ):
         self.config = config or KvRouterConfig()
         self._rng = random.Random(seed)
+        # metric-staleness judgments ride the injected clock so the fleet
+        # simulator's virtual time governs them deterministically
+        self._clock = clock
         # live load state, fed by WorkerMetrics events + local bookkeeping
         self._metrics: Dict[WorkerWithDpRank, WorkerMetrics] = {}
         # blocks this router routed but the worker hasn't reported yet
         self._local_decode_blocks: Dict[WorkerWithDpRank, int] = {}
+        # every routing target ever registered/observed (insertion-ordered:
+        # the candidate universe when callers route by exclusion), plus the
+        # load-bucket index answering least_loaded without a fleet scan
+        self._workers: Dict[WorkerWithDpRank, None] = {}
+        self._loads = _LoadIndex()
 
     # -- state feeds ---------------------------------------------------------
+    def register_worker(self, worker: WorkerWithDpRank) -> None:
+        """Make ``worker`` part of the candidate universe (idempotent).
+        Discovery/fleet layers call this as instances appear so idle
+        workers are reachable through the least-loaded prune path before
+        they ever publish metrics or serve a request."""
+        if worker not in self._workers:
+            self._workers[worker] = None
+            self._loads.set(worker, self._raw_load(worker))
+
+    def _raw_load(self, worker: WorkerWithDpRank) -> int:
+        """Index load: last reported decode blocks + optimistic local. The
+        index deliberately skips the staleness check ``decode_blocks``
+        applies — it orders *candidates for exact rescoring*, which then
+        prices staleness exactly."""
+        m = self._metrics.get(worker)
+        reported = m.active_decode_blocks if m is not None else 0
+        return reported + self._local_decode_blocks.get(worker, 0)
+
     def update_metrics(self, m: WorkerMetrics) -> None:
         # staleness is judged against *our* clock: stamp arrival time rather
         # than trusting the producer's wall clock (cross-host skew would
         # silently disable the load term)
-        m.ts = time.time()
+        m.ts = self._clock()
         self._metrics[m.worker] = m
         # worker's own report supersedes our optimistic local estimate
         self._local_decode_blocks[m.worker] = 0
+        self._workers.setdefault(m.worker, None)
+        self._loads.set(m.worker, m.active_decode_blocks)
 
     def add_local_load(self, worker: WorkerWithDpRank, blocks: int) -> None:
         self._local_decode_blocks[worker] = self._local_decode_blocks.get(worker, 0) + blocks
+        self._workers.setdefault(worker, None)
+        self._loads.set(worker, self._raw_load(worker))
 
     def sub_local_load(self, worker: WorkerWithDpRank, blocks: int) -> None:
+        if worker not in self._workers:
+            # late release for a removed worker (an in-flight request
+            # completing after remove_worker): drop the residue instead of
+            # resurrecting a dead worker as a zero-load routing candidate
+            self._local_decode_blocks.pop(worker, None)
+            return
         self._local_decode_blocks[worker] = max(
             0, self._local_decode_blocks.get(worker, 0) - blocks
         )
+        self._loads.set(worker, self._raw_load(worker))
 
     def remove_worker(self, worker: WorkerWithDpRank) -> None:
         self._metrics.pop(worker, None)
         self._local_decode_blocks.pop(worker, None)
+        self._workers.pop(worker, None)
+        self._loads.remove(worker)
 
     def decode_blocks(self, worker: WorkerWithDpRank) -> int:
         m = self._metrics.get(worker)
         reported = 0
         if m is not None and (
             self.config.metrics_stale_after_s <= 0
-            or time.time() - m.ts < self.config.metrics_stale_after_s
+            or self._clock() - m.ts < self.config.metrics_stale_after_s
         ):
             reported = m.active_decode_blocks
         return reported + self._local_decode_blocks.get(worker, 0)
+
+    # -- the prune-stage feeds (router.py) -----------------------------------
+    def worker_count(self) -> int:
+        return len(self._workers)
+
+    def known_workers(self) -> List[WorkerWithDpRank]:
+        return list(self._workers)
+
+    def least_loaded(self, k: int, excluded=()) -> List[WorkerWithDpRank]:
+        return self._loads.least(k, excluded)
 
     # -- selection -----------------------------------------------------------
     def select_worker(

@@ -114,6 +114,11 @@ class ModelPipeline:
         # frontend breaker stays on /metrics).
         self._worker_breakers: Dict[int, CircuitBreaker] = {}
         self._worker_cb_metrics = M.MetricsScope()
+        # router-universe reconcile throttle (_prune_dead_workers): the
+        # full sweep is O(fleet), so it runs on instance-count change or
+        # every N requests, not per decision
+        self._router_sync_tick = 0
+        self._router_synced_count = -1
         self._rr = 0  # non-KV fallback round-robin over non-shunned workers
         # disaggregation: set when a prefill pool is registered for this model
         self.prefill_router = None
@@ -130,26 +135,31 @@ class ModelPipeline:
 
     def _tripped(self, excluded: List[int]) -> List[int]:
         """Workers to steer around: open circuits, unless that would leave
-        no candidate at all (then trying a tripped worker beats failing)."""
+        no candidate at all (then trying a tripped worker beats failing).
+        Only workers that ever recorded an outcome have a breaker, so the
+        scan is O(breakers), never O(fleet) — a worker with no breaker is
+        treated as closed without constructing one (healthy hot path)."""
         assert self.client is not None
-        # drop breakers for departed workers here (not only on the KV path)
-        # so long-lived non-KV frontends under churn don't accumulate them
-        for iid in list(self._worker_breakers):
-            if iid not in self.client.instances:
-                self._worker_breakers.pop(iid, None)
-        # a worker with no breaker yet has never recorded an outcome —
-        # treat as closed without constructing one (healthy hot path)
+        inst = self.client.instances
+        # drop breakers for departed workers opportunistically when the
+        # table outgrows the fleet (long-lived non-KV frontends under churn
+        # would otherwise accumulate them; the KV path also sweeps them in
+        # _prune_dead_workers)
+        if len(self._worker_breakers) > len(inst):
+            for iid in list(self._worker_breakers):
+                if iid not in inst:
+                    self._worker_breakers.pop(iid, None)
         avoid = [
-            iid for iid in self.client.instances
-            if iid not in excluded
-            and (cb := self._worker_breakers.get(iid)) is not None
-            and cb.state == OPEN
+            iid for iid, cb in self._worker_breakers.items()
+            if iid not in excluded and iid in inst and cb.state == OPEN
         ]
-        eligible = [
-            iid for iid in self.client.instances
-            if iid not in excluded and iid not in avoid
-        ]
-        return avoid if eligible else []
+        if not avoid:
+            return []
+        shun_live = sum(1 for iid in set(excluded) if iid in inst)
+        # would avoiding empty the pool? (all counts over live instances)
+        if len(inst) - shun_live - len(avoid) <= 0:
+            return []
+        return avoid
 
     async def start(self) -> "ModelPipeline":
         endpoint = (
@@ -192,14 +202,39 @@ class ModelPipeline:
         return cands
 
     def _prune_dead_workers(self) -> None:
+        """Sync the KV router's candidate universe with discovery: departed
+        instances are removed, new ones registered (per dp_rank). Routing
+        then passes only per-request exclusion sets — the O(K) path — and
+        never builds a fleet-sized candidate list per decision.
+
+        The reconcile itself is O(fleet), so it is throttled: it runs when
+        the instance count changes and on a coarse request tick, not per
+        decision. The sweep walks the router's *registered universe*, not a
+        known-set delta — a late metrics event auto-registers workers in
+        the scheduler (update_metrics), so a removed worker can be
+        resurrected after its one-shot delta removal and must be swept out
+        again."""
         if self.kv_router is None or self.client is None:
             return
-        live = set(self.client.instances)
-        gone = self._known_worker_ids - live
-        for iid in gone:
-            self.kv_router.remove_worker_id(iid)
-            self._worker_breakers.pop(iid, None)
-        self._known_worker_ids = set(live)
+        inst_map = self.client.instances
+        self._router_sync_tick += 1
+        if (
+            len(inst_map) == self._router_synced_count
+            and self._router_sync_tick % 64 != 1
+        ):
+            return
+        live = set(inst_map)
+        for w in self.kv_router.scheduler.known_workers():
+            if w.worker_id not in live:
+                self.kv_router.remove_worker_id(w.worker_id)
+                self._worker_breakers.pop(w.worker_id, None)
+        for iid in live - self._known_worker_ids:
+            inst = inst_map.get(iid)
+            dp = int(inst.metadata.get("data_parallel_size", 1) or 1) if inst else 1
+            for r in range(dp):
+                self.kv_router.register_worker(WorkerWithDpRank(iid, r))
+        self._known_worker_ids = live
+        self._router_synced_count = len(inst_map)
 
     async def _send(
         self, req: PreprocessedRequest, context: Context, excluded: List[int]
@@ -230,13 +265,26 @@ class ModelPipeline:
             overlap_tokens = 0
             if use_kv:
                 self._prune_dead_workers()
-                cands = self._candidates(shun)
-                if not cands:
+                inst_map = self.client.instances
+                shun_live = sum(1 for iid in set(shun) if iid in inst_map)
+                if not inst_map or shun_live >= len(inst_map):
                     # every instance is excluded (dead mid-request): fail this
-                    # attempt rather than round-robin back onto a dead worker
+                    # attempt rather than route back onto a dead worker
                     raise NoResponders(f"no non-excluded instances for {self.card.name}")
+                # exclusion-set routing over the router's registered
+                # universe (synced above): O(shun) to build, O(topk) to
+                # decide — no fleet-sized candidate list per request
+                excl = set()
+                for iid in shun:
+                    inst = inst_map.get(iid)
+                    dp = (
+                        int(inst.metadata.get("data_parallel_size", 1) or 1)
+                        if inst is not None else 1
+                    )
+                    for r in range(dp):
+                        excl.add(WorkerWithDpRank(iid, r))
                 decision = self.kv_router.schedule_tokens(
-                    req.token_ids, cands, request_id=req.request_id
+                    req.token_ids, excluded=excl, request_id=req.request_id
                 )
                 instance_id = decision.worker.worker_id
                 overlap_tokens = decision.overlap_blocks * self.card.kv_block_size

@@ -88,9 +88,14 @@ class RouterService:
     def _prune_dead_workers(self) -> None:
         assert self.router is not None and self.client is not None
         live = set(self.client.instances)
-        for iid in self._known_worker_ids - live:
-            self.router.remove_worker_id(iid)
-        self._known_worker_ids = set(live)
+        # sweep the router's registered universe, not a known-set delta: a
+        # stale metrics event auto-registers workers in the scheduler
+        # (update_metrics), so a removed worker can be resurrected after
+        # its one-shot delta removal and must be swept out again
+        for w in self.router.scheduler.known_workers():
+            if w.worker_id not in live:
+                self.router.remove_worker_id(w.worker_id)
+        self._known_worker_ids = live
 
     async def handle(self, request: Any, context: Context) -> AsyncIterator[Any]:
         op = request.get("op", "route")

@@ -89,6 +89,10 @@ class PoolConfig:
     prefill_per_token_s: float = 5e-4
     decode_base_s: float = 0.05
     decode_per_kv_block_s: float = 1e-5
+    # router pruning overrides (None/0 -> KvRouterConfig defaults): top-K
+    # candidate pruning + postings shard count (docs/operations.md)
+    router_topk: Optional[int] = None
+    router_shards: Optional[int] = None
     # planner (autoscale=False -> fixed fleet of initial_workers)
     autoscale: bool = False
     adjustment_interval_s: float = 10.0
@@ -179,14 +183,22 @@ class SimPool:
         # wid -> WorkerWithDpRank, cached: _candidates builds a ~fleet-sized
         # list per routing decision and dataclass construction dominates it
         self._cands: Dict[int, WorkerWithDpRank] = {}
+        kv_overrides = {}
+        if cfg.router_topk is not None:
+            kv_overrides["topk_candidates"] = cfg.router_topk
+        if cfg.router_shards is not None:
+            kv_overrides["index_shards"] = cfg.router_shards
         self.router = KvRouter(
             self.plane, cfg.namespace, cfg.component,
             block_size=cfg.block_size,
             config=KvRouterConfig(
                 overlap_score_weight=cfg.overlap_weight,
                 router_temperature=cfg.router_temperature,
+                **kv_overrides,
             ),
             seed=seed,
+            # staleness/TTL/sync-jitter timing rides the virtual clock
+            clock=self.clock,
         )
         self.stats_pub = FrontendStatsPublisher(
             self.plane, cfg.namespace, clock=self.clock.time
@@ -198,6 +210,9 @@ class SimPool:
         self.slo = SloAccountant(clock=self.clock.time, objective=0.99)
         self.metrics_source: Optional[EventPlaneMetricsSource] = None
         self.planner: Optional[PoolPlanner] = None
+        # workers that ever recorded a failure: the only ones whose breaker
+        # can be OPEN, so per-request breaker checks skip the healthy fleet
+        self._suspects: set = set()
         # -- deterministic outputs -------------------------------------------
         self.records: List[RequestRecord] = []
         self.itls: List[float] = []
@@ -285,6 +300,10 @@ class SimPool:
             wid, engine, breaker, spawned_at=self.clock.time()
         )
         self._cands[wid] = WorkerWithDpRank(wid, 0)
+        # candidate-free routing: the router's universe tracks spawns (and
+        # _retire's remove_worker_id untracks), so submit passes only an
+        # exclusion set — O(K) per decision instead of a fleet-sized list
+        self.router.register_worker(self._cands[wid])
         return wid
 
     def resize(self, n: int) -> None:
@@ -314,6 +333,10 @@ class SimPool:
             # can't reach them — stop the engine even if the drain is
             # cancelled at fleet shutdown
             w.engine.stop()
+            # the draining engine kept publishing metrics, which re-register
+            # the retired worker in the router's universe as a zero-load
+            # ghost; de-register once it can publish no more
+            self.router.remove_worker_id(w.wid)
 
     # -- the closed loop -----------------------------------------------------
     async def _planner_loop(self) -> None:
@@ -340,7 +363,9 @@ class SimPool:
         """Live workers minus open breakers minus this request's already-
         failed workers — unless that empties the pool (then a tripped
         worker beats no worker; llm/discovery.py _tripped + Migration's
-        excluded-instance list)."""
+        excluded-instance list). Kept for scenarios that need the explicit
+        list (the disagg planner's stub client); the hot submit path routes
+        by exclusion set instead (:meth:`_excluded`)."""
         avoid = [
             wid for wid, w in self.workers.items()
             if wid in excluded or w.breaker.state == OPEN
@@ -349,6 +374,30 @@ class SimPool:
         if not eligible:
             eligible = list(self.workers)
         return [self._cands[wid] for wid in eligible]
+
+    def _excluded(self, tried) -> set:
+        """The exclusion set for one routing decision: this request's
+        already-failed workers plus open breakers. Only ``_suspects``
+        (workers with at least one recorded failure) can possibly be OPEN,
+        so the scan is O(failures seen), not O(fleet) — the submit path
+        must stay sublinear in fleet size at 10k workers. Returns empty
+        when exclusion would cover the whole pool (a tripped worker beats
+        no worker; the router applies the same fallback internally)."""
+        avoid = set()
+        for wid in tried:
+            c = self._cands.get(wid)
+            if c is not None:
+                avoid.add(c)
+        for wid in list(self._suspects):
+            w = self.workers.get(wid)
+            if w is None:
+                self._suspects.discard(wid)
+                continue
+            if w.breaker.state == OPEN:
+                avoid.add(self._cands[wid])
+        if len(avoid) >= len(self.workers):
+            return set()
+        return avoid
 
     def _note_breaker(self, w: SimWorker) -> None:
         state = w.breaker.state
@@ -377,21 +426,30 @@ class SimPool:
         tried: set = set()
         while rec.attempts < self.fleet.cfg.max_attempts:
             rec.attempts += 1
-            cands = self._candidates(excluded=tried)
-            if not cands:
+            if not self.workers:
                 break
+            excluded = self._excluded(tried)
             rid = f"sim-{self.cfg.name}-{idx}.a{rec.attempts}"
             t0 = time.perf_counter_ns()
-            decision = self.router.schedule_tokens(tokens, cands, request_id=rid)
+            # candidate-free routing over the router's registered universe:
+            # the decision (prune + exact rescore) is the measured
+            # control-plane cost, with no O(fleet) list build around it
+            decision = self.router.schedule_tokens(
+                tokens, excluded=excluded, request_id=rid
+            )
             self.decision_wall_ns.append(time.perf_counter_ns() - t0)
-            self.fanout.append(len(cands))
+            self.fanout.append(len(self.workers) - len(excluded))
             wid = decision.worker.worker_id
             w = self.workers.get(wid)
             ok = False
             try:
                 # seeded flap injection on this worker's serving path
                 await FAULTS.ainject(worker_fault_point(wid))
-                if w is None:  # retired between decision and dispatch
+                if w is None:
+                    # retired between decision and dispatch — or a ghost a
+                    # draining engine's metrics resurrected: de-register so
+                    # the zero-load ghost can't keep winning least-loaded
+                    self.router.remove_worker_id(wid)
                     raise ConnectionError(f"sim worker {wid} gone")
                 ok = await self._consume(w.engine, rid, tokens, item, rec, t_arrive)
             except (ConnectionError, FaultInjected):
@@ -403,6 +461,7 @@ class SimPool:
                 # stream ending without a finish) — otherwise radix affinity
                 # re-picks the same dead worker every attempt
                 tried.add(wid)
+                self._suspects.add(wid)
             if w is not None:
                 w.breaker.record(ok)
                 self._note_breaker(w)

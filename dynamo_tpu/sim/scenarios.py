@@ -29,6 +29,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..global_router.pool_selection import PrefillPoolSelectionStrategy
+from ..profiler.loadgen import pct
 from ..runtime.resilience import OPEN
 from . import clock as simclock
 from . import traces
@@ -771,6 +772,487 @@ async def _disagg_streamed_prefill(
 
 
 # ---------------------------------------------------------------------------
+# router-scale-sublinear
+# ---------------------------------------------------------------------------
+
+
+def _probe_decision_latency(pool, trace, n: int = 400) -> Dict[str, list]:
+    """Wall-clock routing-decision probe on the post-trace router state:
+    ``score_tokens`` (side-effect-free) over trace-shaped prompts, pruned
+    (the configured top-K) and exact (top-K forced to 0, the linear scan).
+    Per-call wall ns lists — host-dependent, wall-section only. Each
+    prompt is measured twice and the per-prompt MIN kept, so a one-off GC
+    pause or scheduler hiccup on a loaded host cannot inflate the p99 the
+    sublinearity invariant reads."""
+    from ..profiler.loadgen import prefix_prompt
+
+    router = pool.router
+    prompts = [
+        prefix_prompt(trace[i % len(trace)].item, i,
+                      pool.fleet.cfg.prefix_share)
+        for i in range(n)
+    ]
+
+    def run(topk: int) -> list:
+        saved = router.config.topk_candidates
+        router.config.topk_candidates = topk
+        try:
+            for toks in prompts[:20]:  # warm caches/allocator
+                router.score_tokens(toks)
+            lat = [float("inf")] * len(prompts)
+            for _pass in range(2):
+                for i, toks in enumerate(prompts):
+                    t0 = time.perf_counter_ns()
+                    router.score_tokens(toks)
+                    lat[i] = min(lat[i], time.perf_counter_ns() - t0)
+        finally:
+            router.config.topk_candidates = saved
+        return lat
+
+    return {
+        "pruned_ns": run(router.config.topk_candidates or 16),
+        "exact_ns": run(0),
+    }
+
+
+def _ns_pcts(ns: list) -> Dict[str, float]:
+    xs = sorted(ns)
+    return {
+        "p50_us": round(pct(xs, 0.50) / 1e3, 1),
+        "p99_us": round(pct(xs, 0.99) / 1e3, 1),
+    }
+
+
+async def _router_scale(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    """Control-plane scale (ROADMAP item: 10k workers): the SAME
+    prefix-heavy trace shape runs against a small and a large mocker fleet
+    behind the real KvRouter — candidate-free routing over the registered
+    universe, pruned top-K decisions by default. The wall section records
+    decision-latency p50/p99 at both sizes plus a pruned-vs-exact probe;
+    the headline invariant is sublinearity: the large fleet's p99 within
+    3x the small fleet's (the linear scan scales ~size-ratio x). Like
+    ``http-frontend``, the latency invariants derive from WALL
+    measurements (floored and noise-trimmed), so this scenario asserts
+    bounded behavior and is deliberately absent from the byte-identity
+    pins; everything else in its sim section stays seed-deterministic."""
+    large = max(workers, 512)
+    small = max(64, large // 8)
+    rate = 3.0  # FIXED across sizes: the trace shape must be identical
+
+    phases: Dict[str, Dict] = {}
+    for label, size in (("small", small), ("large", large)):
+        trace = traces.prefix_heavy(
+            duration_s=duration_s, rate=rate, isl=256, osl=8,
+            num_groups=48, hot_group_share=0.4, seed=seed,
+            ttft_target_s=30.0, itl_target_s=5.0,
+        )
+        cfg = FleetConfig(
+            seed=seed, prefix_share=0.75,
+            pools=[PoolConfig(
+                name=label, namespace=f"sim-scale-{label}",
+                initial_workers=size, min_workers=size, max_workers=size,
+                num_blocks=512, **_SPEED,
+            )],
+        )
+        fleet = SimFleet(cfg, clock)
+        await fleet.start()
+        try:
+            await fleet.run_trace(trace)
+        finally:
+            await fleet.stop()
+        pool = fleet.pools[label]
+        # live-decision counters BEFORE the probe pollutes them: the trace's
+        # own decisions are the deterministic prune-share evidence
+        counters = {
+            "pruned_decisions": pool.router.pruned_decisions,
+            "exact_decisions": pool.router.exact_decisions,
+        }
+        phases[label] = {
+            "size": size,
+            "fleet": fleet,
+            "pool": pool,
+            "counters": counters,
+            "probe": _probe_decision_latency(pool, trace),
+            "requests": len(trace),
+        }
+
+    from .report import pool_report
+
+    sm, lg = phases["small"], phases["large"]
+    probe = {
+        label: {
+            "fleet_size": ph["size"],
+            "pruned": _ns_pcts(ph["probe"]["pruned_ns"]),
+            "exact": _ns_pcts(ph["probe"]["exact_ns"]),
+        }
+        for label, ph in phases.items()
+    }
+    p99_small = probe["small"]["pruned"]["p99_us"]
+    p99_large = probe["large"]["pruned"]["p99_us"]
+    p50_small = probe["small"]["pruned"]["p50_us"]
+    p50_large = probe["large"]["pruned"]["p50_us"]
+    # floors guard the ratio against sub-20us denominators on fast hosts
+    ok_p99 = p99_large <= 3.0 * max(p99_small, 20.0)
+    ok_p50 = p50_large <= 3.0 * max(p50_small, 10.0)
+    exact_p50_large = probe["large"]["exact"]["p50_us"]
+    rep_s, rep_l = pool_report(sm["pool"]), pool_report(lg["pool"])
+    lg_total = (
+        lg["counters"]["pruned_decisions"] + lg["counters"]["exact_decisions"]
+    )
+    pruned_share = lg["counters"]["pruned_decisions"] / max(lg_total, 1)
+    size_ratio = lg["size"] / sm["size"]
+    invs = [
+        _invariant(
+            "decision_p99_sublinear", ok_p99,
+            f"pruned decision p99 {p99_large}us at {lg['size']} workers vs "
+            f"{p99_small}us at {sm['size']} (bound 3x for a {size_ratio:.0f}x "
+            "fleet; the linear scan scales with the fleet)",
+        ),
+        _invariant(
+            "decision_p50_sublinear", ok_p50,
+            f"pruned decision p50 {p50_large}us at {lg['size']} workers vs "
+            f"{p50_small}us at {sm['size']} (bound 3x)",
+        ),
+        _invariant(
+            "pruned_beats_exact_at_scale",
+            p50_large < exact_p50_large,
+            f"pruned p50 {p50_large}us vs exact linear-scan p50 "
+            f"{exact_p50_large}us at {lg['size']} workers",
+        ),
+        _invariant(
+            "pruned_is_default_path", pruned_share >= 0.9,
+            f"{lg['counters']['pruned_decisions']}/{lg_total} live decisions "
+            "took the pruned path at the large fleet",
+        ),
+        _invariant(
+            "radix_reuse_at_scale", rep_l["cache_hit_ratio"] >= 0.35,
+            f'large-fleet cache hit ratio {rep_l["cache_hit_ratio"]} '
+            "(pruned prefix candidates must keep finding the holders)",
+        ),
+        _invariant(
+            "all_completed",
+            rep_s["failed"] == 0 and rep_l["failed"] == 0,
+            f'small {rep_s["completed"]}/{rep_s["requests"]}, '
+            f'large {rep_l["completed"]}/{rep_l["requests"]}',
+        ),
+    ]
+    return {
+        "fleet": lg["fleet"],
+        "invariants": invs,
+        "requests": lg["requests"],
+        "extra_sim": {
+            "scale": {
+                label: {
+                    "fleet_size": ph["size"],
+                    "completed": pool_report(ph["pool"])["completed"],
+                    "cache_hit_ratio": pool_report(ph["pool"])["cache_hit_ratio"],
+                    **ph["counters"],
+                }
+                for label, ph in phases.items()
+            },
+        },
+        "extra_wall": {
+            "router_probe": probe,
+            "small_fleet_decision_us": (
+                _ns_pcts(sm["pool"].decision_wall_ns)
+            ),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# http-frontend
+# ---------------------------------------------------------------------------
+
+
+async def _http_frontend(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    """The REAL HTTP frontend in the virtual-clock loop (the last sim
+    realism gap): a real aiohttp ``HttpService`` on a localhost socket, a
+    real KV-mode ``ModelPipeline`` (preprocessor -> Migration -> per-worker
+    breakers -> KvRouter) over the mocker fleet, driven by a real aiohttp
+    client. Bursts overrun ``busy_threshold`` so admission sheds with 503s;
+    a seeded flap on one worker trips its frontend-side breaker so routing
+    steers around it and Migration absorbs the losses; /metrics and
+    /debug/slo are scraped over the wire. Socket readiness is real I/O, so
+    this scenario's counts are *bounded*, not byte-deterministic — its
+    invariants assert behavior windows, and it is deliberately absent from
+    the byte-identity pins."""
+    import aiohttp
+
+    from ..llm.discovery import ModelManager, ModelPipeline
+    from ..llm.http.service import HttpService
+    from ..llm.model_card import ModelDeploymentCard
+    from ..llm.protocols.common import PreprocessedRequest
+    from ..runtime.component import RouterMode
+    from ..runtime.faults import FAULTS, FaultInjected
+
+    flap_wid = 1
+    flap_until = 0.55 * duration_s
+    busy_threshold = max(6, 2 * workers)
+    trace = traces.bursty(
+        duration_s=duration_s,
+        base_rate=0.2 * workers * _CAPACITY_REQ_S,
+        burst_rate=1.1 * workers * _CAPACITY_REQ_S,
+        burst_len_s=duration_s / 8, cycle_s=duration_s / 4,
+        isl=128, osl=6, seed=seed, ttft_target_s=60.0, itl_target_s=5.0,
+    )
+    cfg = FleetConfig(
+        seed=seed, prefix_share=0.5,
+        faults=f"sim.http.worker.{flap_wid}:drop@p=0.9@seed={seed + 31}",
+        pools=[PoolConfig(
+            name="decode", initial_workers=workers,
+            min_workers=workers, max_workers=workers, **_SPEED,
+        )],
+    )
+    fleet = SimFleet(cfg, clock)
+    await fleet.start()
+    pool = fleet.default_pool
+
+    serve_log: List[tuple] = []    # (t, wid) engine dispatches that started
+    fault_log: List[tuple] = []    # (t, wid) flap-injected connection losses
+    calls = [0]
+
+    class _Inst:
+        __slots__ = ("metadata",)
+
+        def __init__(self):
+            self.metadata = {"data_parallel_size": 1}
+
+    _INST = _Inst()
+
+    class _Stream:
+        """Worker stream with the ``instance_id`` tag Migration attributes
+        failures to (the request plane's _TaggedStream analog)."""
+
+        def __init__(self, gen, iid):
+            self._gen = gen.__aiter__()
+            self.instance_id = iid
+
+        def __aiter__(self):
+            return self
+
+        def __anext__(self):
+            return self._gen.__anext__()
+
+    class _SimClient:
+        """The Client surface ModelPipeline reads, over the mocker fleet."""
+
+        @property
+        def instances(self):
+            return {wid: _INST for wid in pool.workers}
+
+        def instance_ids(self):
+            return list(pool.workers)
+
+        async def generate(self, obj, context, instance_id=None):
+            calls[0] += 1
+            w = pool.workers.get(instance_id)
+            if w is None:
+                e = ConnectionError(f"sim worker {instance_id} gone")
+                e.instance_id = instance_id
+                raise e
+            try:
+                # drop raises InjectedDrop (a ConnectionError) so it looks
+                # like transport loss; fail raises FaultInjected
+                await FAULTS.ainject(f"sim.http.worker.{instance_id}")
+            except (FaultInjected, ConnectionError) as flap:
+                fault_log.append((clock.time(), instance_id))
+                e = ConnectionError(str(flap))
+                e.instance_id = instance_id
+                raise e
+            serve_log.append((clock.time(), instance_id))
+            req = PreprocessedRequest.from_obj(obj)
+            return _Stream(w.engine.generate(req, context), instance_id)
+
+    card = ModelDeploymentCard(
+        name="sim-http", tokenizer="byte", context_length=8192,
+        kv_block_size=pool.cfg.block_size, migration_limit=3,
+    )
+    pipeline = ModelPipeline(None, card, RouterMode.KV)
+    pipeline.client = _SimClient()
+    pipeline.kv_router = pool.router  # the pool's REAL KvRouter
+    manager = ModelManager()
+    manager.add("sim-http", pipeline)
+    service = HttpService(
+        manager, busy_threshold=busy_threshold, host="127.0.0.1", port=0,
+    )
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+
+    # a steady timer keeps the virtualized selector polling (socket
+    # readiness is real I/O the loop must keep observing) and bounds how
+    # far virtual time can jump while a TCP exchange is in flight
+    async def _heartbeat():
+        while True:
+            await clock.sleep(0.2)
+
+    fleet.spawn_task(_heartbeat())
+
+    async def _recover():
+        await clock.sleep(flap_until)
+        fleet.disarm_fault(f"sim.http.worker.{flap_wid}")
+
+    fleet.spawn_task(_recover())
+
+    # frontend-side breaker transitions for the flapping worker, sampled on
+    # the virtual clock (discovery builds these lazily per worker id)
+    breaker_states: List[tuple] = []
+
+    async def _monitor():
+        last = None
+        while True:
+            await clock.sleep(1.0)
+            cb = pipeline._worker_breakers.get(flap_wid)
+            st = cb.state if cb is not None else "unknown"
+            if st != last:
+                breaker_states.append((round(clock.time(), 1), st))
+                last = st
+
+    fleet.spawn_task(_monitor())
+
+    statuses: Dict[str, int] = {}
+
+    def _note(key: str) -> None:
+        statuses[key] = statuses.get(key, 0) + 1
+
+    results = {"ok": 0, "failed": 0, "client_retries": 0}
+    timeout = aiohttp.ClientTimeout(
+        total=None, connect=None, sock_read=None, sock_connect=None
+    )
+    session = aiohttp.ClientSession(
+        timeout=timeout, connector=aiohttp.TCPConnector(force_close=True),
+    )
+
+    async def _one(idx: int, sreq: traces.SimRequest) -> None:
+        item = sreq.item
+        shared = (f"g{item.group % 100:02d}:" * item.isl)[: int(item.isl * 0.6)]
+        text = (shared + f"u{idx}:" * item.isl)[: item.isl]
+        body = {
+            "model": "sim-http", "prompt": text,
+            "max_tokens": item.osl, "stream": False,
+        }
+        for attempt in range(8):
+            if attempt:
+                results["client_retries"] += 1
+            try:
+                async with session.post(
+                    base + "/v1/completions", json=body
+                ) as resp:
+                    status = resp.status
+                    try:
+                        data = await resp.json()
+                    except Exception:
+                        data = None
+            except aiohttp.ClientError:
+                _note("conn_error")
+                await clock.sleep(1.0)
+                continue
+            if status == 200:
+                _note("200")
+                results["ok"] += 1
+                return
+            if status == 503:
+                msg = ((data or {}).get("error") or {}).get("message", "")
+                busy = "busy" in msg
+                _note("503_busy" if busy else "503_circuit")
+                try:
+                    retry_after = float(resp.headers.get("Retry-After", 1.0))
+                except ValueError:
+                    retry_after = 1.0
+                # linear backoff past the burst tail: shed load must come
+                # back later, not hammer the breaker window
+                await clock.sleep(min(retry_after, 2.0) + 2.0 * attempt + 0.5)
+                continue
+            _note(str(status))
+            break
+        results["failed"] += 1
+
+    metrics_text = ""
+    slo_payload: Dict = {}
+    try:
+        import asyncio
+
+        tasks: List = []
+        t_prev = 0.0
+        for idx, sreq in enumerate(trace):
+            dt = sreq.t - t_prev
+            t_prev = sreq.t
+            if dt > 0:
+                await clock.sleep(dt)
+            tasks.append(asyncio.create_task(_one(idx, sreq)))
+        if tasks:
+            await asyncio.gather(*tasks)
+        # scrape the observability surfaces over the real wire
+        async with session.get(base + "/metrics") as r:
+            if r.status == 200:
+                metrics_text = await r.text()
+        async with session.get(base + "/debug/slo") as r:
+            if r.status == 200:
+                slo_payload = await r.json()
+    finally:
+        await session.close()
+        await service.stop()
+        await fleet.stop()
+
+    n_req = len(trace)
+    goodput = results["ok"] / max(n_req, 1)
+    opens = [t for t, st in breaker_states if st == OPEN]
+    first_open = opens[0] if opens else float("inf")
+    during = [
+        (t, wid) for t, wid in serve_log if first_open <= t <= flap_until
+    ]
+    on_flapped = sum(1 for _, wid in during if wid == flap_wid)
+    share_during = on_flapped / max(len(during), 1)
+    fair = 1.0 / workers
+    shed = statuses.get("503_busy", 0)
+    invs = [
+        _invariant(
+            "admission_shed", shed > 0 and shed < n_req,
+            f"frontend shed {shed} requests with busy-503 at "
+            f"busy_threshold={busy_threshold} (statuses "
+            f"{dict(sorted(statuses.items()))})",
+        ),
+        _invariant(
+            "breaker_steered",
+            bool(opens) and share_during <= max(0.5 * fair, 0.02),
+            f"worker {flap_wid} breaker opened at t={opens[:3]}; it served "
+            f"{share_during:.4f} of dispatches while tripped "
+            f"(fair share {fair:.4f})",
+        ),
+        _invariant(
+            "migration_absorbed",
+            len(fault_log) >= 3 and goodput >= 0.97,
+            f"{len(fault_log)} injected worker losses absorbed "
+            f"(retry-then-migrate); goodput {goodput:.4f} over {n_req}",
+        ),
+        _invariant(
+            "frontend_observable",
+            "dtpu_requests_total" in metrics_text
+            and "sim-http" in str(slo_payload.get("models", {})),
+            "/metrics exposes dtpu_requests_total and /debug/slo carries "
+            "the sim-http ledger, scraped over the live socket",
+        ),
+    ]
+    return {
+        "fleet": fleet,
+        "invariants": invs,
+        "requests": n_req,
+        "extra_sim": {
+            "http": {
+                "statuses": dict(sorted(statuses.items())),
+                "client_retries": results["client_retries"],
+                "generate_calls": calls[0],
+                "breaker_transitions": breaker_states,
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # registry + runner
 # ---------------------------------------------------------------------------
 
@@ -781,6 +1263,8 @@ SCENARIOS: Dict[str, Callable] = {
     "multi-pool-balance": _multi_pool_balance,
     "multi-region-follow-sun": _multi_region_follow_sun,
     "disagg-streamed-prefill": _disagg_streamed_prefill,
+    "router-scale-sublinear": _router_scale,
+    "http-frontend": _http_frontend,
 }
 
 # aliases accepted by the CLI (`python -m dynamo_tpu.sim diurnal`)
@@ -791,6 +1275,8 @@ ALIASES = {
     "multipool": "multi-pool-balance",
     "regions": "multi-region-follow-sun",
     "disagg": "disagg-streamed-prefill",
+    "scale": "router-scale-sublinear",
+    "frontend": "http-frontend",
 }
 
 
@@ -825,8 +1311,12 @@ def run_scenario(
         name=full, seed=seed, fleet=out["fleet"],
         invariants=out["invariants"], sim_duration_s=duration,
         wall_elapsed_s=time.perf_counter() - t0,
-        extra_sim={"workers": workers, "trace_requests": out["requests"]},
+        extra_sim={
+            "workers": workers, "trace_requests": out["requests"],
+            **out.get("extra_sim", {}),
+        },
         sim_advanced_s=clock.advanced,
+        extra_wall=out.get("extra_wall"),
     )
 
 
@@ -841,7 +1331,8 @@ def run_suite(
     gate = names or [
         "diurnal-autoscale", "bursty-breaker-chaos",
         "prefix-heavy-radix", "multi-pool-balance",
-        "disagg-streamed-prefill",
+        "disagg-streamed-prefill", "router-scale-sublinear",
+        "http-frontend",
     ]
     return [
         run_scenario(n, seed=seed, workers=workers, duration_s=duration_s)

@@ -1,0 +1,421 @@
+"""Two-stage routing (prune -> exact rescore): postings index, load buckets,
+sharded snapshots, and the quality-parity property (ISSUE 13).
+
+The pruned path must be an *optimization*, not a behavior change: on small
+fleets it never engages; where it engages, the exact rescoring stage keeps
+the decision inside the exact argmin's tie-set on seeded random trees and
+loads (the NetKV-style claim the ROADMAP targets)."""
+
+import random
+
+from dynamo_tpu.kv_router import (
+    ApproxKvIndexer,
+    KvCacheEvent,
+    KvEventKind,
+    KvIndexer,
+    KvRouter,
+    KvRouterConfig,
+    RadixTree,
+    RouterEvent,
+    WorkerWithDpRank,
+)
+from dynamo_tpu.kv_router.microbench import router_microbench
+from dynamo_tpu.kv_router.scheduler import _LoadIndex
+from dynamo_tpu.runtime import InProcEventPlane
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+BS = 4
+
+
+def W(i, r=0):
+    return WorkerWithDpRank(i, r)
+
+
+def hashes(tokens, bs=BS):
+    return compute_sequence_hashes(tokens, bs)
+
+
+# ---------------------------------------------------------------------------
+# postings index
+# ---------------------------------------------------------------------------
+
+
+class TestPostings:
+    def test_bucket_caps_and_preserves_insertion_order(self):
+        tree = RadixTree(postings_bucket=3)
+        h = hashes(list(range(8)))  # 2 blocks
+        for i in range(6):
+            tree.store(W(i), h)
+        # only the first 3 storers are posted, in order; holders stay exact
+        assert tree.postings.posted(h[0]) == (W(0), W(1), W(2))
+        assert len(tree.find_matches(h).scores) == 6
+
+    def test_underflow_refills_sorted_from_holders(self):
+        tree = RadixTree(postings_bucket=4)
+        h = hashes(list(range(4)))  # 1 block
+        for i in range(8):
+            tree.store(W(i), h)
+        assert tree.postings.posted(h[0]) == (W(0), W(1), W(2), W(3))
+        # removing posted workers below half refills deterministically
+        tree.remove(W(0), h)
+        tree.remove(W(1), h)
+        tree.remove(W(2), h)
+        posted = tree.postings.posted(h[0])
+        assert posted[0] == W(3)
+        assert set(posted) <= {W(i) for i in range(3, 8)}
+        assert len(posted) == 4  # refilled back to the bucket cap
+
+    def test_drop_node_drops_postings(self):
+        tree = RadixTree()
+        h = hashes(list(range(4)))
+        tree.store(W(1), h)
+        tree.remove_worker(W(1))
+        assert tree.postings.posted(h[0]) == ()
+        assert len(tree.postings) == 0
+
+    def test_top_prefix_workers_deepest_first(self):
+        tree = RadixTree()
+        h = hashes(list(range(16)))  # 4 blocks
+        tree.store(W(1), h[:1])
+        tree.store(W(2), h[:2])
+        tree.store(W(3), h)          # deepest holder
+        got = tree.top_prefix_workers(h, 2)
+        assert got[0] == W(3)
+        assert len(got) == 2
+        # k >= holders returns everyone, deepest first
+        assert tree.top_prefix_workers(h, 10) == [W(3), W(2), W(1)]
+        assert tree.top_prefix_workers(h, 0) == []
+        assert tree.top_prefix_workers([], 5) == []
+
+    def test_sharded_postings_partition_by_hash(self):
+        tree = RadixTree(shards=4)
+        h = hashes(list(range(64)))  # 16 blocks spread over shards
+        tree.store(W(1), h)
+        sizes = tree.postings.shard_sizes()
+        assert sum(sizes) == len(h)
+        assert sum(1 for s in sizes if s > 0) > 1  # actually partitioned
+        assert tree.top_prefix_workers(h, 1) == [W(1)]
+
+
+# ---------------------------------------------------------------------------
+# restricted exact matching + the find_matches micro-fix
+# ---------------------------------------------------------------------------
+
+
+class TestFindMatches:
+    def _random_tree(self, seed, n_workers=12, groups=6, depth=8):
+        rng = random.Random(seed)
+        tree = RadixTree()
+        chains = []
+        for g in range(groups):
+            h = hashes([g * 1000 + t for t in range(depth * BS)])
+            chains.append(h)
+            for w in rng.sample(range(n_workers), rng.randrange(1, n_workers)):
+                tree.store(W(w), h[: rng.randrange(1, depth + 1)])
+        return tree, chains
+
+    def test_find_matches_for_equals_restricted_full_scores(self):
+        for seed in range(5):
+            tree, chains = self._random_tree(seed)
+            for h in chains:
+                full = tree.find_matches(h).scores
+                cands = [W(i) for i in range(0, 12, 2)]
+                got = tree.find_matches_for(cands, h).scores
+                want = {w: s for w, s in full.items() if w in set(cands)}
+                assert got == want, (seed, got, want)
+
+    def test_find_matches_one_holder_set_per_block_beyond_first(self):
+        """The per-block ``set(holders)`` copy is gone: a 64-block chain
+        visits 64 nodes and materializes exactly matched-1 = 63 holder
+        sets (the required intersections; the first block aliases the
+        node's set read-only). Pre-fix the walk allocated an EXTRA copy
+        per matched block — 127 total, each O(fleet) on a fleet-hot
+        prefix."""
+        tree = RadixTree()
+        h = hashes(list(range(64 * BS)))  # 64 blocks
+        for i in range(3):
+            tree.store(W(i), h)
+        m = tree.find_matches(h)
+        assert m.matched_blocks == 64
+        assert tree.last_nodes_visited == 64
+        assert tree.last_holder_sets == 63
+        # single-block query: pure alias, zero set allocations
+        tree.find_matches(h[:1])
+        assert tree.last_holder_sets == 0
+
+    def test_find_matches_semantics_unchanged(self):
+        tree = RadixTree()
+        h = hashes(list(range(16)))
+        tree.store(W(0), h)
+        tree.store(W(1), h[:2])
+        m = tree.find_matches(h)
+        assert m.scores == {W(0): 4, W(1): 2}
+        assert m.matched_blocks == 4
+
+
+# ---------------------------------------------------------------------------
+# load index
+# ---------------------------------------------------------------------------
+
+
+class TestLoadIndex:
+    def test_least_orders_and_updates(self):
+        idx = _LoadIndex()
+        for i, load in enumerate([5, 0, 3, 0, 9]):
+            idx.set(W(i), load)
+        assert idx.least(3) == [W(1), W(3), W(2)]
+        idx.set(W(1), 100)                 # busiest now
+        assert idx.least(2) == [W(3), W(2)]
+        idx.remove(W(3))
+        assert idx.least(2) == [W(2), W(0)]
+
+    def test_excluded_and_duplicate_bucket_keys(self):
+        idx = _LoadIndex()
+        idx.set(W(0), 1)
+        idx.set(W(0), 2)
+        idx.set(W(1), 1)   # bucket 1 re-created: duplicate heap key
+        idx.set(W(2), 1)
+        got = idx.least(10)
+        assert got == [W(1), W(2), W(0)]
+        assert idx.least(10, excluded={W(1)}) == [W(2), W(0)]
+        # repeated queries stay stable (heap keys restored)
+        assert idx.least(10) == got
+
+
+# ---------------------------------------------------------------------------
+# the pruned decision path
+# ---------------------------------------------------------------------------
+
+
+def _make_router(n_workers, seed, topk, use_kv_events=True):
+    cfg = KvRouterConfig(
+        topk_candidates=topk, use_kv_events=use_kv_events,
+        metrics_stale_after_s=0.0,  # local-load only: no wall-time reads
+    )
+    router = KvRouter(
+        InProcEventPlane(), "t", "be", block_size=BS, config=cfg, seed=seed,
+    )
+    workers = [W(i) for i in range(n_workers)]
+    for w in workers:
+        router.register_worker(w)
+    return router, workers
+
+
+def _seed_state(router, workers, seed, groups=8, depth=8, max_load=40):
+    rng = random.Random(seed)
+    chains = []
+    eid = 0
+    for g in range(groups):
+        h = hashes([g * 1000 + t for t in range(depth * BS)])
+        chains.append(h)
+        for w in rng.sample(workers, rng.randrange(1, max(2, len(workers) // 2))):
+            eid += 1
+            router.indexer.apply(RouterEvent(
+                w, KvCacheEvent(KvEventKind.STORED, list(h), None, BS), eid,
+            ))
+    for w in workers:
+        load = rng.randrange(0, max_load)
+        if load:
+            router.scheduler.add_local_load(w, load)
+    return chains, rng
+
+
+class TestPrunedSelection:
+    def test_small_fleet_never_prunes(self):
+        router, workers = _make_router(8, 0, topk=16)
+        _seed_state(router, workers, 0)
+        router.score_tokens(list(range(32)))
+        assert router.pruned_decisions == 0
+        assert router.exact_decisions == 1
+
+    def test_pruned_equals_exact_when_k_covers_fleet(self):
+        for n in (8, 24, 64):
+            router, workers = _make_router(n, 3, topk=n)
+            chains, rng = _seed_state(router, workers, 3)
+            for i in range(20):
+                toks = [rng.randrange(2000) for _ in range(24)]
+                a = router.score_tokens(toks)
+                saved = router.config.topk_candidates
+                router.config.topk_candidates = 0
+                b = router.score_tokens(toks)
+                router.config.topk_candidates = saved
+                assert a.worker == b.worker
+
+    def test_pruned_pick_within_exact_tie_set(self):
+        """Quality parity on fleets <= 64: the pruned winner's exact logit
+        equals the exact argmin's logit across seeded random trees/loads —
+        prefix-or-load pruning plus exact rescoring does not change what
+        the decision optimizes."""
+        for n in (40, 48, 64):
+            for seed in range(4):
+                router, workers = _make_router(n, seed, topk=16)
+                chains, rng = _seed_state(router, workers, seed)
+                for i in range(25):
+                    if rng.random() < 0.5:
+                        h = list(rng.choice(chains))
+                        toks = None
+                    else:
+                        toks = [rng.randrange(5000) for _ in range(6 * BS)]
+                        h = None
+                    kw = dict(hashes=h) if h is not None else {}
+                    toks = toks if toks is not None else list(range(6 * BS))
+                    pruned = router.score_tokens(toks, **kw)
+                    saved = router.config.topk_candidates
+                    router.config.topk_candidates = 0
+                    exact = router.score_tokens(toks, **kw)
+                    router.config.topk_candidates = saved
+                    assert router.pruned_decisions > 0
+                    best = min(exact.logits.values())
+                    got = exact.logits[pruned.worker]
+                    assert got == best, (
+                        n, seed, i, got, best, pruned.worker, exact.worker,
+                    )
+
+    def test_excluded_set_routing_and_fallback(self):
+        router, workers = _make_router(6, 0, topk=0)
+        d = router.score_tokens(list(range(16)), excluded={workers[0]})
+        assert d.worker != workers[0]
+        # exclusion covering the whole universe falls back to everyone
+        d2 = router.score_tokens(list(range(16)), excluded=set(workers))
+        assert d2.worker in workers
+
+    def test_reroute_releases_previous_charge(self):
+        """Migration-retry regression: re-scheduling the same request id
+        must release the failed attempt's optimistic load, or the dead
+        worker keeps phantom load forever and is never routed to again."""
+        router, workers = _make_router(2, 0, topk=0)
+        w0, w1 = workers
+        d1 = router.schedule_tokens(list(range(32)), request_id="r1")
+        first = d1.worker
+        other = w1 if first == w0 else w0
+        d2 = router.schedule_tokens(list(range(32)), request_id="r1")
+        assert d2.worker == other  # retry steers to the other worker
+        # the first attempt's charge is gone; only the retry's remains
+        assert router.scheduler.decode_blocks(first) == 0
+        assert router.scheduler.decode_blocks(other) == 8
+        router.complete("r1")
+        assert router.scheduler.decode_blocks(other) == 0
+
+    def test_remove_worker_id_clears_registered_universe(self):
+        router, workers = _make_router(4, 0, topk=0)
+        router.remove_worker_id(2)
+        assert W(2) not in router.scheduler.known_workers()
+        assert router.scheduler.worker_count() == 3
+
+    def test_late_complete_does_not_resurrect_removed_worker(self):
+        """An in-flight request completing AFTER its worker was removed
+        must not re-insert the dead worker into the load index as a
+        zero-load candidate that least_loaded keeps picking."""
+        router, workers = _make_router(4, 0, topk=0)
+        d = router.schedule_tokens(list(range(32)), request_id="r1")
+        router.remove_worker_id(d.worker.worker_id)
+        router.complete("r1")  # late release: the worker is already gone
+        # a stray release reaching the scheduler directly (peer sync) too
+        router.scheduler.sub_local_load(d.worker, 8)
+        assert d.worker not in router.scheduler.known_workers()
+        assert d.worker not in router.scheduler.least_loaded(10)
+        assert router.scheduler.decode_blocks(d.worker) == 0
+
+    def test_approx_indexer_pruned_path(self):
+        router, workers = _make_router(80, 1, topk=8, use_kv_events=False)
+        toks = list(range(8 * BS))
+        d = router.schedule_tokens(toks, request_id="a1")
+        router.complete("a1")  # release the optimistic charge
+        # the approx index learned the route; the pruned prefix path finds it
+        d2 = router.score_tokens(toks)
+        assert router.pruned_decisions >= 1
+        assert d2.overlap_blocks == 8
+        assert d2.worker == d.worker
+
+
+# ---------------------------------------------------------------------------
+# clock injection
+# ---------------------------------------------------------------------------
+
+
+def test_approx_indexer_injected_clock():
+    now = [0.0]
+    idx = ApproxKvIndexer(block_size=BS, ttl_s=10.0, clock=lambda: now[0])
+    h = hashes(list(range(16)))
+    idx.process_routed_request(h, W(0))
+    assert idx.find_matches(h).scores[W(0)] == 4
+    now[0] = 11.0
+    assert W(0) not in idx.find_matches(h).scores
+
+
+def test_scheduler_injected_clock_staleness():
+    from dynamo_tpu.kv_router import WorkerMetrics
+    from dynamo_tpu.kv_router.scheduler import KvScheduler
+
+    now = [100.0]
+    sched = KvScheduler(
+        KvRouterConfig(metrics_stale_after_s=5.0), clock=lambda: now[0]
+    )
+    sched.update_metrics(WorkerMetrics(W(0), active_decode_blocks=50))
+    assert sched.decode_blocks(W(0)) == 50
+    now[0] = 106.0  # stale on the injected clock, no wall time involved
+    assert sched.decode_blocks(W(0)) == 0
+
+
+# ---------------------------------------------------------------------------
+# sharded snapshots
+# ---------------------------------------------------------------------------
+
+
+class TestShardedSnapshots:
+    def test_tree_shard_pieces_compose_to_full(self):
+        tree = RadixTree()
+        for g in range(5):
+            h = hashes([g * 100 + t for t in range(24)])
+            tree.store(W(g), h)
+            tree.store(W(g + 10), h[:3])
+        shards = 4
+        pieces = [tree.snapshot(shard=i, num_shards=shards) for i in range(shards)]
+        assert sum(len(p["nodes"]) for p in pieces) == len(tree)
+        merged = RadixTree()
+        for p in pieces:
+            merged.merge_snapshot(p)
+        for g in range(5):
+            h = hashes([g * 100 + t for t in range(24)])
+            assert merged.find_matches(h).scores == tree.find_matches(h).scores
+
+    def test_indexer_shard_snapshots_merge(self):
+        a = KvIndexer(block_size=BS, shards=4)
+        h = hashes(list(range(64)))
+        a.apply(RouterEvent(W(1), KvCacheEvent(KvEventKind.STORED, h, None, BS), 7))
+        b = KvIndexer(block_size=BS, shards=4)
+        for i in range(4):
+            b.load_snapshot(a.snapshot(shard=i, num_shards=4))
+        assert b.find_matches(h).scores == a.find_matches(h).scores
+        assert b._last_event_id[W(1)] == 7
+
+    def test_approx_shard_snapshots_merge(self):
+        now = [0.0]
+        a = ApproxKvIndexer(block_size=BS, shards=3, clock=lambda: now[0])
+        h = hashes(list(range(32)))
+        a.process_routed_request(h, W(2))
+        b = ApproxKvIndexer(block_size=BS, shards=3, clock=lambda: now[0])
+        for i in range(3):
+            b.load_snapshot(a.snapshot(shard=i, num_shards=3))
+        assert b.find_matches(h).scores == {W(2): 8}
+
+
+# ---------------------------------------------------------------------------
+# the BENCH micro-bench record
+# ---------------------------------------------------------------------------
+
+
+def test_router_microbench_schema():
+    import json
+
+    rec = router_microbench(sizes=(64, 256), decisions=20)
+    assert set(rec) == {"topk", "decisions", "sizes"}
+    assert set(rec["sizes"]) == {"64", "256"}
+    for size in rec["sizes"].values():
+        for mode in ("pruned", "exact"):
+            assert size[mode]["decisions_per_s"] > 0
+            assert size[mode]["mean_candidates_scored"] > 0
+    # exact scores the whole fleet; pruned scores a small bounded set
+    assert rec["sizes"]["256"]["exact"]["mean_candidates_scored"] == 256.0
+    assert rec["sizes"]["256"]["pruned"]["mean_candidates_scored"] < 64
+    json.dumps(rec)

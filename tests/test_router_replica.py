@@ -121,6 +121,65 @@ async def test_late_joiner_snapshot_catchup():
         await plane.close()
 
 
+async def test_late_joiner_sharded_snapshot_catchup():
+    """index_shards > 1: the joiner requests one snapshot per hash-bucket
+    shard and the merged pieces equal the peer's whole tree; the in-flight
+    load table rides the shard-0 answer exactly once (ISSUE 13 sharded
+    router state)."""
+    plane = InProcEventPlane()
+    cfg = KvRouterConfig(replica_sync=True, index_shards=4)
+    a = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    try:
+        pub = KvEventPublisher(plane, "ns", "be", worker_id=0, block_size=BS)
+        prompt = list(range(64))  # 16 blocks spread across the 4 shards
+        await pub.stored(compute_sequence_hashes(prompt, BS))
+        await drain()
+        assert len(a.indexer.tree) == 16
+        a.schedule_tokens(prompt, [W0, W1], request_id="inflight")
+
+        b = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+        assert await poll(lambda: len(b._synced_shards) == 4)
+        assert b.synced_from_peer  # shard 0 carried the active table
+        assert len(b.indexer.tree) == 16
+        assert b.scheduler.decode_blocks(W0) == a.scheduler.decode_blocks(W0)
+        assert (
+            b.schedule_tokens(prompt, [W0, W1]).worker
+            == a.schedule_tokens(prompt, [W0, W1]).worker
+        )
+        await b.stop()
+    finally:
+        await a.stop()
+        await plane.close()
+
+
+async def test_replica_reroute_releases_peer_charge():
+    """A migration retry re-publishes the route for the same request id;
+    peers must release the superseded attempt's load, not leak it onto the
+    failed worker (the phantom-load regression the HTTP-frontend sim
+    scenario exposed)."""
+    plane = InProcEventPlane()
+    cfg = KvRouterConfig(replica_sync=True)
+    a = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    b = await KvRouter(plane, "ns", "be", block_size=BS, config=cfg).start()
+    try:
+        prompt = list(range(32))  # 8 blocks
+        d1 = a.schedule_tokens(prompt, [W0, W1], request_id="r1")
+        d2 = a.schedule_tokens(prompt, [W0, W1], request_id="r1")  # retry
+        assert d2.worker != d1.worker
+        await drain()
+        # on BOTH routers only the retry's charge remains
+        for r in (a, b):
+            assert r.scheduler.decode_blocks(d1.worker) == 0
+            assert r.scheduler.decode_blocks(d2.worker) == 8
+        a.complete("r1")
+        await drain()
+        assert b.scheduler.decode_blocks(d2.worker) == 0
+    finally:
+        await a.stop()
+        await b.stop()
+        await plane.close()
+
+
 async def test_live_events_survive_snapshot_merge():
     """KV events applied while a snapshot is in flight are merged, not wiped:
     the joiner ends with snapshot blocks AND the live event's blocks."""

@@ -11,6 +11,8 @@ import asyncio
 import json
 import time
 
+import pytest
+
 from dynamo_tpu.mocker.engine import MockEngineArgs, MockerEngine
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.llm.protocols.common import (
@@ -226,6 +228,48 @@ def test_disagg_streamed_prefill_same_seed_identical():
     a = run_scenario("disagg-streamed-prefill", seed=3, **SMOKE)
     b = run_scenario("disagg-streamed-prefill", seed=3, **SMOKE)
     assert canonical_json(a["sim"]) == canonical_json(b["sim"])
+
+
+def test_router_scale_sublinear_smoke():
+    """ISSUE 13 tier-1 gate: pruned decision latency sublinear in fleet
+    size at >= 1k workers (p99 within 3x of the 8x-smaller fleet), pruned
+    is the default path, and radix quality holds at scale."""
+    rep = run_scenario("router-scale-sublinear", seed=0, workers=1024,
+                       duration_s=120.0)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    by_name = {iv["name"]: iv for iv in rep["sim"]["invariants"]}
+    assert by_name["decision_p99_sublinear"]["ok"]
+    assert by_name["pruned_is_default_path"]["ok"]
+    scale = rep["sim"]["scale"]
+    assert scale["large"]["fleet_size"] == 1024
+    assert scale["large"]["exact_decisions"] == 0  # pruned by default
+    probe = rep["wall"]["router_probe"]
+    assert probe["large"]["pruned"]["p99_us"] > 0
+    assert probe["large"]["exact"]["p50_us"] > probe["large"]["pruned"]["p50_us"]
+
+
+@pytest.mark.slow
+def test_router_scale_10k_workers():
+    """The full acceptance scale: 10k mocker workers behind the real
+    KvRouter; decision p99 within 3x of the 1250-worker fleet (the linear
+    scan is ~8x and is recorded alongside in the wall section)."""
+    rep = run_scenario("router-scale-sublinear", seed=0, workers=10000,
+                       duration_s=120.0)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    assert rep["sim"]["scale"]["large"]["fleet_size"] == 10000
+
+
+def test_http_frontend_smoke():
+    """The REAL aiohttp frontend inside the virtual-clock loop: admission
+    sheds with busy-503s, the flapping worker's breaker trips and routing
+    steers around it, migration absorbs the injected losses, and
+    /metrics + /debug/slo answer over the live socket."""
+    rep = run_scenario("http-frontend", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    http = rep["sim"]["http"]
+    assert http["statuses"].get("503_busy", 0) > 0
+    assert http["generate_calls"] > 0
+    assert any(st == "open" for _, st in http["breaker_transitions"])
 
 
 # ---------------------------------------------------------------------------

@@ -415,6 +415,10 @@ def _is_sim_path_file(norm_path: str) -> bool:
     return (
         "dynamo_tpu/sim/" in norm_path
         or "/mocker/" in norm_path
+        # the whole KV-routing plane runs inside the virtual-clock sim:
+        # metric staleness, approx TTLs and sync jitter must ride the
+        # injected clock or the sim silently mixes wall seconds in
+        or "dynamo_tpu/kv_router/" in norm_path
         or norm_path.endswith((
             "profiler/loadgen.py", "profiler/fleet_bench.py",
             "planner/metrics_source.py",
