@@ -97,7 +97,7 @@ def _phase_summary(samples: list) -> dict:
     baseline future perf PRs diff against (engine/telemetry.py)."""
     durs = sorted(s.duration_s for s in samples)
     n = len(durs)
-    return {
+    out = {
         "steps": n,
         "mean_ms": round(sum(durs) / n * 1e3, 3),
         "p99_ms": round(durs[min(n - 1, int(n * 0.99))] * 1e3, 3),
@@ -106,6 +106,24 @@ def _phase_summary(samples: list) -> dict:
         ),
         "mean_tokens_per_step": round(sum(s.tokens for s in samples) / n, 2),
     }
+    # async host step-prep overlap (engine/prep.py, DTPU_ASYNC_PREP): how
+    # many chunk-carrying steps consumed a prebuilt pack, the host-prep ms
+    # that ran UNDER the previous step's device compute, and the residual
+    # wait the dispatch still paid
+    prepped = [s for s in samples if getattr(s, "prep_hit", None) is not None]
+    if prepped:
+        hits = [s for s in prepped if s.prep_hit]
+        out["prep"] = {
+            "steps": len(prepped),
+            "hits": len(hits),
+            "overlapped_build_ms": round(
+                sum(s.prep_build_s for s in hits) * 1e3, 3
+            ),
+            "residual_wait_ms": round(
+                sum(s.prep_wait_s for s in hits) * 1e3, 3
+            ),
+        }
+    return out
 
 
 def roofline_tokens_per_s(cfg: LlamaConfig, batch: int, ctx: int) -> float:
@@ -249,10 +267,7 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
             ("long_prompt", 8 * PROMPT_LEN),
         )
     }
-    kernel_bytes = mixed_vs_split(
-        chunk_len=chunk,
-        chunk_total_len=chunk,
-        decode_seq_lens=[PROMPT_LEN + DECODE_TOKENS // 2] * batch,
+    kernel_kw = dict(
         block_size=cfg.block_size,
         kv_heads=mcfg.num_kv_heads,
         num_heads=mcfg.num_heads,
@@ -260,9 +275,38 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
         max_blocks_per_seq=cfg.max_blocks_per_seq,
         kv_itemsize=kv_itemsize,
         quantized=kv_dtype == "int8",
-        bucket=next((b for b in cfg.prefill_buckets if b >= chunk),
-                    cfg.prefill_chunk),
     )
+    decode_lens = [PROMPT_LEN + DECODE_TOKENS // 2] * batch
+    bucket = next((b for b in cfg.prefill_buckets if b >= chunk),
+                  cfg.prefill_chunk)
+    kernel_bytes = mixed_vs_split(
+        chunk_len=chunk,
+        chunk_total_len=chunk,
+        decode_seq_lens=decode_lens,
+        bucket=bucket,
+        **kernel_kw,
+    )
+    # per-family unified-vs-split byte ratios (ops/costs.py): the gated
+    # families now ride the unified kernel, so BENCH tracks each family's
+    # ratio separately (tier-1 pins the schema and ratio <= 1.0)
+    from dynamo_tpu.ops.costs import spec_verify_vs_split
+
+    kernel_bytes["families"] = {
+        # gpt-oss-like sliding window over the bench shapes: the unified
+        # side skips aged-out pages, the split side's trailing gather
+        "windowed": mixed_vs_split(
+            chunk_len=chunk, chunk_total_len=chunk,
+            decode_seq_lens=decode_lens, bucket=bucket, window=128,
+            **kernel_kw,
+        ),
+        # spec-decode verify: query_len = k+1 unified rows vs the retired
+        # split prefix-extend launch
+        "spec_verify": spec_verify_vs_split(4, decode_lens, **kernel_kw),
+        # batched LoRA rides the SAME packed launch — adapter gathers live
+        # in the projections, attention bytes are identical to plain mixed
+        "lora": dict(kernel_bytes, note="adapter ids ride the packed "
+                     "buffer; attention bytes equal plain mixed"),
+    }
 
     return {
         "metric": "decode_throughput_qwen3_0.6b_bs%d" % batch,

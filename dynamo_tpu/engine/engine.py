@@ -142,7 +142,7 @@ class TpuEngineConfig:
     # the draft keeps a SHADOW paged KV cache addressed by the same block
     # tables as the main cache, drafts spec_k greedy tokens per round, and
     # ONE main-model forward over the k candidate positions verifies them
-    # (ops/attention.paged_extend_attention). Greedy-equality is the
+    # (query_len=k+1 rows of the unified ragged kernel). Greedy-equality is the
     # invariant: output is token-identical to the plain engine; the draft
     # only ever changes the acceptance rate. Eligible rows: temperature 0,
     # no penalties, no logprobs, no logits processors (mixed batches fall
@@ -466,29 +466,41 @@ class TpuEngine:
                     "eos_id) — see guided.vocab_bytes_from_tokenizer"
                 )
         if registry.is_gptoss(self.mcfg) or registry.is_gemma(self.mcfg):
+            # the unified ragged kernel carries per-row window/sink/softcap
+            # attributes (ops/pallas_unified), so use_pallas is no longer
+            # rejected for these families: windowed/sink layers route
+            # through the unified launch, full-attention layers keep the
+            # split decode kernel. Only the ring (sp) path still lacks the
+            # window masks.
             if config.sp > 1:
                 raise ValueError(
                     "sliding-window attention (gpt-oss/gemma) does not ride"
                     " the ring (sp) path yet; use chunked prefill on sp=1"
-                )
-            if config.use_pallas:
-                raise ValueError(
-                    "windowed/softcapped attention (gpt-oss/gemma) runs the"
-                    " pure-JAX paths; the Pallas kernels do not support it"
                 )
         # whether the Pallas kernels are active for this engine (one
         # resolution shared by _build_programs and the mixed gate below)
         self.use_pallas = self._resolve_use_pallas()
         # mixed continuous batching: a prefill chunk fuses into the decode
         # batch through ONE program (unified ragged paged attention). The
-        # knob gates intent; the feature additionally requires the plain
-        # text path (the fused program covers neither the pp/sp forwards,
-        # the draft-cache coupling of spec decode, per-token LoRA/vision
-        # splicing, the multihost replay table, nor windowed/sink families)
-        # AND the Pallas kernels by default — on a pure-JAX engine the
-        # fused step would run the O(R*Tq*T) reference attention, slower
-        # than the split dispatches it replaces, so only an EXPLICIT
-        # mixed_admission=True (--mixed on; CPU/interpret tests) forces it.
+        # knob gates intent; the feature additionally requires the Pallas
+        # kernels by default — on a pure-JAX engine the fused step would
+        # run the O(R*Tq*T) reference attention, slower than the split
+        # dispatches it replaces, so only an EXPLICIT mixed_admission=True
+        # (--mixed on; CPU/interpret tests) forces it.
+        #
+        # MIXED GATE (the one documented exclusion site — tools/analysis
+        # MIXED-GATE pins it; add a family here only with a baseline
+        # entry). Remaining exclusions and why:
+        #   pp/sp    — the fused step covers neither the wavefront nor the
+        #              ring forward;
+        #   vision   — per-chunk soft-token splicing is not threaded
+        #              through the packed buffer yet;
+        #   multihost — the fused program is not in the replay table.
+        # Spec decode, LoRA and the windowed/sink/softcap families
+        # (gpt-oss/gemma) ARE mixed-eligible: verify rides the unified
+        # kernel as q_len=k+1 rows, per-row adapter ids thread through the
+        # packed buffer, and window/sink/softcap are per-row kernel
+        # attributes.
         mixed = config.mixed_admission
         if mixed is None:
             mixed = os.environ.get("DTPU_MIXED", "1").lower() not in (
@@ -499,12 +511,8 @@ class TpuEngine:
             and (config.mixed_admission is True or self.use_pallas)
             and config.pp == 1
             and config.sp == 1
-            and config.spec_draft is None
             and config.vision is None
-            and config.lora_max_adapters == 0
             and multihost is None
-            and not registry.is_gptoss(self.mcfg)
-            and not registry.is_gemma(self.mcfg)
         )
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
@@ -696,6 +704,15 @@ class TpuEngine:
         self._offload_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-offload"
         )
+        # async host step-prep (engine/prep.py, DTPU_ASYNC_PREP): step N+1's
+        # chunk packing + upload run on a prep thread under step N's device
+        # compute. Multihost keeps serial prep (dispatch args are part of
+        # the leader's replay-ordered broadcast).
+        from .prep import ChunkPrep, async_prep_enabled
+
+        self._prep = None
+        if async_prep_enabled() and multihost is None:
+            self._prep = ChunkPrep(self._chunk_arrays, upload=jnp.asarray)
         # multimodal vision tower (models/vision.py) + encoder cache
         self.vision_params = None
         self._encode_image_fn = None
@@ -860,10 +877,13 @@ class TpuEngine:
         slices need the minor dim 128-aligned (head_dim is the page's minor
         dim, so odd head sizes fall back to pure JAX); the shard_map'd
         kernel shards the cache on kv_heads, so fewer kv heads than TP
-        shards (MQA / MLA latent) falls back to the GSPMD pure-JAX path;
-        windowed/sink attention families (gpt-oss, gemma) ride the pure-JAX
-        ops. pp serving never uses Pallas (construction rejects the
-        combination)."""
+        shards (MQA / MLA latent) falls back to the GSPMD pure-JAX path.
+        The windowed/sink families (gpt-oss, gemma) are SUPPORTED by the
+        unified kernel's per-row attributes but stay off the auto rule
+        until a real-TPU run confirms the windowed chunk-start lowering
+        (the PR 2 caveat protocol) — an explicit use_pallas=True routes
+        their windowed/sink layers through the unified launch. pp serving
+        never uses Pallas (construction rejects the combination)."""
         if self.cfg.pp > 1:
             return False
         if self.cfg.use_pallas is not None:
@@ -1110,13 +1130,34 @@ class TpuEngine:
         use_pallas = self.use_pallas
         if use_pallas:
             from ..ops import pallas_attention as pa
+            from ..ops import pallas_unified as pun
 
             mesh = self.mesh
             # off-TPU (forced use_pallas in CPU tests) the kernel runs in the
             # Pallas interpreter
             interp = jax.default_backend() != "tpu"
 
-            def paged_attention(q, kc, vc, tables, lens):
+            def paged_attention(q, kc, vc, tables, lens, **extra):
+                if extra:
+                    # windowed/sink/softcap layers (gpt-oss/gemma): the
+                    # split decode kernel carries no per-row attributes —
+                    # serve the decode batch as q_len=1 rows of the
+                    # unified ragged kernel instead
+                    B = q.shape[0]
+                    win = extra.get("window")
+                    return pun.sharded_ragged_paged_attention(
+                        mesh, meshlib.AXIS_TP, q, kc, vc, tables,
+                        jnp.arange(B, dtype=jnp.int32),
+                        (lens > 0).astype(jnp.int32),
+                        lens.astype(jnp.int32),
+                        windows=(
+                            jnp.full((B,), win, jnp.int32)
+                            if win is not None else None
+                        ),
+                        sinks=extra.get("sinks"),
+                        softcap=extra.get("softcap"),
+                        interpret=interp,
+                    )
                 return pa.sharded_paged_decode_attention(
                     mesh, meshlib.AXIS_TP, q, kc, vc, tables, lens,
                     interpret=interp,
@@ -1235,6 +1276,28 @@ class TpuEngine:
                     return ringlib.ring_extend_attention(
                         self.mesh, q, k_new, v_new, k_ctx, v_ctx,
                         positions, chunk_start, chunk_start,
+                    )
+                if use_pallas and extra:
+                    # windowed/sink/softcap chunk (gpt-oss/gemma): the
+                    # flash-extend kernel has no per-row attributes —
+                    # serve the chunk as ONE ragged row of the unified
+                    # kernel (segment at the context tail; window
+                    # page-skip included) instead of the dense reference
+                    # extend over the gathered context
+                    win = extra.get("window")
+                    return pun.sharded_ragged_paged_attention(
+                        mesh, meshlib.AXIS_TP, q, kc, vc,
+                        block_table[None],
+                        jnp.zeros((1,), jnp.int32),
+                        (total_len - chunk_start).astype(jnp.int32)[None],
+                        total_len.astype(jnp.int32)[None],
+                        windows=(
+                            jnp.full((1,), win, jnp.int32)
+                            if win is not None else None
+                        ),
+                        sinks=extra.get("sinks"),
+                        softcap=extra.get("softcap"),
+                        interpret=interp,
                     )
                 from ..ops import pallas_prefill as pf
 
@@ -1444,12 +1507,19 @@ class TpuEngine:
             return out + (g_out,) if g_active is not None else out
 
         if use_pallas:
-            from ..ops import pallas_unified as pun
-
-            def ragged_attention(q, kc, vc, tables, q_starts, q_lens, lens):
+            def ragged_attention(q, kc, vc, tables, q_starts, q_lens, lens,
+                                 window=None, sinks=None, softcap=None):
+                # scalar per-layer window -> per-row windows array (every
+                # row of one launch shares the layer's bound)
+                R = tables.shape[0]
                 return pun.sharded_ragged_paged_attention(
                     self.mesh, meshlib.AXIS_TP, q, kc, vc, tables,
-                    q_starts, q_lens, lens, interpret=interp,
+                    q_starts, q_lens, lens,
+                    windows=(
+                        jnp.full((R,), window, jnp.int32)
+                        if window is not None else None
+                    ),
+                    sinks=sinks, softcap=softcap, interpret=interp,
                 )
         else:
             ragged_attention = att.ragged_paged_attention
@@ -1483,8 +1553,9 @@ class TpuEngine:
             active = d_seq_lens > 0
 
             def attend(q, k_new, v_new, layer_idx, **extra):
-                # extra stays empty: mixed is gated off for windowed/sink
-                # families at engine construction
+                # extra: per-layer attention variants (sliding window,
+                # per-head sinks, softcap — gpt-oss/gemma) thread straight
+                # into the unified launch as per-row attributes
                 kc, vc = k_caches[layer_idx], v_caches[layer_idx]
                 k_c, v_c = k_new[:S_pad], v_new[:S_pad]
                 if quantized:
@@ -1517,11 +1588,23 @@ class TpuEngine:
                     d_seq_lens.astype(jnp.int32),
                 ])
                 return ragged_attention(
-                    q, kc, vc, tables, q_starts, q_lens, row_lens
+                    q, kc, vc, tables, q_starts, q_lens, row_lens, **extra
                 )
 
+            if lora_enabled:
+                # per-row adapter indices threaded through the packed
+                # buffer: the chunk's tokens carry its slot's adapter, each
+                # decode token its own — batched LoRA rides the same launch
+                # (lora/adapters.make_lora_fn per-token branch)
+                packed_lora_ids = jnp.concatenate([
+                    jnp.full((S_pad,), lora_ids[c_slot], jnp.int32),
+                    lora_ids.astype(jnp.int32),
+                ])
+            else:
+                packed_lora_ids = lora_ids
             hidden = call_fwd(
-                params, tokens, positions, attend, lora_tables, lora_ids
+                params, tokens, positions, attend, lora_tables,
+                packed_lora_ids,
             )  # [S_pad + B, H]
 
             # -- decode epilogue: verbatim decode() ---------------------------
@@ -1672,9 +1755,11 @@ class TpuEngine:
                 use_pallas
                 and dcfg.head_dim % 128 == 0
                 and dcfg.num_kv_heads % meshlib.tp_size(self.mesh) == 0
-                # windowed/softcapped families (gpt-oss AND gemma) need the
-                # pure-JAX attention extras the Pallas decode kernel lacks —
-                # same gating the main model gets at construction time
+                # windowed/softcapped draft families (gpt-oss AND gemma)
+                # keep the pure-JAX decode path: the draft loop uses the
+                # split decode kernel, which has no per-row attributes —
+                # only the MAIN model's windowed layers ride the unified
+                # kernel (same auto-rule caution as _resolve_use_pallas)
                 and not registry.is_gptoss(dcfg)
                 and not registry.is_gemma(dcfg)
             )
@@ -1717,9 +1802,10 @@ class TpuEngine:
                            lora_tables, lora_ids):
                 """R speculative rounds in one program. Each round: sk greedy
                 draft steps over the shadow cache, ONE main forward verifying
-                the sk+1 candidate positions (paged_extend_attention), then
-                vectorized accept — advance n_match+1 capped at sk tokens per
-                row. Packed result [R, B, 1+2sk]: advance count, the sk
+                the sk+1 candidate positions (query_len=sk+1 rows of the
+                unified ragged kernel — the same launch mixed batching
+                uses), then vectorized accept — advance n_match+1 capped at
+                sk tokens per row. Packed result [R, B, 1+2sk]: advance count, the sk
                 verified tokens, their logprobs. Carry (tokens/seq_lens/
                 steps) matches decode_multi's, so spec horizons chain with
                 normal ones."""
@@ -1785,10 +1871,32 @@ class TpuEngine:
                                 kc2, vc2, k_new[:, s], v_new[:, s], wb, wo
                             )
                         k_caches[layer_idx], v_caches[layer_idx] = kc2, vc2
-                        return att.paged_extend_attention(
-                            q, kc2, vc2, block_tables, start,
-                            seq_lens + sk, **extra
+                        if not use_pallas:
+                            # pure-JAX engines keep the batched extend op:
+                            # the unified TWIN scores the whole packed
+                            # buffer per row (O(B^2) verify FLOPs) — same
+                            # fallback split the prefill/decode paths use
+                            return att.paged_extend_attention(
+                                q, kc2, vc2, block_tables, start,
+                                seq_lens + sk, **extra
+                            )
+                        # verify rides the UNIFIED ragged kernel: each row
+                        # is a segment of query_len = sk+1 candidate tokens
+                        # at its context tail — the same launch the mixed
+                        # step uses, not a separate prefix-extend entry
+                        # point (window/sink/softcap extras included)
+                        h, d_ = q.shape[2], q.shape[3]
+                        out = ragged_attention(
+                            q.reshape(B * (sk + 1), h, d_), kc2, vc2,
+                            block_tables,
+                            jnp.arange(B, dtype=jnp.int32) * (sk + 1),
+                            jnp.where(active, sk + 1, 0).astype(jnp.int32),
+                            jnp.where(active, seq_lens + sk, 0).astype(
+                                jnp.int32
+                            ),
+                            **extra,
                         )
+                        return out.reshape(B, sk + 1, h, d_)
 
                     hidden = call_fwd(
                         params, cand, pos, attend, lora_tables, lora_ids
@@ -2389,6 +2497,8 @@ class TpuEngine:
                 LOCAL_SERVERS.pop(self.transfer_address, None)
         self._executor.shutdown(wait=False)
         self._fetch_executor.shutdown(wait=False)
+        if self._prep is not None:
+            self._prep.stop()
         if self._mh is not None and self._mh.is_leader:
             # broadcasts __stop__ under the dispatch lock so an in-flight
             # dispatch can't slip a collective past the followers' exit
@@ -3155,6 +3265,71 @@ class TpuEngine:
         new_block_ids[: len(real)] = real
         return tokens, positions, new_block_ids
 
+    def _take_chunk_arrays(self, st: "_Seq", prompt, start: int,
+                           chunk_len: int):
+        """One chunk's packed arrays: the async step-prep pipeline's
+        prebuild when it matches exactly (engine/prep.py — built and
+        uploaded under the PREVIOUS step's device compute), else serial
+        ``_chunk_arrays``. Returns ((tokens, positions, new_block_ids),
+        device_uploads_or_None); outputs are byte-identical either way."""
+        if self._prep is not None:
+            got = self._prep.take(
+                st.req.request_id, prompt, start, chunk_len, st.block_ids
+            )
+            if got is not None:
+                return got
+        return (
+            self._chunk_arrays(prompt, start, chunk_len, st.block_ids),
+            None,
+        )
+
+    def _schedule_next_chunk(self, st: "_Seq", prompt, is_final: bool) -> None:
+        """Executor thread, right after a chunk's device call is dispatched
+        (device compute is in flight from here): hand the NEXT chunk's
+        packing + upload to the prep thread so step N+1's host prep runs
+        under step N's device work."""
+        if self._prep is None or is_final:
+            return
+        start = st.prefill_pos
+        remaining = len(prompt) - start
+        if remaining <= 0:
+            return
+        chunk_len = min(remaining, self.cfg.prefill_chunk)
+        self._prep.schedule(
+            st.req.request_id, prompt, start, chunk_len, st.block_ids
+        )
+
+    def _advance_draft_prefill(self, st: "_Seq", prompt) -> None:
+        """Speculative decoding: bring the DRAFT cache's prompt coverage up
+        to the main cache's. Driven off prefill_pos rather than the chunk
+        just dispatched so regions the main cache acquired WITHOUT compute
+        (prefix-cache hit, disagg/kvbm import set prefill_pos past 0) are
+        draft-prefilled too — shared cached blocks get idempotent rewrites
+        (same tokens => same draft KV). Draft coverage of the whole prompt
+        is what keeps acceptance up; correctness never depends on it.
+        Spec-ineligible requests skip it: their draft KV is never read
+        (eligible batchmates cover shared prefix blocks themselves).
+        Shared by the split prefill dispatch AND the fused mixed step."""
+        if self.cfg.spec_draft is None or not st.spec_ok:
+            return
+        cap = self.cfg.prefill_chunk
+        _j = self._j
+        while st.draft_prefill_pos < st.prefill_pos:
+            dstart = st.draft_prefill_pos
+            dlen = min(st.prefill_pos - dstart, cap)
+            dtok, dpos, dnb = self._chunk_arrays(
+                prompt, dstart, dlen, st.block_ids
+            )
+            self.draft_k_caches, self.draft_v_caches = (
+                self._draft_prefill_fn(
+                    self.draft_params, self.draft_k_caches,
+                    self.draft_v_caches, _j(dtok), _j(dpos),
+                    _j(self._block_tables[st.slot]), _j(dnb),
+                    _j(np.int32(dstart + dlen)),
+                )
+            )
+            st.draft_prefill_pos = dstart + dlen
+
     def _run_prefill_chunk(self, st: _Seq):
         """Prefill ONE bounded chunk of st's prompt (reference chunked
         prefill, protocols.rs:112): writes the chunk's KV pages; the final
@@ -3166,14 +3341,18 @@ class TpuEngine:
         cap = self.cfg.prefill_chunk
         is_final = remaining <= cap
         chunk_len = remaining if is_final else cap
-        tokens, positions, new_block_ids = self._chunk_arrays(
-            prompt, start, chunk_len, st.block_ids
+        (tokens, positions, new_block_ids), dev = self._take_chunk_arrays(
+            st, prompt, start, chunk_len
         )
         S_pad = len(tokens)  # the bucketed width (_mm_chunk needs it)
 
         s = st.req.sampling
         total_len = start + chunk_len
         _j = self._j
+        d_tokens, d_positions, d_new_blocks = (
+            dev if dev is not None
+            else (_j(tokens), _j(positions), _j(new_block_ids))
+        )
         g_args = ()
         if self.guided_enabled:
             # full versioned device tables, indexed by slot in the program;
@@ -3184,9 +3363,9 @@ class TpuEngine:
         (self.k_caches, self.v_caches, self.output_counts, tok, lp, tlp_vals,
          tlp_ids) = self._prefill_fn(
             self.params, self.k_caches, self.v_caches, self.output_counts,
-            _j(tokens), _j(positions),
+            d_tokens, d_positions,
             _j(self._block_tables[st.slot]),
-            _j(new_block_ids), _j(np.int32(total_len)), _j(np.int32(start)),
+            d_new_blocks, _j(np.int32(total_len)), _j(np.int32(start)),
             _j(np.array([self._seeds[st.slot]], np.uint32)),
             _j(np.array([0], np.int32)),
             _j(np.array([s.temperature], np.float32)),
@@ -3205,31 +3384,8 @@ class TpuEngine:
             *g_args,
         )
         st.prefill_pos = total_len
-        # speculative decoding: bring the DRAFT cache's prompt coverage up to
-        # the main cache's. Driven off prefill_pos rather than the chunk just
-        # dispatched so regions the main cache acquired WITHOUT compute
-        # (prefix-cache hit, disagg/kvbm import set prefill_pos past 0) are
-        # draft-prefilled too — shared cached blocks get idempotent rewrites
-        # (same tokens => same draft KV). Draft coverage of the whole prompt
-        # is what keeps acceptance up; correctness never depends on it.
-        # Spec-ineligible requests skip it: their draft KV is never read
-        # (eligible batchmates cover shared prefix blocks themselves).
-        if self.cfg.spec_draft is not None and st.spec_ok:
-            while st.draft_prefill_pos < st.prefill_pos:
-                dstart = st.draft_prefill_pos
-                dlen = min(st.prefill_pos - dstart, cap)
-                dtok, dpos, dnb = self._chunk_arrays(
-                    prompt, dstart, dlen, st.block_ids
-                )
-                self.draft_k_caches, self.draft_v_caches = (
-                    self._draft_prefill_fn(
-                        self.draft_params, self.draft_k_caches,
-                        self.draft_v_caches, _j(dtok), _j(dpos),
-                        _j(self._block_tables[st.slot]), _j(dnb),
-                        _j(np.int32(dstart + dlen)),
-                    )
-                )
-                st.draft_prefill_pos = dstart + dlen
+        self._schedule_next_chunk(st, prompt, is_final)
+        self._advance_draft_prefill(st, prompt)
         if not is_final:
             return None
         # NO sync readback here: converting tok/lp on this thread would pay
@@ -3371,8 +3527,8 @@ class TpuEngine:
         cap = self.cfg.prefill_chunk
         is_final = remaining <= cap
         chunk_len = remaining if is_final else cap
-        tokens, positions, new_block_ids = self._chunk_arrays(
-            prompt, start, chunk_len, st.block_ids
+        (tokens, positions, new_block_ids), dev = self._take_chunk_arrays(
+            st, prompt, start, chunk_len
         )
         (d_positions, d_seq_lens, write_blocks, write_offsets, steps) = (
             self._decode_dispatch_arrays(seqs)
@@ -3389,12 +3545,16 @@ class TpuEngine:
                 g_active, _j(self._g_state.copy()),
                 _j(np.int32(st.guided_state)), g_class, g_trans,
             )
+        d_tokens, d_pos_chunk, d_new_blocks = (
+            dev if dev is not None
+            else (_j(tokens), _j(positions), _j(new_block_ids))
+        )
         (self.k_caches, self.v_caches, self.output_counts, toks, lps,
          tlp_vals, tlp_ids, c_tok, c_lp, c_tlp_vals, c_tlp_ids) = (
             self._mixed_fn(
                 self.params, self.k_caches, self.v_caches, self.output_counts,
-                _j(tokens), _j(positions),
-                _j(self._block_tables[st.slot]), _j(new_block_ids),
+                d_tokens, d_pos_chunk,
+                _j(self._block_tables[st.slot]), d_new_blocks,
                 _j(np.int32(start + chunk_len)), _j(np.int32(start)),
                 _j(np.int32(st.slot)), _j(np.bool_(is_final)),
                 _j(np.bool_(c_lp_need)),
@@ -3412,6 +3572,8 @@ class TpuEngine:
             )
         )
         st.prefill_pos = start + chunk_len
+        self._schedule_next_chunk(st, prompt, is_final)
+        self._advance_draft_prefill(st, prompt)
         results = self._decode_results(seqs, toks, lps, tlp_ids, tlp_vals,
                                        lp_need)
         prefill_res = None
@@ -4157,6 +4319,13 @@ class TpuEngine:
             spec_acc = self.spec_stats["emitted"] / (
                 self.spec_stats["rounds"] * self.spec_stats["k"]
             )
+        # async step-prep accounting: only chunk-carrying phases consume a
+        # prebuild (engine/prep.py take())
+        prep = (
+            self._prep.pop_last()
+            if self._prep is not None and phase in ("prefill", "mixed")
+            else None
+        )
         try:
             hook(StepStats(
                 phase=phase,
@@ -4171,6 +4340,9 @@ class TpuEngine:
                 kv_free_blocks=self.allocator.free_blocks,
                 kv_total_blocks=self.cfg.num_blocks,
                 spec_acceptance=spec_acc,
+                prep_hit=(prep["hit"] if prep is not None else None),
+                prep_build_s=(prep["build_s"] if prep is not None else 0.0),
+                prep_wait_s=(prep["wait_s"] if prep is not None else 0.0),
             ))
         except Exception:
             log.exception("stats hook failed")

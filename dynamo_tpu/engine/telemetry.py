@@ -53,6 +53,14 @@ class StepStats:
     kv_free_blocks: int
     kv_total_blocks: int
     spec_acceptance: Optional[float] = None  # None unless spec decoding on
+    # async host step-prep (engine/prep.py, DTPU_ASYNC_PREP): whether this
+    # chunk-carrying step consumed a prebuilt pack, how long the prebuild
+    # took (that time ran UNDER the previous step's device compute when
+    # hit), and how long the dispatch still had to wait on it. None/0 on
+    # decode-only steps and with async prep off.
+    prep_hit: Optional[bool] = None
+    prep_build_s: float = 0.0
+    prep_wait_s: float = 0.0
 
 
 class EngineTelemetry:

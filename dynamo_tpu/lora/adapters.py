@@ -198,9 +198,13 @@ class LoraAdapterTable:
 def make_lora_fn(tables: Dict[str, jax.Array], adapter_ids: jax.Array):
     """``lora(name, layer_idx, x) -> delta`` for llama.forward.
 
-    adapter_ids: [B] int32 for batched decode ([B, S, H] activations) or a
-    scalar for single-sequence prefill ([S, H] activations)."""
+    adapter_ids: [B] int32 for batched decode ([B, S, H] activations), a
+    scalar for single-sequence prefill ([S, H] activations), or [T] int32
+    for a PACKED ragged buffer ([T, H] activations — the engine's mixed
+    step, where each token carries its row's adapter index so one fused
+    launch mixes adapters freely)."""
     scales = tables["scales"]
+    per_token = getattr(adapter_ids, "ndim", 0) == 1
 
     def lora(name: str, layer_idx: int, x: jax.Array) -> Optional[jax.Array]:
         a_key, b_key = f"{name}.A", f"{name}.B"
@@ -208,6 +212,14 @@ def make_lora_fn(tables: Dict[str, jax.Array], adapter_ids: jax.Array):
             return None
         A = tables[a_key][:, layer_idx]   # [N, in, r]
         Bm = tables[b_key][:, layer_idx]  # [N, r, out]
+        if x.ndim == 2 and per_token:
+            # packed buffer: one adapter id per TOKEN (punica-style
+            # gathered batched LoRA, expressed as einsums XLA fuses)
+            Atok = A[adapter_ids]             # [T, in, r]
+            Btok = Bm[adapter_ids]            # [T, r, out]
+            s = scales[adapter_ids][:, None]
+            xa = jnp.einsum("th,thr->tr", x, Atok)
+            return (jnp.einsum("tr,tro->to", xa, Btok) * s).astype(x.dtype)
         if x.ndim == 2:  # prefill: [S, H], one adapter
             s = scales[adapter_ids]
             xa = x @ A[adapter_ids]

@@ -338,6 +338,7 @@ def ragged_paged_attention(
     window: Optional[int] = None,
     sinks: Optional[jax.Array] = None,
     softcap: Optional[float] = None,
+    windows: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Unified ragged paged attention, pure-JAX reference twin of
     ``ops.pallas_unified.ragged_paged_attention``.
@@ -347,10 +348,15 @@ def ragged_paged_attention(
     (its new tokens, sitting at the TAIL of its context — token i of the
     segment is at absolute position ``seq_lens[r] - q_lens[r] + i``) and
     attends causally over its own pages. A decode row is ``q_len == 1``; a
-    prefill chunk is ``q_len == chunk_len``. Segments must be disjoint (gaps
-    are fine — padding rows between segments belong to no row); ``q_len <=
-    seq_len`` per row. Tokens outside every segment, and rows with
-    ``q_len == 0`` or ``seq_len == 0`` (inactive slots), return ZEROS.
+    prefill chunk is ``q_len == chunk_len``; a spec-decode verify pass is a
+    row with ``q_len == k+1``. Segments must be disjoint (gaps are fine —
+    padding rows between segments belong to no row); ``q_len <= seq_len``
+    per row. Tokens outside every segment, and rows with ``q_len == 0`` or
+    ``seq_len == 0`` (inactive slots), return ZEROS.
+
+    ``window`` applies one sliding-window bound to every row; ``windows``
+    ([R] int32, ``<= 0`` = full attention) sets it per row — the form the
+    Pallas kernel takes. ``sinks``/``softcap``: see causal_attention.
 
     This is the numerics reference the Pallas unified kernel pins against in
     interpret mode; the engine's mixed prefill+decode step uses it directly
@@ -359,8 +365,11 @@ def ragged_paged_attention(
     Tq = q.shape[0]
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     idx = jnp.arange(Tq)
+    if windows is None and window is not None:
+        windows = jnp.full(block_tables.shape[0], window, jnp.int32)
+    windowed = windows is not None
 
-    def one(table, q_start, q_len, seq_len):
+    def one(table, q_start, q_len, seq_len, w):
         k, v = gather_kv(k_cache, v_cache, table)   # [T, kvh, d]
         local = idx - q_start
         member = (local >= 0) & (local < q_len) & (seq_len > 0)
@@ -369,8 +378,10 @@ def ragged_paged_attention(
         key_pos = jnp.arange(k.shape[0])
         lim = jnp.minimum(q_pos + 1, seq_len)
         valid = key_pos[None, :] < lim[:, None]
-        if window is not None:
-            valid &= key_pos[None, :] > q_pos[:, None] - window
+        if windowed:
+            valid &= jnp.where(
+                w > 0, key_pos[None, :] > q_pos[:, None] - w, True
+            )
         scores = jnp.where(valid[:, None, :], scores, NEG_INF)
         if sinks is None:
             weights = jax.nn.softmax(scores, axis=-1)
@@ -379,7 +390,11 @@ def ragged_paged_attention(
         out = _gqa_values(weights, v)               # [Tq, h, d] f32
         return jnp.where(member[:, None, None], out, 0.0)
 
-    outs = jax.vmap(one)(block_tables, q_starts, q_lens, seq_lens)
+    w_arg = (
+        windows if windowed
+        else jnp.zeros(block_tables.shape[0], jnp.int32)
+    )
+    outs = jax.vmap(one)(block_tables, q_starts, q_lens, seq_lens, w_arg)
     # segments are disjoint, so summing the per-row masked outputs packs them
     return jnp.sum(outs, axis=0).astype(q.dtype)
 
@@ -398,9 +413,11 @@ def paged_extend_attention(
     """Batched paged prefix-extend: every row attends its S_new new tokens
     causally over its OWN pages (which must already contain the new tokens'
     KV). The verify pass of speculative decoding
-    (docs/speculative_decoding.md) — one main-model forward over the k+1
-    candidate positions per sequence — is exactly this shape; it is also a
-    batched generalization of the engine's per-sequence chunk-extend path.
+    (docs/speculative_decoding.md) is this shape; Pallas engines fold it
+    into the unified ragged kernel as ``query_len = k+1`` rows, while
+    pure-JAX engines keep this op as their fallback split dispatch (the
+    unified TWIN would score the whole packed buffer per row — O(B^2)
+    verify FLOPs). KERNEL-SPLIT flags any new engine call site.
 
     vmap of gather_kv + extend_attention: pure JAX, any head layout the
     single-sequence ops accept (GQA, MQA/MLA-latent), window/sinks

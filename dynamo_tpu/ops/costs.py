@@ -11,9 +11,12 @@ perf gate. Two complementary sources:
   XLA's view of bytes (the kernel drives its own DMAs), so they are
   surfaced as entries for the caller to price with the analytic models;
 - the analytic models below price the paged-attention DMA traffic of the
-  three Pallas kernels exactly — pages touched, scale rows, q/o streams,
-  and the gather copies the split path pays that the unified kernel does
-  not — parameterized by the concrete per-row (query_len, seq_len) mix.
+  three Pallas kernels exactly — pages touched (window-skipped pages
+  excluded for sliding-window rows), scale rows, q/o streams, and the
+  gather copies the split path pays that the unified kernel does not —
+  parameterized by the concrete per-row (query_len, seq_len[, window])
+  mix. Spec-decode verify rows (query_len = k+1) price against the
+  retired split prefix-extend launch (:func:`spec_verify_vs_split`).
 
 ``bench.py`` folds :func:`mixed_vs_split` into BENCH JSON as
 ``detail.kernel_bytes`` and ``tests/test_unified_attention.py`` gates
@@ -117,7 +120,7 @@ def _pages(seq_len: int, bs: int) -> int:
 
 
 def unified_attention_bytes(
-    rows: Sequence[Tuple[int, int]],   # (query_len, seq_len) per row
+    rows: Sequence[Tuple[int, ...]],   # (query_len, seq_len[, window])
     *,
     block_size: int,
     kv_heads: int,
@@ -128,15 +131,25 @@ def unified_attention_bytes(
     quantized: bool = False,
 ) -> int:
     """HBM bytes one unified ragged launch moves (ops/pallas_unified):
-    each active row's REAL pages stream once per kv head as per-head slices
+    each active row's LIVE pages stream once per kv head as per-head slices
     (total = the full page bytes), plus int8 scale rows, plus the packed
-    q read and o write. No gather, no per-q-tile context re-read."""
-    total_q = sum(max(q, 0) for q, _ in rows)
+    q read and o write. No gather, no per-q-tile context re-read.
+
+    A row may carry a third element — a positive sliding-window bound —
+    in which case the kernel never DMAs the pages the window aged out:
+    live pages start at ``max(ctx_start - w + 1, 0) // bs`` (page-granular,
+    matching the kernel's windowed head skip)."""
+    total_q = sum(max(r[0], 0) for r in rows)
     kv = 0
-    for q_len, seq_len in rows:
+    for row in rows:
+        q_len, seq_len = row[0], row[1]
+        w = row[2] if len(row) > 2 else 0
         if q_len <= 0 or seq_len <= 0:
             continue
         p = _pages(seq_len, block_size)
+        if w and w > 0:
+            ctx_start = seq_len - q_len
+            p -= max(ctx_start - w + 1, 0) // block_size
         kv += 2 * p * block_size * kv_heads * head_dim * kv_itemsize
         if quantized:
             # the kernel DMAs the full [kvh] scale row per page per kv head
@@ -192,9 +205,12 @@ def split_decode_bytes(
     kv_itemsize: int = 2,
     q_itemsize: int = 2,
     quantized: bool = False,
+    window: int = None,
 ) -> int:
     """HBM bytes one ragged decode launch moves (ops/pallas_attention):
-    each row's real pages once (+ scale rows), one query token per row."""
+    each row's real pages once (+ scale rows), one query token per row.
+    ``window``: the split windowed-decode path gathers only the trailing
+    ``ceil(w / bs) + 1`` blocks (ops/attention.paged_decode_attention)."""
     kv = 0
     n = 0
     for L in seq_lens:
@@ -202,11 +218,82 @@ def split_decode_bytes(
             continue
         n += 1
         p = _pages(L, block_size)
+        if window is not None and window > 0:
+            p = min((window + block_size - 1) // block_size + 1, p)
         kv += 2 * p * block_size * kv_heads * head_dim * kv_itemsize
         if quantized:
             kv += 2 * p * kv_heads * SCALE_BYTES
     qo = 2 * n * num_heads * head_dim * q_itemsize
     return kv + qo
+
+
+def split_extend_bytes(
+    n_rows: int,
+    s_new: int,                        # candidate tokens per row (spec: k+1)
+    table_blocks: int,                 # gather width: max_blocks_per_seq
+    *,
+    block_size: int,
+    kv_heads: int,
+    num_heads: int,
+    head_dim: int,
+    kv_itemsize: int = 2,
+    q_itemsize: int = 2,
+    quantized: bool = False,
+) -> int:
+    """HBM bytes the SPLIT prefix-extend launch moves for a batch — the
+    pre-unification spec-decode verify pass
+    (ops/attention.paged_extend_attention): per row, ``gather_kv``
+    materializes the FULL padded table (read + write, K and V), the dense
+    extend scores read the gathered context once more, plus the q read /
+    o write over the ``s_new`` candidate positions."""
+    T = table_blocks * block_size
+    ctx_elems = T * kv_heads * head_dim
+    per_row = 2 * 2 * ctx_elems * kv_itemsize   # gather: K+V, read+write
+    per_row += 2 * ctx_elems * kv_itemsize      # dense scores re-read K+V
+    if quantized:
+        per_row += 2 * 2 * table_blocks * kv_heads * SCALE_BYTES
+    qo = 2 * s_new * num_heads * head_dim * q_itemsize
+    return n_rows * (per_row + qo)
+
+
+def spec_verify_vs_split(
+    spec_k: int,
+    decode_seq_lens: Sequence[int],
+    *,
+    block_size: int,
+    kv_heads: int,
+    num_heads: int,
+    head_dim: int,
+    max_blocks_per_seq: int,
+    kv_itemsize: int = 2,
+    q_itemsize: int = 2,
+    quantized: bool = False,
+) -> Dict[str, Any]:
+    """Price ONE spec-decode verify pass as unified ragged rows
+    (``query_len = k+1`` per row, candidates at the context tail) against
+    the split prefix-extend launch it replaced. Returned as a
+    ``detail.kernel_bytes.families`` entry by ``bench.py``; tier-1 asserts
+    the ratio <= 1.0 — strictly stronger than the acceptance bound (the
+    split side here omits the decode dispatch the pair formulation adds).
+    """
+    rows = [(spec_k + 1, int(L) + spec_k) for L in decode_seq_lens if L > 0]
+    kw = dict(
+        block_size=block_size, kv_heads=kv_heads, num_heads=num_heads,
+        head_dim=head_dim, kv_itemsize=kv_itemsize, q_itemsize=q_itemsize,
+        quantized=quantized,
+    )
+    unified = unified_attention_bytes(rows, **kw)
+    split = split_extend_bytes(
+        len(rows), spec_k + 1, max_blocks_per_seq, **kw
+    )
+    return {
+        "unified_verify_bytes": int(unified),
+        "split_extend_bytes": int(split),
+        "ratio": round(unified / split, 4) if split else 0.0,
+        "rows": len(rows),
+        "spec_k": int(spec_k),
+        "quantized": bool(quantized),
+    }
 
 
 # ------------------------------------------------- analytic transfer model
@@ -298,14 +385,18 @@ def mixed_vs_split(
     q_itemsize: int = 2,
     quantized: bool = False,
     bucket: int = None,
+    window: int = None,
 ) -> Dict[str, Any]:
     """Price ONE mixed continuous-batching step against the equivalent
     split pair (one prefill-chunk dispatch + one decode dispatch over the
     same rows). Returns the byte counts and their ratio — the deterministic
     gate `bench.py` emits as ``detail.kernel_bytes`` and tier-1 asserts
-    stays <= 1.0."""
-    rows: List[Tuple[int, int]] = [(chunk_len, chunk_total_len)]
-    rows += [(1, int(L)) for L in decode_seq_lens]
+    stays <= 1.0. ``window``: price every row with a sliding-window bound
+    (gpt-oss/gemma sliding layers) — the unified side skips aged-out pages,
+    the split decode side gathers only the trailing window blocks."""
+    w = int(window) if window else 0
+    rows: List[Tuple[int, int, int]] = [(chunk_len, chunk_total_len, w)]
+    rows += [(1, int(L), w) for L in decode_seq_lens]
     kw = dict(
         block_size=block_size, kv_heads=kv_heads, num_heads=num_heads,
         head_dim=head_dim, kv_itemsize=kv_itemsize, q_itemsize=q_itemsize,
@@ -314,11 +405,14 @@ def mixed_vs_split(
     mixed = unified_attention_bytes(rows, **kw)
     split = split_prefill_bytes(
         chunk_len, chunk_total_len, max_blocks_per_seq, bucket=bucket, **kw
-    ) + split_decode_bytes(decode_seq_lens, **kw)
-    return {
+    ) + split_decode_bytes(decode_seq_lens, window=window, **kw)
+    out = {
         "mixed_step_bytes": int(mixed),
         "split_pair_bytes": int(split),
         "ratio": round(mixed / split, 4) if split else 0.0,
         "rows": len(rows),
         "quantized": bool(quantized),
     }
+    if window is not None:
+        out["window"] = int(window)
+    return out

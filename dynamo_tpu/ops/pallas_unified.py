@@ -8,6 +8,24 @@ kernel (ops/pallas_prefill.py flash extend) with a decode-only kernel
 pairs: query tokens pack densely into one ragged buffer, each row's segment
 sits at the TAIL of its own paged context, and causal masking is per row.
 
+Beyond the base pair, rows may carry OPTIONAL per-row attributes — the
+additions that let the gated model families ride the same launch:
+
+- ``windows`` [R] int32: per-row sliding-window bound (``<= 0`` = full
+  attention). Key ``j`` is visible to query ``i`` iff ``i - w < j <= i``,
+  and the page-chunk loop STARTS at the first chunk the row's earliest
+  query can see — a 128-token window over a 128k context streams ~window
+  keys, not the whole cache (the gpt-oss/gemma sliding layers);
+- ``sinks`` [h] f32: per-head attention-sink logits (gpt-oss), folded into
+  the softmax denominator by seeding each tile's online-softmax state with
+  the sink as a virtual zero-value key (``m0 = sink, l0 = 1, acc0 = 0``) —
+  algebraically identical to ops/attention._sink_softmax;
+- ``softcap`` (static float): gemma-2 logit softcapping,
+  ``cap * tanh(s / cap)`` applied post-scale, pre-mask.
+
+A speculative-decode verify pass is just a row with ``query_len = k + 1``
+(candidate tokens at the context tail) — no special case in the kernel.
+
 Versus the two split kernels this also removes two whole classes of HBM
 traffic:
 
@@ -22,7 +40,7 @@ traffic:
   many query tokens ride on them.
 
 ``ops/costs.py`` turns both layouts into byte counts; the tier-1 gate pins
-mixed <= split.
+mixed <= split (including the windowed and spec-verify row shapes).
 
 Layout/machinery shared with the PR 2 kernels: paged cache
 ``[num_blocks, block_size, kv_heads, head_dim]``; int8 caches
@@ -47,7 +65,8 @@ prefill chunk amortizes the same page stream over all its tiles.
 NOTE (hardware): the dynamic scratch slices step in ``q_seg * g`` sublanes
 and the per-head page DMA strides over kv heads; both run interpret-clean
 and need the first real-TPU run to confirm Mosaic lowering (same protocol
-as the PR 2 scale-row caveat — fallback: use_pallas=False).
+as the PR 2 scale-row caveat — fallback: use_pallas=False). The windowed
+variant additionally starts its chunk loop at a traced lower bound.
 """
 
 from __future__ import annotations
@@ -72,35 +91,51 @@ Q_SEG = 8
 
 
 def _unified_kernel(
-    # scalar prefetch (SMEM)
-    starts_ref,   # [R] int32 packed-q segment starts
-    qlens_ref,    # [R] int32 segment lengths (0 = empty row)
-    lens_ref,     # [R] int32 context lengths (incl. the segment)
-    tables_ref,   # [R * max_blocks] int32 flattened block tables
-    # inputs
-    q_ref,        # VMEM [1, Tq, g, d] this kv head's packed queries
-    k_hbm,        # ANY/HBM [num_blocks, bs, kvh, d] (model dtype or int8)
-    v_hbm,
-    # quantized=True only: ks_hbm/vs_hbm ANY/HBM [num_blocks, kvh] f32
-    # outputs
-    # o_ref       VMEM [1, Tq, g, d]
-    # scratch
-    # k_buf/v_buf VMEM [2, CP, bs, d] double-buffered page slices (this head)
-    # quantized=True only: ks_buf/vs_buf VMEM [2, CP, kvh] f32 scale rows
-    # m/l/acc     VMEM [Tq_pad*g, 1/1/d] f32 online-softmax state per q tile
-    # sem         DMA sems [2, 2, CP]; quantized: ssem [2, 2, CP]
-    *rest,
+    *args,
     max_blocks: int,
     chunk_pages: int,
     q_seg: int,
     quantized: bool,
+    has_window: bool,
+    has_sinks: bool,
+    softcap,
 ):
+    # args layout (optional pieces gated by the static flags):
+    #   scalar prefetch (SMEM): starts [R], qlens [R], lens [R],
+    #     [windows [R]], tables [R * max_blocks]
+    #   inputs: q VMEM [1, Tq, g, d], [sinks VMEM [1, g]],
+    #     k/v ANY/HBM [num_blocks, bs, kvh, d],
+    #     [k/v scales ANY/HBM [num_blocks, kvh] f32]
+    #   outputs: o VMEM [1, Tq, g, d]
+    #   scratch: k/v_buf VMEM [2, CP, bs, d], [k/v scale bufs [2, CP, kvh]],
+    #     m/l/acc VMEM [Tq_pad*g, 1/1/d] f32, DMA sems [2, 2, CP] (+quant)
+    it = iter(args)
+    starts_ref = next(it)
+    qlens_ref = next(it)
+    lens_ref = next(it)
+    windows_ref = next(it) if has_window else None
+    tables_ref = next(it)
+    q_ref = next(it)
+    sinks_ref = next(it) if has_sinks else None
+    k_hbm = next(it)
+    v_hbm = next(it)
+    ks_hbm = vs_hbm = None
     if quantized:
-        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
-         m_scr, l_scr, acc_scr, sem, ssem) = rest
-    else:
-        o_ref, k_buf, v_buf, m_scr, l_scr, acc_scr, sem = rest
-        ks_hbm = vs_hbm = ks_buf = vs_buf = ssem = None
+        ks_hbm = next(it)
+        vs_hbm = next(it)
+    o_ref = next(it)
+    k_buf = next(it)
+    v_buf = next(it)
+    ks_buf = vs_buf = None
+    if quantized:
+        ks_buf = next(it)
+        vs_buf = next(it)
+    m_scr = next(it)
+    l_scr = next(it)
+    acc_scr = next(it)
+    sem = next(it)
+    ssem = next(it) if quantized else None
+
     kh = pl.program_id(0)
     r = pl.program_id(1)
     bs, kvh, d = k_hbm.shape[1], k_hbm.shape[2], k_hbm.shape[3]
@@ -112,6 +147,7 @@ def _unified_kernel(
     q_start = starts_ref[r]
     q_len = qlens_ref[r]
     seq_len = lens_ref[r]
+    w = windows_ref[r] if has_window else None
 
     @pl.when(r == 0)
     def _zero_out():
@@ -125,6 +161,19 @@ def _unified_kernel(
     active = jnp.logical_and(q_len > 0, seq_len > 0)
     chunks = jnp.where(active, num_chunks, 0)
     ctx_start = seq_len - q_len  # absolute position of the segment's row 0
+    if has_window:
+        # a windowed row's earliest query (position ctx_start) sees no key
+        # below ctx_start - w + 1: pages a sliding window already aged out
+        # are never DMA'd (page-granular, like the split decode path's
+        # trailing-window gather), and the chunk loop starts at the first
+        # chunk holding a live page
+        lo_page = jnp.where(
+            w > 0, jnp.maximum(ctx_start - w + 1, 0) // bs, 0
+        )
+        c_lo = lo_page // CP
+    else:
+        lo_page = 0
+        c_lo = 0
 
     def page_dma(kind, c, j, slot):
         """DMA this kv head's slice of page j of chunk c: [bs, d]."""
@@ -145,9 +194,16 @@ def _unified_kernel(
             src.at[idx], dst.at[slot, j], ssem.at[kind, slot, j]
         )
 
+    def page_live(c, j):
+        """Page j of chunk c holds keys some query of this row can see."""
+        live = c * CP + j < num_pages
+        if has_window:
+            live = jnp.logical_and(live, c * CP + j >= lo_page)
+        return live
+
     def start_chunk(c, slot):
-        for j in range(CP):  # static unroll; guard ragged tail
-            @pl.when(c * CP + j < num_pages)
+        for j in range(CP):  # static unroll; guard ragged tail + window
+            @pl.when(page_live(c, j))
             def _():
                 page_dma(0, c, j, slot).start()
                 page_dma(1, c, j, slot).start()
@@ -157,7 +213,7 @@ def _unified_kernel(
 
     def wait_chunk(c, slot):
         for j in range(CP):
-            @pl.when(c * CP + j < num_pages)
+            @pl.when(page_live(c, j))
             def _():
                 page_dma(0, c, j, slot).wait()
                 page_dma(1, c, j, slot).wait()
@@ -166,14 +222,24 @@ def _unified_kernel(
                     scale_dma(1, c, j, slot).wait()
 
     # per-row online-softmax state: one (m, l, acc) strip per q tile,
-    # reset every row (only the first nq tiles are ever touched)
-    m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-    l_scr[...] = jnp.zeros_like(l_scr)
+    # reset every row (only the first nq tiles are ever touched). With
+    # sinks, the state is seeded as if one virtual zero-value key with
+    # logit sinks[h] had already been folded in (m0 = sink, l0 = 1) —
+    # exactly _sink_softmax's denominator term.
+    if has_sinks:
+        srow = sinks_ref[0].astype(jnp.float32)              # [g], this head
+        m_scr[...] = jnp.broadcast_to(
+            srow[None, :], (Tq, g)
+        ).reshape(Tq * g, 1)
+        l_scr[...] = jnp.ones_like(l_scr)
+    else:
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
     acc_scr[...] = jnp.zeros_like(acc_scr)
 
     @pl.when(active)
     def _prime():
-        start_chunk(0, 0)
+        start_chunk(c_lo, jax.lax.rem(c_lo, 2) if has_window else 0)
 
     scale = 1.0 / (d ** 0.5)
 
@@ -209,9 +275,13 @@ def _unified_kernel(
         k = k.reshape(T, d)
         v = v.reshape(T, d)
         # rows past seq_len were never DMA'd (garbage / NaN): scores are
-        # masked below, but V must be zeroed too — 0-weight * NaN = NaN
+        # masked below, but V must be zeroed too — 0-weight * NaN = NaN.
+        # Same for pages a row's sliding window skipped at the head.
         row_pos = c * T + jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
-        v = jnp.where(row_pos < seq_len, v, 0.0)
+        v_live = row_pos < seq_len
+        if has_window:
+            v_live = jnp.logical_and(v_live, row_pos >= lo_page * bs)
+        v = jnp.where(v_live, v, 0.0)
         key_pos = c * T + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
 
         def tile_body(qt, carry2):
@@ -226,8 +296,18 @@ def _unified_kernel(
             # causal tile-skip: this chunk's keys start at c*T; the tile's
             # highest attention limit is its last member row's
             hi = jnp.minimum(ctx_start + (seg - q_start) + q_seg, seq_len)
+            do_tile = c * T < hi
+            if has_window:
+                # window tile-skip: the tile's EARLIEST member query sits
+                # at q_pos_min; a chunk whose last key is below its window
+                # contributes nothing to any row of the tile
+                q_pos_min = ctx_start + jnp.maximum(seg - q_start, 0)
+                do_tile = jnp.logical_and(
+                    do_tile,
+                    jnp.where(w > 0, (c + 1) * T > q_pos_min - w + 1, True),
+                )
 
-            @pl.when(c * T < hi)
+            @pl.when(do_tile)
             def _():
                 qf = (
                     q_ref[0, pl.ds(seg, q_seg)].astype(jnp.float32) * scale
@@ -237,14 +317,31 @@ def _unified_kernel(
                     dimension_numbers=(((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )                                                  # [QG, T]
-                s = jnp.where(key_pos < lim, s, NEG_INF)
+                if softcap is not None:
+                    s = jnp.tanh(s / softcap) * softcap
+                valid = key_pos < lim
+                if has_window:
+                    lo = jnp.where(
+                        jnp.logical_and(member, w > 0), q_pos - w + 1, 0
+                    )
+                    valid = jnp.logical_and(valid, key_pos >= lo)
+                s = jnp.where(valid, s, NEG_INF)
                 sl = pl.ds(qt * QG, QG)
                 m_prev = m_scr[sl]
                 l_prev = l_scr[sl]
                 acc_prev = acc_scr[sl]
                 m_cur = jnp.max(s, axis=-1, keepdims=True)
                 m_new = jnp.maximum(m_prev, m_cur)
-                p = jnp.exp(s - m_new)
+                if has_window:
+                    # a windowed row's FIRST visible chunk can still hand a
+                    # tile an all-masked score row (the row's own window
+                    # starts mid-chunk): exp(NEG_INF - NEG_INF) would be 1,
+                    # so masked lanes are zeroed explicitly
+                    p = jnp.where(
+                        s > NEG_INF * 0.5, jnp.exp(s - m_new), 0.0
+                    )
+                else:
+                    p = jnp.exp(s - m_new)
                 alpha = jnp.exp(m_prev - m_new)
                 m_scr[sl] = m_new
                 l_scr[sl] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
@@ -258,7 +355,7 @@ def _unified_kernel(
         jax.lax.fori_loop(0, nq, tile_body, 0)
         return carry
 
-    jax.lax.fori_loop(0, chunks, chunk_body, 0)
+    jax.lax.fori_loop(c_lo, chunks, chunk_body, 0)
 
     def emit_tile(qt, carry):
         seg = tile_start(qt)
@@ -282,7 +379,8 @@ def _unified_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("q_seg", "chunk_tokens", "interpret")
+    jax.jit,
+    static_argnames=("q_seg", "chunk_tokens", "interpret", "softcap"),
 )
 def ragged_paged_attention(
     q: jax.Array,             # [Tq, h, d] densely packed ragged queries
@@ -293,6 +391,9 @@ def ragged_paged_attention(
     q_lens: jax.Array,        # [R] int32 (0 = empty row)
     seq_lens: jax.Array,      # [R] int32
     *,
+    windows: jax.Array = None,   # [R] int32 per-row window (<=0 = full)
+    sinks: jax.Array = None,     # [h] f32 per-head sink logits
+    softcap: float = None,       # static logit softcap (gemma-2)
     q_seg: int = Q_SEG,
     chunk_tokens: int = 128,
     interpret: bool = False,
@@ -301,15 +402,21 @@ def ragged_paged_attention(
     ``ops.attention.ragged_paged_attention`` (the pure-JAX reference twin):
     row r's segment ``q[q_starts[r] : q_starts[r]+q_lens[r]]`` attends
     causally over that row's pages with the segment at the context tail;
-    tokens outside every segment return zeros. ``k_cache``/``v_cache`` may
-    be ``QuantizedKV`` — int8 pages + per-block scale rows DMA together and
-    dequantize in-register, halving per-page HBM bytes vs bf16."""
+    tokens outside every segment return zeros. Optional per-row
+    ``windows`` (sliding-window bounds), per-head ``sinks`` logits, and a
+    static ``softcap`` extend the same launch to the gpt-oss/gemma
+    families and spec-verify rows (``q_len = k+1``). ``k_cache``/
+    ``v_cache`` may be ``QuantizedKV`` — int8 pages + per-block scale rows
+    DMA together and dequantize in-register, halving per-page HBM bytes
+    vs bf16."""
     Tq, h, d = q.shape
     _, bs, kvh, _ = k_cache.shape
     R, max_blocks = block_tables.shape
     g = h // kvh
     chunk_pages = max(1, chunk_tokens // bs)
     quantized = is_quantized(k_cache)
+    has_window = windows is not None
+    has_sinks = sinks is not None
 
     # pad the packed buffer so every clamped q tile is in bounds
     Tq_pad = max(q_seg, -(-Tq // q_seg) * q_seg)
@@ -318,7 +425,8 @@ def ragged_paged_attention(
 
     kernel = functools.partial(
         _unified_kernel, max_blocks=max_blocks, chunk_pages=chunk_pages,
-        q_seg=q_seg, quantized=quantized,
+        q_seg=q_seg, quantized=quantized, has_window=has_window,
+        has_sinks=has_sinks, softcap=softcap,
     )
     cache_specs = [
         pl.BlockSpec(memory_space=pl.ANY),
@@ -349,12 +457,20 @@ def ragged_paged_attention(
     # [Tq, h, d] -> [kvh, Tq, g, d]: each kv head's q group contiguous; the
     # kv head is the OUTER grid dim so the block stays resident across rows
     qg = q.reshape(Tq_pad, kvh, g, d).transpose(1, 0, 2, 3)
+    in_specs = [
+        pl.BlockSpec((1, Tq_pad, g, d), lambda kh, r, *_: (kh, 0, 0, 0))
+    ]
+    if has_sinks:
+        # this head's [g] sink logits ride a tiny VMEM block; the head
+        # grouping matches the q reshape (head = kh * g + gi)
+        in_specs.append(
+            pl.BlockSpec((1, g), lambda kh, r, *_: (kh, 0))
+        )
+    in_specs += cache_specs
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=4 + (1 if has_window else 0),
         grid=(kvh, R),
-        in_specs=[
-            pl.BlockSpec((1, Tq_pad, g, d), lambda kh, r, *_: (kh, 0, 0, 0))
-        ] + cache_specs,
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, Tq_pad, g, d), lambda kh, r, *_: (kh, 0, 0, 0)
         ),
@@ -364,19 +480,23 @@ def ragged_paged_attention(
         (k_cache.data, v_cache.data, k_cache.scale, v_cache.scale)
         if quantized else (k_cache, v_cache)
     )
+    prefetch = [
+        q_starts.astype(jnp.int32),
+        q_lens.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+    ]
+    if has_window:
+        prefetch.append(windows.astype(jnp.int32))
+    prefetch.append(block_tables.reshape(-1).astype(jnp.int32))
+    inputs = [qg]
+    if has_sinks:
+        inputs.append(sinks.astype(jnp.float32).reshape(kvh, g))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((kvh, Tq_pad, g, d), q.dtype),
         interpret=interpret,
-    )(
-        q_starts.astype(jnp.int32),
-        q_lens.astype(jnp.int32),
-        seq_lens.astype(jnp.int32),
-        block_tables.reshape(-1).astype(jnp.int32),
-        qg,
-        *cache_args,
-    )
+    )(*prefetch, *inputs, *cache_args)
     # [kvh, Tq_pad, g, d] -> [Tq, h, d]
     return out.transpose(1, 0, 2, 3).reshape(Tq_pad, h, d)[:Tq]
 
@@ -391,35 +511,58 @@ def sharded_ragged_paged_attention(
     q_starts: jax.Array,
     q_lens: jax.Array,
     seq_lens: jax.Array,
+    *,
+    windows: jax.Array = None,
+    sinks: jax.Array = None,
     **kw,
 ) -> jax.Array:
     """TP-sharded wrapper: attention is head-wise independent, so each TP
-    shard runs the kernel on its own heads (q sharded on h, caches on kvh).
-    shard_map because GSPMD cannot partition a custom call — the same
-    treatment as the split kernels' sharded wrappers."""
+    shard runs the kernel on its own heads (q sharded on h, caches on kvh,
+    sink logits on their head dim; per-row windows replicate). shard_map
+    because GSPMD cannot partition a custom call — the same treatment as
+    the split kernels' sharded wrappers."""
     if mesh.shape[tp_axis] == 1:
         return ragged_paged_attention(
             q, k_cache, v_cache, block_tables, q_starts, q_lens, seq_lens,
-            **kw,
+            windows=windows, sinks=sinks, **kw,
         )
     cache_spec = P(None, None, tp_axis, None)
     if is_quantized(k_cache):
         # spec tree mirrors the QuantizedKV pytree (payload on kv_heads,
         # scale rows on their kv-head dim) — same as the decode kernel
         cache_spec = QuantizedKV(cache_spec, P(None, tp_axis))
+    args = [q, k_cache, v_cache, block_tables, q_starts, q_lens, seq_lens]
+    specs = [
+        P(None, tp_axis, None),
+        cache_spec,
+        cache_spec,
+        P(None, None),
+        P(None),
+        P(None),
+        P(None),
+    ]
+    has_window = windows is not None
+    has_sinks = sinks is not None
+    if has_window:
+        args.append(windows)
+        specs.append(P(None))
+    if has_sinks:
+        args.append(sinks)
+        specs.append(P(tp_axis))
+
+    def run(q, kc, vc, tables, qs, ql, sl, *rest):
+        rest = list(rest)
+        win = rest.pop(0) if has_window else None
+        snk = rest.pop(0) if has_sinks else None
+        return ragged_paged_attention(
+            q, kc, vc, tables, qs, ql, sl, windows=win, sinks=snk, **kw
+        )
+
     fn = shard_map(
-        functools.partial(ragged_paged_attention, **kw),
+        run,
         mesh=mesh,
-        in_specs=(
-            P(None, tp_axis, None),
-            cache_spec,
-            cache_spec,
-            P(None, None),
-            P(None),
-            P(None),
-            P(None),
-        ),
+        in_specs=tuple(specs),
         out_specs=P(None, tp_axis, None),
         check_vma=False,
     )
-    return fn(q, k_cache, v_cache, block_tables, q_starts, q_lens, seq_lens)
+    return fn(*args)

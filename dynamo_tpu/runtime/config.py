@@ -47,6 +47,7 @@ ENV_TRACE_JSONL = "DTPU_TRACE_JSONL"                  # span JSONL file
 ENV_FLIGHT_CAPACITY = "DTPU_FLIGHT_CAPACITY"          # retained request timelines
 ENV_FLIGHT_DUMP = "DTPU_FLIGHT_DUMP"                  # JSONL path for failure dumps
 ENV_SLOW_STEP_MS = "DTPU_SLOW_STEP_MS"                # slow-step log threshold
+ENV_ASYNC_PREP = "DTPU_ASYNC_PREP"                    # async host step-prep on/off
 # SLO accounting (runtime/slo.py)
 ENV_SLA_CLASSES = "DTPU_SLA_CLASSES"                  # "interactive:ttft=0.5,itl=0.05;batch:ttft=30"
 ENV_SLA_DEFAULT = "DTPU_SLA_DEFAULT"                  # class stamped when a request names none

@@ -635,3 +635,70 @@ def test_metric_cardinality_current_tree_clean():
         if f.rule == "METRIC-CARDINALITY"
     ]
     assert found == []
+
+
+# -- MIXED-GATE --------------------------------------------------------------
+
+def test_mixed_gate_flags_terms_at_site(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/engine.py",
+        "class E:\n"
+        "    def __init__(self, config):\n"
+        "        self.mixed_enabled = bool(\n"
+        "            mixed\n"
+        "            and config.pp == 1\n"
+        "            and config.new_family is None\n"
+        "        )\n",
+        rule="MIXED-GATE",
+    )
+    # one finding per and-term: a NEW exclusion term surfaces as a new,
+    # non-baselined finding
+    assert len(found) == 3
+    assert any("config.new_family is None" in f.message for f in found)
+    assert all("baseline entry" in f.message for f in found)
+
+
+def test_mixed_gate_flags_assignment_outside_site(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/dp.py",
+        "class D:\n"
+        "    def setup(self):\n"
+        "        self.mixed_enabled = False\n",
+        rule="MIXED-GATE",
+    )
+    assert len(found) == 1
+    assert "outside the documented gate site" in found[0].message
+
+
+def test_mixed_gate_ignores_reads_and_tests(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/loop.py",
+        "def f(self):\n"
+        "    if self.mixed_enabled:\n"
+        "        return 1\n",
+        rule="MIXED-GATE",
+    )
+    assert found == []
+
+
+def test_mixed_gate_current_tree_exactly_baselined():
+    """The live gate carries exactly the documented pp/sp/vision/multihost
+    exclusions (plus the two intent terms), all baselined — the gate can
+    only shrink without touching the baseline."""
+    modules, parse = core.load_modules([os.path.join(REPO, "dynamo_tpu")])
+    found = [
+        f for f in core.collect_findings(modules, parse)
+        if f.rule == "MIXED-GATE"
+    ]
+    assert len(found) == 6
+    assert all(f.path == "dynamo_tpu/engine/engine.py" for f in found)
+    msgs = "\n".join(f.message for f in found)
+    for term in ("config.pp == 1", "config.sp == 1",
+                 "config.vision is None", "multihost is None"):
+        assert term in msgs
+    # the retired family exclusions stay retired
+    for gone in ("spec_draft", "lora_max_adapters", "is_gptoss", "is_gemma"):
+        assert gone not in msgs
+    baseline = core.load_baseline(core.DEFAULT_BASELINE)
+    for f in found:
+        assert f.baseline_key() in baseline

@@ -6,11 +6,19 @@ prefill.
 Engines here OPT IN via mixed_admission=True (tests/conftest.py pins
 DTPU_MIXED=0 suite-wide so the other ~40 engine-building files do not each
 pay the fused program's XLA compile). The core greedy/sampled/logprobs
-equivalence runs in tier-1; the int8 and in-engine-Pallas variants are
-``slow`` per the existing convention (they each build two more engines).
+equivalence runs in tier-1; the int8, in-engine-Pallas and gated-family
+variants (gpt-oss / gemma / LoRA — mixed-eligible since the per-row
+kernel attributes landed) are ``slow`` per the existing convention (they
+each build two more engines).
+
+The tier-1 pair also proves the ASYNC STEP-PREP pipeline byte-identical:
+the mixed engine runs with DTPU_ASYNC_PREP on (default — chunk packing for
+step N+1 prebuilt under step N's device compute) while the split reference
+engine packs serially, and the streams still match exactly.
 """
 
 import asyncio
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -34,12 +42,22 @@ P_RESIDENT = [(i * 37 + 11) % 500 for i in range(30)]
 P_ARRIVER = [(i * 53 + 7) % 500 for i in range(90)]  # 3 chunks of 32
 
 
-def make_engine(mixed, **kw):
+def make_engine(mixed, model=MODEL, serial_prep=False, **kw):
     cfg = TpuEngineConfig(
-        model=MODEL, num_blocks=256, block_size=4, max_batch_size=4,
+        model=model, num_blocks=256, block_size=4, max_batch_size=4,
         max_context=512, prefill_buckets=(16, 32), decode_steps=4,
         decode_pipeline=2, mixed_admission=mixed, **kw,
     )
+    if serial_prep:
+        prev = os.environ.get("DTPU_ASYNC_PREP")
+        os.environ["DTPU_ASYNC_PREP"] = "0"
+        try:
+            return TpuEngine(cfg)
+        finally:
+            if prev is None:
+                os.environ.pop("DTPU_ASYNC_PREP", None)
+            else:
+                os.environ["DTPU_ASYNC_PREP"] = prev
     return TpuEngine(cfg)
 
 
@@ -95,8 +113,16 @@ async def _mixed_vs_split(mk_mixed, mk_split):
     # a fused step's token count spans the chunk AND the decode rows it
     # carried; occupancy reflects the resident batch
     assert any(s.tokens > 1 for s in phases["mixed"])
+    # async step-prep fired: at least one chunk-carrying step consumed a
+    # prebuilt pack (the first chunk of each prompt is always a serial
+    # miss — there was no prior step to prep under)
+    chunk_steps = phases.get("mixed", []) + phases.get("prefill", [])
+    assert any(s.prep_hit for s in chunk_steps), (
+        "no step consumed an async-prepped chunk"
+    )
 
     e_split = mk_split()
+    assert e_split._prep is None, "split reference engine must pack serially"
     sphases: dict = {}
     e_split.stats_hook = lambda s: sphases.setdefault(s.phase, []).append(s)
     try:
@@ -129,10 +155,14 @@ async def _mixed_vs_split(mk_mixed, mk_split):
 
 def test_mixed_equals_split_e2e():
     """Greedy + logprobs + seeded-sampling streams from the mixed engine
-    match the split engine byte-for-byte (tokens) while the mixed phase
-    actually fires. Sync wrapper with its own budget: two engine builds."""
+    (async step-prep ON) match the serial-prep split engine byte-for-byte
+    (tokens) while the mixed phase actually fires and consumes prebuilt
+    chunks. Sync wrapper with its own budget: two engine builds."""
     asyncio.run(asyncio.wait_for(
-        _mixed_vs_split(lambda: make_engine(True), lambda: make_engine(False)),
+        _mixed_vs_split(
+            lambda: make_engine(True),
+            lambda: make_engine(False, serial_prep=True),
+        ),
         timeout=420,
     ))
 
@@ -163,7 +193,7 @@ def test_mixed_equals_split_int8():
     asyncio.run(asyncio.wait_for(
         _mixed_vs_split(
             lambda: make_engine(True, kv_dtype="int8"),
-            lambda: make_engine(False, kv_dtype="int8"),
+            lambda: make_engine(False, kv_dtype="int8", serial_prep=True),
         ),
         timeout=420,
     ))
@@ -177,7 +207,138 @@ def test_mixed_pallas_kernel_in_engine():
     asyncio.run(asyncio.wait_for(
         _mixed_vs_split(
             lambda: make_engine(True, use_pallas=True),
-            lambda: make_engine(False, use_pallas=False),
+            lambda: make_engine(False, use_pallas=False, serial_prep=True),
         ),
         timeout=600,
     ))
+
+
+# ------------------------------------------- gated families (now eligible)
+async def _family_mixed_vs_split(model, **kw):
+    """Minimal mixed-vs-split token identity for a family engine pair
+    (no logprob leg — family engines are compile-heavy enough)."""
+    e_mixed = make_engine(True, model=model, **kw)
+    phases: dict = {}
+    e_mixed.stats_hook = lambda s: phases.setdefault(s.phase, []).append(s)
+    try:
+        m = await overlap_scenario(
+            e_mixed, preq("r1", P_RESIDENT, 16), preq("r2", P_ARRIVER, 6),
+        )
+    finally:
+        e_mixed.stop()
+    assert "mixed" in phases, f"mixed never fired (phases: {set(phases)})"
+    e_split = make_engine(False, model=model, serial_prep=True, **kw)
+    sphases: dict = {}
+    e_split.stats_hook = lambda s: sphases.setdefault(s.phase, []).append(s)
+    try:
+        s = await overlap_scenario(
+            e_split, preq("r1", P_RESIDENT, 16), preq("r2", P_ARRIVER, 6),
+        )
+    finally:
+        e_split.stop()
+    assert "mixed" not in sphases
+    assert m[0][0] == s[0][0]
+    assert m[1][0] == s[1][0]
+
+
+@pytest.mark.slow
+def test_mixed_equals_split_gptoss():
+    """gpt-oss (sliding window + per-head sinks, MoE) rides the mixed
+    step: window/sink extras thread into the unified launch as per-row
+    attributes; outputs byte-identical to the split dispatches."""
+    from dynamo_tpu.models.gptoss import GptOssConfig
+
+    asyncio.run(asyncio.wait_for(
+        _family_mixed_vs_split(GptOssConfig.tiny_gptoss(vocab_size=512)),
+        timeout=600,
+    ))
+
+
+@pytest.mark.slow
+def test_mixed_equals_split_gemma():
+    """gemma-2 (interleaved sliding layers + attn-logit softcap) rides the
+    mixed step; outputs byte-identical to the split dispatches."""
+    from dynamo_tpu.models.gemma import GemmaConfig
+
+    asyncio.run(asyncio.wait_for(
+        _family_mixed_vs_split(GemmaConfig.tiny_gemma2(vocab_size=512)),
+        timeout=600,
+    ))
+
+
+@pytest.mark.slow
+def test_mixed_equals_split_gptoss_pallas():
+    """gpt-oss with the Pallas kernels FORCED (interpreted on CPU): the
+    windowed/sink layers route through the unified kernel — both the
+    fused mixed step and the split decode dispatch (which serves windowed
+    layers as q_len=1 unified rows) — and the greedy stream still equals
+    the pure-JAX split engine's."""
+    from dynamo_tpu.models.gptoss import GptOssConfig
+
+    asyncio.run(asyncio.wait_for(
+        _family_mixed_vs_split(
+            GptOssConfig.tiny_gptoss(vocab_size=512), use_pallas=True,
+        ),
+        timeout=600,
+    ))
+
+
+@pytest.mark.slow
+def test_mixed_equals_split_lora():
+    """Batched LoRA rides the mixed step: per-row adapter indices thread
+    through the packed buffer, and streams (base + two adapters, one
+    arriving mid-decode) are byte-identical mixed vs split."""
+    import numpy as _np
+
+    def _adapter(seed):
+        rng = _np.random.default_rng(seed)
+        L, H = MODEL.num_layers, MODEL.hidden_size
+        w = {}
+        for t, out in (("wq", MODEL.q_size), ("wk", MODEL.kv_size),
+                       ("wv", MODEL.kv_size), ("wo", MODEL.hidden_size)):
+            inp = MODEL.q_size if t == "wo" else H
+            w[f"{t}.A"] = rng.standard_normal((L, inp, 4)).astype(
+                _np.float32)
+            w[f"{t}.B"] = rng.standard_normal((L, 4, out)).astype(
+                _np.float32)
+        return w
+
+    def lreq(rid, tokens, n, lora=None):
+        return PreprocessedRequest(
+            request_id=rid, model="m", token_ids=tokens,
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+            sampling=SamplingOptions(temperature=0.0),
+            annotations={"lora": lora} if lora else {},
+        )
+
+    async def run(mixed):
+        eng = make_engine(
+            mixed, lora_max_adapters=2, lora_rank=4,
+            serial_prep=not mixed,
+        )
+        eng.lora.load("a", _adapter(5), alpha=8.0)
+        eng.lora.load("b", _adapter(9), alpha=8.0)
+        phases: dict = {}
+        eng.stats_hook = lambda s: phases.setdefault(s.phase, []).append(s)
+        try:
+            first = asyncio.Event()
+            t1 = asyncio.create_task(
+                run_one(eng, lreq("r1", P_RESIDENT, 16, lora="a"), first)
+            )
+            await asyncio.wait_for(first.wait(), 120)
+            t2 = asyncio.create_task(
+                run_one(eng, lreq("r2", P_ARRIVER, 6, lora="b"))
+            )
+            t3 = asyncio.create_task(run_one(eng, lreq("r3", P_RESIDENT, 8)))
+            out = await asyncio.gather(t1, t2, t3)
+        finally:
+            eng.stop()
+        return [o[0] for o in out], phases
+
+    async def both():
+        m, phases_m = await run(True)
+        s, phases_s = await run(False)
+        assert "mixed" in phases_m and "mixed" not in phases_s
+        assert m == s
+
+    asyncio.run(asyncio.wait_for(both(), timeout=600))

@@ -4,7 +4,8 @@ The reference exposes draft-model speculation through its vLLM adapter
 (docs/features/speculative_decoding); this engine owns it: a draft model
 with a shadow paged cache addressed by the same block tables drafts
 spec_k greedy tokens per round, one main-model forward over the candidate
-positions verifies them (ops/attention.paged_extend_attention), and the
+positions verifies them — query_len = k+1 rows of the unified ragged
+kernel (ops/pallas_unified; the pure-JAX twin off-Pallas) — and the
 advance is the accepted prefix plus a bonus token, capped at spec_k.
 
 The invariant under test everywhere: spec output is TOKEN-IDENTICAL to
@@ -29,11 +30,14 @@ from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.parallel.mesh import make_mesh
 from dynamo_tpu.runtime import Context
 
-# every test here builds 2+ engines (main + draft programs compile
+# most tests here build 2+ engines (main + draft programs compile
 # separately) — with the persistent XLA cache disabled on this image that is
-# minutes of compile per test, which times out under parallel runs; tier-1
-# skips the file (-m 'not slow'), run it serially with -m slow
-pytestmark = pytest.mark.slow
+# minutes of compile per test, which times out under parallel runs; those
+# carry @pytest.mark.slow individually (run serially with -m slow). The
+# one tier-1 exception is test_spec_e2e_tier1 below: now that the verify
+# pass rides the unified ragged kernel, a minimal greedy e2e keeps spec
+# coverage in every tier-1 run instead of exclusively behind the slow mark.
+slow = pytest.mark.slow
 
 MODEL = LlamaConfig(
     vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
@@ -90,6 +94,7 @@ async def _greedy_reference():
         e.stop()
 
 
+@slow
 async def test_spec_equals_plain_greedy():
     """Concurrent greedy requests through a spec engine with an unrelated
     random draft produce exactly the plain engine's tokens."""
@@ -105,6 +110,7 @@ async def test_spec_equals_plain_greedy():
     assert e.spec_stats["rounds"] > 0  # the spec path actually dispatched
 
 
+@slow
 async def test_perfect_draft_accepts_everything():
     """draft == main (same config, same weights): every draft matches, so
     every round advances the full spec_k — and the output is still exactly
@@ -126,6 +132,7 @@ async def test_perfect_draft_accepts_everything():
     assert stats["emitted"] / (stats["rounds"] * stats["k"]) == 1.0
 
 
+@slow
 async def test_spec_with_prefix_cache_reuse():
     """A repeated prompt cache-hits its prefix blocks; the draft re-prefills
     the cached region from token ids (draft_prefill_pos is independent of
@@ -141,6 +148,7 @@ async def test_spec_with_prefix_cache_reuse():
     assert again == ref[1]
 
 
+@slow
 async def test_spec_chunked_prefill():
     """A prompt longer than every bucket forces chunked prefill; the draft
     shadow cache follows chunk by chunk."""
@@ -158,6 +166,7 @@ async def test_spec_chunked_prefill():
     assert got == ref
 
 
+@slow
 async def test_mixed_batch_falls_back_to_normal_horizons():
     """A sampled request in the batch makes every dispatch ineligible for
     spec; the greedy batchmate still gets exactly the reference tokens
@@ -175,8 +184,8 @@ async def test_mixed_batch_falls_back_to_normal_horizons():
 
 
 async def _spec_matches_family_main(main_cfg):
-    """The verify pass (paged_extend_attention) covers every cache layout
-    the families use — MLA's latent-MQA cache and gemma's windowed,
+    """The unified-kernel verify rows cover every cache layout the
+    families use — MLA's latent-MQA cache and gemma's windowed,
     softcap-free layers included. Greedy equality pins it per family; the
     draft stays a plain dense model (drafts are family-agnostic as long as
     the vocab matches)."""
@@ -215,18 +224,120 @@ async def _spec_matches_family_main(main_cfg):
 # parallel CI (-n 4) while passing serially. Each half owns its own budget.
 
 
+@slow
 async def test_spec_with_mla_main():
     from dynamo_tpu.models.mla import MlaConfig
 
     await _spec_matches_family_main(MlaConfig.tiny_mla(vocab_size=512))
 
 
+@slow
 async def test_spec_with_gemma_main():
     from dynamo_tpu.models.gemma import GemmaConfig
 
     await _spec_matches_family_main(GemmaConfig.tiny_gemma3(vocab_size=512))
 
 
+# tier-1 spec coverage: 1-layer main + 1-layer draft keep the compile
+# budget minimal (the rest of the file's 2-layer pairs stay slow-marked)
+TINY_MAIN = LlamaConfig(
+    vocab_size=256, hidden_size=32, num_layers=1, num_heads=2,
+    num_kv_heads=1, head_dim=16, intermediate_size=64, dtype=jnp.float32,
+)
+TINY_DRAFT = LlamaConfig(
+    vocab_size=256, hidden_size=16, num_layers=1, num_heads=1,
+    num_kv_heads=1, head_dim=16, intermediate_size=32, dtype=jnp.float32,
+)
+
+
+def _tiny_engine(spec=None, **kw):
+    cfg = TpuEngineConfig(
+        model=TINY_MAIN, spec_draft=spec, num_blocks=64, block_size=4,
+        max_batch_size=2, max_context=128, prefill_buckets=(16,),
+        decode_steps=4, decode_pipeline=1, spec_k=2, **kw,
+    )
+    return TpuEngine(cfg, mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+
+
+async def _tiny_spec_e2e(**spec_kw):
+    prompt = [(i * 37 + 11) % 200 for i in range(11)]
+    e_ref = _tiny_engine()
+    try:
+        ref = await collect(e_ref, preq("r", prompt, n=10))
+    finally:
+        e_ref.stop()
+    e = _tiny_engine(spec=TINY_DRAFT, **spec_kw)
+    try:
+        got = await collect(e, preq("s", prompt, n=10))
+    finally:
+        e.stop()
+    assert got == ref
+    assert e.spec_stats["rounds"] > 0  # the spec path actually dispatched
+
+
+def test_spec_e2e_tier1():
+    """Tier-1 spec e2e (greedy, tiny model): spec output token-identical
+    to plain greedy through the pure-JAX verify fallback. Sync wrapper
+    with its own budget (two minimal engine builds)."""
+    asyncio.run(asyncio.wait_for(_tiny_spec_e2e(), timeout=300))
+
+
+@slow
+def test_spec_pallas_unified_verify_equals_plain():
+    """With the Pallas kernels forced (interpreted on CPU), the verify
+    pass runs in-engine as query_len = k+1 rows of the unified ragged
+    kernel — and the greedy stream still equals the plain engine's."""
+    asyncio.run(asyncio.wait_for(
+        _tiny_spec_e2e(use_pallas=True), timeout=600,
+    ))
+
+
+@slow
+async def test_spec_mixed_batching_equals_split():
+    """Spec engines are mixed-eligible now: with a prefill overlapping a
+    resident decode, the fused mixed step serves both (draft prefill
+    catch-up included) and the token streams still equal the mixed-off
+    spec engine's."""
+
+    async def run(mixed):
+        cfg = TpuEngineConfig(
+            model=MODEL, spec_draft=DRAFT, num_blocks=256, block_size=4,
+            max_batch_size=4, max_context=512, prefill_buckets=(16, 32),
+            decode_steps=6, decode_pipeline=2, spec_k=3,
+            mixed_admission=mixed,
+        )
+        e = TpuEngine(cfg, mesh=make_mesh(tp=1, devices=jax.devices()[:1]))
+        phases: dict = {}
+        e.stats_hook = lambda s: phases.setdefault(s.phase, []).append(s)
+        try:
+            first = asyncio.Event()
+
+            async def one(rid, tokens, n, wait_first=False):
+                toks = []
+                async for out in e.generate(
+                    preq(rid, tokens, n=n), Context()
+                ):
+                    toks.extend(out.token_ids)
+                    if toks:
+                        first.set()
+                return toks
+
+            t1 = asyncio.create_task(one("a", PROMPTS[0], 24))
+            await asyncio.wait_for(first.wait(), 120)
+            arriver = [(i * 53 + 7) % 500 for i in range(90)]
+            t2 = asyncio.create_task(one("b", arriver, 8))
+            return await asyncio.gather(t1, t2), phases
+        finally:
+            e.stop()
+
+    got_m, phases_m = await run(True)
+    got_s, phases_s = await run(False)
+    assert "mixed" in phases_m, set(phases_m)
+    assert "mixed" not in phases_s
+    assert got_m == got_s
+
+
+@slow
 def test_spec_config_gates():
     with pytest.raises(ValueError, match="vocabulary"):
         bad = LlamaConfig(

@@ -89,6 +89,146 @@ def test_unified_matches_reference(name, quant):
     )
 
 
+# --------------------------------------------- per-row attributes (PR 14)
+# Windowed rows, sink rows, softcap rows, spec-verify rows (q_len = k+1)
+# and combinations — each against the pure-JAX twin, float and int8, mixed
+# with plain rows in ONE launch.
+ATTR_CASES = {
+    # per-row windows: a windowed chunk + windowed decode rows + a full-
+    # attention row (w=0) in one launch; small window over a longer context
+    # exercises the page-granular head skip
+    "windowed_rows": dict(
+        rows=[(12, 36), (1, 33), (0, 0), (1, 9)],
+        windows=[7, 16, 0, 0],
+    ),
+    # gpt-oss shape: sinks on every row, window on some (alternating-layer
+    # pattern collapses to per-launch extras; rows still differ in shape)
+    "sink_rows": dict(rows=[(8, 24), (1, 17), (1, 5)], sinks=True),
+    "softcap_rows": dict(
+        rows=[(8, 24), (1, 17), (1, 5)], softcap=30.0,
+    ),
+    "window_sink_softcap": dict(
+        rows=[(12, 20), (1, 33), (0, 0), (1, 9)],
+        windows=[6, 12, 0, 5], sinks=True, softcap=50.0,
+    ),
+    # spec-decode verify rows (q_len = k+1, candidates at the context
+    # tail) riding alongside a plain decode row and an idle slot
+    "verify_rows": dict(rows=[(4, 12), (4, 21), (0, 0), (1, 33)]),
+    # verify + windowed in one launch: the mixed-step shape for a gemma
+    # sliding layer while spec-verify rows are in flight
+    "verify_windowed": dict(
+        rows=[(4, 36), (4, 21), (1, 17)], windows=[9, 0, 11],
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ATTR_CASES))
+@pytest.mark.parametrize("quant", [False, True], ids=["float", "int8"])
+def test_unified_row_attributes_match_reference(name, quant):
+    """Interpret parity (<= 1e-5 abs err, the acceptance bound) for every
+    new per-row attribute against the pure-JAX twin."""
+    case = ATTR_CASES[name]
+    rng = np.random.default_rng(hash(name) % (2**32))
+    args = _make_case(
+        rng, case["rows"], h=8, kvh=4, d=32, bs=8, num_blocks=64,
+        max_blocks=8, quant=quant, gap_after=3,
+    )
+    kw = {}
+    if "windows" in case:
+        kw["windows"] = jnp.asarray(case["windows"], jnp.int32)
+    if case.get("sinks"):
+        kw["sinks"] = jnp.asarray(rng.standard_normal(8), jnp.float32)
+    if case.get("softcap"):
+        kw["softcap"] = case["softcap"]
+    ref = att.ragged_paged_attention(*args, **kw)
+    got = pu.ragged_paged_attention(
+        *args, **kw, q_seg=4, chunk_tokens=16, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-5, rtol=ATOL
+    )
+
+
+def test_unified_scalar_window_equals_per_row():
+    """The twin's scalar ``window`` (the engine's per-layer form) and the
+    per-row ``windows`` array agree when every row shares the bound."""
+    rng = np.random.default_rng(21)
+    args = _make_case(
+        rng, [(8, 24), (1, 17)], h=4, kvh=2, d=32, bs=8, num_blocks=32,
+        max_blocks=4,
+    )
+    a = att.ragged_paged_attention(*args, window=9)
+    b = att.ragged_paged_attention(
+        *args, windows=jnp.full((2,), 9, jnp.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unified_sharded_wrapper_with_attributes():
+    """TP shard_map wrapper threads windows (replicated) and sinks (head-
+    sharded) through to per-shard kernels."""
+    from dynamo_tpu.parallel.mesh import AXIS_TP, make_mesh
+
+    rng = np.random.default_rng(5)
+    args = _make_case(
+        rng, [(8, 16), (1, 9)], h=8, kvh=4, d=32, bs=8, num_blocks=32,
+        max_blocks=4,
+    )
+    windows = jnp.asarray([5, 0], jnp.int32)
+    sinks = jnp.asarray(rng.standard_normal(8), jnp.float32)
+    ref = att.ragged_paged_attention(
+        *args, windows=windows, sinks=sinks, softcap=40.0
+    )
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    with mesh:
+        got = pu.sharded_ragged_paged_attention(
+            mesh, AXIS_TP, *args, windows=windows, sinks=sinks,
+            softcap=40.0, q_seg=4, chunk_tokens=16, interpret=True,
+        )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-5, rtol=ATOL
+    )
+
+
+def test_per_row_adapter_ids_in_packed_buffer():
+    """Per-row LoRA adapter indices threaded through the packed buffer:
+    the per-token branch of lora/adapters.make_lora_fn applies each row's
+    adapter to exactly its own segment — equal to applying each adapter's
+    dense delta per segment."""
+    from dynamo_tpu.lora.adapters import make_lora_fn
+
+    rng = np.random.default_rng(3)
+    L_layers, H, r, out = 2, 16, 4, 16
+    N = 3  # slot 0 = identity
+    A = jnp.asarray(rng.standard_normal((N, L_layers, H, r)), jnp.float32)
+    Bm = jnp.asarray(rng.standard_normal((N, L_layers, r, out)), jnp.float32)
+    A = A.at[0].set(0.0)
+    Bm = Bm.at[0].set(0.0)
+    scales = jnp.asarray([0.0, 0.5, 2.0], jnp.float32)
+    tables = {"wq.A": A, "wq.B": Bm, "scales": scales}
+    # packed buffer: chunk of 5 tokens (adapter 1), decode rows with
+    # adapters [0, 2, 1]
+    token_ids = jnp.asarray([1] * 5 + [0, 2, 1], jnp.int32)
+    x = jnp.asarray(rng.standard_normal((8, H)), jnp.float32)
+    got = make_lora_fn(tables, token_ids)("wq", 1, x)
+    for t in range(8):
+        a = int(token_ids[t])
+        want = (x[t] @ A[a, 1]) @ Bm[a, 1] * scales[a]
+        np.testing.assert_allclose(
+            np.asarray(got[t]), np.asarray(want), atol=1e-5, rtol=1e-5
+        )
+    # the [B]-ids decode branch is untouched: 3-dim activations
+    xb = jnp.asarray(rng.standard_normal((3, 1, H)), jnp.float32)
+    ids_b = jnp.asarray([0, 2, 1], jnp.int32)
+    got_b = make_lora_fn(tables, ids_b)("wq", 0, xb)
+    for b in range(3):
+        a = int(ids_b[b])
+        want = (xb[b, 0] @ A[a, 0]) @ Bm[a, 0] * scales[a]
+        np.testing.assert_allclose(
+            np.asarray(got_b[b, 0]), np.asarray(want), atol=1e-5, rtol=1e-5
+        )
+
+
 def test_unified_bf16_and_head_layouts():
     """bf16 queries/pages and MQA-ish head grouping (kvh=1)."""
     rng = np.random.default_rng(7)
@@ -196,6 +336,93 @@ def test_mixed_step_moves_fewer_bytes_than_split():
             )
             assert r["mixed_step_bytes"] <= r["split_pair_bytes"], r
             assert 0 < r["ratio"] <= 1.0, r
+
+
+def test_windowed_mixed_moves_fewer_bytes_than_split():
+    """Tier-1 gate for the windowed families: a mixed step over sliding-
+    window rows (unified kernel skips aged-out pages) stays <= the split
+    pair (whose decode side already gathers only the trailing window
+    blocks)."""
+    shapes = [
+        # (chunk, total, decode_lens, window, bs, kvh, h, d, mbs, bucket)
+        (256, 256, [320] * 8, 128, 16, 8, 16, 128, 64, 256),  # gpt-oss-ish
+        (32, 160, [40] * 4, 16, 4, 2, 4, 16, 40, 32),
+        (64, 64, [2000] * 8, 128, 16, 1, 8, 128, 256, 64),    # long context
+        (512, 512, [384] * 32, 1024, 16, 8, 16, 128, 64, 512),  # w > ctx
+    ]
+    for (cl, tl, dec, w, bs, kvh, h, d, mbs, bucket) in shapes:
+        for quant, esize in [(False, 2), (True, 1)]:
+            r = costs.mixed_vs_split(
+                chunk_len=cl, chunk_total_len=tl, decode_seq_lens=dec,
+                block_size=bs, kv_heads=kvh, num_heads=h, head_dim=d,
+                max_blocks_per_seq=mbs, kv_itemsize=esize, quantized=quant,
+                bucket=bucket, window=w,
+            )
+            assert r["mixed_step_bytes"] <= r["split_pair_bytes"], r
+            assert 0 < r["ratio"] <= 1.0, r
+            assert r["window"] == w
+            # a small window must be CHEAPER than full attention on the
+            # same rows (the head-skip actually skips)
+            if w < min(dec):
+                full = costs.mixed_vs_split(
+                    chunk_len=cl, chunk_total_len=tl, decode_seq_lens=dec,
+                    block_size=bs, kv_heads=kvh, num_heads=h, head_dim=d,
+                    max_blocks_per_seq=mbs, kv_itemsize=esize,
+                    quantized=quant, bucket=bucket,
+                )
+                assert r["mixed_step_bytes"] < full["mixed_step_bytes"]
+
+
+def test_spec_verify_bytes_leq_split_extend_pair():
+    """Tier-1 gate: a spec-verify pass priced as unified q_len=k+1 rows
+    moves <= the split prefix-extend launch it replaced (strictly stronger
+    than <= the extend+decode pair)."""
+    for k in (1, 3, 4, 8):
+        for quant, esize in [(False, 2), (True, 1)]:
+            r = costs.spec_verify_vs_split(
+                k, [320] * 8, block_size=16, kv_heads=8, num_heads=16,
+                head_dim=128, max_blocks_per_seq=64, kv_itemsize=esize,
+                quantized=quant,
+            )
+            assert r["unified_verify_bytes"] <= r["split_extend_bytes"], r
+            assert 0 < r["ratio"] <= 1.0, r
+            # a fortiori vs the pair formulation (extend + one decode step)
+            pair = r["split_extend_bytes"] + costs.split_decode_bytes(
+                [320] * 8, block_size=16, kv_heads=8, num_heads=16,
+                head_dim=128, kv_itemsize=esize, quantized=quant,
+            )
+            assert r["unified_verify_bytes"] <= pair
+
+
+def test_bench_kernel_bytes_family_schema():
+    """The per-family entries bench.py emits under
+    detail.kernel_bytes.families carry the gate fields and pass <= 1.0."""
+    base = costs.mixed_vs_split(
+        chunk_len=256, chunk_total_len=256, decode_seq_lens=[320] * 8,
+        block_size=16, kv_heads=8, num_heads=16, head_dim=128,
+        max_blocks_per_seq=64, bucket=256,
+    )
+    families = {
+        "windowed": costs.mixed_vs_split(
+            chunk_len=256, chunk_total_len=256, decode_seq_lens=[320] * 8,
+            block_size=16, kv_heads=8, num_heads=16, head_dim=128,
+            max_blocks_per_seq=64, bucket=256, window=128,
+        ),
+        "spec_verify": costs.spec_verify_vs_split(
+            4, [320] * 8, block_size=16, kv_heads=8, num_heads=16,
+            head_dim=128, max_blocks_per_seq=64,
+        ),
+        "lora": dict(base, note="x"),
+    }
+    for fam in ("windowed", "lora"):
+        for key in ("mixed_step_bytes", "split_pair_bytes", "ratio", "rows"):
+            assert key in families[fam], fam
+        assert families[fam]["ratio"] <= 1.0, fam
+    sv = families["spec_verify"]
+    for key in ("unified_verify_bytes", "split_extend_bytes", "ratio",
+                "rows", "spec_k"):
+        assert key in sv
+    assert sv["ratio"] <= 1.0
 
 
 def test_jaxpr_counts_traces_kernel_and_reference():

@@ -480,6 +480,10 @@ _sim_wallclock_pass.RULES = ("SIM-WALLCLOCK",)
 SPLIT_ATTENTION_ENTRY_POINTS = frozenset({
     "flash_extend_attention", "sharded_flash_extend_attention",
     "paged_decode_attention", "sharded_paged_decode_attention",
+    # retired from the PALLAS verify path when spec-decode verify became
+    # unified-kernel rows (query_len = k+1); the pure-JAX engine's one
+    # fallback verify dispatch is baselined
+    "paged_extend_attention",
 })
 
 
@@ -849,3 +853,77 @@ def _metric_cardinality_pass(ctx: Context) -> Iterator[Finding]:
 
 
 _metric_cardinality_pass.RULES = ("METRIC-CARDINALITY",)
+
+
+# -- MIXED-GATE --------------------------------------------------------------
+
+# Mixed continuous batching's family gate lives in ONE documented site —
+# the `self.mixed_enabled = bool(... and ...)` assignment in
+# TpuEngine.__init__ (dynamo_tpu/engine/engine.py). PR 14 shrank the gate
+# to pp/sp/vision/multihost; every surviving `and`-term is baselined, so
+# ADDING an exclusion term (or a second gate site anywhere else) surfaces
+# as a new finding. The gate can only shrink silently — growing it takes a
+# deliberate baseline entry.
+_MIXED_GATE_SITE = "dynamo_tpu/engine/engine.py"
+
+
+def _target_names(node: ast.Assign):
+    for t in node.targets:
+        if isinstance(t, ast.Attribute):
+            yield t.attr
+        elif isinstance(t, ast.Name):
+            yield t.id
+
+
+def mixed_gate_terms(path: str, tree: ast.AST):
+    """(path, lineno, msg) per `and`-term of every mixed_enabled
+    assignment, plus a site finding for assignments outside the documented
+    gate location."""
+    out = []
+    at_site = path.endswith(_MIXED_GATE_SITE) or path == _MIXED_GATE_SITE
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if "mixed_enabled" not in set(_target_names(node)):
+            continue
+        if not at_site:
+            out.append((
+                path, node.lineno,
+                "mixed_enabled assigned outside the documented gate site "
+                f"({_MIXED_GATE_SITE} TpuEngine.__init__) — family "
+                "eligibility must stay in the one audited gate",
+            ))
+            continue
+        val = node.value
+        if (
+            isinstance(val, ast.Call)
+            and isinstance(val.func, ast.Name)
+            and val.func.id == "bool"
+            and val.args
+        ):
+            val = val.args[0]
+        terms = (
+            val.values
+            if isinstance(val, ast.BoolOp) and isinstance(val.op, ast.And)
+            else [val]
+        )
+        for term in terms:
+            out.append((
+                path, term.lineno,
+                f"mixed gate term `{ast.unparse(term)}` — adding a family "
+                "exclusion needs a deliberate baseline entry (the gate "
+                "should only shrink)",
+            ))
+    return out
+
+
+@register("mixed-gate", "mixed-batching family exclusions outside the audited gate")
+def _mixed_gate_pass(ctx: Context) -> Iterator[Finding]:
+    for m in ctx.modules:
+        if m.path.startswith(("tests/", "tools/")):
+            continue
+        for _p, lineno, msg in mixed_gate_terms(m.path, m.tree):
+            yield Finding("MIXED-GATE", m.path, lineno, msg)
+
+
+_mixed_gate_pass.RULES = ("MIXED-GATE",)
