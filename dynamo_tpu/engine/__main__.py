@@ -26,6 +26,17 @@ from dynamo_tpu.models.mla import MlaConfig
 from dynamo_tpu.models.moe import MoeConfig
 from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig, init_logging
 from dynamo_tpu.runtime.component import new_instance_id
+from dynamo_tpu.runtime.config import (
+    ENV_KVBM_DISK_CACHE_GB,
+    ENV_KVBM_DISK_PATH,
+    ENV_KVBM_HOST_CACHE_GB,
+    ENV_KVBM_REMOTE,
+    ENV_MIGRATION_LIMIT,
+    ENV_NAMESPACE,
+    env_float,
+    env_int,
+    env_str,
+)
 
 PRESETS = {
     "tiny": lambda: LlamaConfig(),
@@ -71,7 +82,7 @@ def parse_args():
                         "(e.g. deepseek_r1, qwen3, gpt_oss; "
                         "parsers/reasoning.py registry); default: gpt_oss "
                         "for gpt-oss presets, else none")
-    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--namespace", default=env_str(ENV_NAMESPACE, "dynamo"))
     p.add_argument("--component", default="backend")
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--store", default=None)
@@ -120,13 +131,19 @@ def parse_args():
                    help="pipeline-parallel stages for serving: layers + "
                    "paged KV shard over a pp mesh axis, activations ride a "
                    "shard_map wavefront (parallel/pp_serving.py)")
-    p.add_argument("--migration-limit", type=int, default=0)
-    p.add_argument("--kvbm-host-gb", type=float, default=0.0,
+    p.add_argument("--migration-limit", type=int,
+                   default=env_int(ENV_MIGRATION_LIMIT, 0))
+    p.add_argument("--kvbm-host-gb", type=float,
+                   default=env_float(ENV_KVBM_HOST_CACHE_GB, 0.0),
                    help="host DRAM KV tier size (G2); 0 disables kvbm")
-    p.add_argument("--kvbm-disk-gb", type=float, default=0.0,
+    p.add_argument("--kvbm-disk-gb", type=float,
+                   default=env_float(ENV_KVBM_DISK_CACHE_GB, 0.0),
                    help="disk KV tier size (G3)")
-    p.add_argument("--kvbm-disk-path", default="/tmp/dtpu_kvbm")
-    p.add_argument("--kvbm-remote", default=None, metavar="HOST:PORT",
+    p.add_argument("--kvbm-disk-path",
+                   default=env_str(ENV_KVBM_DISK_PATH, "/tmp/dtpu_kvbm"))
+    p.add_argument("--kvbm-remote",
+                   default=(env_str(ENV_KVBM_REMOTE, "") or None),
+                   metavar="HOST:PORT",
                    help="G4 fleet-shared block store "
                         "(python -m dynamo_tpu.kvbm)")
     p.add_argument("--lora-max-adapters", type=int, default=0,

@@ -49,6 +49,7 @@ from ..models import llama, registry
 from ..models.vision import IMAGE_TOKEN_ID
 from ..ops import attention as att
 from ..parallel import mesh as meshlib
+from ..runtime.config import ENV_KV_BLOCK_SIZE, env_int
 from ..runtime.engine import Context
 from ..runtime.errors import (
     ContextLengthError,
@@ -80,7 +81,10 @@ log = get_logger("engine")
 class TpuEngineConfig:
     model: llama.LlamaConfig
     num_blocks: int = 512
-    block_size: int = 16
+    # explicit values win; DTPU_KV_BLOCK_SIZE configures what callers leave open
+    block_size: int = dataclasses.field(
+        default_factory=lambda: env_int(ENV_KV_BLOCK_SIZE, 16)
+    )
     max_batch_size: int = 8
     # max_context may exceed the largest prefill bucket: prompts prefill in
     # bounded chunks (one chunk per engine-loop tick, so running decodes
@@ -2485,10 +2489,10 @@ class TpuEngine:
             except RuntimeError:
                 loop = None  # no running loop (sync teardown): sockets close with us
             if loop is not None:
-                # keep a ref: the loop only weak-refs tasks
-                self._transfer_stop_task = loop.create_task(
-                    self._transfer_server.stop(0.5)
-                )
+                # spawn_bg pins the task (the loop only weak-refs it) and
+                # logs a failed stop; nothing joins it — stop() is the
+                # shutdown path itself
+                spawn_bg(self._transfer_server.stop(0.5))
         if getattr(self, "_kv_transfer_srv", None) is not None:
             self._kv_transfer_srv.close()
             if self.transfer_address is not None:
@@ -3620,9 +3624,10 @@ class TpuEngine:
                 except OutOfBlocks:
                     ok = False
                     break
-                for bid in new_ids:
-                    st.block_ids.append(bid)
-                    self._block_tables[st.slot, len(st.block_ids) - 1] = bid
+                base = len(st.block_ids)
+                st.block_ids.extend(new_ids)
+                for off, bid in enumerate(new_ids):
+                    self._block_tables[st.slot, base + off] = bid
                 granted.append((st, len(new_ids)))
         if not ok:
             for st, count in granted:

@@ -409,6 +409,16 @@ class KvTransferServer:
             "shards": shards,
         }
 
+    def _reclaim_leases(self, leases: List[Tuple[int, int]]) -> None:
+        """Synchronously drop every (slot, token) lease the client never
+        freed — the token match keeps re-leased slots untouched. Shared by
+        the one-shot native branch (failed gather) and the streaming
+        handler (client gone mid-stream)."""
+        for slot, token in leases:
+            lease = self._slot_lease.get(slot)
+            if lease is not None and lease[1] == token:
+                self._slot_lease.pop(slot, None)
+
     def _lease_slots(self, n: int) -> Optional[Tuple[List[int], int]]:
         now = time.monotonic()
         free = [
@@ -487,7 +497,15 @@ class KvTransferServer:
             leased = self._lease_slots(n) if native_ok else None
             if leased is not None:
                 slots, token = leased
-                checksums = await self._gather_into_arena(block_ids, slots)
+                try:
+                    checksums = await self._gather_into_arena(block_ids, slots)
+                except BaseException:
+                    # failed mid-serve: the client never learns these slot
+                    # numbers, so nothing would free them until SLOT_LEASE_S
+                    # expiry — the same capacity bleed the streaming branch
+                    # reclaims on abnormal exit
+                    self._reclaim_leases([(s, token) for s in slots])
+                    raise
                 self._trace_serve(
                     request, t_serve, "native", n, n * self._block_nbytes
                 )
@@ -628,11 +646,7 @@ class KvTransferServer:
         finally:
             if not clean_exit:
                 # client gone mid-stream: reclaim every lease it never freed
-                # (token match keeps re-leased slots untouched)
-                for slot, token in stream_leases:
-                    lease = self._slot_lease.get(slot)
-                    if lease is not None and lease[1] == token:
-                        self._slot_lease.pop(slot, None)
+                self._reclaim_leases(stream_leases)
 
     def _gather_np(self, block_ids: List[int], dtype=None) -> np.ndarray:
         """Executor thread: device gather -> [L, 2, n, bs, kvh, d]; dtype

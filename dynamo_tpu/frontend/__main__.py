@@ -14,20 +14,28 @@ from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
 from dynamo_tpu.llm.http.service import HttpService
 from dynamo_tpu.llm.request_template import RequestTemplate
 from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig, init_logging
+from dynamo_tpu.runtime.config import (
+    ENV_BUSY_THRESHOLD,
+    ENV_HTTP_PORT,
+    ENV_NAMESPACE,
+    env_int,
+    env_str,
+)
 
 
 def parse_args():
     p = argparse.ArgumentParser("dynamo_tpu.frontend")
     p.add_argument("--host", default="0.0.0.0")
-    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--port", type=int, default=env_int(ENV_HTTP_PORT, 8000))
     p.add_argument(
         "--router-mode", choices=["round-robin", "random", "kv"], default="round-robin"
     )
-    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--namespace", default=env_str(ENV_NAMESPACE, "dynamo"))
     p.add_argument("--store", default=None, help="mem|file (default from DTPU_STORE)")
     p.add_argument("--store-path", default=None)
     p.add_argument("--event-plane", default=None, help="zmq|inproc")
-    p.add_argument("--busy-threshold", type=int, default=None)
+    p.add_argument("--busy-threshold", type=int,
+                   default=(env_int(ENV_BUSY_THRESHOLD, 0) or None))
     p.add_argument("--grpc-port", type=int, default=-1,
                    help="KServe v2 gRPC frontend port (0 = ephemeral, -1 = off)")
     p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)

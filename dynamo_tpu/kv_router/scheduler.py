@@ -28,6 +28,7 @@ import random
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..runtime.config import ENV_ROUTER_REPLICA_SYNC, env_bool
 from ..runtime.logging import get_logger
 from .protocols import OverlapScores, WorkerMetrics, WorkerWithDpRank
 
@@ -53,7 +54,9 @@ class KvRouterConfig:
     # peers', so replicated routers share one load + (approx) prefix view;
     # new replicas catch up via a snapshot handshake (reference:
     # lib/llm/src/kv_router/subscriber.rs, kv_router.rs:163-165)
-    replica_sync: bool = False
+    replica_sync: bool = dataclasses.field(
+        default_factory=lambda: env_bool(ENV_ROUTER_REPLICA_SYNC, False)
+    )
     metrics_stale_after_s: float = 10.0
     approx_ttl_s: float = 120.0
     # -- two-stage decision knobs (docs/operations.md "Router scale") -------

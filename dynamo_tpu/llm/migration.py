@@ -26,6 +26,9 @@ SendFn = Callable[[PreprocessedRequest, Context, List[int]], Awaitable[AsyncIter
 class Migration:
     def __init__(self, send: SendFn, migration_limit: int = 0):
         self.send = send
+        # DTPU_MIGRATION_LIMIT applies at the worker CLI boundary (the
+        # --migration-limit argparse default) so an explicit 0 here still
+        # means "migration disabled" — don't re-consult the env
         self.migration_limit = migration_limit
 
     async def generate(

@@ -64,6 +64,40 @@ ENV_CONFIG_FILE = "DTPU_CONFIG"                       # layered config file (jso
 ENV_RETRY_DEFAULT = "DTPU_RETRY_DEFAULT"              # "attempts=3,base=0.05,max=2,timeout=10,deadline=30"
 ENV_CB_DEFAULT = "DTPU_CB_DEFAULT"                    # "threshold=5,rate=0.5,window=30,reset=5,half_open=1"
 ENV_FAULTS = "DTPU_FAULTS"                            # fault-injection spec, e.g. "transfer.pull:drop@2"
+# engine + kernels (engine/engine.py, ops/quant.py, engine/warm.py,
+# engine/weight_service.py, parallel/pp_serving.py, runtime/multihost.py)
+ENV_MIXED = "DTPU_MIXED"                              # mixed continuous batching on/off/auto
+ENV_KV_DTYPE = "DTPU_KV_DTYPE"                        # paged KV cache dtype (int8 opt-in)
+ENV_LOOP_TRACE = "DTPU_LOOP_TRACE"                    # engine step-loop debug trace
+ENV_WARM_CACHE = "DTPU_WARM_CACHE"                    # host weight cache dir
+ENV_WEIGHT_SERVICE = "DTPU_WEIGHT_SERVICE"            # shared weight service address
+ENV_WEIGHT_SHM = "DTPU_WEIGHT_SHM"                    # weight shm segment prefix
+ENV_PP_MICROBATCHES = "DTPU_PP_MICROBATCHES"          # pp wavefront microbatch count
+ENV_PP_COND_SKIP = "DTPU_PP_COND_SKIP"                # pp conditional bubble skip
+ENV_MH_TRACE = "DTPU_MH_TRACE"                        # multihost replay debug trace
+# KV transfer plane (engine/transfer.py, transfer/native.py)
+ENV_STREAM_WINDOW = "DTPU_STREAM_WINDOW"              # streamed fetch window (blocks)
+ENV_STREAM_WAIT_S = "DTPU_STREAM_WAIT_S"              # streamed fetch commit-wait budget
+ENV_DEVICE_TRANSFER = "DTPU_DEVICE_TRANSFER"          # device-to-device pull path on/off
+ENV_ICI_TRANSFER = "DTPU_ICI_TRANSFER"                # same-process ICI fast path on/off
+ENV_XFER_HOST = "DTPU_XFER_HOST"                      # advertised transfer-plane host
+ENV_KV_WIRE = "DTPU_KV_WIRE"                          # advertised kv wire class (ici/tcp/...)
+# router scale (kv_router/scheduler.py, docs/operations.md 9b)
+ENV_ROUTER_TOPK = "DTPU_ROUTER_TOPK"                  # two-stage routing candidate K
+ENV_ROUTER_SHARDS = "DTPU_ROUTER_SHARDS"              # postings/snapshot index shards
+ENV_ROUTER_POSTINGS_BUCKET = "DTPU_ROUTER_POSTINGS_BUCKET"  # per-block postings cap
+# disagg routing + prefill deflection (llm/prefill_router.py, PR 10 knobs)
+ENV_STREAM_KV = "DTPU_STREAM_KV"                      # streamed (vs sequential) disagg dispatch
+ENV_DEFLECT = "DTPU_DEFLECT"                          # prefill deflection valve on/off
+ENV_DEFLECT_MAX_TOKENS = "DTPU_DEFLECT_MAX_TOKENS"    # short-prompt deflection bound
+ENV_DEFLECT_OVERLAP = "DTPU_DEFLECT_OVERLAP"          # decode-pool radix-hit deflection share
+ENV_DEFLECT_MARGIN = "DTPU_DEFLECT_MARGIN"            # load-skew deflection margin
+ENV_PREFILL_BLOCK_MS = "DTPU_PREFILL_BLOCK_MS"        # per-block prefill cost prior
+ENV_KV_BYTES_PER_BLOCK = "DTPU_KV_BYTES_PER_BLOCK"    # wire-cost bytes/block override
+# model hub + media fetch (llm/hub.py, llm/media.py)
+ENV_HUB_CACHE = "DTPU_HUB_CACHE"                      # checkpoint cache dir
+ENV_HUB_OFFLINE = "DTPU_HUB_OFFLINE"                  # forbid hub network fetches
+ENV_MEDIA_FILE_ROOT = "DTPU_MEDIA_FILE_ROOT"          # multimodal file:// jail root
 
 _TRUTHY = {"1", "true", "yes", "on", "enabled"}
 _FALSEY = {"0", "false", "no", "off", "disabled", ""}

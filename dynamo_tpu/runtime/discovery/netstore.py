@@ -178,13 +178,25 @@ class TcpKVStore(KVStore):
         self._watchers: Dict[int, Watcher] = {}
         self._rid = 0
         self._wid = 0
-        self._lock = asyncio.Lock()
+        self._lock = asyncio.Lock()          # write ordering on the one connection
+        self._connect_lock = asyncio.Lock()  # connect dedup ONLY — never held for sends
 
     async def _ensure(self) -> None:
+        """Connect (once) OUTSIDE the send lock: when the store is down,
+        every pending op used to queue single-file behind one OS-timeout-
+        scale connect attempt under self._lock — a dead store serialized
+        the whole discovery plane. The dedicated connect lock's entire job
+        is deduplicating the dial; it guards no request traffic."""
         if self._writer is not None:
             return
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
-        self._rx_task = asyncio.create_task(self._rx_loop())
+        async with self._connect_lock:
+            if self._writer is not None:
+                return  # lost the race: the winner's connection serves us
+            reader, writer = await asyncio.open_connection(  # dtpu: ignore[LOCK-ACROSS-AWAIT] — the connect lock exists to hold exactly this await; senders are not behind it
+                self.host, self.port
+            )
+            self._reader, self._writer = reader, writer
+            self._rx_task = asyncio.create_task(self._rx_loop())
 
     async def _rx_loop(self) -> None:
         try:
@@ -225,8 +237,13 @@ class TcpKVStore(KVStore):
 
     async def _call(self, obj: dict) -> dict:
         await FAULTS.ainject("discovery.call")
+        await self._ensure()
         async with self._lock:
-            await self._ensure()
+            if self._writer is None:
+                # severed between _ensure and the lock: surface as the same
+                # transport loss a mid-drain sever raises; _call_retry's
+                # policy reconnects on the next attempt
+                raise ConnectionError("kv store connection lost")
             self._rid += 1
             rid = self._rid
             obj["rid"] = rid
@@ -258,8 +275,8 @@ class TcpKVStore(KVStore):
         return (await self._call_retry({"op": "list", "prefix": prefix}))["items"]
 
     async def watch(self, prefix: str) -> Watcher:
+        await self._ensure()
         async with self._lock:
-            await self._ensure()
             self._wid += 1
             wid = self._wid
         w = Watcher()

@@ -22,6 +22,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 from aiohttp import web
 
 from . import metrics as M
+from .config import ENV_CANARY_WAIT_TIME, ENV_SYSTEM_HOST, env_float, env_str
 from .logging import get_logger
 from .request_plane.tcp import TcpClient
 from .tasks import spawn_bg
@@ -69,14 +70,18 @@ class EndpointCanary:
         self,
         targets: Dict[str, str],
         state: Optional[HealthState] = None,
-        interval_s: float = 1.0,
+        interval_s: Optional[float] = None,
         timeout_s: float = 2.0,
         fail_threshold: int = 3,
         on_unhealthy: Optional[Callable[[str], Awaitable[None]]] = None,
     ):
         self.targets = dict(targets)
         self.state = state or HealthState()
-        self.interval_s = interval_s
+        # DTPU_CANARY_WAIT_TIME (reference canary_wait_time) paces the probe
+        # loop when the caller leaves it open
+        self.interval_s = (
+            env_float(ENV_CANARY_WAIT_TIME, 1.0) if interval_s is None else interval_s
+        )
         self.timeout_s = timeout_s
         self.fail_threshold = fail_threshold
         self.on_unhealthy = on_unhealthy
@@ -173,7 +178,7 @@ class StatusServer:
         metrics_scope: Optional[M.MetricsScope] = None,
         metadata_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         pre_expose: Optional[Callable[[], None]] = None,
-        host: str = "0.0.0.0",
+        host: Optional[str] = None,
         port: int = 0,
         loras_fn: Optional[Callable[[], list]] = None,
         flight_recorder=None,
@@ -183,7 +188,8 @@ class StatusServer:
         self.metadata_fn = metadata_fn
         self.loras_fn = loras_fn
         self.pre_expose = pre_expose  # refresh gauges right before scraping
-        self.host = host
+        # explicit host wins; DTPU_SYSTEM_HOST configures what callers left open
+        self.host = host if host is not None else env_str(ENV_SYSTEM_HOST, "0.0.0.0")
         self.port = port
         # None = the process-global recorder (workers get /debug/requests
         # without wiring); tests pass their own

@@ -70,3 +70,18 @@ def pytest_pyfunc_call(pyfuncitem):
 @pytest.fixture
 def tmp_store_path(tmp_path):
     return str(tmp_path / "store")
+
+
+@pytest.fixture(scope="session")
+def repo_analysis():
+    """ONE whole-tree tools/analysis run (dynamo_tpu/, every pass, no
+    baseline) shared by every current-tree pin in test_analysis.py /
+    test_analysis_flows.py — each used to reload and re-analyze the tree
+    themselves, which multiplied ~7s per test into the tier-1 clock.
+    Returns (modules, parse_findings, findings)."""
+    from tools.analysis import core
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    modules, parse = core.load_modules([os.path.join(repo, "dynamo_tpu")])
+    findings = core.collect_findings(modules, parse)
+    return modules, parse, findings

@@ -432,6 +432,9 @@ def test_cli_list_rules_and_select(tmp_path):
         "DROPPED-TASK", "BROAD-RETRY", "SLEEP-RETRY", "KV-DTYPE",
         "SIM-WALLCLOCK", "PROMETHEUS-IMPORT", "WALLCLOCK-LATENCY",
         "UNUSED-METRIC",
+        # the interprocedural lifecycle + catalog-drift rules (flows.py)
+        "RESOURCE-LEAK", "LOCK-ACROSS-AWAIT", "TASK-JOIN",
+        "ENV-DRIFT", "FAULTS-DRIFT",
     }
     assert expected <= rules
 
@@ -512,14 +515,11 @@ def test_wire_blocking_scoped_to_request_path_modules(tmp_path):
     assert found == []
 
 
-def test_wire_blocking_current_tree_only_baselined_sites():
+def test_wire_blocking_current_tree_only_baselined_sites(repo_analysis):
     """The live tree carries exactly the deliberate blocking-wire sites in
     handle()'s legacy branch — both baselined; anything new fails the gate."""
-    modules, parse = core.load_modules([os.path.join(REPO, "dynamo_tpu")])
-    found = [
-        f for f in core.collect_findings(modules, parse)
-        if f.rule == "WIRE-BLOCKING"
-    ]
+    _modules, _parse, findings = repo_analysis
+    found = [f for f in findings if f.rule == "WIRE-BLOCKING"]
     assert len(found) == 2
     assert all(f.path == "dynamo_tpu/engine/transfer.py" for f in found)
     baseline = core.load_baseline(core.DEFAULT_BASELINE)
@@ -529,14 +529,14 @@ def test_wire_blocking_current_tree_only_baselined_sites():
 
 # -- parity with the pre-framework lint.py -----------------------------------
 
-def test_ported_passes_match_preport_lint_on_current_tree():
+def test_ported_passes_match_preport_lint_on_current_tree(repo_analysis):
     """The legacy helpers kept their pre-port behavior: driving them with
     the OLD tools/lint.py main()'s per-file orchestration (scoping rules
     and all) over dynamo_tpu/ must produce exactly the findings the
     framework reports for those rules."""
     from tools.analysis import legacy
 
-    modules, parse = core.load_modules([os.path.join(REPO, "dynamo_tpu")])
+    modules, parse, findings = repo_analysis
     assert not parse
 
     old = []  # (rule, path, line) per finding, old-driver scoping
@@ -576,7 +576,9 @@ def test_ported_passes_match_preport_lint_on_current_tree():
         "WALLCLOCK-LATENCY", "UNUSED-METRIC",
     }
     new = []
-    for f in core.collect_findings(modules, parse, select=sorted(legacy_rules)):
+    for f in findings:
+        if f.rule not in legacy_rules:
+            continue
         name = f.message.split()[0] if f.rule in ("UNDEFINED", "UNUSED-IMPORT") else None
         new.append((f.rule, f.path, f.line, name))
     assert sorted(old) == sorted(new)
@@ -626,14 +628,11 @@ def test_metric_cardinality_scoped_to_serving_packages(tmp_path):
     assert found == []
 
 
-def test_metric_cardinality_current_tree_clean():
+def test_metric_cardinality_current_tree_clean(repo_analysis):
     """The live serving tree keeps every metric label bounded (worker ids
     ride detached scopes; anything new fails the gate)."""
-    modules, parse = core.load_modules([os.path.join(REPO, "dynamo_tpu")])
-    found = [
-        f for f in core.collect_findings(modules, parse)
-        if f.rule == "METRIC-CARDINALITY"
-    ]
+    _modules, _parse, findings = repo_analysis
+    found = [f for f in findings if f.rule == "METRIC-CARDINALITY"]
     assert found == []
 
 
@@ -681,15 +680,12 @@ def test_mixed_gate_ignores_reads_and_tests(tmp_path):
     assert found == []
 
 
-def test_mixed_gate_current_tree_exactly_baselined():
+def test_mixed_gate_current_tree_exactly_baselined(repo_analysis):
     """The live gate carries exactly the documented pp/sp/vision/multihost
     exclusions (plus the two intent terms), all baselined — the gate can
     only shrink without touching the baseline."""
-    modules, parse = core.load_modules([os.path.join(REPO, "dynamo_tpu")])
-    found = [
-        f for f in core.collect_findings(modules, parse)
-        if f.rule == "MIXED-GATE"
-    ]
+    _modules, _parse, findings = repo_analysis
+    found = [f for f in findings if f.rule == "MIXED-GATE"]
     assert len(found) == 6
     assert all(f.path == "dynamo_tpu/engine/engine.py" for f in found)
     msgs = "\n".join(f.message for f in found)

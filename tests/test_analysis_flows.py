@@ -1,0 +1,914 @@
+"""tools/analysis interprocedural engine (flows.py) + the five lifecycle/
+drift passes (RESOURCE-LEAK, LOCK-ACROSS-AWAIT, TASK-JOIN, ENV-DRIFT,
+FAULTS-DRIFT), the PR 10 / PR 13 reverted-fix re-detection pins, the SARIF
+output mode, and --changed-only.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from tools.analysis import core, flows
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def analyze(tmp_path, rel, src, rule=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    modules, parse = core.load_modules([str(tmp_path)])
+    found = core.collect_findings(modules, parse)
+    if rule is not None:
+        found = [f for f in found if f.rule == rule]
+    return found
+
+
+def run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analysis", *args],
+        capture_output=True, text=True, timeout=300, cwd=cwd,
+    )
+
+
+def _flows_for(srcs):
+    """Build Flows over {relpath: source} fixture modules."""
+    modules = []
+    for rel, src in srcs.items():
+        src = textwrap.dedent(src)
+        modules.append(core.Module(rel, src, ast.parse(src), src.splitlines()))
+    return flows.build(modules)
+
+
+# ---------------------------------------------------------------------------
+# call graph
+# ---------------------------------------------------------------------------
+
+def test_callgraph_resolves_methods_and_module_functions():
+    fl = _flows_for({
+        "pkg/a.py": """
+            def helper():
+                return 1
+
+            class C:
+                def entry(self):
+                    helper()
+                    self.step()
+
+                def step(self):
+                    return 2
+        """,
+    })
+    entry = fl.index.by_key[("pkg/a.py", "C.entry")]
+    callees = fl.graph.callees(entry.key)
+    assert ("pkg/a.py", "helper") in callees
+    assert ("pkg/a.py", "C.step") in callees
+
+
+def test_callgraph_resolves_imports_and_module_alias():
+    fl = _flows_for({
+        "pkg/util.py": """
+            def gadget():
+                return 1
+        """,
+        "pkg/main.py": """
+            from pkg.util import gadget
+            from pkg import util
+
+            def run():
+                gadget()
+                util.gadget()
+        """,
+    })
+    run_key = ("pkg/main.py", "run")
+    assert ("pkg/util.py", "gadget") in fl.graph.callees(run_key)
+
+
+def test_callgraph_decorated_defs_and_nested_defs_indexed():
+    fl = _flows_for({
+        "m.py": """
+            import functools
+
+            @functools.lru_cache
+            def cached():
+                return 1
+
+            def outer():
+                def inner():
+                    cached()
+                inner()
+        """,
+    })
+    assert ("m.py", "cached") in fl.index.by_key
+    outer = fl.index.by_key[("m.py", "outer")]
+    assert ("m.py", "outer.<locals>.inner") in fl.graph.callees(outer.key)
+    inner = fl.index.by_key[("m.py", "outer.<locals>.inner")]
+    assert ("m.py", "cached") in fl.graph.callees(inner.key)
+
+
+def test_callgraph_partial_reference_edges():
+    fl = _flows_for({
+        "m.py": """
+            import functools
+
+            def work(x):
+                return x
+
+            def sched(runner):
+                runner(functools.partial(work, 1))
+        """,
+    })
+    sched = fl.index.by_key[("m.py", "sched")]
+    assert ("m.py", "work") in fl.graph.refs[sched.key]
+
+
+def test_callgraph_cycles_converge():
+    fl = _flows_for({
+        "m.py": """
+            def a():
+                b()
+
+            def b():
+                a()
+
+            def c():
+                a()
+        """,
+    })
+    closure = fl.graph.closure_calling({("m.py", "a")})
+    assert closure == {("m.py", "a"), ("m.py", "b"), ("m.py", "c")}
+
+
+# ---------------------------------------------------------------------------
+# CFG + dataflow
+# ---------------------------------------------------------------------------
+
+def _fn(src, name):
+    tree = ast.parse(textwrap.dedent(src))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == name:
+            return node
+    raise AssertionError(name)
+
+
+def test_cfg_return_routes_through_finally():
+    fn = _fn("""
+        def f(x):
+            try:
+                if x:
+                    return 1
+                y = 2
+            finally:
+                cleanup()
+            return y
+    """, "f")
+    cfg = flows.build_cfg(fn)
+    fin = [i for i, n in enumerate(cfg.nodes) if "finalbody" in n.meta]
+    assert len(fin) == 1
+    ret_nodes = [
+        i for i, n in enumerate(cfg.nodes)
+        if isinstance(n.node, ast.Return) and n.node.value is not None
+        and isinstance(n.node.value, ast.Constant)
+    ]
+    # the early return's only successor is the finally join
+    assert cfg.succ[ret_nodes[0]] == {fin[0]}
+    # and the finally flows BOTH onward (the trailing return) and out (exit)
+    fin_out = set()
+    for i, n in enumerate(cfg.nodes):
+        if cfg.succ[i] and fin[0] in cfg.succ[i]:
+            fin_out.add(i)
+    assert flows.Cfg.EXIT_ID in {s for i in range(len(cfg.nodes)) for s in cfg.succ[i]}
+
+
+def test_cfg_generator_yield_has_exit_edge():
+    fn = _fn("""
+        async def g():
+            acquire()
+            yield 1
+            release()
+    """, "g")
+    cfg = flows.build_cfg(fn)
+    yield_nodes = [
+        i for i, n in enumerate(cfg.nodes)
+        if n.node is not None and any(
+            isinstance(x, ast.Yield) for x in ast.walk(n.node)
+        )
+    ]
+    assert yield_nodes and flows.Cfg.EXIT_ID in cfg.succ[yield_nodes[0]]
+    # a non-generator's statements have no such edge
+    fn2 = _fn("async def h():\n    acquire()\n    release()\n", "h")
+    cfg2 = flows.build_cfg(fn2)
+    for i, n in enumerate(cfg2.nodes):
+        if n.kind == flows.STMT and n.node is not None:
+            assert flows.Cfg.EXIT_ID not in cfg2.succ[i] or i == len(cfg2.nodes) - 1
+
+
+def test_cfg_narrowing_assume_nodes():
+    fn = _fn("""
+        def f():
+            x = maybe()
+            if x is not None:
+                use(x)
+            return
+    """, "f")
+    cfg = flows.build_cfg(fn)
+    assumes = [n for n in cfg.nodes if n.kind == flows.ASSUME]
+    assert len(assumes) == 2
+    assert all(n.meta["narrow"] == ("x", "not_none") for n in assumes)
+    assert {n.meta["branch"] for n in assumes} == {True, False}
+
+
+def test_forward_dataflow_converges_on_loops():
+    fn = _fn("""
+        def f(n):
+            i = 0
+            while i < n:
+                i = i + 1
+            return i
+    """, "f")
+    cfg = flows.build_cfg(fn)
+    visited = set()
+
+    def transfer(idx, node, state):
+        visited.add(idx)
+        return state + 1 if state < 5 else state
+
+    def join(a, b):
+        return max(a, b)
+
+    state_in, _ = flows.forward(cfg, 0, transfer, join)
+    assert state_in[flows.Cfg.EXIT_ID] is not None  # fixpoint reached
+    assert len(visited) >= 4
+
+
+# ---------------------------------------------------------------------------
+# RESOURCE-LEAK fixtures
+# ---------------------------------------------------------------------------
+
+def test_leak_unreleased_acquire_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/transfer.py", """
+        class S:
+            async def serve(self, n):
+                leased = self._lease_slots(n)
+                if leased is not None:
+                    slots, token = leased
+                    await self._push(slots)
+        """,
+        rule="RESOURCE-LEAK",
+    )
+    assert len(found) == 1
+    assert "arena-lease" in found[0].message and "serve" in found[0].message
+
+
+def test_leak_release_and_ownership_paths_clean(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/transfer.py", """
+        class S:
+            async def released(self, n):
+                leased = self._lease_slots(n)
+                if leased is not None:
+                    slots, token = leased
+                    try:
+                        await self._push(slots)
+                    finally:
+                        for s in slots:
+                            self._slot_lease.pop(s, None)
+
+            async def returned(self, n):
+                leased = self._lease_slots(n)
+                if leased is not None:
+                    slots, token = leased
+                    return {"slots": slots, "token": token}
+                return None
+
+            async def yielded(self, n):
+                leased = self._lease_slots(n)
+                if leased is not None:
+                    slots, token = leased
+                    yield {"slots": slots, "token": token}
+
+            async def none_path_is_not_a_leak(self, n):
+                leased = self._lease_slots(n) if n else None
+                if leased is None:
+                    return 0
+                slots, token = leased
+                return slots
+
+            async def lock_wrapped_acquire_discharges(self, n):
+                # the with-HEAD must not double-process body calls: the
+                # acquire belongs to the body statement that binds it
+                async with self._mu:
+                    leased = self._lease_slots(n)
+                    if leased is not None:
+                        slots, token = leased
+                        return {"slots": slots, "token": token}
+                    return None
+        """,
+        rule="RESOURCE-LEAK",
+    )
+    assert found == []
+
+
+def test_leak_cfg_edge_semantics(tmp_path):
+    """The three CFG edges a reviewer broke out of the first cut: a finally
+    entered only by normal flow must NOT continue past the code after the
+    try; for/else is skipped by break; while/else runs on every non-break
+    exit."""
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/transfer.py", """
+        class S:
+            def release_after_quiet_finally(self, n):
+                leased = self._lease_slots(n)
+                try:
+                    x = 1
+                finally:
+                    self.log(x)
+                # reachable on EVERY path (no abrupt exit can enter that
+                # finally) — this release must count
+                if leased is not None:
+                    slots, token = leased
+                    self._slot_lease.pop(slots[0], None)
+
+            def break_skips_for_else(self, n, items):
+                leased = self._lease_slots(n)
+                if leased is None:
+                    return
+                for i in items:
+                    if i:
+                        break
+                else:
+                    self._slot_lease.pop(0, None)
+                # the break path never released: LEAK
+
+            def while_else_always_runs(self, n):
+                leased = self._lease_slots(n)
+                if leased is None:
+                    return
+                while self.cond():
+                    self.work()
+                else:
+                    self._slot_lease.pop(0, None)
+        """,
+        rule="RESOURCE-LEAK",
+    )
+    assert len(found) == 1, found
+    assert "break_skips_for_else" in found[0].message
+
+
+def test_leak_interprocedural_param_transfer(tmp_path):
+    # helper acquires and stores into the caller's list: the CALLER now
+    # holds the resource; without a release on its exit paths it leaks
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/transfer.py", """
+        class S:
+            async def _window(self, n, held):
+                leased = self._lease_slots(n)
+                if leased is not None:
+                    slots, token = leased
+                    held.extend((s, token) for s in slots)
+                    return slots
+                return None
+
+            async def leaky_stream(self, n):
+                held = []
+                await self._window(n, held)
+                yield {"served": n}
+
+            async def reclaiming_stream(self, n):
+                held = []
+                try:
+                    await self._window(n, held)
+                    yield {"served": n}
+                finally:
+                    for slot, token in held:
+                        self._slot_lease.pop(slot, None)
+
+            async def yielding_stream_transfers_ownership(self, n):
+                held = []
+                item = await self._window(n, held)
+                yield item
+        """,
+        rule="RESOURCE-LEAK",
+    )
+    assert len(found) == 1
+    assert "leaky_stream" in found[0].message
+    assert "_window" in found[0].message
+
+
+def test_leak_kv_blocks_owner_store_clean_and_bare_leak(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/eng.py", """
+        class E:
+            def book(self, st, extra):
+                new_ids = self.allocator.allocate(extra)
+                st.block_ids.extend(new_ids)
+                return True
+
+            def rollback_ok(self, extra):
+                ids = self.allocator.allocate(extra)
+                if not self.fits(ids):
+                    self.allocator.release(ids)
+                    return False
+                return ids
+
+            def leaky(self, extra):
+                ids = self.allocator.allocate(extra)
+                if not self.fits(ids):
+                    return False
+                return ids
+        """,
+        rule="RESOURCE-LEAK",
+    )
+    assert len(found) == 1 and "leaky" in found[0].message
+
+
+def test_leak_charge_displacement_rule(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/kv_router/r.py", """
+        class R:
+            def bare_overwrite(self, rid, worker, blocks):
+                self._active[rid] = (worker, blocks)
+
+            def pop_then_store(self, rid, worker, blocks):
+                prev = self._active.pop(rid, None)
+                if prev is not None:
+                    self.scheduler.sub_local_load(*prev)
+                self._active[rid] = (worker, blocks)
+
+            def guarded_store(self, key, worker, blocks):
+                if key in self._remote_active:
+                    return
+                self._remote_active[key] = (worker, blocks)
+        """,
+        rule="RESOURCE-LEAK",
+    )
+    assert len(found) == 1
+    assert found[0].line == 4
+    assert "displace" in found[0].message and "_active" in found[0].message
+
+
+def test_leak_out_of_scope_paths_not_scanned(tmp_path):
+    # same shapes outside the spec'd paths: no findings
+    found = analyze(
+        tmp_path, "dynamo_tpu/planner/thing.py", """
+        class S:
+            async def serve(self, n):
+                leased = self._lease_slots(n)
+                slots, token = leased
+                await self._push(slots)
+        """,
+        rule="RESOURCE-LEAK",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# reverted-fix re-detection pins (the acceptance-criteria fixtures)
+# ---------------------------------------------------------------------------
+
+_PR13_FIX = (
+    "            prev = self._active.pop(request_id, None)\n"
+    "            if prev is not None:\n"
+    "                self.scheduler.sub_local_load(*prev)\n"
+    "            self._active[request_id] = (decision.worker, new_blocks)\n"
+)
+_PR13_REVERTED = (
+    "            self._active[request_id] = (decision.worker, new_blocks)\n"
+)
+
+
+def test_reverting_pr13_reroute_release_fix_is_redetected(tmp_path, repo_analysis):
+    """Reverting the PR 13 migration-retry charge release (overwrite
+    _active without releasing the superseded charge) must surface as a
+    non-baselined RESOURCE-LEAK finding."""
+    src = open(os.path.join(REPO, "dynamo_tpu/kv_router/router.py")).read()
+    assert _PR13_FIX in src, "router.py drifted: update the revert fixture"
+    fixture = tmp_path / "dynamo_tpu" / "kv_router" / "router.py"
+    fixture.parent.mkdir(parents=True)
+    fixture.write_text(src.replace(_PR13_FIX, _PR13_REVERTED))
+    modules, parse = core.load_modules([str(tmp_path)])
+    found = [
+        f for f in core.collect_findings(modules, parse)
+        if f.rule == "RESOURCE-LEAK"
+    ]
+    assert any(
+        "_active" in f.message and "schedule_tokens" in f.message for f in found
+    ), found
+    baseline = core.load_baseline(core.DEFAULT_BASELINE)
+    for f in found:
+        assert f.baseline_key() not in baseline
+    # the LIVE tree (fix present) is clean
+    _m, _p, live_findings = repo_analysis
+    assert [
+        f for f in live_findings
+        if f.rule == "RESOURCE-LEAK" and f.path.startswith("dynamo_tpu/kv_router/")
+    ] == []
+
+
+_PR10_FIX = "                self._reclaim_leases(stream_leases)\n"
+_PR10_REVERTED = "                pass  # (reverted) leases bleed until SLOT_LEASE_S expiry\n"
+
+
+def test_reverting_pr10_lease_reclaim_fix_is_redetected(tmp_path, repo_analysis):
+    """Reverting the PR 10 stream-exit lease reclaim (the finally that
+    drops a dead stream's unfreed arena leases) must surface as a
+    non-baselined RESOURCE-LEAK finding on _handle_stream."""
+    src = open(os.path.join(REPO, "dynamo_tpu/engine/transfer.py")).read()
+    assert src.count(_PR10_FIX) == 1, "transfer.py drifted: update the revert fixture"
+    fixture = tmp_path / "dynamo_tpu" / "engine" / "transfer.py"
+    fixture.parent.mkdir(parents=True)
+    fixture.write_text(src.replace(_PR10_FIX, _PR10_REVERTED))
+    modules, parse = core.load_modules([str(tmp_path)])
+    found = [
+        f for f in core.collect_findings(modules, parse)
+        if f.rule == "RESOURCE-LEAK"
+    ]
+    assert any(
+        "arena-lease" in f.message and "_handle_stream" in f.message
+        for f in found
+    ), found
+    baseline = core.load_baseline(core.DEFAULT_BASELINE)
+    for f in found:
+        assert f.baseline_key() not in baseline
+    # the LIVE tree (fix present) is clean
+    _m, _p, live_findings = repo_analysis
+    assert [
+        f for f in live_findings
+        if f.rule == "RESOURCE-LEAK" and f.path.startswith("dynamo_tpu/engine/")
+    ] == []
+
+
+# ---------------------------------------------------------------------------
+# LOCK-ACROSS-AWAIT fixtures
+# ---------------------------------------------------------------------------
+
+def test_lock_across_await_direct_and_interprocedural(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/plane.py", """
+        import asyncio
+
+        class C:
+            async def direct(self, peer):
+                async with self._lock:
+                    await peer.round_trip({"op": "x"})
+
+            async def _dial(self):
+                await asyncio.open_connection("h", 1)
+
+            async def transitive(self):
+                async with self._lock:
+                    await self._dial()
+
+            async def fine(self):
+                async with self._lock:
+                    self.counter += 1
+                await self._dial()
+        """,
+        rule="LOCK-ACROSS-AWAIT",
+    )
+    assert sorted(f.line for f in found) == [7, 14]
+    assert all("holding self._lock" in f.message for f in found)
+
+
+def test_lock_across_await_implicit_suspensions(tmp_path):
+    # async for / async with suspend without an ast.Await node: the
+    # streamed-transfer shape under a lock must still flag
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/plane3.py", """
+        class C:
+            async def stream_under_lock(self, client):
+                async with self._lock:
+                    async for w in client._pull_stream(self.req):
+                        self.got.append(w)
+
+            async def ctx_under_lock(self, client):
+                async with self._sem:
+                    async with client.round_trip(self.req) as resp:
+                        return resp
+        """,
+        rule="LOCK-ACROSS-AWAIT",
+    )
+    assert sorted(f.line for f in found) == [5, 10]
+
+
+def test_lock_across_await_sleep_and_nonlock_with_pass(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/plane2.py", """
+        import asyncio
+
+        class C:
+            async def paced(self):
+                async with self._lock:
+                    await asyncio.sleep(0.1)
+
+            async def not_a_lock(self, peer):
+                async with self.tracer.span("x"):
+                    await peer.round_trip({})
+        """,
+        rule="LOCK-ACROSS-AWAIT",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# TASK-JOIN fixtures
+# ---------------------------------------------------------------------------
+
+def test_task_join_unjoined_class_task_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/svc.py", """
+        import asyncio
+
+        class Leaky:
+            def start(self):
+                self._task = asyncio.create_task(self._loop())
+
+            async def _loop(self):
+                pass
+        """,
+        rule="TASK-JOIN",
+    )
+    assert len(found) == 1
+    assert "self._task" in found[0].message and "Leaky.start" in found[0].message
+
+
+def test_task_join_unrelated_await_is_not_a_join(tmp_path):
+    # an await of something ELSE next to a guard that loads the task attr
+    # must not count as joining it — the stop()-that-stops-everything-but-
+    # the-task shape
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/svc3.py", """
+        import asyncio
+
+        class StillLeaky:
+            def start(self):
+                self._t = asyncio.create_task(self._loop())
+
+            async def stop(self):
+                if self._t is not None:
+                    await self._server.stop()
+        """,
+        rule="TASK-JOIN",
+    )
+    assert len(found) == 1 and "self._t" in found[0].message
+
+
+def test_task_join_cancel_await_gather_and_helper_pass(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/svc2.py", """
+        import asyncio
+
+        def _stop_task(t):
+            if t is not None:
+                t.cancel()
+
+        class Cancelled:
+            def start(self):
+                self._task = asyncio.create_task(self._loop())
+
+            def stop(self):
+                self._task.cancel()
+
+        class Awaited:
+            def start(self):
+                self._task = asyncio.create_task(self._loop())
+
+            async def stop(self):
+                await self._task
+
+        class Looped:
+            def start(self):
+                self._a = asyncio.create_task(self._loop())
+                self._b = asyncio.create_task(self._loop())
+
+            def stop(self):
+                for t in [self._a, self._b]:
+                    t.cancel()
+
+        class ViaHelper:
+            def start(self):
+                self._task = asyncio.create_task(self._loop())
+
+            def stop(self):
+                _stop_task(self._task)
+        """,
+        rule="TASK-JOIN",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# ENV-DRIFT fixtures
+# ---------------------------------------------------------------------------
+
+_ENV_CATALOG = """
+    ENV_LOG = "DTPU_LOG"
+    ENV_DEAD = "DTPU_DEAD_KNOB"
+    ENV_RETRY_DEFAULT = "DTPU_RETRY_DEFAULT"
+"""
+
+
+def test_env_drift_unregistered_read_and_dead_entry(tmp_path):
+    (tmp_path / "dynamo_tpu" / "runtime").mkdir(parents=True)
+    (tmp_path / "dynamo_tpu" / "runtime" / "config.py").write_text(
+        textwrap.dedent(_ENV_CATALOG)
+    )
+    found = analyze(
+        tmp_path, "dynamo_tpu/svc.py", """
+        import os
+
+        LEVEL = os.environ.get("DTPU_LOG")
+        ROGUE = os.environ.get("DTPU_ROGUE_KNOB")
+        SCOPED = os.environ.get("DTPU_RETRY_" + "TRANSFER")
+        PREFIX_OK = "DTPU_RETRY_"
+        """,
+        rule="ENV-DRIFT",
+    )
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 2, found
+    assert any("DTPU_ROGUE_KNOB" in m and "register" in m for m in msgs)
+    assert any("ENV_DEAD" in m and "zero read sites" in m for m in msgs)
+
+
+def test_env_drift_clean_catalog_and_prefix_reads(tmp_path):
+    (tmp_path / "dynamo_tpu" / "runtime").mkdir(parents=True)
+    (tmp_path / "dynamo_tpu" / "runtime" / "config.py").write_text(
+        'ENV_LOG = "DTPU_LOG"\nENV_RETRY_DEFAULT = "DTPU_RETRY_DEFAULT"\n'
+    )
+    found = analyze(
+        tmp_path, "dynamo_tpu/svc.py", """
+        import os
+
+        LEVEL = os.environ.get("DTPU_LOG")
+        DEFAULTS = os.environ.get("DTPU_RETRY_" "DEFAULT")
+        PREFIX = "DTPU_RETRY_"
+        """,
+        rule="ENV-DRIFT",
+    )
+    assert found == []
+
+
+def test_env_drift_skipped_without_catalog_module(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/svc.py",
+        'import os\nX = os.environ.get("DTPU_WHATEVER")\n',
+        rule="ENV-DRIFT",
+    )
+    assert found == []
+
+
+def test_env_drift_current_tree_clean(repo_analysis):
+    _m, _p, findings = repo_analysis
+    assert [f for f in findings if f.rule == "ENV-DRIFT"] == []
+
+
+# ---------------------------------------------------------------------------
+# FAULTS-DRIFT fixtures
+# ---------------------------------------------------------------------------
+
+_FAULTS_MOD = """
+    FAULT_POINTS = (
+        "plane.send",
+        "plane.recv",
+    )
+"""
+_DOCS = """\
+# ops
+
+Fault-point catalog: `plane.send`, `plane.ghost`.
+
+other text
+"""
+
+
+def test_faults_drift_all_directions(tmp_path):
+    (tmp_path / "dynamo_tpu" / "runtime").mkdir(parents=True)
+    (tmp_path / "dynamo_tpu" / "runtime" / "faults.py").write_text(
+        textwrap.dedent(_FAULTS_MOD)
+    )
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "operations.md").write_text(_DOCS)
+    found = analyze(
+        tmp_path, "dynamo_tpu/plane.py", """
+        from .runtime.faults import FAULTS
+
+        async def send(wid):
+            await FAULTS.ainject("plane.send")          # cataloged + documented
+            await FAULTS.ainject("plane.rogue")         # nowhere
+            await FAULTS.ainject(f"sim.worker.{wid}")   # dynamic: skipped
+            await FAULTS.ainject("sim.worker.static")   # sim family: skipped
+        """,
+        rule="FAULTS-DRIFT",
+    )
+    msgs = "\n".join(f.message for f in found)
+    # plane.rogue: armed but missing from BOTH catalogs (2 findings)
+    assert msgs.count("'plane.rogue'") == 2
+    # plane.recv: cataloged in code, never armed, not in docs (2 findings)
+    assert "'plane.recv' has no inject/mangle site" in msgs
+    assert "'plane.recv' is missing from the docs" in msgs
+    # plane.ghost: documented but not in FAULT_POINTS
+    assert "'plane.ghost'" in msgs and "prune the doc row" in msgs
+    assert len(found) == 5, found
+
+
+def test_faults_drift_current_tree_clean(repo_analysis):
+    _m, _p, findings = repo_analysis
+    assert [f for f in findings if f.rule == "FAULTS-DRIFT"] == []
+
+
+# ---------------------------------------------------------------------------
+# current-tree pins for the lifecycle rules
+# ---------------------------------------------------------------------------
+
+def test_lock_across_await_current_tree_exactly_baselined(repo_analysis):
+    """The live tree carries exactly the four deliberate frame-atomicity
+    drains (per-connection write locks + the netstore multiplexed-send
+    lock), all baselined; anything new fails the gate."""
+    _m, _p, findings = repo_analysis
+    found = [f for f in findings if f.rule == "LOCK-ACROSS-AWAIT"]
+    assert len(found) == 4, found
+    assert all("drain()" in f.message for f in found)
+    paths = {f.path for f in found}
+    assert paths == {
+        "dynamo_tpu/runtime/discovery/netstore.py",
+        "dynamo_tpu/runtime/request_plane/tcp.py",
+    }
+    baseline = core.load_baseline(core.DEFAULT_BASELINE)
+    for f in found:
+        assert f.baseline_key() in baseline
+
+
+def test_task_join_and_resource_leak_current_tree_clean(repo_analysis):
+    _m, _p, findings = repo_analysis
+    assert [
+        f for f in findings if f.rule in ("TASK-JOIN", "RESOURCE-LEAK")
+    ] == []
+
+
+# ---------------------------------------------------------------------------
+# --sarif
+# ---------------------------------------------------------------------------
+
+def test_sarif_output_schema_pinned(tmp_path):
+    fixture = tmp_path / "j.py"
+    fixture.write_text("import time\nasync def h():\n    time.sleep(1)\n")
+    r = run_cli([str(fixture), "--no-baseline", "--sarif"])
+    assert r.returncode == 1
+    obj = json.loads(r.stdout)
+    assert obj["version"] == "2.1.0"
+    assert obj["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = obj["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tools.analysis"
+    rule_ids = [rule["id"] for rule in driver["rules"]]
+    assert "ASYNC-BLOCKING" in rule_ids
+    result = next(
+        x for x in run["results"] if x["ruleId"] == "ASYNC-BLOCKING"
+    )
+    assert result["level"] == "error"
+    assert result["ruleIndex"] == rule_ids.index("ASYNC-BLOCKING")
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("j.py")
+    assert loc["region"]["startLine"] == 3
+    assert result["message"]["text"]
+
+    clean = tmp_path / "ok.py"
+    fixture.unlink()
+    clean.write_text("X = 1\n")
+    r2 = run_cli([str(tmp_path), "--no-baseline", "--sarif"])
+    assert r2.returncode == 0
+    obj2 = json.loads(r2.stdout)
+    assert obj2["runs"][0]["results"] == []
+
+    r3 = run_cli([str(clean), "--sarif", "--json"])
+    assert r3.returncode == 2 and "mutually exclusive" in r3.stderr
+
+
+# ---------------------------------------------------------------------------
+# --changed-only
+# ---------------------------------------------------------------------------
+
+def test_changed_only_scopes_to_git_changed_files():
+    """An untracked file with a violation is picked up; the analyzer does
+    not walk the rest of the tree (a whole-tree rule like UNUSED-METRIC's
+    zero-site direction is skipped on partial runs)."""
+    fixture = os.path.join(REPO, "tests", "_changed_only_fixture_tmp.py")
+    try:
+        with open(fixture, "w") as f:
+            f.write("import time\nasync def h():\n    time.sleep(1)\n")
+        r = run_cli(["tests", "--changed-only", "--no-baseline",
+                     "--select", "ASYNC-BLOCKING"])
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "_changed_only_fixture_tmp.py" in r.stdout
+    finally:
+        os.unlink(fixture)
+    # the clean-gated tree stays clean under --changed-only (baseline honored)
+    r = run_cli(["dynamo_tpu", "--changed-only"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    # rewriting the baseline from a partial view is refused
+    r = run_cli(["dynamo_tpu", "--changed-only", "--write-baseline"])
+    assert r.returncode == 2 and "whole tree" in r.stderr

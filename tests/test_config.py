@@ -58,3 +58,48 @@ def test_bad_env_value_falls_back(monkeypatch):
 def test_truthy():
     assert is_truthy("1") and is_truthy("True") and is_truthy("on")
     assert not is_truthy("0") and not is_truthy(None) and not is_truthy("nope")
+
+
+def test_env_catalog_knobs_reach_their_defaults(monkeypatch):
+    """The ENV-DRIFT cleanup wired the previously-dead catalog entries to
+    their natural defaults: env configures what callers leave open, and an
+    explicit value always wins."""
+    # DTPU_MIGRATION_LIMIT applies at the worker CLI boundary only: an
+    # explicit migration_limit=0 (migration disabled) must stay 0 even
+    # with the fleet env set
+    monkeypatch.setenv("DTPU_MIGRATION_LIMIT", "4")
+    from dynamo_tpu.llm.migration import Migration
+    from dynamo_tpu.runtime.config import ENV_MIGRATION_LIMIT, env_int
+
+    async def _send(req, ctx, excluded):  # pragma: no cover — never called
+        raise AssertionError
+
+    assert Migration(_send, migration_limit=0).migration_limit == 0
+    assert Migration(_send, migration_limit=2).migration_limit == 2
+    assert env_int(ENV_MIGRATION_LIMIT, 0) == 4  # the CLI default's source
+
+    monkeypatch.setenv("DTPU_CANARY_WAIT_TIME", "0.25")
+    from dynamo_tpu.runtime.health import EndpointCanary, StatusServer
+
+    assert EndpointCanary({}).interval_s == 0.25
+    assert EndpointCanary({}, interval_s=3.0).interval_s == 3.0
+
+    monkeypatch.setenv("DTPU_SYSTEM_HOST", "127.0.0.9")
+    from dynamo_tpu.runtime.health import HealthState
+
+    assert StatusServer(HealthState()).host == "127.0.0.9"
+    assert StatusServer(HealthState(), host="0.0.0.0").host == "0.0.0.0"
+
+    monkeypatch.setenv("DTPU_ROUTER_REPLICA_SYNC", "1")
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+
+    assert KvRouterConfig().replica_sync is True
+    assert KvRouterConfig(replica_sync=False).replica_sync is False
+
+    monkeypatch.setenv("DTPU_KV_BLOCK_SIZE", "32")
+    from dynamo_tpu.engine.engine import TpuEngineConfig
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    model = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=1)
+    assert TpuEngineConfig(model).block_size == 32
+    assert TpuEngineConfig(model, block_size=8).block_size == 8

@@ -542,3 +542,49 @@ def test_paged_extend_attention_matches_per_row():
             np.testing.assert_allclose(
                 np.asarray(got[b]), ref, rtol=2e-5, atol=2e-5
             )
+
+
+def test_stop_transfer_server_rides_spawn_bg(monkeypatch):
+    """stop() hands the transfer-server shutdown to runtime/tasks.spawn_bg:
+    the task is pinned against GC (the loop only weak-refs tasks) and a
+    FAILED stop is logged instead of silently vanishing with the frame —
+    the TASK-JOIN shape the analyzer flagged on the old stored-attr spawn."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.runtime import tasks as task_mod
+
+    errors = []
+    monkeypatch.setattr(
+        task_mod.log, "error",
+        lambda msg, *a: errors.append(msg % a if a else msg),
+    )
+
+    class _Exec:
+        def shutdown(self, wait=False):
+            pass
+
+    async def run():
+        stopped = asyncio.Event()
+
+        class _GoodServer:
+            async def stop(self, timeout):
+                stopped.set()
+
+        ns = SimpleNamespace(
+            _loop_task=None, _transfer_server=_GoodServer(),
+            _kv_transfer_srv=None, transfer_address=None,
+            _executor=_Exec(), _fetch_executor=_Exec(), _prep=None, _mh=None,
+        )
+        TpuEngine.stop(ns)
+        await asyncio.wait_for(stopped.wait(), 2.0)
+
+        class _BadServer:
+            async def stop(self, timeout):
+                raise RuntimeError("transfer server stop died")
+
+        ns._transfer_server = _BadServer()
+        TpuEngine.stop(ns)
+        await asyncio.sleep(0.05)
+        assert any("background task failed" in e for e in errors), errors
+
+    asyncio.run(run())

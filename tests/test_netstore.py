@@ -169,3 +169,77 @@ def test_client_reconnects_after_server_restart():
             await server2.stop()
 
     _run(run())
+
+
+def test_connect_happens_outside_the_send_lock(monkeypatch):
+    """When the store is down/slow to dial, pending ops must NOT queue
+    single-file behind one OS-timeout-scale connect attempt under the send
+    lock (the LOCK-ACROSS-AWAIT shape the analyzer found): the dial runs
+    under a dedicated connect lock, deduplicated, with the send lock free."""
+
+    async def run():
+        store = TcpKVStore("127.0.0.1:9")
+        dialing = asyncio.Event()
+        release = asyncio.Event()
+        connects = 0
+
+        class _FakeWriter:
+            def write(self, b):
+                pass
+
+            async def drain(self):
+                pass
+
+            def close(self):
+                pass
+
+        class _FakeReader:
+            async def readexactly(self, n):
+                await asyncio.Event().wait()  # park the rx loop forever
+
+        async def fake_open(host, port):
+            nonlocal connects
+            connects += 1
+            dialing.set()
+            await release.wait()
+            return _FakeReader(), _FakeWriter()
+
+        monkeypatch.setattr(asyncio, "open_connection", fake_open)
+        t1 = asyncio.create_task(store._call({"op": "get", "key": "a"}))
+        t2 = asyncio.create_task(store._call({"op": "get", "key": "b"}))
+        await dialing.wait()
+        await asyncio.sleep(0.01)
+        # mid-dial: the SEND lock is free — a connected peer could proceed
+        assert not store._lock.locked()
+        # and the dial is deduplicated behind the connect lock
+        assert store._connect_lock.locked()
+        release.set()
+        await asyncio.sleep(0.05)
+        assert connects == 1, "double-checked connect must dial once"
+        # answer both rids so the calls complete normally
+        for rid, fut in list(store._pending.items()):
+            if not fut.done():
+                fut.set_result({"rid": rid, "value": b"x"})
+        r1, r2 = await asyncio.gather(t1, t2)
+        assert r1["value"] == b"x" and r2["value"] == b"x"
+        await store.close()
+
+    _run(run())
+
+
+def test_call_surfaces_sever_between_ensure_and_send(monkeypatch):
+    """A connection severed after _ensure but before the send lock raises
+    ConnectionError (the same transport loss a mid-drain sever produces),
+    so _call_retry's policy reconnects on the next attempt."""
+
+    async def run():
+        store = TcpKVStore("127.0.0.1:9")
+
+        async def fake_ensure():
+            pass  # pretend connected, but leave _writer None (severed)
+
+        monkeypatch.setattr(store, "_ensure", fake_ensure)
+        with pytest.raises(ConnectionError):
+            await store._call({"op": "get", "key": "a"})
+
+    _run(run())

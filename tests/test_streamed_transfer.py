@@ -347,6 +347,29 @@ async def test_cancelled_stream_releases_window_leases():
         eng.stop()
 
 
+async def test_failed_native_gather_reclaims_leases(monkeypatch):
+    """One-shot native serve whose arena gather dies mid-flight must drop
+    its leases immediately: the client never learns the slot numbers, so
+    nothing else would free them until SLOT_LEASE_S expiry — the stream-
+    exit reclaim (PR 10), applied to the blocking branch. Found by the
+    analyzer's RESOURCE-LEAK pass."""
+    eng, srv, hashes = await _native_stream_server()
+    try:
+        async def boom(block_ids, slots):
+            raise RuntimeError("device gather died")
+
+        monkeypatch.setattr(srv, "_gather_into_arena", boom)
+        gen = srv.handle({"hashes": hashes, "native_ok": True}, None)
+        with pytest.raises(RuntimeError):
+            async for _ in gen:
+                pass
+        # every lease the failed serve took is reclaimed, and the pinned
+        # prefix refs were dropped by the existing finally
+        assert not srv._slot_lease, srv._slot_lease
+    finally:
+        eng.stop()
+
+
 async def test_clean_stream_keeps_leases_for_client_free():
     """A half-consumed-but-cleanly-finished stream must NOT yank the last
     window's slots out from under the client: leases survive the eof and

@@ -108,16 +108,30 @@ class Module:
 
 
 class Context:
-    """Everything a pass may look at: the parsed module set."""
+    """Everything a pass may look at: the parsed module set, plus the
+    lazily-built interprocedural engine (flows.py) shared by every pass
+    that needs the call graph or dataflow CFGs. ``partial`` marks
+    --changed-only runs: cross-file zero-site checks (a catalog entry
+    nothing reads) are skipped because absence can only be proven against
+    the whole tree."""
 
-    def __init__(self, modules: List[Module]):
+    def __init__(self, modules: List[Module], partial: bool = False):
         self.modules = modules
+        self.partial = partial
+        self._flows = None
 
     def module(self, suffix: str) -> Optional[Module]:
         for m in self.modules:
             if m.path.endswith(suffix):
                 return m
         return None
+
+    def flows(self):
+        if self._flows is None:
+            from . import flows
+
+            self._flows = flows.build(self.modules)
+        return self._flows
 
 
 # -- pass registry -----------------------------------------------------------
@@ -152,7 +166,7 @@ def rule_ids() -> List[str]:
 def _load_builtin_passes() -> None:
     # deferred so core is importable without the pass modules (and so the
     # shim can import pieces without triggering registration twice)
-    from . import asyncpass, legacy, purity  # noqa: F401  # dtpu: ignore[UNUSED-IMPORT] — imported for @register side effects
+    from . import asyncpass, drift, legacy, lifecycle, purity  # noqa: F401  # dtpu: ignore[UNUSED-IMPORT] — imported for @register side effects
 
 
 # -- module loading ----------------------------------------------------------
@@ -304,10 +318,11 @@ def collect_findings(
     modules: List[Module],
     parse_findings: List[Finding],
     select: Optional[Iterable[str]] = None,
+    partial: bool = False,
 ) -> List[Finding]:
     """Run every registered pass once over the shared Context; honor inline
     ignores. ``select`` filters by RULE id (not pass name)."""
-    ctx = Context(modules)
+    ctx = Context(modules, partial=partial)
     by_path = {m.path: m for m in modules}
     findings: List[Finding] = list(parse_findings)
     for name, (fn, _doc) in sorted(registered_passes().items()):
@@ -328,13 +343,77 @@ def collect_findings(
     return kept
 
 
+def changed_files(paths: List[str]) -> List[str]:
+    """The git-diff-scoped .py file set under ``paths`` (worktree +
+    staged + untracked), for --changed-only runs. Catalog anchor files
+    (config/metrics/faults) ride along so the cross-file passes that key
+    on them still see their catalogs."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD", "--"],
+            capture_output=True, text=True, timeout=30, cwd=REPO_ROOT,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, timeout=30, cwd=REPO_ROOT,
+        )
+        if diff.returncode != 0 or untracked.returncode != 0:
+            raise AnalysisError(
+                f"--changed-only needs a git checkout: "
+                f"{(diff.stderr or untracked.stderr).strip()}"
+            )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise AnalysisError(f"--changed-only could not run git: {e}")
+    # resolve relative paths against the repo root when they don't exist
+    # relative to the cwd — and refuse paths that exist in neither, exactly
+    # like a normal run (a wrong working directory must not silently pass
+    # the gate having matched nothing)
+    roots = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if not os.path.exists(ap) and not os.path.isabs(p):
+            ap = os.path.join(REPO_ROOT, p)
+        if not os.path.exists(ap):
+            raise AnalysisError(f"no such file or directory: {p}")
+        roots.append(ap)
+    changed = []
+    names = set(diff.stdout.splitlines()) | set(untracked.stdout.splitlines())
+    anchors = (
+        "dynamo_tpu/runtime/config.py",
+        "dynamo_tpu/runtime/metrics.py",
+        "dynamo_tpu/runtime/faults.py",
+    )
+    for rel in sorted(names | set(anchors)):
+        if not rel.endswith(".py") or rel.endswith("_pb2.py"):
+            continue
+        ap = os.path.join(REPO_ROOT, rel)
+        if not os.path.isfile(ap):
+            continue  # deleted files have no source to analyze
+        in_scope = any(
+            ap == r or ap.startswith(r + os.sep) for r in roots
+        )
+        if in_scope or rel in anchors:
+            changed.append(ap)
+    return changed
+
+
 def run(
     paths: List[str],
     baseline_path: Optional[str] = DEFAULT_BASELINE,
     select: Optional[Iterable[str]] = None,
+    changed_only: bool = False,
 ) -> RunResult:
-    modules, parse_findings = load_modules(paths)
-    findings = collect_findings(modules, parse_findings, select)
+    if changed_only:
+        files = changed_files(paths)
+        if not files:
+            return RunResult(new=[], suppressed=[], stale=Counter(), total_raw=0)
+        modules, parse_findings = load_modules(files)
+        findings = collect_findings(modules, parse_findings, select, partial=True)
+    else:
+        modules, parse_findings = load_modules(paths)
+        findings = collect_findings(modules, parse_findings, select)
     if baseline_path:
         baseline = load_baseline(baseline_path)
         new, suppressed, stale = apply_baseline(findings, baseline)
@@ -382,6 +461,67 @@ def render_json(result: RunResult) -> str:
     )
 
 
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+SARIF_VERSION = "2.1.0"
+
+
+def render_sarif(result: RunResult) -> str:
+    """SARIF 2.1.0 for code-scanning surfaces: one run, one rule object per
+    rule id that fired, one result per non-baselined finding."""
+    _load_builtin_passes()
+    descriptions = {}
+    for name, (fn, doc) in registered_passes().items():
+        for rid in getattr(fn, "RULES", (name,)):
+            descriptions[rid] = doc
+    fired = sorted({f.rule for f in result.new})
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {
+                "text": descriptions.get(rid, rid)
+            },
+        }
+        for rid in fired
+    ]
+    rule_index = {rid: i for i, rid in enumerate(fired)}
+    results = []
+    for f in result.new:
+        region = {"startLine": f.line} if f.line else {"startLine": 1}
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": region,
+                },
+            }],
+        })
+    return json.dumps(
+        {
+            "$schema": SARIF_SCHEMA,
+            "version": SARIF_VERSION,
+            "runs": [{
+                "tool": {
+                    "driver": {
+                        "name": "tools.analysis",
+                        "informationUri":
+                            "docs/development.md",
+                        "rules": rules,
+                    },
+                },
+                "results": results,
+            }],
+        },
+        indent=2,
+    )
+
+
 def main(argv: List[str]) -> int:
     import argparse
 
@@ -392,6 +532,12 @@ def main(argv: List[str]) -> int:
     )
     ap.add_argument("paths", nargs="*", default=None)
     ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 output for code-scanning surfaces")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="analyze only git-changed files under the given "
+                         "paths (baseline still applies; whole-tree "
+                         "zero-site checks are skipped)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="FILE")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, including baselined ones")
@@ -411,6 +557,17 @@ def main(argv: List[str]) -> int:
         paths = ns.paths or [os.path.join(REPO_ROOT, "dynamo_tpu")]
         select = [s.strip() for s in ns.select.split(",")] if ns.select else None
         baseline = None if ns.no_baseline else ns.baseline
+        if ns.json and ns.sarif:
+            print("error: --json and --sarif are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        if ns.write_baseline and ns.changed_only:
+            print(
+                "error: --write-baseline needs the whole tree; a "
+                "--changed-only rewrite would drop every unchanged file's "
+                "entries", file=sys.stderr,
+            )
+            return 2
         if ns.write_baseline:
             if select is not None:
                 # write_baseline REPLACES the file; under --select that would
@@ -427,11 +584,17 @@ def main(argv: List[str]) -> int:
             write_baseline(ns.baseline, findings)
             print(f"wrote {len(findings)} finding(s) to {ns.baseline}")
             return 0
-        result = run(paths, baseline_path=baseline, select=select)
+        result = run(
+            paths, baseline_path=baseline, select=select,
+            changed_only=ns.changed_only,
+        )
     except AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    text = render_json(result) if ns.json else render_text(result, ns.verbose)
+    if ns.sarif:
+        text = render_sarif(result)
+    else:
+        text = render_json(result) if ns.json else render_text(result, ns.verbose)
     if text:
         print(text)
     return result.exit_code

@@ -1,0 +1,280 @@
+"""Catalog-drift passes: the env-var and fault-point catalogs must match
+the code that reads/arms them, in both directions.
+
+- ENV-DRIFT: every ``DTPU_*`` name read in dynamo_tpu/ must be registered
+  as an ``ENV_*`` constant in the runtime/config.py catalog (the single
+  source of truth for knob names), and every catalog entry must have at
+  least one read site — a knob nobody reads is documentation lying in
+  wait. Names ending in ``_`` are scope PREFIXES (DTPU_RETRY_<SCOPE>,
+  DTPU_CB_<SCOPE>): they pass when the catalog carries an entry under that
+  prefix.
+- FAULTS-DRIFT: every fault point armed in code (literal first argument of
+  ``FAULTS.inject/ainject/mangle``) must appear in runtime/faults.py's
+  ``FAULT_POINTS`` catalog AND in the docs/operations.md fault-point
+  catalog paragraph, and vice versa. Dynamically-named points (the sim's
+  per-worker ``sim.worker.<id>`` family) are skipped — only literals are
+  checkable.
+
+Both zero-site directions are skipped on partial (--changed-only) runs:
+absence can only be proven against the whole tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import REPO_ROOT, Context, Finding, register
+
+_ENV_NAME_RE = re.compile(r"^DTPU_[A-Z0-9_]+$")
+_CONFIG_SUFFIX = "runtime/config.py"
+_FAULTS_SUFFIX = "runtime/faults.py"
+
+
+# ---------------------------------------------------------------------------
+# ENV-DRIFT
+# ---------------------------------------------------------------------------
+
+def _env_literals(tree: ast.AST) -> List[Tuple[str, int]]:
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _ENV_NAME_RE.match(node.value)
+        ):
+            out.append((node.value, node.lineno))
+    return out
+
+
+def _catalog_entries(tree: ast.AST) -> Dict[str, Tuple[str, int]]:
+    """value -> (constant name, line) for every ``ENV_X = "DTPU_..."``
+    module-level assignment in the config catalog."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.startswith("ENV_")
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.value.value] = (node.targets[0].id, node.lineno)
+    return out
+
+
+@register("env-drift", "DTPU_* reads vs the runtime/config ENV catalog, both ways")
+def _env_drift_pass(ctx: Context) -> Iterator[Finding]:
+    config = next(
+        (m for m in ctx.modules if m.path.endswith(_CONFIG_SUFFIX)), None
+    )
+    if config is None:
+        return
+    catalog = _catalog_entries(config.tree)
+    names = set(catalog)
+    prefixes = tuple(n for n in names if n.endswith("_"))
+    # direction 1: reads outside the catalog
+    reads: Dict[str, int] = {}  # name -> count of read sites outside config
+    const_refs: Set[str] = set()  # ENV_* constant names referenced elsewhere
+    for m in ctx.modules:
+        if "dynamo_tpu/" not in m.path:
+            continue
+        in_config = m.path == config.path
+        for var, line in _env_literals(m.tree):
+            if not in_config:
+                reads[var] = reads.get(var, 0) + 1
+            if in_config:
+                continue
+            if var in names:
+                continue
+            if var.endswith("_"):
+                # a scope prefix passes when the catalog has an entry
+                # under it (DTPU_RETRY_ -> DTPU_RETRY_DEFAULT)
+                if any(n.startswith(var) for n in names):
+                    continue
+            elif any(var.startswith(p) for p in prefixes):
+                continue
+            yield Finding(
+                "ENV-DRIFT", m.path, line,
+                f"{var} is read outside the runtime/config ENV catalog — "
+                f"register it as an ENV_* constant in "
+                f"dynamo_tpu/runtime/config.py and document the knob in "
+                f"docs/operations.md",
+            )
+        if not in_config:
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Name) and node.id.startswith("ENV_"):
+                    const_refs.add(node.id)
+                elif isinstance(node, ast.Attribute) and node.attr.startswith("ENV_"):
+                    const_refs.add(node.attr)
+    # direction 2: catalog entries nothing reads (whole-tree runs only)
+    if getattr(ctx, "partial", False):
+        return
+    # reads INSIDE config.py (from_env wiring) count too
+    config_refs: Set[str] = set()
+    for node in ast.walk(config.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id.startswith("ENV_"):
+                config_refs.add(node.id)
+    # a read of a scope-prefix literal ("DTPU_RETRY_" + scope) covers every
+    # catalog entry under that prefix — resilience builds its layered
+    # DTPU_RETRY_<SCOPE>/DTPU_CB_<SCOPE> names this way
+    read_prefixes = tuple(v for v in reads if v.endswith("_"))
+    for value, (const, line) in sorted(catalog.items()):
+        if value.endswith("_"):
+            continue  # prefix namespaces are read by construction
+        if reads.get(value):
+            continue
+        if const in const_refs or const in config_refs:
+            continue
+        if any(value.startswith(p) for p in read_prefixes):
+            continue
+        yield Finding(
+            "ENV-DRIFT", config.path, line,
+            f"catalog entry {const} = \"{value}\" has zero read sites in "
+            f"the scanned tree — wire it or drop it",
+        )
+
+
+_env_drift_pass.RULES = ("ENV-DRIFT",)
+
+
+# ---------------------------------------------------------------------------
+# FAULTS-DRIFT
+# ---------------------------------------------------------------------------
+
+_INJECT_METHODS = ("inject", "ainject", "mangle")
+
+
+def _fault_points_catalog(tree: ast.AST) -> Tuple[Set[str], int]:
+    """Entries of the module-level FAULT_POINTS tuple + its line."""
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "FAULT_POINTS"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            vals = {
+                el.value for el in node.value.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            }
+            return vals, node.lineno
+    return set(), 0
+
+
+def _armed_points(tree: ast.AST) -> List[Tuple[str, int]]:
+    """Literal point names passed to FAULTS.inject/ainject/mangle (any
+    receiver whose trailing name is FAULTS). Non-literal args (f-strings,
+    helper calls) are dynamic families and skipped."""
+    out = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _INJECT_METHODS
+        ):
+            continue
+        recv = node.func.value
+        recv_name = (
+            recv.id if isinstance(recv, ast.Name)
+            else recv.attr if isinstance(recv, ast.Attribute) else None
+        )
+        if recv_name != "FAULTS":
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str
+        ):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+_DOCS_CATALOG_RE = re.compile(r"Fault-point catalog:(.*?)(?:\n\n|\Z)", re.S)
+_BACKTICK_RE = re.compile(r"`([a-z_]+(?:\.[a-z_]+)+)`")
+
+
+def _docs_catalog(docs_path: str) -> Optional[Set[str]]:
+    """Backticked point names in the docs 'Fault-point catalog:' paragraph;
+    None when the docs file or the paragraph is missing."""
+    if not os.path.isfile(docs_path):
+        return None
+    with open(docs_path, encoding="utf-8") as f:
+        text = f.read()
+    m = _DOCS_CATALOG_RE.search(text)
+    if m is None:
+        return None
+    return set(_BACKTICK_RE.findall(m.group(1)))
+
+
+def _docs_path_for(faults_module_path: str) -> str:
+    """docs/operations.md for the tree containing this runtime/faults.py —
+    the repo's own docs for in-repo runs, the fixture tree's for tests."""
+    ap = faults_module_path
+    if not os.path.isabs(ap):
+        ap = os.path.join(REPO_ROOT, ap)
+    # <root>/dynamo_tpu/runtime/faults.py -> <root>/docs/operations.md
+    root = os.path.dirname(os.path.dirname(os.path.dirname(ap)))
+    return os.path.join(root, "docs", "operations.md")
+
+
+@register("faults-drift", "armed fault points vs code + docs catalogs, both ways")
+def _faults_drift_pass(ctx: Context) -> Iterator[Finding]:
+    faults = next(
+        (m for m in ctx.modules if m.path.endswith(_FAULTS_SUFFIX)), None
+    )
+    if faults is None:
+        return
+    code_catalog, catalog_line = _fault_points_catalog(faults.tree)
+    docs = _docs_catalog(_docs_path_for(faults.path))
+    armed: Dict[str, Tuple[str, int]] = {}  # point -> (path, line)
+    for m in ctx.modules:
+        if "dynamo_tpu/" not in m.path or m.path == faults.path:
+            continue
+        for point, line in _armed_points(m.tree):
+            armed.setdefault(point, (m.path, line))
+    for point, (path, line) in sorted(armed.items()):
+        if point.startswith(("sim.", "test.")):
+            continue  # sim/test-local families are deliberately uncataloged
+        if point not in code_catalog:
+            yield Finding(
+                "FAULTS-DRIFT", path, line,
+                f"fault point '{point}' is armed in code but missing from "
+                f"runtime/faults.py FAULT_POINTS — add it to the catalog",
+            )
+        if docs is not None and point not in docs:
+            yield Finding(
+                "FAULTS-DRIFT", path, line,
+                f"fault point '{point}' is armed in code but missing from "
+                f"the docs/operations.md fault-point catalog — add the "
+                f"catalog entry so operators can arm it",
+            )
+    if getattr(ctx, "partial", False):
+        return
+    for point in sorted(code_catalog):
+        if point not in armed:
+            yield Finding(
+                "FAULTS-DRIFT", faults.path, catalog_line,
+                f"FAULT_POINTS entry '{point}' has no inject/mangle site "
+                f"in the scanned tree — wire it or drop it",
+            )
+        if docs is not None and point not in docs:
+            yield Finding(
+                "FAULTS-DRIFT", faults.path, catalog_line,
+                f"FAULT_POINTS entry '{point}' is missing from the "
+                f"docs/operations.md fault-point catalog",
+            )
+    if docs is not None:
+        for point in sorted(docs - code_catalog):
+            yield Finding(
+                "FAULTS-DRIFT", faults.path, catalog_line,
+                f"docs/operations.md catalogs fault point '{point}' which "
+                f"is not in runtime/faults.py FAULT_POINTS — prune the doc "
+                f"row or register the point",
+            )
+
+
+_faults_drift_pass.RULES = ("FAULTS-DRIFT",)

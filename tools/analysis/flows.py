@@ -1,0 +1,696 @@
+"""Interprocedural dataflow engine: function index, module-level call graph,
+and a lowered per-function control-flow graph with a forward worklist solver.
+
+The PR 7 passes are per-function and syntactic; the resource-lifecycle rules
+(lifecycle.py) need to see *paths*: a lease acquired here must reach a
+release on every way out of the function, including the except/finally edges
+and the generator-exit edge a cancelled stream consumer takes. This module
+provides the three shared pieces, built once per run on the single-parse
+Context (``Context.flows()`` caches the result):
+
+- :class:`FunctionIndex` — every ``def``/``async def`` in the module set,
+  keyed ``(module_path, qualname)``; methods carry their class, nested defs
+  their ``outer.<locals>.inner`` qualname, decorated defs are indexed like
+  any other (the decorator does not hide the body).
+- :class:`CallGraph` — resolved call edges (same-module functions,
+  ``self.method()`` within a class, imported names incl. relative imports,
+  nested defs) plus *reference* edges for callables passed as values
+  (``functools.partial(fn, ...)``, spawn/executor arguments). Cycles are
+  fine everywhere: closures are computed with iterative worklists.
+- :func:`build_cfg` — statement-level CFG for one function. Modeled edges:
+  if/else (with ``x is None`` narrowing on assume nodes), loops,
+  break/continue, try/except/finally (exception edges from every statement
+  in a ``try`` body to its handlers and — unless a broad handler catches —
+  onward through the finally chain to the exit), return/raise routed
+  through enclosing ``finally`` blocks, and generator-exit edges: in a
+  generator every ``yield`` may be the last statement that ever runs
+  (the consumer abandons the stream), so each yield gets an abrupt edge
+  through the finally chain to the exit. Awaits are deliberately NOT
+  treated as exits: modeling cancellation at every await point drowns the
+  signal (see docs/development.md for the model's contract).
+- :func:`forward` — generic monotone forward dataflow (worklist to
+  fixpoint; loops and cycles converge because states only grow).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+
+# ---------------------------------------------------------------------------
+# function index
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: str                      # normalized module path
+    qualname: str                    # "Class.method", "func", "f.<locals>.g"
+    cls: Optional[str]               # owning class name (methods only)
+    node: ast.AST                    # FunctionDef | AsyncFunctionDef
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module, self.qualname)
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+    @property
+    def is_generator(self) -> bool:
+        return _contains_yield(self.node)
+
+    @property
+    def params(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+def _contains_yield(fn: ast.AST) -> bool:
+    for node in _walk_shallow(fn):
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            return True
+    return False
+
+
+def _walk_shallow(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function/class
+    scopes (those are separate FuncInfos)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class FunctionIndex:
+    def __init__(self) -> None:
+        self.by_key: Dict[Tuple[str, str], FuncInfo] = {}
+        # module -> {simple name -> [FuncInfo]} for top-level defs
+        self.top_level: Dict[str, Dict[str, FuncInfo]] = {}
+        # (module, class) -> {method name -> FuncInfo}
+        self.methods: Dict[Tuple[str, str], Dict[str, FuncInfo]] = {}
+        # (module, owner qualname) -> {nested def name -> FuncInfo}
+        self.nested: Dict[Tuple[str, str], Dict[str, FuncInfo]] = {}
+
+    def add_module(self, path: str, tree: ast.AST) -> None:
+        self.top_level.setdefault(path, {})
+
+        def visit(node: ast.AST, qual: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{qual}.<locals>.{child.name}" if qual and not cls else (
+                        f"{cls}.{child.name}" if cls else child.name
+                    )
+                    fi = FuncInfo(path, q, cls, child)
+                    self.by_key[fi.key] = fi
+                    if not qual and not cls:
+                        self.top_level[path][child.name] = fi
+                    elif cls and not qual.count(".<locals>."):
+                        self.methods.setdefault((path, cls), {})[child.name] = fi
+                    if qual or cls:
+                        owner = qual if qual else cls
+                        self.nested.setdefault((path, owner or ""), {})[child.name] = fi
+                    # descend for nested defs; inside a function, class
+                    # context no longer applies to bare-name resolution
+                    visit(child, q, None)
+                elif isinstance(child, ast.ClassDef):
+                    # methods: qual stays empty at module level
+                    if not qual and cls is None:
+                        visit(child, "", child.name)
+                    else:
+                        visit(child, qual or cls or "", None)
+                else:
+                    visit(child, qual, cls)
+
+        visit(tree, "", None)
+
+    def functions(self) -> Iterable[FuncInfo]:
+        return self.by_key.values()
+
+
+# ---------------------------------------------------------------------------
+# import resolution
+# ---------------------------------------------------------------------------
+
+def _dotted(path: str) -> str:
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".").lstrip(".")
+
+
+def _resolve_relative(module_path: str, level: int, target: Optional[str]) -> str:
+    parts = _dotted(module_path).split(".")
+    # package of this module = everything but the file component
+    pkg = parts[:-1]
+    if level > 1:
+        pkg = pkg[: len(pkg) - (level - 1)]
+    return ".".join(pkg + ([target] if target else []))
+
+
+def _import_map(module_path: str, tree: ast.AST) -> Dict[str, Tuple[str, Optional[str]]]:
+    """local name -> (dotted module, object name | None). Object None means
+    the name IS a module alias (``import a.b as c``)."""
+    out: Dict[str, Tuple[str, Optional[str]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = (a.name, None)
+                else:
+                    out[a.name.split(".")[0]] = (a.name.split(".")[0], None)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level:
+                mod = _resolve_relative(module_path, node.level, node.module)
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = (mod, a.name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# call graph
+# ---------------------------------------------------------------------------
+
+class CallGraph:
+    """Resolved call + reference edges over the FunctionIndex."""
+
+    def __init__(self, index: FunctionIndex, modules: List) -> None:
+        self.index = index
+        self.calls: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self.refs: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self._imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        self._module_by_dotted: Dict[str, str] = {}
+        for m in modules:
+            self._imports[m.path] = _import_map(m.path, m.tree)
+            self._module_by_dotted[_dotted(m.path)] = m.path
+        for fi in index.functions():
+            self._scan(fi)
+
+    # -- resolution ----------------------------------------------------------
+    def _module_for(self, dotted: str) -> Optional[str]:
+        hit = self._module_by_dotted.get(dotted)
+        if hit is not None:
+            return hit
+        suffix = "." + dotted
+        for d, p in self._module_by_dotted.items():
+            if d.endswith(suffix):
+                return p
+        return None
+
+    def resolve(self, func_expr: ast.AST, caller: FuncInfo) -> Optional[FuncInfo]:
+        """Best-effort resolution of a call's func expression to a FuncInfo.
+        Covers: nested defs in the caller, same-module top-level functions,
+        ``self.method()``, imported names, and module-alias attribute calls.
+        Unresolvable callees return None (callers must treat them as opaque:
+        they neither release nor acquire anything)."""
+        if isinstance(func_expr, ast.Name):
+            name = func_expr.id
+            nested = self.index.nested.get((caller.module, caller.qualname), {})
+            if name in nested:
+                return nested[name]
+            top = self.index.top_level.get(caller.module, {})
+            if name in top:
+                return top[name]
+            imp = self._imports.get(caller.module, {}).get(name)
+            if imp is not None:
+                mod_dotted, obj = imp
+                if obj is not None:
+                    mpath = self._module_for(mod_dotted)
+                    if mpath is not None:
+                        return self.index.top_level.get(mpath, {}).get(obj)
+            return None
+        if isinstance(func_expr, ast.Attribute):
+            base = func_expr.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls") and caller.cls is not None:
+                    return self.index.methods.get(
+                        (caller.module, caller.cls), {}
+                    ).get(func_expr.attr)
+                imp = self._imports.get(caller.module, {}).get(base.id)
+                if imp is not None and imp[1] is None:
+                    mpath = self._module_for(imp[0])
+                    if mpath is not None:
+                        return self.index.top_level.get(mpath, {}).get(func_expr.attr)
+        return None
+
+    def _resolve_ref(self, expr: ast.AST, caller: FuncInfo) -> Optional[FuncInfo]:
+        """A bare function REFERENCE (not a call): partial targets,
+        callbacks handed to spawn/executor calls."""
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self.resolve(expr, caller)
+        return None
+
+    @staticmethod
+    def _is_partial(call: ast.Call) -> bool:
+        f = call.func
+        return (isinstance(f, ast.Name) and f.id == "partial") or (
+            isinstance(f, ast.Attribute) and f.attr == "partial"
+        )
+
+    def _scan(self, fi: FuncInfo) -> None:
+        calls = self.calls.setdefault(fi.key, set())
+        refs = self.refs.setdefault(fi.key, set())
+        for node in _walk_shallow(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve(node.func, fi)
+            if callee is not None:
+                calls.add(callee.key)
+            if self._is_partial(node) and node.args:
+                target = self._resolve_ref(node.args[0], fi)
+                if target is not None:
+                    refs.add(target.key)
+            else:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        target = self._resolve_ref(arg, fi)
+                        if target is not None:
+                            refs.add(target.key)
+
+    # -- closures ------------------------------------------------------------
+    def callees(self, key: Tuple[str, str], include_refs: bool = False) -> Set[Tuple[str, str]]:
+        out = set(self.calls.get(key, ()))
+        if include_refs:
+            out |= self.refs.get(key, set())
+        return out
+
+    def closure_calling(
+        self, seeds: Iterable[Tuple[str, str]], include_refs: bool = True
+    ) -> Set[Tuple[str, str]]:
+        """All function keys that (transitively, through call or reference
+        edges) reach any seed — including the seeds. Cycle-safe."""
+        seed_set = set(seeds)
+        rev: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for src, dsts in self.calls.items():
+            for d in dsts:
+                rev.setdefault(d, set()).add(src)
+        if include_refs:
+            for src, dsts in self.refs.items():
+                for d in dsts:
+                    rev.setdefault(d, set()).add(src)
+        out = set(seed_set)
+        work = deque(seed_set)
+        while work:
+            cur = work.popleft()
+            for caller in rev.get(cur, ()):
+                if caller not in out:
+                    out.add(caller)
+                    work.append(caller)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Flows: the per-run bundle
+# ---------------------------------------------------------------------------
+
+class Flows:
+    def __init__(self, modules: List) -> None:
+        self.modules = modules
+        self.index = FunctionIndex()
+        for m in modules:
+            self.index.add_module(m.path, m.tree)
+        self.graph = CallGraph(self.index, modules)
+
+    def functions_in(self, path_pred: Callable[[str], bool]) -> Iterator[FuncInfo]:
+        for fi in self.index.functions():
+            if path_pred(fi.module):
+                yield fi
+
+
+def build(modules: List) -> Flows:
+    return Flows(modules)
+
+
+# ---------------------------------------------------------------------------
+# control-flow graph
+# ---------------------------------------------------------------------------
+
+# node kinds
+ENTRY, EXIT, STMT, ASSUME, LOOP_HEAD = "entry", "exit", "stmt", "assume", "loop"
+
+_BROAD_EXC = ("Exception", "BaseException")
+
+
+@dataclasses.dataclass
+class CfgNode:
+    kind: str
+    node: Optional[ast.AST]               # the statement / test expr
+    meta: Dict = dataclasses.field(default_factory=dict)
+
+
+class Cfg:
+    def __init__(self) -> None:
+        self.nodes: List[CfgNode] = [CfgNode(ENTRY, None), CfgNode(EXIT, None)]
+        self.succ: List[Set[int]] = [set(), set()]
+
+    ENTRY_ID = 0
+    EXIT_ID = 1
+
+    def new(self, kind: str, node: Optional[ast.AST], **meta) -> int:
+        self.nodes.append(CfgNode(kind, node, meta))
+        self.succ.append(set())
+        return len(self.nodes) - 1
+
+    def edge(self, a: int, b: int) -> None:
+        self.succ[a].add(b)
+
+    def connect(self, frontier: Iterable[int], b: int) -> None:
+        for a in frontier:
+            self.edge(a, b)
+
+    def preds(self) -> List[Set[int]]:
+        out: List[Set[int]] = [set() for _ in self.nodes]
+        for a, dsts in enumerate(self.succ):
+            for b in dsts:
+                out[b].add(a)
+        return out
+
+
+def _narrowing(test: ast.AST) -> Optional[Tuple[str, str]]:
+    """(var, kind) for tests the dataflow can narrow on: ``x is None`` ->
+    (x, 'is_none'), ``x is not None`` -> (x, 'not_none'), bare ``x`` ->
+    (x, 'truthy'), ``not x`` -> (x, 'falsy')."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = _narrowing(test.operand)
+        if inner is None:
+            return None
+        var, kind = inner
+        flip = {"is_none": "not_none", "not_none": "is_none",
+                "truthy": "falsy", "falsy": "truthy"}
+        return (var, flip[kind])
+    if isinstance(test, ast.Name):
+        return (test.id, "truthy")
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.left, ast.Name)
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ):
+        if isinstance(test.ops[0], ast.Is):
+            return (test.left.id, "is_none")
+        if isinstance(test.ops[0], ast.IsNot):
+            return (test.left.id, "not_none")
+    return None
+
+
+def _has_broad_handler(t: ast.Try) -> bool:
+    for h in t.handlers:
+        if h.type is None:
+            return True
+        types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        for ty in types:
+            if isinstance(ty, ast.Name) and ty.id in _BROAD_EXC:
+                return True
+    return False
+
+
+class _CfgBuilder:
+    def __init__(self, fn: ast.AST):
+        self.cfg = Cfg()
+        self.is_gen = _contains_yield(fn)
+        self.finally_stack: List[int] = []        # entry node of each finally
+        self.loop_stack: List[Tuple[int, List[int]]] = []  # (head, break_frontier)
+        self.try_handlers: List[Tuple[List[int], bool]] = []  # (entries, broad)
+        # finally entries some abrupt exit actually flows INTO: only those
+        # finallys continue outward after running — a finally entered purely
+        # by normal flow must not grow a phantom edge past the code after it
+        self._abrupt_used: Set[int] = set()
+        frontier = self.lower_body(fn.body, {Cfg.ENTRY_ID})
+        self.cfg.connect(frontier, Cfg.EXIT_ID)
+
+    # the innermost finally entry (or EXIT) an abrupt exit flows to
+    def abrupt_target(self) -> int:
+        return self.finally_stack[-1] if self.finally_stack else Cfg.EXIT_ID
+
+    def abrupt_edge(self, idx: int) -> None:
+        tgt = self.abrupt_target()
+        self.cfg.edge(idx, tgt)
+        if self.finally_stack and tgt == self.finally_stack[-1]:
+            self._abrupt_used.add(tgt)
+
+    def _exception_edges(self, idx: int) -> None:
+        """A SUSPENDING statement inside a try body may abort: edge to each
+        handler and (unless a broad handler catches everything) onward to
+        the abrupt target. Only awaits/yields generate these edges — they
+        are where cancellation and consumer-abandonment really strike, and
+        modeling every conceivable sync raise drowns the rules in paths no
+        scheduler ever takes (the model's contract in docs/development.md)."""
+        if not self.try_handlers:
+            return
+        entries, broad = self.try_handlers[-1]
+        for h in entries:
+            self.cfg.edge(idx, h)
+        if not broad:
+            self.abrupt_edge(idx)
+
+    def _stmt_node(self, stmt: ast.AST, frontier: Set[int], **meta) -> int:
+        idx = self.cfg.new(STMT, stmt, **meta)
+        self.cfg.connect(frontier, idx)
+        suspends = any(
+            isinstance(n, (ast.Await, ast.Yield, ast.YieldFrom))
+            for n in ast.walk(stmt)
+        )
+        if suspends:
+            self._exception_edges(idx)
+        if self.is_gen and any(
+            isinstance(n, (ast.Yield, ast.YieldFrom)) for n in ast.walk(stmt)
+        ):
+            # generator-exit: the consumer may abandon the stream at this
+            # yield — GeneratorExit runs the finally chain and leaves
+            self.abrupt_edge(idx)
+        return idx
+
+    def lower_body(self, body: List[ast.stmt], frontier: Set[int]) -> Set[int]:
+        for stmt in body:
+            if not frontier:
+                break  # unreachable after return/raise/break
+            frontier = self.lower_stmt(stmt, frontier)
+        return frontier
+
+    def lower_stmt(self, stmt: ast.stmt, frontier: Set[int]) -> Set[int]:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            test = self._stmt_node(stmt.test, frontier)
+            narrow = _narrowing(stmt.test)
+            a_true = cfg.new(ASSUME, stmt.test, narrow=narrow, branch=True)
+            a_false = cfg.new(ASSUME, stmt.test, narrow=narrow, branch=False)
+            cfg.edge(test, a_true)
+            cfg.edge(test, a_false)
+            out_t = self.lower_body(stmt.body, {a_true})
+            out_f = self.lower_body(stmt.orelse, {a_false})
+            return out_t | out_f
+        if isinstance(stmt, ast.While):
+            head = self._stmt_node(stmt.test, frontier)
+            narrow = _narrowing(stmt.test)
+            a_true = cfg.new(ASSUME, stmt.test, narrow=narrow, branch=True)
+            a_false = cfg.new(ASSUME, stmt.test, narrow=narrow, branch=False)
+            cfg.edge(head, a_true)
+            cfg.edge(head, a_false)
+            breaks: List[int] = []
+            self.loop_stack.append((head, breaks))
+            body_out = self.lower_body(stmt.body, {a_true})
+            self.loop_stack.pop()
+            cfg.connect(body_out, head)
+            # while/else runs on every non-break exit; break skips it
+            if stmt.orelse:
+                return self.lower_body(stmt.orelse, {a_false}) | set(breaks)
+            return {a_false} | set(breaks)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            head = self._stmt_node(
+                stmt.iter, frontier, for_target=stmt.target, for_iter=stmt.iter
+            )
+            breaks = []
+            self.loop_stack.append((head, breaks))
+            body_out = self.lower_body(stmt.body, {head})
+            self.loop_stack.pop()
+            cfg.connect(body_out, head)
+            # for/else runs only on exhaustion; break skips it
+            out = {head}
+            if stmt.orelse:
+                out = self.lower_body(stmt.orelse, out)
+            return out | set(breaks)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            idx = self._stmt_node(stmt, frontier, with_items=stmt.items)
+            return self.lower_body(stmt.body, {idx})
+        if isinstance(stmt, ast.Try):
+            return self._lower_try(stmt, frontier)
+        if isinstance(stmt, ast.Return):
+            idx = self._stmt_node(stmt, frontier)
+            self.abrupt_edge(idx)
+            return set()
+        if isinstance(stmt, ast.Raise):
+            idx = self._stmt_node(stmt, frontier)
+            if self.try_handlers:
+                for h in self.try_handlers[-1][0]:
+                    cfg.edge(idx, h)
+            self.abrupt_edge(idx)
+            return set()
+        if isinstance(stmt, ast.Break):
+            idx = self._stmt_node(stmt, frontier)
+            if self.loop_stack:
+                self.loop_stack[-1][1].append(idx)
+            return set()
+        if isinstance(stmt, ast.Continue):
+            idx = self._stmt_node(stmt, frontier)
+            if self.loop_stack:
+                cfg.edge(idx, self.loop_stack[-1][0])
+            return set()
+        # plain statement (incl. nested defs, which the walk treats as
+        # opaque) — one node
+        return {self._stmt_node(stmt, frontier)}
+
+    def _lower_try(self, stmt: ast.Try, frontier: Set[int]) -> Set[int]:
+        cfg = self.cfg
+        # 1. lower the finally region first so body statements can target it
+        fin_entry: Optional[int] = None
+        fin_out: Set[int] = set()
+        if stmt.finalbody:
+            # join point; meta carries the finalbody so passes can treat a
+            # release site ANYWHERE inside the finally as reachable on every
+            # path through it (conditionals inside a finally usually key on
+            # how the block was entered — state the dataflow can't track)
+            fin_entry = cfg.new(STMT, None, finalbody=stmt.finalbody)
+            fin_out = self.lower_body(stmt.finalbody, {fin_entry})
+            self.finally_stack.append(fin_entry)
+        # 2. handlers
+        handler_entries: List[int] = []
+        handler_outs: Set[int] = set()
+        for h in stmt.handlers:
+            # entry binds the exception name; meta carries the handler body
+            # for the same coarse-kill treatment as finalbody (reclaim loops
+            # inside handlers iterate dynamic state the dataflow can't see)
+            h_entry = cfg.new(STMT, h, handlerbody=h.body)
+            handler_entries.append(h_entry)
+            handler_outs |= self.lower_body(h.body, {h_entry})
+        # 3. body with exception edges into the handlers
+        self.try_handlers.append((handler_entries, _has_broad_handler(stmt)))
+        body_out = self.lower_body(stmt.body, frontier)
+        self.try_handlers.pop()
+        if stmt.orelse:
+            body_out = self.lower_body(stmt.orelse, body_out)
+        merged = body_out | handler_outs
+        if fin_entry is not None:
+            self.finally_stack.pop()
+            cfg.connect(merged, fin_entry)
+            # only a finally some abrupt exit actually ENTERED continues
+            # outward after running — a finally reached purely by normal
+            # flow proceeds to the code after the try, nothing else
+            if fin_entry in self._abrupt_used:
+                outer = self.abrupt_target()
+                cfg.connect(fin_out, outer)
+                if self.finally_stack and outer == self.finally_stack[-1]:
+                    self._abrupt_used.add(outer)
+            return set(fin_out)
+        return merged
+
+
+def build_cfg(fn: ast.AST) -> Cfg:
+    """Statement-level CFG for one function node."""
+    return _CfgBuilder(fn).cfg
+
+
+# ---------------------------------------------------------------------------
+# forward dataflow
+# ---------------------------------------------------------------------------
+
+def forward(
+    cfg: Cfg,
+    init,
+    transfer: Callable[[int, CfgNode, object], object],
+    join: Callable[[object, object], object],
+    max_iter: int = 200000,
+):
+    """Worklist forward dataflow to fixpoint. Returns (state_in, state_out)
+    lists indexed by node id; unreachable nodes hold None."""
+    n = len(cfg.nodes)
+    preds = cfg.preds()
+    state_in: List = [None] * n
+    state_out: List = [None] * n
+    state_in[Cfg.ENTRY_ID] = init
+    state_out[Cfg.ENTRY_ID] = transfer(Cfg.ENTRY_ID, cfg.nodes[Cfg.ENTRY_ID], init)
+    work = deque(cfg.succ[Cfg.ENTRY_ID])
+    seen_iter = 0
+    while work:
+        seen_iter += 1
+        if seen_iter > max_iter:  # pragma: no cover — safety valve
+            break
+        idx = work.popleft()
+        acc = None
+        for p in preds[idx]:
+            if state_out[p] is None:
+                continue
+            acc = state_out[p] if acc is None else join(acc, state_out[p])
+        if acc is None:
+            continue
+        if state_in[idx] is not None:
+            acc = join(state_in[idx], acc)
+        if acc == state_in[idx]:
+            continue
+        state_in[idx] = acc
+        new_out = transfer(idx, cfg.nodes[idx], acc)
+        if new_out != state_out[idx]:
+            state_out[idx] = new_out
+            for s in cfg.succ[idx]:
+                work.append(s)
+    return state_in, state_out
+
+
+# ---------------------------------------------------------------------------
+# small shared helpers for the passes
+# ---------------------------------------------------------------------------
+
+def call_name_and_receiver(func_expr: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """('pop', '_slot_lease') for self._slot_lease.pop, ('f', None) for
+    f(...): the called name plus the trailing identifier of its receiver."""
+    if isinstance(func_expr, ast.Name):
+        return func_expr.id, None
+    if isinstance(func_expr, ast.Attribute):
+        base = func_expr.value
+        recv = None
+        if isinstance(base, ast.Name):
+            recv = base.id
+        elif isinstance(base, ast.Attribute):
+            recv = base.attr
+        return func_expr.attr, recv
+    return None, None
+
+
+def names_in(expr: ast.AST) -> Set[str]:
+    return {
+        n.id for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def target_names(target: ast.AST) -> List[str]:
+    """Flat Name targets of an assignment (tuples/lists unpacked; attribute
+    and subscript targets excluded — they are stores, not bindings)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in target.elts:
+            out.extend(target_names(el))
+        return out
+    if isinstance(target, ast.Starred):
+        return target_names(target.value)
+    return []

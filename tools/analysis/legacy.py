@@ -756,6 +756,8 @@ def unused_metric_names(parsed):
 
 @register("unused-metric", "catalog metric names with zero observation sites")
 def _unused_metric_pass(ctx: Context) -> Iterator[Finding]:
+    if getattr(ctx, "partial", False):
+        return  # zero-site checks need the whole tree (--changed-only)
     parsed = [(m.path, m.tree) for m in ctx.modules]
     for p, lineno, msg in unused_metric_names(parsed):
         yield Finding("UNUSED-METRIC", p, lineno, msg)

@@ -1,0 +1,786 @@
+"""Resource-lifecycle passes on the interprocedural engine (flows.py).
+
+- RESOURCE-LEAK: a declared acquire (resources.py) must reach a matching
+  release on every path out of the acquiring function, or be returned /
+  stored to a recognized owner — including the except/finally and
+  async-generator-exit edges. Function summaries make it interprocedural:
+  a helper that acquires and transfers the resource into a caller-supplied
+  list marks the caller's variable as the holder; a helper containing a
+  release site counts as a release at its call sites. The same rule also
+  enforces the owner-dict displacement discipline (ChargeSpec): storing
+  into a router charge table must release (or prove absent) the entry it
+  displaces — the PR 13 migration-retry leak.
+- LOCK-ACROSS-AWAIT: an asyncio.Lock/Semaphore held across an await that
+  (transitively, via the call graph) reaches a request-plane/transfer call
+  serializes every other holder behind one peer's latency — the breaker-
+  starvation shape ROADMAP item 1 worries about.
+- TASK-JOIN: the interprocedural extension of TASK-LIFECYCLE — a task
+  handle stored onto ``self`` escapes its frame, so GC can't kill it, but
+  nothing ever joins it either: some method of the owning class must
+  cancel/await/gather it (or hand it to a helper that does).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from . import flows as F
+from . import resources as R
+from .core import MUTATING_METHODS, Context, Finding, register
+
+# ---------------------------------------------------------------------------
+# RESOURCE-LEAK
+# ---------------------------------------------------------------------------
+
+_OWNER_MUTATORS = MUTATING_METHODS | {"extend"}
+
+
+@dataclasses.dataclass
+class _Summary:
+    releases: Set[str] = dataclasses.field(default_factory=set)
+    returns: Set[str] = dataclasses.field(default_factory=set)
+    # (param name, spec name): calling this function stores a fresh
+    # acquisition into the argument bound to that parameter
+    param_transfers: Set[Tuple[str, str]] = dataclasses.field(default_factory=set)
+
+    def as_tuple(self):
+        return (
+            frozenset(self.releases),
+            frozenset(self.returns),
+            frozenset(self.param_transfers),
+        )
+
+
+class _Token:
+    __slots__ = ("tid", "spec", "line", "desc")
+
+    def __init__(self, tid: int, spec: str, line: int, desc: str):
+        self.tid = tid
+        self.spec = spec
+        self.line = line
+        self.desc = desc
+
+
+class _State:
+    """(live token ids, var -> token ids). Join = pointwise union."""
+
+    __slots__ = ("live", "env")
+
+    def __init__(self, live: FrozenSet[int] = frozenset(), env=None):
+        self.live = live
+        self.env: Dict[str, FrozenSet[int]] = env or {}
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _State)
+            and self.live == other.live
+            and self.env == other.env
+        )
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def copy(self) -> "_State":
+        return _State(self.live, dict(self.env))
+
+
+def _join(a: _State, b: _State) -> _State:
+    env = dict(a.env)
+    for k, v in b.env.items():
+        env[k] = env.get(k, frozenset()) | v
+    return _State(a.live | b.live, env)
+
+
+def _receiver_matches(recv: Optional[str], hints: Tuple[str, ...]) -> bool:
+    if not hints:
+        return True
+    if recv is None:
+        return False
+    low = recv.lower()
+    return any(h in low for h in hints)
+
+
+def _iter_calls(node: ast.AST) -> Iterator[ast.Call]:
+    """Calls in a statement subtree, skipping nested def/lambda scopes
+    (executor closures run elsewhere)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _args_by_param(call: ast.Call, callee: F.FuncInfo) -> Dict[str, ast.AST]:
+    """Map callee parameter names to this call's argument expressions.
+    Method calls through an attribute receiver skip the leading ``self``."""
+    params = callee.params
+    if params and params[0] in ("self", "cls") and isinstance(call.func, ast.Attribute):
+        params = params[1:]
+    out: Dict[str, ast.AST] = {}
+    for i, arg in enumerate(call.args):
+        if i < len(params):
+            out[params[i]] = arg
+    for kw in call.keywords:
+        if kw.arg is not None:
+            out[kw.arg] = kw.value
+    return out
+
+
+class _FnLeakAnalysis:
+    """One function's forward leak dataflow against the active specs."""
+
+    def __init__(
+        self,
+        fi: F.FuncInfo,
+        specs: List[R.ResourceSpec],
+        fl: F.Flows,
+        summaries: Dict[Tuple[str, str], _Summary],
+    ):
+        self.fi = fi
+        self.specs = specs
+        self.flows = fl
+        self.summaries = summaries
+        self.cfg = F.build_cfg(fi.node)
+        self.tokens: Dict[int, _Token] = {}
+        self._next_tid = 0
+        self.summary = _Summary()
+        self.params = set(fi.params)
+        self._spec_by_name = {s.name: s for s in specs}
+        # token identity must be stable across dataflow iterations: key on
+        # the (cfg node, spec) acquire site
+        self._site_tokens: Dict[Tuple[int, str, str], int] = {}
+
+    # -- token helpers -------------------------------------------------------
+    def _token(self, node_idx: int, spec: str, desc: str, line: int) -> int:
+        key = (node_idx, spec, desc)
+        tid = self._site_tokens.get(key)
+        if tid is None:
+            tid = self._next_tid
+            self._next_tid += 1
+            self._site_tokens[key] = tid
+            self.tokens[tid] = _Token(tid, spec, line, desc)
+        return tid
+
+    def _tokens_of_expr(self, expr: ast.AST, st: _State) -> FrozenSet[int]:
+        out: Set[int] = set()
+        for name in F.names_in(expr):
+            out |= st.env.get(name, frozenset())
+        return frozenset(out)
+
+    def _kill_spec(self, st: _State, spec: str) -> None:
+        dead = {t for t in st.live if self.tokens[t].spec == spec}
+        if dead:
+            st.live = st.live - dead
+
+    def _discharge(self, st: _State, tokens: FrozenSet[int], via: str) -> None:
+        if not tokens:
+            return
+        st.live = st.live - tokens
+        if via == "return":
+            for t in tokens:
+                self.summary.returns.add(self.tokens[t].spec)
+
+    # -- the transfer function ----------------------------------------------
+    def transfer(self, idx: int, cnode: F.CfgNode, state: _State) -> _State:
+        st = state.copy()
+        if cnode.kind in (F.ENTRY, F.EXIT):
+            return st
+        if cnode.kind == F.ASSUME:
+            narrow = cnode.meta.get("narrow")
+            if narrow is not None:
+                var, kind = narrow
+                if not cnode.meta.get("branch"):
+                    kind = {
+                        "is_none": "not_none", "not_none": "is_none",
+                        "truthy": "falsy", "falsy": "truthy",
+                    }[kind]
+                if kind in ("is_none", "falsy"):
+                    held = st.env.get(var)
+                    if held:
+                        st.live = st.live - held
+                        st.env = dict(st.env)
+                        st.env[var] = frozenset()
+            return st
+        cleanup_body = cnode.meta.get("finalbody") or cnode.meta.get("handlerbody")
+        if cleanup_body is not None:
+            # a release site anywhere inside a finally/except block kills on
+            # every path through it: cleanup conditionals key on HOW the
+            # block was entered (clean-exit flags, reclaim loops over
+            # dynamic lease lists) — state the dataflow can't correlate
+            # with its own entry edges. Helper calls count via their
+            # summaries (the reclaim loop may be factored out).
+            for stmt in cleanup_body:
+                for call in _iter_calls(stmt):
+                    name, recv = F.call_name_and_receiver(call.func)
+                    for spec in self.specs:
+                        for rel_name, hints in spec.release:
+                            if name == rel_name and _receiver_matches(recv, hints):
+                                self._kill_spec(st, spec.name)
+                    callee = self.flows.graph.resolve(call.func, self.fi)
+                    if callee is not None:
+                        summ = self.summaries.get(callee.key)
+                        if summ is not None:
+                            for spec_name in summ.releases:
+                                self._kill_spec(st, spec_name)
+            return st
+        if "with_items" in cnode.meta:
+            # a With/AsyncWith HEAD evaluates only its context expressions —
+            # the body statements are their own CFG nodes (processing the
+            # whole subtree here would double-count every body call and
+            # strand phantom tokens on the head)
+            pending: Set[int] = set()
+            kills: Set[str] = set()
+            for item in cnode.meta["with_items"]:
+                for call in _iter_calls(item.context_expr):
+                    pending |= self._apply_call(idx, call, st, kills)
+            st.env = dict(st.env)
+            for item in cnode.meta["with_items"]:
+                if item.optional_vars is not None:
+                    toks = self._tokens_of_expr(item.context_expr, st) | frozenset(
+                        pending
+                    )
+                    for name in F.target_names(item.optional_vars):
+                        st.env[name] = toks
+            if pending:
+                st.live = st.live | frozenset(pending)
+            for spec in kills:
+                self._kill_spec(st, spec)
+                self.summary.releases.add(spec)
+            return st
+        node = cnode.node
+        if node is None:
+            return st
+        if isinstance(node, ast.ExceptHandler):
+            return st
+        self._apply_stmt(idx, node, cnode.meta, st)
+        return st
+
+    def _apply_stmt(self, idx: int, stmt: ast.AST, meta: Dict, st: _State) -> None:
+        pending: Set[int] = set()
+        kills: Set[str] = set()
+        for call in _iter_calls(stmt):
+            pending |= self._apply_call(idx, call, st, kills)
+        # statement-shape handling
+        if isinstance(stmt, ast.Assign):
+            value_tokens = self._tokens_of_expr(stmt.value, st) | frozenset(pending)
+            for tgt in stmt.targets:
+                self._bind_or_store(tgt, value_tokens, st)
+            pending.clear()
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            value_tokens = self._tokens_of_expr(stmt.value, st) | frozenset(pending)
+            self._bind_or_store(stmt.target, value_tokens, st)
+            pending.clear()
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._discharge(
+                    st, self._tokens_of_expr(stmt.value, st) | frozenset(pending),
+                    "return",
+                )
+            pending.clear()
+        elif isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, (ast.Yield, ast.YieldFrom)
+        ):
+            val = stmt.value.value
+            if val is not None:
+                self._discharge(
+                    st, self._tokens_of_expr(val, st) | frozenset(pending), "return"
+                )
+            pending.clear()
+        # for-loop heads derive the target from the iterated expression
+        if "for_target" in meta:
+            derived = self._tokens_of_expr(meta["for_iter"], st)
+            st.env = dict(st.env)
+            for name in F.target_names(meta["for_target"]):
+                st.env[name] = derived
+        # any yield expression used in an assignment etc. also hands its
+        # referenced tokens to the consumer
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Yield, ast.YieldFrom)) and n.value is not None:
+                self._discharge(st, self._tokens_of_expr(n.value, st), "return")
+        # leftover acquisitions bound to nothing stay live (leak candidates
+        # unless a release on the path kills them)
+        if pending:
+            st.live = st.live | frozenset(pending)
+        for spec in kills:
+            self._kill_spec(st, spec)
+            self.summary.releases.add(spec)
+
+    def _apply_call(
+        self, idx: int, call: ast.Call, st: _State, kills: Set[str]
+    ) -> Set[int]:
+        """Process one call: returns fresh token ids to bind; applies
+        releases/transfers in place."""
+        fresh: Set[int] = set()
+        name, recv = F.call_name_and_receiver(call.func)
+        if name is None:
+            return fresh
+        for spec in self.specs:
+            for rel_name, hints in spec.release:
+                if name == rel_name and _receiver_matches(recv, hints):
+                    kills.add(spec.name)
+            if spec.self_releasing:
+                continue
+            for acq_name, hints in spec.acquire:
+                if name == acq_name and _receiver_matches(recv, hints):
+                    t = self._token(
+                        idx, spec.name, f"{acq_name}()", call.lineno
+                    )
+                    st.live = st.live | {t}
+                    fresh.add(t)
+        # interprocedural: resolved callee summaries
+        callee = self.flows.graph.resolve(call.func, self.fi)
+        if callee is not None:
+            summ = self.summaries.get(callee.key)
+            if summ is not None:
+                for spec_name in summ.releases:
+                    kills.add(spec_name)
+                # one token per spec the callee hands out, even when it both
+                # returns the acquisition AND stores it into a caller-supplied
+                # container: those are two references to the SAME resource, so
+                # discharging either (yield the returned item, reclaim the
+                # list) discharges the acquisition
+                touched = {
+                    s for s in summ.returns if s in self._spec_by_name
+                } | {s for _p, s in summ.param_transfers if s in self._spec_by_name}
+                args = None
+                for spec_name in sorted(touched):
+                    t = self._token(
+                        idx, spec_name, f"{callee.name}()", call.lineno
+                    )
+                    st.live = st.live | {t}
+                    if spec_name in summ.returns:
+                        fresh.add(t)
+                    for pname, s in summ.param_transfers:
+                        if s != spec_name:
+                            continue
+                        if args is None:
+                            args = _args_by_param(call, callee)
+                        arg = args.get(pname)
+                        if isinstance(arg, ast.Name):
+                            st.env = dict(st.env)
+                            st.env[arg.id] = st.env.get(arg.id, frozenset()) | {t}
+        # ownership transfer: mutating call on an owner attribute or on a
+        # caller-supplied parameter
+        if name in _OWNER_MUTATORS and isinstance(call.func, ast.Attribute):
+            owner_attr = recv in self._all_owner_names()
+            owner_param = recv in self.params and recv not in ("self", "cls")
+            if owner_attr or owner_param:
+                moved: Set[int] = set()
+                for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                    moved |= self._tokens_of_expr(arg, st)
+                if moved:
+                    st.live = st.live - frozenset(moved)
+                    if owner_param:
+                        for t in moved:
+                            self.summary.param_transfers.add(
+                                (recv, self.tokens[t].spec)
+                            )
+        return fresh
+
+    def _all_owner_names(self) -> Set[str]:
+        out: Set[str] = set()
+        for s in self.specs:
+            out |= set(s.owners)
+        return out
+
+    def _bind_or_store(
+        self, tgt: ast.AST, value_tokens: FrozenSet[int], st: _State
+    ) -> None:
+        names = F.target_names(tgt)
+        if names:
+            st.env = dict(st.env)
+            for n in names:
+                st.env[n] = value_tokens
+            return
+        # attribute / subscript store: discharge when the base attribute is
+        # a declared owner
+        base = tgt
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Attribute) and base.attr in self._all_owner_names():
+            st.live = st.live - value_tokens
+
+    # -- run -----------------------------------------------------------------
+    def run(self) -> List[Tuple[int, str]]:
+        init = _State()
+        state_in, _state_out = F.forward(self.cfg, init, self.transfer, _join)
+        exit_state = state_in[F.Cfg.EXIT_ID]
+        findings: List[Tuple[int, str]] = []
+        if exit_state is None:
+            return findings
+        seen: Set[Tuple[str, str]] = set()
+        for t in sorted(exit_state.live):
+            tok = self.tokens[t]
+            spec = self._spec_by_name.get(tok.spec)
+            if spec is None:
+                continue
+            key = (tok.spec, tok.desc)
+            if key in seen:
+                continue
+            seen.add(key)
+            owners = "/".join(spec.owners) or "a declared owner"
+            findings.append((
+                tok.line,
+                f"{tok.spec} acquired via {tok.desc} in {self.fi.qualname}() "
+                f"can leave the function still held on some path out "
+                f"(counting except/finally and generator-exit edges) — "
+                f"release it, store it to {owners}, or return it to the "
+                f"caller; spec: tools/analysis/resources.py",
+            ))
+        return findings
+
+
+def _specs_for(path: str) -> List[R.ResourceSpec]:
+    return [
+        s for s in R.RESOURCES
+        if not s.self_releasing and any(p in path for p in s.paths)
+    ]
+
+
+def _charge_findings(path: str, tree: ast.AST) -> List[Tuple[int, str]]:
+    """ChargeSpec displacement discipline: ``self.<owner>[k] = v`` must be
+    preceded in the same function by a ``pop`` on the owner (release the
+    displaced charge) or a containment test on the owner (prove no
+    displacement)."""
+    out: List[Tuple[int, str]] = []
+    charges = [c for c in R.CHARGES if any(p in path for p in c.paths)]
+    if not charges:
+        return out
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(fn.name in c.exempt_functions for c in charges):
+            continue
+        # collect per-owner evidence lines: pops and containment tests
+        evidence: Dict[str, List[int]] = {}
+        stores: List[Tuple[int, str]] = []
+        for node in F._walk_shallow(fn):
+            for c in charges:
+                for owner in c.owner_attrs:
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "pop"
+                    ):
+                        _n, recv = F.call_name_and_receiver(node.func)
+                        if recv == owner:
+                            evidence.setdefault(owner, []).append(node.lineno)
+                    if isinstance(node, ast.Compare) and any(
+                        isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+                    ):
+                        for comp in node.comparators:
+                            base = comp
+                            if isinstance(base, ast.Attribute) and base.attr == owner:
+                                evidence.setdefault(owner, []).append(node.lineno)
+                    if isinstance(node, ast.Assign):
+                        for tgt in node.targets:
+                            if (
+                                isinstance(tgt, ast.Subscript)
+                                and isinstance(tgt.value, ast.Attribute)
+                                and tgt.value.attr == owner
+                            ):
+                                stores.append((node.lineno, owner))
+        for line, owner in stores:
+            c = next(c for c in charges if owner in c.owner_attrs)
+            if not any(ev <= line for ev in evidence.get(owner, [])):
+                out.append((
+                    line,
+                    f"{c.name}: store into self.{owner}[...] in {fn.name}() "
+                    f"may displace a live entry without releasing its "
+                    f"charge — pop the previous entry and {c.release} it "
+                    f"(or guard with a containment check) before "
+                    f"overwriting; spec: tools/analysis/resources.py",
+                ))
+    return out
+
+
+@register("resource-leak", "acquire/release pairing over interprocedural dataflow")
+def _resource_leak_pass(ctx: Context) -> Iterator[Finding]:
+    fl = ctx.flows()
+    # fixpoint over summaries: helpers' transfer/release effects must be
+    # visible at their call sites regardless of analysis order (cycles OK —
+    # summaries only grow)
+    summaries: Dict[Tuple[str, str], _Summary] = {}
+    scoped: List[Tuple[F.FuncInfo, List[R.ResourceSpec]]] = []
+    for m in ctx.modules:
+        specs = _specs_for(m.path)
+        if not specs:
+            continue
+        exempt = {name for s in specs for name in s.exempt_functions}
+        for fi in fl.functions_in(lambda p, mp=m.path: p == mp):
+            if fi.name in exempt:
+                continue
+            scoped.append((fi, specs))
+    results: List[Tuple[F.FuncInfo, List[Tuple[int, str]]]] = []
+    converged = False
+    for _round in range(4):
+        changed = False
+        results = []
+        for fi, specs in scoped:
+            a = _FnLeakAnalysis(fi, specs, fl, summaries)
+            results.append((fi, a.run()))
+            prev = summaries.get(fi.key)
+            if prev is None or prev.as_tuple() != a.summary.as_tuple():
+                summaries[fi.key] = a.summary
+                changed = True
+        if not changed:
+            # nothing moved this round, so every analysis already saw the
+            # settled summaries — its findings ARE the final findings
+            converged = True
+            break
+    if not converged:  # pragma: no cover — pathological summary churn
+        results = []
+        for fi, specs in scoped:
+            a = _FnLeakAnalysis(fi, specs, fl, summaries)
+            results.append((fi, a.run()))
+    for fi, found in results:
+        for line, msg in found:
+            yield Finding("RESOURCE-LEAK", fi.module, line, msg)
+    for m in ctx.modules:
+        for line, msg in _charge_findings(m.path, m.tree):
+            yield Finding("RESOURCE-LEAK", m.path, line, msg)
+
+
+_resource_leak_pass.RULES = ("RESOURCE-LEAK",)
+
+
+# ---------------------------------------------------------------------------
+# LOCK-ACROSS-AWAIT
+# ---------------------------------------------------------------------------
+
+_LOCK_HINTS = ("lock", "mutex", "sem", "cond")
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        node = node.func
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    return name is not None and any(h in name.lower() for h in _LOCK_HINTS)
+
+
+def _slow_closure(fl: F.Flows) -> Set[Tuple[str, str]]:
+    """Functions that (transitively) call something in SLOW_AWAIT_NAMES."""
+    seeds: Set[Tuple[str, str]] = set()
+    for fi in fl.index.functions():
+        for node in F._walk_shallow(fi.node):
+            if isinstance(node, ast.Call):
+                name, _recv = F.call_name_and_receiver(node.func)
+                if name in R.SLOW_AWAIT_NAMES:
+                    seeds.add(fi.key)
+                    break
+        else:
+            continue
+    return fl.graph.closure_calling(seeds)
+
+
+def _lock_across_await(
+    fi: F.FuncInfo, fl: F.Flows, slow: Set[Tuple[str, str]]
+) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+
+    def check_call(call: ast.AST, lineno: int, lock_key: str) -> None:
+        if not isinstance(call, ast.Call):
+            return
+        name, _recv = F.call_name_and_receiver(call.func)
+        slow_hit = name in R.SLOW_AWAIT_NAMES
+        if not slow_hit:
+            callee = fl.graph.resolve(call.func, fi)
+            slow_hit = callee is not None and callee.key in slow
+        if slow_hit:
+            out.append((
+                lineno,
+                f"await of {name}() while holding {lock_key} — a "
+                f"request/transfer-plane wait under an asyncio lock "
+                f"serializes every other holder behind one peer's "
+                f"latency (breaker-starvation shape); move the slow "
+                f"await outside the lock or scope the lock to the "
+                f"local mutation",
+            ))
+
+    def check_exprs(stmt: ast.stmt, lock_key: str) -> None:
+        """Awaits in THIS statement's own expressions (sub-statement bodies
+        are visited separately so nested locks rebind the key first)."""
+        for child in ast.iter_child_nodes(stmt):
+            if not isinstance(child, ast.expr):
+                continue
+            for node in ast.walk(child):
+                if isinstance(node, ast.Lambda):
+                    continue
+                if isinstance(node, ast.Await):
+                    check_call(node.value, node.lineno, lock_key)
+
+    def visit(stmts: List[ast.stmt], lock_key: Optional[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes scanned on their own
+            if isinstance(stmt, ast.AsyncWith):
+                locked = [
+                    i for i in stmt.items if _is_lock_expr(i.context_expr)
+                ]
+                if locked:
+                    try:
+                        key = ast.unparse(locked[0].context_expr)
+                    except Exception:  # pragma: no cover
+                        key = "<lock>"
+                    visit(stmt.body, key)
+                    continue
+                if lock_key is not None:
+                    # non-lock async context manager under a held lock: its
+                    # __aenter__ suspends with no ast.Await node
+                    for item in stmt.items:
+                        check_call(item.context_expr, stmt.lineno, lock_key)
+            if lock_key is not None and isinstance(stmt, ast.AsyncFor):
+                # the async iterator suspends at every __anext__ — the
+                # streamed-transfer shape (`async for w in pull_stream(...)`)
+                check_call(stmt.iter, stmt.lineno, lock_key)
+            if lock_key is not None:
+                check_exprs(stmt, lock_key)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    visit(sub, lock_key)
+            for h in getattr(stmt, "handlers", []):
+                visit(h.body, lock_key)
+
+    visit(fi.node.body, None)
+    return out
+
+
+@register("lock-across-await", "asyncio locks held across request/transfer-plane awaits")
+def _lock_across_await_pass(ctx: Context) -> Iterator[Finding]:
+    fl = ctx.flows()
+    slow = _slow_closure(fl)
+    for m in ctx.modules:
+        if not any(p in m.path for p in R.LOCK_AWAIT_PATHS):
+            continue
+        for fi in fl.functions_in(lambda p, mp=m.path: p == mp):
+            if not fi.is_async:
+                continue
+            for line, msg in _lock_across_await(fi, fl, slow):
+                yield Finding("LOCK-ACROSS-AWAIT", m.path, line, msg)
+
+
+_lock_across_await_pass.RULES = ("LOCK-ACROSS-AWAIT",)
+
+
+# ---------------------------------------------------------------------------
+# TASK-JOIN
+# ---------------------------------------------------------------------------
+
+def _is_task_spawn_call(call: ast.Call) -> bool:
+    name, recv = F.call_name_and_receiver(call.func)
+    if name in R.TASK_SPAWN_NAMES:
+        return True
+    if name == "spawn" and recv is not None and any(
+        h in recv.lower() for h in R.TASK_SPAWN_TRACKER_HINTS
+    ):
+        return True
+    return False
+
+
+def _loads_self_attr(node: ast.AST, attr: str) -> bool:
+    return any(
+        isinstance(n, ast.Attribute)
+        and n.attr == attr
+        and isinstance(n.value, ast.Name)
+        and n.value.id == "self"
+        and isinstance(getattr(n, "ctx", None), ast.Load)
+        for n in ast.walk(node)
+    )
+
+
+def _stmt_joins(stmt: ast.AST, attr: str, fl: F.Flows, fi: F.FuncInfo) -> bool:
+    """Does this statement's subtree both reference self.<attr> and apply a
+    join (an await OF the attr, or a cancel/gather/wait/shield call — direct
+    or through a resolved helper whose own body joins)?"""
+    if not _loads_self_attr(stmt, attr):
+        return False
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Await):
+            # an await only joins the task when the awaited expression
+            # references it — `await self._server.stop()` next to an
+            # `if self._t is not None` guard joins nothing
+            if _loads_self_attr(n.value, attr):
+                return True
+            continue
+        if isinstance(n, ast.Call):
+            name, _recv = F.call_name_and_receiver(n.func)
+            if name in R.TASK_JOIN_CALL_NAMES:
+                return True
+            callee = fl.graph.resolve(n.func, fi)
+            if callee is not None and any(
+                isinstance(c, ast.Call)
+                and F.call_name_and_receiver(c.func)[0] in R.TASK_JOIN_CALL_NAMES
+                for c in F._walk_shallow(callee.node)
+            ):
+                return True
+    return False
+
+
+@register("task-join", "class-held task handles with no shutdown join")
+def _task_join_pass(ctx: Context) -> Iterator[Finding]:
+    fl = ctx.flows()
+    for m in ctx.modules:
+        if "dynamo_tpu/" not in m.path:
+            continue
+        for cls in ast.walk(m.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [
+                n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            # attr -> (line, spawning method)
+            spawned: Dict[str, Tuple[int, str]] = {}
+            for meth in methods:
+                for node in F._walk_shallow(meth):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and _is_task_spawn_call(node.value)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                    ):
+                        spawned.setdefault(
+                            node.targets[0].attr, (node.lineno, meth.name)
+                        )
+            if not spawned:
+                continue
+            for attr, (line, meth_name) in sorted(spawned.items()):
+                joined = False
+                for meth in methods:
+                    fi = fl.index.by_key.get((m.path, f"{cls.name}.{meth.name}"))
+                    if fi is None:
+                        continue
+                    for stmt in F._walk_shallow(meth):
+                        if isinstance(stmt, ast.stmt) and _stmt_joins(
+                            stmt, attr, fl, fi
+                        ):
+                            joined = True
+                            break
+                    if joined:
+                        break
+                if not joined:
+                    yield Finding(
+                        "TASK-JOIN", m.path, line,
+                        f"task handle self.{attr} spawned in "
+                        f"{cls.name}.{meth_name}() is never cancelled/"
+                        f"awaited/gathered on any shutdown path of "
+                        f"{cls.name} — join it in stop/close, or don't "
+                        f"store it (runtime/tasks.spawn_bg already pins "
+                        f"and logs fire-and-forget work)",
+                    )
+
+
+_task_join_pass.RULES = ("TASK-JOIN",)
