@@ -785,6 +785,35 @@ async def main() -> None:
     stop = asyncio.Event()
     health = HealthState()
 
+    # planned reclaims (docs/operations.md §13): restore warm state from a
+    # prior drain's G3 checkpoint, then stand up the drain coordinator so a
+    # POST /drain (or supervisor call) runs the evacuate-and-checkpoint
+    # pipeline before the kill
+    from dynamo_tpu.engine.checkpoint import restore_engine, weights_ref_for
+    from dynamo_tpu.engine.drain import DrainCoordinator
+    from dynamo_tpu.runtime import metrics as M_
+    from dynamo_tpu.runtime.config import ENV_CKPT_DIR
+
+    ckpt_dir = env_str(ENV_CKPT_DIR, "") or None
+    if ckpt_dir:
+        restored = await restore_engine(engines[0], ckpt_dir)
+        tele_scope.gauge(
+            M_.CHECKPOINT_RESTORE_MODE,
+            "1 for the restore mode this worker booted with",
+            extra_labels=("mode",),
+        ).set(1, mode=restored["mode"])
+        print(
+            f"CHECKPOINT_RESTORE mode={restored['mode']} "
+            f"blocks={restored['blocks']}", flush=True,
+        )
+    drain_coordinator = DrainCoordinator(
+        engine, served,
+        ckpt_dir=ckpt_dir,
+        weights_ref=weights_ref_for(args.model_path or args.preset, mcfg),
+        metrics_scope=tele_scope,
+        on_drained=stop.set,
+    )
+
     async def on_down() -> None:
         stop.set()  # watchdog already deregistered; exit so a supervisor restarts
 
@@ -825,6 +854,7 @@ async def main() -> None:
                 (lambda: engines[0].lora.list_adapters())
                 if engines[0].lora is not None else None
             ),
+            drain_fn=drain_coordinator.begin,
         )
         await status_server.start()
     print(f"TPU_ENGINE_READY {args.model} tp={args.tp}", flush=True)

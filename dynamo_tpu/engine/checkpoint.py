@@ -1,0 +1,380 @@
+"""Engine checkpoint/restore through the G3 disk tier.
+
+The planned-death half of crash recovery (docs/operations.md §13): a worker
+that received a reclaim notice serializes its *warm* state — sealed KV pages
+in the exact dtype-headered block-file layout the G3 spill tier already uses
+(kvbm/pool.py), the allocator's radix/LRU hash order, a request-queue
+manifest, and the model weights by content-hash reference (engine/warm.py
+fingerprint — never a weight copy) — so its replacement restores warm
+instead of re-prefilling the fleet's working set from scratch. Analog of the
+reference's CRIU-based chrek checkpointer, minus the process image: we
+snapshot the state that is expensive to recompute, not the process.
+
+Crash consistency: block files land first (each one atomically via
+tmp+rename), the manifest rename is the single commit point. A death between
+block writes and the manifest commit leaves no ``MANIFEST.json`` — restore
+classifies that as a partial checkpoint and cold-boots instead of serving a
+torn snapshot. Restore validates the manifest structure and every block
+against the declared block format; any mismatch raises
+:class:`CheckpointCorrupt` (manifest) or stops the import (block), never
+imports garbage pages.
+
+No wall-clock reads here: the sim drives these functions under its virtual
+clock and pins same-seed byte identity, so manifests carry no timestamps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..kvbm.pool import _read_block_file, _write_block_file
+from ..runtime.config import ENV_CKPT_MAX_BLOCKS, env_int
+from ..runtime.faults import FAULTS
+from ..runtime.logging import get_logger
+
+log = get_logger("engine.checkpoint")
+
+MANIFEST_NAME = "MANIFEST.json"
+FORMAT_VERSION = 1
+_HASH_RE_WIDTH = 16  # hashes serialize as zero-padded 16-hex, like G3 files
+
+
+class CheckpointCorrupt(RuntimeError):
+    """Checkpoint failed validation — the caller must cold-boot, not serve it."""
+
+    code = "checkpoint_corrupt"
+
+
+def weights_ref_for(source: str, cfg: Any) -> str:
+    """Content-hash reference for the weights (engine/warm.py fingerprint:
+    checkpoint path + mtime + config + layout version). The checkpoint
+    stores this REFERENCE; restore re-resolves weights through the warm
+    cache / weight service rather than duplicating gigabytes per reclaim."""
+    from .warm import _fingerprint
+
+    return _fingerprint(source, cfg)
+
+
+class CheckpointWriter:
+    """Stages block files under ``<dir>/blocks/`` and commits the manifest
+    atomically. ``begin_manifest`` hands out a tmp-file handle that MUST be
+    discharged by ``commit_manifest`` or ``abort_manifest`` on every path —
+    the checkpoint-manifest ResourceSpec (tools/analysis/resources.py) holds
+    callers to that."""
+
+    def __init__(self, ckpt_dir: str, max_blocks: Optional[int] = None):
+        self.dir = ckpt_dir
+        self.blocks_dir = os.path.join(ckpt_dir, "blocks")
+        os.makedirs(self.blocks_dir, exist_ok=True)
+        self.max_blocks = (
+            env_int(ENV_CKPT_MAX_BLOCKS, 4096) if max_blocks is None else max_blocks
+        )
+        self.written: List[int] = []
+
+    def _block_file(self, h: int) -> str:
+        return os.path.join(self.blocks_dir, f"{h:016x}.kv")
+
+    def write_block(self, h: int, block: np.ndarray) -> bool:
+        """Durably write one sealed block; False once the cap is reached.
+        Atomic per block: a crash mid-write leaves only a tmp file the
+        manifest never references."""
+        if len(self.written) >= self.max_blocks:
+            return False
+        FAULTS.inject("checkpoint.write")
+        tmp = self._block_file(h) + f".tmp{os.getpid()}"
+        _write_block_file(tmp, block)
+        os.replace(tmp, self._block_file(h))
+        self.written.append(h)
+        return True
+
+    def begin_manifest(self, manifest: Dict[str, Any]) -> str:
+        tmp = os.path.join(self.dir, MANIFEST_NAME + f".tmp{os.getpid()}")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, sort_keys=True, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        return tmp
+
+    def commit_manifest(self, tmp: str) -> None:
+        # the injection sits BEFORE the rename: an armed checkpoint.manifest
+        # fault models dying mid-commit — no manifest appears, and restore
+        # must classify the directory as a partial checkpoint
+        FAULTS.inject("checkpoint.manifest")
+        os.replace(tmp, os.path.join(self.dir, MANIFEST_NAME))
+
+    def abort_manifest(self, tmp: str) -> None:
+        try:
+            os.unlink(tmp)
+        except FileNotFoundError:
+            pass
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    blocks: Iterable[Tuple[int, np.ndarray]],
+    *,
+    block_format: Dict[str, Any],
+    radix_order: Optional[Sequence[int]] = None,
+    queue: Sequence[Dict[str, Any]] = (),
+    weights_ref: str = "",
+    max_blocks: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Write a complete checkpoint and commit its manifest.
+
+    ``blocks`` yields ``(hash, array)`` in radix-LRU order (oldest first, the
+    same order the allocator would evict) so a capped checkpoint keeps the
+    hottest suffix droppable last on restore. ``block_format`` is either
+    ``{"kind": "int8", "nbytes": N}`` (flat QuantizedBlockCodec buffers) or
+    ``{"kind": "float", "dtype": name, "shape": [L, 2, bs, kvh, d]}``.
+    Returns the committed manifest dict."""
+    w = CheckpointWriter(ckpt_dir, max_blocks=max_blocks)
+    stored: List[int] = []
+    for h, arr in blocks:
+        if not w.write_block(h, arr):
+            break
+        stored.append(h)
+    manifest = {
+        "version": FORMAT_VERSION,
+        "blocks": [f"{h:0{_HASH_RE_WIDTH}x}" for h in stored],
+        "block_format": dict(block_format),
+        "radix": [
+            f"{h:0{_HASH_RE_WIDTH}x}"
+            for h in (stored if radix_order is None else radix_order)
+        ],
+        "queue": list(queue),
+        "weights_ref": str(weights_ref),
+    }
+    handle = w.begin_manifest(manifest)
+    try:
+        w.commit_manifest(handle)
+    except BaseException:
+        w.abort_manifest(handle)
+        raise
+    return manifest
+
+
+@dataclasses.dataclass
+class CheckpointState:
+    """A validated, committed checkpoint ready to restore from."""
+
+    dir: str
+    blocks: List[int]            # sealed-block hashes, radix-LRU order
+    block_format: Dict[str, Any]
+    radix: List[int]             # full radix/LRU snapshot (may exceed blocks)
+    queue: List[Dict[str, Any]]  # request-queue manifest
+    weights_ref: str
+
+    def _block_file(self, h: int) -> str:
+        return os.path.join(self.dir, "blocks", f"{h:016x}.kv")
+
+    def load_block(self, h: int) -> np.ndarray:
+        """One sealed block, validated against the manifest's block format."""
+        FAULTS.inject("restore.read")
+        try:
+            arr = _read_block_file(self._block_file(h))
+        except (OSError, ValueError, KeyError) as e:
+            raise CheckpointCorrupt(f"block {h:016x} unreadable: {e}") from e
+        fmt = self.block_format
+        if fmt.get("kind") == "int8":
+            if arr.dtype != np.uint8 or arr.shape != (int(fmt["nbytes"]),):
+                raise CheckpointCorrupt(
+                    f"block {h:016x} is not the manifest's int8 codec buffer "
+                    f"({arr.dtype} {arr.shape} vs nbytes={fmt['nbytes']})"
+                )
+        else:
+            expect = tuple(fmt.get("shape", ()))
+            if arr.shape != expect or arr.dtype.name != fmt.get("dtype"):
+                raise CheckpointCorrupt(
+                    f"block {h:016x} does not match the manifest block format "
+                    f"({arr.dtype.name} {arr.shape} vs {fmt.get('dtype')} {expect})"
+                )
+        return arr
+
+
+def _parse_hashes(raw: Any, what: str) -> List[int]:
+    if not isinstance(raw, list):
+        raise CheckpointCorrupt(f"manifest {what} is not a list")
+    out = []
+    for item in raw:
+        try:
+            out.append(int(item, 16))
+        except (TypeError, ValueError):
+            raise CheckpointCorrupt(f"manifest {what} entry {item!r} is not a hash")
+    return out
+
+
+def load_checkpoint(ckpt_dir: str) -> CheckpointState:
+    """Validate and open a checkpoint. Raises :class:`CheckpointCorrupt` for
+    anything short of a fully committed, structurally sound manifest — a
+    missing manifest is the crash-consistent partial-checkpoint signature
+    (blocks were staged but the commit rename never happened)."""
+    FAULTS.inject("restore.read")
+    mpath = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        raise CheckpointCorrupt(
+            "no committed manifest (absent or partial checkpoint)"
+        )
+    try:
+        with open(mpath, encoding="utf-8") as f:
+            manifest = f.read()
+        doc = json.loads(manifest)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(f"manifest unreadable: {e}") from e
+    if not isinstance(doc, dict) or doc.get("version") != FORMAT_VERSION:
+        raise CheckpointCorrupt(
+            f"manifest version {doc.get('version') if isinstance(doc, dict) else doc!r} "
+            f"!= {FORMAT_VERSION}"
+        )
+    fmt = doc.get("block_format")
+    if not isinstance(fmt, dict) or fmt.get("kind") not in ("int8", "float"):
+        raise CheckpointCorrupt(f"bad block_format {fmt!r}")
+    blocks = _parse_hashes(doc.get("blocks"), "blocks")
+    radix = _parse_hashes(doc.get("radix", doc.get("blocks")), "radix")
+    queue = doc.get("queue", [])
+    if not isinstance(queue, list):
+        raise CheckpointCorrupt("manifest queue is not a list")
+    state = CheckpointState(
+        dir=ckpt_dir,
+        blocks=blocks,
+        block_format=fmt,
+        radix=radix,
+        queue=queue,
+        weights_ref=str(doc.get("weights_ref", "")),
+    )
+    # every manifest-referenced block must exist: the manifest commits LAST,
+    # so a missing file means someone truncated the directory after commit
+    for h in blocks:
+        if not os.path.isfile(state._block_file(h)):
+            raise CheckpointCorrupt(f"manifest names missing block {h:016x}")
+    return state
+
+
+# ---------------------------------------------------------------------------
+# TpuEngine capture/restore (the sim's mocker path drives the functions above
+# directly; these two wrap them around a real engine's device state)
+# ---------------------------------------------------------------------------
+
+def _engine_block_format(engine) -> Dict[str, Any]:
+    if engine.kv_quantized:
+        return {"kind": "int8", "nbytes": int(engine._kv_codec().nbytes)}
+    return {
+        "kind": "float",
+        "dtype": np.dtype(engine.mcfg.dtype).name,
+        "shape": [
+            engine.mcfg.num_layers, 2, engine.cfg.block_size,
+            engine.mcfg.num_kv_heads, engine.mcfg.head_dim,
+        ],
+    }
+
+
+def _encode_gathered(engine, pending, gathered) -> List[np.ndarray]:
+    """Per-block host arrays from a device gather, in the engine's STORAGE
+    format — the same encode the kvbm offload path performs
+    (engine/engine.py _offload_fetch), so checkpoint files are bit-identical
+    to G3 spill files."""
+    n = len(pending)
+    if engine.kv_quantized:
+        codec = engine._kv_codec()
+        pay = np.empty((n,) + codec.payload_shape, np.int8)
+        scl = np.empty((n,) + codec.scales_shape, np.float32)
+        for li, (kq, vq) in enumerate(gathered):
+            pay[:, li, 0] = np.asarray(kq.data)
+            pay[:, li, 1] = np.asarray(vq.data)
+            scl[:, li, 0] = np.asarray(kq.scale)
+            scl[:, li, 1] = np.asarray(vq.scale)
+        return [codec.encode(pay[i], scl[i]) for i in range(n)]
+    store_dtype = np.dtype(engine.mcfg.dtype)
+    layers = []
+    for k_dev, v_dev in gathered:
+        k = np.asarray(k_dev, store_dtype)
+        v = np.asarray(v_dev, store_dtype)
+        layers.append(np.stack([k, v], axis=1))     # [n, 2, bs, kvh, d]
+    arr = np.stack(layers, axis=1)                  # [n, L, 2, bs, kvh, d]
+    return [arr[i].copy() for i in range(n)]
+
+
+async def checkpoint_engine(
+    engine,
+    ckpt_dir: str,
+    *,
+    queue: Sequence[Dict[str, Any]] = (),
+    weights_ref: str = "",
+    max_blocks: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Serialize a live engine's sealed prefix-cache pages (radix-LRU order,
+    oldest first) + queue manifest to ``ckpt_dir``. Runs the device gather on
+    the event loop (same ordering contract as the offload path) and the file
+    writes in the default executor."""
+    import asyncio
+
+    alloc = engine.allocator
+    pending = [
+        (bid, alloc._hash_of[bid], 0)
+        for bid in alloc._lru
+        if bid in alloc._hash_of
+    ]
+    cap = env_int(ENV_CKPT_MAX_BLOCKS, 4096) if max_blocks is None else max_blocks
+    if len(pending) > cap:
+        pending = pending[-cap:]  # keep the hottest (most recent) suffix
+    blocks: List[Tuple[int, np.ndarray]] = []
+    if pending:
+        gathered = engine._enqueue_offload_gather(pending)
+        arrs = _encode_gathered(engine, pending, gathered)
+        blocks = [(h, arr) for (_, h, _), arr in zip(pending, arrs)]
+    loop = asyncio.get_event_loop()
+    return await loop.run_in_executor(
+        None,
+        lambda: save_checkpoint(
+            ckpt_dir, blocks,
+            block_format=_engine_block_format(engine),
+            radix_order=[h for _, h, _ in pending],
+            queue=queue, weights_ref=weights_ref, max_blocks=cap,
+        ),
+    )
+
+
+async def restore_engine(engine, ckpt_dir: str) -> Dict[str, Any]:
+    """Restore sealed pages from a checkpoint into a fresh engine. Never
+    raises on a bad checkpoint: corruption is DETECTED and reported as a
+    cold boot (``{"mode": "cold", ...}``), the failure mode the chaos sim
+    pins. Returns ``{"mode": "warm"|"cold", "blocks": n, "queue": [...]}``."""
+    import asyncio
+
+    loop = asyncio.get_event_loop()
+    try:
+        state = await loop.run_in_executor(None, load_checkpoint, ckpt_dir)
+    except CheckpointCorrupt as e:
+        log.warning("checkpoint at %s rejected (%s); cold boot", ckpt_dir, e)
+        return {"mode": "cold", "blocks": 0, "queue": [], "reason": str(e)}
+    if state.block_format != _engine_block_format(engine):
+        log.warning(
+            "checkpoint block format %s does not match this engine (%s); "
+            "cold boot", state.block_format, _engine_block_format(engine),
+        )
+        return {"mode": "cold", "blocks": 0, "queue": [], "reason": "format"}
+    imported = 0
+    window = 64
+    for lo in range(0, len(state.blocks), window):
+        batch = state.blocks[lo : lo + window]
+        try:
+            arrs = [
+                await loop.run_in_executor(None, state.load_block, h)
+                for h in batch
+            ]
+        except CheckpointCorrupt as e:
+            # content-addressed pages already imported are valid — keep the
+            # warm prefix, stop at the first torn block
+            log.warning("restore stopped at bad block (%s)", e)
+            break
+        if state.block_format["kind"] == "int8":
+            arr = engine._kv_codec().decode_many(np.stack(arrs))
+        else:
+            arr = np.stack(arrs)
+        imported += await engine.import_blocks(list(batch), arr)
+    mode = "warm" if imported else "cold"
+    return {"mode": mode, "blocks": imported, "queue": list(state.queue)}

@@ -1,0 +1,193 @@
+"""Planned worker death: the drain coordinator (docs/operations.md §13).
+
+A reclaim notice (spot/preemptible TPU reclaim, rolling upgrade) arrives with
+a deadline — either on the ``/drain`` control endpoint (runtime/health.py) or
+from a supervisor calling :meth:`DrainCoordinator.begin` directly. The
+coordinator then runs the pipeline the fleet sim proves end to end
+(sim/scenarios.py ``elastic-reclaim``):
+
+1. flip this worker's discovery instance record to ``state=draining`` — the
+   frontend and KvRouter stop routing new work here (llm/discovery.py folds
+   draining instances into the exclusion set, same path as tripped breakers);
+2. wait out short in-flight decodes inside the deadline budget (long ones are
+   the frontend's job: its Migration layer replays them elsewhere, and the
+   error-finish frames carry an evacuation annotation pointing the retry at
+   this worker's sealed KV);
+3. checkpoint warm state (sealed KV pages, radix order, queue manifest,
+   weights by content-hash reference) to the G3 tier so the rescheduled
+   replacement restores warm (engine/checkpoint.py).
+
+The drain lease (:class:`DrainLedger`) brackets the whole operation; the
+RESOURCE-LEAK drain-lease spec (tools/analysis/resources.py) proves every
+path out of :meth:`begin` releases it — a leaked lease is a worker stuck
+advertising ``draining`` with no drain running.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..runtime import metrics as M
+from ..runtime.config import (
+    ENV_CKPT_DIR,
+    ENV_DRAIN_DEADLINE_S,
+    ENV_DRAIN_MARGIN_S,
+    env_float,
+    env_str,
+)
+from ..runtime.faults import FAULTS
+from ..runtime.logging import get_logger
+
+log = get_logger("engine.drain")
+
+
+class DrainLedger:
+    """At most one live drain lease per worker process."""
+
+    def __init__(self):
+        self._leases: Dict[int, float] = {}
+        self._next = 1
+
+    def acquire_drain(self, deadline_s: float) -> Optional[int]:
+        """A lease token, or None when a drain is already in flight."""
+        if self._leases:
+            return None
+        token = self._next
+        self._next += 1
+        self._leases[token] = deadline_s
+        return token
+
+    def release_drain(self, token: int) -> None:
+        self._leases.pop(token, None)
+
+    @property
+    def draining(self) -> bool:
+        return bool(self._leases)
+
+
+class DrainCoordinator:
+    """Owns one worker's planned-death pipeline. ``served`` is the
+    registered endpoint handle (runtime/component.ServedEndpoint) whose
+    metadata update flips the discovery record; ``engine`` is the TpuEngine
+    (or dp facade) being drained."""
+
+    def __init__(
+        self,
+        engine,
+        served,
+        *,
+        ckpt_dir: Optional[str] = None,
+        weights_ref: str = "",
+        metrics_scope=None,
+        on_drained: Optional[Callable[[], None]] = None,
+    ):
+        self.engine = engine
+        self.served = served
+        self.ckpt_dir = (
+            ckpt_dir if ckpt_dir is not None else (env_str(ENV_CKPT_DIR, "") or None)
+        )
+        self.weights_ref = weights_ref
+        self.ledger = DrainLedger()
+        # fires after the drain completes (metadata flipped, KV checkpointed):
+        # __main__ wires the process stop event here
+        self.on_drained = on_drained
+        self._evacuated = (
+            metrics_scope.counter(
+                M.DRAIN_EVACUATED_BLOCKS,
+                "sealed KV blocks evacuated/checkpointed during drains",
+            )
+            if metrics_scope is not None else None
+        )
+        self._margin = (
+            metrics_scope.gauge(
+                M.DRAIN_DEADLINE_MARGIN,
+                "seconds left on the reclaim deadline when the drain finished",
+            )
+            if metrics_scope is not None else None
+        )
+
+    def _queue_manifest(self) -> List[Dict[str, Any]]:
+        """Request-queue manifest for the checkpoint: enough to audit what
+        was in flight at the kill (the requests themselves are replayed by
+        the frontend's Migration, not restored from here)."""
+        out: List[Dict[str, Any]] = []
+        for state_name, seqs in (
+            ("running", getattr(self.engine, "_slots", None) or []),
+            ("waiting", getattr(self.engine, "_waiting", None) or []),
+        ):
+            for st in seqs:
+                req = getattr(st, "req", None)
+                rid = getattr(req, "request_id", None)
+                if rid is None:
+                    continue
+                out.append({
+                    "request_id": rid,
+                    "state": state_name,
+                    "produced": int(getattr(st, "produced", 0) or 0),
+                })
+        return out
+
+    async def _await_quiesce(self, budget_s: float, t0: float) -> bool:
+        """Let short in-flight decodes run to completion inside the budget.
+        True when the engine went idle; False when the budget ran out (the
+        frontend migrates what is left when the process dies)."""
+        while time.monotonic() - t0 < budget_s:
+            snap = self.engine.snapshot()
+            ranks = snap["ranks"] if "ranks" in snap else [snap]
+            if sum(r["running"] + r["waiting"] for r in ranks) == 0:
+                return True
+            await asyncio.sleep(0.05)
+        return False
+
+    async def begin(self, deadline_s: Optional[float] = None) -> Dict[str, Any]:
+        """Run the drain. Idempotent: a second notice while one drain is in
+        flight reports ``already=True`` and changes nothing."""
+        await FAULTS.ainject("drain.notice")
+        if deadline_s is None:
+            deadline_s = env_float(ENV_DRAIN_DEADLINE_S, 30.0)
+        margin_s = env_float(ENV_DRAIN_MARGIN_S, 2.0)
+        token = self.ledger.acquire_drain(deadline_s)
+        if token is None:
+            return {"state": "draining", "already": True}
+        t0 = time.monotonic()
+        try:
+            await self.served.update_metadata({
+                "state": "draining",
+                "drain_deadline_s": deadline_s,
+            })
+            log.info("draining: deadline=%.1fs", deadline_s)
+            quiesced = await self._await_quiesce(
+                max(0.0, deadline_s - margin_s), t0
+            )
+            ckpt_blocks = 0
+            if self.ckpt_dir:
+                from .checkpoint import checkpoint_engine
+
+                manifest = await checkpoint_engine(
+                    self.engine, self.ckpt_dir,
+                    queue=self._queue_manifest(),
+                    weights_ref=self.weights_ref,
+                )
+                ckpt_blocks = len(manifest.get("blocks", ()))
+                if self._evacuated is not None and ckpt_blocks:
+                    self._evacuated.inc(ckpt_blocks)
+            margin = deadline_s - (time.monotonic() - t0)
+            if self._margin is not None:
+                self._margin.set(margin)
+            log.info(
+                "drain complete: quiesced=%s ckpt_blocks=%d margin=%.2fs",
+                quiesced, ckpt_blocks, margin,
+            )
+            if self.on_drained is not None:
+                self.on_drained()
+            return {
+                "state": "draining",
+                "deadline_s": deadline_s,
+                "quiesced": quiesced,
+                "checkpoint_blocks": ckpt_blocks,
+                "deadline_margin_s": margin,
+            }
+        finally:
+            self.ledger.release_drain(token)

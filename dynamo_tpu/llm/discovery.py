@@ -161,6 +161,27 @@ class ModelPipeline:
             return []
         return avoid
 
+    def _draining(self, excluded: List[int]) -> List[int]:
+        """Workers whose discovery record advertises a planned reclaim
+        (``state=draining``, engine/drain.py): new work AND migration
+        retries steer around them — a retry landing on a worker seconds
+        from death just migrates twice. Same empty-pool fallback as
+        ``_tripped``: when avoiding every draining worker would leave no
+        candidate, a draining worker beats no worker (it still serves
+        until the deadline)."""
+        assert self.client is not None
+        inst = self.client.instances
+        avoid = [
+            iid for iid, rec in inst.items()
+            if iid not in excluded and rec.metadata.get("state") == "draining"
+        ]
+        if not avoid:
+            return []
+        shun_live = sum(1 for iid in set(excluded) if iid in inst)
+        if len(inst) - shun_live - len(avoid) <= 0:
+            return []
+        return avoid
+
     async def start(self) -> "ModelPipeline":
         endpoint = (
             self.runtime.namespace(self.card.namespace)
@@ -236,6 +257,43 @@ class ModelPipeline:
         self._known_worker_ids = live
         self._router_synced_count = len(inst_map)
 
+    def _evacuation_costs(
+        self, req: PreprocessedRequest, inst_map, shun: List[int]
+    ) -> Optional[Dict[WorkerWithDpRank, float]]:
+        """Bandwidth-priced destination costs for an evacuation replay
+        (docs/operations.md §13): a request migrating off a draining worker
+        carries a reference to its sealed KV in ``kv_transfer`` — charge
+        every candidate the time to pull those blocks over its advertised
+        wire class (per-wire EWMA, runtime/bandwidth.py), converted to
+        block units (the KvScheduler ``extra_costs`` currency), so the
+        evacuated KV lands where the wire is fast instead of round-robin.
+        None for ordinary requests — the common path pays nothing."""
+        kvt = getattr(req, "kv_transfer", None) or {}
+        hashes = kvt.get("hashes") or ()
+        if not hashes:
+            return None
+        from ..runtime.bandwidth import get_bandwidth_estimator
+        from ..runtime.config import ENV_PREFILL_BLOCK_MS, env_float
+
+        bw = get_bandwidth_estimator()
+        bpb = int(kvt.get("bytes_per_block", 0) or 0) or (
+            int(getattr(self.card.runtime_config, "kv_bytes_per_block", 0) or 0)
+            or 256 * 1024
+        )
+        move_bytes = len(hashes) * bpb
+        block_time_s = env_float(ENV_PREFILL_BLOCK_MS, 10.0) / 1e3
+        shun_set = set(shun)
+        costs: Dict[WorkerWithDpRank, float] = {}
+        for iid, inst in inst_map.items():
+            if iid in shun_set:
+                continue
+            wire = str(inst.metadata.get("kv_wire") or "inline")
+            cost = bw.transfer_seconds(wire, move_bytes) / block_time_s
+            dp = int(inst.metadata.get("data_parallel_size", 1) or 1)
+            for r in range(dp):
+                costs[WorkerWithDpRank(iid, r)] = cost
+        return costs or None
+
     async def _send(
         self, req: PreprocessedRequest, context: Context, excluded: List[int]
     ) -> AsyncIterator[Any]:
@@ -256,8 +314,11 @@ class ModelPipeline:
             req.annotations["traceparent"] = span.traceparent()
         try:
             # per-request exclusions (migration) plus cross-request tripped
-            # circuits: both are steered around the same way
+            # circuits plus draining (reclaim-notice) workers: all steered
+            # around the same way; _draining sees the combined set so its
+            # empty-pool fallback accounts for already-shunned workers
             shun = list(excluded) + self._tripped(excluded)
+            shun += self._draining(shun)
             # pooled forwards don't touch KV pages: routing them through the KV
             # scheduler would charge phantom blocks to a worker (and pollute the
             # approx prefix view) that complete() on the embed path never frees
@@ -284,7 +345,8 @@ class ModelPipeline:
                     for r in range(dp):
                         excl.add(WorkerWithDpRank(iid, r))
                 decision = self.kv_router.schedule_tokens(
-                    req.token_ids, excluded=excl, request_id=req.request_id
+                    req.token_ids, excluded=excl, request_id=req.request_id,
+                    extra_costs=self._evacuation_costs(req, inst_map, shun),
                 )
                 instance_id = decision.worker.worker_id
                 overlap_tokens = decision.overlap_blocks * self.card.kv_block_size

@@ -9,7 +9,7 @@ generated (``prior_token_ids``) so decode resumes where it stopped, bounded by
 
 from __future__ import annotations
 
-from typing import Any, AsyncIterator, Awaitable, Callable, List, Optional
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 
 from ..runtime.engine import Context
 from ..runtime.flight_recorder import get_flight_recorder
@@ -37,10 +37,16 @@ class Migration:
         attempts_left = self.migration_limit
         accumulated: List[int] = list(request.prior_token_ids)
         excluded: List[int] = []
+        # a draining worker's parting gift (docs/operations.md §13): its
+        # error-finish frame references this request's sealed KV (transfer
+        # address + block hashes); the replay carries it so routing prices
+        # destinations by pull bandwidth and the chosen worker fetches the
+        # KV instead of re-prefilling
+        evacuation: Optional[Dict[str, Any]] = None
 
         while True:
             req = request
-            if accumulated != list(request.prior_token_ids):
+            if accumulated != list(request.prior_token_ids) or evacuation is not None:
                 # re-issue with progress so the new worker resumes decode
                 req = PreprocessedRequest.from_obj(request.to_obj())
                 req.prior_token_ids = list(accumulated)
@@ -48,6 +54,8 @@ class Migration:
                     req.stop.max_tokens = max(
                         1, req.stop.max_tokens - (len(accumulated) - len(request.prior_token_ids))
                     )
+                if evacuation is not None:
+                    req.kv_transfer = dict(evacuation)
             try:
                 stream = await self.send(req, context, excluded)
                 async for item in stream:
@@ -61,6 +69,9 @@ class Migration:
                         iid = getattr(stream, "instance_id", None)
                         if iid is not None:
                             err.instance_id = iid  # type: ignore[attr-defined]
+                        evac = out.kv_transfer or out.annotations.get("evacuation")
+                        if evac:
+                            err.evacuation = evac  # type: ignore[attr-defined]
                         raise err
                     accumulated.extend(out.token_ids)
                     # a resumed worker counts only ITS OWN tokens: normalize
@@ -97,12 +108,16 @@ class Migration:
                 worker_id: Optional[int] = getattr(e, "instance_id", None)
                 if worker_id is not None and worker_id not in excluded:
                     excluded.append(worker_id)
+                evac = getattr(e, "evacuation", None)
+                if evac:
+                    evacuation = dict(evac)
                 get_flight_recorder().record(
                     request.request_id, "migration",
                     tokens_so_far=len(accumulated),
                     attempts_left=attempts_left,
                     from_worker=(f"{worker_id:016x}" if worker_id is not None
                                  else "unknown"),
+                    evacuated=bool(evac),
                     error=str(e)[:200],
                 )
                 log.info(

@@ -153,6 +153,11 @@ class LoadSnapshot:
     # empty when no class-labeled stats arrived): the signal that lets the
     # planner scale against promises instead of raw load
     class_attainment: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # workers that announced a planned reclaim (engine/drain.py) whose
+    # deadline has not passed: forecast signal — each one is capacity that
+    # WILL vanish, so the planner pre-warms its replacement before the kill
+    # instead of reacting to the load spike after it
+    announced_reclaims: int = 0
     ts: float = dataclasses.field(default_factory=time.time)
 
 
@@ -205,6 +210,10 @@ class PoolPlanner:
         div = self.config.queue_bump_divisor
         if snapshot.num_waiting > 0 and div > 0:
             needed = max(needed, math.ceil(snapshot.num_waiting / div) + 1)
+        # announced reclaims are capacity already spoken for: ask for their
+        # replacements NOW so spares are warm before the deadline (the
+        # connector's replica count still includes the draining workers)
+        needed += snapshot.announced_reclaims
         return max(self.config.min_replicas, min(self.config.max_replicas, max(needed, 1)))
 
     async def plan_and_apply(self, snapshot: LoadSnapshot) -> int:

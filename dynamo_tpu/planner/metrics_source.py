@@ -109,6 +109,11 @@ class EventPlaneMetricsSource:
         # sla_class -> [met_count, total] over the window (met = ttft and,
         # when observed, itl within the record's own targets)
         self._class_window: Dict[str, list] = {}
+        # worker_id -> reclaim deadline timestamp (this source's clock):
+        # announced planned deaths ride LoadSnapshot.announced_reclaims
+        # until their deadline passes (the worker is then gone and the
+        # regular replica accounting takes over)
+        self._reclaims: Dict[int, float] = {}
 
     async def start(self) -> "EventPlaneMetricsSource":
         for comp in self.components:
@@ -165,6 +170,17 @@ class EventPlaneMetricsSource:
         if itl_s > 0:
             self._itl_window.append(itl_s)
 
+    def note_reclaim(self, worker_id: int, deadline_ts: float) -> None:
+        """A worker announced a planned reclaim (drain notice) with this
+        absolute deadline on the source's clock. Idempotent per worker; a
+        later call moves the deadline."""
+        self._reclaims[worker_id] = deadline_ts
+
+    def clear_reclaim(self, worker_id: int) -> None:
+        """The reclaim resolved early (worker died, or the notice was
+        cancelled)."""
+        self._reclaims.pop(worker_id, None)
+
     def record_class_outcome(self, sla_class: str, ttft_s: float,
                              ttft_target_s: float, itl_s: float,
                              itl_target_s: float,
@@ -183,6 +199,15 @@ class EventPlaneMetricsSource:
         cell = self._class_window.setdefault(sla_class, [0, 0])
         cell[0] += 1 if met else 0
         cell[1] += 1
+
+    def _count_reclaims(self, now: float) -> int:
+        """Live announced reclaims; expired ones are pruned (their workers
+        are dead — double-counting them against the replica count would
+        hold phantom spares forever)."""
+        for wid, deadline in list(self._reclaims.items()):
+            if deadline <= now:
+                del self._reclaims[wid]
+        return len(self._reclaims)
 
     def snapshot(self) -> LoadSnapshot:
         now = self._clock()
@@ -211,6 +236,7 @@ class EventPlaneMetricsSource:
                 cls: round(met / max(total, 1), 4)
                 for cls, (met, total) in sorted(self._class_window.items())
             },
+            announced_reclaims=self._count_reclaims(now),
         )
         self._last_rate_calc = now
         self._prefill_tokens_window = 0

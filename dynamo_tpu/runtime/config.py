@@ -94,6 +94,11 @@ ENV_DEFLECT_OVERLAP = "DTPU_DEFLECT_OVERLAP"          # decode-pool radix-hit de
 ENV_DEFLECT_MARGIN = "DTPU_DEFLECT_MARGIN"            # load-skew deflection margin
 ENV_PREFILL_BLOCK_MS = "DTPU_PREFILL_BLOCK_MS"        # per-block prefill cost prior
 ENV_KV_BYTES_PER_BLOCK = "DTPU_KV_BYTES_PER_BLOCK"    # wire-cost bytes/block override
+# planned reclaims + checkpoint/restore (engine/drain.py, engine/checkpoint.py)
+ENV_DRAIN_DEADLINE_S = "DTPU_DRAIN_DEADLINE_S"        # default reclaim deadline (s)
+ENV_DRAIN_MARGIN_S = "DTPU_DRAIN_MARGIN_S"            # stop evacuating this early (s)
+ENV_CKPT_DIR = "DTPU_CKPT_DIR"                        # G3 checkpoint directory
+ENV_CKPT_MAX_BLOCKS = "DTPU_CKPT_MAX_BLOCKS"          # sealed blocks per checkpoint cap
 # model hub + media fetch (llm/hub.py, llm/media.py)
 ENV_HUB_CACHE = "DTPU_HUB_CACHE"                      # checkpoint cache dir
 ENV_HUB_OFFLINE = "DTPU_HUB_OFFLINE"                  # forbid hub network fetches

@@ -62,6 +62,10 @@ FAULT_POINTS = (
     "transfer.native_fetch",     # native (C++ agent) bulk fetch
     "engine.step",               # engine step loop (crash/watchdog drills)
     "controller.spawn",          # deploy controller process spawn
+    "drain.notice",              # reclaim notice delivery (engine/drain.py)
+    "checkpoint.write",          # per sealed-block checkpoint file write
+    "checkpoint.manifest",       # atomic manifest commit (pre-rename)
+    "restore.read",              # checkpoint manifest/block read on restore
 )
 
 ACTIONS = ("fail", "drop", "delay", "hang", "corrupt")

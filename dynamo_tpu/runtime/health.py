@@ -170,6 +170,10 @@ class StatusServer:
       /debug/slo  per-(model, sla_class) attainment/burn-rate/goodput ledger
                  (runtime/slo.py SloAccountant; the worker-side view fed
                  from engine milestone timestamps)
+      POST /drain  planned-reclaim notice (engine/drain.py DrainCoordinator;
+                 docs/operations.md §13): body ``{"deadline_s": 30}`` —
+                 flips discovery to `draining`, evacuates/checkpoints, 409
+                 when no drain handler is wired
     """
 
     def __init__(
@@ -182,11 +186,13 @@ class StatusServer:
         port: int = 0,
         loras_fn: Optional[Callable[[], list]] = None,
         flight_recorder=None,
+        drain_fn: Optional[Callable[[Optional[float]], Awaitable[Dict[str, Any]]]] = None,
     ):
         self.state = state
         self.metrics = metrics_scope
         self.metadata_fn = metadata_fn
         self.loras_fn = loras_fn
+        self.drain_fn = drain_fn
         self.pre_expose = pre_expose  # refresh gauges right before scraping
         # explicit host wins; DTPU_SYSTEM_HOST configures what callers left open
         self.host = host if host is not None else env_str(ENV_SYSTEM_HOST, "0.0.0.0")
@@ -204,6 +210,7 @@ class StatusServer:
         app.router.add_get("/v1/loras", self._loras)
         app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/debug/slo", self._debug_slo)
+        app.router.add_post("/drain", self._drain)
         self.app = app
 
     async def _health(self, request: web.Request) -> web.Response:
@@ -244,6 +251,27 @@ class StatusServer:
         from .slo import debug_slo_payload, get_slo_accountant
 
         return web.json_response(debug_slo_payload(get_slo_accountant()))
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        if self.drain_fn is None:
+            return web.json_response(
+                {"error": "no drain handler on this component"}, status=409
+            )
+        deadline_s: Optional[float] = None
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        raw = body.get("deadline_s", request.query.get("deadline_s"))
+        if raw is not None:
+            try:
+                deadline_s = float(raw)
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": f"bad deadline_s {raw!r}"}, status=400
+                )
+        summary = await self.drain_fn(deadline_s)
+        return web.json_response(summary)
 
     async def start(self) -> str:
         self._runner = web.AppRunner(self.app, access_log=None)

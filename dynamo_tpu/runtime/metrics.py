@@ -53,6 +53,11 @@ SLO_ATTAINMENT = f"{PREFIX}_slo_attainment_ratio"
 SLO_BURN_RATE = f"{PREFIX}_slo_burn_rate"
 GOODPUT_TOKENS = f"{PREFIX}_goodput_tokens_total"
 
+# planned reclaims (engine/drain.py, engine/checkpoint.py)
+DRAIN_EVACUATED_BLOCKS = f"{PREFIX}_drain_evacuated_blocks_total"
+DRAIN_DEADLINE_MARGIN = f"{PREFIX}_drain_deadline_margin_seconds"
+CHECKPOINT_RESTORE_MODE = f"{PREFIX}_checkpoint_restore_mode"
+
 RETRY_ATTEMPTS_TOTAL = f"{PREFIX}_retry_attempts_total"
 RETRY_GIVEUPS_TOTAL = f"{PREFIX}_retry_giveups_total"
 CIRCUIT_STATE = f"{PREFIX}_circuit_state"

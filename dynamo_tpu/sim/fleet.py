@@ -28,6 +28,13 @@ import dataclasses
 import time
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
+from ..engine.checkpoint import (
+    CheckpointCorrupt,
+    load_checkpoint,
+    save_checkpoint,
+)
 from ..kv_router import (
     KvEventPublisher,
     KvRouter,
@@ -49,6 +56,7 @@ from ..planner.metrics_source import (
 )
 from ..profiler.loadgen import prefix_prompt
 from ..runtime import metrics as M
+from ..runtime.bandwidth import WireBandwidthEstimator
 from ..runtime.engine import Context
 from ..runtime.event_plane.base import InProcEventPlane
 from ..runtime.faults import FAULTS, FaultInjected, parse_faults
@@ -64,6 +72,35 @@ log = get_logger("sim.fleet")
 def worker_fault_point(worker_id: int) -> str:
     """Fault-registry point name for one simulated worker's serving path."""
     return f"sim.worker.{worker_id}"
+
+
+# -- planned-reclaim evacuation model (drain_worker) -------------------------
+# wire classes per worker: even ids sit a native hop from the rest of the
+# pool, odd ids only reach it over a congested inline path — the skew the
+# cost-priced destination choice must react to (same shape the disagg
+# scenario uses for its prefill pool)
+_EVAC_WIRE_PRIORS = {"native": 2.0e9, "inline": 1.0e8}
+# large-model scale (a 16-token page of KV across all layers runs tens of
+# MB): at this size the normalized wire term lands in the same block units
+# as the scheduler's overlap/load logit instead of vanishing under it, so
+# a congested wire genuinely loses the destination pick
+_EVAC_KV_BYTES_PER_BLOCK = 32 * 1024 * 1024
+
+# the mocker has no KV tensors, so checkpoint files carry a 16-byte
+# deterministic stand-in per sealed page — but they round-trip through the
+# REAL engine/checkpoint.py writer and G3 block-file codec, so chaos faults
+# and corruption detection exercise the production path
+_SIM_BLOCK_FORMAT = {"kind": "float", "dtype": "uint8", "shape": [16]}
+
+
+def evac_wire_for(wid: int) -> str:
+    return "native" if wid % 2 == 0 else "inline"
+
+
+def _sim_block_payload(h: int) -> np.ndarray:
+    return np.frombuffer(
+        (int(h) & ((1 << 64) - 1)).to_bytes(8, "little") * 2, dtype=np.uint8
+    ).copy()
 
 
 @dataclasses.dataclass
@@ -213,6 +250,13 @@ class SimPool:
         # workers that ever recorded a failure: the only ones whose breaker
         # can be OPEN, so per-request breaker checks skip the healthy fleet
         self._suspects: set = set()
+        # workers in their drain window (drain_worker): excluded from
+        # routing like OPEN breakers — the sim analog of the discovery
+        # record flipping to "draining" (llm/discovery.py _draining)
+        self._draining: set = set()
+        self.drain_log: List[Dict] = []
+        self.evacuated_blocks_total = 0
+        self.evac_dest_wires: List[str] = []
         # -- deterministic outputs -------------------------------------------
         self.records: List[RequestRecord] = []
         self.itls: List[float] = []
@@ -318,6 +362,7 @@ class SimPool:
     def _retire(self, wid: int) -> None:
         w = self.workers.pop(wid)
         self._cands.pop(wid, None)
+        self._draining.discard(wid)
         self.router.remove_worker_id(wid)
         self.fleet.spawn_task(self._drain_stop(w))
 
@@ -337,6 +382,187 @@ class SimPool:
             # the retired worker in the router's universe as a zero-load
             # ghost; de-register once it can publish no more
             self.router.remove_worker_id(w.wid)
+
+    # -- planned reclaims (docs/operations.md §13) ----------------------------
+    async def drain_worker(
+        self,
+        wid: int,
+        deadline_s: float = 30.0,
+        *,
+        ckpt_dir: Optional[str] = None,
+        margin_s: float = 2.0,
+        stream_window: int = 8,
+        bandwidth: Optional[WireBandwidthEstimator] = None,
+    ) -> Dict:
+        """Planned death of one worker — the sim analog of
+        engine/drain.py's DrainCoordinator.begin: flag it draining (new
+        routing stops immediately, like the discovery-record flip), let
+        short in-flight decodes run out, bulk-evacuate its sealed KV to
+        cost-priced destinations in block-window units (the PR 10 streamed
+        protocol; a dropped ``transfer.stream_window`` resumes per block),
+        checkpoint through the REAL engine/checkpoint.py writer inside the
+        deadline margin, then hard-kill at the deadline — still-running
+        decodes get FINISH_ERROR and the submit loop migrates them."""
+        w = self.workers.get(wid)
+        if w is None:
+            return {"wid": wid, "state": "gone"}
+        await FAULTS.ainject("drain.notice")
+        t0 = self.clock.time()
+        t_kill = t0 + deadline_s
+        self._draining.add(wid)
+        if self.metrics_source is not None:
+            # announced reclaims ride LoadSnapshot.announced_reclaims so
+            # the planner pre-warms replacements (planner/core.py)
+            self.metrics_source.note_reclaim(wid, t_kill)
+        bw = bandwidth or WireBandwidthEstimator(priors=dict(_EVAC_WIRE_PRIORS))
+        block_time_s = self.cfg.prefill_per_token_s * self.cfg.block_size
+        summary: Dict = {
+            "wid": wid, "t_notice": round(t0, 3), "deadline_s": deadline_s,
+            "evacuated": 0, "resumed_windows": 0, "ckpt": "skipped",
+            "quiesced": False, "killed_in_flight": 0,
+        }
+        # ---- mass KV evacuation: sealed (evictable) pages, oldest first ----
+        hashes = list(w.engine.kv.cached)
+        for lo in range(0, len(hashes), stream_window):
+            if self.clock.time() >= t_kill - margin_s:
+                break  # notice budget spent: keep the checkpoint margin
+            batch = hashes[lo : lo + stream_window]
+            move_bytes = len(batch) * _EVAC_KV_BYTES_PER_BLOCK
+            # destinations priced by bandwidth EWMA in block-time units —
+            # the same extra_costs currency the prefill router uses — NOT
+            # round-robin; overlap on the window's hashes dedups re-sends
+            costs = {
+                cand: bw.transfer_seconds(evac_wire_for(w2), move_bytes)
+                / block_time_s
+                for w2, cand in self._cands.items()
+                if w2 != wid
+            }
+            decision = self.router.score_tokens(
+                [], hashes=batch, extra_costs=costs,
+                excluded=self._excluded(()),
+            )
+            dest = self.workers.get(decision.worker.worker_id)
+            if dest is None or dest.wid == wid:
+                break
+            wire = evac_wire_for(dest.wid)
+            wire_s = bw.transfer_seconds(wire, move_bytes)
+            await self.clock.sleep(wire_s)
+            bw.observe(wire, move_bytes, wire_s)
+            try:
+                await FAULTS.ainject("transfer.stream_window")
+            except (ConnectionError, FaultInjected):
+                # dropped mid-window: the block-window protocol resumes
+                # from the last acked block, re-sending the tail per block
+                # — costs one more window of wire time, loses nothing
+                summary["resumed_windows"] += 1
+                await self.clock.sleep(wire_s)
+            fresh = []
+            for h in batch:
+                if h in dest.engine.kv.active or h in dest.engine.kv.cached:
+                    continue
+                if dest.engine.kv.free_blocks <= 0:
+                    break
+                dest.engine.kv.cached[h] = None
+                fresh.append(h)
+            if fresh and dest.engine.kv_publisher is not None:
+                # publish directly (not via events_stored): an idle
+                # destination engine only drains events when it next serves
+                await dest.engine.kv_publisher.stored(fresh)
+            summary["evacuated"] += len(batch)
+            self.evac_dest_wires.append(wire)
+        self.evacuated_blocks_total += summary["evacuated"]
+        # ---- short in-flight decodes run to completion ----
+        while self.clock.time() < t_kill - margin_s:
+            s = w.engine.snapshot()
+            if not s["waiting"] and not s["running"]:
+                summary["quiesced"] = True
+                break
+            await self.clock.sleep(0.25)
+        # ---- checkpoint inside the margin (REAL writer: faults fire) ----
+        if ckpt_dir is not None:
+            try:
+                save_checkpoint(
+                    ckpt_dir,
+                    [(h, _sim_block_payload(h)) for h in w.engine.kv.cached],
+                    block_format=dict(_SIM_BLOCK_FORMAT),
+                    queue=[
+                        {"request_id": st.req.request_id,
+                         "produced": st.produced}
+                        for st in (w.engine._waiting + w.engine._running)
+                    ],
+                    weights_ref=f"sim-{self.cfg.name}",
+                )
+                summary["ckpt"] = "ok"
+            except (FaultInjected, ConnectionError, OSError) as e:
+                # died mid-commit: no manifest lands, so restore classifies
+                # the directory as a partial checkpoint and cold-boots
+                summary["ckpt"] = f"failed:{type(e).__name__}"
+        summary["margin_s"] = round(t_kill - self.clock.time(), 3)
+        # ---- the reclaim fires at the deadline ----
+        dt = t_kill - self.clock.time()
+        if dt > 0:
+            await self.clock.sleep(dt)
+        s = w.engine.snapshot()
+        summary["killed_in_flight"] = s["waiting"] + s["running"]
+        self.kill_worker(wid)
+        self.drain_log.append(summary)
+        return summary
+
+    def kill_worker(self, wid: int) -> None:
+        """The reclaim itself: hard-stop NOW. Unlike :meth:`_retire` there
+        is no graceful wait — still-running streams get FINISH_ERROR from
+        the dying loop and the submit retry loop migrates them (zero lost
+        requests is the scenario's invariant, not a kindness of the
+        kill)."""
+        w = self.workers.pop(wid, None)
+        self._draining.discard(wid)
+        self._suspects.discard(wid)
+        self._cands.pop(wid, None)
+        self.router.remove_worker_id(wid)
+        if w is not None:
+            w.engine.stop()
+
+    async def restore_worker(
+        self, ckpt_dir: str, *, startup_s: Optional[float] = None
+    ) -> Dict:
+        """Boot a replacement from a checkpoint (the sim analog of
+        engine/__main__.py's restore_engine wiring). A committed manifest
+        restores WARM: the replacement pre-seeds the checkpointed sealed
+        pages into its prefix cache and announces them to the router, so
+        the fleet's working set survives the reclaim. Anything short of
+        that — absent or partial manifest, torn blocks — detects as
+        corrupt and boots COLD (full prefix rebuild), never serving
+        garbage pages."""
+        blocks: List[int] = []
+        reason = ""
+        try:
+            state = load_checkpoint(ckpt_dir)
+        except CheckpointCorrupt as e:
+            reason = str(e)
+        else:
+            for h in state.blocks:
+                try:
+                    state.load_block(h)  # validate against the block format
+                except CheckpointCorrupt as e:
+                    reason = str(e)  # keep the intact warm prefix
+                    break
+                blocks.append(h)
+        wid = self._spawn(startup_s=startup_s)
+        eng = self.workers[wid].engine
+        seeded: List[int] = []
+        for h in blocks:
+            if eng.kv.free_blocks <= 0:
+                break
+            eng.kv.cached[h] = None
+            seeded.append(h)
+        if seeded and eng.kv_publisher is not None:
+            await eng.kv_publisher.stored(seeded)
+        return {
+            "wid": wid,
+            "mode": "warm" if seeded else "cold",
+            "blocks": len(seeded),
+            "reason": reason,
+        }
 
     # -- the closed loop -----------------------------------------------------
     async def _planner_loop(self) -> None:
@@ -368,7 +594,8 @@ class SimPool:
         by exclusion set instead (:meth:`_excluded`)."""
         avoid = [
             wid for wid, w in self.workers.items()
-            if wid in excluded or w.breaker.state == OPEN
+            if wid in excluded or wid in self._draining
+            or w.breaker.state == OPEN
         ]
         eligible = [wid for wid in self.workers if wid not in avoid]
         if not eligible:
@@ -395,6 +622,12 @@ class SimPool:
                 continue
             if w.breaker.state == OPEN:
                 avoid.add(self._cands[wid])
+        for wid in list(self._draining):
+            c = self._cands.get(wid)
+            if c is None:
+                self._draining.discard(wid)
+                continue
+            avoid.add(c)
         if len(avoid) >= len(self.workers):
             return set()
         return avoid
@@ -573,6 +806,13 @@ class SimFleet:
         await self.plane.close()
         for point in self._armed_points:
             FAULTS.disarm(point)
+        # disarm(point) keeps the point's fired-event history (the live log is
+        # a cross-rule determinism record) — but a finished sim run must leave
+        # the process-global registry exactly as it found it, or a later
+        # chaos test's exact-schedule assertion sees our fires prepended
+        armed = set(self._armed_points)
+        if armed:
+            FAULTS.fired = [f for f in FAULTS.fired if f[0] not in armed]
         self._armed_points = []
 
     def spawn_task(self, coro) -> asyncio.Task:

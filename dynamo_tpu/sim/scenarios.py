@@ -15,6 +15,11 @@ not point metrics but the loop behaviors ROADMAP item 3 needs proven:
                             interactive pool isolated from batch load
 - ``multi-region-follow-sun``  phase-shifted regional diurnals keep the
                             combined fleet busy while each region holds SLA
+- ``elastic-reclaim``     planned death of 30% of a warm fleet: drain,
+                            mass KV evacuation, checkpoint, kill at the
+                            deadline, warm restore — zero lost requests
+                            (``-chaos`` variant drops the evacuation stream
+                            and tears a checkpoint manifest)
 
 Scenarios scale with ``workers`` and ``duration_s`` so the same invariants
 run as a tier-1 smoke (small fleet, ~4 simulated minutes, seconds of wall
@@ -1253,6 +1258,285 @@ async def _http_frontend(
 
 
 # ---------------------------------------------------------------------------
+# elastic-reclaim
+# ---------------------------------------------------------------------------
+
+
+async def _elastic_reclaim_impl(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float,
+    chaos: bool,
+) -> Dict:
+    """Planned worker death at fleet scale (docs/operations.md §13): 30% of
+    a loaded, radix-warm fleet receives a reclaim notice with a 30s virtual
+    deadline. Drained workers leave routing immediately, short in-flight
+    decodes run out, sealed KV bulk-evacuates to bandwidth-priced
+    destinations, the REAL engine/checkpoint.py writer snapshots each
+    victim, the kill fires at the deadline (still-running decodes migrate),
+    and replacements restore WARM from the checkpoints. Invariants: zero
+    lost requests, goodput >= 0.97, restored-worker first-token TTFT within
+    1.2x a never-killed warm worker's, draining workers never receive new
+    traffic, and cost-priced evacuation steers to fast-wire destinations.
+    The chaos variant drops the evacuation stream mid-window (the
+    block-window protocol resumes per block) and fails one checkpoint
+    mid-manifest (restore detects the partial checkpoint and cold-boots) —
+    still with zero lost requests."""
+    import asyncio
+    import os
+    import shutil
+    import tempfile
+
+    from ..llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from ..profiler.loadgen import prefix_prompt
+    from ..runtime.engine import Context
+
+    workers = max(4, workers)
+    n_victims = max(1, int(round(0.3 * workers)))
+    deadline_s = 30.0
+    t_drain = 0.45 * duration_s
+    share = 0.75
+    trace = traces.prefix_heavy(
+        duration_s=duration_s, rate=0.35 * workers * _CAPACITY_REQ_S,
+        isl=256, osl=12, num_groups=max(4, workers), hot_group_share=0.4,
+        seed=seed, ttft_target_s=15.0, itl_target_s=3.0,
+    )
+    # long decodes that outlive the notice window, arriving just before it:
+    # the quiesce wait cannot finish them, so the deadline kill cuts them
+    # mid-decode and the submit loop must migrate them (the "long ones
+    # bulk-migrate" half of the drain contract)
+    long_osl = int((deadline_s + 20.0) / _SPEED["decode_base_s"])
+    trace = traces.merge(trace, [
+        traces.SimRequest(
+            traces.TraceItem(t_drain - 6.0 + 0.5 * j, 64, long_osl, 900 + j),
+            ttft_target_s=60.0, itl_target_s=3.0,
+        )
+        for j in range(workers)
+    ])
+    faults = ""
+    if chaos:
+        faults = (
+            f"transfer.stream_window:drop@p=0.4@seed={seed + 41};"
+            "checkpoint.manifest:fail@1"
+        )
+    cfg = FleetConfig(
+        seed=seed, prefix_share=share, max_attempts=4, faults=faults,
+        pools=[PoolConfig(
+            name="decode", initial_workers=workers,
+            min_workers=1, max_workers=2 * workers,
+            startup_time_s=5.0, **_SPEED,
+        )],
+    )
+    fleet = SimFleet(cfg, clock)
+    await fleet.start()
+    pool = fleet.default_pool
+    # victims are picked at notice time, not up front: a real reclaim does
+    # not politely choose idle machines, so we take the BUSIEST workers of
+    # the original fleet — guaranteeing in-flight decodes at the deadline
+    victims: List[int] = []
+    ckpt_root = tempfile.mkdtemp(prefix="dtpu-sim-ckpt-")
+    drains: List[Dict] = []
+    restores: List[Dict] = []
+
+    async def _reclaim() -> None:
+        await clock.sleep(t_drain)
+        cands = [wid for wid in sorted(pool.workers) if wid <= workers]
+        cands.sort(key=lambda wid: (
+            -(pool.workers[wid].engine.snapshot()["running"]
+              + pool.workers[wid].engine.snapshot()["waiting"]),
+            wid,
+        ))
+        victims.extend(cands[:n_victims])
+        outs = await asyncio.gather(*[
+            pool.drain_worker(
+                wid, deadline_s=deadline_s,
+                ckpt_dir=os.path.join(ckpt_root, f"w{wid}"),
+            )
+            for wid in victims
+        ])
+        drains.extend(outs)
+        for wid in victims:
+            restores.append(
+                await pool.restore_worker(os.path.join(ckpt_root, f"w{wid}"))
+            )
+
+    async def _probe_ttft(engine, rid: str, tokens: List[int]) -> float:
+        req = PreprocessedRequest(
+            request_id=rid, model="sim", token_ids=tokens,
+            stop=StopConditions(max_tokens=1, min_tokens=1, ignore_eos=True),
+            sampling=SamplingOptions(temperature=0.0),
+        )
+        t0 = clock.time()
+        async for out in engine.generate(req, Context(rid)):
+            if out.finish_reason is not None:
+                break
+        return clock.time() - t0
+
+    def _replay_tokens(wid: int) -> Optional[List[int]]:
+        """The prompt of a request this worker completed while alive — its
+        blocks are exactly what a warm cache (or a restored checkpoint of
+        one) must hold."""
+        for r in pool.records:
+            if r.ok and r.worker == wid and r.idx < len(trace):
+                return prefix_prompt(trace[r.idx].item, r.idx, share)
+        return None
+
+    restored_ttfts: List[float] = []
+    baseline_ttft = 0.0
+    reclaim_task = asyncio.create_task(_reclaim())
+    try:
+        await fleet.run_trace(trace)
+        await reclaim_task
+        # first-token probes against live engines, before teardown: each
+        # restored replacement replays a prompt ITS victim served (warmth
+        # must come from the checkpoint's pre-seeded pages), the baseline
+        # is a never-killed survivor replaying a prompt it served itself
+        survivors = [wid for wid in pool.workers if wid not in victims
+                     and wid <= workers]
+        base_wid = max(
+            survivors, key=lambda wid: (pool.workers[wid].requests, -wid)
+        )
+        base_toks = _replay_tokens(base_wid)
+        if base_toks is not None:
+            baseline_ttft = await _probe_ttft(
+                pool.workers[base_wid].engine, "probe-warm", base_toks
+            )
+        for i, (vic, r) in enumerate(zip(victims, restores)):
+            if r["mode"] != "warm":
+                continue
+            w = pool.workers.get(r["wid"])
+            toks = _replay_tokens(vic)
+            if w is None or toks is None:
+                continue
+            restored_ttfts.append(
+                await _probe_ttft(w.engine, f"probe-restored-{i}", toks)
+            )
+    finally:
+        reclaim_task.cancel()
+        await asyncio.gather(reclaim_task, return_exceptions=True)
+        await fleet.stop()
+        shutil.rmtree(ckpt_root, ignore_errors=True)
+
+    from .report import pool_report
+
+    rep = pool_report(pool)
+    goodput = rep["completed"] / max(rep["requests"], 1)
+    killed_in_flight = sum(d.get("killed_in_flight", 0) for d in drains)
+    victim_set = set(victims)
+    routed_to_draining = sum(
+        1 for r in pool.records
+        if r.worker in victim_set and r.t_arrive > t_drain + 0.5
+    )
+    native_share = (
+        sum(1 for wr in pool.evac_dest_wires if wr == "native")
+        / max(len(pool.evac_dest_wires), 1)
+    )
+    modes = sorted(r["mode"] for r in restores)
+    worst_ratio = (
+        max(restored_ttfts) / baseline_ttft
+        if restored_ttfts and baseline_ttft > 0 else float("inf")
+    )
+    invs = [
+        _invariant(
+            "zero_lost_requests", rep["failed"] == 0,
+            f'{rep["completed"]}/{rep["requests"]} completed; '
+            f"{killed_in_flight} in flight at the kills, all migrated "
+            f'({rep["retries"]} retries)',
+        ),
+        _invariant(
+            "goodput_held", goodput >= 0.97,
+            f"goodput {goodput:.4f} through a 30% planned fleet loss",
+        ),
+        _invariant(
+            "long_decodes_migrated", killed_in_flight >= 1,
+            f"the deadline kill cut {killed_in_flight} still-running "
+            "request(s); the retry loop re-ran them elsewhere",
+        ),
+        _invariant(
+            "draining_excluded", routed_to_draining == 0,
+            f"{routed_to_draining} new arrivals routed to a draining worker "
+            f"after the notice at t={t_drain:.0f}s",
+        ),
+        _invariant(
+            "kv_evacuated",
+            pool.evacuated_blocks_total > 0 and native_share >= 0.6,
+            f"{pool.evacuated_blocks_total} sealed blocks evacuated; "
+            f"{native_share:.3f} of windows steered to native-wire "
+            "destinations (cost-priced, not round-robin; half the pool)",
+        ),
+        _invariant(
+            "deadline_respected",
+            all(d.get("margin_s", -1.0) >= 0.0 for d in drains),
+            f"checkpoint margins {[d.get('margin_s') for d in drains]}s "
+            f"before the {deadline_s:.0f}s deadline",
+        ),
+    ]
+    if chaos:
+        resumed = sum(d.get("resumed_windows", 0) for d in drains)
+        ckpt_failed = sum(
+            1 for d in drains if str(d.get("ckpt", "")).startswith("failed")
+        )
+        cold = sum(1 for m in modes if m == "cold")
+        invs += [
+            _invariant(
+                "stream_drops_resumed", resumed > 0,
+                f"{resumed} evacuation windows dropped mid-stream and "
+                "resumed per block (no block lost)",
+            ),
+            _invariant(
+                "partial_checkpoint_cold_boot",
+                ckpt_failed == 1 and cold == ckpt_failed
+                and len(modes) == len(victims),
+                f"{ckpt_failed} checkpoint(s) died mid-manifest commit; "
+                f"restore modes {modes} (partial checkpoints detected, "
+                "cold-booted; the rest restored warm)",
+            ),
+        ]
+    else:
+        invs += [
+            _invariant(
+                "restored_warm", modes == ["warm"] * len(victims),
+                f"restore modes {modes} over {len(victims)} replacements",
+            ),
+            _invariant(
+                "warm_restore_ttft", worst_ratio <= 1.2,
+                f"restored first-token TTFT worst ratio {worst_ratio:.3f} "
+                f"vs never-killed warm worker {baseline_ttft:.3f}s "
+                "(bound 1.2x)",
+            ),
+        ]
+    return {
+        "fleet": fleet,
+        "invariants": invs,
+        "requests": len(trace),
+        "extra_sim": {
+            "reclaim": {
+                "victims": victims,
+                "drains": drains,
+                "restores": restores,
+                "restored_ttft_s": [round(t, 4) for t in restored_ttfts],
+                "baseline_ttft_s": round(baseline_ttft, 4),
+                "native_wire_share": round(native_share, 4),
+            },
+        },
+    }
+
+
+async def _elastic_reclaim(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    return await _elastic_reclaim_impl(clock, seed, workers, duration_s, False)
+
+
+async def _elastic_reclaim_chaos(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    return await _elastic_reclaim_impl(clock, seed, workers, duration_s, True)
+
+
+# ---------------------------------------------------------------------------
 # registry + runner
 # ---------------------------------------------------------------------------
 
@@ -1265,6 +1549,8 @@ SCENARIOS: Dict[str, Callable] = {
     "disagg-streamed-prefill": _disagg_streamed_prefill,
     "router-scale-sublinear": _router_scale,
     "http-frontend": _http_frontend,
+    "elastic-reclaim": _elastic_reclaim,
+    "elastic-reclaim-chaos": _elastic_reclaim_chaos,
 }
 
 # aliases accepted by the CLI (`python -m dynamo_tpu.sim diurnal`)
@@ -1277,6 +1563,8 @@ ALIASES = {
     "disagg": "disagg-streamed-prefill",
     "scale": "router-scale-sublinear",
     "frontend": "http-frontend",
+    "reclaim": "elastic-reclaim",
+    "reclaim-chaos": "elastic-reclaim-chaos",
 }
 
 
@@ -1332,7 +1620,7 @@ def run_suite(
         "diurnal-autoscale", "bursty-breaker-chaos",
         "prefix-heavy-radix", "multi-pool-balance",
         "disagg-streamed-prefill", "router-scale-sublinear",
-        "http-frontend",
+        "http-frontend", "elastic-reclaim",
     ]
     return [
         run_scenario(n, seed=seed, workers=workers, duration_s=duration_s)

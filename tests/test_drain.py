@@ -1,0 +1,218 @@
+"""Planned reclaims (docs/operations.md §13): drain routing, the drain lease,
+the /drain control endpoint, and the planner's announced-reclaim pre-warm.
+
+The frontend-side regression here is the one the elastic-reclaim sim pins
+e2e: a worker advertising ``state=draining`` must never be chosen — not for
+new work and, critically, not as a MIGRATION destination (a retry landing on
+a worker seconds from death just migrates twice).
+"""
+
+from types import SimpleNamespace
+
+import aiohttp
+
+from dynamo_tpu.engine.drain import DrainCoordinator, DrainLedger
+from dynamo_tpu.llm.discovery import ModelPipeline
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.common import BackendOutput, PreprocessedRequest
+from dynamo_tpu.planner.core import LoadSnapshot, PlannerConfig, PoolPlanner
+from dynamo_tpu.planner.metrics_source import EventPlaneMetricsSource
+from dynamo_tpu.runtime import HealthState, StatusServer
+from dynamo_tpu.runtime.engine import Context
+
+
+class _Stream:
+    def __init__(self, wid, outs):
+        self.instance_id = wid
+        self._iter = iter(outs)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            return next(self._iter)
+        except StopIteration:
+            raise StopAsyncIteration
+
+
+class _StubClient:
+    """Discovery + transport stub: ``metadata`` drives the draining state,
+    ``outs_for`` drives what each worker's stream yields."""
+
+    def __init__(self, workers):
+        self.instances = {
+            wid: SimpleNamespace(metadata=dict(meta)) for wid, meta in workers.items()
+        }
+        self.outs_for = {}
+        self.calls = []
+
+    def instance_ids(self):
+        return sorted(self.instances)
+
+    async def generate(self, obj, context, instance_id):
+        # instance_id None = client-routed (no frontend shun set): pick the
+        # first worker, like the round-robin transport would
+        wid = instance_id if instance_id is not None else self.instance_ids()[0]
+        self.calls.append(wid)
+        self.sent_prior = list(obj.get("prior_token_ids", []))
+        return _Stream(wid, self.outs_for.get(wid, []))
+
+
+def _pipeline(client, migration_limit=2):
+    card = ModelDeploymentCard(name="m", migration_limit=migration_limit)
+    p = ModelPipeline(None, card)
+    p.client = client
+    return p
+
+
+async def test_draining_worker_never_migration_destination():
+    # A dies mid-stream (error finish), B is draining, C is healthy: the
+    # migration retry must route to C even though B looks alive in discovery
+    a, b, c = 10, 11, 12
+    client = _StubClient({
+        a: {}, b: {"state": "draining"}, c: {},
+    })
+    client.outs_for[a] = [
+        BackendOutput(token_ids=[1]),
+        BackendOutput(finish_reason="error"),
+    ]
+    client.outs_for[c] = [BackendOutput(token_ids=[2, 3], finish_reason="stop")]
+    p = _pipeline(client)
+
+    req = PreprocessedRequest(request_id="r1", model="m", token_ids=[5, 6, 7])
+    got = []
+    async for out in p.migration.generate(req, Context("r1")):
+        got.extend(out.token_ids)
+
+    assert client.calls == [a, c], client.calls
+    assert b not in client.calls  # the regression: no retry onto draining
+    assert got == [1, 2, 3]
+    assert client.sent_prior == [1]  # the replay carried A's progress to C
+
+
+async def test_new_work_steers_around_draining():
+    a, b = 20, 21
+    client = _StubClient({a: {"state": "draining"}, b: {}})
+    client.outs_for[b] = [BackendOutput(token_ids=[9], finish_reason="stop")]
+    p = _pipeline(client)
+    for i in range(4):
+        req = PreprocessedRequest(request_id=f"n{i}", model="m", token_ids=[1])
+        async for _ in p.migration.generate(req, Context(f"n{i}")):
+            pass
+    assert set(client.calls) == {b}
+
+
+async def test_whole_pool_draining_falls_back_to_serving():
+    # avoiding every draining worker would leave no candidate: a draining
+    # worker (still serving until its deadline) beats NoResponders
+    a, b = 30, 31
+    client = _StubClient({a: {"state": "draining"}, b: {"state": "draining"}})
+    for wid in (a, b):
+        client.outs_for[wid] = [BackendOutput(token_ids=[1], finish_reason="stop")]
+    p = _pipeline(client)
+    req = PreprocessedRequest(request_id="f1", model="m", token_ids=[1])
+    async for out in p.migration.generate(req, Context("f1")):
+        assert out.finish_reason == "stop"
+    assert len(client.calls) == 1 and client.calls[0] in (a, b)
+
+
+def test_drain_ledger_single_lease():
+    led = DrainLedger()
+    tok = led.acquire_drain(30.0)
+    assert tok is not None and led.draining
+    assert led.acquire_drain(30.0) is None  # one drain per process
+    led.release_drain(tok)
+    assert not led.draining
+    assert led.acquire_drain(5.0) is not None  # released lease re-acquirable
+
+
+class _IdleEngine:
+    def snapshot(self):
+        return {"running": 0, "waiting": 0}
+
+
+class _Served:
+    def __init__(self):
+        self.meta = {}
+
+    async def update_metadata(self, m):
+        self.meta.update(m)
+
+
+async def test_drain_coordinator_flips_discovery_and_reports():
+    served = _Served()
+    fired = []
+    coord = DrainCoordinator(
+        _IdleEngine(), served, ckpt_dir=None, on_drained=lambda: fired.append(1)
+    )
+    # deadline comfortably above the default 2s evacuation margin, so the
+    # quiesce wait gets a real budget
+    summary = await coord.begin(deadline_s=5.0)
+    assert served.meta["state"] == "draining"
+    assert summary["state"] == "draining"
+    assert summary["quiesced"] is True  # idle engine quiesces immediately
+    assert summary["deadline_margin_s"] > 0
+    assert fired == [1]
+    assert not coord.ledger.draining  # lease released on the way out
+
+
+async def test_drain_endpoint():
+    served = _Served()
+    coord = DrainCoordinator(_IdleEngine(), served, ckpt_dir=None)
+    server = StatusServer(HealthState(), drain_fn=coord.begin)
+    bare = StatusServer(HealthState())  # no drain handler wired
+    await server.start()
+    await bare.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{bare.port}/drain", json={"deadline_s": 1}
+            )
+            assert r.status == 409  # this component cannot drain
+
+            r = await s.post(
+                f"http://127.0.0.1:{server.port}/drain",
+                json={"deadline_s": "not-a-number"},
+            )
+            assert r.status == 400
+
+            r = await s.post(
+                f"http://127.0.0.1:{server.port}/drain", json={"deadline_s": 1.0}
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["state"] == "draining"
+            assert served.meta["state"] == "draining"
+    finally:
+        await server.stop()
+        await bare.stop()
+
+
+def test_planner_prewarms_announced_reclaims():
+    cfg = PlannerConfig(min_replicas=1, max_replicas=16)
+    pool = PoolPlanner("decode", "backend", None, cfg, lambda s: 100.0)
+    pool.observe(100.0)  # steady state: exactly 1 replica of capacity
+    base = pool.desired_replicas(LoadSnapshot())
+    # two announced reclaims = two replicas of capacity already spoken for:
+    # their replacements are requested BEFORE the deadline, not after the
+    # post-kill latency spike
+    bumped = pool.desired_replicas(LoadSnapshot(announced_reclaims=2))
+    assert bumped == base + 2
+
+
+def test_metrics_source_reclaim_window():
+    now = [100.0]
+    src = EventPlaneMetricsSource(None, "ns", [], clock=lambda: now[0])
+    src.note_reclaim(7, deadline_ts=130.0)
+    src.note_reclaim(8, deadline_ts=110.0)
+    assert src.snapshot().announced_reclaims == 2
+    now[0] = 115.0  # worker 8's deadline passed: it is dead, not announced
+    assert src.snapshot().announced_reclaims == 1
+    src.note_reclaim(7, deadline_ts=117.0)  # a later notice moves the deadline
+    now[0] = 118.0
+    assert src.snapshot().announced_reclaims == 0
+    src.clear_reclaim(7)  # idempotent on an already-expired entry
+    src.note_reclaim(9, deadline_ts=200.0)
+    src.clear_reclaim(9)  # cancelled notice
+    assert src.snapshot().announced_reclaims == 0

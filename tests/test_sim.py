@@ -272,6 +272,58 @@ def test_http_frontend_smoke():
     assert any(st == "open" for _, st in http["breaker_transitions"])
 
 
+def test_elastic_reclaim_smoke():
+    """ISSUE 16 acceptance: 30% of a loaded fleet killed on a 30s announced
+    deadline — zero lost requests, draining workers excluded from routing
+    and migration, sealed KV evacuated to bandwidth-priced destinations,
+    checkpoints committed inside the deadline, and restored workers serve
+    their victims' hot prompts at warm-cache TTFT."""
+    rep = run_scenario("elastic-reclaim", seed=0, workers=6, duration_s=120.0)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    by_name = {iv["name"]: iv for iv in rep["sim"]["invariants"]}
+    assert by_name["zero_lost_requests"]["ok"]
+    assert by_name["long_decodes_migrated"]["ok"]  # the kill cut live decodes
+    assert by_name["restored_warm"]["ok"]
+    assert by_name["warm_restore_ttft"]["ok"]
+    rc = rep["sim"]["reclaim"]
+    assert sum(d["evacuated"] for d in rc["drains"]) > 0
+    assert rc["native_wire_share"] >= 0.6  # cost-priced, not round-robin
+    assert all(d["margin_s"] > 0 for d in rc["drains"])
+
+
+def test_elastic_reclaim_same_seed_identical():
+    a = run_scenario("elastic-reclaim", seed=7, workers=6, duration_s=120.0)
+    b = run_scenario("elastic-reclaim", seed=7, workers=6, duration_s=120.0)
+    assert canonical_json(a["sim"]) == canonical_json(b["sim"])
+
+
+def test_elastic_reclaim_chaos_zero_lost():
+    """The chaos variant: evacuation streams drop mid-window (per-block
+    resume), one checkpoint dies mid-manifest-commit (detected partial ->
+    cold boot) — and still zero requests are lost."""
+    rep = run_scenario(
+        "elastic-reclaim-chaos", seed=0, workers=6, duration_s=120.0
+    )
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    by_name = {iv["name"]: iv for iv in rep["sim"]["invariants"]}
+    assert by_name["zero_lost_requests"]["ok"]
+    assert by_name["stream_drops_resumed"]["ok"]
+    assert by_name["partial_checkpoint_cold_boot"]["ok"]
+    assert rep["sim"]["pools"]["decode"]["failed"] == 0
+    modes = [r["mode"] for r in rep["sim"]["reclaim"]["restores"]]
+    assert modes.count("cold") == 1  # exactly the torn-manifest victim
+
+
+@pytest.mark.slow
+def test_elastic_reclaim_full_scale():
+    """Bigger fleet, longer horizon, 3 victims — the full acceptance run for
+    both variants."""
+    for name in ("elastic-reclaim", "elastic-reclaim-chaos"):
+        rep = run_scenario(name, seed=0, workers=10, duration_s=300.0)
+        assert rep["sim"]["passed"], (name, rep["sim"]["invariants"])
+        assert len(rep["sim"]["reclaim"]["victims"]) == 3
+
+
 # ---------------------------------------------------------------------------
 # BENCH schema + CLI
 # ---------------------------------------------------------------------------

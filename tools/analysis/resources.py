@@ -123,6 +123,33 @@ RESOURCES: Tuple[ResourceSpec, ...] = (
         self_releasing=True,  # expiry scan is the backstop; store-shaped acquire
     ),
     ResourceSpec(
+        name="drain-lease",
+        doc="The single drain slot a worker holds while it evacuates "
+            "(engine/drain.py DrainLedger): acquire_drain returns a token "
+            "(None when a drain is already running — the /drain 409 path); "
+            "an unreleased token leaves the worker advertising 'draining' "
+            "after the reclaim resolves, so no router ever sends it work "
+            "again.",
+        paths=("engine/drain.py",),
+        acquire=(("acquire_drain", ("ledger",)),),
+        release=(("release_drain", ("ledger",)),),
+        exempt_functions=("acquire_drain", "release_drain"),
+    ),
+    ResourceSpec(
+        name="checkpoint-manifest",
+        doc="The checkpoint writer's manifest tmp-file handle "
+            "(engine/checkpoint.py CheckpointWriter): begin_manifest hands "
+            "out a tmp path that must reach commit_manifest (the atomic "
+            "os.replace publish) or abort_manifest on every path out — a "
+            "dangling tmp is exactly the partial-checkpoint state restores "
+            "must treat as corrupt.",
+        paths=("engine/checkpoint.py",),
+        acquire=(("begin_manifest", ()),),
+        release=(("commit_manifest", ()), ("abort_manifest", ())),
+        exempt_functions=("begin_manifest", "commit_manifest",
+                          "abort_manifest"),
+    ),
+    ResourceSpec(
         name="kv-commit-signal",
         doc="KvCommitSignal waits are self-cleaning by construction: one "
             "shared shielded future serves every waiter and wait() never "
