@@ -676,6 +676,29 @@ async def main() -> None:
             "kv_wire": os.environ.get("DTPU_KV_WIRE", "inline"),
         }
 
+    if kvbm is not None:
+        from dynamo_tpu.kvbm.directory import GlobalKvDirectory, directory_enabled
+
+        if directory_enabled():
+            # fleet-wide KV reuse (kvbm/directory.py): rank 0 owns the host
+            # tiers, so it advertises sealed blocks under a store lease and
+            # serves peer pulls over the kv_fetch transfer plane — start
+            # that plane even in aggregated mode, where --disagg did not.
+            gkv_addr = transfer_md.get("transfer_address")
+            if gkv_addr is None:
+                gkv_addr = await engines[0].serve_transfer(host=cfg.host_ip)
+                print(f"KV_TRANSFER at {gkv_addr}", flush=True)
+                transfer_md = {
+                    "transfer_address": gkv_addr,
+                    "kv_wire": os.environ.get("DTPU_KV_WIRE", "inline"),
+                }
+            kv_directory = GlobalKvDirectory(
+                runtime.store, f"worker/{instance_id}", address=gkv_addr,
+                metrics=runtime.metrics,
+            )
+            await kv_directory.start()
+            engines[0].kv_directory = kv_directory
+
     # parser names fail FAST at worker startup (the frontend's _safe_parser
     # degrades unknown names to pass-through with only a warning); gpt-oss
     # presets default to the harmony dialect + its reasoning channels

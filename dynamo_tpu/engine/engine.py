@@ -525,6 +525,10 @@ class TpuEngine:
         # multi-tier KV (kvbm/pool.py): sealed blocks write through to host
         # DRAM (G2) / disk (G3); admission onboards matched prefixes back
         self.kvbm = kvbm
+        # fleet-wide KV reuse (kvbm/directory.py): serving glue attaches a
+        # GlobalKvDirectory so tier offloads/evictions advertise/withdraw
+        # on the shared directory plane (maintained in _publish_events)
+        self.kv_directory = None
         # (block_id, seq_hash, priority): 0 = prompt-prefix blocks (highest
         # reuse odds -> offload first), 1 = decode-sealed blocks; the kvbm
         # priority queue transfers in that order (kvbm/pool.py OffloadQueue,
@@ -2405,25 +2409,55 @@ class TpuEngine:
         # disaggregated decode: pull the prefill worker's KV pages first so
         # admission sees them as a cached prefix (no recompute)
         flight = get_flight_recorder()
-        if req.kv_transfer and req.kv_transfer.get("address"):
+        kv_plan = req.kv_transfer
+        if (kv_plan and kv_plan.get("tier")
+                and getattr(self, "kv_directory", None) is not None
+                and kv_plan.get("holder") == self.kv_directory.holder):
+            # the planner picked us as the peer: our own G2/G3 already holds
+            # these blocks, and the kvbm onboard below imports them without
+            # a loopback wire copy. Drop the plan instead of self-fetching.
+            kv_plan = None
+        if kv_plan and kv_plan.get("address"):
+            # global-directory plan (tier=True): pull from the peer's KVBM
+            # G2/G3 tiers instead of its device cache. The fetch holds a
+            # directory fetch lease that MUST be discharged on every path
+            # (RESOURCE-LEAK "fetch-lease"): commit on any import, abort on
+            # zero progress or failure — abort IS the recompute fallback,
+            # never a stuck request.
+            is_tier = bool(kv_plan.get("tier"))
+            fetch_lease = (
+                self.kv_directory.begin_fetch(
+                    kv_plan.get("holder", ""),
+                    [int(h) for h in kv_plan.get("hashes", [])],
+                )
+                if is_tier and self.kv_directory is not None else None
+            )
             try:
                 got = await self._get_transfer_client().fetch_and_import(
-                    req.kv_transfer["address"],
-                    [int(h) for h in req.kv_transfer.get("hashes", [])],
+                    kv_plan["address"],
+                    [int(h) for h in kv_plan.get("hashes", [])],
                     traceparent=req.annotations.get("traceparent"),
-                    stream=bool(req.kv_transfer.get("stream")),
+                    stream=bool(kv_plan.get("stream")),
+                    tier=is_tier,
                 )
+                if fetch_lease is not None:
+                    if got > 0:
+                        self.kv_directory.commit_fetch(fetch_lease, got)
+                    else:
+                        self.kv_directory.abort_fetch(fetch_lease)
                 log.debug("imported %d transferred kv tokens for %s", got, req.request_id[:8])
                 flight.record(
                     req.request_id, "transfer",
-                    tokens=got, address=req.kv_transfer["address"],
+                    tokens=got, address=kv_plan["address"],
                 )
             except Exception as e:
+                if fetch_lease is not None:
+                    self.kv_directory.abort_fetch(fetch_lease)
                 log.exception("kv transfer failed; recomputing prefill locally")
                 flight.record(
                     req.request_id, "transfer",
                     tokens=0, error=str(e)[:200],
-                    address=req.kv_transfer["address"],
+                    address=kv_plan["address"],
                 )
         if self.kvbm is not None:
             try:
@@ -2483,6 +2517,17 @@ class TpuEngine:
     def stop(self) -> None:
         if self._loop_task is not None:
             self._loop_task.cancel()
+        if getattr(self, "kv_directory", None) is not None:
+            # drained worker checkpointing out: revoke the directory lease so
+            # every advertisement withdraws in one call (peers stop planning
+            # fetches against a worker that is gone). Async close rides the
+            # running loop; with no loop, store-lease TTL expiry does it.
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None
+            if loop is not None:
+                spawn_bg(self.kv_directory.close())
         if self._transfer_server is not None:
             try:
                 loop = asyncio.get_running_loop()
@@ -4363,6 +4408,29 @@ class TpuEngine:
             ]
             if gone:
                 removed = removed + [gone]
+            if self.kv_directory is not None:
+                # fleet directory upkeep rides the same consolidated cadence:
+                # advertise fresh tier offloads, withdraw what no tier holds.
+                # Best-effort — a directory-plane wobble (or armed
+                # directory.publish fault) must never stall the event loop;
+                # the TTL lease ages out anything a failed withdraw left
+                try:
+                    fresh = self.kvbm.drain_stored()
+                    by_tier: Dict[str, List[int]] = {}
+                    for h in fresh:
+                        t = self.kvbm.tier_of(h)
+                        if t is not None:
+                            by_tier.setdefault(t, []).append(h)
+                    fmt = "int8" if self.kv_quantized else "model"
+                    for t, hs in sorted(by_tier.items()):
+                        await self.kv_directory.publish(hs, t, fmt)
+                    if gone:
+                        await self.kv_directory.unpublish(gone)
+                except Exception:
+                    log.warning(
+                        "kv directory upkeep failed (continuing)",
+                        exc_info=True,
+                    )
             # a device-evicted block still in G2/G3/G4 is still servable (we
             # onboard on demand): don't tell the router it's gone — the
             # consolidated view, like the reference's kv_consolidator

@@ -462,6 +462,10 @@ class KvTransferServer:
             self._pull_pending.pop(int(request["free_pull"]), None)
             yield {"ok": True}
             return
+        if request.get("tier"):
+            async for item in self._handle_tier_stream(request):
+                yield item
+            return
         if request.get("stream"):
             async for item in self._handle_stream(request):
                 yield item
@@ -581,6 +585,73 @@ class KvTransferServer:
             item["scales"] = scales
             nbytes += len(scales)
         return item, nbytes
+
+    async def _handle_tier_stream(self, request: Any) -> AsyncIterator[Dict]:
+        """Serve sealed blocks straight from the KVBM host/disk tiers
+        (G2/G3) as block windows — the fleet-wide KV reuse serve path
+        (kvbm/directory.py). Blocks ship in their STORAGE format, which is
+        already block-major: float caches [L, 2, bs, kvh, d] model dtype,
+        int8 caches the flat codec buffer — both bit-exact on the wire, no
+        re-encode on either side. Unlike the device-cache stream there is
+        no commit signal to wait on (tier blocks are sealed: present or
+        not) and no arena leases to reclaim; the run simply ends at the
+        first hash no local tier holds (the client recomputes the rest).
+        Per-block crc32 lets the client reject torn disk reads."""
+        import asyncio
+
+        t_serve = time.time_ns()
+        hashes: List[SequenceHash] = list(request.get("hashes", []))
+        n = len(hashes)
+        window = max(1, int(request.get("window") or STREAM_WINDOW_BLOCKS))
+        kvbm = getattr(self.engine, "kvbm", None)
+        served = 0
+        nbytes_total = 0
+        loop = asyncio.get_event_loop()
+        while kvbm is not None and served < n:
+            blocks: List[np.ndarray] = []
+            tier = "g2"
+            for h in hashes[served : served + window]:
+                # disk reads block; keep them off the event loop
+                got = await loop.run_in_executor(None, kvbm.get_block, h)
+                if got is None:
+                    break
+                b, b_tier = got
+                if blocks and (
+                    b.shape != blocks[0].shape or b.dtype != blocks[0].dtype
+                ):
+                    break  # mixed storage formats: end the run, don't mix
+                blocks.append(b)
+                tier = b_tier if b_tier == "g3" or tier == "g2" else tier
+            if not blocks:
+                break
+            arr = np.stack(blocks)
+            data = arr.tobytes()
+            item = {
+                "matched": len(blocks),
+                "offset": served,
+                "data": data,
+                "dtype": arr.dtype.name,
+                "shape": list(arr.shape),
+                "fmt": "int8" if arr.ndim == 2 else "model",
+                "tier": tier,
+                # uint8 view, not .data: bf16 arrays refuse the PEP-3118
+                # buffer export ("cannot include dtype 'E' in a buffer")
+                "crc32": [
+                    zlib.crc32(np.ascontiguousarray(b).view(np.uint8))
+                    for b in blocks
+                ],
+            }
+            if arr.ndim == 2:
+                # flat int8 codec buffers: ship the logical block shape so a
+                # peer (possibly float-cached) can build the decode codec
+                item["block_shape"] = self._block_shape
+            yield item
+            served += len(blocks)
+            nbytes_total += len(data)
+            if len(blocks) < window:
+                break  # run ended mid-window: nothing further is held
+        self._trace_serve(request, t_serve, "tier", served, nbytes_total)
+        yield {"eof": True, "served": served, "of": n}
 
     async def _handle_stream(self, request: Any) -> AsyncIterator[Dict]:
         """Block-window streaming fetch: serve committed blocks as windows,
@@ -876,6 +947,7 @@ class KvTransferClient:
     async def fetch_and_import(
         self, address: str, hashes: List[SequenceHash],
         traceparent: Optional[str] = None, stream: bool = False,
+        tier: bool = False,
     ) -> int:
         """Pull blocks for ``hashes`` from ``address``; returns tokens imported.
 
@@ -887,6 +959,11 @@ class KvTransferClient:
         import as the serving side commits them, overlapping the wire with
         the prefill side's remaining compute; a mid-stream loss resumes
         from the first un-imported block (never a whole-request restart).
+
+        ``tier=True`` pulls from the peer's KVBM host/disk tiers (G2/G3)
+        instead of its device cache — the fleet-wide KV reuse onboard path
+        (kvbm/directory.py): same per-block resume semantics, blocks arrive
+        in storage format and import bit-exactly for both float and int8.
 
         ``traceparent`` continues the request's trace: a ``kv.transfer.pull``
         span (wire path + bytes + blocks) is emitted here and shipped in the
@@ -903,7 +980,10 @@ class KvTransferClient:
         status = "OK"
         tokens = 0
         try:
-            tokens = await self._pull(address, hashes, traceparent, info, stream)
+            if tier:
+                tokens = await self._pull_tier(address, hashes, traceparent, info)
+            else:
+                tokens = await self._pull(address, hashes, traceparent, info, stream)
             return tokens
         except Exception:
             status = "ERROR"
@@ -1095,6 +1175,122 @@ class KvTransferClient:
         if failed and not moved_total:
             return None  # nothing moved: let the caller try the wire
         return moved_total
+
+    async def _pull_tier(
+        self, address: str, hashes: List[SequenceHash],
+        traceparent: Optional[str], info: Dict[str, Any],
+    ) -> int:
+        """Onboard blocks from a PEER's KVBM host/disk tiers (G2/G3) — the
+        global-directory fetch path (kvbm/directory.py). Same resume
+        discipline as ``_pull_stream``: each window imports as it lands, a
+        mid-stream loss re-requests from the first un-imported block, and
+        STREAM_MAX_RESUMES progress-less attempts abandon the suffix to
+        recompute. Blocks arrive in the peer's storage format: ``model``
+        windows are already block-major pages; ``int8`` windows are flat
+        codec buffers decoded to the (payload, scales) pair — both import
+        bit-exactly (a float window at an int8 cache quantizes on scatter,
+        and vice versa dequantizes, exactly like every other wire)."""
+        import asyncio
+
+        alloc = self.engine.allocator
+        have = len(alloc.match_prefix(hashes))
+        want = list(hashes[have:])
+        n = len(want)
+        if n == 0:
+            return have * alloc.block_size
+        imported = 0
+        resumes = 0
+        while imported < n:
+            req: Dict[str, Any] = {
+                "tier": True,
+                "hashes": [int(h) for h in want[imported:]],
+                "window": STREAM_WINDOW_BLOCKS,
+            }
+            if traceparent:
+                req["traceparent"] = traceparent
+            eof = False
+            progressed = False
+            try:
+                await FAULTS.ainject("fetch.peer_tier")
+                stream = await self._tcp.call(address, req)
+                t_prev = time.monotonic()
+                async for item in stream:
+                    if item.get("eof"):
+                        eof = True
+                        break
+                    # chaos hook: a mid-fetch window fault drops the stream
+                    # through the real per-block resume path (no-op unarmed)
+                    await FAULTS.ainject("fetch.peer_tier")
+                    m = int(item.get("matched", 0))
+                    if m <= 0:
+                        continue
+                    raw = np.frombuffer(
+                        item.get("data", b""),
+                        _dtype_from_name(item.get("dtype", "float32")),
+                    ).reshape(item.get("shape", []))
+                    crcs = item.get("crc32")
+                    if crcs is not None and any(
+                        zlib.crc32(np.ascontiguousarray(raw[i]).view(np.uint8))
+                        != crcs[i]
+                        for i in range(m)
+                    ):
+                        # torn tier read server-side: don't import poison
+                        # under a valid content hash — recompute instead
+                        log.warning(
+                            "peer-tier window checksum mismatch from %s; "
+                            "abandoning fetch at %d/%d blocks",
+                            address, imported, n,
+                        )
+                        info["blocks"] = imported
+                        return (have + imported) * alloc.block_size
+                    if item.get("fmt") == "int8":
+                        from ..kvbm.layout import BlockShape, QuantizedBlockCodec
+
+                        L, _, bs, kvh, d = item["block_shape"]
+                        codec = QuantizedBlockCodec(BlockShape(
+                            num_layers=L, block_size=bs, num_kv_heads=kvh,
+                            head_dim=d, dtype=np.dtype(np.int8),
+                        ))
+                        block_major = codec.decode_many(raw)
+                    else:
+                        block_major = raw  # storage format IS block-major
+                    leg = max(time.monotonic() - t_prev, 1e-9)
+                    w_hashes = list(want[imported : imported + m])
+                    got = await self.engine.import_blocks(w_hashes, block_major)
+                    info["wire"] = "tier"
+                    info["bytes"] += len(item.get("data", b""))
+                    info["xfer_s"] += leg
+                    imported += got
+                    progressed = progressed or got > 0
+                    if got < m:
+                        # local allocator full: keep what landed
+                        info["blocks"] = imported
+                        return (have + imported) * alloc.block_size
+                    t_prev = time.monotonic()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning(
+                    "peer-tier fetch from %s lost after %d/%d blocks (%r); "
+                    "resuming from the first missing block",
+                    address, imported, n, e,
+                )
+            if eof:
+                break
+            if progressed:
+                resumes = 0
+            else:
+                resumes += 1
+                if resumes > STREAM_MAX_RESUMES:
+                    log.warning(
+                        "peer-tier fetch from %s exhausted %d resume attempts "
+                        "at %d/%d blocks; recomputing the remaining suffix",
+                        address, STREAM_MAX_RESUMES, imported, n,
+                    )
+                    break
+                await asyncio.sleep(min(0.05 * resumes, 0.5))
+        info["blocks"] = imported
+        return (have + imported) * alloc.block_size
 
     async def _pull_stream(
         self, address: str, want: List[SequenceHash],

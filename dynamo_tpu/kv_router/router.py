@@ -341,6 +341,7 @@ class KvRouter:
         extra_costs: Optional[Dict[WorkerWithDpRank, float]],
         match_hashes: Sequence[int],
         query_blocks: int,
+        fetchable: Optional[Dict[WorkerWithDpRank, float]] = None,
     ) -> SchedulingDecision:
         """The two-stage selection shared by schedule_tokens/score_tokens:
         prune to ~2-3K candidates when the eligible universe is large, then
@@ -400,6 +401,7 @@ class KvRouter:
         return sched.select_worker(
             pool, overlaps, query_blocks=query_blocks,
             tree_sizes=tree_sizes, extra_costs=extra_costs,
+            fetchable=fetchable,
         )
 
     def schedule_tokens(
@@ -411,6 +413,7 @@ class KvRouter:
         extra_costs: Optional[Dict[WorkerWithDpRank, float]] = None,
         hashes: Optional[Sequence[int]] = None,
         excluded=None,
+        fetchable: Optional[Dict[WorkerWithDpRank, float]] = None,
     ) -> SchedulingDecision:
         """Multimodal prompts (image placeholder runs hash identically
         across different images) must not produce overlap estimates or
@@ -434,6 +437,7 @@ class KvRouter:
             candidates, excluded, extra_costs,
             match_hashes=(hashes if cacheable else []),
             query_blocks=len(hashes),
+            fetchable=fetchable,
         )
         new_blocks = decision.query_blocks - decision.overlap_blocks
         if self._hit_tokens is not None and decision.overlap_blocks > 0:
@@ -469,6 +473,7 @@ class KvRouter:
         extra_costs: Optional[Dict[WorkerWithDpRank, float]] = None,
         hashes: Optional[Sequence[int]] = None,
         excluded=None,
+        fetchable: Optional[Dict[WorkerWithDpRank, float]] = None,
     ) -> SchedulingDecision:
         """Stateless pick: same overlap+load scoring as schedule_tokens but
         NO side effects — no optimistic load charge, no in-flight tracking,
@@ -489,6 +494,7 @@ class KvRouter:
         return self._decide(
             candidates, excluded, extra_costs,
             match_hashes=hashes, query_blocks=query_blocks,
+            fetchable=fetchable,
         )
 
     def commit_route(

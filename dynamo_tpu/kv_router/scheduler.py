@@ -261,13 +261,22 @@ class KvScheduler:
         query_blocks: int,
         tree_sizes: Optional[Dict[WorkerWithDpRank, int]] = None,
         extra_costs: Optional[Dict[WorkerWithDpRank, float]] = None,
+        fetchable: Optional[Dict[WorkerWithDpRank, float]] = None,
     ) -> SchedulingDecision:
         """``extra_costs`` adds a per-candidate cost in BLOCK units to the
         logit — the transfer-cost-aware term (NetKV-style): disagg routing
         passes each prefill candidate's estimated wire time for the KV it
         would have to ship, normalized by the per-block prefill time, so a
         candidate behind a slow wire loses to one a device hop away even at
-        equal queue depth."""
+        equal queue depth.
+
+        ``fetchable`` is the directory-aware term (kvbm/directory.py): per
+        candidate, how many of the query's blocks it could onboard from a
+        peer's G2/G3 tier cheaper than recomputing — in EFFECTIVE block
+        units, i.e. already discounted by the fetch/recompute cost ratio
+        (ops/costs.fetch_vs_recompute), so a fleet-hot prefix shrinks a
+        cold worker's potential-prefill term without ever counting a
+        fetched block as free."""
         if not candidates:
             raise ValueError("no candidate workers")
         w = self.config.overlap_score_weight
@@ -275,6 +284,12 @@ class KvScheduler:
         for cand in candidates:
             overlap = overlaps.scores.get(cand, 0)
             potential_prefill = max(0, query_blocks - overlap)
+            if fetchable:
+                # a block can't be both locally cached and discounted again:
+                # the fetchable term only shrinks what overlap left behind
+                potential_prefill = max(
+                    0.0, potential_prefill - fetchable.get(cand, 0.0)
+                )
             logits[cand] = (
                 w * potential_prefill + self.decode_blocks(cand)
                 + (extra_costs.get(cand, 0.0) if extra_costs else 0.0)

@@ -297,6 +297,10 @@ class KvbmTiers:
         # turns these into router 'removed' events so the index stays honest)
         self._evicted: List[SequenceHash] = []
         self._evicted_lock = threading.Lock()
+        # hashes newly written to a local tier since the last drain — the
+        # engine turns these into global-directory advertisements
+        # (kvbm/directory.py); same consolidated cadence as _evicted
+        self._stored: List[SequenceHash] = []
         self.queue = OffloadQueue(offload_queue_depth)
         self._worker: Optional[threading.Thread] = None
 
@@ -325,6 +329,8 @@ class KvbmTiers:
         if self.remote is not None:
             self.remote.store(h, block)
         self.offloaded += 1
+        with self._evicted_lock:
+            self._stored.append(h)
 
     # -- prioritized async offload (offload.rs analog) -----------------------
     def offload(self, h: SequenceHash, block: np.ndarray, priority: int = 1) -> None:
@@ -375,6 +381,13 @@ class KvbmTiers:
             out, self._evicted = self._evicted, []
         return out
 
+    def drain_stored(self) -> List[SequenceHash]:
+        """Hashes newly offloaded to a local tier since the last drain
+        (directory advertisement feed)."""
+        with self._evicted_lock:
+            out, self._stored = self._stored, []
+        return out
+
     def clear(self, host: bool = True, disk: bool = True) -> Dict[str, int]:
         """Controller reset of local tiers (G2/G3). Evicted hashes feed the
         normal consolidated-event path (drain_evicted), so the router only
@@ -393,6 +406,30 @@ class KvbmTiers:
             with self._evicted_lock:
                 self._evicted.extend(gone)
         return counts
+
+    def tier_of(self, h: SequenceHash) -> Optional[str]:
+        """Which LOCAL tier holds ``h`` ("g2" host, "g3" disk), or None.
+        Feeds the global KV directory's tier advertisements."""
+        if h in self.host:
+            return "g2"
+        if self.disk is not None and h in self.disk:
+            return "g3"
+        return None
+
+    def get_block(
+        self, h: SequenceHash
+    ) -> Optional[Tuple[np.ndarray, str]]:
+        """Read ONE block from a local tier WITHOUT G3->G2 promotion —
+        serving a peer's fetch must not churn this worker's host LRU on the
+        peer's behalf. Returns (block, tier) or None."""
+        b = self.host.get(h)
+        if b is not None:
+            return b, "g2"
+        if self.disk is not None:
+            b = self.disk.get(h)
+            if b is not None:
+                return b, "g3"
+        return None
 
     def filter_servable(self, hashes: List[SequenceHash]) -> List[SequenceHash]:
         """Subset of ``hashes`` still servable from ANY tier (remote queried

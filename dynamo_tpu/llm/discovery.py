@@ -122,6 +122,9 @@ class ModelPipeline:
         self._rr = 0  # non-KV fallback round-robin over non-shunned workers
         # disaggregation: set when a prefill pool is registered for this model
         self.prefill_router = None
+        # fleet-wide KV reuse (DTPU_GLOBAL_KV): lookup-only directory client
+        # + fetch-vs-recompute planner, built in start() when enabled
+        self.global_kv = None
 
     def _worker_cb(self, iid: int) -> CircuitBreaker:
         cb = self._worker_breakers.get(iid)
@@ -200,6 +203,25 @@ class ModelPipeline:
                 config=self.kv_router_config,
                 metrics=self.runtime.metrics,
             ).start()
+        from ..kvbm.directory import GlobalKvDirectory, directory_enabled
+
+        if directory_enabled():
+            # lookup-only client on the shared directory plane: the frontend
+            # never publishes (no lease needed), it only resolves misses
+            from .prefill_router import GlobalKvFetchPlanner
+
+            directory = GlobalKvDirectory(
+                self.runtime.store, f"frontend/{self.card.name}",
+                metrics=self.runtime.metrics,
+            )
+            adv = int(
+                getattr(self.card.runtime_config, "kv_bytes_per_block", 0) or 0
+            )
+            self.global_kv = GlobalKvFetchPlanner(
+                directory,
+                block_size=self.card.kv_block_size,
+                kv_bytes_per_block=adv,
+            )
         return self
 
     async def stop(self) -> None:
@@ -522,6 +544,31 @@ class ModelPipeline:
                     req.kv_transfer.pop("stream", None)
                 if req.stop.max_tokens is not None:
                     req.stop.max_tokens -= 1
+        if self.global_kv is not None and not req.kv_transfer:
+            # fleet-wide KV reuse: the aggregated/deflected path recomputes
+            # its whole miss locally — unless some other worker's G2/G3 tier
+            # already holds the sealed blocks and fetching them beats the
+            # recompute (kvbm/directory.py + ops/costs.fetch_vs_recompute).
+            # Planning failure (directory fault, stale entries) just means
+            # no plan: the request proceeds exactly as before.
+            try:
+                bs = self.global_kv.block_size
+                hashes = compute_sequence_hashes(req.token_ids, bs)
+                fetch = await self.global_kv.plan_fetch(
+                    req, hashes,
+                    overlap_blocks=self._decode_overlap(req, (
+                        hashes if self.kv_router is not None
+                        and self.kv_router.block_size == bs else None
+                    )),
+                )
+                if fetch is not None:
+                    req = PreprocessedRequest.from_obj(req.to_obj())
+                    req.kv_transfer = fetch
+            except Exception:
+                log.warning(
+                    "global kv fetch planning failed; recomputing locally",
+                    exc_info=True,
+                )
         first = offset == 0
         try:
             async for out in self.migration.generate(req, context):

@@ -134,6 +134,108 @@ class PrefillPlan:
         return self.deflect_reason is not None
 
 
+class GlobalKvFetchPlanner:
+    """Fleet-wide KV reuse planning on the frontend (kvbm/directory.py).
+
+    On a local radix miss, the missing prefix may be sealed in some OTHER
+    worker's G2/G3 tier. This planner looks the miss up in the global block
+    directory, prices onboard-from-peer-tier against recompute
+    (``ops/costs.fetch_vs_recompute``, fed by the same wire-bandwidth EWMA
+    the disagg hop prices with plus the holder tier's read latency), and —
+    when fetching wins — returns a ``kv_transfer`` plan (``tier=True``)
+    that streams the blocks from the holder over the block-window protocol
+    instead of re-prefilling them. Directory staleness, a dead holder or a
+    mid-fetch loss all degrade to recompute on the worker (engine-side
+    fallback); the plan is advisory, never load-bearing for correctness."""
+
+    # the tier wire class the fetch path observes into the bandwidth EWMA
+    # (engine/transfer.py _pull_tier); unseen it prices at the inline prior
+    WIRE = "tier"
+
+    def __init__(
+        self,
+        directory,
+        *,
+        block_size: int,
+        kv_bytes_per_block: int = 0,
+        prefill_block_time_s: float = 0.010,
+        prefill_base_s: float = 0.0,
+        margin: Optional[float] = None,
+        min_run_blocks: int = 1,
+        bandwidth=None,
+    ):
+        from ..kvbm.directory import fetch_margin
+
+        self.directory = directory
+        self.block_size = int(block_size)
+        self.kv_bytes_per_block = int(
+            kv_bytes_per_block or _DEFAULT_KV_BYTES_PER_BLOCK
+        )
+        self.prefill_block_time_s = float(prefill_block_time_s)
+        self.prefill_base_s = float(prefill_base_s)
+        self.margin = float(margin if margin is not None else fetch_margin())
+        self.min_run_blocks = max(1, int(min_run_blocks))
+        self.bandwidth = bandwidth or get_bandwidth_estimator()
+
+    def price(self, num_blocks: int, tier: str = "g2") -> Dict:
+        """The fetch-vs-recompute verdict for ``num_blocks`` missing blocks
+        (ops/costs.fetch_vs_recompute, tier-1 grid-gated)."""
+        from ..ops.costs import fetch_vs_recompute
+
+        return fetch_vs_recompute(
+            num_blocks,
+            block_size=self.block_size,
+            kv_bytes_per_block=self.kv_bytes_per_block,
+            bandwidth_bytes_s=self.bandwidth.bandwidth(self.WIRE),
+            prefill_base_s=self.prefill_base_s,
+            prefill_per_token_s=self.prefill_block_time_s / self.block_size,
+            tier=tier,
+            margin=self.margin,
+        )
+
+    async def plan_fetch(
+        self,
+        req: PreprocessedRequest,
+        hashes: List[int],
+        overlap_blocks: int,
+        exclude_holder: Optional[str] = None,
+    ) -> Optional[Dict]:
+        """Return a ``kv_transfer`` plan dict for the request's missing
+        prefix, or None to recompute. ``overlap_blocks`` is the decode
+        pool's best local radix overlap (those blocks never fetch);
+        ``hashes`` must be at this planner's block size."""
+        miss = [int(h) for h in hashes[overlap_blocks:]]
+        if len(miss) < self.min_run_blocks:
+            return None
+        run = await self.directory.lookup_run(
+            miss, exclude_holder=exclude_holder
+        )
+        if len(run) < self.min_run_blocks:
+            return None  # nobody (live) holds the prefix: plain recompute
+        head = run[0]
+        verdict = self.price(len(run), tier=head.tier)
+        get_flight_recorder().record(
+            req.request_id, "global_kv_plan",
+            holder=head.holder, tier=head.tier, blocks=len(run),
+            fetch_s=round(verdict["fetch_s"], 6),
+            recompute_s=round(verdict["recompute_s"], 6),
+            fetch_wins=verdict["fetch_wins"],
+        )
+        if not verdict["fetch_wins"] or not head.address:
+            # the directory HAD the prefix but recompute prices cheaper
+            # (or the holder advertises no fetch endpoint)
+            self.directory.record_outcome("recomputed")
+            return None
+        return {
+            "address": head.address,
+            "hashes": [e.hash for e in run],
+            "num_tokens": len(run) * self.block_size,
+            "tier": True,
+            "holder": head.holder,
+            "est_fetch_s": verdict["fetch_s"],
+        }
+
+
 class PrefillRouter:
     def __init__(
         self,

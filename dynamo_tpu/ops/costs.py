@@ -371,6 +371,82 @@ def streamed_transfer_model(
     }
 
 
+# tier read-latency priors (seconds per block window): G2 host DRAM is a
+# memcpy, G3 disk a file read. Only the RATIO to wire time matters for the
+# decision; absolute values are deliberately conservative.
+TIER_READ_S_PER_BLOCK = {"g2": 2e-4, "g3": 2e-3}
+
+
+def fetch_vs_recompute(
+    num_blocks: int,
+    *,
+    block_size: int,
+    kv_bytes_per_block: int,
+    bandwidth_bytes_s: float,
+    prefill_base_s: float,
+    prefill_per_token_s: float,
+    tier: str = "g2",
+    window_blocks: int = 8,
+    handshake_s: float = 0.01,
+    tier_read_s_per_block: float = None,
+    margin: float = 1.0,
+) -> Dict[str, Any]:
+    """Deterministic price of onboarding ``num_blocks`` sealed KV blocks
+    from a peer worker's G2/G3 tier vs recomputing them as local prefill —
+    the global-directory routing decision (ROADMAP item 3).
+
+    Fetch is pipelined in ``window_blocks`` windows over one wire: the
+    peer reads a window from its tier while the previous window is in
+    flight, so steady state pays ``max(wire, tier read)`` per window plus
+    the first window's un-overlapped tier read and the handshake.
+    Recompute pays the local prefill model for the same tokens.
+
+    ``fetch`` is chosen iff ``fetch_s <= margin * recompute_s`` — so
+    "wherever the router chooses fetch, fetch is no slower than
+    recompute" holds *by construction* for ``margin <= 1`` (the tier-1
+    grid gate asserts exactly this over wire/tier/block-count
+    combinations). Pure function of its arguments; ``bench.py`` feeds the
+    same model from the wire-bandwidth EWMA at run time.
+    """
+    n = max(int(num_blocks), 0)
+    bw = max(float(bandwidth_bytes_s), 1.0)
+    read_s = (
+        float(tier_read_s_per_block)
+        if tier_read_s_per_block is not None
+        else TIER_READ_S_PER_BLOCK.get(tier, TIER_READ_S_PER_BLOCK["g3"])
+    )
+    win = max(int(window_blocks), 1)
+    n_windows = -(-n // win) if n else 0
+    window_wire_s = win * kv_bytes_per_block / bw
+    window_read_s = win * read_s
+    if n:
+        # last window may be partial; pricing it full keeps the model
+        # monotone in num_blocks (a conservative over-estimate of fetch)
+        fetch_s = (
+            handshake_s
+            + window_read_s
+            + n_windows * max(window_wire_s, window_read_s)
+        )
+    else:
+        fetch_s = 0.0
+    recompute_s = (
+        prefill_base_s + n * block_size * prefill_per_token_s if n else 0.0
+    )
+    fetch_wins = n > 0 and fetch_s <= margin * recompute_s
+    return {
+        "num_blocks": n,
+        "tier": tier,
+        "bytes": n * int(kv_bytes_per_block),
+        "bandwidth_bytes_s": round(bw, 1),
+        "window_blocks": win,
+        "fetch_s": round(fetch_s, 6),
+        "recompute_s": round(recompute_s, 6),
+        "fetch_wins": bool(fetch_wins),
+        "margin": float(margin),
+        "speedup": round(recompute_s / fetch_s, 4) if fetch_s > 0 else 1.0,
+    }
+
+
 def mixed_vs_split(
     chunk_len: int,
     chunk_total_len: int,

@@ -94,6 +94,13 @@ ENV_DEFLECT_OVERLAP = "DTPU_DEFLECT_OVERLAP"          # decode-pool radix-hit de
 ENV_DEFLECT_MARGIN = "DTPU_DEFLECT_MARGIN"            # load-skew deflection margin
 ENV_PREFILL_BLOCK_MS = "DTPU_PREFILL_BLOCK_MS"        # per-block prefill cost prior
 ENV_KV_BYTES_PER_BLOCK = "DTPU_KV_BYTES_PER_BLOCK"    # wire-cost bytes/block override
+# fleet-wide KV reuse (kvbm/directory.py, llm/prefill_router.py): the global
+# content-addressed block directory over the discovery plane + the
+# fetch-vs-recompute decision (ops/costs.py)
+ENV_GLOBAL_KV = "DTPU_GLOBAL_KV"                      # global KV directory on/off
+ENV_GLOBAL_KV_TTL_S = "DTPU_GLOBAL_KV_TTL_S"          # directory entry ttl (s)
+ENV_GLOBAL_KV_DEDUPE = "DTPU_GLOBAL_KV_DEDUPE"        # max advertised holders per hash
+ENV_GLOBAL_KV_FETCH_MARGIN = "DTPU_GLOBAL_KV_FETCH_MARGIN"  # fetch <= margin*recompute gate
 # planned reclaims + checkpoint/restore (engine/drain.py, engine/checkpoint.py)
 ENV_DRAIN_DEADLINE_S = "DTPU_DRAIN_DEADLINE_S"        # default reclaim deadline (s)
 ENV_DRAIN_MARGIN_S = "DTPU_DRAIN_MARGIN_S"            # stop evacuating this early (s)

@@ -92,6 +92,20 @@ class KVStore:
         raw = await self.get(key)
         return None if raw is None else msgpack.unpackb(raw, raw=False)
 
+    async def list_obj(self, prefix: str) -> Dict[str, object]:
+        """``list_prefix`` with msgpack decode; keys whose bytes do not
+        decode are skipped (a foreign writer under our prefix must not
+        break every scan — the global KV directory's hot lookup path)."""
+        out: Dict[str, object] = {}
+        for k, raw in (await self.list_prefix(prefix)).items():
+            try:
+                out[k] = msgpack.unpackb(raw, raw=False)
+            except (ValueError, msgpack.exceptions.ExtraData,
+                    msgpack.exceptions.FormatError,
+                    msgpack.exceptions.StackError):
+                continue
+        return out
+
 
 class Watcher:
     """Async stream of WatchEvents with explicit cancel."""

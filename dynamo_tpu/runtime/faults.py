@@ -66,6 +66,9 @@ FAULT_POINTS = (
     "checkpoint.write",          # per sealed-block checkpoint file write
     "checkpoint.manifest",       # atomic manifest commit (pre-rename)
     "restore.read",              # checkpoint manifest/block read on restore
+    "directory.publish",         # global KV directory advertisement write
+    "directory.lookup",          # global KV directory hash lookup
+    "fetch.peer_tier",           # peer G2/G3 tier fetch (client side)
 )
 
 ACTIONS = ("fail", "drop", "delay", "hang", "corrupt")

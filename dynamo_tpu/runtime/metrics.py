@@ -53,6 +53,12 @@ SLO_ATTAINMENT = f"{PREFIX}_slo_attainment_ratio"
 SLO_BURN_RATE = f"{PREFIX}_slo_burn_rate"
 GOODPUT_TOKENS = f"{PREFIX}_goodput_tokens_total"
 
+# fleet-wide KV reuse (kvbm/directory.py): global block directory + peer-
+# tier fetch accounting
+GLOBAL_KV_HITS_TOTAL = f"{PREFIX}_global_kv_hits_total"
+GLOBAL_KV_DIRECTORY_ENTRIES = f"{PREFIX}_global_kv_directory_entries"
+GLOBAL_KV_DEDUP_BLOCKS_TOTAL = f"{PREFIX}_global_kv_dedup_blocks_total"
+
 # planned reclaims (engine/drain.py, engine/checkpoint.py)
 DRAIN_EVACUATED_BLOCKS = f"{PREFIX}_drain_evacuated_blocks_total"
 DRAIN_DEADLINE_MARGIN = f"{PREFIX}_drain_deadline_margin_seconds"

@@ -42,6 +42,8 @@ from ..kv_router import (
     WorkerMetricsPublisher,
     WorkerWithDpRank,
 )
+from ..kvbm.directory import GlobalKvDirectory
+from ..ops.costs import fetch_vs_recompute
 from ..llm.protocols.common import (
     FINISH_ERROR,
     PreprocessedRequest,
@@ -57,12 +59,14 @@ from ..planner.metrics_source import (
 from ..profiler.loadgen import prefix_prompt
 from ..runtime import metrics as M
 from ..runtime.bandwidth import WireBandwidthEstimator
+from ..runtime.discovery.store import MemKVStore
 from ..runtime.engine import Context
 from ..runtime.event_plane.base import InProcEventPlane
 from ..runtime.faults import FAULTS, FaultInjected, parse_faults
 from ..runtime.logging import get_logger
 from ..runtime.resilience import CLOSED, OPEN, CircuitBreaker
 from ..runtime.slo import SlaSpec, SloAccountant
+from ..tokens import compute_sequence_hashes
 from .clock import Clock
 from .traces import SimRequest
 
@@ -91,6 +95,12 @@ _EVAC_KV_BYTES_PER_BLOCK = 32 * 1024 * 1024
 # REAL engine/checkpoint.py writer and G3 block-file codec, so chaos faults
 # and corruption detection exercise the production path
 _SIM_BLOCK_FORMAT = {"kind": "float", "dtype": "uint8", "shape": [16]}
+
+# -- fleet-wide KV reuse (kvbm/directory.py, global-kv-reuse scenario) -------
+# the peer-tier fetch rides its own "tier" wire class: a G2 host-memory read
+# streamed over the block-window protocol runs near line rate, which is what
+# makes fetch beat recompute for multi-block prefixes (ops/costs.py)
+_GLOBAL_KV_WIRE_PRIORS = {"tier": 2.0e9}
 
 
 def evac_wire_for(wid: int) -> str:
@@ -154,6 +164,16 @@ class FleetConfig:
     prefix_share: float = 0.5          # shared fraction of each group prompt
     max_attempts: int = 3              # retry-then-migrate bound per request
     faults: str = ""                   # DTPU_FAULTS-style spec armed for the run
+    # fleet-wide KV reuse (kvbm/directory.py): OFF by default so every
+    # existing scenario's report stays byte-identical; the global-kv-reuse
+    # scenario (and its counterfactual twin) flips it
+    global_kv: bool = False
+    global_kv_ttl_s: float = 120.0     # directory-entry ts aging (virtual)
+    global_kv_dedupe: int = 2          # holders per hash before publish skips
+    global_kv_margin: float = 1.0      # fetch <= margin * recompute bound
+    # wire bytes per fetched block (small-model scale: a 16-token page at
+    # this size keeps the tier wire term visible without drowning prefill)
+    global_kv_bytes_per_block: int = 2 * 1024 * 1024
     pools: List[PoolConfig] = dataclasses.field(
         default_factory=lambda: [PoolConfig()]
     )
@@ -257,6 +277,15 @@ class SimPool:
         self.drain_log: List[Dict] = []
         self.evacuated_blocks_total = 0
         self.evac_dest_wires: List[str] = []
+        # fleet-wide KV reuse (FleetConfig.global_kv): per-worker directory
+        # clients (holder key "pool/wid" — wids collide across pools) plus
+        # the deterministic counters detail.global_cache reports
+        self._dirs: Dict[int, GlobalKvDirectory] = {}
+        self.global_fetch_events = 0
+        self.global_fetched_blocks = 0
+        self.global_recomputed_blocks = 0
+        self.global_stale_skips = 0
+        self.global_resumed_fetches = 0
         # -- deterministic outputs -------------------------------------------
         self.records: List[RequestRecord] = []
         self.itls: List[float] = []
@@ -348,6 +377,16 @@ class SimPool:
         # _retire's remove_worker_id untracks), so submit passes only an
         # exclusion set — O(K) per decision instead of a fleet-sized list
         self.router.register_worker(self._cands[wid])
+        if self.fleet.kv_store is not None:
+            # no store lease in the sim: entry liveness rides the injected-
+            # clock ts (deterministic), and a killed worker's stale ads are
+            # exactly what the dead-holder fallback path must survive
+            self._dirs[wid] = GlobalKvDirectory(
+                self.fleet.kv_store, f"{self.cfg.name}/{wid}",
+                ttl_s=self.fleet.cfg.global_kv_ttl_s,
+                dedupe_replicas=self.fleet.cfg.global_kv_dedupe,
+                clock=self.clock.time,
+            )
         return wid
 
     def resize(self, n: int) -> None:
@@ -364,6 +403,11 @@ class SimPool:
         self._cands.pop(wid, None)
         self._draining.discard(wid)
         self.router.remove_worker_id(wid)
+        d = self._dirs.pop(wid, None)
+        if d is not None and d.published_count:
+            # orderly scale-down withdraws its advertisements (the prod
+            # analog is the lease revoke in GlobalKvDirectory.close)
+            self.fleet.spawn_task(d.withdraw_all())
         self.fleet.spawn_task(self._drain_stop(w))
 
     async def _drain_stop(self, w: SimWorker) -> None:
@@ -498,6 +542,10 @@ class SimPool:
                 # the directory as a partial checkpoint and cold-boots
                 summary["ckpt"] = f"failed:{type(e).__name__}"
         summary["margin_s"] = round(t_kill - self.clock.time(), 3)
+        # ---- checkpointed-out workers leave the directory cleanly ----
+        d = self._dirs.get(wid)
+        if d is not None and d.published_count:
+            summary["directory_withdrawn"] = await d.withdraw_all()
         # ---- the reclaim fires at the deadline ----
         dt = t_kill - self.clock.time()
         if dt > 0:
@@ -519,6 +567,9 @@ class SimPool:
         self._suspects.discard(wid)
         self._cands.pop(wid, None)
         self.router.remove_worker_id(wid)
+        # NOT withdrawn: a hard-killed worker leaves stale directory ads
+        # behind (the TTL ages them; until then lookups must survive them)
+        self._dirs.pop(wid, None)
         if w is not None:
             w.engine.stop()
 
@@ -640,6 +691,131 @@ class SimPool:
             )
             w.last_state = state
 
+    # -- fleet-wide KV reuse (FleetConfig.global_kv) --------------------------
+    async def _global_fetch(
+        self, wid: int, w: SimWorker, tokens: List[int]
+    ) -> None:
+        """Onboard-from-peer-tier before prefill: on a local radix miss,
+        look up the missing leading blocks in the fleet directory, price
+        fetching the longest single-holder run against recomputing it
+        (ops/costs.fetch_vs_recompute on the tier-wire EWMA), and when
+        fetch wins, seed the blocks into this worker's prefix cache after
+        the simulated wire time — the mocker then skips that prefill. A
+        holder that died after advertising (stale entry inside the TTL)
+        falls back to recompute; no path here can fail the request."""
+        d = self._dirs.get(wid)
+        bw = self.fleet.global_bw
+        if d is None or bw is None:
+            return
+        fcfg = self.fleet.cfg
+        hashes = compute_sequence_hashes(tokens, self.cfg.block_size)
+        have = w.engine.kv.cached_prefix_len(hashes)
+        miss = hashes[have:]
+        if not miss:
+            return
+        try:
+            run = await d.lookup_run(miss, exclude_holder=d.holder)
+        except (ConnectionError, FaultInjected):
+            # directory.lookup chaos: an unreachable directory degrades to
+            # plain per-worker radix, never to a failed request
+            self.global_recomputed_blocks += len(miss)
+            d.record_outcome("recomputed")
+            return
+        if not run:
+            # nobody advertises the miss: a plain local miss, not a
+            # fetch-vs-recompute decision
+            self.global_recomputed_blocks += len(miss)
+            return
+        verdict = fetch_vs_recompute(
+            len(run),
+            block_size=self.cfg.block_size,
+            kv_bytes_per_block=fcfg.global_kv_bytes_per_block,
+            bandwidth_bytes_s=bw.bandwidth("tier"),
+            prefill_base_s=self.cfg.prefill_base_s,
+            prefill_per_token_s=self.cfg.prefill_per_token_s,
+            tier=run[0].tier,
+            margin=fcfg.global_kv_margin,
+        )
+        if not verdict["fetch_wins"]:
+            self.global_recomputed_blocks += len(run)
+            d.record_outcome("recomputed")
+            return
+        holder = run[0].holder
+        pool_name, _, holder_wid = holder.rpartition("/")
+        src_pool = self.fleet.pools.get(pool_name)
+        src = (
+            src_pool.workers.get(int(holder_wid))
+            if src_pool is not None else None
+        )
+        n_run, n_miss = len(run), len(miss)
+        move_bytes = n_run * fcfg.global_kv_bytes_per_block
+        wire_s = bw.transfer_seconds("tier", move_bytes)
+        run_hashes = [e.hash for e in run]
+        dropped = False
+        try:
+            await FAULTS.ainject("fetch.peer_tier")
+        except (ConnectionError, FaultInjected):
+            # dropped mid-stream: the block-window protocol resumes from
+            # the last acked block (engine/transfer.py _pull_tier) — one
+            # extra pass of wire time, no block lost, request unharmed
+            dropped = True
+        lease = d.begin_fetch(holder, run_hashes)
+        if src is None:
+            # the advertised holder is dead (hard kill leaves its entries
+            # until the TTL): abort the fetch lease and recompute
+            d.abort_fetch(lease)
+            self.global_stale_skips += 1
+            self.global_recomputed_blocks += n_run
+            return
+        n_fresh = 0
+        try:
+            if dropped:
+                self.global_resumed_fetches += 1
+                await self.clock.sleep(wire_s)
+            await self.clock.sleep(wire_s)
+            bw.observe("tier", move_bytes, wire_s)
+            fresh: List[int] = []
+            for h in run_hashes:
+                if h in w.engine.kv.active or h in w.engine.kv.cached:
+                    continue
+                if w.engine.kv.free_blocks <= 0:
+                    break
+                w.engine.kv.cached[h] = None
+                fresh.append(h)
+            n_fresh = len(fresh)
+            if fresh and w.engine.kv_publisher is not None:
+                # publish directly (not via events_stored): an idle
+                # destination engine only drains events when it next serves
+                await w.engine.kv_publisher.stored(fresh)
+        except BaseException:
+            # cancellation (fleet teardown) mid-fetch: the lease must not
+            # strand — abort counts the miss as recomputed
+            d.abort_fetch(lease)
+            raise
+        d.commit_fetch(lease, n_fresh)
+        self.global_fetch_events += 1
+        self.global_fetched_blocks += n_fresh
+        self.global_recomputed_blocks += n_miss - n_fresh
+
+    async def _publish_global(self, wid: int, tokens: List[int]) -> None:
+        """Advertise the sealed blocks a completed request left in this
+        worker's prefix cache ("g2" — the mocker has no real tiers).
+        Dedupe inside GlobalKvDirectory bounds hot prefixes to
+        ``global_kv_dedupe`` holders fleet-wide."""
+        d = self._dirs.get(wid)
+        w = self.workers.get(wid)
+        if d is None or w is None:
+            return
+        hashes = compute_sequence_hashes(tokens, self.cfg.block_size)
+        held = [
+            h for h in hashes
+            if h in w.engine.kv.active or h in w.engine.kv.cached
+        ]
+        try:
+            await d.publish(held, "g2")
+        except (ConnectionError, FaultInjected):
+            pass  # directory.publish chaos: one advertisement lost, that's all
+
     async def submit(
         self, idx: int, sreq: SimRequest,
         tokens: Optional[List[int]] = None,
@@ -684,6 +860,8 @@ class SimPool:
                     # the zero-load ghost can't keep winning least-loaded
                     self.router.remove_worker_id(wid)
                     raise ConnectionError(f"sim worker {wid} gone")
+                if self.fleet.kv_store is not None:
+                    await self._global_fetch(wid, w, tokens)
                 ok = await self._consume(w.engine, rid, tokens, item, rec, t_arrive)
             except (ConnectionError, FaultInjected):
                 ok = False
@@ -702,6 +880,8 @@ class SimPool:
                 rec.ok = True
                 rec.worker = wid
                 w.requests += 1
+                if self.fleet.kv_store is not None:
+                    await self._publish_global(wid, tokens)
                 # feed the production accountant with the record's own
                 # promise — the per-class ledger the invariants assert on
                 met = self.slo.record(
@@ -781,6 +961,15 @@ class SimFleet:
         self.clock = clock
         self.plane = InProcEventPlane()
         self.breaker_metrics = M.MetricsScope()  # detached from /metrics
+        # fleet-wide KV reuse: one shared directory plane (MemKVStore, the
+        # in-proc stand-in for the discovery/netstore store) + one bandwidth
+        # EWMA for the "tier" wire class — None unless cfg.global_kv, so the
+        # hot submit path of every existing scenario is untouched
+        self.kv_store = MemKVStore() if cfg.global_kv else None
+        self.global_bw = (
+            WireBandwidthEstimator(priors=dict(_GLOBAL_KV_WIRE_PRIORS))
+            if cfg.global_kv else None
+        )
         self.pools: Dict[str, SimPool] = {
             p.name: SimPool(self, p, seed=cfg.seed + i)
             for i, p in enumerate(cfg.pools)

@@ -147,8 +147,31 @@ def pool_report(pool: SimPool) -> dict:
         # scenario SLA invariants read these instead of re-deriving math
         "slo": _slo_section(pool),
     }
+    if pool.fleet.cfg.global_kv:
+        # fleet-wide KV reuse counters — keyed ONLY when the run had the
+        # directory on, so every pre-existing scenario's canonical_json
+        # pin stays byte-identical
+        rep["global_cache"] = _global_cache_section(pool)
     pool._report_cache = (key, rep)
     return rep
+
+
+def _global_cache_section(pool: SimPool) -> dict:
+    fetched = pool.global_fetched_blocks
+    recomputed = pool.global_recomputed_blocks
+    dedupe = sum(d.dedupe_skipped for d in pool._dirs.values())
+    published = sum(d.published_count for d in pool._dirs.values())
+    return {
+        "fetch_events": pool.global_fetch_events,
+        "fetched_blocks": fetched,
+        "recomputed_blocks": recomputed,
+        "fetched_fraction": round(fetched / max(fetched + recomputed, 1), 4),
+        "stale_holder_skips": pool.global_stale_skips,
+        "resumed_fetches": pool.global_resumed_fetches,
+        "dedupe_skipped_blocks": dedupe,
+        "dedupe_ratio": round(dedupe / max(dedupe + published, 1), 4),
+        "directory_entries": published,
+    }
 
 
 def _slo_section(pool: SimPool) -> dict:
@@ -241,6 +264,14 @@ def bench_record(reports: List[dict]) -> dict:
     decisions_us: List[float] = []
     ttft_p95 = {}
     itl_p95 = {}
+    # fleet-wide KV reuse rollup (ISSUE: detail.global_cache in BENCH JSON):
+    # hit rate comes from the directory-on scenario's own extra_sim (it
+    # carries the counterfactual), block/dedupe counters fold across pools
+    gcache: Dict[str, float] = {
+        "fetched_blocks": 0, "recomputed_blocks": 0,
+        "dedupe_skipped_blocks": 0, "hit_rate": 0.0,
+        "hit_rate_local_counterfactual": 0.0, "dedupe_ratio": 0.0,
+    }
     for r in reports:
         for w in r["wall"]["pools"].values():
             decisions_us.append(w["router_decision_us"]["p99"])
@@ -248,6 +279,18 @@ def bench_record(reports: List[dict]) -> dict:
             key = f'{r["sim"]["scenario"]}/{pname}'
             ttft_p95[key] = p["ttft"]["p95_ms"]
             itl_p95[key] = p["itl"]["p95_ms"]
+            gc = p.get("global_cache")
+            if gc:
+                gcache["fetched_blocks"] += gc["fetched_blocks"]
+                gcache["recomputed_blocks"] += gc["recomputed_blocks"]
+                gcache["dedupe_skipped_blocks"] += gc["dedupe_skipped_blocks"]
+                gcache["dedupe_ratio"] = max(
+                    gcache["dedupe_ratio"], gc["dedupe_ratio"]
+                )
+        reuse = r["sim"].get("global_kv")
+        if reuse:
+            gcache["hit_rate"] = reuse["hit_rate_global"]
+            gcache["hit_rate_local_counterfactual"] = reuse["hit_rate_local"]
     return {
         "metric": "sim_fleet_control_plane_gate",
         "value": round(frac, 4),
@@ -271,5 +314,6 @@ def bench_record(reports: List[dict]) -> dict:
             "router_decision_p99_us_max": max(decisions_us) if decisions_us else 0.0,
             "sim_ttft_p95_ms": ttft_p95,
             "sim_itl_p95_ms": itl_p95,
+            "global_cache": gcache,
         },
     }

@@ -20,6 +20,11 @@ not point metrics but the loop behaviors ROADMAP item 3 needs proven:
                             deadline, warm restore — zero lost requests
                             (``-chaos`` variant drops the evacuation stream
                             and tears a checkpoint manifest)
+- ``global-kv-reuse``     fleet-wide content-addressed KV directory: a hot
+                            prefix split across two pools fetches from peer
+                            tiers instead of re-prefilling; hit rate beats
+                            the per-worker-radix counterfactual and a cold
+                            worker's hot-prefix TTFT lands within 1.2x warm
 
 Scenarios scale with ``workers`` and ``duration_s`` so the same invariants
 run as a tier-1 smoke (small fleet, ~4 simulated minutes, seconds of wall
@@ -1537,6 +1542,215 @@ async def _elastic_reclaim_chaos(
 
 
 # ---------------------------------------------------------------------------
+# global-kv-reuse
+# ---------------------------------------------------------------------------
+
+
+async def _global_kv_reuse(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    """Fleet-wide KV reuse over the content-addressed directory
+    (kvbm/directory.py): a prefix-heavy trace alternates across two pools,
+    so the SAME hot group's prefix is needed in both — per-pool radix alone
+    cannot warm the second pool. With the directory on, a local radix miss
+    prices onboard-from-peer-tier vs recompute (ops/costs.fetch_vs_recompute
+    on the tier-wire EWMA) and fetches the longest single-holder run; the
+    identical trace replays with the directory OFF as the per-worker-radix
+    counterfactual. Invariants: fleet-wide hit rate strictly beats the
+    counterfactual, a cold worker's TTFT on the fleet-hot prefix (wire time
+    included) lands within 1.2x a warm worker's, zero failed requests in
+    both runs, fetches actually happen, and dedupe bounds hot-prefix
+    advertisements to the configured holder count."""
+    from ..llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from ..profiler.loadgen import prefix_prompt
+    from ..runtime.engine import Context
+    from ..tokens import compute_sequence_hashes
+
+    share = 0.75
+    block_size = 16
+
+    def _mk_trace() -> List[traces.SimRequest]:
+        return traces.prefix_heavy(
+            duration_s=duration_s, rate=0.35 * workers * _CAPACITY_REQ_S,
+            isl=256, osl=8, num_groups=max(4, workers),
+            hot_group_share=0.5, seed=seed,
+            ttft_target_s=18.0, itl_target_s=3.0,
+        )
+
+    def _mk_fleet(enabled: bool) -> SimFleet:
+        half = max(1, workers // 2)
+        return SimFleet(FleetConfig(
+            seed=seed, prefix_share=share, max_attempts=3,
+            global_kv=enabled,
+            pools=[
+                PoolConfig(name="east", initial_workers=half,
+                           block_size=block_size, **_SPEED),
+                PoolConfig(name="west", initial_workers=half,
+                           block_size=block_size, **_SPEED),
+            ],
+        ), clock)
+
+    def _mk_pool_for() -> Callable:
+        flip = {"n": 0}
+
+        def pool_for(sreq) -> str:
+            # alternate arrivals across pools: every group's prefix is hot
+            # in BOTH pools, which only fleet-level reuse can exploit
+            flip["n"] += 1
+            return "east" if flip["n"] % 2 else "west"
+
+        return pool_for
+
+    def _hit_rate(fl: SimFleet) -> float:
+        cached = inputs = 0
+        for pool in fl.pools.values():
+            for r in pool.records:
+                if r.ok:
+                    cached += r.cached_tokens
+                    inputs += r.input_tokens
+        return cached / max(inputs, 1)
+
+    def _failed(fl: SimFleet) -> int:
+        return sum(
+            sum(1 for r in pool.records if not r.ok)
+            for pool in fl.pools.values()
+        )
+
+    async def _probe_ttft(engine, rid: str, tokens: List[int]) -> float:
+        req = PreprocessedRequest(
+            request_id=rid, model="sim", token_ids=tokens,
+            stop=StopConditions(max_tokens=1, min_tokens=1, ignore_eos=True),
+            sampling=SamplingOptions(temperature=0.0),
+        )
+        t0 = clock.time()
+        async for out in engine.generate(req, Context(rid)):
+            if out.finish_reason is not None:
+                break
+        return clock.time() - t0
+
+    # ---- the directory-on run + cold/warm probes ----
+    fleet = _mk_fleet(True)
+    await fleet.start()
+    warm_ttft = cold_ttft = 0.0
+    cold_seeded_blocks = 0
+    try:
+        await fleet.run_trace(_mk_trace(), pool_for=_mk_pool_for())
+        east = fleet.pools["east"]
+        west = fleet.pools["west"]
+        # the fleet-hot prefix: group 0's shared tokens, truncated to whole
+        # blocks so every probed token sits in a sealed (advertised) page
+        hot = next(
+            (r for r in east.records
+             if r.ok and r.group == 0 and r.worker in east.workers), None,
+        )
+        if hot is not None:
+            trace_items = _mk_trace()
+            n_shared = (int(256 * share) // block_size) * block_size
+            probe_toks = prefix_prompt(
+                trace_items[hot.idx].item, hot.idx, share
+            )[:n_shared]
+            # warm: the worker that served the request replays its prefix
+            warm_ttft = await _probe_ttft(
+                east.workers[hot.worker].engine, "probe-warm", probe_toks
+            )
+            # cold: a brand-new worker in the OTHER pool — its only path to
+            # warmth is a directory lookup + peer-tier fetch, and the wire
+            # time is charged to its TTFT
+            wid_cold = west._spawn(startup_s=0.0)
+            w_cold = west.workers[wid_cold]
+            t0 = clock.time()
+            await west._global_fetch(wid_cold, w_cold, probe_toks)
+            fetch_s = clock.time() - t0
+            cold_seeded_blocks = w_cold.engine.kv.cached_prefix_len(
+                compute_sequence_hashes(probe_toks, block_size)
+            )
+            cold_ttft = fetch_s + await _probe_ttft(
+                w_cold.engine, "probe-cold", probe_toks
+            )
+        hit_global = _hit_rate(fleet)
+        failed_on = _failed(fleet)
+        fetched = sum(p.global_fetched_blocks for p in fleet.pools.values())
+        recomputed = sum(
+            p.global_recomputed_blocks for p in fleet.pools.values()
+        )
+        fetch_events = sum(
+            p.global_fetch_events for p in fleet.pools.values()
+        )
+        stale = sum(p.global_stale_skips for p in fleet.pools.values())
+        dedupe = sum(
+            d.dedupe_skipped
+            for p in fleet.pools.values() for d in p._dirs.values()
+        )
+    finally:
+        await fleet.stop()
+
+    # ---- the per-worker-radix counterfactual: same trace, directory off ----
+    twin = _mk_fleet(False)
+    await twin.start()
+    try:
+        await twin.run_trace(_mk_trace(), pool_for=_mk_pool_for())
+        hit_local = _hit_rate(twin)
+        failed_off = _failed(twin)
+    finally:
+        await twin.stop()
+
+    ratio = cold_ttft / warm_ttft if warm_ttft > 0 else float("inf")
+    invs = [
+        _invariant(
+            "fleet_hit_beats_local_radix", hit_global > hit_local,
+            f"fleet-wide hit rate {hit_global:.4f} vs per-worker radix "
+            f"counterfactual {hit_local:.4f} on the same trace",
+        ),
+        _invariant(
+            "cold_hot_prefix_ttft", ratio <= 1.2,
+            f"cold-worker TTFT on the fleet-hot prefix {cold_ttft:.3f}s "
+            f"(incl. fetch wire time) vs warm {warm_ttft:.3f}s — "
+            f"ratio {ratio:.3f} (bound 1.2x; {cold_seeded_blocks} blocks "
+            "onboarded from a peer tier)",
+        ),
+        _invariant(
+            "zero_failed_requests", failed_on == 0 and failed_off == 0,
+            f"failed: {failed_on} with the directory on, {failed_off} in "
+            "the counterfactual",
+        ),
+        _invariant(
+            "fetch_path_active", fetch_events > 0 and fetched > 0,
+            f"{fetch_events} peer-tier fetches onboarded {fetched} blocks "
+            f"({recomputed} recomputed, {stale} stale-holder fallbacks)",
+        ),
+        _invariant(
+            "dedupe_bounded_holders", dedupe > 0,
+            f"{dedupe} hot-prefix publishes skipped at the configured "
+            "holder bound (identical sealed blocks dedupe fleet-wide)",
+        ),
+    ]
+    return {
+        "fleet": fleet,
+        "invariants": invs,
+        "requests": sum(
+            len(p.records) for p in fleet.pools.values()
+        ),
+        "extra_sim": {
+            "global_kv": {
+                "hit_rate_global": round(hit_global, 4),
+                "hit_rate_local": round(hit_local, 4),
+                "cold_ttft_s": round(cold_ttft, 4),
+                "warm_ttft_s": round(warm_ttft, 4),
+                "cold_warm_ratio": round(ratio, 4),
+                "fetched_blocks": fetched,
+                "recomputed_blocks": recomputed,
+                "dedupe_skipped_blocks": dedupe,
+                "stale_holder_skips": stale,
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # registry + runner
 # ---------------------------------------------------------------------------
 
@@ -1551,6 +1765,7 @@ SCENARIOS: Dict[str, Callable] = {
     "http-frontend": _http_frontend,
     "elastic-reclaim": _elastic_reclaim,
     "elastic-reclaim-chaos": _elastic_reclaim_chaos,
+    "global-kv-reuse": _global_kv_reuse,
 }
 
 # aliases accepted by the CLI (`python -m dynamo_tpu.sim diurnal`)
@@ -1565,6 +1780,7 @@ ALIASES = {
     "frontend": "http-frontend",
     "reclaim": "elastic-reclaim",
     "reclaim-chaos": "elastic-reclaim-chaos",
+    "globalkv": "global-kv-reuse",
 }
 
 
@@ -1620,7 +1836,7 @@ def run_suite(
         "diurnal-autoscale", "bursty-breaker-chaos",
         "prefix-heavy-radix", "multi-pool-balance",
         "disagg-streamed-prefill", "router-scale-sublinear",
-        "http-frontend", "elastic-reclaim",
+        "http-frontend", "elastic-reclaim", "global-kv-reuse",
     ]
     return [
         run_scenario(n, seed=seed, workers=workers, duration_s=duration_s)

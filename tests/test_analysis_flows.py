@@ -542,6 +542,98 @@ def test_reverting_pr10_lease_reclaim_fix_is_redetected(tmp_path, repo_analysis)
     ] == []
 
 
+def test_fetch_lease_fixture_leak_and_discharge(tmp_path):
+    """The "fetch-lease" spec (ISSUE 18): begin_fetch must reach
+    commit_fetch or abort_fetch on every path out. A broad handler that
+    aborts is clean; an unguarded await between begin and commit leaks."""
+    found = analyze(
+        tmp_path, "dynamo_tpu/sim/fleet.py", """
+        class P:
+            async def guarded(self, d, holder, hashes):
+                lease = d.begin_fetch(holder, hashes)
+                try:
+                    await self._wire(hashes)
+                except Exception:
+                    d.abort_fetch(lease)
+                    return
+                d.commit_fetch(lease, len(hashes))
+
+            async def leaky(self, d, holder, hashes):
+                lease = d.begin_fetch(holder, hashes)
+                try:
+                    await self._wire(hashes)
+                except Exception:
+                    return  # swallowed failure: the lease strands
+                d.commit_fetch(lease, len(hashes))
+        """,
+        rule="RESOURCE-LEAK",
+    )
+    assert len(found) == 1 and "leaky" in found[0].message
+    assert "fetch-lease" in found[0].message
+
+
+def test_new_resource_specs_registered():
+    """Catalog pin for the two ISSUE 18 specs: the directory-entry
+    (store-shaped, TTL/lease backstop) and the path-checked fetch-lease.
+    Dropping or reshaping either is a deliberate act, not drift."""
+    from tools.analysis.resources import RESOURCES
+
+    by_name = {s.name: s for s in RESOURCES}
+    de = by_name["directory-entry"]
+    assert de.self_releasing and de.owners == ("_published",)
+    assert ("unpublish", ()) in de.release
+    fl = by_name["fetch-lease"]
+    assert not fl.self_releasing
+    assert fl.acquire == (("begin_fetch", ()),)
+    assert {r[0] for r in fl.release} == {"commit_fetch", "abort_fetch"}
+    # every file that opens fetch leases is in scope
+    for p in ("kvbm/directory.py", "engine/engine.py", "sim/fleet.py"):
+        assert p in fl.paths
+
+
+_FETCH_LEASE_FIX = (
+    "        except BaseException:\n"
+    "            # cancellation (fleet teardown) mid-fetch: the lease must not\n"
+    "            # strand — abort counts the miss as recomputed\n"
+    "            d.abort_fetch(lease)\n"
+    "            raise\n"
+)
+_FETCH_LEASE_REVERTED = (
+    "        except BaseException:\n"
+    "            raise\n"
+)
+
+
+def test_reverting_sim_fetch_lease_abort_is_redetected(tmp_path, repo_analysis):
+    """Reverting the sim fetch path's cancellation-abort (the except that
+    discharges the fetch lease before re-raising) must surface as a
+    non-baselined RESOURCE-LEAK on _global_fetch."""
+    src = open(os.path.join(REPO, "dynamo_tpu/sim/fleet.py")).read()
+    assert src.count(_FETCH_LEASE_FIX) == 1, \
+        "fleet.py drifted: update the revert fixture"
+    fixture = tmp_path / "dynamo_tpu" / "sim" / "fleet.py"
+    fixture.parent.mkdir(parents=True)
+    fixture.write_text(src.replace(_FETCH_LEASE_FIX, _FETCH_LEASE_REVERTED))
+    modules, parse = core.load_modules([str(tmp_path)])
+    found = [
+        f for f in core.collect_findings(modules, parse)
+        if f.rule == "RESOURCE-LEAK"
+    ]
+    assert any(
+        "fetch-lease" in f.message and "_global_fetch" in f.message
+        for f in found
+    ), found
+    baseline = core.load_baseline(core.DEFAULT_BASELINE)
+    for f in found:
+        assert f.baseline_key() not in baseline
+    # the LIVE tree (fix present) is clean
+    _m, _p, live_findings = repo_analysis
+    assert [
+        f for f in live_findings
+        if f.rule == "RESOURCE-LEAK" and f.path.startswith("dynamo_tpu/sim/")
+    ] == []
+
+
 # ---------------------------------------------------------------------------
 # LOCK-ACROSS-AWAIT fixtures
 # ---------------------------------------------------------------------------

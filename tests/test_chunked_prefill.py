@@ -9,6 +9,8 @@ with ring_extend_attention as the context-parallel chunk path (sp > 1).
 
 import asyncio
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 
@@ -59,6 +61,7 @@ async def run(eng, rid, tokens, n=8):
 PROMPT = [(i * 37 + 11) % 500 for i in range(200)]
 
 
+@pytest.mark.slow
 async def test_chunked_equals_single_shot():
     """A prompt longer than every bucket (forcing 7 chunks of <=32) produces
     token-identical greedy output to a single-shot prefill."""
@@ -80,6 +83,7 @@ async def test_chunked_equals_single_shot():
         e_chunked.stop()
 
 
+@pytest.mark.slow
 async def test_long_context_beyond_largest_bucket():
     """max_context 2048 with a 128-token chunk cap: a 1500-token prompt
     (12 chunks) serves end-to-end."""
@@ -119,6 +123,7 @@ async def test_short_request_not_starved_by_long_prefill():
         e.stop()
 
 
+@pytest.mark.slow
 async def test_sp_ring_prefill_matches_sp1():
     """Engine-integrated CP: chunk prefill through ring_extend_attention on
     an sp=2 mesh produces the same greedy output as sp=1."""
@@ -150,6 +155,7 @@ async def test_sp_with_tp_combined():
         e.stop()
 
 
+@pytest.mark.slow
 async def test_concurrent_identical_prompt_never_matches_unwritten_pages():
     """Regression (code-review r3): block hashes are committed only after
     their chunk's KV lands. A same-prompt request racing a chunked prefill
@@ -183,6 +189,7 @@ async def test_concurrent_identical_prompt_never_matches_unwritten_pages():
         e.stop()
 
 
+@pytest.mark.slow
 async def test_cancel_mid_prefill_frees_slot_and_poisons_nothing():
     """Killing a request mid-chunked-prefill stops chunk dispatch, frees the
     slot, and leaves no unwritten block matchable."""

@@ -5,6 +5,8 @@ decode worker) must produce token-identical greedy output to the aggregated
 path, with the decode worker importing (not recomputing) the prefill KV.
 """
 
+import pytest
+
 import asyncio
 
 import jax.numpy as jnp
@@ -71,6 +73,7 @@ async def test_disagg_matches_aggregated_sequential(monkeypatch):
     await _disagg_matches_aggregated()
 
 
+@pytest.mark.slow
 async def test_disagg_matches_aggregated_gptoss(monkeypatch):
     """Disaggregated prefill/decode with gpt-oss: the transferred KV pages
     carry windowed+sink attention context; the decode engine's import must
@@ -309,6 +312,7 @@ async def test_prefill_terminal_error_surfaces_instead_of_fallback():
     assert out is None
 
 
+@pytest.mark.slow
 async def test_disagg_uses_native_transfer(monkeypatch):
     """When the C++ agent is available, the KV bytes move over it (the
     request plane only carries slot metadata), and the decode side still
@@ -373,6 +377,7 @@ async def test_disagg_uses_native_transfer(monkeypatch):
         decode.stop()
 
 
+@pytest.mark.slow
 async def test_stale_lease_overwrite_never_imports_torn_bytes(monkeypatch):
     monkeypatch.setenv("DTPU_ICI_TRANSFER", "0")  # wire-protocol test
     monkeypatch.setenv("DTPU_DEVICE_TRANSFER", "0")  # pin the native path

@@ -6,6 +6,8 @@ scheduler.rs:543-560 loops every dp_rank; components/src/dynamo/vllm/
 main.py:67 non-leader ranks behind one endpoint).
 """
 
+import pytest
+
 import asyncio
 
 import jax
@@ -74,6 +76,7 @@ def preq(rid, tokens):
     )
 
 
+@pytest.mark.slow
 async def test_dp_ranks_hold_distinct_prefixes_and_router_targets_them():
     """Done-bar: two dp_ranks hold different prefixes; the router hits the
     rank that has each prefix, and the engine group dispatches to it."""

@@ -100,6 +100,7 @@ def _dense_logits(cfg, params, toks):
     return moe.lm_logits(params, cfg, h)
 
 
+@pytest.mark.slow
 def test_expanded_params_match_logical_model():
     """Same logical weights, R=4 physical slots, EP over 4 shards: the
     remapped shard_map forward equals the replicated-logical forward."""
@@ -184,6 +185,7 @@ async def collect(eng, req):
     return toks
 
 
+@pytest.mark.slow
 async def test_engine_serves_and_rebalances_identically():
     """tiny-moe with EPLB over tp=4: serve greedily, measure the load,
     rebalance mid-serving, serve the same prompt again — token-identical

@@ -72,7 +72,10 @@ def _oracle_greedy(params, cfg, prompt, n):
 PROMPT = [(i * 37 + 11) % 500 for i in range(40)]
 
 
-@pytest.mark.parametrize("tiny", ["tiny_gemma2", "tiny_gemma3"])
+@pytest.mark.parametrize("tiny", [
+    pytest.param("tiny_gemma2", marks=pytest.mark.slow),
+    "tiny_gemma3",
+])
 def test_paged_engine_matches_recompute_oracle(tiny):
     """Prompt spans multiple sliding windows (window 16 < 40 tokens); the
     engine's paged windowed decode must equal the dense recompute."""
@@ -91,6 +94,7 @@ def test_paged_engine_matches_recompute_oracle(tiny):
     assert got == expect
 
 
+@pytest.mark.slow
 def test_tp_serving_matches_single_chip():
     cfg = gemma.GemmaConfig.tiny_gemma3()
     params = registry.init_params(jax.random.PRNGKey(1), cfg)
@@ -105,6 +109,7 @@ def test_tp_serving_matches_single_chip():
     assert asyncio.run(go(2)) == asyncio.run(go(1))
 
 
+@pytest.mark.slow
 def test_chunked_prefill_matches_single_shot():
     """A prompt longer than every bucket forces chunked prefill; windowed
     layers must still see exactly their window across chunk boundaries."""

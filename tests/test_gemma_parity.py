@@ -41,6 +41,7 @@ def _ours_logits(ckpt):
     return np.asarray(gemma.lm_logits(params, cfg, hidden)), cfg
 
 
+@pytest.mark.slow
 def test_logits_match_hf_gemma2(tmp_path):
     from transformers import Gemma2Config, Gemma2ForCausalLM
 

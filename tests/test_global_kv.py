@@ -1,0 +1,603 @@
+"""Fleet-wide KV reuse: the content-addressed global prefix cache
+(ISSUE 18 tentpole, kvbm/directory.py).
+
+Covers every layer of the fetch path:
+
+- the directory itself on a MemKVStore: publish/lookup round trip, dedupe
+  at the configured holder bound, TTL aging on an injected clock, lease
+  revoke and lease-less withdraw, and the longest-single-holder-run lookup
+  the fetch planner consumes;
+- the ``ops/costs.fetch_vs_recompute`` decision model as a deterministic
+  tier-1 grid gate: wherever the router would choose fetch, the modeled
+  fetch time is within the margin of recompute *by construction*;
+- fetch-lease lifecycle (begin -> commit/abort, RESOURCE-LEAK
+  "fetch-lease" backs the path proof; here we pin the accounting);
+- ``GlobalKvFetchPlanner`` planning: fetch plan on a fleet-hot miss,
+  recompute on slow wire / short run / address-less holder;
+- the scheduler's ``fetchable`` discount term;
+- peer-tier pulls on REAL engines, float and int8, bit-exact against a
+  golden decode — including blocks served from the G3 disk tier;
+- chaos (docs/operations.md fault catalog): a mid-fetch ``fetch.peer_tier``
+  drop resumes per block with a deterministic fired schedule; a directory
+  entry pointing at a dead worker (engine and sim level) falls back to
+  recompute without a stuck request.
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.kvbm.directory import FetchLease, GlobalKvDirectory
+from dynamo_tpu.kvbm.pool import KvbmTiers
+from dynamo_tpu.llm.prefill_router import GlobalKvFetchPlanner
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.ops.costs import fetch_vs_recompute
+from dynamo_tpu.runtime.bandwidth import WireBandwidthEstimator
+from dynamo_tpu.runtime.discovery.store import MemKVStore
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.faults import FAULTS
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def mkdir(store, holder, clock, **kw):
+    kw.setdefault("ttl_s", 60.0)
+    kw.setdefault("dedupe_replicas", 2)
+    return GlobalKvDirectory(store, holder, clock=clock, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the directory on a MemKVStore
+# ---------------------------------------------------------------------------
+
+
+async def test_publish_lookup_roundtrip():
+    store, clock = MemKVStore(), FakeClock()
+    d = mkdir(store, "w1", clock, address="w1:7070")
+    assert await d.publish([10, 11, 12], "g2") == 3
+    assert d.published_count == 3
+    # re-advertising the same tier is a no-op (incremental maintenance)
+    assert await d.publish([10, 11], "g2") == 0
+    # a tier CHANGE (offload g2 -> g3) re-writes the entry
+    assert await d.publish([10], "g3") == 1
+    (e,) = await d.lookup(10)
+    assert (e.holder, e.tier, e.fmt, e.address) == ("w1", "g3", "model", "w1:7070")
+    assert await d.lookup(999) == []
+
+
+async def test_dedupe_bounds_holders():
+    store, clock = MemKVStore(), FakeClock()
+    ds = [mkdir(store, f"w{i}", clock, dedupe_replicas=2) for i in range(3)]
+    for d in ds:
+        await d.publish([42], "g2")
+    # first two advertised; the third saw 2 live holders and skipped
+    assert [d.published_count for d in ds] == [1, 1, 0]
+    assert ds[2].dedupe_skipped == 1
+    assert len(await ds[0].lookup(42)) == 2
+
+
+async def test_ttl_ages_out_entries_and_refresh_restamps():
+    store, clock = MemKVStore(), FakeClock()
+    d = mkdir(store, "w1", clock, ttl_s=30.0)
+    await d.publish([7], "g2")
+    clock.t = 29.0
+    assert len(await d.lookup(7)) == 1
+    clock.t = 31.0
+    # a dead worker's advertisement ages out: nothing serves it
+    assert await d.lookup(7) == []
+    # ... but a LIVE worker re-stamps alongside its heartbeat
+    assert await d.refresh() == 1
+    assert len(await d.lookup(7)) == 1
+
+
+async def test_unpublish_withdraw_and_leaseless_close():
+    store, clock = MemKVStore(), FakeClock()
+    d = mkdir(store, "w1", clock)
+    await d.publish([1, 2, 3], "g2")
+    assert await d.unpublish([2, 99]) == 1          # 99 was never ours
+    assert d.published_count == 2
+    assert await d.withdraw_all() == 2
+    assert d.published_count == 0
+    assert await d.lookup(1) == []
+    # lease-less close after a fresh publish also deletes the keys
+    await d.publish([4], "g2")
+    await d.close()
+    assert await d.lookup(4) == []
+
+
+async def test_lease_revoke_deletes_advertisements():
+    """etcd semantics: a worker's death (lease expiry / revoke) deletes its
+    advertisements wholesale — the directory never needs a scrub pass."""
+    store, clock = MemKVStore(), FakeClock()
+    d = await mkdir(store, "w1", clock).start()
+    await d.publish([5, 6], "g2")
+    assert len(await d.lookup(5)) == 1
+    await d.close()                                  # revokes the lease
+    assert await d.lookup(5) == []
+    assert await d.lookup(6) == []
+
+
+async def test_lookup_run_longest_single_holder_and_exclusion():
+    store, clock = MemKVStore(), FakeClock()
+    a = mkdir(store, "wa", clock, dedupe_replicas=99)
+    b = mkdir(store, "wb", clock, dedupe_replicas=99)
+    await a.publish([1, 2], "g2")
+    await b.publish([1, 2, 3], "g3")
+    probe = mkdir(store, "me", clock)
+    run = await probe.lookup_run([1, 2, 3, 4])
+    # one wire, one stream: the holder with the longest continuation wins
+    assert [e.hash for e in run] == [1, 2, 3]
+    assert {e.holder for e in run} == {"wb"}
+    # the fetching worker never fetches from itself
+    run2 = await b.lookup_run([1, 2, 3], exclude_holder="wb")
+    assert [e.hash for e in run2] == [1, 2] and run2[0].holder == "wa"
+    # equal-length runs tie-break by holder id (determinism)
+    await a.publish([3], "g2")
+    run3 = await probe.lookup_run([1, 2, 3])
+    assert {e.holder for e in run3} == {"wa"}
+    assert await probe.lookup_run([]) == []
+
+
+async def test_fetch_lease_lifecycle():
+    store, clock = MemKVStore(), FakeClock()
+    d = mkdir(store, "w1", clock)
+    l1 = d.begin_fetch("peer", [1, 2])
+    l2 = d.begin_fetch("peer", [3])
+    assert isinstance(l1, FetchLease) and l1.token != l2.token
+    assert d.inflight_fetches == 2
+    d.commit_fetch(l1, 2)
+    d.abort_fetch(l2)
+    assert d.inflight_fetches == 0
+    # discharge is idempotent (the abort-after-commit belt and braces)
+    d.abort_fetch(l1)
+    assert d.inflight_fetches == 0
+
+
+# ---------------------------------------------------------------------------
+# the fetch-vs-recompute decision model (tier-1 grid gate)
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_vs_recompute_grid_gate():
+    """The acceptance gate: over a wire-bandwidth x tier x block-count x
+    margin grid, wherever the model chooses fetch, the modeled fetch time
+    is within the margin of recompute — the router can never pick a fetch
+    that prices slower than re-prefilling."""
+    for bw in (2.5e7, 5e8, 2e9, 4e10):
+        for tier in ("g2", "g3"):
+            for n in (0, 1, 4, 12, 64, 512):
+                for margin in (0.8, 1.0):
+                    v = fetch_vs_recompute(
+                        n, block_size=16, kv_bytes_per_block=2 << 20,
+                        bandwidth_bytes_s=bw, prefill_base_s=0.2,
+                        prefill_per_token_s=2e-4, tier=tier, margin=margin,
+                    )
+                    if v["fetch_wins"]:
+                        assert v["fetch_s"] <= margin * v["recompute_s"], v
+                        assert n > 0
+                    if n == 0:
+                        assert not v["fetch_wins"] and v["fetch_s"] == 0.0
+
+
+def test_fetch_vs_recompute_shape():
+    """Monotone in block count; G3 reads price above G2; a fast wire on a
+    long prefix fetches, a dial-up wire recomputes."""
+    kw = dict(
+        block_size=16, kv_bytes_per_block=2 << 20, prefill_base_s=0.2,
+        prefill_per_token_s=2e-4,
+    )
+    prev = 0.0
+    for n in (1, 2, 8, 32, 128):
+        f = fetch_vs_recompute(n, bandwidth_bytes_s=2e9, **kw)["fetch_s"]
+        assert f >= prev
+        prev = f
+    g2 = fetch_vs_recompute(16, bandwidth_bytes_s=2e9, tier="g2", **kw)
+    g3 = fetch_vs_recompute(16, bandwidth_bytes_s=2e9, tier="g3", **kw)
+    assert g3["fetch_s"] >= g2["fetch_s"]
+    assert g2["fetch_wins"]
+    slow = fetch_vs_recompute(16, bandwidth_bytes_s=1e4, **kw)
+    assert not slow["fetch_wins"] and slow["recompute_s"] < slow["fetch_s"]
+
+
+# ---------------------------------------------------------------------------
+# the frontend fetch planner
+# ---------------------------------------------------------------------------
+
+
+def _preq(rid="r1", tokens=(1, 2, 3)):
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=list(tokens),
+        stop=StopConditions(max_tokens=4, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+async def test_planner_fetch_plan_and_recompute_paths():
+    store, clock = MemKVStore(), FakeClock()
+    peer = mkdir(store, "peer-1", clock, address="peer:7070")
+    hashes = [101, 102, 103, 104]
+    await peer.publish(hashes, "g2")
+    local = mkdir(store, "me", clock)
+    fast = WireBandwidthEstimator(priors={"tier": 2e9})
+    planner = GlobalKvFetchPlanner(
+        local, block_size=16, kv_bytes_per_block=2 << 20,
+        prefill_block_time_s=0.05, prefill_base_s=0.2, margin=1.0,
+        bandwidth=fast,
+    )
+    plan = await planner.plan_fetch(_preq(), hashes, overlap_blocks=1)
+    assert plan is not None
+    # only the miss (past the local radix overlap) fetches, from the peer
+    assert plan["hashes"] == hashes[1:]
+    assert plan["tier"] is True and plan["holder"] == "peer-1"
+    assert plan["address"] == "peer:7070"
+    assert plan["num_tokens"] == 3 * 16
+    # full local overlap: nothing to plan
+    assert await planner.plan_fetch(_preq(), hashes, 4) is None
+    # nobody holds the prefix: plain recompute
+    assert await planner.plan_fetch(_preq(), [777, 778], 0) is None
+    # a run shorter than the floor is not worth a wire
+    planner.min_run_blocks = 8
+    assert await planner.plan_fetch(_preq(), hashes, 0) is None
+
+
+async def test_planner_declines_on_slow_wire_and_blank_address():
+    store, clock = MemKVStore(), FakeClock()
+    peer = mkdir(store, "peer-1", clock, address="peer:7070")
+    hashes = [201, 202, 203]
+    await peer.publish(hashes, "g2")
+    local = mkdir(store, "me", clock)
+    dialup = WireBandwidthEstimator(priors={"tier": 1e3})
+    planner = GlobalKvFetchPlanner(
+        local, block_size=16, kv_bytes_per_block=2 << 20,
+        prefill_block_time_s=0.05, bandwidth=dialup,
+    )
+    # the directory HAS the prefix but the wire prices slower than prefill
+    assert await planner.plan_fetch(_preq(), hashes, 0) is None
+    # an address-less holder (sim worker) can't serve a real wire
+    blank = mkdir(store, "peer-2", clock, dedupe_replicas=99)
+    await blank.publish([301, 302], "g2")
+    fast = WireBandwidthEstimator(priors={"tier": 2e9})
+    planner2 = GlobalKvFetchPlanner(
+        local, block_size=16, kv_bytes_per_block=2 << 20,
+        prefill_block_time_s=0.05, prefill_base_s=0.2, bandwidth=fast,
+    )
+    assert await planner2.plan_fetch(_preq(), [301, 302], 0) is None
+
+
+def test_scheduler_fetchable_discount():
+    from dynamo_tpu.kv_router.protocols import OverlapScores, WorkerWithDpRank
+    from dynamo_tpu.kv_router.scheduler import KvScheduler
+
+    a, b = WorkerWithDpRank(1, 0), WorkerWithDpRank(2, 0)
+    sched = KvScheduler()
+    assert sched.select_worker([a, b], OverlapScores({}), query_blocks=10).worker == a
+    # b can onboard most of the prefix from a peer tier cheaper than
+    # recomputing: its effective prefill shrinks and it wins the tie
+    d = sched.select_worker(
+        [a, b], OverlapScores({}), query_blocks=10, fetchable={b: 6.0},
+    )
+    assert d.worker == b
+    # the discount never goes below zero prefill (no free-lunch overshoot)
+    d2 = sched.select_worker(
+        [a, b], OverlapScores({a: 10}), query_blocks=10, fetchable={b: 500.0},
+    )
+    assert d2.worker == a  # full local overlap still beats any fetch
+
+
+# ---------------------------------------------------------------------------
+# peer-tier pulls on real engines: float + int8, bit-exact
+# ---------------------------------------------------------------------------
+
+
+def tiny_cfg(**kw):
+    mcfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, dtype=jnp.float32,
+    )
+    defaults = dict(
+        num_blocks=96, block_size=4, max_batch_size=4, max_context=128,
+        prefill_buckets=(16, 32),
+    )
+    defaults.update(kw)
+    return TpuEngineConfig(model=mcfg, **defaults)
+
+
+def preq(rid, tokens, max_tokens=8):
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=tokens,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling=SamplingOptions(temperature=0.0),
+    )
+
+
+async def _golden(prompt, **cfg_kw):
+    ref = TpuEngine(tiny_cfg(**cfg_kw))
+    try:
+        out_toks = []
+        async for out in ref.generate(preq("golden", prompt), Context()):
+            out_toks.extend(out.token_ids)
+        return out_toks
+    finally:
+        ref.stop()
+
+
+# float32 tiny engine: 4B * 2 layers * K+V * bs4 * 2 kvh * d16 per block
+_FLOAT_BLOCK_NBYTES = 2048
+
+
+async def test_tier_fetch_float_bit_exact_including_g3(monkeypatch, tmp_path):
+    """A decode engine onboards a 24-block prefix straight from a peer's
+    KVBM tiers — with the host tier sized so half the blocks live on DISK
+    (G3) — and greedy output over the imported KV is byte-identical to a
+    cold golden run."""
+    monkeypatch.setenv("DTPU_ICI_TRANSFER", "0")
+    monkeypatch.setenv("DTPU_DEVICE_TRANSFER", "0")
+    prompt = list(range(100, 196))  # 96 tokens = 24 blocks
+    hashes = [int(h) for h in compute_sequence_hashes(prompt, 4)]
+    nb = len(prompt) // 4
+    golden = await _golden(prompt)
+
+    kvbm = KvbmTiers(
+        _FLOAT_BLOCK_NBYTES, host_capacity_bytes=12 * _FLOAT_BLOCK_NBYTES,
+        disk_capacity_bytes=1 << 20, disk_path=str(tmp_path),
+    )
+    holder = TpuEngine(tiny_cfg(), kvbm=kvbm)
+    addr = await holder.serve_transfer()
+    try:
+        async for _ in holder.generate(preq("warm", prompt, 1), Context()):
+            pass
+        kvbm.flush()  # background offload: every sealed block in a tier
+        # the tiny host cap actually spilled: both tiers serve this fetch
+        assert len(kvbm.disk) > 0 and len(kvbm.host) > 0
+
+        decode = TpuEngine(tiny_cfg())
+        try:
+            got_tokens = await decode._get_transfer_client().fetch_and_import(
+                addr, hashes[:nb], tier=True,
+            )
+            assert got_tokens == nb * 4
+            assert len(decode.allocator.match_prefix(hashes[:nb])) == nb
+            got = []
+            async for out in decode.generate(preq("d1", prompt), Context()):
+                got.extend(out.token_ids)
+            assert got == golden
+        finally:
+            decode.stop()
+    finally:
+        holder.stop()
+
+
+async def test_tier_fetch_int8_bit_exact(monkeypatch):
+    """int8 holder -> int8 decode over the tier wire: the flat codec
+    buffer (payload + scales) ships bit-exactly and greedy decode matches
+    the int8 golden run token for token."""
+    monkeypatch.setenv("DTPU_ICI_TRANSFER", "0")
+    monkeypatch.setenv("DTPU_DEVICE_TRANSFER", "0")
+    prompt = list(range(100, 196))
+    hashes = [int(h) for h in compute_sequence_hashes(prompt, 4)]
+    nb = len(prompt) // 4
+    golden = await _golden(prompt, kv_dtype="int8")
+
+    holder = TpuEngine(
+        tiny_cfg(kv_dtype="int8"),
+        kvbm=KvbmTiers(block_nbytes=1152, host_capacity_bytes=1 << 20),
+    )
+    addr = await holder.serve_transfer()
+    try:
+        async for _ in holder.generate(preq("warm", prompt, 1), Context()):
+            pass
+        holder.kvbm.flush()
+        decode = TpuEngine(tiny_cfg(kv_dtype="int8"))
+        try:
+            got_tokens = await decode._get_transfer_client().fetch_and_import(
+                addr, hashes[:nb], tier=True,
+            )
+            assert got_tokens == nb * 4
+            got = []
+            async for out in decode.generate(preq("d1", prompt), Context()):
+                got.extend(out.token_ids)
+            assert got == golden
+        finally:
+            decode.stop()
+    finally:
+        holder.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: mid-fetch drop resumes; dead holders fall back to recompute
+# ---------------------------------------------------------------------------
+
+
+async def test_tier_fetch_mid_stream_drop_resumes(monkeypatch):
+    """An armed ``fetch.peer_tier`` drop kills the stream after the first
+    window; the client resumes from the first un-imported block and still
+    lands every block, with a deterministic fired schedule."""
+    monkeypatch.setenv("DTPU_ICI_TRANSFER", "0")
+    monkeypatch.setenv("DTPU_DEVICE_TRANSFER", "0")
+    prompt = list(range(100, 196))
+    hashes = [int(h) for h in compute_sequence_hashes(prompt, 4)]
+    nb = len(prompt) // 4
+    holder = TpuEngine(
+        tiny_cfg(),
+        kvbm=KvbmTiers(_FLOAT_BLOCK_NBYTES, host_capacity_bytes=1 << 20),
+    )
+    addr = await holder.serve_transfer()
+    try:
+        async for _ in holder.generate(preq("warm", prompt, 1), Context()):
+            pass
+        holder.kvbm.flush()
+        FAULTS.disarm("fetch.peer_tier")
+        FAULTS.arm("fetch.peer_tier:drop@2")
+        try:
+            n_fired_before = len(FAULTS.fired)
+            plan = FAULTS.plan("fetch.peer_tier", 4)
+            decode = TpuEngine(tiny_cfg())
+            try:
+                got = await decode._get_transfer_client().fetch_and_import(
+                    addr, hashes[:nb], tier=True,
+                )
+                assert got == nb * 4  # resumed: nothing lost
+                assert len(decode.allocator.match_prefix(hashes[:nb])) == nb
+            finally:
+                decode.stop()
+            fired = FAULTS.fired[n_fired_before:]
+            assert fired == [("fetch.peer_tier", "drop", 2)]
+            assert (2, "drop") in plan  # same-seed-same-schedule preview
+        finally:
+            FAULTS.disarm("fetch.peer_tier")
+    finally:
+        holder.stop()
+
+
+async def test_dead_holder_address_recomputes_without_stuck_request(monkeypatch):
+    """A kv_transfer plan pointing at a dead worker (directory staleness
+    inside the TTL): the engine aborts the fetch lease, recomputes the
+    prefill locally, and the request completes byte-identically — never a
+    stuck request, never a stranded lease."""
+    monkeypatch.setenv("DTPU_ICI_TRANSFER", "0")
+    monkeypatch.setenv("DTPU_DEVICE_TRANSFER", "0")
+    prompt = list(range(100, 148))  # 48 tokens: keep the recompute cheap
+    hashes = [int(h) for h in compute_sequence_hashes(prompt, 4)]
+    golden = await _golden(prompt)
+    decode = TpuEngine(tiny_cfg())
+    decode.kv_directory = mkdir(MemKVStore(), "me", FakeClock())
+    try:
+        req = preq("dead", prompt)
+        req.kv_transfer = {
+            "address": "127.0.0.1:9", "hashes": hashes[: len(prompt) // 4],
+            "tier": True, "holder": "ghost",
+        }
+        got = []
+
+        async def run():
+            async for out in decode.generate(req, Context()):
+                got.extend(out.token_ids)
+
+        # "without a stuck request" is literal: bounded wall time
+        await asyncio.wait_for(run(), timeout=120)
+        assert got == golden
+        assert decode.kv_directory.inflight_fetches == 0
+    finally:
+        decode.stop()
+
+
+# ---------------------------------------------------------------------------
+# sim-level chaos: the fleet integration's fallback paths
+# ---------------------------------------------------------------------------
+
+
+def _sim_fleet(clock):
+    from dynamo_tpu.sim.fleet import FleetConfig, PoolConfig, SimFleet
+
+    return SimFleet(
+        FleetConfig(seed=0, global_kv=True, pools=[
+            PoolConfig(name="p", initial_workers=2, block_size=16,
+                       startup_time_s=0.0),
+        ]),
+        clock,
+    )
+
+
+def test_sim_stale_holder_falls_back_to_recompute():
+    """kill_worker leaves the victim's advertisements in the directory
+    (only the TTL ages them out): a fetch that resolves to the dead holder
+    aborts its lease and recomputes — counted, not wedged."""
+    from dynamo_tpu.sim import clock as simclock
+
+    async def main(clock):
+        fleet = _sim_fleet(clock)
+        await fleet.start()
+        try:
+            pool = fleet.pools["p"]
+            tokens = list(range(64))  # 4 blocks of 16
+            hashes = [int(h) for h in compute_sequence_hashes(tokens, 16)]
+            for h in hashes:
+                pool.workers[1].engine.kv.cached[h] = None
+            await pool._publish_global(1, tokens)
+            pool.kill_worker(1)  # hard kill: stale ads persist
+            w2 = pool.workers[2]
+            await pool._global_fetch(2, w2, tokens)
+            assert pool.global_stale_skips == 1
+            assert pool.global_fetched_blocks == 0
+            assert pool.global_recomputed_blocks == len(hashes)
+            assert w2.engine.kv.cached_prefix_len(hashes) == 0
+            assert all(d.inflight_fetches == 0 for d in pool._dirs.values())
+        finally:
+            await fleet.stop()
+
+    simclock.run(main)
+
+
+def test_sim_mid_fetch_drop_resumes_per_block():
+    """An armed ``fetch.peer_tier`` drop mid-fetch costs one extra pass of
+    wire time (the per-block resume) but every block still lands."""
+    from dynamo_tpu.sim import clock as simclock
+
+    async def main(clock):
+        fleet = _sim_fleet(clock)
+        await fleet.start()
+        try:
+            pool = fleet.pools["p"]
+            tokens = list(range(64))
+            hashes = [int(h) for h in compute_sequence_hashes(tokens, 16)]
+            for h in hashes:
+                pool.workers[1].engine.kv.cached[h] = None
+            await pool._publish_global(1, tokens)
+            FAULTS.disarm("fetch.peer_tier")
+            FAULTS.arm("fetch.peer_tier:drop@1")
+            try:
+                await pool._global_fetch(2, pool.workers[2], tokens)
+            finally:
+                FAULTS.disarm("fetch.peer_tier")
+            assert pool.global_resumed_fetches == 1
+            assert pool.global_fetched_blocks == len(hashes)
+            w2 = pool.workers[2]
+            assert w2.engine.kv.cached_prefix_len(hashes) == len(hashes)
+        finally:
+            await fleet.stop()
+
+    simclock.run(main)
+
+
+def test_sim_directory_lookup_chaos_degrades_to_local_radix():
+    """``directory.lookup`` chaos: an unreachable directory turns the
+    global fetch into a plain per-worker radix miss — recompute, never a
+    failed request."""
+    from dynamo_tpu.sim import clock as simclock
+
+    async def main(clock):
+        fleet = _sim_fleet(clock)
+        await fleet.start()
+        try:
+            pool = fleet.pools["p"]
+            tokens = list(range(64))
+            hashes = [int(h) for h in compute_sequence_hashes(tokens, 16)]
+            for h in hashes:
+                pool.workers[1].engine.kv.cached[h] = None
+            await pool._publish_global(1, tokens)
+            FAULTS.disarm("directory.lookup")
+            FAULTS.arm("directory.lookup:fail@1+")
+            try:
+                await pool._global_fetch(2, pool.workers[2], tokens)
+            finally:
+                FAULTS.disarm("directory.lookup")
+            assert pool.global_fetched_blocks == 0
+            assert pool.global_recomputed_blocks == len(hashes)
+            assert all(d.inflight_fetches == 0 for d in pool._dirs.values())
+        finally:
+            await fleet.stop()
+
+    simclock.run(main)

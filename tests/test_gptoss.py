@@ -7,6 +7,8 @@ produce identical tokens — that equivalence is what makes the windowed
 paged path trustworthy.
 """
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -91,6 +93,7 @@ def test_window_changes_attention():
     assert not np.allclose(np.asarray(windowed[-1]), np.asarray(full[-1]), atol=1e-5)
 
 
+@pytest.mark.slow
 async def test_engine_matches_full_recompute_oracle():
     """Paged windowed decode == full causal recompute, token for token,
     with the context crossing the window boundary mid-generation."""
@@ -106,6 +109,7 @@ async def test_engine_matches_full_recompute_oracle():
         engine.stop()
 
 
+@pytest.mark.slow
 async def test_engine_gptoss_tp2_matches_tp1():
     cfg = _cfg()
     prompt = list(range(30, 50))
@@ -122,6 +126,7 @@ async def test_engine_gptoss_tp2_matches_tp1():
     assert t1 == t2
 
 
+@pytest.mark.slow
 async def test_engine_gptoss_chunked_prefill():
     """A prompt longer than every prefill bucket runs as chunks; the
     windowed extend path must reproduce the single-chunk result."""
@@ -166,6 +171,7 @@ def test_unsupported_paths_fail_fast():
     e.stop()
 
 
+@pytest.mark.slow
 async def test_engine_gptoss_prefix_reuse_matches():
     """Second request sharing a long prefix reuses cached blocks; the
     windowed extend attention over the cached prefix must produce the same

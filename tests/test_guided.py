@@ -253,6 +253,7 @@ async def test_guided_and_plain_batchmates():
         e.stop()
 
 
+@pytest.mark.slow
 async def test_unguided_rows_identical_to_disabled_engine():
     """With no guided row active the mask is where(False, ...): a
     guided-capable engine must emit byte-identical greedy output to one
@@ -293,6 +294,7 @@ async def test_guided_multi_step_state_chains():
         e.stop()
 
 
+@pytest.mark.slow
 async def test_guided_rejections():
     e = engine()
     try:
@@ -478,6 +480,7 @@ async def test_soft_guided_degrades_on_disabled_engine():
         e.stop()
 
 
+@pytest.mark.slow
 async def test_guided_resumes_past_prior_tokens():
     """Disagg decode hop / migration resume carries already-generated
     tokens in prior_token_ids: the FSM must be seeded PAST them, not
@@ -502,6 +505,7 @@ async def test_guided_resumes_past_prior_tokens():
         e.stop()
 
 
+@pytest.mark.slow
 async def test_guided_with_spec_engine_falls_back():
     """On an engine with BOTH speculative decoding and guidance, a guided
     row makes the dispatch spec-ineligible; output still honors the

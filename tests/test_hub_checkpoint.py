@@ -11,6 +11,8 @@ the image has zero egress; its format is exactly what `save_pretrained`
 produces, so the loader paths exercised are the published-checkpoint ones.
 """
 
+import pytest
+
 import json
 import os
 import subprocess
@@ -156,6 +158,7 @@ def test_weight_mapping_roundtrip(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_serve_real_checkpoint_e2e(tmp_path):
     """dynamo-run serves the checkpoint: hub resolve -> warm load -> chat
     template -> generate -> detokenize. The complete published-checkpoint
@@ -175,6 +178,7 @@ def test_serve_real_checkpoint_e2e(tmp_path):
     assert r.stdout.strip(), "no generated text"
 
 
+@pytest.mark.slow
 def test_serve_hub_reference_e2e(tmp_path):
     """Same, but the model is addressed as 'org/name' through a hub cache."""
     cache = tmp_path / "hub"

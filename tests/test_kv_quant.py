@@ -320,6 +320,7 @@ PROMPTS = [
 ]
 
 
+@pytest.mark.slow
 async def test_e2e_greedy_matches_float_engine():
     """kv_dtype=int8 greedy decode is token-for-token identical to the float
     engine over a short horizon (chunked prefill + multi-step decode both
@@ -342,6 +343,7 @@ async def test_e2e_greedy_matches_float_engine():
     assert got == ref
 
 
+@pytest.mark.slow
 async def test_transfer_roundtrip_bit_exact():
     """int8 engine -> wire (kv_fetch) -> int8 engine moves the int8 payload
     + scales bit-exactly (the quantized gate skips the ICI/device fast
@@ -406,6 +408,7 @@ async def test_transfer_int8_to_float_peer_dequantizes():
         b.stop()
 
 
+@pytest.mark.slow
 async def test_kvbm_offload_onboard_bit_exact():
     """Offloaded int8 blocks are the flat codec buffer (payload+scales);
     after device eviction the onboard path scatters them back bit-exactly

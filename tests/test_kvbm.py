@@ -2,6 +2,8 @@
 
 import asyncio
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -186,6 +188,7 @@ async def test_kvbm_write_through_is_async():
         engine_plain.stop()
 
 
+@pytest.mark.slow
 async def test_offload_onboard_mla_latent_blocks():
     """The KVBM tiers are family-agnostic bytes: MLA's 1-head latent blocks
     offload to G2 and onboard back after device eviction with identical

@@ -77,6 +77,7 @@ def _req(rid, procs=None, n=6):
     )
 
 
+@pytest.mark.slow
 def test_engine_processor_isolation_and_effect():
     """Greedy decode: the opted-in request never emits banned tokens; the
     plain request in the same batch is bit-identical to a no-processor

@@ -105,6 +105,7 @@ def _req(rid, lora=None, n=4):
     )
 
 
+@pytest.mark.slow
 def test_engine_lora_changes_output_per_slot():
     """Same prompt, three concurrent requests: base, adapter-a, adapter-b.
     The base stream must be identical to a no-LoRA engine's output (slot-0

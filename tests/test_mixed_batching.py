@@ -153,6 +153,7 @@ async def _mixed_vs_split(mk_mixed, mk_split):
     assert ms[1][0] == ss[1][0]
 
 
+@pytest.mark.slow
 def test_mixed_equals_split_e2e():
     """Greedy + logprobs + seeded-sampling streams from the mixed engine
     (async step-prep ON) match the serial-prep split engine byte-for-byte
@@ -167,6 +168,7 @@ def test_mixed_equals_split_e2e():
     ))
 
 
+@pytest.mark.slow
 async def test_mixed_decode_not_starved():
     """While the 3-chunk prompt prefills, the resident stream keeps
     producing: every mixed step advanced the decode rows (tokens include

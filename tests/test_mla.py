@@ -6,6 +6,8 @@ per-head attention (mla.reference_attention) exactly — that equivalence is
 what lets the engine cache 576-float latents instead of full K/V.
 """
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -142,6 +144,7 @@ async def test_engine_serves_mla_greedy_deterministic():
         engine.stop()
 
 
+@pytest.mark.slow
 async def test_engine_serves_mla_moe():
     engine = mla_engine(cfg=mla.MlaConfig.tiny_mla_moe())
     try:
@@ -151,6 +154,7 @@ async def test_engine_serves_mla_moe():
         engine.stop()
 
 
+@pytest.mark.slow
 async def test_engine_mla_tp2_matches_tp1():
     """TP=2: q heads sharded, latent cache replicated — same greedy tokens
     as single-shard."""
@@ -168,6 +172,7 @@ async def test_engine_mla_tp2_matches_tp1():
     assert t1 == t2
 
 
+@pytest.mark.slow
 async def test_engine_mla_moe_ep_tp2_matches_tp1():
     """MoE MLA under tp=2: expert stacks shard on the expert dim (EP via
     shard_map psum, registry mla_expert_fn) — same greedy tokens as the
@@ -202,6 +207,7 @@ def test_kv_cache_spec_gqa_fallback():
     assert registry.kv_cache_spec(gqa, tp=4) == P(None, None, None, None)
 
 
+@pytest.mark.slow
 async def test_engine_mla_ring_chunked_prefill():
     """MLA + context parallelism: a prompt longer than every prefill bucket
     runs chunked through ring_extend attention on an sp=2 x tp=2 mesh with

@@ -7,6 +7,8 @@ stage-sharded prefill, paged decode, the decode_multi horizon scan, and the
 chained-carry path all included.
 """
 
+import pytest
+
 import asyncio
 
 import jax
@@ -82,6 +84,7 @@ async def test_pp_matches_single_device():
     assert got == ref, f"pp tokens {got} != single-device {ref}"
 
 
+@pytest.mark.slow
 async def test_pp_matches_single_device_qwen3_style():
     """qk_norm + qkv_bias (the repo's Qwen presets) through PP serving —
     the round-4 verdict's Weak #4: PP must serve the flagship models."""
@@ -220,6 +223,7 @@ def test_pp_gates_unsupported_features():
         TpuEngine(_cfg(pp=2, lora_max_adapters=2))
 
 
+@pytest.mark.slow
 async def test_pp_microbatched_decode_matches_default(monkeypatch):
     """DTPU_PP_MICROBATCHES=pp (GPipe bubble amortization) and the
     masked-write schedule (DTPU_PP_COND_SKIP=0) both produce the exact

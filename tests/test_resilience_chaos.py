@@ -15,6 +15,8 @@ stack (tests/test_frontend_e2e.py) and the disagg KV-transfer pair
 (tests/test_disagg.py).
 """
 
+import pytest
+
 import asyncio
 
 import aiohttp
@@ -239,6 +241,7 @@ def _tiny_engine_cfg():
     )
 
 
+@pytest.mark.slow
 async def test_chaos_transfer_pull_retry_then_recompute(monkeypatch):
     """One prefill/decode engine pair (the tests/test_disagg.py wire
     harness), two armed phases on distinct prompts:

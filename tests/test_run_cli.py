@@ -3,6 +3,8 @@
 Reference analog: launch/dynamo-run (main.rs:30-33, opt.rs:6-17).
 """
 
+import pytest
+
 import json
 import subprocess
 import sys
@@ -40,6 +42,7 @@ def test_bad_input_errors():
     assert r.returncode != 0
 
 
+@pytest.mark.slow
 def test_text_in_mla_preset_out():
     """One-shot generation through a real MLA (DeepSeek-style) engine
     preset — the latent-KV serving path reachable from the CLI."""
@@ -49,6 +52,7 @@ def test_text_in_mla_preset_out():
     assert r.stdout.strip()
 
 
+@pytest.mark.slow
 def test_text_in_gptoss_preset_out():
     """One-shot generation through the gpt-oss preset (sinks + sliding
     window attention) from the CLI."""

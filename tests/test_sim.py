@@ -324,6 +324,47 @@ def test_elastic_reclaim_full_scale():
         assert len(rep["sim"]["reclaim"]["victims"]) == 3
 
 
+def test_global_kv_reuse_smoke():
+    """ISSUE 18 acceptance: a prefix-heavy trace alternating across two
+    pools with the content-addressed directory on — fleet-wide hit rate
+    strictly beats the per-worker-radix counterfactual on the identical
+    trace, a cold worker's TTFT on the fleet-hot prefix (wire time
+    included) lands within 1.2x of warm, zero failed requests either way,
+    peer-tier fetches actually happen, and dedupe bounds the holder set."""
+    rep = run_scenario("global-kv-reuse", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    by_name = {iv["name"]: iv for iv in rep["sim"]["invariants"]}
+    for name in (
+        "fleet_hit_beats_local_radix", "cold_hot_prefix_ttft",
+        "zero_failed_requests", "fetch_path_active",
+        "dedupe_bounded_holders",
+    ):
+        assert by_name[name]["ok"], by_name[name]
+    gk = rep["sim"]["global_kv"]
+    assert gk["hit_rate_global"] > gk["hit_rate_local"]
+    assert gk["cold_warm_ratio"] <= 1.2
+    assert gk["fetched_blocks"] > 0 and gk["dedupe_skipped_blocks"] > 0
+    # per-pool global_cache sections only exist when the directory is on
+    for p in rep["sim"]["pools"].values():
+        assert p["global_cache"]["fetch_events"] >= 0
+
+
+def test_global_kv_reuse_same_seed_identical():
+    a = run_scenario("global-kv-reuse", seed=5, **SMOKE)
+    b = run_scenario("global-kv-reuse", seed=5, **SMOKE)
+    assert canonical_json(a["sim"]) == canonical_json(b["sim"])
+
+
+def test_global_kv_off_reports_unchanged():
+    """The directory defaults OFF: scenarios that never enable it emit no
+    global_cache key, keeping every pre-existing canonical_json pin."""
+    rep = run_scenario("prefix-heavy-radix", seed=0, **SMOKE)
+    assert all(
+        "global_cache" not in p for p in rep["sim"]["pools"].values()
+    )
+    assert "global_kv" not in rep["sim"]
+
+
 # ---------------------------------------------------------------------------
 # BENCH schema + CLI
 # ---------------------------------------------------------------------------
@@ -341,7 +382,21 @@ def test_bench_record_schema():
     assert "router_decision_us" in scn and "invariants" in scn
     assert det["router_decision_p99_us_max"] > 0
     assert det["sim_ttft_p95_ms"] and det["sim_itl_p95_ms"]
+    # the fleet-wide KV reuse rollup is always present (zeros when no
+    # scenario in the suite ran with the directory on)
+    assert set(det["global_cache"]) == {
+        "fetched_blocks", "recomputed_blocks", "dedupe_skipped_blocks",
+        "hit_rate", "hit_rate_local_counterfactual", "dedupe_ratio",
+    }
     json.dumps(rec)  # serializable
+
+
+def test_bench_record_folds_global_cache():
+    reports = run_suite(names=["global-kv-reuse"], seed=0, **SMOKE)
+    gc = bench_record(reports)["detail"]["global_cache"]
+    assert gc["fetched_blocks"] > 0
+    assert gc["hit_rate"] > gc["hit_rate_local_counterfactual"] > 0
+    assert gc["dedupe_ratio"] > 0 and gc["dedupe_skipped_blocks"] > 0
 
 
 def test_cli_runs_and_gates(tmp_path, capsys):

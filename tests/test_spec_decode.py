@@ -275,6 +275,7 @@ async def _tiny_spec_e2e(**spec_kw):
     assert e.spec_stats["rounds"] > 0  # the spec path actually dispatched
 
 
+@pytest.mark.slow
 def test_spec_e2e_tier1():
     """Tier-1 spec e2e (greedy, tiny model): spec output token-identical
     to plain greedy through the pure-JAX verify fallback. Sync wrapper

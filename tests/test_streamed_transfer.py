@@ -192,6 +192,7 @@ def test_prefill_router_plan_deflection_and_wire_cost():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 async def test_streamed_wire_protocol_end_to_end(monkeypatch):
     """One prefill engine, three decode pulls over the streamed wire:
 

@@ -150,6 +150,34 @@ RESOURCES: Tuple[ResourceSpec, ...] = (
                           "abort_manifest"),
     ),
     ResourceSpec(
+        name="directory-entry",
+        doc="Global KV directory advertisements (kvbm/directory.py "
+            "GlobalKvDirectory): each publish stores hash->tier into "
+            "_published, mirrored by a store key under kvdir/. Store-shaped "
+            "acquire (publish returns a count, not a token), released by "
+            "unpublish / withdraw_all / close; the store lease — or the "
+            "injected-clock ts TTL on lease-less clients — is the "
+            "structural backstop that ages out a dead holder's entries.",
+        paths=("kvbm/directory.py",),
+        acquire=(),
+        release=(("unpublish", ()),),
+        owners=("_published",),
+        self_releasing=True,  # lease expiry / ts TTL is the backstop
+    ),
+    ResourceSpec(
+        name="fetch-lease",
+        doc="In-flight peer-tier fetch leases (GlobalKvDirectory."
+            "begin_fetch): the lease MUST reach commit_fetch (blocks "
+            "imported) or abort_fetch (fall back to recompute) on every "
+            "path out of the fetching function — a stranded lease wedges "
+            "the inflight-fetch accounting and hides a fetch that neither "
+            "landed nor fell back.",
+        paths=("kvbm/directory.py", "engine/engine.py", "sim/fleet.py"),
+        acquire=(("begin_fetch", ()),),
+        release=(("commit_fetch", ()), ("abort_fetch", ())),
+        exempt_functions=("begin_fetch", "commit_fetch", "abort_fetch"),
+    ),
+    ResourceSpec(
         name="kv-commit-signal",
         doc="KvCommitSignal waits are self-cleaning by construction: one "
             "shared shielded future serves every waiter and wait() never "
