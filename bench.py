@@ -240,7 +240,23 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
     # dead on this image); tier-1 asserts streamed <= blocking.
     from dynamo_tpu.ops.costs import streamed_transfer_model
     from dynamo_tpu.runtime.bandwidth import WIRE_PRIORS
+    from dynamo_tpu.runtime.attribution import (
+        attribute,
+        bench_attribution_detail,
+    )
+    from dynamo_tpu.runtime.flight_recorder import get_flight_recorder
     from dynamo_tpu.runtime.slo import bench_slo_detail
+
+    # per-phase critical-path decomposition of the timed requests' flight
+    # timelines (runtime/attribution.py) — warmup requests carry different
+    # ids, so only the measured run lands here
+    recorder = get_flight_recorder()
+    attr_breakdowns = []
+    for i in range(batch):
+        flight = recorder.timeline(f"bench-{100 + i}-{DECODE_TOKENS}")
+        attr = attribute(flight) if flight else None
+        if attr is not None:
+            attr_breakdowns.append(attr["phases_ns"])
 
     kv_itemsize = 1 if kv_dtype == "int8" else 2
     chunk = min(PROMPT_LEN, cfg.prefill_chunk)
@@ -333,6 +349,10 @@ async def run_bench(batch: int = BATCH, kv_dtype: str = "model") -> dict:
             # against the named SLA classes (runtime/slo.py; tier-1 pins
             # the schema in tests/test_slo.py)
             "slo": bench_slo_detail(slo_samples),
+            # per-phase mean/p99 latency + share of e2e for the timed
+            # requests (runtime/attribution.py; tier-1 pins the schema in
+            # tests/test_attribution.py)
+            "attribution": bench_attribution_detail(attr_breakdowns),
             "step_telemetry": {
                 phase: _phase_summary(samples)
                 for phase, samples in sorted(step_log.items())

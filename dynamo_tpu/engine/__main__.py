@@ -633,8 +633,54 @@ async def main() -> None:
         dtpu_namespace=args.namespace, dtpu_component=component,
         dtpu_endpoint=args.endpoint,
     )
+    # degradation detectors (runtime/health.py): the step hook below feeds
+    # measured-vs-modeled step time into cost_model_drift; events land on
+    # the flight recorder, the metrics registry, and the event plane
+    from dynamo_tpu.runtime.health import get_health_monitor
+
+    health_monitor = get_health_monitor()
+    health_monitor.bind_metrics(tele_scope)
+
+    def _predicted_step_s(s) -> float:
+        """ops/costs.py roofline floor for the step the hook just saw.
+        The exact per-row mix is gone by hook time, so rows are the
+        occupancy-mean context — fine for drift detection, which trips on
+        the measured/predicted RATIO moving, not its absolute level
+        (calibrate DTPU_HEALTH_DRIFT_RATIO per platform)."""
+        from dynamo_tpu.ops.costs import predict_step_seconds
+
+        occ = max(s.batch_occupancy, 1)
+        mean_len = max(s.kv_active_blocks * args.block_size // occ, 1)
+        q = max(s.tokens // occ, 1) if s.phase != "decode" else 1
+        return predict_step_seconds(
+            [(q, mean_len)] * occ,
+            block_size=args.block_size,
+            kv_heads=getattr(mcfg, "num_kv_heads", 8),
+            num_heads=getattr(mcfg, "num_heads", 32),
+            head_dim=getattr(mcfg, "head_dim", 128),
+            layers=getattr(mcfg, "num_layers", 32),
+            # sustained HBM stream prior (v5e-class, ~0.8 TB/s); only the
+            # ratio's drift matters, not the absolute calibration
+            hbm_bytes_s=8.0e11,
+            dispatch_s=5e-3,
+        )
+
+    telemetries = []
     for r, e in enumerate(engines):
-        e.stats_hook = EngineTelemetry(tele_scope.child(dp_rank=str(r))).on_step
+        tele = EngineTelemetry(tele_scope.child(dp_rank=str(r)))
+        telemetries.append(tele)
+
+        def _hook(s, _tele=tele, _r=r):
+            _tele.on_step(s)
+            try:
+                health_monitor.observe_step(
+                    f"worker/{instance_id:016x}/dp{_r}",
+                    s.duration_s, _predicted_step_s(s), phase=s.phase,
+                )
+            except Exception:
+                pass  # the detector must never take the step loop down
+
+        e.stats_hook = _hook
     # per-wire KV transfer bandwidth EWMA onto /metrics (the decode side of
     # a disagg pair observes pulls here; routing elsewhere reads the gauge)
     from dynamo_tpu.runtime.bandwidth import get_bandwidth_estimator
@@ -676,6 +722,7 @@ async def main() -> None:
             "kv_wire": os.environ.get("DTPU_KV_WIRE", "inline"),
         }
 
+    kv_directory = None
     if kvbm is not None:
         from dynamo_tpu.kvbm.directory import GlobalKvDirectory, directory_enabled
 
@@ -818,8 +865,10 @@ async def main() -> None:
     from dynamo_tpu.runtime.config import ENV_CKPT_DIR
 
     ckpt_dir = env_str(ENV_CKPT_DIR, "") or None
+    restore_mode = None
     if ckpt_dir:
         restored = await restore_engine(engines[0], ckpt_dir)
+        restore_mode = restored["mode"]
         tele_scope.gauge(
             M_.CHECKPOINT_RESTORE_MODE,
             "1 for the restore mode this worker booted with",
@@ -861,6 +910,50 @@ async def main() -> None:
             # rolling attainment/burn gauges follow the scrape clock
             get_slo_accountant().export_metrics()
 
+        def worker_snapshot() -> dict:
+            """The ``/debug/worker`` document — everything the frontend's
+            ``/debug/fleet`` fan-out (llm/fleet.py) merges from this worker
+            in one call: engine + step telemetry, the SLO ledger, the
+            attribution windows, KV occupancy, drain/restore state, the
+            global-KV directory stats, wire bandwidth, health events."""
+            from dynamo_tpu.runtime.attribution import get_attribution
+            from dynamo_tpu.runtime.slo import debug_slo_payload
+
+            snap = engine.snapshot()
+            ranks = snap["ranks"] if "ranks" in snap else [snap]
+            doc = {
+                "instance_id": f"{instance_id:016x}",
+                "model": args.model,
+                "tp": args.tp,
+                "dp": args.dp,
+                "engine": snap,
+                "telemetry": [t.snapshot() for t in telemetries],
+                "slo": debug_slo_payload(get_slo_accountant()),
+                "attribution": get_attribution().snapshot(),
+                "bandwidth": get_bandwidth_estimator().snapshot(),
+                "health": health_monitor.snapshot(),
+                "drain": {"draining": drain_coordinator.ledger.draining},
+                "kv": {
+                    "active_blocks": sum(
+                        r.get("active_blocks", 0) for r in ranks
+                    ),
+                    "free_blocks": sum(r.get("free_blocks", 0) for r in ranks),
+                    "total_blocks": args.num_blocks * args.dp,
+                    "cached_blocks": sum(
+                        r.get("cached_blocks", 0) for r in ranks
+                    ),
+                },
+            }
+            if restore_mode is not None:
+                doc["restore_mode"] = restore_mode
+            if kv_directory is not None:
+                doc["global_kv"] = {
+                    "published": kv_directory.published_count,
+                    "inflight_fetches": kv_directory.inflight_fetches(),
+                    "dedupe_skipped": kv_directory.dedupe_skipped,
+                }
+            return doc
+
         status_server = StatusServer(
             health,
             metrics_scope=runtime.metrics,
@@ -878,8 +971,35 @@ async def main() -> None:
                 if engines[0].lora is not None else None
             ),
             drain_fn=drain_coordinator.begin,
+            worker_snapshot_fn=worker_snapshot,
         )
         await status_server.start()
+        # advertise the side port on the discovery record so the frontend's
+        # /debug/fleet fan-out can find this worker's /debug/worker
+        await served.update_metadata({
+            "status_address": f"{cfg.host_ip}:{status_server.port}",
+        })
+
+    # health events onto the event plane: planners/supervisors subscribe to
+    # dtpu.health.* without scraping; the subscription handle is closed on
+    # shutdown (RESOURCE-LEAK health-subscription)
+    import json as _json
+
+    from dynamo_tpu.runtime.tasks import spawn_bg as _spawn_bg
+
+    _main_loop = asyncio.get_running_loop()
+
+    def _publish_health(ev) -> None:
+        payload = _json.dumps(ev.to_dict()).encode()
+        coro = runtime.event_plane.publish(
+            f"dtpu.health.{ev.detector}", payload
+        )
+        try:
+            _main_loop.call_soon_threadsafe(_spawn_bg, coro)
+        except RuntimeError:
+            coro.close()  # loop already closed during shutdown
+
+    health_sub = health_monitor.subscribe(_publish_health)
     print(f"TPU_ENGINE_READY {args.model} tp={args.tp}", flush=True)
 
     loop = asyncio.get_running_loop()
@@ -890,6 +1010,7 @@ async def main() -> None:
     # the request server waits out in-flight streams before closing
     await watchdog.stop()
     await canary.stop()
+    health_sub.close()
     if status_server is not None:
         await status_server.stop()
     if not watchdog.fired:

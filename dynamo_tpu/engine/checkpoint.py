@@ -338,11 +338,31 @@ async def checkpoint_engine(
     )
 
 
+def _record_restore(result: Dict[str, Any], ckpt_dir: str) -> Dict[str, Any]:
+    """Stamp the restore outcome on the worker's flight recorder under a
+    synthetic id (like engine/drain.py's drain timeline): the restore
+    classification is the first thing to read after an elastic respawn."""
+    from ..runtime.flight_recorder import get_flight_recorder
+
+    get_flight_recorder().record(
+        "restore", "checkpoint_restore",
+        mode=result["mode"], blocks=result["blocks"],
+        queued=len(result.get("queue", ())), ckpt_dir=ckpt_dir,
+        **({"reason": result["reason"]} if "reason" in result else {}),
+    )
+    return result
+
+
 async def restore_engine(engine, ckpt_dir: str) -> Dict[str, Any]:
     """Restore sealed pages from a checkpoint into a fresh engine. Never
     raises on a bad checkpoint: corruption is DETECTED and reported as a
     cold boot (``{"mode": "cold", ...}``), the failure mode the chaos sim
-    pins. Returns ``{"mode": "warm"|"cold", "blocks": n, "queue": [...]}``."""
+    pins. Returns ``{"mode": "warm"|"partial"|"cold", "blocks": n,
+    "queue": [...]}`` — ``partial`` means a torn block cut the import
+    short but the content-addressed prefix before it is live (still a
+    useful respawn, but the operator should know the tail is cold). The
+    outcome also lands on the flight recorder as a ``checkpoint_restore``
+    event under the synthetic ``restore`` timeline."""
     import asyncio
 
     loop = asyncio.get_event_loop()
@@ -350,14 +370,21 @@ async def restore_engine(engine, ckpt_dir: str) -> Dict[str, Any]:
         state = await loop.run_in_executor(None, load_checkpoint, ckpt_dir)
     except CheckpointCorrupt as e:
         log.warning("checkpoint at %s rejected (%s); cold boot", ckpt_dir, e)
-        return {"mode": "cold", "blocks": 0, "queue": [], "reason": str(e)}
+        return _record_restore(
+            {"mode": "cold", "blocks": 0, "queue": [], "reason": str(e)},
+            ckpt_dir,
+        )
     if state.block_format != _engine_block_format(engine):
         log.warning(
             "checkpoint block format %s does not match this engine (%s); "
             "cold boot", state.block_format, _engine_block_format(engine),
         )
-        return {"mode": "cold", "blocks": 0, "queue": [], "reason": "format"}
+        return _record_restore(
+            {"mode": "cold", "blocks": 0, "queue": [], "reason": "format"},
+            ckpt_dir,
+        )
     imported = 0
+    truncated = False
     window = 64
     for lo in range(0, len(state.blocks), window):
         batch = state.blocks[lo : lo + window]
@@ -370,11 +397,20 @@ async def restore_engine(engine, ckpt_dir: str) -> Dict[str, Any]:
             # content-addressed pages already imported are valid — keep the
             # warm prefix, stop at the first torn block
             log.warning("restore stopped at bad block (%s)", e)
+            truncated = True
             break
         if state.block_format["kind"] == "int8":
             arr = engine._kv_codec().decode_many(np.stack(arrs))
         else:
             arr = np.stack(arrs)
         imported += await engine.import_blocks(list(batch), arr)
-    mode = "warm" if imported else "cold"
-    return {"mode": mode, "blocks": imported, "queue": list(state.queue)}
+    if not imported:
+        mode = "cold"
+    elif truncated or imported < len(state.blocks):
+        mode = "partial"
+    else:
+        mode = "warm"
+    return _record_restore(
+        {"mode": mode, "blocks": imported, "queue": list(state.queue)},
+        ckpt_dir,
+    )

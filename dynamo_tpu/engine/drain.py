@@ -38,9 +38,15 @@ from ..runtime.config import (
     env_str,
 )
 from ..runtime.faults import FAULTS
+from ..runtime.flight_recorder import get_flight_recorder
 from ..runtime.logging import get_logger
 
 log = get_logger("engine.drain")
+
+# the drain pipeline leaves a timeline under this synthetic id (PR 17 gap):
+# notice -> quiesce -> checkpoint on one /debug/requests?id=drain record,
+# so "how did the last reclaim go" reads like any request post-mortem
+DRAIN_FLIGHT_ID = "drain"
 
 
 class DrainLedger:
@@ -151,6 +157,10 @@ class DrainCoordinator:
         token = self.ledger.acquire_drain(deadline_s)
         if token is None:
             return {"state": "draining", "already": True}
+        flight = get_flight_recorder()
+        flight.record(
+            DRAIN_FLIGHT_ID, "drain_notice", deadline_s=deadline_s,
+        )
         t0 = time.monotonic()
         try:
             await self.served.update_metadata({
@@ -160,6 +170,10 @@ class DrainCoordinator:
             log.info("draining: deadline=%.1fs", deadline_s)
             quiesced = await self._await_quiesce(
                 max(0.0, deadline_s - margin_s), t0
+            )
+            flight.record(
+                DRAIN_FLIGHT_ID, "drain_quiesce", quiesced=quiesced,
+                elapsed_s=round(time.monotonic() - t0, 3),
             )
             ckpt_blocks = 0
             if self.ckpt_dir:
@@ -173,6 +187,9 @@ class DrainCoordinator:
                 ckpt_blocks = len(manifest.get("blocks", ()))
                 if self._evacuated is not None and ckpt_blocks:
                     self._evacuated.inc(ckpt_blocks)
+            flight.record(
+                DRAIN_FLIGHT_ID, "drain_checkpoint", blocks=ckpt_blocks,
+            )
             margin = deadline_s - (time.monotonic() - t0)
             if self._margin is not None:
                 self._margin.set(margin)

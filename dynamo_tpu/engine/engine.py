@@ -57,6 +57,7 @@ from ..runtime.errors import (
     InvalidRequestError,
 )
 from ..runtime.faults import FAULTS
+from ..runtime.attribution import get_attribution
 from ..runtime.flight_recorder import get_flight_recorder
 from ..runtime.slo import get_slo_accountant, sla_t0_ns, spec_from_annotations
 from ..runtime.tasks import spawn_bg
@@ -2432,6 +2433,15 @@ class TpuEngine:
                 )
                 if is_tier and self.kv_directory is not None else None
             )
+            # the fetch lifecycle lands on the request's timeline (PR 16
+            # gap): started/committed/aborted bracket the wire pull, so the
+            # attribution plane charges this wait to kv_fetch and a stuck
+            # fetch is visible as started-without-terminal
+            flight.record(
+                req.request_id, "fetch_started",
+                holder=kv_plan.get("holder", ""), tier=is_tier,
+                blocks=len(kv_plan.get("hashes", [])),
+            )
             try:
                 got = await self._get_transfer_client().fetch_and_import(
                     kv_plan["address"],
@@ -2445,6 +2455,15 @@ class TpuEngine:
                         self.kv_directory.commit_fetch(fetch_lease, got)
                     else:
                         self.kv_directory.abort_fetch(fetch_lease)
+                if got > 0:
+                    flight.record(
+                        req.request_id, "fetch_committed", tokens=got,
+                    )
+                else:
+                    flight.record(
+                        req.request_id, "fetch_aborted",
+                        reason="zero_progress",
+                    )
                 log.debug("imported %d transferred kv tokens for %s", got, req.request_id[:8])
                 flight.record(
                     req.request_id, "transfer",
@@ -2454,6 +2473,9 @@ class TpuEngine:
                 if fetch_lease is not None:
                     self.kv_directory.abort_fetch(fetch_lease)
                 log.exception("kv transfer failed; recomputing prefill locally")
+                flight.record(
+                    req.request_id, "fetch_aborted", reason=str(e)[:200],
+                )
                 flight.record(
                     req.request_id, "transfer",
                     tokens=0, error=str(e)[:200],
@@ -4297,6 +4319,19 @@ class TpuEngine:
             tokens=st.produced,
             **({"sla_class": st.sla.sla_class} if st.sla is not None else {}),
         )
+        # critical-path attribution (runtime/attribution.py): fold the
+        # closed timeline into the worker's rolling per-(model, class)
+        # phase aggregates — the /debug/worker "where does p99 go" view
+        try:
+            timeline = flight.timeline(rid)
+            if timeline is not None:
+                get_attribution().observe_flight(
+                    st.req.model,
+                    st.sla.sla_class if st.sla is not None else "unclassified",
+                    timeline,
+                )
+        except Exception:
+            log.exception("attribution observe failed for %s", rid[:8])
         tracer = get_tracer()
         if not tracer.enabled:
             return

@@ -22,8 +22,9 @@ mean/p99 step time in the BENCH JSON.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Optional
+from typing import Any, Dict, Optional
 
 from ..runtime import metrics as M
 from ..runtime.config import ENV_SLOW_STEP_MS, env_float
@@ -108,10 +109,59 @@ class EngineTelemetry:
             M.SLOW_STEPS_TOTAL, "steps slower than DTPU_SLOW_STEP_MS",
             extra_labels=("phase",),
         )
+        self.slow_steps = 0
+        # small rolling window + last-seen gauges for the /debug/worker
+        # snapshot (runtime/health.py): step telemetry without a Prometheus
+        # scrape-and-parse round trip
+        self._recent: "collections.deque[StepStats]" = collections.deque(
+            maxlen=128
+        )
+        self._last: Optional[StepStats] = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The step-telemetry section of the worker's ``/debug/worker``
+        document: rolling per-phase step-time means plus the last step's
+        occupancy/queue/KV view."""
+        recent = list(self._recent)
+        by_phase: Dict[str, Dict[str, Any]] = {}
+        for s in recent:
+            agg = by_phase.setdefault(
+                s.phase, {"steps": 0, "duration_sum_s": 0.0, "tokens": 0}
+            )
+            agg["steps"] += 1
+            agg["duration_sum_s"] += s.duration_s
+            agg["tokens"] += s.tokens
+        phases = {
+            phase: {
+                "steps": agg["steps"],
+                "mean_step_s": round(agg["duration_sum_s"] / agg["steps"], 6),
+                "tokens": agg["tokens"],
+            }
+            for phase, agg in sorted(by_phase.items())
+        }
+        out: Dict[str, Any] = {
+            "steps_total": self.steps,
+            "slow_steps_total": self.slow_steps,
+            "recent": phases,
+        }
+        last = self._last
+        if last is not None:
+            out["last"] = {
+                "phase": last.phase,
+                "batch_occupancy": last.batch_occupancy,
+                "batch_size": last.batch_size,
+                "queue_depth": last.queue_depth,
+                "kv_active_blocks": last.kv_active_blocks,
+                "kv_free_blocks": last.kv_free_blocks,
+                "kv_total_blocks": last.kv_total_blocks,
+            }
+        return out
 
     def on_step(self, s: StepStats) -> None:
         try:
             self.steps += 1
+            self._recent.append(s)
+            self._last = s
             self._dur.observe(s.duration_s, phase=s.phase)
             if s.tokens > 0:
                 self._tokens.observe(s.tokens, phase=s.phase)
@@ -124,6 +174,7 @@ class EngineTelemetry:
             if s.spec_acceptance is not None:
                 self._spec.set(s.spec_acceptance)
             if s.duration_s > self.slow_step_s:
+                self.slow_steps += 1
                 self._slow.inc(phase=s.phase)
                 log.warning(
                     "slow %s step: %.0f ms (threshold %.0f ms; occupancy "

@@ -115,6 +115,11 @@ class WorkerMetrics:
     active_decode_blocks: int = 0
     active_prefill_tokens: int = 0
     num_requests_waiting: int = 0
+    # blocks the worker has ACCEPTED but not yet admitted (its waiting
+    # queue, in block units): the scheduler folds these into the load
+    # term so a report can supersede the router's optimistic charges
+    # without erasing queued work the worker already owns
+    waiting_prefill_blocks: int = 0
     # running SEQUENCES (not blocks): the planner's ITL interpolation is
     # keyed on decode concurrency, which blocks overstate by ctx/block_size
     num_requests_active: int = 0
@@ -127,6 +132,7 @@ class WorkerMetrics:
             "decode_blocks": self.active_decode_blocks,
             "prefill_tokens": self.active_prefill_tokens,
             "waiting": self.num_requests_waiting,
+            "waiting_blocks": self.waiting_prefill_blocks,
             "active": self.num_requests_active,
             "total_blocks": self.total_blocks,
             "ts": self.ts,
@@ -139,6 +145,7 @@ class WorkerMetrics:
             active_decode_blocks=obj.get("decode_blocks", 0),
             active_prefill_tokens=obj.get("prefill_tokens", 0),
             num_requests_waiting=obj.get("waiting", 0),
+            waiting_prefill_blocks=obj.get("waiting_blocks", 0),
             num_requests_active=obj.get("active", 0),
             total_blocks=obj.get("total_blocks", 0),
             ts=obj.get("ts", 0.0),

@@ -104,6 +104,7 @@ class WorkerMetricsPublisher:
         num_requests_waiting: int = 0,
         num_requests_active: int = 0,
         total_blocks: int = 0,
+        waiting_prefill_blocks: int = 0,
     ) -> None:
         m = WorkerMetrics(
             worker=self.worker,
@@ -112,6 +113,7 @@ class WorkerMetricsPublisher:
             num_requests_waiting=num_requests_waiting,
             num_requests_active=num_requests_active,
             total_blocks=total_blocks,
+            waiting_prefill_blocks=waiting_prefill_blocks,
             ts=self._clock(),
         )
         await self._plane.publish(self._topic, msgpack.packb(m.to_obj(), use_bin_type=True))

@@ -34,6 +34,10 @@ from .protocols import OverlapScores, WorkerMetrics, WorkerWithDpRank
 
 log = get_logger("kv_router.scheduler")
 
+# removed-worker tombstones retained against straggler metric reports; far
+# above any live fleet's churn window, tiny either way
+_TOMBSTONE_CAP = 65536
+
 
 def _env_int(name: str, default: int) -> int:
     try:
@@ -179,6 +183,17 @@ class KvScheduler:
         # load-bucket index answering least_loaded without a fleet scan
         self._workers: Dict[WorkerWithDpRank, None] = {}
         self._loads = _LoadIndex()
+        # tombstones: workers explicitly removed (discovery departure,
+        # retire, reclaim). A straggler metrics report arriving after the
+        # removal must NOT resurrect the worker as a routing candidate —
+        # a draining engine keeps publishing until it stops, and a ghost
+        # that re-registers at zero-ish load wins the least-loaded prune
+        # exactly while real workers honestly report deep queues. Only an
+        # explicit re-register (discovery says it's back) clears the mark.
+        # Insertion-ordered and bounded: a long-lived router under fleet
+        # churn trims the oldest tombstones (a publisher that still lingers
+        # months later is not a real failure mode).
+        self._removed: Dict[WorkerWithDpRank, None] = {}
 
     # -- state feeds ---------------------------------------------------------
     def register_worker(self, worker: WorkerWithDpRank) -> None:
@@ -186,6 +201,7 @@ class KvScheduler:
         Discovery/fleet layers call this as instances appear so idle
         workers are reachable through the least-loaded prune path before
         they ever publish metrics or serve a request."""
+        self._removed.pop(worker, None)
         if worker not in self._workers:
             self._workers[worker] = None
             self._loads.set(worker, self._raw_load(worker))
@@ -196,21 +212,36 @@ class KvScheduler:
         applies — it orders *candidates for exact rescoring*, which then
         prices staleness exactly."""
         m = self._metrics.get(worker)
-        reported = m.active_decode_blocks if m is not None else 0
+        reported = (
+            m.active_decode_blocks + m.waiting_prefill_blocks
+            if m is not None else 0
+        )
         return reported + self._local_decode_blocks.get(worker, 0)
 
     def update_metrics(self, m: WorkerMetrics) -> None:
+        if m.worker in self._removed:
+            # late report from a removed worker: drop it wholesale
+            return
         # staleness is judged against *our* clock: stamp arrival time rather
         # than trusting the producer's wall clock (cross-host skew would
         # silently disable the load term)
         m.ts = self._clock()
         self._metrics[m.worker] = m
-        # worker's own report supersedes our optimistic local estimate
+        # worker's own report supersedes our optimistic local estimate —
+        # it covers BOTH admitted work (active_decode_blocks) and its
+        # still-queued backlog (waiting_prefill_blocks), so zeroing the
+        # local charges never hides accepted-but-waiting requests
         self._local_decode_blocks[m.worker] = 0
         self._workers.setdefault(m.worker, None)
-        self._loads.set(m.worker, m.active_decode_blocks)
+        self._loads.set(
+            m.worker, m.active_decode_blocks + m.waiting_prefill_blocks
+        )
 
     def add_local_load(self, worker: WorkerWithDpRank, blocks: int) -> None:
+        if worker in self._removed:
+            # a charge can race the removal (decision in flight while
+            # discovery retires the worker): never resurrect the candidate
+            return
         self._local_decode_blocks[worker] = self._local_decode_blocks.get(worker, 0) + blocks
         self._workers.setdefault(worker, None)
         self._loads.set(worker, self._raw_load(worker))
@@ -232,6 +263,9 @@ class KvScheduler:
         self._local_decode_blocks.pop(worker, None)
         self._workers.pop(worker, None)
         self._loads.remove(worker)
+        self._removed[worker] = None
+        while len(self._removed) > _TOMBSTONE_CAP:
+            self._removed.pop(next(iter(self._removed)))
 
     def decode_blocks(self, worker: WorkerWithDpRank) -> int:
         m = self._metrics.get(worker)
@@ -240,7 +274,7 @@ class KvScheduler:
             self.config.metrics_stale_after_s <= 0
             or self._clock() - m.ts < self.config.metrics_stale_after_s
         ):
-            reported = m.active_decode_blocks
+            reported = m.active_decode_blocks + m.waiting_prefill_blocks
         return reported + self._local_decode_blocks.get(worker, 0)
 
     # -- the prune-stage feeds (router.py) -----------------------------------

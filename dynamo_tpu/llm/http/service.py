@@ -157,6 +157,10 @@ def _openapi_spec() -> dict:
             "/debug/slo": {"get": op(
                 "Per-class SLO attainment / burn-rate ledger", tag="system"
             )},
+            "/debug/fleet": {"get": op(
+                "Merged fleet snapshot (fan-out to every worker)",
+                tag="system",
+            )},
             "/openapi.json": {"get": op("This document", tag="system")},
         },
     }
@@ -207,6 +211,13 @@ class HttpService:
         # and served on /debug/slo. Worker-side engines keep their own
         # ledger from milestone timestamps (StatusServer /debug/slo).
         self.slo = SloAccountant(metrics=self.metrics)
+        # critical-path attribution (runtime/attribution.py): every finished
+        # request's flight-recorder timeline decomposed into phases that sum
+        # to e2e, rolled up per (model, class) window — "where does p99 go"
+        # without reading timelines by hand. Served in /debug/fleet.
+        from ...runtime.attribution import AttributionAggregator
+
+        self.attribution = AttributionAggregator(metrics=self.metrics)
         self._ttft = self.metrics.histogram(
             M.TTFT_SECONDS, "time to first token",
             extra_labels=(M.LABEL_MODEL, M.LABEL_SLA_CLASS),
@@ -280,6 +291,7 @@ class HttpService:
         app.router.add_get("/docs", self.docs)
         app.router.add_get("/debug/requests", self.debug_requests)
         app.router.add_get("/debug/slo", self.debug_slo)
+        app.router.add_get("/debug/fleet", self.debug_fleet)
         return app
 
     async def start(self) -> str:
@@ -337,6 +349,27 @@ class HttpService:
         """Per-(model, sla_class) attainment/burn-rate ledger
         (runtime/slo.py) — the client-observed view this frontend keeps."""
         return web.json_response(debug_slo_payload(self.slo))
+
+    async def debug_fleet(self, request: web.Request) -> web.Response:
+        """One-call fleet snapshot (llm/fleet.py): fan out to every
+        discovered worker's ``/debug/worker``, merge with the frontend's
+        own SLO/attribution/breaker view. Unreachable workers come back
+        ``stale``-marked, never as a 500 — a degraded fleet is exactly
+        when this endpoint matters."""
+        from ..fleet import fleet_snapshot
+
+        doc = await fleet_snapshot(
+            self.manager.pipelines(),
+            frontend={
+                "slo": self.slo.snapshot(),
+                "attribution": self.attribution.snapshot(),
+                "model_breakers": {
+                    m: cb.state
+                    for m, cb in sorted(self._model_breakers.items())
+                },
+            },
+        )
+        return web.json_response(doc)
 
     def _resolve_sla(self, request: web.Request, body_class: Optional[str],
                      pipeline: ModelPipeline):
@@ -792,9 +825,26 @@ class HttpService:
                 error_class=fail_type,
                 status=status, completion_tokens=completion_tokens,
             )
+            self._observe_attribution(model, sla, rid, flight)
             if audit_handle is not None:
                 audit_handle.emit()
                 await self.audit.drain_async_sinks()
+
+    def _observe_attribution(self, model, sla, rid, flight) -> None:
+        """Fold the finished request's timeline into the rolling phase
+        aggregates. The timeline is read back from the recorder AFTER
+        finish() so engine-stamped milestones (queued, admitted, first
+        token) that raced the frontend's view are included."""
+        try:
+            timeline = flight.timeline(rid)
+            if timeline is not None:
+                self.attribution.observe_flight(
+                    model,
+                    sla.sla_class if sla is not None else "unclassified",
+                    timeline,
+                )
+        except Exception:
+            log.exception("attribution observe failed for %s", rid[:16])
 
     async def _fail(
         self, resp: Optional[web.StreamResponse], status: int, msg: str, err_type: str
@@ -1169,6 +1219,7 @@ class HttpService:
                 error_class=fail_type,
                 status=status, completion_tokens=completion_tokens,
             )
+            self._observe_attribution(rreq.model, sla, preq.request_id, flight)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         busy = self._check_capacity()

@@ -430,11 +430,20 @@ class MockerEngine:
             for batch in removed:
                 await self.kv_publisher.removed(batch)
         if self.metrics_publisher is not None:
+            bs = self.args.block_size
             await self.metrics_publisher.publish(
                 active_decode_blocks=len(self.kv.active),
                 num_requests_waiting=len(self._waiting),
                 num_requests_active=len(self._running),
                 total_blocks=self.args.num_blocks,
+                # queued work in block units: without this the report
+                # erases the router's optimistic charges for requests
+                # that are accepted but not yet admitted, so a backed-up
+                # worker scores as if it were serving a single request
+                waiting_prefill_blocks=sum(
+                    (len(st.req.token_ids) + bs - 1) // bs
+                    for st in self._waiting
+                ),
             )
 
     # -- introspection (for planner/tests) ------------------------------------

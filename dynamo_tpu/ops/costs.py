@@ -447,6 +447,47 @@ def fetch_vs_recompute(
     }
 
 
+def predict_step_seconds(
+    rows: Sequence[Tuple[int, ...]],   # (query_len, seq_len[, window])
+    *,
+    block_size: int,
+    kv_heads: int,
+    num_heads: int,
+    head_dim: int,
+    hbm_bytes_s: float,
+    dispatch_s: float = 0.0,
+    weight_bytes: int = 0,
+    layers: int = 1,
+    kv_itemsize: int = 2,
+    q_itemsize: int = 2,
+    quantized: bool = False,
+) -> float:
+    """Roofline floor for one unified-attention engine step in SECONDS:
+    the step's modeled HBM traffic (attention pages via
+    :func:`unified_attention_bytes`, once per layer, + one weight stream)
+    over the device's sustained HBM bandwidth, plus a fixed host dispatch
+    overhead.
+
+    This is the expectation side of the ``cost_model_drift`` degradation
+    detector (runtime/health.py): the engine's measured step wall time is
+    compared against this prediction for the same row mix, and a worker
+    whose ratio climbs while its neighbours' stays flat has a local
+    problem (thermal throttle, noisy neighbour, dying HBM) that no
+    fleet-wide average would localize. A memory-bound floor is exactly
+    what is wanted for that comparison: real steps run a bounded factor
+    above it, and the detector trips on the RATIO drifting, not on the
+    absolute value.
+    """
+    att_bytes = unified_attention_bytes(
+        rows, block_size=block_size, kv_heads=kv_heads, num_heads=num_heads,
+        head_dim=head_dim, kv_itemsize=kv_itemsize, q_itemsize=q_itemsize,
+        quantized=quantized,
+    )
+    bw = max(float(hbm_bytes_s), 1.0)
+    total = att_bytes * max(int(layers), 1) + max(int(weight_bytes), 0)
+    return total / bw + max(dispatch_s, 0.0)
+
+
 def mixed_vs_split(
     chunk_len: int,
     chunk_total_len: int,

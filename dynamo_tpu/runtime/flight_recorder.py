@@ -165,11 +165,18 @@ def debug_requests_payload(
         # single-request view gains the SLO budget breakdown (queue/prefill/
         # decode share of the TTFT target, remaining deadline) when the
         # engine stamped the request's sla class onto its queued event
+        from .attribution import attribution_breakdown
         from .slo import budget_breakdown
 
         slo = budget_breakdown(flight)
         if slo is not None:
             flight = dict(flight, slo=slo)
+        # the critical-path phase decomposition (runtime/attribution.py):
+        # exhaustive, non-overlapping, sums to the e2e duration — present
+        # for any flight with >= 2 events, classed or not
+        attribution = attribution_breakdown(flight)
+        if attribution is not None:
+            flight = dict(flight, attribution=attribution)
         return 200, flight
     try:
         limit = int(limit_raw) if limit_raw is not None else 64

@@ -53,6 +53,12 @@ SLO_ATTAINMENT = f"{PREFIX}_slo_attainment_ratio"
 SLO_BURN_RATE = f"{PREFIX}_slo_burn_rate"
 GOODPUT_TOKENS = f"{PREFIX}_goodput_tokens_total"
 
+# critical-path attribution (runtime/attribution.py): per-request phase
+# decomposition that sums to the e2e duration
+REQUEST_PHASE_SECONDS = f"{PREFIX}_request_phase_seconds"
+# degradation detectors (runtime/health.py): typed, rate-limited events
+HEALTH_EVENTS_TOTAL = f"{PREFIX}_health_events_total"
+
 # fleet-wide KV reuse (kvbm/directory.py): global block directory + peer-
 # tier fetch accounting
 GLOBAL_KV_HITS_TOTAL = f"{PREFIX}_global_kv_hits_total"

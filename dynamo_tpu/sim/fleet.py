@@ -140,6 +140,11 @@ class PoolConfig:
     # candidate pruning + postings shard count (docs/operations.md)
     router_topk: Optional[int] = None
     router_shards: Optional[int] = None
+    # metric-staleness horizon override: a slowed worker's step (and thus
+    # its publish cadence) can outlast the default 10s window, making a
+    # backed-up worker score as idle exactly while it is drowning — the
+    # degradation scenario stretches this past its slowest step period
+    router_stale_s: Optional[float] = None
     # planner (autoscale=False -> fixed fleet of initial_workers)
     autoscale: bool = False
     adjustment_interval_s: float = 10.0
@@ -245,6 +250,8 @@ class SimPool:
             kv_overrides["topk_candidates"] = cfg.router_topk
         if cfg.router_shards is not None:
             kv_overrides["index_shards"] = cfg.router_shards
+        if cfg.router_stale_s is not None:
+            kv_overrides["metrics_stale_after_s"] = cfg.router_stale_s
         self.router = KvRouter(
             self.plane, cfg.namespace, cfg.component,
             block_size=cfg.block_size,

@@ -25,6 +25,12 @@ not point metrics but the loop behaviors ROADMAP item 3 needs proven:
                             tiers instead of re-prefilling; hit rate beats
                             the per-worker-radix counterfactual and a cold
                             worker's hot-prefix TTFT lands within 1.2x warm
+- ``degradation-localization``  seeded mid-run slowdown of one worker's
+                            step pacing + one wire's bandwidth: the
+                            production detectors (runtime/health.py) name
+                            the right worker and wire, the attribution
+                            aggregator's p99 dominant phase flips to the
+                            injected phase, and emissions stay rate-limited
 
 Scenarios scale with ``workers`` and ``duration_s`` so the same invariants
 run as a tier-1 smoke (small fleet, ~4 simulated minutes, seconds of wall
@@ -986,11 +992,16 @@ async def _http_frontend(
     breakers -> KvRouter) over the mocker fleet, driven by a real aiohttp
     client. Bursts overrun ``busy_threshold`` so admission sheds with 503s;
     a seeded flap on one worker trips its frontend-side breaker so routing
-    steers around it and Migration absorbs the losses; /metrics and
-    /debug/slo are scraped over the wire. Socket readiness is real I/O, so
-    this scenario's counts are *bounded*, not byte-deterministic — its
-    invariants assert behavior windows, and it is deliberately absent from
-    the byte-identity pins."""
+    steers around it and Migration absorbs the losses; /metrics, /debug/slo
+    and /debug/fleet are scraped over the wire — the fleet fan-out against
+    one worker with a REAL StatusServer, one advertising a dead address,
+    and the rest advertising nothing, so the partial-result merge (stale
+    entries, never a 500) is exercised over live sockets. Socket readiness
+    is real I/O, so this scenario's counts are *bounded*, not
+    byte-deterministic — its invariants assert behavior windows, and it is
+    deliberately absent from the byte-identity pins."""
+    import os
+
     import aiohttp
 
     from ..llm.discovery import ModelManager, ModelPipeline
@@ -998,7 +1009,9 @@ async def _http_frontend(
     from ..llm.model_card import ModelDeploymentCard
     from ..llm.protocols.common import PreprocessedRequest
     from ..runtime.component import RouterMode
+    from ..runtime.config import ENV_FLEET_TIMEOUT_S
     from ..runtime.faults import FAULTS, FaultInjected
+    from ..runtime.health import HealthState, StatusServer
 
     flap_wid = 1
     flap_until = 0.55 * duration_s
@@ -1029,10 +1042,13 @@ async def _http_frontend(
     class _Inst:
         __slots__ = ("metadata",)
 
-        def __init__(self):
-            self.metadata = {"data_parallel_size": 1}
+        def __init__(self, extra=None):
+            self.metadata = {"data_parallel_size": 1, **(extra or {})}
 
-    _INST = _Inst()
+    # per-worker discovery metadata: the /debug/fleet fan-out reads each
+    # instance's advertised status_address (engine/__main__.py stamps it
+    # after the side port binds); populated once the live StatusServer is up
+    status_meta: Dict[int, Dict] = {}
 
     class _Stream:
         """Worker stream with the ``instance_id`` tag Migration attributes
@@ -1053,7 +1069,9 @@ async def _http_frontend(
 
         @property
         def instances(self):
-            return {wid: _INST for wid in pool.workers}
+            return {
+                wid: _Inst(status_meta.get(wid)) for wid in pool.workers
+            }
 
         def instance_ids(self):
             return list(pool.workers)
@@ -1092,6 +1110,42 @@ async def _http_frontend(
     )
     await service.start()
     base = f"http://127.0.0.1:{service.port}"
+
+    # one worker backs its advertised status_address with a REAL
+    # StatusServer (its /debug/worker document feeds the merge rollups),
+    # one advertises a dead address (connection refused -> stale entry),
+    # the rest advertise nothing (stale: "no status_address advertised")
+    wids = sorted(pool.workers)
+    live_wid = wids[len(wids) // 2]
+    dead_wid = wids[-1]
+
+    def _worker_doc() -> Dict:
+        w = pool.workers.get(live_wid)
+        active = len(w.engine.kv.active) if w is not None else 0
+        total = pool.cfg.num_blocks
+        return {
+            "worker": f"sim-{live_wid}",
+            "kv": {
+                "active_blocks": active,
+                "free_blocks": total - active,
+                "total_blocks": total,
+            },
+            "restore_mode": "warm",
+            "health": {"active": []},
+        }
+
+    status = StatusServer(
+        HealthState(), host="127.0.0.1", port=0,
+        worker_snapshot_fn=_worker_doc,
+    )
+    status_addr = await status.start()
+    status_meta[live_wid] = {"status_address": status_addr}
+    status_meta[dead_wid] = {"status_address": "127.0.0.1:1"}
+    # the fan-out's per-worker timeout is judged on the virtualized loop
+    # clock, which can jump while a real TCP exchange is in flight — widen
+    # it so only genuinely dead addresses go stale
+    prev_timeout = os.environ.get(ENV_FLEET_TIMEOUT_S)
+    os.environ[ENV_FLEET_TIMEOUT_S] = "600"
 
     # a steady timer keeps the virtualized selector polling (socket
     # readiness is real I/O the loop must keep observing) and bounds how
@@ -1183,6 +1237,8 @@ async def _http_frontend(
 
     metrics_text = ""
     slo_payload: Dict = {}
+    fleet_payload: Dict = {}
+    fleet_status = 0
     try:
         import asyncio
 
@@ -1203,8 +1259,17 @@ async def _http_frontend(
         async with session.get(base + "/debug/slo") as r:
             if r.status == 200:
                 slo_payload = await r.json()
+        async with session.get(base + "/debug/fleet") as r:
+            fleet_status = r.status
+            if r.status == 200:
+                fleet_payload = await r.json()
     finally:
+        if prev_timeout is None:
+            os.environ.pop(ENV_FLEET_TIMEOUT_S, None)
+        else:
+            os.environ[ENV_FLEET_TIMEOUT_S] = prev_timeout
         await session.close()
+        await status.stop()
         await service.stop()
         await fleet.stop()
 
@@ -1246,6 +1311,21 @@ async def _http_frontend(
             "/metrics exposes dtpu_requests_total and /debug/slo carries "
             "the sim-http ledger, scraped over the live socket",
         ),
+        _invariant(
+            "fleet_snapshot_partial",
+            fleet_status == 200
+            and fleet_payload.get("fleet", {}).get("workers_total")
+            == workers
+            and fleet_payload.get("fleet", {}).get("workers_live") == 1
+            and fleet_payload.get("fleet", {}).get("workers_stale")
+            == workers - 1
+            and "attribution" in fleet_payload.get("frontend", {}),
+            f"/debug/fleet answered {fleet_status} over the live socket "
+            f"with {workers} workers (1 live via a real StatusServer, "
+            f"{workers - 1} stale: one dead address, the rest "
+            f"unadvertised) — partial results never turn into a 500; "
+            f"fleet rollup: {fleet_payload.get('fleet')}",
+        ),
     ]
     return {
         "fleet": fleet,
@@ -1257,6 +1337,12 @@ async def _http_frontend(
                 "client_retries": results["client_retries"],
                 "generate_calls": calls[0],
                 "breaker_transitions": breaker_states,
+                "fleet_snapshot": {
+                    "status": fleet_status,
+                    "rollup": fleet_payload.get("fleet"),
+                    "restore_modes": fleet_payload.get("restore_modes"),
+                    "merged_kv": fleet_payload.get("kv"),
+                },
             },
         },
     }
@@ -1751,6 +1837,245 @@ async def _global_kv_reuse(
 
 
 # ---------------------------------------------------------------------------
+# degradation-localization
+# ---------------------------------------------------------------------------
+
+
+async def _degradation_localization(
+    clock: simclock.VirtualClock, seed: int, workers: int, duration_s: float
+) -> Dict:
+    """The observability plane catching a seeded fault it was never told
+    about: halfway through a steady prefill-heavy trace, ONE worker's step
+    pacing slows 8x and the ``inline`` transfer wire collapses 20x. The
+    PRODUCTION detectors (runtime/health.py HealthMonitor) and attribution
+    aggregator (runtime/attribution.py) run on the virtual clock over the
+    live fleet signals — the scenario never tells them which worker or
+    wire it broke. Invariants: ``cost_model_drift`` fires and every firing
+    names exactly the slowed worker; ``wire_collapse`` fires and names
+    exactly the collapsed wire; the aggregator's p99 dominant phase flips
+    from ``prefill_compute`` (the healthy prefill-heavy trace) to
+    ``decode`` (the injected slowdown's phase); emissions respect the
+    rate limit and hysteresis (zero events before the injection, zero
+    spurious recoveries); zero failed requests. Fully deterministic:
+    same (seed, workers, duration_s) => byte-identical report section."""
+    from ..runtime.attribution import AttributionAggregator
+    from ..runtime.bandwidth import WireBandwidthEstimator
+    from ..runtime.flight_recorder import FlightRecorder
+    from ..runtime.health import HealthMonitor
+
+    # a large slowdown, deliberately: one degraded request's decode must
+    # outweigh the phase sums of the handful of healthy stragglers that
+    # share the p99 tail with it, at any fleet scale
+    slow_factor = 30.0
+    wire_factor = 20.0
+    inject_at = duration_s / 2.0
+    tick_s = 2.0
+    min_interval_s = 20.0
+    # steady arrivals at low utilization: healthy requests almost never
+    # queue, so the healthy p99 tail stays compute-shaped
+    trace = traces.diurnal(
+        duration_s=duration_s, mean_rate=0.2 * workers * _CAPACITY_REQ_S,
+        amplitude=0.0, period_s=duration_s, isl=512, osl=5,
+        num_groups=max(4, workers), seed=seed,
+        ttft_target_s=60.0, itl_target_s=15.0,
+    )
+    fleet = SimFleet(FleetConfig(
+        seed=seed, prefix_share=0.25,
+        pools=[PoolConfig(
+            name="serve", initial_workers=workers,
+            min_workers=workers, max_workers=workers,
+            # one sequence per worker at a time: ITL gaps are then pure
+            # decode pacing (no co-running prefill chunks riding in the
+            # iteration), so the healthy p99 tail stays prefill-dominant
+            # and the flip invariant isolates the injected slowdown.
+            # Pure least-loaded routing (no radix affinity): the worker-
+            # reported load (active + waiting_prefill_blocks) steers
+            # arrivals off the backed-up slow worker, so its queue —
+            # whose wait would land in prefill_queue and mask the decode
+            # flip — never forms; the slow worker still takes work when
+            # idle, which is exactly the degraded-but-unqueued stream the
+            # tail should surface. The staleness horizon must outlast the
+            # slowed worker's publish cadence (one step = slow_factor x
+            # decode_base), else it scores as idle between its steps.
+            max_num_seqs=1, overlap_weight=0.0, router_stale_s=30.0,
+            **_SPEED,
+        )],
+    ), clock)
+    await fleet.start()
+    pool = fleet.default_pool
+    slow_wid = sorted(pool.workers)[len(pool.workers) // 2]
+    slow_subject = f"worker/{slow_wid}"
+
+    # the production observability plane, on the virtual clock; a local
+    # flight recorder keeps health timelines out of the process global
+    monitor = HealthMonitor(
+        clock=clock.time, min_interval_s=min_interval_s, drift_ratio=2.0,
+        flight_recorder=FlightRecorder(),
+    )
+    events: List = []
+    sub = monitor.subscribe(events.append)
+    agg = AttributionAggregator(clock=clock.time)
+    est = WireBandwidthEstimator()
+    healthy_bw = est.bandwidth("inline")  # the static prior
+    wire_down = [False]
+    base_decode_s = _SPEED["decode_base_s"]
+    drained = [0]
+
+    last_finish: Dict[int, float] = {}
+
+    def _drain_records() -> None:
+        """Feed completed requests to the aggregator through the same
+        attribute() path the frontends use, on a synthetic timeline built
+        from the record's measured milestones. Workers serve one request
+        at a time here, so the engine-admission milestone the production
+        flight recorder would stamp is reconstructible: a request is
+        admitted when its predecessor on the same worker finished — queue
+        wait then lands in prefill_queue (as in production timelines)
+        instead of polluting prefill_compute."""
+        recs = pool.records
+        while drained[0] < len(recs):
+            rec = recs[drained[0]]
+            drained[0] += 1
+            if not rec.ok or rec.ttft_s < 0:
+                continue
+            finish_s = rec.t_arrive + rec.ttft_s + rec.itl_sum_s
+            admitted_s = min(
+                max(rec.t_arrive, last_finish.get(rec.worker, 0.0)),
+                rec.t_arrive + rec.ttft_s,
+            )
+            last_finish[rec.worker] = finish_s
+            t0 = int(rec.t_arrive * 1e9)
+            t_adm = int(admitted_s * 1e9)
+            t_ft = t0 + int(rec.ttft_s * 1e9)
+            t_end = t_ft + int(rec.itl_sum_s * 1e9)
+            agg.observe_flight("sim", rec.sla_class, {"events": [
+                {"timestamp": t0, "event": {"kind": "received"}},
+                {"timestamp": t0, "event": {"kind": "queued"}},
+                {"timestamp": t_adm, "event": {"kind": "admitted"}},
+                {"timestamp": t_ft, "event": {"kind": "first_token"}},
+                {"timestamp": t_end, "event": {"kind": "finish"}},
+            ]})
+
+    async def _ticker() -> None:
+        # the sampling loop a worker's step hook / transfer client replace
+        # in production: measured pacing vs the cost model's prediction,
+        # and the wire EWMA vs its own history
+        while True:
+            await clock.sleep(tick_s)
+            for wid, w in sorted(pool.workers.items()):
+                monitor.observe_step(
+                    f"worker/{wid}", w.engine.perf.decode_base_s,
+                    base_decode_s,
+                )
+            nbytes = 1 << 20
+            bw = healthy_bw / (wire_factor if wire_down[0] else 1.0)
+            est.observe("inline", nbytes, nbytes / bw)
+            monitor.observe_wire("inline", est.bandwidth("inline"))
+            _drain_records()
+
+    snap_before: Dict = {}
+
+    async def _inject() -> None:
+        await clock.sleep(inject_at)
+        _drain_records()
+        snap_before.update(agg.snapshot())
+        # the seeded fault: pacing drifts on ONE worker (the mocker's perf
+        # constants ARE its virtual step durations), one wire collapses
+        pool.workers[slow_wid].engine.perf.decode_base_s *= slow_factor
+        wire_down[0] = True
+
+    fleet.spawn_task(_ticker())
+    fleet.spawn_task(_inject())
+    try:
+        await fleet.run_trace(trace)
+        # let stragglers finish and the detectors settle
+        await clock.sleep(30.0)
+        _drain_records()
+        snap_after = agg.snapshot()
+    finally:
+        sub.close()
+        await fleet.stop()
+
+    def _p99_dominant(snap: Dict, window: str) -> Optional[str]:
+        classes = snap.get("models", {}).get("sim", {})
+        body = next(iter(classes.values()), {}).get(window, {})
+        return (body.get("p99") or {}).get("dominant")
+
+    degraded = [e for e in events if e.kind == "degraded"]
+    recovered = [e for e in events if e.kind == "recovered"]
+    drift = [e for e in degraded if e.detector == "cost_model_drift"]
+    wire = [e for e in degraded if e.detector == "wire_collapse"]
+    false_pos = [e for e in degraded if e.t < inject_at]
+    dom_before = _p99_dominant(snap_before, "total")
+    dom_after = _p99_dominant(snap_after, "total")
+    failed = sum(1 for r in pool.records if not r.ok)
+
+    def _spaced(evs: List) -> bool:
+        ts = [e.t for e in evs]
+        return all(b - a >= min_interval_s - 1e-6
+                   for a, b in zip(ts, ts[1:]))
+
+    # ceiling on per-subject emissions over the degraded window (trace
+    # tail + straggler completions + the settling sleep): the trip plus
+    # min_interval-spaced re-emissions
+    last_t = max((e.t for e in degraded), default=inject_at)
+    max_emits = 1 + int((last_t - inject_at) / min_interval_s)
+    invs = [
+        _invariant(
+            "drift_localized",
+            bool(drift) and all(e.subject == slow_subject for e in drift),
+            f"cost_model_drift fired {len(drift)}x, subjects "
+            f"{sorted({e.subject for e in drift})} (injected: "
+            f"{slow_subject} slowed {slow_factor}x at t={inject_at:.0f})",
+        ),
+        _invariant(
+            "wire_localized",
+            bool(wire) and all(e.subject == "wire/inline" for e in wire),
+            f"wire_collapse fired {len(wire)}x, subjects "
+            f"{sorted({e.subject for e in wire})} (injected: wire/inline "
+            f"collapsed {wire_factor}x)",
+        ),
+        _invariant(
+            "p99_dominant_flip",
+            dom_before != "decode" and dom_after == "decode",
+            f"p99 dominant phase {dom_before} at injection -> {dom_after} "
+            "after (the injected slowdown lands in decode)",
+        ),
+        _invariant(
+            "rate_limited_no_flap",
+            not false_pos and not recovered
+            and len(drift) <= max_emits and len(wire) <= max_emits
+            and _spaced(drift) and _spaced(wire),
+            f"{len(false_pos)} events before injection, {len(recovered)} "
+            f"spurious recoveries; {len(drift)}/{len(wire)} emissions "
+            f"within the {max_emits}-emission rate-limit ceiling, spaced "
+            f">= {min_interval_s:.0f}s",
+        ),
+        _invariant(
+            "zero_failed_requests", failed == 0,
+            f"{failed} failed requests under the injected degradation",
+        ),
+    ]
+    return {
+        "fleet": fleet,
+        "invariants": invs,
+        "requests": len(trace),
+        "extra_sim": {
+            "degradation": {
+                "slow_worker": slow_wid,
+                "injected_at_s": round(inject_at, 3),
+                "drift_events": len(drift),
+                "wire_events": len(wire),
+                "first_drift_t": round(drift[0].t, 3) if drift else None,
+                "first_wire_t": round(wire[0].t, 3) if wire else None,
+                "dominant_before": dom_before,
+                "dominant_after": dom_after,
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # registry + runner
 # ---------------------------------------------------------------------------
 
@@ -1766,6 +2091,7 @@ SCENARIOS: Dict[str, Callable] = {
     "elastic-reclaim": _elastic_reclaim,
     "elastic-reclaim-chaos": _elastic_reclaim_chaos,
     "global-kv-reuse": _global_kv_reuse,
+    "degradation-localization": _degradation_localization,
 }
 
 # aliases accepted by the CLI (`python -m dynamo_tpu.sim diurnal`)
@@ -1781,6 +2107,7 @@ ALIASES = {
     "reclaim": "elastic-reclaim",
     "reclaim-chaos": "elastic-reclaim-chaos",
     "globalkv": "global-kv-reuse",
+    "degradation": "degradation-localization",
 }
 
 
@@ -1837,6 +2164,7 @@ def run_suite(
         "prefix-heavy-radix", "multi-pool-balance",
         "disagg-streamed-prefill", "router-scale-sublinear",
         "http-frontend", "elastic-reclaim", "global-kv-reuse",
+        "degradation-localization",
     ]
     return [
         run_scenario(n, seed=seed, workers=workers, duration_s=duration_s)

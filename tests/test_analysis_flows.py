@@ -1,7 +1,7 @@
-"""tools/analysis interprocedural engine (flows.py) + the five lifecycle/
+"""tools/analysis interprocedural engine (flows.py) + the lifecycle/
 drift passes (RESOURCE-LEAK, LOCK-ACROSS-AWAIT, TASK-JOIN, ENV-DRIFT,
-FAULTS-DRIFT), the PR 10 / PR 13 reverted-fix re-detection pins, the SARIF
-output mode, and --changed-only.
+FAULTS-DRIFT, SPAN-DRIFT), the PR 10 / PR 13 reverted-fix re-detection
+pins, the SARIF output mode, and --changed-only.
 """
 
 import ast
@@ -910,6 +910,89 @@ def test_faults_drift_all_directions(tmp_path):
 def test_faults_drift_current_tree_clean(repo_analysis):
     _m, _p, findings = repo_analysis
     assert [f for f in findings if f.rule == "FAULTS-DRIFT"] == []
+
+
+# ---------------------------------------------------------------------------
+# SPAN-DRIFT fixtures
+# ---------------------------------------------------------------------------
+
+_SPAN_DOCS = """\
+# ops
+
+## Spans
+
+| span | emitted by | attributes |
+|---|---|---|
+| `engine.step` | engine loop | step index |
+| `ghost.span` | nobody anymore | - |
+"""
+
+
+def _span_tree(tmp_path, docs=_SPAN_DOCS):
+    (tmp_path / "dynamo_tpu" / "runtime").mkdir(parents=True)
+    (tmp_path / "dynamo_tpu" / "runtime" / "tracing.py").write_text(
+        "class Tracer:\n    pass\n"
+    )
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "operations.md").write_text(docs)
+
+
+def test_span_drift_both_directions(tmp_path):
+    _span_tree(tmp_path)
+    found = analyze(
+        tmp_path, "dynamo_tpu/svc.py", """
+        from .runtime.tracing import tracer
+
+        class Svc:
+            def step(self, i, name):
+                tracer.span("engine.step")        # documented: clean
+                self.tracer.emit("svc.rogue")     # undocumented: flagged
+                tracer.span("sim.tick")           # sim family: skipped
+                tracer.span(name)                 # dynamic: skipped
+                self.audit.emit("audit.write")    # wrong receiver: skipped
+        """,
+        rule="SPAN-DRIFT",
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert "'svc.rogue'" in msgs and "missing from the" in msgs
+    assert "'ghost.span'" in msgs and "prune the row" in msgs
+    assert "engine.step" not in msgs
+    assert len(found) == 2, found
+    # the undocumented emit is flagged AT its emit site, the unemitted doc
+    # row at the tracing module (there is no better anchor for a doc row)
+    rogue = next(f for f in found if "svc.rogue" in f.message)
+    assert rogue.path.endswith("dynamo_tpu/svc.py")
+    ghost = next(f for f in found if "ghost.span" in f.message)
+    assert ghost.path.endswith("runtime/tracing.py") and ghost.line == 1
+
+
+def test_span_drift_documented_and_emitted_is_clean(tmp_path):
+    _span_tree(
+        tmp_path,
+        "| span | emitted by |\n|---|---|\n| `engine.step` | loop |\n",
+    )
+    found = analyze(
+        tmp_path, "dynamo_tpu/svc.py",
+        'tracer.span("engine.step")\n',
+        rule="SPAN-DRIFT",
+    )
+    assert found == []
+
+
+def test_span_drift_skipped_without_docs_table(tmp_path):
+    """No span table (or no docs at all): nothing to drift against."""
+    _span_tree(tmp_path, "# ops\n\nno table here\n")
+    found = analyze(
+        tmp_path, "dynamo_tpu/svc.py",
+        'tracer.span("engine.step")\n',
+        rule="SPAN-DRIFT",
+    )
+    assert found == []
+
+
+def test_span_drift_current_tree_clean(repo_analysis):
+    _m, _p, findings = repo_analysis
+    assert [f for f in findings if f.rule == "SPAN-DRIFT"] == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,234 @@
+"""Critical-path attribution (runtime/attribution.py).
+
+ISSUE 19 acceptance: the sum-to-e2e property is pinned in tier-1 — for ANY
+flight-recorder timeline (unknown kinds, out-of-order events, duplicate
+timestamps) the phase decomposition sums EXACTLY (integer ns) to the
+e2e duration. Plus: the windowed aggregator on a fake clock, the p99-tail
+dominant logic the degradation scenario asserts on, the pinned
+``detail.attribution`` bench schema, and an end-to-end check that a REAL
+engine request's recorded timeline decomposes with the same guarantee.
+"""
+
+import random
+
+from dynamo_tpu.runtime.attribution import (
+    PHASES,
+    AttributionAggregator,
+    attribute,
+    attribution_breakdown,
+    bench_attribution_detail,
+    tail_samples,
+)
+
+NS = 1_000_000_000
+
+
+def _flight(*events):
+    """events: (t_seconds, kind) -> recorder-shaped timeline dict."""
+    return {
+        "events": [
+            {"timestamp": int(t * NS), "event": {"kind": kind}}
+            for t, kind in events
+        ]
+    }
+
+
+LIFECYCLE = _flight(
+    (0.0, "received"), (0.01, "tokenized"), (0.02, "routed"),
+    (0.03, "queued"), (0.5, "admitted"), (1.5, "first_token"),
+    (3.0, "finish"),
+)
+
+
+# ------------------------------------------------------------ sum-to-e2e
+class TestSumToE2E:
+    def test_lifecycle_sums_exactly(self):
+        attr = attribute(LIFECYCLE)
+        assert sum(attr["phases_ns"].values()) == attr["e2e_ns"] == 3 * NS
+
+    def test_property_random_timelines(self):
+        """The acceptance property: exhaustive + non-overlapping for any
+        timeline — random kinds (known, unknown, terminal), random order,
+        duplicate timestamps."""
+        rng = random.Random(0)
+        kinds = [
+            "received", "tokenized", "routed", "queued", "admitted",
+            "first_token", "finish", "abort", "fetch_started",
+            "fetch_committed", "transfer", "migration", "mystery_kind",
+            "another_new_kind", None,
+        ]
+        for _ in range(300):
+            n = rng.randint(2, 12)
+            events = [
+                (rng.uniform(0, 10.0), rng.choice(kinds)) for _ in range(n)
+            ]
+            attr = attribute(_flight(*events))
+            ordered = sorted(int(t * NS) for t, _ in events)
+            assert attr["e2e_ns"] == ordered[-1] - ordered[0]
+            assert sum(attr["phases_ns"].values()) == attr["e2e_ns"]
+            assert all(v >= 0 for v in attr["phases_ns"].values())
+            assert set(attr["phases_ns"]) == set(PHASES)
+            assert attr["dominant"] in PHASES
+
+    def test_out_of_order_events_are_sorted(self):
+        shuffled = _flight(
+            (3.0, "finish"), (0.0, "received"), (1.5, "first_token"),
+            (0.03, "queued"), (0.5, "admitted"),
+        )
+        attr = attribute(shuffled)
+        assert attr["e2e_ns"] == 3 * NS
+        assert sum(attr["phases_ns"].values()) == 3 * NS
+        assert attr["phases_ns"]["decode"] == int(1.5 * NS)
+
+    def test_too_short_timeline_is_none(self):
+        assert attribute(_flight((0.0, "received"))) is None
+        assert attribute({"events": []}) is None
+        assert attribute({}) is None
+
+
+# ------------------------------------------------------- phase semantics
+class TestPhaseCharging:
+    def test_gaps_charge_to_later_events_phase(self):
+        attr = attribute(LIFECYCLE)
+        p = attr["phases_ns"]
+        assert p["frontend_queue"] == int(0.01 * NS)   # received->tokenized
+        assert p["route"] == int(0.02 * NS)            # ->routed + ->queued
+        assert p["prefill_queue"] == int(0.47 * NS)    # queued->admitted
+        assert p["prefill_compute"] == NS              # admitted->first_token
+        assert p["decode"] == int(1.5 * NS)            # first_token->finish
+        assert attr["dominant"] == "decode"
+
+    def test_kv_fetch_phase(self):
+        attr = attribute(_flight(
+            (0.0, "received"), (0.1, "fetch_started"),
+            (0.9, "fetch_committed"), (1.0, "first_token"), (2.0, "finish"),
+        ))
+        assert attr["phases_ns"]["kv_fetch"] == int(0.8 * NS)
+
+    def test_unknown_kind_falls_back_by_position(self):
+        attr = attribute(_flight(
+            (0.0, "received"), (0.5, "first_token"),
+            (1.0, "mystery_checkpoint"), (2.0, "finish"),
+        ))
+        # mystery after first_token: its gap lands in decode
+        assert attr["phases_ns"]["decode"] == int(1.5 * NS)
+
+    def test_post_terminal_gap_is_epilogue(self):
+        attr = attribute(_flight(
+            (0.0, "received"), (1.0, "finish"), (1.25, "flushed"),
+        ))
+        assert attr["phases_ns"]["epilogue"] == int(0.25 * NS)
+        assert sum(attr["phases_ns"].values()) == attr["e2e_ns"]
+
+    def test_breakdown_shares_sum_to_one(self):
+        b = attribution_breakdown(LIFECYCLE)
+        assert b["e2e_s"] == 3.0
+        assert b["dominant"] == "decode"
+        assert abs(sum(b["shares"].values()) - 1.0) < 1e-3
+        assert set(b["phases"]) == set(PHASES)
+
+
+# ---------------------------------------------------------- aggregator
+class TestAggregator:
+    def test_windows_age_out_but_total_retains(self):
+        now = [1000.0]
+        agg = AttributionAggregator(clock=lambda: now[0])
+        agg.observe_flight("m", "standard", LIFECYCLE)
+        snap = agg.snapshot()["models"]["m"]["standard"]
+        assert snap["1m"]["requests"] == 1
+        assert snap["total"]["requests"] == 1
+        now[0] += 120.0  # past the 1m horizon, inside 5m
+        snap = agg.snapshot()["models"]["m"]["standard"]
+        assert snap["1m"]["requests"] == 0
+        assert snap["5m"]["requests"] == 1
+        assert snap["total"]["requests"] == 1
+
+    def test_p99_tail_dominant_isolates_slow_requests(self):
+        """90 fast prefill-dominant requests + 1 slow decode-dominant one:
+        the mean dominant stays prefill_compute, the p99 tail flips to
+        decode — the exact signal the degradation scenario pins."""
+        now = [5000.0]
+        agg = AttributionAggregator(clock=lambda: now[0])
+        fast = _flight(
+            (0.0, "received"), (0.01, "queued"), (0.02, "admitted"),
+            (1.0, "first_token"), (1.2, "finish"),
+        )
+        slow = _flight(
+            (0.0, "received"), (0.01, "queued"), (0.02, "admitted"),
+            (1.0, "first_token"), (40.0, "finish"),
+        )
+        for _ in range(90):
+            agg.observe_flight("m", "standard", fast)
+        agg.observe_flight("m", "standard", slow)
+        body = agg.snapshot()["models"]["m"]["standard"]["total"]
+        assert body["dominant"] == "prefill_compute"
+        assert body["p99"]["dominant"] == "decode"
+        assert body["p99"]["e2e_s"] == 40.0
+
+    def test_snapshot_schema(self):
+        agg = AttributionAggregator(clock=lambda: 0.0)
+        agg.observe_flight("m", "interactive", LIFECYCLE)
+        snap = agg.snapshot()
+        assert snap["windows"] == ["1h", "1m", "5m", "total"]
+        assert snap["phases"] == list(PHASES)
+        body = snap["models"]["m"]["interactive"]["total"]
+        assert set(body) >= {"requests", "e2e_mean_s", "mean_share",
+                             "dominant", "p99"}
+        assert set(body["mean_share"]) == set(PHASES)
+
+    def test_observe_flight_returns_none_for_short(self):
+        agg = AttributionAggregator(clock=lambda: 0.0)
+        assert agg.observe_flight("m", "c", {"events": []}) is None
+        assert "m" not in agg.snapshot()["models"]
+
+
+def test_tail_samples_picks_slowest():
+    samples = [(i * NS, {"decode": i * NS}) for i in range(1, 201)]
+    tail = tail_samples(samples)
+    assert len(tail) == 2  # 200 - int(0.99 * 200)
+    assert [s[0] for s in tail] == [199 * NS, 200 * NS]
+    assert len(tail_samples(samples[:5])) == 1  # floor of one sample
+
+
+# ------------------------------------------------------- bench schema pin
+def test_bench_attribution_detail_schema():
+    breakdowns = [
+        attribute(LIFECYCLE)["phases_ns"],
+        attribute(_flight(
+            (0.0, "received"), (0.5, "first_token"), (4.0, "finish"),
+        ))["phases_ns"],
+    ]
+    detail = bench_attribution_detail(breakdowns)
+    assert detail["requests"] == 2
+    assert detail["dominant"] == "decode"
+    assert set(detail["phases"]) == set(PHASES)
+    for body in detail["phases"].values():
+        assert set(body) == {"mean_s", "p99_s", "mean_share"}
+    shares = sum(b["mean_share"] for b in detail["phases"].values())
+    assert abs(shares - 1.0) < 1e-2
+    assert bench_attribution_detail([]) == {
+        "requests": 0, "phases": {}, "e2e_mean_s": 0.0, "dominant": None,
+    }
+
+
+# ------------------------------------------------------- real engine e2e
+async def test_engine_timeline_sums_to_e2e():
+    """A REAL TpuEngine request's recorded flight timeline decomposes with
+    the exact sum-to-e2e guarantee, and the milestone phases the engine
+    stamps (queued/admitted/first_token/finish) all carry time."""
+    from dynamo_tpu.runtime.flight_recorder import get_flight_recorder
+    from test_engine import greedy_req, run_req, tiny_engine
+
+    engine = tiny_engine()
+    try:
+        toks, _ = await run_req(engine, greedy_req("attr-e2e", list(range(40, 56))))
+        assert toks
+    finally:
+        engine.stop()
+    flight = get_flight_recorder().timeline("attr-e2e")
+    assert flight and len(flight["events"]) >= 2
+    attr = attribute(flight)
+    assert sum(attr["phases_ns"].values()) == attr["e2e_ns"]
+    assert attr["e2e_ns"] > 0
+    b = attribution_breakdown(flight)
+    assert abs(sum(b["shares"].values()) - 1.0) < 1e-3

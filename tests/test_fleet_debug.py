@@ -1,0 +1,199 @@
+"""One-call fleet snapshot (llm/fleet.py) + the worker's /debug/worker.
+
+ISSUE 19 acceptance: the frontend's ``/debug/fleet`` fan-out returns
+PARTIAL results — a dead, timed-out, or unadvertised worker becomes a
+``stale: true`` entry carrying the error, never a 500 — and the merge
+folds worker KV occupancy, global-KV stats, restore modes, and active
+health events into fleet-level rollups. Exercised both with an injected
+fetch (deterministic) and over real aiohttp sockets (StatusServer).
+"""
+
+import asyncio
+
+from dynamo_tpu.llm.fleet import fleet_snapshot
+from dynamo_tpu.runtime.health import HealthState, StatusServer
+
+
+class _Inst:
+    def __init__(self, address=None, state="ready"):
+        self.metadata = {"data_parallel_size": 1}
+        if address is not None:
+            self.metadata["status_address"] = address
+        if state != "ready":
+            self.metadata["state"] = state
+
+
+class _Card:
+    name = "m"
+
+
+class _Breaker:
+    def __init__(self, state="closed"):
+        self.state = state
+
+
+class _Client:
+    def __init__(self, instances):
+        self.instances = instances
+
+
+class _Pipeline:
+    def __init__(self, instances, breakers=None):
+        self.card = _Card()
+        self.client = _Client(instances)
+        self._worker_breakers = breakers or {}
+
+
+WORKER_DOC = {
+    "kv": {"active_blocks": 10, "free_blocks": 22, "total_blocks": 32},
+    "global_kv": {"published": 4, "inflight_fetches": 1, "dedupe_skipped": 2},
+    "restore_mode": "warm",
+    "health": {"active": [{"detector": "cost_model_drift",
+                           "subject": "worker/1"}]},
+}
+
+
+# ---------------------------------------------------- injected-fetch path
+async def test_partial_failure_is_stale_not_error():
+    """One worker answers, one worker's fetch raises, one times out, one
+    never advertised an address: 1 live + 3 stale, and the call returns."""
+
+    async def fetch(address):
+        if address == "good:1":
+            return dict(WORKER_DOC)
+        if address == "dead:1":
+            raise ConnectionError("connection refused")
+        await asyncio.sleep(3600)  # wedged worker: the timeout must cut it
+
+    pipe = _Pipeline(
+        {1: _Inst("good:1"), 2: _Inst("dead:1"), 3: _Inst("hung:1"),
+         4: _Inst()},
+        breakers={2: _Breaker("open"), 1: _Breaker()},
+    )
+    doc = await fleet_snapshot([pipe], fetch=fetch, timeout_s=0.05)
+    assert doc["fleet"] == {
+        "workers_total": 4, "workers_live": 1, "workers_stale": 3,
+        "draining": 0,
+    }
+    by_id = {w["worker_id"]: w for w in doc["workers"]}
+    assert not by_id[f"{1:016x}"]["stale"]
+    assert by_id[f"{2:016x}"]["stale"]
+    assert "ConnectionError" in by_id[f"{2:016x}"]["error"]
+    assert by_id[f"{3:016x}"]["stale"]
+    assert "timed out" in by_id[f"{3:016x}"]["error"]
+    assert by_id[f"{4:016x}"]["error"] == "no status_address advertised"
+    # routing-plane health rides along: the open circuit is visible
+    assert doc["models"]["m"]["open_circuits"] == 1
+
+
+async def test_merge_folds_worker_sections():
+    async def fetch(address):
+        return dict(WORKER_DOC)
+
+    pipe = _Pipeline({1: _Inst("a:1"), 2: _Inst("b:1")})
+    doc = await fleet_snapshot([pipe], fetch=fetch, timeout_s=1.0)
+    assert doc["kv"] == {
+        "active_blocks": 20, "free_blocks": 44, "total_blocks": 64,
+    }
+    assert doc["global_kv"] == {
+        "published": 8, "inflight_fetches": 2, "dedupe_skipped": 4,
+    }
+    assert doc["restore_modes"] == {"warm": 2}
+    # active health events are attributed to the reporting worker
+    assert len(doc["health_active"]) == 2
+    assert all("worker_id" in h for h in doc["health_active"])
+
+
+async def test_draining_state_counted():
+    async def fetch(address):
+        return {}
+
+    pipe = _Pipeline({1: _Inst("a:1", state="draining"), 2: _Inst("b:1")})
+    doc = await fleet_snapshot([pipe], fetch=fetch, timeout_s=1.0)
+    assert doc["fleet"]["draining"] == 1
+
+
+async def test_frontend_section_passthrough():
+    pipe = _Pipeline({})
+    doc = await fleet_snapshot(
+        [pipe], fetch=None, timeout_s=0.01,
+        frontend={"slo": {"models": {}}, "attribution": {"models": {}}},
+        clock=lambda: 123.0,
+    )
+    assert doc["generated_at"] == 123.0
+    assert set(doc["frontend"]) == {"slo", "attribution"}
+    assert doc["fleet"]["workers_total"] == 0
+
+
+async def test_all_workers_dead_still_answers():
+    async def fetch(address):
+        raise OSError("network down")
+
+    pipe = _Pipeline({i: _Inst(f"w{i}:1") for i in range(5)})
+    doc = await fleet_snapshot([pipe], fetch=fetch, timeout_s=0.1)
+    assert doc["fleet"]["workers_live"] == 0
+    assert doc["fleet"]["workers_stale"] == 5
+    assert all(w["stale"] for w in doc["workers"])
+
+
+# -------------------------------------------------------- real-socket path
+async def test_fleet_snapshot_over_real_sockets():
+    """Default HTTP fetch against a REAL StatusServer (live worker) plus a
+    dead address: live entry carries the /debug/worker document, dead one
+    goes stale, nothing raises."""
+    status = StatusServer(
+        HealthState(), host="127.0.0.1", port=0,
+        worker_snapshot_fn=lambda: dict(WORKER_DOC),
+    )
+    addr = await status.start()
+    try:
+        pipe = _Pipeline({1: _Inst(addr), 2: _Inst("127.0.0.1:1")})
+        doc = await fleet_snapshot([pipe], timeout_s=5.0)
+    finally:
+        await status.stop()
+    assert doc["fleet"]["workers_live"] == 1
+    assert doc["fleet"]["workers_stale"] == 1
+    live = next(w for w in doc["workers"] if not w["stale"])
+    assert live["snapshot"]["kv"]["active_blocks"] == 10
+    assert live["snapshot"]["restore_mode"] == "warm"
+    assert "uptime_s" in live["snapshot"]
+    assert doc["restore_modes"] == {"warm": 1}
+
+
+# ----------------------------------------------------- /debug/worker route
+async def _get_json(addr, path):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://{addr}{path}") as r:
+            return r.status, await r.json()
+
+
+async def test_debug_worker_fallback_without_snapshot_fn():
+    state = HealthState()
+    state.set("engine", True, "ok")
+    status = StatusServer(state, host="127.0.0.1", port=0)
+    addr = await status.start()
+    try:
+        code, doc = await _get_json(addr, "/debug/worker")
+    finally:
+        await status.stop()
+    assert code == 200
+    assert doc["health"]["subsystems"]["engine"]["healthy"]
+    assert "uptime_s" in doc
+
+
+async def test_debug_worker_snapshot_fn_error_does_not_500():
+    def boom():
+        raise RuntimeError("section assembly exploded")
+
+    status = StatusServer(
+        HealthState(), host="127.0.0.1", port=0, worker_snapshot_fn=boom,
+    )
+    addr = await status.start()
+    try:
+        code, doc = await _get_json(addr, "/debug/worker")
+    finally:
+        await status.stop()
+    assert code == 200  # a broken section must not 500 the probe
+    assert "section assembly exploded" in doc["error"]

@@ -1,0 +1,251 @@
+"""Degradation detectors (runtime/health.py HealthMonitor) on a fake clock.
+
+ISSUE 19 acceptance: trip/clear hysteresis, per-(detector, subject) rate
+limiting, the no-flap band between the clear and trip thresholds, the
+wire/hit-rate reference EWMAs that freeze while tripped (a collapse must
+not drag its own baseline down), burn-rate acceleration, and subscription
+lifecycle — all deterministic, no sleeps.
+"""
+
+from dynamo_tpu.runtime.flight_recorder import FlightRecorder
+from dynamo_tpu.runtime.health import (
+    _CLEAR_N,
+    _MIN_REFERENCE_OBS,
+    _TRIP_N,
+    HealthMonitor,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def monitor(clock, **kw):
+    kw.setdefault("min_interval_s", 30.0)
+    kw.setdefault("flight_recorder", FlightRecorder())
+    return HealthMonitor(clock=clock, **kw)
+
+
+# -------------------------------------------------------- trip hysteresis
+def test_drift_trips_after_consecutive_bad():
+    clock = FakeClock()
+    mon = monitor(clock, drift_ratio=2.0)
+    events = []
+    sub = mon.subscribe(events.append)
+    try:
+        for i in range(_TRIP_N - 1):
+            assert mon.observe_step("worker/3", 1.0, 0.4) is None
+        ev = mon.observe_step("worker/3", 1.0, 0.4)
+        assert ev is not None and ev.kind == "degraded"
+        assert ev.detector == "cost_model_drift"
+        assert ev.subject == "worker/3"
+        assert ev.ratio == 2.5
+        assert [e.kind for e in events] == ["degraded"]
+        assert mon.active() == [
+            {"detector": "cost_model_drift", "subject": "worker/3"}
+        ]
+    finally:
+        sub.close()
+
+
+def test_single_spike_never_fires():
+    clock = FakeClock()
+    mon = monitor(clock)
+    # bad, then good: the consecutive counter resets every time
+    for _ in range(10):
+        assert mon.observe_step("worker/1", 1.0, 0.4) is None
+        assert mon.observe_step("worker/1", 0.4, 0.4) is None
+    assert mon.active() == []
+    assert not mon.recent
+
+
+# ------------------------------------------------------------ rate limit
+def test_rate_limited_reemission_while_tripped():
+    clock = FakeClock()
+    mon = monitor(clock, min_interval_s=30.0)
+    for _ in range(_TRIP_N):
+        mon.observe_step("worker/2", 1.0, 0.4)
+    assert len(mon.recent) == 1
+    # still degraded, but inside the emission interval: silent
+    for _ in range(20):
+        clock.t += 1.0
+        assert mon.observe_step("worker/2", 1.0, 0.4) is None
+    assert len(mon.recent) == 1
+    clock.t += 10.0  # past min_interval_s since the trip
+    ev = mon.observe_step("worker/2", 1.0, 0.4)
+    assert ev is not None and ev.kind == "degraded"
+    assert len(mon.recent) == 2
+    assert mon.counts["cost_model_drift"] == 2
+
+
+# ------------------------------------------------------- clear hysteresis
+def test_recovery_after_consecutive_good():
+    clock = FakeClock()
+    mon = monitor(clock)
+    for _ in range(_TRIP_N):
+        mon.observe_step("worker/5", 1.0, 0.4)
+    assert mon.active()
+    for i in range(_CLEAR_N - 1):
+        assert mon.observe_step("worker/5", 0.4, 0.4) is None
+    ev = mon.observe_step("worker/5", 0.4, 0.4)
+    assert ev is not None and ev.kind == "recovered"
+    assert mon.active() == []
+    # a fresh degradation must re-count from zero
+    assert mon.observe_step("worker/5", 1.0, 0.4) is None
+
+
+def test_no_flap_band_resets_both_counters():
+    """Values between the clear threshold (0.8 * trip) and the trip
+    threshold belong to neither side: they reset the consecutive counters,
+    so oscillating around the trip point can never fire OR clear."""
+    clock = FakeClock()
+    mon = monitor(clock, drift_ratio=2.0)
+    # ratio 1.9: above clear (1.6), below trip (2.0)
+    for _ in range(2):
+        mon.observe_step("worker/7", 0.8, 0.4)      # bad x2
+        mon.observe_step("worker/7", 0.76, 0.4)     # band: resets
+    assert mon.observe_step("worker/7", 0.8, 0.4) is None
+    assert mon.active() == []
+    # trip it, then oscillate in the band: no recovery either
+    for _ in range(_TRIP_N):
+        mon.observe_step("worker/7", 0.8, 0.4)
+    assert mon.active()
+    for _ in range(10):
+        assert mon.observe_step("worker/7", 0.76, 0.4) is None
+    assert mon.active()  # still tripped
+
+
+# ------------------------------------------------------------- wire EWMA
+def test_wire_collapse_reference_freezes_while_tripped():
+    clock = FakeClock()
+    mon = monitor(clock, min_interval_s=5.0)
+    healthy = 1e9
+    for _ in range(_MIN_REFERENCE_OBS + 2):
+        assert mon.observe_wire("ici", healthy) is None
+        clock.t += 1.0
+    events = []
+    for _ in range(_TRIP_N):
+        ev = mon.observe_wire("ici", 0.1 * healthy)
+        clock.t += 1.0
+        if ev:
+            events.append(ev)
+    assert [e.kind for e in events] == ["degraded"]
+    assert events[0].subject == "wire/ici"
+    # the reference must NOT have learned the collapsed bandwidth
+    st = mon._states[("wire_collapse", "wire/ici")]
+    assert st.reference > 0.9 * healthy
+    # sustained collapse for a long time: reference still frozen
+    for _ in range(50):
+        clock.t += 10.0
+        mon.observe_wire("ici", 0.1 * healthy)
+    assert st.reference > 0.9 * healthy
+    # bandwidth restored: clears after _CLEAR_N good observations
+    cleared = []
+    for _ in range(_CLEAR_N):
+        ev = mon.observe_wire("ici", healthy)
+        clock.t += 1.0
+        if ev:
+            cleared.append(ev)
+    assert [e.kind for e in cleared] == ["recovered"]
+
+
+def test_wire_unarmed_before_min_observations():
+    clock = FakeClock()
+    mon = monitor(clock)
+    # low-looking bandwidth from the start: the first sample IS the
+    # reference, and the detector must not fire before it has history
+    for _ in range(_MIN_REFERENCE_OBS):
+        assert mon.observe_wire("native", 1e6) is None
+    assert mon.active() == []
+
+
+# -------------------------------------------------------------- hit rate
+def test_hitrate_drop_fires_against_own_baseline():
+    clock = FakeClock()
+    mon = monitor(clock)
+    for _ in range(_MIN_REFERENCE_OBS + 2):
+        assert mon.observe_hit_rate("radix/worker0", 0.8) is None
+    events = []
+    for _ in range(_TRIP_N):
+        ev = mon.observe_hit_rate("radix/worker0", 0.1)
+        if ev:
+            events.append(ev)
+    assert [e.kind for e in events] == ["degraded"]
+    assert events[0].detector == "hitrate_drop"
+
+
+def test_always_cold_cache_never_arms():
+    clock = FakeClock()
+    mon = monitor(clock)
+    for _ in range(40):
+        assert mon.observe_hit_rate("global_kv", 0.01) is None
+    assert mon.active() == []
+
+
+# ------------------------------------------------------------- burn rate
+def test_burn_acceleration():
+    clock = FakeClock()
+    mon = monitor(clock, burn_accel=4.0)
+    events = []
+    for _ in range(_TRIP_N):
+        ev = mon.observe_burn("m", "interactive", short_burn=5.0, long_burn=1.0)
+        if ev:
+            events.append(ev)
+    assert [e.kind for e in events] == ["degraded"]
+    assert events[0].subject == "class/m/interactive"
+    assert events[0].detector == "burn_rate_accel"
+    # short burn high relative to long but under budget in absolute terms
+    # (short <= 1.0) must not fire
+    mon2 = monitor(clock, burn_accel=4.0)
+    for _ in range(10):
+        assert mon2.observe_burn("m", "batch", 0.9, 0.1) is None
+    assert mon2.observe_burn("m", "batch", None, 1.0) is None
+
+
+# ------------------------------------------------------------ plumbing
+def test_subscription_close_detaches():
+    clock = FakeClock()
+    mon = monitor(clock)
+    got = []
+    sub = mon.subscribe(got.append)
+    for _ in range(_TRIP_N):
+        mon.observe_step("worker/9", 1.0, 0.4)
+    assert len(got) == 1
+    sub.close()
+    clock.t += 100.0
+    mon.observe_step("worker/9", 1.0, 0.4)
+    assert len(got) == 1  # no delivery after close
+
+
+def test_broken_subscriber_does_not_break_detection():
+    clock = FakeClock()
+    mon = monitor(clock)
+
+    def boom(ev):
+        raise RuntimeError("subscriber died")
+
+    sub = mon.subscribe(boom)
+    try:
+        for _ in range(_TRIP_N):
+            mon.observe_step("worker/4", 1.0, 0.4)
+        assert len(mon.recent) == 1  # event still recorded
+    finally:
+        sub.close()
+
+
+def test_snapshot_shape():
+    clock = FakeClock()
+    mon = monitor(clock)
+    for _ in range(_TRIP_N):
+        mon.observe_step("worker/0", 1.0, 0.4)
+    snap = mon.snapshot()
+    assert snap["active"] == [
+        {"detector": "cost_model_drift", "subject": "worker/0"}
+    ]
+    assert snap["counts"] == {"cost_model_drift": 1}
+    assert snap["recent"][-1]["kind"] == "degraded"
+    assert snap["recent"][-1]["subject"] == "worker/0"

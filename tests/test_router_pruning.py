@@ -316,6 +316,32 @@ class TestPrunedSelection:
         assert d.worker not in router.scheduler.least_loaded(10)
         assert router.scheduler.decode_blocks(d.worker) == 0
 
+    def test_late_metrics_report_does_not_resurrect_removed_worker(self):
+        """A draining engine keeps publishing metrics after discovery
+        removed it; the report must not re-register the ghost — it would
+        win the least-loaded prune at near-zero load exactly while live
+        workers honestly report deep queues. An explicit re-register
+        (discovery says it's back) lifts the tombstone."""
+        from dynamo_tpu.kv_router import WorkerMetrics
+
+        router, workers = _make_router(4, 0, topk=0)
+        router.remove_worker_id(2)
+        router.scheduler.update_metrics(
+            WorkerMetrics(W(2), active_decode_blocks=0)
+        )
+        assert W(2) not in router.scheduler.known_workers()
+        assert W(2) not in router.scheduler.least_loaded(10)
+        # the charge path can race a removal too
+        router.scheduler.add_local_load(W(2), 8)
+        assert W(2) not in router.scheduler.known_workers()
+        # discovery re-admits the worker: candidate again, reports land
+        router.scheduler.register_worker(W(2))
+        router.scheduler.update_metrics(
+            WorkerMetrics(W(2), active_decode_blocks=3)
+        )
+        assert W(2) in router.scheduler.known_workers()
+        assert router.scheduler.decode_blocks(W(2)) == 3
+
     def test_approx_indexer_pruned_path(self):
         router, workers = _make_router(80, 1, topk=8, use_kv_events=False)
         toks = list(range(8 * BS))

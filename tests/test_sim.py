@@ -263,13 +263,20 @@ def test_http_frontend_smoke():
     """The REAL aiohttp frontend inside the virtual-clock loop: admission
     sheds with busy-503s, the flapping worker's breaker trips and routing
     steers around it, migration absorbs the injected losses, and
-    /metrics + /debug/slo answer over the live socket."""
+    /metrics + /debug/slo + /debug/fleet answer over the live socket —
+    the fleet fan-out returning partial results (one live worker, the
+    rest stale) instead of a 500."""
     rep = run_scenario("http-frontend", seed=0, **SMOKE)
     assert rep["sim"]["passed"], rep["sim"]["invariants"]
     http = rep["sim"]["http"]
     assert http["statuses"].get("503_busy", 0) > 0
     assert http["generate_calls"] > 0
     assert any(st == "open" for _, st in http["breaker_transitions"])
+    snap = http["fleet_snapshot"]
+    assert snap["status"] == 200
+    assert snap["rollup"]["workers_live"] == 1
+    assert snap["rollup"]["workers_stale"] == snap["rollup"]["workers_total"] - 1
+    assert snap["restore_modes"] == {"warm": 1}
 
 
 def test_elastic_reclaim_smoke():
@@ -415,3 +422,29 @@ def test_cli_runs_and_gates(tmp_path, capsys):
     assert rep["sim"]["sim_advanced_s"] >= rep["sim"]["sim_duration_s"]
     assert main(["list"]) == 0
     capsys.readouterr()
+
+
+def test_degradation_localization_smoke():
+    """ISSUE 19 acceptance: a seeded 30x slowdown of one worker's step
+    pacing plus a 20x collapse of one wire, injected mid-run — the health
+    detectors fire, name the right worker and the right wire, never fire
+    before injection or flap a recovery, and the fleet p99 dominant phase
+    flips to decode (where the slowdown was injected)."""
+    rep = run_scenario("degradation-localization", seed=0, **SMOKE)
+    assert rep["sim"]["passed"], rep["sim"]["invariants"]
+    by_name = {iv["name"]: iv for iv in rep["sim"]["invariants"]}
+    for name in (
+        "drift_localized", "wire_localized", "p99_dominant_flip",
+        "rate_limited_no_flap", "zero_failed_requests",
+    ):
+        assert by_name[name]["ok"], by_name[name]["detail"]
+    deg = rep["sim"]["degradation"]
+    assert deg["dominant_after"] == "decode"
+    assert deg["first_drift_t"] > deg["injected_at_s"]
+    assert deg["drift_events"] > 0 and deg["wire_events"] > 0
+
+
+def test_degradation_localization_same_seed_identical():
+    a = run_scenario("degradation-localization", seed=0, **SMOKE)
+    b = run_scenario("degradation-localization", seed=0, **SMOKE)
+    assert canonical_json(a["sim"]) == canonical_json(b["sim"])

@@ -1,5 +1,5 @@
-"""Catalog-drift passes: the env-var and fault-point catalogs must match
-the code that reads/arms them, in both directions.
+"""Catalog-drift passes: the env-var, fault-point and trace-span catalogs
+must match the code that reads/arms/emits them, in both directions.
 
 - ENV-DRIFT: every ``DTPU_*`` name read in dynamo_tpu/ must be registered
   as an ``ENV_*`` constant in the runtime/config.py catalog (the single
@@ -14,8 +14,14 @@ the code that reads/arms them, in both directions.
   catalog paragraph, and vice versa. Dynamically-named points (the sim's
   per-worker ``sim.worker.<id>`` family) are skipped — only literals are
   checkable.
+- SPAN-DRIFT: every span name a ``tracer.span(...)`` / ``tracer.emit(...)``
+  site emits (literal first argument, receiver's trailing name ``tracer``)
+  must appear in the docs/operations.md span table (§8's "span | emitted
+  by | attributes" table), and every table row must have an emit site — a
+  documented span nobody emits sends an operator filtering traces for a
+  name that never appears.
 
-Both zero-site directions are skipped on partial (--changed-only) runs:
+All zero-site directions are skipped on partial (--changed-only) runs:
 absence can only be proven against the whole tree.
 """
 
@@ -278,3 +284,111 @@ def _faults_drift_pass(ctx: Context) -> Iterator[Finding]:
 
 
 _faults_drift_pass.RULES = ("FAULTS-DRIFT",)
+
+
+# ---------------------------------------------------------------------------
+# SPAN-DRIFT
+# ---------------------------------------------------------------------------
+
+_TRACING_SUFFIX = "runtime/tracing.py"
+_SPAN_METHODS = ("span", "emit")
+_SPAN_TABLE_HEADER_RE = re.compile(
+    r"^\|\s*span\s*\|\s*emitted by\s*\|", re.I
+)
+_SPAN_NAME_RE = re.compile(r"`([a-z_]+(?:\.[a-z_]+)+)`")
+
+
+def _emitted_spans(tree: ast.AST) -> List[Tuple[str, int]]:
+    """Literal span names passed to ``<...>.tracer.span(...)`` /
+    ``tracer.emit(...)`` — any receiver whose trailing name is ``tracer``,
+    which covers ``tracer.span``, ``self.tracer.span`` and module-level
+    ``tracer.emit`` while excluding unrelated ``.emit`` receivers (audit
+    sinks, log handlers). Non-literal names are dynamic and skipped."""
+    out = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SPAN_METHODS
+        ):
+            continue
+        recv = node.func.value
+        recv_name = (
+            recv.id if isinstance(recv, ast.Name)
+            else recv.attr if isinstance(recv, ast.Attribute) else None
+        )
+        if recv_name != "tracer":
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str
+        ):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+def _docs_span_table(docs_path: str) -> Optional[Set[str]]:
+    """Backticked span names from the FIRST column of the operations.md
+    span table (the row right of the ``| span | emitted by | ...`` header);
+    None when the docs file or the table is missing. Rows may carry
+    several names (``http.generate`` / ``http.responses`` share a row)."""
+    if not os.path.isfile(docs_path):
+        return None
+    names: Set[str] = set()
+    in_table = False
+    with open(docs_path, encoding="utf-8") as f:
+        for line in f:
+            if _SPAN_TABLE_HEADER_RE.match(line.strip()):
+                in_table = True
+                continue
+            if not in_table:
+                continue
+            stripped = line.strip()
+            if not stripped.startswith("|"):
+                break  # table ended
+            cells = stripped.split("|")
+            if len(cells) < 2:
+                continue
+            first_col = cells[1]
+            if set(first_col.strip()) <= {"-", ":", " "}:
+                continue  # the |---|---| separator row
+            names.update(_SPAN_NAME_RE.findall(first_col))
+    return names if in_table else None
+
+
+@register("span-drift", "emitted tracer span names vs the docs span table, both ways")
+def _span_drift_pass(ctx: Context) -> Iterator[Finding]:
+    tracing = next(
+        (m for m in ctx.modules if m.path.endswith(_TRACING_SUFFIX)), None
+    )
+    if tracing is None:
+        return
+    docs = _docs_span_table(_docs_path_for(tracing.path))
+    if docs is None:
+        return  # no span table to drift against (fixture trees without docs)
+    emitted: Dict[str, Tuple[str, int]] = {}  # name -> (path, line)
+    for m in ctx.modules:
+        if "dynamo_tpu/" not in m.path or m.path == tracing.path:
+            continue
+        for name, line in _emitted_spans(m.tree):
+            emitted.setdefault(name, (m.path, line))
+    for name, (path, line) in sorted(emitted.items()):
+        if name.startswith(("sim.", "test.")):
+            continue  # sim/test-local spans are deliberately undocumented
+        if name not in docs:
+            yield Finding(
+                "SPAN-DRIFT", path, line,
+                f"span '{name}' is emitted in code but missing from the "
+                f"docs/operations.md span table — add the row so operators "
+                f"can find it when reading a trace",
+            )
+    if getattr(ctx, "partial", False):
+        return
+    for name in sorted(docs - set(emitted)):
+        yield Finding(
+            "SPAN-DRIFT", tracing.path, 1,
+            f"docs/operations.md span table documents '{name}' which no "
+            f"tracer.span/emit site emits — prune the row or wire the span",
+        )
+
+
+_span_drift_pass.RULES = ("SPAN-DRIFT",)

@@ -178,6 +178,19 @@ RESOURCES: Tuple[ResourceSpec, ...] = (
         exempt_functions=("begin_fetch", "commit_fetch", "abort_fetch"),
     ),
     ResourceSpec(
+        name="health-subscription",
+        doc="Degradation-event subscriptions (runtime/health.py "
+            "HealthMonitor.subscribe): each subscription handle keeps its "
+            "callback on every future health event until close() — a "
+            "dangling handle keeps publishing to a torn-down consumer "
+            "(the worker __main__ closes its event-plane publisher's "
+            "subscription on shutdown).",
+        paths=("runtime/health.py", "engine/__main__.py", "sim/"),
+        acquire=(("subscribe", ("monitor", "health")),),
+        release=(("close", ("sub",)),),
+        exempt_functions=("subscribe", "close"),
+    ),
+    ResourceSpec(
         name="kv-commit-signal",
         doc="KvCommitSignal waits are self-cleaning by construction: one "
             "shared shielded future serves every waiter and wait() never "
