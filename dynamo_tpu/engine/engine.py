@@ -814,6 +814,36 @@ class TpuEngine:
             * self.cfg.block_size
         )
 
+    def _evacuation_plan(self, st) -> Optional[Dict[str, Any]]:
+        """The evacuation reference an error-finish frame carries
+        (docs/operations.md §13): the retry's router prices destinations by
+        the cost of pulling this worker's sealed KV, and the replacement
+        worker replays the plan as its ``kv_transfer`` fetch instead of
+        recomputing the prefix. Tier streaming (``tier: True``) serves from
+        the host tier, which survives engine-loop death and drain. None
+        when the request has nothing fetchable (no transfer server, opted
+        out of caching, or no full block computed yet)."""
+        if self.transfer_address is None or getattr(st, "no_cache", False):
+            return None
+        seq = getattr(st, "seq", None)
+        if seq is None:
+            return None
+        try:
+            hashes = [int(h) for h in seq.sequence_hashes()]
+        except Exception:
+            return None
+        n_tokens = len(st.req.token_ids) + int(st.produced)
+        blocks = min(len(hashes), n_tokens // self.cfg.block_size)
+        if blocks <= 0:
+            return None
+        return {
+            "address": self.transfer_address,
+            "hashes": hashes[:blocks],
+            "num_tokens": blocks * self.cfg.block_size,
+            "tier": True,
+            "bytes_per_block": int(self.kv_bytes_per_block),
+        }
+
     # ------------------------------------------------------------------ setup
     def _shard_params(self, params: llama.Params, mcfg=None) -> llama.Params:
         specs = registry.param_specs(mcfg if mcfg is not None else self.mcfg)
@@ -3123,9 +3153,11 @@ class TpuEngine:
                 spawn_bg(self.on_crash(crash))
             for st in list(self._waiting) + [s for s in self._slots if s]:
                 st.done = True
-                st.out_queue.put_nowait(
-                    BackendOutput(finish_reason="error", cumulative_tokens=st.produced)
-                )
+                evac = self._evacuation_plan(st)
+                st.out_queue.put_nowait(BackendOutput(
+                    finish_reason="error", cumulative_tokens=st.produced,
+                    annotations={"evacuation": evac} if evac else {},
+                ))
                 if st.block_ids:
                     self.allocator.release(st.block_ids)
             self._waiting = []
@@ -3744,8 +3776,10 @@ class TpuEngine:
             log.exception("prefill readback failed")
             st.prefill_inflight = False
             st.done = True
+            evac = self._evacuation_plan(st)
             st.out_queue.put_nowait(BackendOutput(
-                finish_reason="error", cumulative_tokens=st.produced
+                finish_reason="error", cumulative_tokens=st.produced,
+                annotations={"evacuation": evac} if evac else {},
             ))
             self._wake.set()
             return
@@ -4267,6 +4301,13 @@ class TpuEngine:
                 "cached_tokens": st.cached_tokens,
                 "input_tokens": len(st.req.token_ids),
             }
+            # echo the router's routing decision back on the metrics frame
+            # (protocols/common.py documents worker_id as a first-chunk
+            # annotation) so the frontend's flight record can attribute the
+            # request to the worker that actually served it
+            wid = (st.req.annotations or {}).get("worker_id")
+            if wid is not None:
+                ann["worker_id"] = wid
         if first_ann and (emit_ids or finish is not None) and st.t_first_token == 0:
             st.t_first_token = time.time_ns()
             get_flight_recorder().record(

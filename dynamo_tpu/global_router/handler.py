@@ -7,8 +7,11 @@ forwards the request to that pool's own namespace — where a local KV router /
 worker set handles it. Two-level routing: global (SLA/pool) then local
 (KV-overlap/load).
 
-SLA targets ride request annotations ``ttft_target_ms`` / ``itl_target_ms``
-(the reference reads them from nvext)."""
+SLA targets ride the request-plane ``sla`` annotation the frontend stamps
+(runtime/slo.py ``SlaSpec.to_annotation``: ``ttft_target_s`` /
+``itl_target_s``, seconds) — the same contract the SLO ledger and the
+worker read, converted to the strategy grid's milliseconds here. The
+reference reads equivalent targets from nvext."""
 
 from __future__ import annotations
 
@@ -47,15 +50,24 @@ class GlobalRouterHandler:
     def _pick_pool(self, req: PreprocessedRequest) -> PoolSpec:
         isl = len(req.token_ids)
         ann = req.annotations or {}
+        # the frontend's sla annotation carries targets in SECONDS; the
+        # pool-selection grid is calibrated in milliseconds
+        sla = ann.get("sla") or {}
         if ann.get("disagg") == "prefill" and self.config.prefill_pools:
-            ttft = ann.get("ttft_target_ms", self.config.default_ttft_ms)
+            ttft = (
+                float(sla.get("ttft_target_s") or 0.0) * 1e3
+                or self.config.default_ttft_ms
+            )
             idx = (
                 self.config.prefill_strategy.select_pool(isl, ttft)
                 if self.config.prefill_strategy else 0
             )
             pools = self.config.prefill_pools
         else:
-            itl = ann.get("itl_target_ms", self.config.default_itl_ms)
+            itl = (
+                float(sla.get("itl_target_s") or 0.0) * 1e3
+                or self.config.default_itl_ms
+            )
             ctx = isl + (req.stop.max_tokens or 0)
             idx = (
                 self.config.decode_strategy.select_pool(ctx, itl)

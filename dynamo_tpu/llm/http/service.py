@@ -520,7 +520,17 @@ class HttpService:
         ok = True
         try:
             async for out in pipe.generate_tokens(preq, ctx):
-                for img in (out.annotations or {}).get("images", []):
+                ann = out.annotations or {}
+                if out.finish_reason == "error":
+                    # the engine's error frame carries the reason in the
+                    # "error" annotation — surface it instead of returning
+                    # 200 with an empty data list
+                    ok = False
+                    return await self._fail(
+                        None, 502, ann.get("error") or "image generation failed",
+                        "upstream_error",
+                    )
+                for img in ann.get("images", []):
                     data.append({"b64_json": img})
         except NoResponders:
             ok = False
@@ -551,6 +561,14 @@ class HttpService:
             try:
                 async for out in stream:
                     now = time.monotonic()
+                    ann = out.annotations or {}
+                    if "prefill_worker_id" in ann:
+                        # disagg attribution: the prefill router stamps the
+                        # remote prefill worker on its final frame
+                        get_flight_recorder().record(
+                            request_id, "prefill_done",
+                            prefill_worker_id=ann["prefill_worker_id"],
+                        )
                     if out.token_ids:
                         n_tokens += len(out.token_ids)
                         if first_at is None:
@@ -558,9 +576,15 @@ class HttpService:
                             self._ttft.observe(
                                 now - t_start, model=model, sla_class=cls
                             )
+                            # the engine echoes the serving worker on its
+                            # first-chunk metrics annotations
+                            wid = {"worker_id": ann["worker_id"]} if (
+                                "worker_id" in ann
+                            ) else {}
                             get_flight_recorder().record(
                                 request_id, "first_token",
                                 ttft_ms=round((now - t_start) * 1e3, 3),
+                                **wid,
                             )
                         elif last_at is not None:
                             self._itl.observe(

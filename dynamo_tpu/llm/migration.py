@@ -69,6 +69,9 @@ class Migration:
                         iid = getattr(stream, "instance_id", None)
                         if iid is not None:
                             err.instance_id = iid  # type: ignore[attr-defined]
+                        # the dying engine attaches its evacuation plan to
+                        # the error frame (TpuEngine._evacuation_plan); the
+                        # retry replays it as the kv_transfer fetch below
                         evac = out.kv_transfer or out.annotations.get("evacuation")
                         if evac:
                             err.evacuation = evac  # type: ignore[attr-defined]

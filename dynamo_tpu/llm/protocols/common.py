@@ -119,7 +119,11 @@ class BackendOutput:
     # by the worker-side Backend (it owns the tokenizer):
     # {token, logprob, bytes, top_logprobs: [{token, logprob, bytes}, ...]}
     logprob_entries: Optional[List[Dict[str, Any]]] = None
-    # metrics annotations (first chunk): cached_tokens, input_tokens, worker_id
+    # metrics annotations (first chunk): cached_tokens, input_tokens, and the
+    # router-stamped worker_id echoed back for flight-recorder attribution;
+    # error-finish frames carry "error" (the reason) and optionally
+    # "evacuation" (a kv_transfer plan for the retry). The key namespace is
+    # a declared contract (tools/analysis/contracts.py request-annotations).
     annotations: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # disaggregation: prefill worker returns kv transfer params here
     kv_transfer: Optional[Dict[str, Any]] = None

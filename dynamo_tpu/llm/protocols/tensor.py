@@ -104,6 +104,12 @@ class TensorRequest:
 
     @classmethod
     def from_obj(cls, obj: Dict[str, Any]) -> "TensorRequest":
+        op = obj.get("op", "tensor")
+        if op != "tensor":
+            # the discriminator to_obj writes: a mis-routed request-plane
+            # payload (chat/embed/image) must fail loudly here, not decode
+            # into an empty tensor list
+            raise ValueError(f"not a tensor request: op={op!r}")
         return cls(
             request_id=obj.get("id", ""), model=obj.get("model", ""),
             tensors=[Tensor.from_obj(t) for t in obj.get("tensors", [])],

@@ -100,7 +100,11 @@ class ZmqEventPlane(EventPlane):
         between check and act), so exactly one caller sleeps and the rest
         ride the same beat. If the elected sleeper is cancelled mid-beat it
         wakes the waiters and clears the slot so the next caller re-elects —
-        otherwise one cancelled wait_for would deadlock every later publish."""
+        otherwise one cancelled wait_for would deadlock every later publish.
+        The EVENT-LIVENESS rule codifies this shape: a rollback that wakes
+        then clears is only safe because every wait site here re-elects in
+        the loop, and tests/test_analysis_contracts.py pins that the
+        straight-line-waiter variant of this function fires the rule."""
         while True:
             if self._warm_evt is None:
                 self._warm_evt = evt = asyncio.Event()

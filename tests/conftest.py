@@ -85,3 +85,21 @@ def repo_analysis():
     modules, parse = core.load_modules([os.path.join(repo, "dynamo_tpu")])
     findings = core.collect_findings(modules, parse)
     return modules, parse, findings
+
+
+@pytest.fixture(scope="session")
+def repo_analysis_full():
+    """ONE run over the FULL gated tree (dynamo_tpu/ + tools/ + tests/) for
+    the cross-plane contract pins: the contract spec table registers
+    consumer sites that live under tests/ (the /debug/requests schema
+    pins), so the dynamo_tpu-only ``repo_analysis`` view would report
+    direction drift a full run doesn't. Returns (modules, parse,
+    findings)."""
+    from tools.analysis import core
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    modules, parse = core.load_modules(
+        [os.path.join(repo, p) for p in ("dynamo_tpu", "tools", "tests")]
+    )
+    findings = core.collect_findings(modules, parse)
+    return modules, parse, findings

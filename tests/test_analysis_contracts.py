@@ -1,0 +1,641 @@
+"""tools/analysis cross-plane contract + async-liveness rules.
+
+CONTRACT-DRIFT (declared producer/consumer dict contracts: drift in both
+directions, constant-key resolution, required-key presence via the CFG),
+LOCK-ORDER (call-graph-transitive asyncio lock-acquisition inversions) and
+EVENT-LIVENESS (zero-setter events, rollback set-then-clear, must-set
+paths). Fixture positives/negatives per rule, partial-view gating,
+current-tree pins against the baseline, no-vacuous-spec pins over the
+registered contract table, and the two revert pins: reintroducing the
+PR 7 zmq ``_warm`` set-then-clear bug or a consumed-but-never-produced
+annotation key must fire NON-baselined.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from tools.analysis import contracts, core
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "analysis", "baseline.txt")
+
+
+def analyze(tmp_path, rel, src, rule=None, partial=False):
+    """Write ``src`` at tmp_path/rel, analyze the tmp tree, return findings
+    (for one rule if given). No baseline — raw findings. An empty stub
+    under tests/ makes the tree cover the contract specs' consumer scope,
+    so the whole-tree drift directions run (they skip on views that never
+    saw the declared consumer paths — see _scope_covered)."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(src)
+    stub = tmp_path / "tests" / "_scope_stub.py"
+    stub.parent.mkdir(exist_ok=True)
+    stub.write_text("")
+    modules, parse = core.load_modules([str(tmp_path)])
+    found = core.collect_findings(modules, parse, partial=partial)
+    if rule is not None:
+        found = [f for f in found if f.rule == rule]
+    return found
+
+
+def run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analysis", *args],
+        capture_output=True, text=True, timeout=120, cwd=cwd,
+    )
+
+
+# -- CONTRACT-DRIFT: direction 1 (produced, never consumed) ------------------
+
+def test_drift_produced_never_consumed_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/llm/stamper.py",
+        "def stamp(req):\n"
+        "    req.annotations['zombie_field'] = 1\n",
+        rule="CONTRACT-DRIFT",
+    )
+    assert len(found) == 1
+    assert "zombie_field" in found[0].message
+    assert "produced but no" in found[0].message
+
+
+# -- CONTRACT-DRIFT: direction 2 (consumed, never produced) ------------------
+
+def test_drift_consumed_never_produced_flagged(tmp_path):
+    # the kv_directory-class wiring bug: a read that silently sees nothing
+    found = analyze(
+        tmp_path, "dynamo_tpu/llm/reader.py",
+        "def route(req):\n"
+        "    return req.annotations.get('kv_directory')\n",
+        rule="CONTRACT-DRIFT",
+    )
+    assert len(found) == 1
+    assert "kv_directory" in found[0].message
+    assert "no registered producer" in found[0].message
+
+
+def test_drift_matched_round_trip_not_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/llm/pair.py",
+        "def stamp(req):\n"
+        "    req.annotations['hops'] = 1\n"
+        "def route(out):\n"
+        "    return out.annotations.get('hops')\n",
+        rule="CONTRACT-DRIFT",
+    )
+    assert found == []
+
+
+def test_drift_constant_keys_resolved(tmp_path):
+    # producer writes through a module-level NAME constant; the literal
+    # consumer in another module must still pair up with it
+    (tmp_path / "dynamo_tpu" / "llm").mkdir(parents=True)
+    (tmp_path / "dynamo_tpu" / "llm" / "w.py").write_text(
+        "TRACE_KEY = 'traceparent_v2'\n"
+        "def stamp(req):\n"
+        "    req.annotations[TRACE_KEY] = 'x'\n"
+    )
+    (tmp_path / "dynamo_tpu" / "llm" / "r.py").write_text(
+        "def read(out):\n"
+        "    return out.annotations.get('traceparent_v2')\n"
+    )
+    modules, parse = core.load_modules([str(tmp_path)])
+    found = [f for f in core.collect_findings(modules, parse)
+             if f.rule == "CONTRACT-DRIFT"]
+    assert found == []
+
+
+# -- CONTRACT-DRIFT: direction 3 (required-key presence on the CFG) ----------
+
+_STREAM_HANDLER = (
+    "class KvTransferServer:\n"
+    "    async def _handle_stream(self, sock, request):\n"
+    "        n = request['blocks']\n"
+    "        for i in range(n):\n"
+    "            await sock.send({'window': i})\n"
+    "        if n == 0:\n"
+    "            return\n"
+    "        await sock.send({'eof': True})\n"
+)
+
+
+def test_required_key_missing_on_branch_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/transfer.py", _STREAM_HANDLER,
+        rule="CONTRACT-DRIFT",
+    )
+    req = [f for f in found if "required key 'eof'" in f.message]
+    assert len(req) == 1
+    assert "_handle_stream" in req[0].message
+
+
+def test_required_key_on_every_path_not_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/transfer.py",
+        "class KvTransferServer:\n"
+        "    async def _handle_stream(self, sock, request):\n"
+        "        n = request['blocks']\n"
+        "        for i in range(n):\n"
+        "            await sock.send({'window': i})\n"
+        "        await sock.send({'eof': True})\n",
+        rule="CONTRACT-DRIFT",
+    )
+    assert not [f for f in found if "required key" in f.message]
+
+
+def test_required_key_still_checked_on_partial_view(tmp_path):
+    # --changed-only runs skip the whole-tree drift directions but the
+    # required-key check is function-local: it must still fire
+    found = analyze(
+        tmp_path, "dynamo_tpu/engine/transfer.py", _STREAM_HANDLER,
+        rule="CONTRACT-DRIFT", partial=True,
+    )
+    assert len(found) == 1
+    assert "required key 'eof'" in found[0].message
+
+
+def test_partial_view_skips_drift_directions(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/llm/reader.py",
+        "def stamp(req):\n"
+        "    req.annotations['zombie_field'] = 1\n"
+        "def route(req):\n"
+        "    return req.annotations.get('kv_directory')\n",
+        rule="CONTRACT-DRIFT", partial=True,
+    )
+    assert found == []
+
+
+def test_drift_direction_skipped_when_consumer_scope_unscanned(tmp_path):
+    """A view that never saw the contract's declared consumer paths (no
+    tests/ here — the shape of ``python tools/lint.py dynamo_tpu``) cannot
+    prove a produced key dead: direction 1 must not fire. Direction 2
+    still runs (the producer scope IS covered)."""
+    mod = tmp_path / "dynamo_tpu" / "llm" / "narrow.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "def stamp(req):\n"
+        "    req.annotations['zombie_field'] = 1\n"
+        "def route(req):\n"
+        "    return req.annotations.get('kv_directory')\n"
+    )
+    modules, parse = core.load_modules([str(tmp_path)])
+    found = [f for f in core.collect_findings(modules, parse)
+             if f.rule == "CONTRACT-DRIFT"]
+    assert ["kv_directory" in f.message for f in found] == [True]
+
+
+def test_stale_provable_scoped_to_view():
+    """Baseline entries for whole-tree directions are only provably stale
+    on runs whose view covered the contract's declared scope; entries for
+    a deleted contract are always stale (nothing can fire them again).
+    The end-to-end narrow run rides test_lint.py::test_package_lints_clean
+    — no extra full-package subprocess here (tier-1 budget)."""
+    narrow = {"dynamo_tpu/llm/fleet.py", "dynamo_tpu/engine/__main__.py"}
+    full = narrow | {"tests/test_fleet_debug.py"}
+    d1 = ("CONTRACT-DRIFT", "dynamo_tpu/engine/__main__.py",
+          "contract 'debug-worker': key 'tp' is produced but no registered "
+          "consumer site reads it — dead field")
+    assert not contracts._stale_provable(narrow, d1)
+    assert contracts._stale_provable(full, d1)
+    gone = ("CONTRACT-DRIFT", "dynamo_tpu/x.py",
+            "contract 'no-such-contract': key 'k' is produced but no "
+            "registered consumer site reads it")
+    assert contracts._stale_provable(narrow, gone)
+    other = ("CONTRACT-DRIFT", "dynamo_tpu/engine/transfer.py",
+             "contract 'transfer-frame': producer X has a non-exceptional "
+             "path out that never writes required key 'eof'")
+    assert contracts._stale_provable(narrow, other)
+
+
+# -- LOCK-ORDER ---------------------------------------------------------------
+
+def test_lock_order_inversion_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/router/locks.py",
+        "import asyncio\n"
+        "class S:\n"
+        "    async def a(self):\n"
+        "        async with self._alpha_lock:\n"
+        "            async with self._beta_lock:\n"
+        "                pass\n"
+        "    async def b(self):\n"
+        "        async with self._beta_lock:\n"
+        "            async with self._alpha_lock:\n"
+        "                pass\n",
+        rule="LOCK-ORDER",
+    )
+    assert len(found) == 1
+    assert "lock-order inversion" in found[0].message
+    assert "_alpha_lock" in found[0].message
+    assert "_beta_lock" in found[0].message
+
+
+def test_lock_order_transitive_through_callee_flagged(tmp_path):
+    # a() never names _beta_lock: it reaches it through _helper(); the
+    # closure over the call graph must still see both orders
+    found = analyze(
+        tmp_path, "dynamo_tpu/router/locks2.py",
+        "import asyncio\n"
+        "class S:\n"
+        "    async def a(self):\n"
+        "        async with self._alpha_lock:\n"
+        "            await self._helper()\n"
+        "    async def _helper(self):\n"
+        "        async with self._beta_lock:\n"
+        "            pass\n"
+        "    async def b(self):\n"
+        "        async with self._beta_lock:\n"
+        "            async with self._alpha_lock:\n"
+        "                pass\n",
+        rule="LOCK-ORDER",
+    )
+    assert len(found) == 1
+    assert "via" in found[0].message
+
+
+def test_lock_order_consistent_not_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/router/locks3.py",
+        "import asyncio\n"
+        "class S:\n"
+        "    async def a(self):\n"
+        "        async with self._alpha_lock:\n"
+        "            async with self._beta_lock:\n"
+        "                pass\n"
+        "    async def b(self):\n"
+        "        async with self._alpha_lock:\n"
+        "            async with self._beta_lock:\n"
+        "                pass\n",
+        rule="LOCK-ORDER",
+    )
+    assert found == []
+
+
+# -- EVENT-LIVENESS: (1) zero-setter ------------------------------------------
+
+def test_event_zero_setter_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/ready.py",
+        "import asyncio\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._ready_evt = asyncio.Event()\n"
+        "    async def wait_ready(self):\n"
+        "        await self._ready_evt.wait()\n",
+        rule="EVENT-LIVENESS",
+    )
+    assert len(found) == 1
+    assert "nothing in the scanned tree ever calls set()" in found[0].message
+
+
+def test_event_callback_set_reference_counts_as_setter(tmp_path):
+    # loop.add_signal_handler(SIGTERM, stop.set): a bare bound-method
+    # reference handed to a registrar IS a set site
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/ready2.py",
+        "import asyncio\n"
+        "class W:\n"
+        "    def __init__(self, loop):\n"
+        "        self._stop_evt = asyncio.Event()\n"
+        "        loop.add_signal_handler(15, self._stop_evt.set)\n"
+        "    async def wait_stop(self):\n"
+        "        await self._stop_evt.wait()\n",
+        rule="EVENT-LIVENESS",
+    )
+    assert found == []
+
+
+def test_event_timed_wait_not_liveness_critical(tmp_path):
+    # asyncio.wait_for-bounded waits time out instead of hanging: a
+    # zero-setter event with only timed waits is not flagged
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/ready3.py",
+        "import asyncio\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._poke_evt = asyncio.Event()\n"
+        "    async def tick(self):\n"
+        "        await asyncio.wait_for(self._poke_evt.wait(), timeout=1.0)\n",
+        rule="EVENT-LIVENESS",
+    )
+    assert found == []
+
+
+def test_event_zero_setter_skipped_on_partial_view(tmp_path):
+    # the setter may simply live outside the changed-files slice
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/ready4.py",
+        "import asyncio\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._ready_evt = asyncio.Event()\n"
+        "    async def wait_ready(self):\n"
+        "        await self._ready_evt.wait()\n",
+        rule="EVENT-LIVENESS", partial=True,
+    )
+    assert found == []
+
+
+# -- EVENT-LIVENESS: (2) rollback set-then-clear ------------------------------
+
+def test_event_set_then_clear_in_rollback_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/plane.py",
+        "import asyncio\n"
+        "class Plane:\n"
+        "    def __init__(self):\n"
+        "        self._warm_evt = asyncio.Event()\n"
+        "    async def warm(self):\n"
+        "        try:\n"
+        "            await asyncio.sleep(0.1)\n"
+        "        except BaseException:\n"
+        "            self._warm_evt.set()\n"
+        "            self._warm_evt.clear()\n"
+        "            raise\n"
+        "        self._warm_evt.set()\n"
+        "    async def send(self):\n"
+        "        await self._warm_evt.wait()\n",
+        rule="EVENT-LIVENESS",
+    )
+    assert len(found) == 1
+    assert "set()-then-clear()" in found[0].message
+    assert found[0].line == 10  # the clear() line
+
+
+def test_event_set_then_clear_with_reelecting_waiters_not_flagged(tmp_path):
+    # every wait site re-elects in a loop (the FIXED zmq _warm shape):
+    # a woken waiter re-checks, so the transient clear is benign
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/plane2.py",
+        "import asyncio\n"
+        "class Plane:\n"
+        "    def __init__(self):\n"
+        "        self._warm_evt = asyncio.Event()\n"
+        "    async def warm(self):\n"
+        "        try:\n"
+        "            await asyncio.sleep(0.1)\n"
+        "        except BaseException:\n"
+        "            self._warm_evt.set()\n"
+        "            self._warm_evt.clear()\n"
+        "            raise\n"
+        "        self._warm_evt.set()\n"
+        "    async def send(self):\n"
+        "        while True:\n"
+        "            evt = self._warm_evt\n"
+        "            await evt.wait()\n"
+        "            if evt.is_set():\n"
+        "                return\n",
+        rule="EVENT-LIVENESS",
+    )
+    assert not [f for f in found if "set()-then-clear()" in f.message]
+
+
+# -- EVENT-LIVENESS: (3) must-set on every non-exceptional path ---------------
+
+def test_event_unset_path_out_flagged(tmp_path):
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/arm.py",
+        "import asyncio\n"
+        "class Warm:\n"
+        "    def __init__(self):\n"
+        "        self._go_evt = asyncio.Event()\n"
+        "    async def waiter(self):\n"
+        "        await self._go_evt.wait()\n"
+        "    async def arm(self, fast):\n"
+        "        if fast:\n"
+        "            return\n"
+        "        try:\n"
+        "            await asyncio.sleep(0.1)\n"
+        "            self._go_evt.set()\n"
+        "        except Exception:\n"
+        "            raise\n",
+        rule="EVENT-LIVENESS",
+    )
+    assert len(found) == 1
+    assert "non-exceptional path out never set()s it" in found[0].message
+
+
+def test_event_is_set_guarded_early_return_not_flagged(tmp_path):
+    # the early return is guarded by is_set(): on that path the event is
+    # already set, so no waiter can be stranded
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/arm2.py",
+        "import asyncio\n"
+        "class Warm:\n"
+        "    def __init__(self):\n"
+        "        self._go_evt = asyncio.Event()\n"
+        "    async def waiter(self):\n"
+        "        await self._go_evt.wait()\n"
+        "    async def arm(self):\n"
+        "        if self._go_evt.is_set():\n"
+        "            return\n"
+        "        try:\n"
+        "            await asyncio.sleep(0.1)\n"
+        "            self._go_evt.set()\n"
+        "        except Exception:\n"
+        "            raise\n",
+        rule="EVENT-LIVENESS",
+    )
+    assert found == []
+
+
+# -- revert pins --------------------------------------------------------------
+
+def test_revert_pin_zmq_warm_set_then_clear_fires_nonbaselined(tmp_path):
+    """Reintroduce the PR 7 zmq ``_warm`` bug (rollback set-then-clear with
+    straight-line waiters) at the real repo path: EVENT-LIVENESS must fire
+    and the finding must NOT be suppressible by the committed baseline."""
+    found = analyze(
+        tmp_path, "dynamo_tpu/runtime/event_plane/zmq_plane.py",
+        "import asyncio\n"
+        "class ZmqEventPlane:\n"
+        "    def __init__(self):\n"
+        "        self._warm_evt = None\n"
+        "    async def _warm(self):\n"
+        "        if self._warm_evt is None:\n"
+        "            self._warm_evt = evt = asyncio.Event()\n"
+        "            try:\n"
+        "                await asyncio.sleep(0.15)\n"
+        "            except BaseException:\n"
+        "                evt.set()\n"
+        "                evt.clear()\n"
+        "                self._warm_evt = None\n"
+        "                raise\n"
+        "            evt.set()\n"
+        "            return\n"
+        "        evt = self._warm_evt\n"
+        "        if evt.is_set():\n"
+        "            return\n"
+        "        await evt.wait()\n",
+        rule="EVENT-LIVENESS",
+    )
+    pins = [f for f in found if "set()-then-clear()" in f.message]
+    assert len(pins) == 1
+    baseline = core.load_baseline(BASELINE)
+    assert not any(
+        rule == "EVENT-LIVENESS" and msg == pins[0].message
+        for (rule, _path, msg) in baseline
+    )
+
+
+def test_revert_pin_consumed_never_produced_fires_nonbaselined(tmp_path):
+    """A consumer of an annotation key nothing produces (the shape of the
+    kv_directory wiring bug) must fire CONTRACT-DRIFT and must not match
+    any committed baseline entry."""
+    found = analyze(
+        tmp_path, "dynamo_tpu/llm/revert_pin.py",
+        "def route(req):\n"
+        "    return req.annotations.get('kv_directory')\n",
+        rule="CONTRACT-DRIFT",
+    )
+    assert len(found) == 1
+    baseline = core.load_baseline(BASELINE)
+    assert not any(
+        rule == "CONTRACT-DRIFT" and msg == found[0].message
+        for (rule, _path, msg) in baseline
+    )
+
+
+# -- current-tree pins --------------------------------------------------------
+
+_NEW_RULES = ("CONTRACT-DRIFT", "LOCK-ORDER", "EVENT-LIVENESS")
+
+
+def test_current_tree_contract_rules_exactly_baselined(repo_analysis_full):
+    """On the full gated tree (dynamo_tpu + tools + tests) the three rules
+    report EXACTLY the committed baseline's entries for them: zero new
+    findings (the gate holds) and zero stale entries (nothing baselined
+    that the tree no longer produces)."""
+    _modules, _parse, findings = repo_analysis_full
+    got = sorted(
+        f.baseline_key() for f in findings if f.rule in _NEW_RULES
+    )
+    baseline = core.load_baseline(BASELINE)
+    want = sorted(
+        k for k, n in baseline.items() for _ in range(n)
+        if k[0] in _NEW_RULES
+    )
+    assert got == want
+
+
+def test_no_vacuous_contract_specs(repo_analysis_full):
+    """Every registered contract names at least one real producer and one
+    real consumer key on the live tree — a spec whose site patterns match
+    nothing would silently verify nothing."""
+    modules, _parse, _findings = repo_analysis_full
+    sites = contracts.extract(core.Context(modules))
+    names = set(sites)
+    # the acceptance floor: these planes must all be registered
+    assert {"request-annotations", "transfer-frame", "discovery-metadata",
+            "debug-fleet"} <= names
+    for name, cs in sorted(sites.items()):
+        assert cs.produced, f"contract {name}: no produced key matched"
+        assert cs.consumed, f"contract {name}: no consumed key matched"
+
+
+def test_transfer_frame_required_keys_declared():
+    by_name = {s.name: s for s in contracts.CONTRACTS}
+    req = dict(by_name["transfer-frame"].required)
+    assert req["KvTransferServer._handle_stream"] == ("eof",)
+    assert req["KvTransferServer._handle_tier_stream"] == ("eof",)
+    assert dict(by_name["debug-fleet"].required)["fleet_snapshot"] == (
+        "generated_at", "fleet", "models", "workers"
+    )
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_list_rules_includes_contract_rules():
+    r = run_cli(["--list-rules"])
+    assert r.returncode == 0
+    rules = set(r.stdout.split())
+    assert set(_NEW_RULES) <= rules
+
+
+def test_cli_select_contract_drift_only(tmp_path):
+    tree = tmp_path / "dynamo_tpu" / "llm"
+    tree.mkdir(parents=True)
+    # drift AND a lock inversion: --select must keep only the drift
+    (tree / "mod.py").write_text(
+        "import asyncio\n"
+        "def route(req):\n"
+        "    return req.annotations.get('kv_directory')\n"
+        "class S:\n"
+        "    async def a(self):\n"
+        "        async with self._alpha_lock:\n"
+        "            async with self._beta_lock:\n"
+        "                pass\n"
+        "    async def b(self):\n"
+        "        async with self._beta_lock:\n"
+        "            async with self._alpha_lock:\n"
+        "                pass\n"
+    )
+    r = run_cli([str(tmp_path), "--select", "CONTRACT-DRIFT",
+                 "--no-baseline"])
+    assert r.returncode == 1
+    assert "kv_directory" in r.stdout
+    assert "LOCK-ORDER" not in r.stdout
+
+
+def test_cli_write_baseline_then_clean(tmp_path):
+    tree = tmp_path / "dynamo_tpu" / "llm"
+    tree.mkdir(parents=True)
+    (tree / "mod.py").write_text(
+        "def route(req):\n"
+        "    return req.annotations.get('kv_directory')\n"
+    )
+    bl = tmp_path / "b.txt"
+    r = run_cli([str(tmp_path), "--write-baseline", "--baseline", str(bl)])
+    assert r.returncode == 0
+    assert "CONTRACT-DRIFT" in bl.read_text()
+    r2 = run_cli([str(tmp_path), "--baseline", str(bl)])
+    assert r2.returncode == 0, r2.stdout
+
+
+def test_cli_write_baseline_with_select_rejected(tmp_path):
+    r = run_cli([str(tmp_path), "--select", "CONTRACT-DRIFT",
+                 "--write-baseline", "--baseline",
+                 str(tmp_path / "b.txt")])
+    assert r.returncode == 2
+    assert "discard" in r.stderr
+
+
+def test_cli_stale_baseline_scoped_to_selected_rules(tmp_path):
+    """A baselined LOCK-ORDER entry must not be called stale by a
+    --select CONTRACT-DRIFT run that never ran that rule."""
+    tree = tmp_path / "dynamo_tpu"
+    tree.mkdir(parents=True)
+    (tree / "mod.py").write_text("def f():\n    return 1\n")
+    bl = tmp_path / "b.txt"
+    # outside the repo root, paths normalize to the absolute posix path
+    bl.write_text(f"LOCK-ORDER\t{tree / 'mod.py'}\tsome stale inversion\n")
+    r = run_cli([str(tmp_path), "--select", "CONTRACT-DRIFT",
+                 "--baseline", str(bl)])
+    assert r.returncode == 0
+    assert "stale" not in r.stdout
+    # ...but an all-rules run over the same scanned file DOES report it
+    r2 = run_cli([str(tmp_path), "--baseline", str(bl)])
+    assert "stale" in r2.stdout
+
+
+def test_cli_sarif_reports_contract_rules(tmp_path):
+    tree = tmp_path / "dynamo_tpu" / "llm"
+    tree.mkdir(parents=True)
+    (tree / "mod.py").write_text(
+        "def route(req):\n"
+        "    return req.annotations.get('kv_directory')\n"
+    )
+    r = run_cli([str(tmp_path), "--sarif", "--no-baseline",
+                 "--select", "CONTRACT-DRIFT"])
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    run0 = doc["runs"][0]
+    assert [x["id"] for x in run0["tool"]["driver"]["rules"]] == [
+        "CONTRACT-DRIFT"
+    ]
+    assert run0["results"]
+    assert run0["results"][0]["ruleId"] == "CONTRACT-DRIFT"

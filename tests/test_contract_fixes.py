@@ -1,0 +1,287 @@
+"""Regressions for the cross-plane contract defects CONTRACT-DRIFT surfaced.
+
+Each of these was a real producer/consumer drift on the live tree: the
+``evacuation`` plan consumed by migration but produced nowhere, the global
+router reading SLA annotation keys nothing stamps, the ``worker_id``
+first-chunk attribution documented but never wired into the flight
+recorder, the image endpoint swallowing error-finish frames into a 200,
+and TensorRequest decoding mis-routed payloads instead of failing on the
+``op`` discriminator it writes.
+"""
+
+import asyncio
+import time
+import types
+
+import pytest
+
+from dynamo_tpu.global_router import GlobalRouterConfig, GlobalRouterHandler
+from dynamo_tpu.llm.protocols.common import (
+    BackendOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.llm.protocols.tensor import Tensor, TensorRequest
+from dynamo_tpu.runtime.flight_recorder import (
+    FlightRecorder,
+    set_flight_recorder,
+)
+
+
+# -- evacuation plan: the error-finish frame's kv_transfer reference ----------
+
+class _Seq:
+    def __init__(self, hashes):
+        self._h = hashes
+
+    def sequence_hashes(self):
+        return list(self._h)
+
+
+def _engine(transfer_address="10.0.0.7:7001", block_size=16,
+            bytes_per_block=4096):
+    from dynamo_tpu.engine.engine import TpuEngine
+
+    eng = types.SimpleNamespace(
+        transfer_address=transfer_address,
+        cfg=types.SimpleNamespace(block_size=block_size),
+        kv_bytes_per_block=bytes_per_block,
+    )
+    return TpuEngine, eng
+
+
+def _st(n_prompt=29, produced=3, hashes=(11, 22, 33), no_cache=False):
+    return types.SimpleNamespace(
+        no_cache=no_cache,
+        seq=_Seq(hashes),
+        produced=produced,
+        req=types.SimpleNamespace(token_ids=list(range(n_prompt))),
+    )
+
+
+def test_evacuation_plan_carries_migration_contract():
+    TpuEngine, eng = _engine()
+    plan = TpuEngine._evacuation_plan(eng, _st())
+    # 29 prompt + 3 produced = 32 tokens -> 2 full blocks of 16; only the
+    # 3 sealed hashes' first 2 ride along
+    assert plan == {
+        "address": "10.0.0.7:7001",
+        "hashes": [11, 22],
+        "num_tokens": 32,
+        "tier": True,
+        "bytes_per_block": 4096,
+    }
+    # exactly the keys discovery._evacuation_costs and migration's replay
+    # read — a hole here is the consumed-but-never-produced bug again
+    assert {"address", "hashes", "num_tokens", "bytes_per_block"} <= set(plan)
+
+
+def test_evacuation_plan_none_when_nothing_fetchable():
+    TpuEngine, eng = _engine()
+    # sub-block progress: no sealed block to evacuate
+    assert TpuEngine._evacuation_plan(eng, _st(n_prompt=3, produced=0)) is None
+    # request opted out of caching
+    assert TpuEngine._evacuation_plan(eng, _st(no_cache=True)) is None
+    # no transfer server to serve the pull
+    TpuEngine, cold = _engine(transfer_address=None)
+    assert TpuEngine._evacuation_plan(cold, _st()) is None
+
+
+# -- global router: SLA targets come from the sla annotation ------------------
+
+def _router_config():
+    return GlobalRouterConfig.from_obj({
+        "prefill_pools": ["pf", "ps"],
+        "decode_pools": ["fast", "bulk"],
+        "prefill_selection": {
+            "ttft_min": 0, "ttft_max": 100, "ttft_resolution": 2,
+            "isl_min": 0, "isl_max": 4096, "isl_resolution": 1,
+            "prefill_pool_mapping": [[0, 1]],
+        },
+        "decode_selection": {
+            "itl_min": 0, "itl_max": 40, "itl_resolution": 2,
+            "context_length_min": 0, "context_length_max": 4096,
+            "context_length_resolution": 1,
+            "decode_pool_mapping": [[0, 1]],
+        },
+        "default_itl_ms": 35.0,
+    })
+
+
+def _preq(annotations=None):
+    return PreprocessedRequest(
+        request_id="r1", model="m", token_ids=list(range(8)),
+        annotations=annotations or {},
+    )
+
+
+def test_pick_pool_reads_sla_annotation():
+    handler = GlobalRouterHandler(None, _router_config())
+    # tight itl target (5ms) -> low-latency pool; loose (35ms) -> bulk
+    tight = _preq({"sla": {"itl_target_s": 0.005}})
+    loose = _preq({"sla": {"itl_target_s": 0.035}})
+    assert handler._pick_pool(tight).namespace == "fast"
+    assert handler._pick_pool(loose).namespace == "bulk"
+
+
+def test_pick_pool_defaults_without_sla_annotation():
+    handler = GlobalRouterHandler(None, _router_config())
+    # no sla annotation: default_itl_ms=35 lands in the loose bucket
+    assert handler._pick_pool(_preq()).namespace == "bulk"
+
+
+def test_pick_pool_prefill_reads_ttft_target():
+    handler = GlobalRouterHandler(None, _router_config())
+    tight = _preq({"disagg": "prefill", "sla": {"ttft_target_s": 0.010}})
+    loose = _preq({"disagg": "prefill", "sla": {"ttft_target_s": 0.090}})
+    assert handler._pick_pool(tight).namespace == "pf"
+    assert handler._pick_pool(loose).namespace == "ps"
+
+
+# -- frontend: worker attribution lands on the flight timeline ----------------
+
+async def test_observed_records_worker_attribution():
+    from dynamo_tpu.llm import ModelManager
+    from dynamo_tpu.llm.http.service import HttpService
+
+    rec = FlightRecorder(capacity=8)
+    set_flight_recorder(rec)
+    try:
+        svc = HttpService(ModelManager(), host="127.0.0.1", port=0)
+
+        async def stream():
+            # first chunk carries the engine's metrics annotations,
+            # including the router-stamped serving worker
+            yield BackendOutput(
+                token_ids=[1],
+                annotations={"worker_id": 7, "prefill_worker_id": 9},
+            )
+            yield BackendOutput(token_ids=[2], finish_reason="stop")
+
+        outs = [
+            o async for o in svc._observed(
+                stream(), "m", time.monotonic(), request_id="r-attr"
+            )
+        ]
+        assert len(outs) == 2
+        events = [e["event"] for e in rec.timeline("r-attr")["events"]]
+        by_kind = {e["kind"]: e for e in events}
+        assert by_kind["first_token"]["worker_id"] == 7
+        assert by_kind["prefill_done"]["prefill_worker_id"] == 9
+    finally:
+        set_flight_recorder(None)
+
+
+async def test_observed_omits_worker_id_when_engine_does_not_echo():
+    from dynamo_tpu.llm import ModelManager
+    from dynamo_tpu.llm.http.service import HttpService
+
+    rec = FlightRecorder(capacity=8)
+    set_flight_recorder(rec)
+    try:
+        svc = HttpService(ModelManager(), host="127.0.0.1", port=0)
+
+        async def stream():
+            yield BackendOutput(token_ids=[1], finish_reason="stop")
+
+        [o async for o in svc._observed(
+            stream(), "m", time.monotonic(), request_id="r-plain"
+        )]
+        events = [e["event"] for e in rec.timeline("r-plain")["events"]]
+        first = next(e for e in events if e["kind"] == "first_token")
+        assert "worker_id" not in first  # no None pollution
+    finally:
+        set_flight_recorder(None)
+
+
+# -- image endpoint: error-finish frames surface as 502, not empty 200 --------
+
+class _BoomImageEngine:
+    async def generate(self, request, context):
+        yield BackendOutput(
+            finish_reason="error",
+            annotations={"error": "sampler exploded"},
+        ).to_obj()
+
+
+async def test_images_error_frame_surfaces_502():
+    import aiohttp
+
+    from dynamo_tpu.llm import (
+        ModelDeploymentCard,
+        ModelManager,
+        ModelWatcher,
+        register_llm,
+    )
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.runtime import (
+        DistributedRuntime,
+        InProcEventPlane,
+        MemKVStore,
+        RouterMode,
+        RuntimeConfig,
+    )
+
+    store = MemKVStore()
+
+    def make_rt():
+        cfg = RuntimeConfig(store="mem", event_plane="inproc", lease_ttl_s=2.0)
+        return DistributedRuntime(
+            cfg, store=store, event_plane=InProcEventPlane()
+        )
+
+    worker_rt = await make_rt().start()
+    frontend_rt = await make_rt().start()
+    card = ModelDeploymentCard(
+        name="boom-images", tokenizer="byte", model_type=["images"],
+    )
+    served = await register_llm(
+        worker_rt, _BoomImageEngine(), card, raw_token_stream=True
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        frontend_rt, manager, RouterMode.ROUND_ROBIN
+    ).start()
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        for _ in range(100):
+            p = manager.get("boom-images")
+            if p and p.client.instances:
+                break
+            await asyncio.sleep(0.05)
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{service.port}/v1/images/generations",
+                json={"model": "boom-images", "prompt": "x", "n": 1},
+            )
+            body = await r.json()
+        assert r.status == 502
+        assert "sampler exploded" in body["error"]["message"]
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await served.stop()
+        await worker_rt.shutdown()
+        await frontend_rt.shutdown()
+
+
+# -- tensor protocol: the op discriminator round-trips and rejects ------------
+
+def test_tensor_request_op_discriminator():
+    import numpy as np
+
+    req = TensorRequest(
+        request_id="t1", model="m",
+        tensors=[Tensor.from_numpy("x", np.arange(4, dtype=np.float32))],
+    )
+    obj = req.to_obj()
+    assert obj["op"] == "tensor"
+    back = TensorRequest.from_obj(obj)
+    assert back.request_id == "t1"
+    assert back.tensor("x").to_numpy().tolist() == [0.0, 1.0, 2.0, 3.0]
+    # a mis-routed chat payload must fail loudly, not decode to empty
+    with pytest.raises(ValueError, match="not a tensor request"):
+        TensorRequest.from_obj({"op": "chat", "id": "t2", "model": "m"})
+    # absent op defaults to tensor (pre-discriminator senders)
+    legacy = TensorRequest.from_obj({"id": "t3", "model": "m"})
+    assert legacy.request_id == "t3"

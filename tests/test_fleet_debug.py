@@ -82,8 +82,12 @@ async def test_partial_failure_is_stale_not_error():
     assert by_id[f"{3:016x}"]["stale"]
     assert "timed out" in by_id[f"{3:016x}"]["error"]
     assert by_id[f"{4:016x}"]["error"] == "no status_address advertised"
+    assert all(w["model"] == "m" for w in doc["workers"])
     # routing-plane health rides along: the open circuit is visible
-    assert doc["models"]["m"]["open_circuits"] == 1
+    entry = doc["models"]["m"]
+    assert entry["open_circuits"] == 1
+    assert entry["instances"] == 4
+    assert entry["worker_breakers"][f"{2:016x}"] == "open"
 
 
 async def test_merge_folds_worker_sections():
@@ -92,16 +96,17 @@ async def test_merge_folds_worker_sections():
 
     pipe = _Pipeline({1: _Inst("a:1"), 2: _Inst("b:1")})
     doc = await fleet_snapshot([pipe], fetch=fetch, timeout_s=1.0)
-    assert doc["kv"] == {
-        "active_blocks": 20, "free_blocks": 44, "total_blocks": 64,
-    }
-    assert doc["global_kv"] == {
-        "published": 8, "inflight_fetches": 2, "dedupe_skipped": 4,
-    }
+    assert doc["kv"]["active_blocks"] == 20
+    assert doc["kv"]["free_blocks"] == 44
+    assert doc["kv"]["total_blocks"] == 64
+    assert doc["global_kv"]["published"] == 8
+    assert doc["global_kv"]["inflight_fetches"] == 2
+    assert doc["global_kv"]["dedupe_skipped"] == 4
     assert doc["restore_modes"] == {"warm": 2}
     # active health events are attributed to the reporting worker
     assert len(doc["health_active"]) == 2
     assert all("worker_id" in h for h in doc["health_active"])
+    assert doc["health_active"][0]["detector"] == "cost_model_drift"
 
 
 async def test_draining_state_counted():

@@ -46,6 +46,9 @@ def test_package_lints_clean():
         capture_output=True, text=True, timeout=120,
     )
     assert r.returncode == 0, "\n" + r.stdout
+    # the narrow view (no tests/) skips whole-tree contract directions and
+    # must NOT call their baseline entries stale (STALE_PROVABLE)
+    assert "stale" not in r.stdout, "\n" + r.stdout
 
 
 def test_linter_catches_undefined_name(tmp_path):

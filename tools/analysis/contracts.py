@@ -1,0 +1,1272 @@
+"""Cross-plane contract + async-liveness passes: CONTRACT-DRIFT, LOCK-ORDER,
+EVENT-LIVENESS.
+
+The planes talk through string-keyed dicts — request-plane annotations,
+transfer-plane frames, event-plane payloads (typed to_obj/from_obj),
+discovery instance metadata, ``/debug/*`` JSON documents — and nothing
+enforces those contracts: the reference gets this from Rust's type system;
+this repo gets it from the analyzer, the way resources.py made lifecycles
+checkable.
+
+- CONTRACT-DRIFT: every contract is DECLARED in the ``CONTRACTS`` table
+  below (producer and consumer site patterns). The pass extracts literal
+  keys written at producer sites (``d[k]=``, ``.setdefault``, dict
+  literals) and read at consumer sites (``d[k]``, ``.get(k)``, ``k in d``)
+  and flags both directions: a produced key no consumer reads (dead field
+  or typo'd producer) and a key consumed on a production path that no
+  producer writes (the ``kv_directory``-class silent-feature bug). Keys
+  spelled as constants (``ANNOTATION_SLA``) resolve through module-level
+  string assignments. ``required`` entries additionally run a CFG
+  must-write analysis: the named producer must write those keys on every
+  non-exceptional path out. Whole-tree zero-site directions are skipped
+  on --changed-only partial views, like ENV/FAULTS/SPAN-DRIFT — and also
+  per-contract when the scanned paths don't cover the side's declared
+  scope (``python tools/lint.py dynamo_tpu`` must not call a key dead
+  just because its registered consumers live under ``tests/``); the
+  matching baseline entries are not provably stale on such runs either
+  (the STALE_PROVABLE hook).
+
+- LOCK-ORDER: call-graph-transitive lock-acquisition ordering. Any pair
+  of asyncio locks (lock/mutex/sem/cond-named ``with``/``async with``
+  context managers) acquired in both orders on different paths is the
+  classic two-party deadlock LOCK-ACROSS-AWAIT cannot see. Lock identity
+  is (owning class | module, attribute name); acquisitions reached through
+  resolved calls made while a lock is held count transitively.
+
+- EVENT-LIVENESS: an ``asyncio.Event`` someone awaits (untimed — a
+  ``wait_for``-bounded wait cannot hang forever and is exempt) must be
+  settable. Three checks: (1) an awaited event with ZERO ``set()`` sites
+  in the scanned tree (whole-tree direction, skipped on partial views);
+  (2) ``set()``-then-``clear()`` in the same rollback scope (except/
+  finally) — woken waiters that re-check a cleared event, and late
+  waiters, hang — flagged unless every wait site re-elects in a loop
+  (the PR 7 zmq ``_warm`` shape); (3) in a function whose ``set()`` sits
+  inside a try construct (i.e. the function visibly handles rollback),
+  every non-exceptional path out must set the event — a swallowed
+  exception path that returns without setting strands every waiter.
+  ``evt.is_set()`` guards and ``await evt.wait()`` count as proof the
+  event is set on that path.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from collections import deque
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from .core import Context, Finding, register
+from .flows import ASSUME, Cfg, FuncInfo, build_cfg
+
+
+def _trailing(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _unwrap(expr: ast.AST) -> ast.AST:
+    """Strip defaulting wrappers so the real receiver shows through:
+    ``(ann or {}).get(k)`` reads ``ann``."""
+    while isinstance(expr, ast.BoolOp):
+        expr = expr.values[0]
+    return expr
+
+
+def _recv_base(expr: ast.AST) -> Optional[str]:
+    """The name the dict ultimately came from, digging through defaulting
+    BoolOps, subscript chains and ``.get()`` hops: the receiver of
+    ``payload.get("fleet", {}).get("workers_total")`` and of
+    ``snap["objective"].get("x")`` is the base name."""
+    while True:
+        expr = _unwrap(expr)
+        if isinstance(expr, ast.Subscript):
+            expr = expr.value
+            continue
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in ("get", "setdefault", "pop")
+        ):
+            expr = expr.func.value
+            continue
+        return _trailing(expr)
+
+
+def _walk_no_defs(node: ast.AST):
+    """ast.walk that does not descend into nested function/class scopes
+    (their bodies run on someone else's schedule, not this path)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+# ---------------------------------------------------------------------------
+# contract spec table — new wire fields register HERE (docs/development.md
+# has the "adding a new wire field" checklist)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SiteSpec:
+    """Where one side of a contract lives. ``paths`` scope modules by
+    substring match on the normalized path. Within a scoped module a site
+    matches when the dict's trailing receiver name is in ``receivers``
+    (``req.annotations[k]`` -> ``annotations``), or when the enclosing
+    function's qualname contains one of ``functions`` — inside such a
+    function every dict literal's string keys count as produced and every
+    literal-key read counts as consumed (the shape of wire handlers that
+    build/unpack frames in local variables), except on receivers named in
+    ``exclude_receivers`` (out-params and ambient lookups that are not
+    this wire). ``key_calls`` counts call arguments as key sites: index
+    >= 0 means a literal string at that position is a key READ (helper
+    funnels like ``_instance_meta(wid, "kv_wire")``); index -1 means every
+    string key of a dict-literal argument is a key WRITE
+    (``update_metadata({...})``)."""
+
+    paths: Tuple[str, ...]
+    receivers: Tuple[str, ...] = ()
+    functions: Tuple[str, ...] = ()
+    key_calls: Tuple[Tuple[str, int], ...] = ()
+    exclude_receivers: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractSpec:
+    name: str
+    doc: str
+    producers: Tuple[SiteSpec, ...]
+    consumers: Tuple[SiteSpec, ...]
+    # (producer function qualname substring, (keys...)) — every named key
+    # must be written on every non-exceptional path out of that function
+    required: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
+
+
+CONTRACTS: Tuple[ContractSpec, ...] = (
+    ContractSpec(
+        name="request-annotations",
+        doc="request-plane annotation keys riding PreprocessedRequest/"
+            "BackendOutput.annotations across frontend, router, worker and "
+            "sim — the traceparent/sla/worker_id/evacuation/... namespace",
+        producers=(
+            # "ann" covers locally-built annotation dicts handed to the
+            # wire (SlaSpec.to_annotation, the engine's first-chunk
+            # metrics frame)
+            SiteSpec(paths=("dynamo_tpu/",),
+                     receivers=("annotations", "ann")),
+        ),
+        consumers=(
+            SiteSpec(paths=("dynamo_tpu/", "tests/"),
+                     receivers=("annotations", "ann")),
+        ),
+    ),
+    ContractSpec(
+        name="kv-transfer-plan",
+        doc="the kv_transfer plan dict a planner attaches to a request — "
+            "global-directory fetch plans, streamed-prefill plans and "
+            "evacuation plans — consumed by the engine-side fetch path "
+            "({address, hashes, stream, window, tier, holder, "
+            "bytes_per_block, est_fetch_s, num_tokens})",
+        producers=(
+            SiteSpec(paths=("dynamo_tpu/",), receivers=("kv_transfer",)),
+            SiteSpec(paths=("dynamo_tpu/llm/prefill_router.py",
+                            "dynamo_tpu/engine/engine.py"),
+                     functions=("plan_fetch", "_evacuation_plan")),
+        ),
+        consumers=(
+            SiteSpec(paths=("dynamo_tpu/", "tests/"),
+                     receivers=("kv_transfer", "kv_plan", "kvt",
+                                "evacuation", "evac")),
+        ),
+    ),
+    ContractSpec(
+        name="transfer-frame",
+        doc="kv_fetch wire frames (engine/transfer.py): the request dict "
+            "a client sends and the window/eof/native item frames the "
+            "server streams back",
+        producers=(
+            SiteSpec(
+                paths=("engine/transfer.py",),
+                functions=(
+                    "KvTransferServer.handle",
+                    "KvTransferServer._window_item",
+                    "KvTransferServer._handle_tier_stream",
+                    "KvTransferServer._handle_stream",
+                    "KvTransferClient._pull",
+                    "KvTransferClient._pull_tier",
+                    "KvTransferClient._pull_stream",
+                    "KvTransferClient._device_pull",
+                    "KvTransferClient._native_fetch",
+                ),
+                # info/meta are fetch-stats out-params, not wire frames
+                exclude_receivers=("info", "meta"),
+            ),
+        ),
+        consumers=(
+            SiteSpec(paths=("engine/transfer.py", "dynamo_tpu/sim/",
+                            "tests/"),
+                     receivers=("request", "item", "nat", "offer")),
+        ),
+        required=(
+            # a stream handler that exits a non-exceptional path without
+            # the eof frame leaves the client awaiting a window forever
+            ("KvTransferServer._handle_stream", ("eof",)),
+            ("KvTransferServer._handle_tier_stream", ("eof",)),
+        ),
+    ),
+    ContractSpec(
+        name="discovery-metadata",
+        doc="discovery instance metadata (state=draining, transfer_address, "
+            "kv_wire, status_address): written at worker registration and "
+            "through update_metadata at drain, read by routing/fleet fan-out",
+        producers=(
+            SiteSpec(paths=("dynamo_tpu/",),
+                     receivers=("metadata", "transfer_md", "status_meta"),
+                     key_calls=(("update_metadata", -1),)),
+        ),
+        consumers=(
+            SiteSpec(paths=("dynamo_tpu/", "tests/"),
+                     receivers=("metadata", "md"),
+                     key_calls=(("_instance_meta", 1),)),
+        ),
+    ),
+    ContractSpec(
+        name="wire-protocol",
+        doc="typed protocol objects' to_obj/from_obj dict round-trip "
+            "(llm/protocols, kv_router/protocols — request, response and "
+            "event-plane payloads): a key one side writes and the other "
+            "never reads is schema drift on the wire",
+        producers=(
+            SiteSpec(paths=("dynamo_tpu/llm/protocols/",
+                            "dynamo_tpu/kv_router/protocols.py"),
+                     functions=("to_obj",)),
+        ),
+        consumers=(
+            SiteSpec(paths=("dynamo_tpu/llm/protocols/",
+                            "dynamo_tpu/kv_router/protocols.py"),
+                     functions=("from_obj",)),
+        ),
+    ),
+    ContractSpec(
+        name="debug-fleet",
+        doc="the /debug/fleet response document (llm/fleet.py "
+            "fleet_snapshot): fleet rollup + per-model breakers + "
+            "per-worker snapshots",
+        producers=(
+            SiteSpec(paths=("dynamo_tpu/llm/fleet.py",),
+                     functions=("fleet_snapshot", "_discover_workers",
+                                "_merge_worker_sections")),
+        ),
+        consumers=(
+            SiteSpec(paths=("dynamo_tpu/llm/fleet.py", "tests/"),
+                     receivers=("doc", "entry", "target", "w")),
+        ),
+        required=(
+            ("fleet_snapshot", ("generated_at", "fleet", "models",
+                                "workers")),
+        ),
+    ),
+    ContractSpec(
+        name="debug-worker",
+        doc="the per-worker /debug/worker observability document "
+            "(engine/__main__.py worker_snapshot + runtime/health.py "
+            "StatusServer/HealthMonitor): the unit /debug/fleet merges",
+        producers=(
+            SiteSpec(paths=("engine/__main__.py",),
+                     functions=("worker_snapshot",)),
+            SiteSpec(paths=("runtime/health.py",),
+                     functions=("StatusServer._debug_worker",
+                                "HealthMonitor.snapshot",
+                                "HealthMonitor.active")),
+        ),
+        consumers=(
+            SiteSpec(paths=("dynamo_tpu/llm/fleet.py", "tests/"),
+                     receivers=("snap", "wkv", "wgkv", "health", "doc",
+                                "live")),
+        ),
+    ),
+    ContractSpec(
+        name="debug-slo",
+        doc="the /debug/slo response document (runtime/slo.py "
+            "SloAccountant.snapshot / debug_slo_payload): objective, "
+            "windows, per-(model, class) series",
+        producers=(
+            SiteSpec(paths=("runtime/slo.py",),
+                     functions=("SloAccountant.snapshot",
+                                "debug_slo_payload")),
+        ),
+        consumers=(
+            SiteSpec(paths=("dynamo_tpu/sim/report.py", "runtime/slo.py",
+                            "tests/"),
+                     receivers=("snap", "body", "payload", "tw")),
+        ),
+    ),
+    ContractSpec(
+        name="debug-requests",
+        doc="the /debug/requests response document (runtime/"
+            "flight_recorder.py FlightRecorder.snapshot / "
+            "debug_requests_payload): capacity + most-recent-first "
+            "request timelines",
+        producers=(
+            SiteSpec(paths=("runtime/flight_recorder.py",),
+                     functions=("FlightRecorder.snapshot",
+                                "debug_requests_payload")),
+        ),
+        consumers=(
+            SiteSpec(paths=("tests/test_tracing.py", "tests/test_slo.py"),
+                     receivers=("snap", "body", "flight", "f", "failed",
+                                "payload")),
+        ),
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# key-site harvest: ONE walk per module collects every literal-key read and
+# write with its receiver, enclosing function and call context; the spec
+# table then matches against the harvested records — so adding a contract
+# costs nothing at parse time
+# ---------------------------------------------------------------------------
+
+# record kinds
+W, R, CW, CR = "w", "r", "cw", "cr"
+
+# fn-scoped reads on these receivers are ambient lookups, never wire keys
+_EXCLUDE_RECEIVERS = frozenset({"environ", "headers", "os", "kwargs"})
+
+Site = Tuple[str, int]          # (path, line)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Rec:
+    kind: str                   # W | R | CW | CR
+    recv: Optional[str]         # receiver name (W/R) or call name (CW/CR)
+    argidx: int                 # CR only: positional index of the key
+    fn: str                     # enclosing function qualname, "" at module level
+    key: str
+    line: int
+
+
+def _const_table(modules) -> Tuple[Dict[str, Dict[str, str]], Dict[str, Set[str]]]:
+    """Module-level ``NAME = "literal"`` assignments: per-module map plus a
+    global name -> {values} view for cross-module constant references."""
+    per: Dict[str, Dict[str, str]] = {}
+    glob: Dict[str, Set[str]] = {}
+    for m in modules:
+        table: Dict[str, str] = {}
+        for node in m.tree.body:
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if not (isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    table[t.id] = value.value
+                    glob.setdefault(t.id, set()).add(value.value)
+        per[m.path] = table
+    return per, glob
+
+
+class _Harvester:
+    def __init__(self, mpath: str, local: Dict[str, str],
+                 glob: Dict[str, Set[str]]):
+        self.mpath = mpath
+        self.local = local
+        self.glob = glob
+        self.records: List[_Rec] = []
+        self._store_subs: Set[int] = set()
+        self._chain_inner: Set[int] = set()
+
+    def _key_of(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        name = _trailing(node)
+        if name is None:
+            return None
+        local = self.local.get(name)
+        if local is not None:
+            return local
+        vals = self.glob.get(name, set())
+        if len(vals) == 1:
+            return next(iter(vals))
+        return None
+
+    def rec(self, kind: str, recv: Optional[str], key: Optional[str],
+            line: int, fn: str, argidx: int = -1) -> None:
+        if key:
+            self.records.append(_Rec(kind, recv, argidx, fn, key, line))
+
+    def _chain(self, sub: ast.Subscript) -> Tuple[Optional[str], List[Tuple[Optional[str], int]]]:
+        """(base receiver, keys outermost-last) for ``d[a][b]`` chains."""
+        keys: List[Tuple[Optional[str], int]] = []
+        cur: ast.AST = sub
+        while isinstance(cur, ast.Subscript):
+            self._chain_inner.add(id(cur))
+            keys.append((self._key_of(cur.slice), cur.lineno))
+            cur = _unwrap(cur.value)
+        keys.reverse()
+        return _recv_base(cur), keys
+
+    @staticmethod
+    def _dict_operands(expr: ast.AST) -> List[ast.Dict]:
+        """Dict literals an expression can evaluate to on some branch:
+        ``{...} if cond else {}`` and ``x or {...}`` still produce their
+        branch's keys."""
+        out: List[ast.Dict] = []
+        stack = [expr]
+        while stack:
+            e = stack.pop()
+            if isinstance(e, ast.Dict):
+                out.append(e)
+            elif isinstance(e, ast.IfExp):
+                stack.extend((e.body, e.orelse))
+            elif isinstance(e, ast.BoolOp):
+                stack.extend(e.values)
+        return out
+
+    def _dict_deep(self, d: ast.Dict, recv: Optional[str], fn: str,
+                   kind: str = W) -> None:
+        """Record every string key of a dict literal, recursing through
+        nested dict/list values — a nested schema is still the contract."""
+        for k, v in zip(d.keys, d.values):
+            if k is not None:
+                self.rec(kind, recv, self._key_of(k), d.lineno, fn)
+            for sub in ast.walk(v):
+                if isinstance(sub, ast.Dict) and sub is not v:
+                    break  # inner dicts get their own visit below
+            if isinstance(v, ast.Dict):
+                self._dict_deep(v, recv, fn, kind)
+            elif isinstance(v, (ast.List, ast.Tuple)):
+                for e in v.elts:
+                    if isinstance(e, ast.Dict):
+                        self._dict_deep(e, recv, fn, kind)
+
+    def visit(self, node: ast.AST, fn: str, cls: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            q = (f"{fn}.<locals>.{node.name}" if fn
+                 else (f"{cls}.{node.name}" if cls else node.name))
+            for child in ast.iter_child_nodes(node):
+                self.visit(child, q, None)
+            return
+        if isinstance(node, ast.ClassDef):
+            for child in ast.iter_child_nodes(node):
+                self.visit(child, fn, node.name if not fn else None)
+            return
+
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    self._store_subs.add(id(t))
+                    base, keys = self._chain(t)
+                    if base is not None and keys:
+                        for k, line in keys[:-1]:
+                            if k:
+                                self.rec(R, base, k, line, fn)
+                        k, line = keys[-1]
+                        self.rec(W, base, k, line, fn)
+                        for d in self._dict_operands(value):
+                            self._dict_deep(d, base, fn)
+                else:
+                    tname = _trailing(t)
+                    if tname is not None:
+                        for d in self._dict_operands(value):
+                            self._dict_deep(d, tname, fn)
+        elif isinstance(node, ast.Dict):
+            # generic record: any dict literal, attributed to the enclosing
+            # function — how fn-scoped producer specs see return/yield
+            # frames and out-of-line helpers
+            for k in node.keys:
+                if k is not None:
+                    self.rec(W, None, self._key_of(k), node.lineno, fn)
+        elif isinstance(node, ast.Call):
+            cname = _trailing(node.func)
+            recv = None
+            if isinstance(node.func, ast.Attribute):
+                recv = _recv_base(node.func.value)
+            if cname in ("get", "pop") and node.args:
+                self.rec(R, recv, self._key_of(node.args[0]),
+                         node.lineno, fn)
+            elif cname == "setdefault" and node.args:
+                self.rec(W, recv, self._key_of(node.args[0]),
+                         node.lineno, fn)
+            elif cname == "update":
+                for a in node.args:
+                    if isinstance(a, ast.Dict):
+                        self._dict_deep(a, recv, fn)
+                for kw in node.keywords:
+                    if kw.arg:
+                        self.rec(W, recv, kw.arg, node.lineno, fn)
+            elif cname == "dict":
+                for kw in node.keywords:
+                    if kw.arg:
+                        self.rec(W, None, kw.arg, node.lineno, fn)
+            if cname is not None:
+                for i, a in enumerate(node.args):
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        self.rec(CR, cname, a.value, a.lineno, fn, argidx=i)
+                    elif isinstance(a, ast.Dict):
+                        for k in a.keys:
+                            if k is not None:
+                                self.rec(CW, cname, self._key_of(k),
+                                         a.lineno, fn)
+            for kw in node.keywords:
+                if kw.arg:
+                    for d in self._dict_operands(kw.value):
+                        self._dict_deep(d, kw.arg, fn)
+        elif isinstance(node, ast.Subscript):
+            if id(node) not in self._store_subs and id(node) not in self._chain_inner:
+                base, keys = self._chain(node)
+                if base is not None:
+                    for k, line in keys:
+                        if k:
+                            self.rec(R, base, k, line, fn)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and isinstance(
+            node.ops[0], (ast.In, ast.NotIn)
+        ):
+            recv = _recv_base(node.comparators[0])
+            self.rec(R, recv, self._key_of(node.left), node.lineno, fn)
+
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, fn, cls)
+
+
+# ---------------------------------------------------------------------------
+# spec matching over the harvest
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ContractSites:
+    spec: ContractSpec
+    produced: Dict[str, List[Site]]
+    consumed: Dict[str, List[Site]]
+    # consumed sites on non-test paths only — the direction-2 evidence
+    consumed_prod: Dict[str, List[Site]]
+
+
+class _Extractor:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.per_const, self.glob_const = _const_table(ctx.modules)
+        self.harvest: Dict[str, List[_Rec]] = {}
+        for m in ctx.modules:
+            h = _Harvester(m.path, self.per_const.get(m.path, {}),
+                           self.glob_const)
+            h.visit(m.tree, "", None)
+            self.harvest[m.path] = h.records
+
+    @staticmethod
+    def _rec_matches(rec: _Rec, spec: SiteSpec, writes: bool) -> bool:
+        if rec.kind in (CW, CR):
+            if writes != (rec.kind == CW):
+                return False
+            for kc_name, kc_idx in spec.key_calls:
+                if rec.recv != kc_name:
+                    continue
+                if rec.kind == CW and kc_idx == -1:
+                    return True
+                if rec.kind == CR and kc_idx == rec.argidx:
+                    return True
+            return False
+        if writes != (rec.kind == W):
+            return False
+        if rec.recv is not None and rec.recv in spec.receivers:
+            return True
+        if spec.functions and any(p in rec.fn for p in spec.functions):
+            if rec.recv is None:
+                return writes  # bare dict literals only make sense as writes
+            if rec.recv in spec.exclude_receivers or (
+                rec.recv in _EXCLUDE_RECEIVERS
+            ):
+                return False
+            return True
+        return False
+
+    def _side(self, specs: Tuple[SiteSpec, ...],
+              writes: bool, out: Dict[str, List[Site]],
+              include_tests: bool = True) -> None:
+        for spec in specs:
+            for mpath, recs in self.harvest.items():
+                if not any(p in mpath for p in spec.paths):
+                    continue
+                if not include_tests and mpath.startswith("tests/"):
+                    continue
+                for rec in recs:
+                    if self._rec_matches(rec, spec, writes):
+                        out.setdefault(rec.key, []).append((mpath, rec.line))
+
+    def sites_for(self, spec: ContractSpec) -> ContractSites:
+        produced: Dict[str, List[Site]] = {}
+        consumed: Dict[str, List[Site]] = {}
+        consumed_prod: Dict[str, List[Site]] = {}
+        # producers: production code only — a key produced only by a test
+        # fixture must NOT mask the consumed-but-never-produced bug
+        self._side(spec.producers, writes=True, out=produced,
+                   include_tests=False)
+        self._side(spec.consumers, writes=False, out=consumed)
+        self._side(spec.consumers, writes=False, out=consumed_prod,
+                   include_tests=False)
+        for d in (produced, consumed, consumed_prod):
+            for sites in d.values():
+                sites.sort()
+        return ContractSites(spec, produced, consumed, consumed_prod)
+
+
+def extract(ctx: Context) -> Dict[str, ContractSites]:
+    """All contract sites on this Context, cached so the pass and the
+    no-vacuous-spec tests share one extraction round per run."""
+    cached = getattr(ctx, "_contract_sites", None)
+    if cached is not None:
+        return cached
+    ex = _Extractor(ctx)
+    out = {spec.name: ex.sites_for(spec) for spec in CONTRACTS}
+    ctx._contract_sites = out
+    ctx._contract_extractor = ex
+    return out
+
+
+# ---------------------------------------------------------------------------
+# must-reach solver (shared by required-key presence and EVENT-LIVENESS)
+# ---------------------------------------------------------------------------
+
+def _must_reach_exit(
+    cfg: Cfg, gen: Dict[int, FrozenSet[str]], universe: FrozenSet[str]
+) -> Optional[FrozenSet[str]]:
+    """Items guaranteed generated on EVERY non-exceptional path reaching
+    EXIT; None when no non-exceptional path reaches EXIT at all (the
+    function always leaves exceptionally — nothing to check)."""
+    n = len(cfg.nodes)
+    preds = cfg.preds()
+    top = universe
+    state_out: List[Optional[FrozenSet[str]]] = [None] * n
+    state_out[Cfg.ENTRY_ID] = gen.get(Cfg.ENTRY_ID, frozenset())
+    work = deque(cfg.succ[Cfg.ENTRY_ID])
+    iters = 0
+    while work:
+        iters += 1
+        if iters > 200000:  # pragma: no cover — safety valve
+            break
+        idx = work.popleft()
+        acc: Optional[FrozenSet[str]] = None
+        reachable = False
+        for p in preds[idx]:
+            if (p, idx) in cfg.exc_edges:
+                continue
+            if state_out[p] is None:
+                # untouched predecessor (loop back-edge): optimistic TOP,
+                # the worklist converges downward from here
+                contrib = top
+            else:
+                contrib = state_out[p]
+            reachable = True
+            acc = contrib if acc is None else (acc & contrib)
+        if not reachable:
+            continue
+        new_out = (acc or frozenset()) | gen.get(idx, frozenset())
+        if new_out != state_out[idx]:
+            state_out[idx] = new_out
+            for s in cfg.succ[idx]:
+                work.append(s)
+    exit_preds = [
+        p for p in preds[Cfg.EXIT_ID]
+        if (p, Cfg.EXIT_ID) not in cfg.exc_edges and state_out[p] is not None
+    ]
+    if not exit_preds:
+        return None
+    acc2: FrozenSet[str] = state_out[exit_preds[0]] or frozenset()
+    for p in exit_preds[1:]:
+        acc2 = acc2 & (state_out[p] or frozenset())
+    return acc2
+
+
+def _node_written_keys(
+    node: ast.AST, key_of, universe: FrozenSet[str]
+) -> FrozenSet[str]:
+    """Contract keys this one CFG statement writes, receiver-insensitively
+    (dict literals, ``d[k]=``, ``.setdefault``, ``dict(k=...)``) — the gen
+    function for the required-key must-analysis."""
+    got: Set[str] = set()
+    for n in _walk_no_defs(node):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if k is not None:
+                    key = key_of(k)
+                    if key in universe:
+                        got.add(key)
+        elif isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript):
+                    key = key_of(t.slice)
+                    if key in universe:
+                        got.add(key)
+        elif isinstance(n, ast.Call):
+            cname = _trailing(n.func)
+            if cname == "setdefault" and n.args:
+                key = key_of(n.args[0])
+                if key in universe:
+                    got.add(key)
+            elif cname == "dict":
+                for kw in n.keywords:
+                    if kw.arg in universe:
+                        got.add(kw.arg)
+    return frozenset(got)
+
+
+# ---------------------------------------------------------------------------
+# CONTRACT-DRIFT pass
+# ---------------------------------------------------------------------------
+
+def _scope_covered(side: Tuple[SiteSpec, ...], scanned: Set[str]) -> bool:
+    """A zero-site claim about one side of a contract is only sound when
+    every path fragment the side's specs name is represented in the
+    scanned module set: ``python tools/lint.py dynamo_tpu`` never saw
+    ``tests/``, so "no consumer reads this key" is unprovable there for
+    contracts whose consumers include test files."""
+    return all(
+        any(frag in mp for mp in scanned)
+        for s in side
+        for frag in s.paths
+    )
+
+
+@register("contracts", "declared cross-plane dict contracts: producer vs "
+                       "consumer key drift + required-key presence")
+def _contract_drift_pass(ctx: Context) -> Iterator[Finding]:
+    sites = extract(ctx)
+    partial = getattr(ctx, "partial", False)
+    ex: _Extractor = ctx._contract_extractor
+    flows = ctx.flows()
+    scanned = set(ex.harvest)
+    for name in sorted(sites):
+        cs = sites[name]
+        spec = cs.spec
+        if not partial and _scope_covered(spec.consumers, scanned):
+            # direction 1: produced key nothing reads — dead field or typo
+            for key in sorted(set(cs.produced) - set(cs.consumed)):
+                path, line = cs.produced[key][0]
+                yield Finding(
+                    "CONTRACT-DRIFT", path, line,
+                    f"contract '{name}': key '{key}' is produced but no "
+                    f"registered consumer site reads it — dead field or "
+                    f"typo'd producer; fix the key or register/prune the "
+                    f"consumer (tools/analysis/contracts.py)",
+                )
+        if not partial and _scope_covered(spec.producers, scanned):
+            # direction 2: key consumed on a production path that nothing
+            # produces — the feature silently never fires
+            for key in sorted(set(cs.consumed_prod) - set(cs.produced)):
+                path, line = cs.consumed_prod[key][0]
+                yield Finding(
+                    "CONTRACT-DRIFT", path, line,
+                    f"contract '{name}': key '{key}' is consumed here but "
+                    f"no registered producer ever writes it — the read "
+                    f"silently sees nothing (kv_directory-class wiring "
+                    f"bug); wire the producer or drop the read",
+                )
+        # direction 3: required-key presence on every non-exceptional
+        # producer path (function-local: fine on partial views)
+        for fnpat, keys in spec.required:
+            universe = frozenset(keys)
+            for fi in flows.index.functions():
+                # exact match: "fleet_snapshot" must not also claim the
+                # nested "fleet_snapshot.<locals>._one"
+                if fi.qualname != fnpat:
+                    continue
+                if not any(
+                    any(p in fi.module for p in s.paths)
+                    for s in spec.producers
+                ):
+                    continue
+                if fi.module.startswith("tests/"):
+                    continue
+                local = ex.per_const.get(fi.module, {})
+
+                def key_of(node, _local=local):
+                    if isinstance(node, ast.Constant):
+                        return node.value if isinstance(node.value, str) else None
+                    nm = _trailing(node)
+                    if nm is None:
+                        return None
+                    if nm in _local:
+                        return _local[nm]
+                    vals = ex.glob_const.get(nm, set())
+                    return next(iter(vals)) if len(vals) == 1 else None
+
+                cfg = build_cfg(fi.node)
+                gen: Dict[int, FrozenSet[str]] = {}
+                for idx, cnode in enumerate(cfg.nodes):
+                    if cnode.node is None:
+                        continue
+                    got = _node_written_keys(cnode.node, key_of, universe)
+                    if got:
+                        gen[idx] = got
+                reached = _must_reach_exit(cfg, gen, universe)
+                if reached is None:
+                    continue
+                for key in sorted(universe - reached):
+                    yield Finding(
+                        "CONTRACT-DRIFT", fi.module, fi.node.lineno,
+                        f"contract '{name}': producer {fi.qualname} has a "
+                        f"non-exceptional path out that never writes "
+                        f"required key '{key}' — consumers of that path "
+                        f"see a hole in the schema",
+                    )
+
+
+_contract_drift_pass.RULES = ("CONTRACT-DRIFT",)
+
+
+_D1_MARK = "is produced but no registered consumer"
+_D2_MARK = "no registered producer ever writes it"
+
+
+def _stale_provable(scanned: Set[str], key: Tuple[str, str, str]) -> bool:
+    """Whether a baseline entry for this rule could have fired on a run
+    that scanned ``scanned``: whole-tree direction entries are NOT stale
+    on a run whose view didn't cover the contract's declared scope (the
+    direction was skipped, see _scope_covered). A deleted contract's
+    entries ARE stale — nothing can fire them again."""
+    _rule, _path, msg = key
+    m = re.match(r"contract '([^']+)'", msg)
+    if m is None:
+        return True
+    spec = next((s for s in CONTRACTS if s.name == m.group(1)), None)
+    if spec is None:
+        return True
+    if _D1_MARK in msg:
+        return _scope_covered(spec.consumers, scanned)
+    if _D2_MARK in msg:
+        return _scope_covered(spec.producers, scanned)
+    return True
+
+
+_contract_drift_pass.STALE_PROVABLE = _stale_provable
+
+
+# ---------------------------------------------------------------------------
+# LOCK-ORDER pass
+# ---------------------------------------------------------------------------
+
+_LOCK_NAME_HINTS = ("lock", "mutex", "sem", "cond")
+
+LockKey = Tuple[str, str]       # (owner: class name | module path, attr)
+
+
+def _lock_key(expr: ast.AST, fi: FuncInfo) -> Optional[LockKey]:
+    name = _trailing(expr)
+    if name is None:
+        return None
+    low = name.lower()
+    if not any(h in low for h in _LOCK_NAME_HINTS):
+        return None
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            return (fi.cls or fi.module, name)
+        # foo.bar._lock: key on the receiver's trailing name — coarser
+        # than a class, but never merges two different classes' locks
+        bname = _trailing(base)
+        return (bname or fi.module, name)
+    return (fi.module, name)
+
+
+def _fmt_lock(k: LockKey) -> str:
+    return f"{k[0]}.{k[1]}"
+
+
+@register("lock-order", "asyncio locks acquired in both orders on "
+                        "different call paths — the two-party deadlock")
+def _lock_order_pass(ctx: Context) -> Iterator[Finding]:
+    flows = ctx.flows()
+    graph = flows.graph
+    acquires: Dict[Tuple[str, str], Set[LockKey]] = {}
+    # ordered (outer, inner) -> best witness (path, line, qualname, via)
+    ordered: Dict[Tuple[LockKey, LockKey], Tuple[str, int, str, str]] = {}
+    calls_under: List[Tuple[Tuple[LockKey, ...], Tuple[str, str],
+                            Tuple[str, int, str]]] = []
+
+    def note_pair(outer: LockKey, inner: LockKey,
+                  witness: Tuple[str, int, str, str]) -> None:
+        if outer == inner:
+            return  # self-reacquire: ASYNC-RMW's department
+        cur = ordered.get((outer, inner))
+        if cur is None or (witness[0], witness[2]) < (cur[0], cur[2]):
+            ordered[(outer, inner)] = witness
+
+    def scan(fi: FuncInfo) -> None:
+        mine = acquires.setdefault(fi.key, set())
+
+        def rec(node: ast.AST, held: Tuple[LockKey, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired: List[LockKey] = []
+                for item in node.items:
+                    rec(item.context_expr, held + tuple(acquired))
+                    lk = _lock_key(item.context_expr, fi)
+                    if lk is not None:
+                        mine.add(lk)
+                        for h in held + tuple(acquired):
+                            note_pair(h, lk, (fi.module,
+                                              item.context_expr.lineno,
+                                              fi.qualname, ""))
+                        acquired.append(lk)
+                inner_held = held + tuple(acquired)
+                for s in node.body:
+                    rec(s, inner_held)
+                return
+            if isinstance(node, ast.Call) and held:
+                callee = graph.resolve(node.func, fi)
+                if callee is not None:
+                    calls_under.append(
+                        (held, callee.key,
+                         (fi.module, node.lineno, fi.qualname))
+                    )
+            for child in ast.iter_child_nodes(node):
+                rec(child, held)
+
+        for stmt in fi.node.body:
+            rec(stmt, ())
+
+    scoped = [
+        fi for fi in flows.index.functions()
+        if "dynamo_tpu/" in fi.module
+    ]
+    for fi in scoped:
+        scan(fi)
+
+    # transitive closure: every lock a callee (or its callees) may acquire
+    closure: Dict[Tuple[str, str], Set[LockKey]] = {
+        k: set(v) for k, v in acquires.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for fi in scoped:
+            mine = closure.setdefault(fi.key, set())
+            before = len(mine)
+            for callee in graph.callees(fi.key):
+                mine |= closure.get(callee, set())
+            if len(mine) != before:
+                changed = True
+
+    for held, callee_key, (path, line, qual) in calls_under:
+        for lk in closure.get(callee_key, ()):
+            for h in held:
+                note_pair(h, lk, (path, line, qual,
+                                  f" (via {callee_key[1]})"))
+
+    seen: Set[Tuple[LockKey, LockKey]] = set()
+    for (a, b) in sorted(ordered):
+        if (b, a) not in ordered or (b, a) in seen:
+            continue
+        seen.add((a, b))
+        w1 = ordered[(a, b)]
+        w2 = ordered[(b, a)]
+        yield Finding(
+            "LOCK-ORDER", w1[0], w1[1],
+            f"lock-order inversion: {w1[2]} acquires "
+            f"{_fmt_lock(a)} then {_fmt_lock(b)}{w1[3]}, but {w2[2]} "
+            f"acquires {_fmt_lock(b)} then {_fmt_lock(a)}{w2[3]} — two "
+            f"tasks on these paths deadlock; pick one global order",
+        )
+
+
+_lock_order_pass.RULES = ("LOCK-ORDER",)
+
+
+# ---------------------------------------------------------------------------
+# EVENT-LIVENESS pass
+# ---------------------------------------------------------------------------
+
+class _Uf:
+    def __init__(self) -> None:
+        self.p: Dict[str, str] = {}
+
+    def find(self, x: str) -> str:
+        self.p.setdefault(x, x)
+        while self.p[x] != x:
+            self.p[x] = self.p[self.p[x]]
+            x = self.p[x]
+        return x
+
+    def union(self, a: str, b: str) -> None:
+        self.p[self.find(a)] = self.find(b)
+
+
+def _event_inventory(ctx: Context):
+    """(event_names, aliases, wait_sites, set_sites) over the module set.
+    Identity is the trailing receiver name (``self._warm_evt`` and a local
+    ``evt = self._warm_evt`` alias fold into one group). Waits bounded by
+    ``asyncio.wait_for`` are NOT liveness-critical (they time out instead
+    of hanging) and are left out of wait_sites."""
+    event_names: Set[str] = set()
+    uf = _Uf()
+    # name -> [(path, line, in_loop)]
+    wait_sites: Dict[str, List[Tuple[str, int, bool]]] = {}
+    set_sites: Dict[str, List[Site]] = {}
+    alias_pairs: List[Tuple[str, str]] = []
+
+    for m in ctx.modules:
+        call_funcs: Set[int] = set()
+        timed_waits: Set[int] = set()
+        for n in ast.walk(m.tree):
+            if isinstance(n, ast.Call):
+                call_funcs.add(id(n.func))
+                if _trailing(n.func) == "wait_for":
+                    for sub in ast.walk(n):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "wait"
+                        ):
+                            timed_waits.add(id(sub))
+
+        def visit(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, ast.Assign):
+                tnames = [t for t in (
+                    _trailing(t) for t in node.targets
+                ) if t]
+                if isinstance(node.value, ast.Call) and _trailing(
+                    node.value.func
+                ) == "Event":
+                    for t in tnames:
+                        event_names.add(t)
+                    for a, b in zip(tnames, tnames[1:]):
+                        alias_pairs.append((a, b))
+                else:
+                    vname = _trailing(node.value)
+                    if vname:
+                        for t in tnames:
+                            alias_pairs.append((t, vname))
+            if isinstance(node, ast.Call):
+                cname = _trailing(node.func)
+                recv = None
+                if isinstance(node.func, ast.Attribute):
+                    recv = _trailing(node.func.value)
+                if recv is not None and not node.args and not node.keywords:
+                    if cname == "wait" and id(node) not in timed_waits:
+                        wait_sites.setdefault(recv, []).append(
+                            (m.path, node.lineno, in_loop)
+                        )
+                    elif cname == "set":
+                        set_sites.setdefault(recv, []).append(
+                            (m.path, node.lineno)
+                        )
+            # bare method REFERENCE handed to a callback registrar
+            # (loop.add_signal_handler(SIGTERM, stop.set)) is a set site
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "set"
+                and isinstance(node.ctx, ast.Load)
+                and id(node) not in call_funcs
+            ):
+                recv = _trailing(node.value)
+                if recv:
+                    set_sites.setdefault(recv, []).append(
+                        (m.path, node.lineno)
+                    )
+            loop_now = in_loop or isinstance(node, (ast.While, ast.For,
+                                                    ast.AsyncFor))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    # fresh loop context inside a nested scope
+                    visit(child, False)
+                else:
+                    visit(child, loop_now)
+
+        visit(m.tree, False)
+
+    # alias chains may be recorded in any order: run to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for a, b in alias_pairs:
+            if (a in event_names) != (b in event_names):
+                changed = True
+            if a in event_names or b in event_names:
+                uf.union(a, b)
+                event_names.add(a)
+                event_names.add(b)
+    return event_names, uf, wait_sites, set_sites
+
+
+def _is_set_guard(test: ast.AST) -> Optional[Tuple[str, bool]]:
+    """('evt', True) when the test is ``evt.is_set()`` (possibly
+    not-wrapped): the returned bool is the branch on which the event is
+    known set."""
+    polarity = True
+    while isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        polarity = not polarity
+        test = test.operand
+    if (
+        isinstance(test, ast.Call)
+        and isinstance(test.func, ast.Attribute)
+        and test.func.attr == "is_set"
+        and not test.args
+    ):
+        recv = _trailing(test.func.value)
+        if recv:
+            return recv, polarity
+    return None
+
+
+@register("event-liveness", "awaited asyncio.Events must stay settable: "
+                            "zero-setter, rollback set-then-clear, and "
+                            "paths that strand waiters")
+def _event_liveness_pass(ctx: Context) -> Iterator[Finding]:
+    event_names, uf, wait_sites, set_sites = _event_inventory(ctx)
+    flows = ctx.flows()
+
+    def group(name: str) -> Set[str]:
+        root = uf.find(name)
+        return {n for n in event_names if uf.find(n) == root}
+
+    def group_waits(names: Set[str]) -> List[Tuple[str, int, bool]]:
+        out: List[Tuple[str, int, bool]] = []
+        for n in names:
+            out.extend(wait_sites.get(n, ()))
+        return sorted(out)
+
+    # (1) awaited event with no set site anywhere — whole-tree only
+    if not getattr(ctx, "partial", False):
+        reported: Set[str] = set()
+        for name in sorted(wait_sites):
+            if name not in event_names:
+                continue  # not provably an asyncio.Event (Condition, custom)
+            g = group(name)
+            root = uf.find(name)
+            if root in reported:
+                continue
+            if any(n in set_sites for n in g):
+                continue
+            reported.add(root)
+            path, line, _ = group_waits(g)[0]
+            yield Finding(
+                "EVENT-LIVENESS", path, line,
+                f"asyncio.Event '{name}' is awaited here but nothing in "
+                f"the scanned tree ever calls set() on it — every waiter "
+                f"hangs forever",
+            )
+
+    # (2) + (3): per-function shapes
+    for fi in flows.index.functions():
+        if "dynamo_tpu/" not in fi.module and "tools/" not in fi.module:
+            continue
+        # (2) set()-then-clear() in the same rollback scope
+        for t in [n for n in _walk_no_defs(fi.node)
+                  if isinstance(n, ast.Try)]:
+            scopes = [h.body for h in t.handlers]
+            if t.finalbody:
+                scopes.append(t.finalbody)
+            for body in scopes:
+                raw: List[Tuple[int, int, str, str]] = []
+                for stmt in body:
+                    for n in _walk_no_defs(stmt):
+                        if not (isinstance(n, ast.Call) and not n.args
+                                and not n.keywords
+                                and isinstance(n.func, ast.Attribute)):
+                            continue
+                        recv = _trailing(n.func.value)
+                        if recv in event_names and n.func.attr in (
+                            "set", "clear"
+                        ):
+                            raw.append((n.lineno, n.col_offset,
+                                        n.func.attr, recv))
+                seq: List[Tuple[str, str, int]] = [
+                    (kind, recv, line)
+                    for line, _col, kind, recv in sorted(raw)
+                ]
+                for i, (kind, recv, _line) in enumerate(seq):
+                    if kind != "set":
+                        continue
+                    for kind2, recv2, line2 in seq[i + 1:]:
+                        if kind2 != "clear" or recv2 != recv:
+                            continue
+                        waits = group_waits(group(recv))
+                        if not waits:
+                            continue
+                        if all(w[2] for w in waits):
+                            continue  # every waiter re-elects in a loop
+                        yield Finding(
+                            "EVENT-LIVENESS", fi.module, line2,
+                            f"rollback set()-then-clear() on event "
+                            f"'{recv}' in {fi.qualname}: a waiter that "
+                            f"wakes re-checks a cleared event and late "
+                            f"waiters hang — leave it set, or make every "
+                            f"wait site re-elect in a loop (the zmq "
+                            f"_warm shape)",
+                        )
+                        break
+
+        # (3) must-set on every non-exceptional path, for functions whose
+        # set visibly participates in rollback (a set inside a try)
+        try_set_names: Set[str] = set()
+        for t in [n for n in _walk_no_defs(fi.node)
+                  if isinstance(n, ast.Try)]:
+            for n in _walk_no_defs(t):
+                if (
+                    isinstance(n, ast.Call) and not n.args and not n.keywords
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "set"
+                ):
+                    recv = _trailing(n.func.value)
+                    if recv in event_names and group_waits(group(recv)):
+                        try_set_names.add(recv)
+        if not try_set_names:
+            continue
+        cfg = build_cfg(fi.node)
+        for ev in sorted(try_set_names):
+            aliases = group(ev)
+            universe = frozenset([ev])
+            gen: Dict[int, FrozenSet[str]] = {}
+            for idx, cnode in enumerate(cfg.nodes):
+                if cnode.node is None:
+                    continue
+                if cnode.kind == ASSUME:
+                    guard = _is_set_guard(cnode.node)
+                    if guard and guard[0] in aliases and (
+                        guard[1] == cnode.meta.get("branch")
+                    ):
+                        gen[idx] = universe
+                    continue
+                for n in _walk_no_defs(cnode.node):
+                    if (
+                        isinstance(n, ast.Call) and not n.args
+                        and not n.keywords
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("set", "wait")
+                    ):
+                        recv = _trailing(n.func.value)
+                        if recv in aliases:
+                            gen[idx] = universe
+                            break
+            reached = _must_reach_exit(cfg, gen, universe)
+            if reached is None or ev in reached:
+                continue
+            yield Finding(
+                "EVENT-LIVENESS", fi.module, fi.node.lineno,
+                f"event '{ev}': {fi.qualname} sets it under a try but a "
+                f"non-exceptional path out never set()s it — waiters on "
+                f"that path hang; set on every normal exit or wake "
+                f"waiters in the rollback",
+            )
+
+
+_event_liveness_pass.RULES = ("EVENT-LIVENESS",)

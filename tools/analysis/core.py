@@ -166,7 +166,7 @@ def rule_ids() -> List[str]:
 def _load_builtin_passes() -> None:
     # deferred so core is importable without the pass modules (and so the
     # shim can import pieces without triggering registration twice)
-    from . import asyncpass, drift, legacy, lifecycle, purity  # noqa: F401  # dtpu: ignore[UNUSED-IMPORT] — imported for @register side effects
+    from . import asyncpass, contracts, drift, legacy, lifecycle, purity  # noqa: F401  # dtpu: ignore[UNUSED-IMPORT] — imported for @register side effects
 
 
 # -- module loading ----------------------------------------------------------
@@ -418,14 +418,24 @@ def run(
         baseline = load_baseline(baseline_path)
         new, suppressed, stale = apply_baseline(findings, baseline)
         # an entry is only provably stale if this run could have produced it:
-        # its file was scanned and its rule ran (wasn't filtered by --select)
+        # its file was scanned, its rule ran (wasn't filtered by --select),
+        # and the pass doesn't disclaim it for this view (STALE_PROVABLE —
+        # whole-tree contract directions skip on scope-narrowed scans)
         scanned = {m.path for m in modules}
         wanted = set(select) if select is not None else None
+        provers: Dict[str, Callable] = {}
+        for _name, (fn, _doc) in registered_passes().items():
+            hook = getattr(fn, "STALE_PROVABLE", None)
+            if hook is not None:
+                for r in getattr(fn, "RULES", ()):
+                    provers[r] = hook
         stale = Counter(
             {
                 (r, p, m): n
                 for (r, p, m), n in stale.items()
-                if p in scanned and (wanted is None or r in wanted)
+                if p in scanned
+                and (wanted is None or r in wanted)
+                and (r not in provers or provers[r](scanned, (r, p, m)))
             }
         )
     else:

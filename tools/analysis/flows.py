@@ -360,6 +360,12 @@ class Cfg:
     def __init__(self) -> None:
         self.nodes: List[CfgNode] = [CfgNode(ENTRY, None), CfgNode(EXIT, None)]
         self.succ: List[Set[int]] = [set(), set()]
+        # edges taken only while an exception PROPAGATES (raise out,
+        # cancellation at a suspend point, generator abandonment at a
+        # yield). Edges INTO handlers are normal: the exception dies there
+        # and the path continues as ordinary control flow. Must-analyses
+        # over "every non-exceptional path" (contracts.py) drop these.
+        self.exc_edges: Set[Tuple[int, int]] = set()
 
     ENTRY_ID = 0
     EXIT_ID = 1
@@ -369,12 +375,14 @@ class Cfg:
         self.succ.append(set())
         return len(self.nodes) - 1
 
-    def edge(self, a: int, b: int) -> None:
+    def edge(self, a: int, b: int, exceptional: bool = False) -> None:
         self.succ[a].add(b)
+        if exceptional:
+            self.exc_edges.add((a, b))
 
-    def connect(self, frontier: Iterable[int], b: int) -> None:
+    def connect(self, frontier: Iterable[int], b: int, exceptional: bool = False) -> None:
         for a in frontier:
-            self.edge(a, b)
+            self.edge(a, b, exceptional=exceptional)
 
     def preds(self) -> List[Set[int]]:
         out: List[Set[int]] = [set() for _ in self.nodes]
@@ -434,6 +442,13 @@ class _CfgBuilder:
         # finallys continue outward after running — a finally entered purely
         # by normal flow must not grow a phantom edge past the code after it
         self._abrupt_used: Set[int] = set()
+        # of those, which were entered by a propagating exception vs a
+        # return: a finally entered ONLY exceptionally continues outward on
+        # an exceptional edge (so non-exceptional-path analyses skip it);
+        # mixed entries stay normal — prefer checking too many paths only
+        # when a return genuinely flows through
+        self._exc_used: Set[int] = set()
+        self._ret_used: Set[int] = set()
         frontier = self.lower_body(fn.body, {Cfg.ENTRY_ID})
         self.cfg.connect(frontier, Cfg.EXIT_ID)
 
@@ -441,11 +456,12 @@ class _CfgBuilder:
     def abrupt_target(self) -> int:
         return self.finally_stack[-1] if self.finally_stack else Cfg.EXIT_ID
 
-    def abrupt_edge(self, idx: int) -> None:
+    def abrupt_edge(self, idx: int, exceptional: bool = False) -> None:
         tgt = self.abrupt_target()
-        self.cfg.edge(idx, tgt)
+        self.cfg.edge(idx, tgt, exceptional=exceptional)
         if self.finally_stack and tgt == self.finally_stack[-1]:
             self._abrupt_used.add(tgt)
+            (self._exc_used if exceptional else self._ret_used).add(tgt)
 
     def _exception_edges(self, idx: int) -> None:
         """A SUSPENDING statement inside a try body may abort: edge to each
@@ -460,7 +476,7 @@ class _CfgBuilder:
         for h in entries:
             self.cfg.edge(idx, h)
         if not broad:
-            self.abrupt_edge(idx)
+            self.abrupt_edge(idx, exceptional=True)
 
     def _stmt_node(self, stmt: ast.AST, frontier: Set[int], **meta) -> int:
         idx = self.cfg.new(STMT, stmt, **meta)
@@ -476,7 +492,7 @@ class _CfgBuilder:
         ):
             # generator-exit: the consumer may abandon the stream at this
             # yield — GeneratorExit runs the finally chain and leaves
-            self.abrupt_edge(idx)
+            self.abrupt_edge(idx, exceptional=True)
         return idx
 
     def lower_body(self, body: List[ast.stmt], frontier: Set[int]) -> Set[int]:
@@ -502,9 +518,17 @@ class _CfgBuilder:
             head = self._stmt_node(stmt.test, frontier)
             narrow = _narrowing(stmt.test)
             a_true = cfg.new(ASSUME, stmt.test, narrow=narrow, branch=True)
-            a_false = cfg.new(ASSUME, stmt.test, narrow=narrow, branch=False)
             cfg.edge(head, a_true)
-            cfg.edge(head, a_false)
+            # ``while True:`` never falls through the test: a phantom false
+            # branch would fabricate a path that skips the body entirely and
+            # breaks every must-analysis over the loop (the zmq _warm shape)
+            infinite = isinstance(stmt.test, ast.Constant) and bool(stmt.test.value)
+            if infinite:
+                falls: Set[int] = set()
+            else:
+                a_false = cfg.new(ASSUME, stmt.test, narrow=narrow, branch=False)
+                cfg.edge(head, a_false)
+                falls = {a_false}
             breaks: List[int] = []
             self.loop_stack.append((head, breaks))
             body_out = self.lower_body(stmt.body, {a_true})
@@ -512,8 +536,8 @@ class _CfgBuilder:
             cfg.connect(body_out, head)
             # while/else runs on every non-break exit; break skips it
             if stmt.orelse:
-                return self.lower_body(stmt.orelse, {a_false}) | set(breaks)
-            return {a_false} | set(breaks)
+                return self.lower_body(stmt.orelse, falls) | set(breaks)
+            return falls | set(breaks)
         if isinstance(stmt, (ast.For, ast.AsyncFor)):
             head = self._stmt_node(
                 stmt.iter, frontier, for_target=stmt.target, for_iter=stmt.iter
@@ -542,7 +566,7 @@ class _CfgBuilder:
             if self.try_handlers:
                 for h in self.try_handlers[-1][0]:
                     cfg.edge(idx, h)
-            self.abrupt_edge(idx)
+            self.abrupt_edge(idx, exceptional=True)
             return set()
         if isinstance(stmt, ast.Break):
             idx = self._stmt_node(stmt, frontier)
@@ -596,9 +620,14 @@ class _CfgBuilder:
             # flow proceeds to the code after the try, nothing else
             if fin_entry in self._abrupt_used:
                 outer = self.abrupt_target()
-                cfg.connect(fin_out, outer)
+                exc_only = (
+                    fin_entry in self._exc_used
+                    and fin_entry not in self._ret_used
+                )
+                cfg.connect(fin_out, outer, exceptional=exc_only)
                 if self.finally_stack and outer == self.finally_stack[-1]:
                     self._abrupt_used.add(outer)
+                    (self._exc_used if exc_only else self._ret_used).add(outer)
             return set(fin_out)
         return merged
 
